@@ -1,0 +1,4 @@
+__version__ = "0.1.0"
+__author__ = "metrics_tpu contributors"
+__license__ = "Apache-2.0"
+__docs__ = "TPU-native metrics framework (jax/XLA/Pallas)"

@@ -1,0 +1,11 @@
+"""metrics_tpu: a TPU-native metrics framework.
+
+Capability parity with TorchMetrics v0.9.0dev (reference mounted at
+/root/reference; see SURVEY.md), redesigned for jax/XLA: metric state as
+immutable pytrees, pure jittable init/update/compute/merge, distributed sync as
+mesh-axis collectives, and heavy kernels (Inception forwards, IoU matching,
+SSIM convs) as jitted XLA programs.
+"""
+from metrics_tpu.__about__ import __version__  # noqa: F401
+from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric  # noqa: F401
+from metrics_tpu.core import CompositionalMetric, Metric, MetricCollection  # noqa: F401

@@ -1,0 +1,161 @@
+"""Aggregation metrics with NaN handling policies.
+
+Reference parity: torchmetrics/aggregation.py (356 LoC) — ``BaseAggregator``
+(:24), ``MaxMetric`` (:94), ``MinMetric`` (:143), ``SumMetric`` (:192),
+``CatMetric`` (:240), ``MeanMetric`` (:290).
+
+TPU-first note: the reference drops NaNs by boolean indexing (``x[~nans]``,
+aggregation.py:80) which is a dynamic shape; here NaN handling is expressed as
+*masking* (impute with the reduction's identity element and zero the weight),
+so every aggregator update is jittable with static shapes. ``CatMetric`` keeps
+the eager filter since its state is an unbounded buffer anyway.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.checks import _is_concrete
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class BaseAggregator(Metric):
+    """Base for simple aggregators: one ``value`` state + a NaN strategy.
+
+    ``nan_strategy``: ``"error"`` | ``"warn"`` | ``"ignore"`` | float (impute).
+    """
+
+    value: Union[Array, List[Array]]
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        fn: Union[Callable, str],
+        default_value: Union[Array, List],
+        nan_strategy: Union[str, float] = "error",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_nan_strategy = ("error", "warn", "ignore")
+        if nan_strategy not in allowed_nan_strategy and not isinstance(nan_strategy, float):
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy} but got {nan_strategy}."
+            )
+        self.nan_strategy = nan_strategy
+        self.add_state("value", default=default_value, dist_reduce_fx=fn)
+
+    def _cast_and_nan_check_input(self, x: Union[float, Array], weight: Union[float, Array, None] = None) -> Tuple[Array, Array]:
+        """Cast to float and apply the NaN strategy via masking.
+
+        Returns ``(x, weight)`` where invalid positions carry zero weight and an
+        imputed value, keeping shapes static (reference filters at :80).
+        """
+        x = jnp.asarray(x, dtype=jnp.float32)
+        weight = jnp.ones_like(x) if weight is None else jnp.broadcast_to(jnp.asarray(weight, dtype=jnp.float32), x.shape)
+        nans = jnp.isnan(x) | jnp.isnan(weight)
+        if self.nan_strategy == "error":
+            if _is_concrete(x, weight) and bool(jnp.any(nans)):
+                raise RuntimeError("Encountered `nan` values in tensor")
+        elif self.nan_strategy in ("ignore", "warn"):
+            if self.nan_strategy == "warn" and _is_concrete(x, weight) and bool(jnp.any(nans)):
+                rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+            x = jnp.where(nans, 0.0, x)
+            weight = jnp.where(nans, 0.0, weight)
+        else:
+            x = jnp.where(nans, float(self.nan_strategy), x)
+            weight = jnp.where(jnp.isnan(weight), float(self.nan_strategy), weight)
+        return x, weight
+
+    def update(self, value: Union[float, Array]) -> None:  # type: ignore[override]
+        pass
+
+    def compute(self) -> Array:
+        return self.value
+
+
+class MaxMetric(BaseAggregator):
+    """Running max. Reference: aggregation.py:94-141."""
+
+    full_state_update = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", jnp.asarray(-jnp.inf), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:  # type: ignore[override]
+        value, weight = self._cast_and_nan_check_input(value)
+        if value.size:  # NaN-masked entries became weight 0 with value 0; use -inf there
+            masked = jnp.where(weight > 0, value, -jnp.inf)
+            self.value = jnp.maximum(self.value, jnp.max(masked))
+
+
+class MinMetric(BaseAggregator):
+    """Running min. Reference: aggregation.py:143-190."""
+
+    full_state_update = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.asarray(jnp.inf), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:  # type: ignore[override]
+        value, weight = self._cast_and_nan_check_input(value)
+        if value.size:
+            masked = jnp.where(weight > 0, value, jnp.inf)
+            self.value = jnp.minimum(self.value, jnp.min(masked))
+
+
+class SumMetric(BaseAggregator):
+    """Running sum. Reference: aggregation.py:192-238."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:  # type: ignore[override]
+        value, weight = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value = self.value + jnp.sum(jnp.where(weight > 0, value, 0.0))
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate all seen values. Reference: aggregation.py:240-288."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:  # type: ignore[override]
+        value, weight = self._cast_and_nan_check_input(value)
+        if value.size and self.nan_strategy in ("ignore", "warn") and _is_concrete(value):
+            import numpy as np
+
+            keep = np.asarray(weight) > 0
+            value = jnp.asarray(jnp.atleast_1d(value)[jnp.asarray(keep).reshape(-1)]) if not bool(keep.all()) else value
+        if value.size:
+            self.value = self.value + [value]
+
+    def compute(self) -> Array:
+        if isinstance(self.value, list) and self.value:
+            return dim_zero_cat(self.value)
+        return self.value
+
+
+class MeanMetric(BaseAggregator):
+    """Weighted running mean. Reference: aggregation.py:290-356."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
+        self.add_state("weight", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:  # type: ignore[override]
+        value, weight = self._cast_and_nan_check_input(value, weight)
+        if value.size == 0:
+            return
+        self.value = self.value + jnp.sum(value * weight)
+        self.weight = self.weight + jnp.sum(weight)
+
+    def compute(self) -> Array:
+        return self.value / self.weight

@@ -1,0 +1,311 @@
+"""MetricCollection: dict-of-metrics with compute-group fusion.
+
+Reference parity: torchmetrics/collections.py (409 LoC) — shared call signature
+(:150-179), compute-group fusion (:181-253), prefix/postfix naming, nested
+collections, ``add_metrics`` (:279).
+
+TPU-first redesign (SURVEY.md §7 decision 5):
+
+- **Static compute groups.** The reference discovers groups at runtime by
+  probing state equality after the first update (collections.py:181-239, with a
+  documented ~100-step break-even). Here groups are computed at construction
+  from ``Metric._update_signature()`` — metrics whose updates provably produce
+  identical state (e.g. the whole stat-scores family with equal init args)
+  declare equal keys. Zero runtime probing cost.
+- **State sharing is free.** Because state pytrees are immutable, broadcasting
+  the group leader's state to members is reference assignment, not the deep
+  copy the reference performs at collections.py:243-250.
+- **One collective bundle per group.** ``compute`` syncs the group leader once
+  and injects the synced state into every member, instead of the reference's
+  redundant per-member all-gathers over identical state (SURVEY.md §3.3 note).
+- **Fused pure protocol**: ``init_state/update_state/compute_state/sync_states``
+  operate on ``{leader_name: state}`` so a whole collection's update + sync
+  compiles into a single XLA call (the BASELINE.md config-2 target).
+"""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
+
+from metrics_tpu.core.metric import Metric, StateDict
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+
+class MetricCollection:
+    """Ordered dict of metrics sharing one call signature.
+
+    Args:
+        metrics: a Metric, a sequence of Metrics, or a dict name->Metric.
+        additional_metrics: more metrics when ``metrics`` is a single one.
+        prefix / postfix: added to every output key.
+        compute_groups: enable static compute-group fusion (default True).
+    """
+
+    _modules: Dict[str, Metric]
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        compute_groups: bool = True,
+    ) -> None:
+        self._metrics: Dict[str, Metric] = {}
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self._enable_compute_groups = compute_groups
+        self._groups: List[List[str]] = []
+        self.add_metrics(metrics, *additional_metrics)
+
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    # ------------------------------------------------------------------ #
+    # construction
+    # ------------------------------------------------------------------ #
+    def add_metrics(self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric) -> None:
+        """Add metrics to the collection (reference: collections.py:279-330)."""
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence):
+            metrics = list(metrics)
+            remain: list = []
+            for m in additional_metrics:
+                (metrics if isinstance(m, Metric) else remain).append(m)
+            if remain:
+                raise ValueError(f"You have passes extra arguments {remain} which are not `Metric` so they will be ignored.")
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passes extra arguments {additional_metrics} which are not compatible"
+                f" with first passed dictionary {metrics} so they will be ignored."
+            )
+
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Value {metric} belonging to key {name} is not an instance of"
+                        " `metrics_tpu.Metric` or `metrics_tpu.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    self[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self[f"{name}_{k}"] = v
+        elif isinstance(metrics, Sequence):
+            for metric in metrics:
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Input {metric} to `MetricCollection` is not a instance of"
+                        " `metrics_tpu.Metric` or `metrics_tpu.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    name = metric.__class__.__name__
+                    if name in self:
+                        raise ValueError(f"Encountered two metrics both named {name}")
+                    self[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self[k] = v
+        else:
+            raise ValueError("Unknown input to MetricCollection.")
+        self._rebuild_groups()
+
+    def _rebuild_groups(self) -> None:
+        """Static grouping by update signature (no runtime probing)."""
+        self._groups = []
+        if not self._enable_compute_groups:
+            self._groups = [[k] for k in self.keys(keep_base=True)]
+            return
+        sig_to_group: Dict[Hashable, List[str]] = {}
+        for name, metric in self.items(keep_base=True):
+            sig = metric._update_signature()
+            if sig is None:
+                self._groups.append([name])
+            else:
+                sig_to_group.setdefault(sig, []).append(name)
+        self._groups.extend(sig_to_group.values())
+
+    @property
+    def compute_groups(self) -> Dict[int, List[str]]:
+        """Group index -> member names (reference: collections.py property)."""
+        return {i: list(g) for i, g in enumerate(self._groups)}
+
+    # ------------------------------------------------------------------ #
+    # dict interface with prefix/postfix handling
+    # ------------------------------------------------------------------ #
+    def _set_name(self, base: str) -> str:
+        name = base if self.prefix is None else self.prefix + base
+        return name if self.postfix is None else name + self.postfix
+
+    def keys(self, keep_base: bool = False):  # type: ignore[override]
+        if keep_base:
+            return list(self._metrics.keys())
+        return [self._set_name(k) for k in self._metrics.keys()]
+
+    def items(self, keep_base: bool = False):  # type: ignore[override]
+        if keep_base:
+            return list(self._metrics.items())
+        return [(self._set_name(k), v) for k, v in self._metrics.items()]
+
+    def values(self):
+        return list(self._metrics.values())
+
+    def __getitem__(self, key: str) -> Metric:
+        if key in self._metrics:
+            return self._metrics[key]
+        # allow lookup by prefixed name
+        for k in self._metrics:
+            if self._set_name(k) == key:
+                return self._metrics[k]
+        raise KeyError(key)
+
+    def __setitem__(self, key: str, metric: Metric) -> None:
+        self._metrics[key] = metric
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._metrics
+
+    def __iter__(self):
+        return iter(self._metrics)
+
+    def __len__(self) -> int:
+        return len(self._metrics)
+
+    # ------------------------------------------------------------------ #
+    # metric interface
+    # ------------------------------------------------------------------ #
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Per-member forward (batch value + accumulation). Reference: :150-158."""
+        res = {self._set_name(k): m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items(keep_base=True)}
+        return _flatten_results(res)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Fused update: one update per compute group; members share the
+        leader's (immutable) state by reference. Reference: :160-179."""
+        for group in self._groups:
+            leader = self._metrics.__getitem__(group[0])
+            leader.update(*args, **leader._filter_kwargs(**kwargs))
+            if len(group) > 1:
+                state = leader.get_state()
+                for name in group[1:]:
+                    m = self._metrics.__getitem__(name)
+                    m.set_state(state)
+                    m._update_count = leader._update_count
+                    m._computed = None
+
+    def compute(self) -> Dict[str, Any]:
+        """One sync per group, value per member. Reference: :241-253."""
+        res: Dict[str, Any] = {}
+        for group in self._groups:
+            leader = self._metrics.__getitem__(group[0])
+            leader.sync(should_sync=leader._to_sync)
+            synced_state = leader.get_state()
+            synced = leader._is_synced
+            for name in group:
+                m = self._metrics.__getitem__(name)
+                if m is not leader:
+                    m.set_state(synced_state)
+                    m._update_count = leader._update_count
+                prev_to_sync, prev_should_unsync = m._to_sync, m._should_unsync
+                # group already synced; keep the member's compute from both
+                # re-syncing and un-syncing the shared state mid-loop
+                m._to_sync, m._should_unsync = False, False
+                try:
+                    res[self._set_name(name)] = m.compute()
+                finally:
+                    m._to_sync, m._should_unsync = prev_to_sync, prev_should_unsync
+            if synced:
+                leader.unsync()
+                local = leader.get_state()
+                for name in group[1:]:
+                    self._metrics.__getitem__(name).set_state(local)
+        return _flatten_results(res)
+
+    def reset(self) -> None:
+        for m in self.values():
+            m.reset()
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        mc = deepcopy(self)
+        if prefix:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        for m in self.values():
+            m.persistent(mode)
+
+    def state_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for k, m in self.items(keep_base=True):
+            out.update(m.state_dict(prefix=f"{k}."))
+        return out
+
+    def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
+        for k, m in self.items(keep_base=True):
+            m.load_state_dict(state_dict, prefix=f"{k}.", strict=strict)
+
+    # ------------------------------------------------------------------ #
+    # fused pure protocol (the compiled hot path)
+    # ------------------------------------------------------------------ #
+    def init_state(self) -> Dict[str, StateDict]:
+        """One state pytree per compute group, keyed by leader name."""
+        return {g[0]: self._metrics.__getitem__(g[0]).init_state() for g in self._groups}
+
+    def update_state(self, states: Dict[str, StateDict], *args: Any, **kwargs: Any) -> Dict[str, StateDict]:
+        """Pure fused update — jit this (optionally together with the model
+        forward) for the single-XLA-call per-step path."""
+        out = {}
+        for group in self._groups:
+            leader = self._metrics.__getitem__(group[0])
+            out[group[0]] = leader.update_state(states[group[0]], *args, **leader._filter_kwargs(**kwargs))
+        return out
+
+    def compute_state(self, states: Dict[str, StateDict]) -> Dict[str, Any]:
+        """Pure fused compute over per-group states."""
+        res = {}
+        for group in self._groups:
+            for name in group:
+                m = self._metrics.__getitem__(name)
+                res[self._set_name(name)] = m.compute_state(states[group[0]])
+        return _flatten_results(res)
+
+    def sync_states(self, states: Dict[str, StateDict], axis_name: Union[str, Tuple[str, ...]]) -> Dict[str, StateDict]:
+        """Pure fused sync: exactly one collective bundle per compute group."""
+        out = {}
+        for group in self._groups:
+            leader = self._metrics.__getitem__(group[0])
+            out[group[0]] = leader.sync_states(states[group[0]], axis_name)
+        return out
+
+    def __repr__(self) -> str:
+        repr_str = self.__class__.__name__ + "(\n"
+        for k, v in self.items(keep_base=True):
+            repr_str += f"  ({k}): {repr(v)}\n"
+        if self.prefix:
+            repr_str += f"  prefix={self.prefix}\n"
+        if self.postfix:
+            repr_str += f"  postfix={self.postfix}\n"
+        return repr_str + ")"
+
+
+def _flatten_results(res: Dict[str, Any]) -> Dict[str, Any]:
+    """Flatten nested dict results (e.g. ClasswiseWrapper) one level."""
+    out: Dict[str, Any] = {}
+    for k, v in res.items():
+        if isinstance(v, dict):
+            out.update(v)
+        else:
+            out[k] = v
+    return out

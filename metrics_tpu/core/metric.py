@@ -1,0 +1,659 @@
+"""The Metric base runtime.
+
+Reference parity: torchmetrics/metric.py (938 LoC) — `Metric` ABC with
+``add_state`` (:149), ``forward`` (:219) and its full/reduced variants
+(:240/:281), ``_reduce_states`` (:317), the distributed sync engine
+(:346-483), compute caching (:485-523), ``reset`` (:524), serialization
+(:639-677), kwarg filtering (:679) and the operator overloads (:720-823).
+
+TPU-first redesign (SURVEY.md §7 design decisions 1-2):
+
+- **State is a pytree** of jax arrays (plus python lists for unbounded ``cat``
+  buffers). Because jax arrays are immutable, the reference's cache/restore
+  choreography in ``forward`` and ``sync``/``unsync`` collapses to keeping
+  references: snapshotting state is free, restoring is reassignment.
+- **Pure functional protocol** alongside the stateful facade: ``init_state()``,
+  ``update_state(state, *args)``, ``compute_state(state)``,
+  ``merge_states(a, b)``, ``sync_states(state, axis_name)`` are all pure and
+  jittable, so a whole train/eval step (model forward + metric update + psum
+  sync) compiles to one XLA program.
+- **Sync emits the reduction as the collective** — ``psum``/``pmean``/``pmax``/
+  ``pmin`` directly over named mesh axes instead of the reference's
+  gather-then-reduce (metric.py:361-372); ``all_gather`` only for cat states.
+  The ``process_group`` kwarg maps to mesh-axis name(s).
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+from contextlib import contextmanager
+from copy import deepcopy
+from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.parallel import sync as _sync
+from metrics_tpu.utils.data import (
+    _flatten,
+    _squeeze_if_scalar,
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+)
+from metrics_tpu.utils.exceptions import MetricsUserError
+from metrics_tpu.utils.prints import rank_zero_warn
+
+StateValue = Union[Array, List[Array]]
+StateDict = Dict[str, StateValue]
+
+_PROTECTED_PROPERTIES = ("is_differentiable", "higher_is_better", "full_state_update")
+
+
+def _copy_state_value(value: StateValue) -> StateValue:
+    """Snapshot a state leaf. Arrays are immutable (free); lists are re-wrapped."""
+    return list(value) if isinstance(value, list) else value
+
+
+class Metric:
+    """Base class for all metrics: stateful facade over a pure pytree protocol.
+
+    Args (kwargs, reference metric.py:90-108):
+        compute_on_cpu: move list states to host memory after each update (the
+            reference's GPU-memory relief valve; here device->host offload).
+        dist_sync_on_step: synchronize state across devices in ``forward``
+            (per-step collective; under jit XLA overlaps it with compute).
+        process_group: mesh axis name(s) to sync over, e.g. ``'data'`` or
+            ``('data', 'model')``. ``None`` = the ambient ``sync_axes`` context.
+        dist_sync_fn: custom callable ``(state_dict, reductions, axis) -> state_dict``
+            replacing the built-in collective sync.
+        sync_on_compute: whether ``compute()`` synchronizes automatically.
+    """
+
+    __jit_unwrapped__ = True  # marker: methods close over self as static config
+
+    is_differentiable: Optional[bool] = None
+    higher_is_better: Optional[bool] = None
+    full_state_update: Optional[bool] = True
+
+    def __init__(
+        self,
+        compute_on_cpu: bool = False,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Union[str, Tuple[str, ...]]] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        sync_on_compute: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        if kwargs:
+            raise ValueError(f"Unexpected keyword arguments: {list(kwargs)}")
+        if not isinstance(compute_on_cpu, bool):
+            raise ValueError(f"Expected keyword argument `compute_on_cpu` to be a `bool` but got {compute_on_cpu}")
+        if not isinstance(dist_sync_on_step, bool):
+            raise ValueError(f"Expected keyword argument `dist_sync_on_step` to be a `bool` but got {dist_sync_on_step}")
+        if dist_sync_fn is not None and not callable(dist_sync_fn):
+            raise ValueError(f"Expected keyword argument `dist_sync_fn` to be callable or None but got {dist_sync_fn}")
+        self.compute_on_cpu = compute_on_cpu
+        self.dist_sync_on_step = dist_sync_on_step
+        self.process_group = process_group
+        self.dist_sync_fn = dist_sync_fn
+        self.sync_on_compute = sync_on_compute
+
+        self._defaults: Dict[str, StateValue] = {}
+        self._persistent: Dict[str, bool] = {}
+        self._reductions: Dict[str, Optional[Union[str, Callable]]] = {}
+
+        self._update_count = 0
+        self._forward_cache: Any = None
+        self._computed: Any = None
+        self._to_sync = self.sync_on_compute
+        self._should_unsync = True
+        self._is_synced = False
+        self._cache: Optional[StateDict] = None
+
+        # wrap the subclass update/compute with bookkeeping (reference :118-119)
+        self.update: Callable = self._wrap_update(self.update)  # type: ignore[method-assign]
+        self.compute: Callable = self._wrap_compute(self.compute)  # type: ignore[method-assign]
+
+    # ------------------------------------------------------------------ #
+    # state registry
+    # ------------------------------------------------------------------ #
+    def add_state(
+        self,
+        name: str,
+        default: StateValue,
+        dist_reduce_fx: Optional[Union[str, Callable]] = None,
+        persistent: bool = False,
+    ) -> None:
+        """Register a state variable (reference: metric.py:149-217).
+
+        ``default`` must be a jax array (fixed-shape state) or an empty list
+        (unbounded ``cat`` buffer). ``dist_reduce_fx`` is one of
+        ``"sum"|"mean"|"max"|"min"|"cat"``, a custom callable applied to the
+        cross-device stack, or None (all-gather, keep per-device values).
+        """
+        if not isinstance(default, (jnp.ndarray, np.ndarray)) and not (isinstance(default, list) and default == []):
+            raise ValueError("state variable must be a jax array or an empty list (any other type would not be supported by jit)")
+        if dist_reduce_fx not in ("sum", "mean", "cat", "max", "min", None) and not callable(dist_reduce_fx):
+            raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")
+        if isinstance(default, np.ndarray):
+            default = jnp.asarray(default)
+
+        self._defaults[name] = _copy_state_value(default)
+        self._persistent[name] = persistent
+        self._reductions[name] = dist_reduce_fx
+        setattr(self, name, _copy_state_value(default))
+
+    @property
+    def metric_state(self) -> StateDict:
+        """Current state values keyed by registered name."""
+        return {attr: getattr(self, attr) for attr in self._defaults}
+
+    # ------------------------------------------------------------------ #
+    # pure functional protocol
+    # ------------------------------------------------------------------ #
+    def init_state(self) -> StateDict:
+        """Fresh state pytree from the registered defaults."""
+        return {k: _copy_state_value(v) for k, v in self._defaults.items()}
+
+    def get_state(self) -> StateDict:
+        return {k: _copy_state_value(getattr(self, k)) for k in self._defaults}
+
+    def set_state(self, state: StateDict) -> None:
+        for k, v in state.items():
+            setattr(self, k, _copy_state_value(v))
+
+    def update_state(self, state: StateDict, *args: Any, **kwargs: Any) -> StateDict:
+        """Pure: return ``state`` advanced by one batch. Jittable (``self`` is
+        closed over as static config). The stateful ``update`` and this function
+        share one implementation, so there is a single code path to test."""
+        prev = self.get_state()
+        try:
+            self.set_state(state)
+            self._update(*args, **kwargs)
+            return self.get_state()
+        finally:
+            self.set_state(prev)
+
+    def compute_state(self, state: StateDict) -> Any:
+        """Pure: metric value from a state pytree (no sync, no cache)."""
+        prev = self.get_state()
+        try:
+            self.set_state(state)
+            return self._compute()
+        finally:
+            self.set_state(prev)
+
+    def merge_states(self, state: StateDict, incoming: StateDict, update_counts: Tuple[int, int] = (1, 1)) -> StateDict:
+        """Pure cross-batch/cross-shard merge by reduction tag.
+
+        Reference analog: ``_reduce_states`` (metric.py:317-344). This is the
+        load-bearing primitive: cross-device sync and cross-batch accumulation
+        are the same operation (SURVEY.md §7 decision 2).
+        """
+        n_a, n_b = update_counts
+        out: StateDict = {}
+        for attr in self._defaults:
+            a, b = state[attr], incoming[attr]
+            reduce_fn = self._reductions[attr]
+            if reduce_fn == "sum":
+                out[attr] = a + b
+            elif reduce_fn == "mean":
+                out[attr] = (n_a * a + n_b * b) / max(n_a + n_b, 1)
+            elif reduce_fn == "max":
+                out[attr] = jnp.maximum(a, b)
+            elif reduce_fn == "min":
+                out[attr] = jnp.minimum(a, b)
+            elif reduce_fn == "cat":
+                out[attr] = list(a) + list(b) if isinstance(a, list) else jnp.concatenate([jnp.atleast_1d(a), jnp.atleast_1d(b)])
+            elif reduce_fn is None and isinstance(a, list):
+                out[attr] = _flatten([list(a), list(b)])
+            elif reduce_fn is None:
+                out[attr] = jnp.stack([a, b])
+            else:
+                out[attr] = reduce_fn(jnp.stack([jnp.asarray(a), jnp.asarray(b)]))
+        return out
+
+    def sync_states(self, state: StateDict, axis_name: Union[str, Tuple[str, ...]]) -> StateDict:
+        """Pure: emit collectives over ``axis_name`` per reduction tag. Must be
+        called inside a ``shard_map``/``pmap`` program over that axis."""
+        return _sync.sync_state(state, self._reductions, axis_name)
+
+    # ------------------------------------------------------------------ #
+    # stateful facade: forward / update / compute
+    # ------------------------------------------------------------------ #
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Compute metric on the batch AND accumulate into global state.
+
+        Reference: metric.py:219-238. Purity makes both variants snapshot-free.
+        """
+        if self._is_synced:
+            raise MetricsUserError(
+                "The Metric shouldn't be synced when performing ``forward``. HINT: Did you forget to call ``unsync`` ?."
+            )
+        if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
+            self._forward_cache = self._forward_full_state_update(*args, **kwargs)
+        else:
+            self._forward_cache = self._forward_reduce_state_update(*args, **kwargs)
+        return self._forward_cache
+
+    def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
+        """Two updates: one into global state, one on a fresh state for the
+        batch value (reference: metric.py:240-279). With immutable state the
+        'cache and restore' is just keeping the old pytree reference."""
+        self.update(*args, **kwargs)
+        _update_count = self._update_count
+
+        self._to_sync = self.dist_sync_on_step
+        self._should_unsync = False
+        _temp_compute_on_cpu = self.compute_on_cpu
+        self.compute_on_cpu = False
+
+        cache = self.get_state()  # free: arrays are immutable
+        self.reset()
+        self.update(*args, **kwargs)
+        batch_val = self.compute()
+
+        self.set_state(cache)
+        self._update_count = _update_count
+        self._is_synced = False
+        self._should_unsync = True
+        self._to_sync = self.sync_on_compute
+        self._computed = None
+        self.compute_on_cpu = _temp_compute_on_cpu
+        return batch_val
+
+    def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
+        """One update on a fresh state, then merge into global state
+        (reference: metric.py:281-315)."""
+        global_state = self.get_state()
+        _update_count = self._update_count
+        self.reset()
+
+        self._to_sync = self.dist_sync_on_step
+        self._should_unsync = False
+        _temp_compute_on_cpu = self.compute_on_cpu
+        self.compute_on_cpu = False
+
+        self.update(*args, **kwargs)
+        batch_val = self.compute()
+
+        self._update_count = _update_count + 1
+        self.set_state(self.merge_states(self.get_state(), global_state, (1, _update_count)))
+
+        self._is_synced = False
+        self._should_unsync = True
+        self._to_sync = self.sync_on_compute
+        self._computed = None
+        self.compute_on_cpu = _temp_compute_on_cpu
+        return batch_val
+
+    def _wrap_update(self, update: Callable) -> Callable:
+        @functools.wraps(update)
+        def wrapped_func(*args: Any, **kwargs: Any) -> None:
+            self._computed = None
+            self._update_count += 1
+            update(*args, **kwargs)
+            if self.compute_on_cpu:
+                self._move_list_states_to_cpu()
+
+        self._update = update  # unwrapped, used by the pure protocol
+        return wrapped_func
+
+    def _move_list_states_to_cpu(self) -> None:
+        """Device->host offload of list states (reference: metric.py:386-391)."""
+        cpu = jax.devices("cpu")[0] if any(d.platform == "cpu" for d in jax.local_devices()) else None
+        for key in self._defaults:
+            val = getattr(self, key)
+            if isinstance(val, list):
+                setattr(self, key, [jax.device_put(v, cpu) if cpu else jax.device_get(v) for v in val])
+
+    # ------------------------------------------------------------------ #
+    # distributed sync (reference: metric.py:346-483)
+    # ------------------------------------------------------------------ #
+    def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
+        axes = process_group or self.process_group or _sync.current_sync_axes()
+        state = self.metric_state
+        if dist_sync_fn is not None:
+            synced = dist_sync_fn(state, self._reductions, axes)
+        elif axes is not None:
+            synced = _sync.sync_state(state, self._reductions, axes)
+        else:
+            # eager multi-host path: gather + host-side reduce per tag
+            synced = {}
+            for attr, red in self._reductions.items():
+                val = state[attr]
+                if isinstance(val, list):
+                    val = dim_zero_cat(val) if val else val
+                    if isinstance(val, list):
+                        synced[attr] = val
+                        continue
+                    gathered = _sync.gather_all_arrays(val)
+                    synced[attr] = [dim_zero_cat(gathered)]
+                    continue
+                gathered_list = _sync.gather_all_arrays(val)
+                if red == "cat":
+                    synced[attr] = dim_zero_cat(gathered_list)
+                    continue
+                gathered = jnp.stack(gathered_list)
+                fn = {"sum": dim_zero_sum, "mean": dim_zero_mean, "max": dim_zero_max, "min": dim_zero_min}.get(red, red)
+                synced[attr] = fn(gathered) if fn is not None else gathered
+        self.set_state(synced)
+
+    def sync(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        distributed_available: Optional[Callable] = _sync.distributed_available,
+    ) -> None:
+        """Replace local state with synced state; cache the local state.
+
+        Reference: metric.py:393-427. State-machine guards kept verbatim.
+        """
+        if self._is_synced and should_sync:
+            raise MetricsUserError("The Metric has already been synced.")
+        is_distributed = distributed_available() if callable(distributed_available) else None
+        if not should_sync or not is_distributed:
+            return
+        self._cache = self.get_state()
+        self._sync_dist(dist_sync_fn or self.dist_sync_fn, process_group=process_group)
+        self._is_synced = True
+
+    def unsync(self, should_unsync: bool = True) -> None:
+        """Restore the pre-sync local state (reference: metric.py:429-449)."""
+        if not should_unsync:
+            return
+        if not self._is_synced:
+            raise MetricsUserError("The Metric has already been un-synced.")
+        if self._cache is None:
+            raise MetricsUserError("The internal cache should exist to unsync the Metric.")
+        self.set_state(self._cache)
+        self._is_synced = False
+        self._cache = None
+
+    @contextmanager
+    def sync_context(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        should_unsync: bool = True,
+        distributed_available: Optional[Callable] = _sync.distributed_available,
+    ) -> Generator:
+        """Sync for the duration of the block, then restore local state
+        (reference: metric.py:451-483)."""
+        self.sync(
+            dist_sync_fn=dist_sync_fn,
+            process_group=process_group,
+            should_sync=should_sync,
+            distributed_available=distributed_available,
+        )
+        yield
+        self.unsync(should_unsync=self._is_synced and should_unsync)
+
+    def _wrap_compute(self, compute: Callable) -> Callable:
+        @functools.wraps(compute)
+        def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+            if self._update_count == 0:
+                rank_zero_warn(
+                    f"The ``compute`` method of metric {self.__class__.__name__}"
+                    " was called before the ``update`` method which may lead to errors,"
+                    " as metric states have not yet been updated.",
+                    UserWarning,
+                )
+            if self._computed is not None:
+                return self._computed
+            with self.sync_context(
+                dist_sync_fn=self.dist_sync_fn, should_sync=self._to_sync, should_unsync=self._should_unsync
+            ):
+                value = compute(*args, **kwargs)
+                self._computed = _squeeze_if_scalar(value)
+            return self._computed
+
+        self._compute = compute  # unwrapped, used by the pure protocol
+        return wrapped_func
+
+    # ------------------------------------------------------------------ #
+    # abstract interface
+    # ------------------------------------------------------------------ #
+    def update(self, *args: Any, **kwargs: Any) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def compute(self) -> Any:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+    # ------------------------------------------------------------------ #
+    def reset(self) -> None:
+        """Restore registered states to their defaults (reference: metric.py:524-543)."""
+        self._update_count = 0
+        self._forward_cache = None
+        self._computed = None
+        for attr, default in self._defaults.items():
+            setattr(self, attr, _copy_state_value(default))
+        self._cache = None
+        self._is_synced = False
+
+    def clone(self) -> "Metric":
+        """Deep copy (reference: metric.py:545-547)."""
+        return deepcopy(self)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name in _PROTECTED_PROPERTIES and hasattr(self, "_defaults"):
+            raise RuntimeError(f"Can't change const `{name}`.")
+        object.__setattr__(self, name, value)
+
+    def __getstate__(self) -> Dict[str, Any]:
+        """Drop the wrapped bound methods for pickling (reference: metric.py:573-577)."""
+        return {k: v for k, v in self.__dict__.items() if k not in ("update", "compute", "_update", "_compute")}
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        self.update = self._wrap_update(type(self).update.__get__(self))  # type: ignore[method-assign]
+        self.compute = self._wrap_compute(type(self).compute.__get__(self))  # type: ignore[method-assign]
+
+    # ------------------------------------------------------------------ #
+    # device / dtype management (reference `_apply`, metric.py:601-632)
+    # ------------------------------------------------------------------ #
+    @property
+    def device(self):
+        for v in self.metric_state.values():
+            arr = v[0] if isinstance(v, list) and v else v
+            if isinstance(arr, jnp.ndarray):
+                try:
+                    return list(arr.devices())[0]
+                except Exception:
+                    return None
+        return None
+
+    def to(self, device) -> "Metric":
+        """Move all states (and defaults) to ``device``."""
+        move = lambda x: jax.device_put(x, device)
+        for attr in self._defaults:
+            val = getattr(self, attr)
+            setattr(self, attr, [move(v) for v in val] if isinstance(val, list) else move(val))
+        self._defaults = {
+            k: ([move(v) for v in d] if isinstance(d, list) else move(d)) for k, d in self._defaults.items()
+        }
+        return self
+
+    def astype(self, dtype) -> "Metric":
+        """Cast floating-point states to ``dtype`` (half/float/double analogs)."""
+        def cast(x):
+            return x.astype(dtype) if isinstance(x, jnp.ndarray) and jnp.issubdtype(x.dtype, jnp.floating) else x
+        for attr in self._defaults:
+            val = getattr(self, attr)
+            setattr(self, attr, [cast(v) for v in val] if isinstance(val, list) else cast(val))
+        self._defaults = {
+            k: ([cast(v) for v in d] if isinstance(d, list) else cast(d)) for k, d in self._defaults.items()
+        }
+        return self
+
+    # ------------------------------------------------------------------ #
+    # serialization (reference: metric.py:634-677)
+    # ------------------------------------------------------------------ #
+    def persistent(self, mode: bool = False) -> None:
+        for key in self._persistent:
+            self._persistent[key] = mode
+
+    def state_dict(self, prefix: str = "") -> Dict[str, Any]:
+        """Host-side snapshot of persistent states (numpy leaves, orbax-friendly)."""
+        out: Dict[str, Any] = {}
+        for key in self._defaults:
+            if self._persistent[key]:
+                current = getattr(self, key)
+                if isinstance(current, list):
+                    out[prefix + key] = [np.asarray(v) for v in current]
+                else:
+                    out[prefix + key] = np.asarray(current)
+        return out
+
+    def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
+        for key in self._defaults:
+            name = prefix + key
+            if name in state_dict:
+                val = state_dict[name]
+                setattr(self, key, [jnp.asarray(v) for v in val] if isinstance(val, list) else jnp.asarray(val))
+            elif strict and self._persistent[key]:
+                raise KeyError(f"Missing key {name!r} in state_dict")
+
+    # ------------------------------------------------------------------ #
+    # misc parity helpers
+    # ------------------------------------------------------------------ #
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        """Keep only kwargs the (unwrapped) update accepts (reference: metric.py:679-703)."""
+        sig = inspect.signature(self._update)
+        params = sig.parameters
+        filter_keys = {
+            k: v
+            for k, v in kwargs.items()
+            if k in params and params[k].kind not in (inspect.Parameter.VAR_KEYWORD, inspect.Parameter.VAR_POSITIONAL)
+        }
+        if any(p.kind == inspect.Parameter.VAR_KEYWORD for p in params.values()):
+            return kwargs
+        return filter_keys
+
+    def _update_signature(self) -> Optional[Tuple]:
+        """Static compute-group key: metrics returning equal keys share identical
+        state trajectories, so a MetricCollection updates one of them and
+        broadcasts state (SURVEY.md §7 decision 5; reference does this by runtime
+        state-equality probing, collections.py:181-239). None = never grouped."""
+        return None
+
+    def __hash__(self) -> int:
+        hash_vals = [self.__class__.__name__, id(self)]
+        return hash(tuple(hash_vals))
+
+    def __repr__(self) -> str:
+        return f"{self.__class__.__name__}()"
+
+    # ------------------------------------------------------------------ #
+    # operator overloads -> CompositionalMetric (reference: metric.py:720-823)
+    # ------------------------------------------------------------------ #
+    def __add__(self, other): return CompositionalMetric(jnp.add, self, other)
+    def __radd__(self, other): return CompositionalMetric(jnp.add, other, self)
+    def __sub__(self, other): return CompositionalMetric(jnp.subtract, self, other)
+    def __rsub__(self, other): return CompositionalMetric(jnp.subtract, other, self)
+    def __mul__(self, other): return CompositionalMetric(jnp.multiply, self, other)
+    def __rmul__(self, other): return CompositionalMetric(jnp.multiply, other, self)
+    def __truediv__(self, other): return CompositionalMetric(jnp.true_divide, self, other)
+    def __rtruediv__(self, other): return CompositionalMetric(jnp.true_divide, other, self)
+    def __floordiv__(self, other): return CompositionalMetric(jnp.floor_divide, self, other)
+    def __rfloordiv__(self, other): return CompositionalMetric(jnp.floor_divide, other, self)
+    def __mod__(self, other): return CompositionalMetric(jnp.mod, self, other)
+    def __rmod__(self, other): return CompositionalMetric(jnp.mod, other, self)
+    def __pow__(self, other): return CompositionalMetric(jnp.power, self, other)
+    def __rpow__(self, other): return CompositionalMetric(jnp.power, other, self)
+    def __matmul__(self, other): return CompositionalMetric(jnp.matmul, self, other)
+    def __rmatmul__(self, other): return CompositionalMetric(jnp.matmul, other, self)
+    def __and__(self, other): return CompositionalMetric(jnp.bitwise_and, self, other)
+    def __rand__(self, other): return CompositionalMetric(jnp.bitwise_and, other, self)
+    def __or__(self, other): return CompositionalMetric(jnp.bitwise_or, self, other)
+    def __ror__(self, other): return CompositionalMetric(jnp.bitwise_or, other, self)
+    def __xor__(self, other): return CompositionalMetric(jnp.bitwise_xor, self, other)
+    def __rxor__(self, other): return CompositionalMetric(jnp.bitwise_xor, other, self)
+    def __eq__(self, other): return CompositionalMetric(jnp.equal, self, other)  # type: ignore[override]
+    def __ne__(self, other): return CompositionalMetric(jnp.not_equal, self, other)  # type: ignore[override]
+    def __lt__(self, other): return CompositionalMetric(jnp.less, self, other)
+    def __le__(self, other): return CompositionalMetric(jnp.less_equal, self, other)
+    def __gt__(self, other): return CompositionalMetric(jnp.greater, self, other)
+    def __ge__(self, other): return CompositionalMetric(jnp.greater_equal, self, other)
+    def __abs__(self): return CompositionalMetric(jnp.abs, self, None)
+    def __neg__(self): return CompositionalMetric(_neg, self, None)
+    def __pos__(self): return CompositionalMetric(jnp.abs, self, None)
+    def __invert__(self): return CompositionalMetric(jnp.logical_not, self, None)
+    def __getitem__(self, idx): return CompositionalMetric(lambda x: x[idx], self, None)
+
+
+def _neg(x: Array) -> Array:
+    return -jnp.abs(x)
+
+
+class CompositionalMetric(Metric):
+    """Lazy arithmetic composition of metrics (reference: metric.py:830-938)."""
+
+    full_state_update = True
+
+    def __init__(self, operator: Callable, metric_a: Union[Metric, float, int, Array, None], metric_b: Union[Metric, float, int, Array, None]) -> None:
+        super().__init__()
+        self.op = operator
+        self.metric_a = metric_a if isinstance(metric_a, Metric) else (jnp.asarray(metric_a) if metric_a is not None else None)
+        self.metric_b = metric_b if isinstance(metric_b, Metric) else (jnp.asarray(metric_b) if metric_b is not None else None)
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        return kwargs
+
+    def update(self, *args: Any, **kwargs: Any) -> None:  # type: ignore[override]
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.update(*args, **self.metric_a._filter_kwargs(**kwargs))
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.update(*args, **self.metric_b._filter_kwargs(**kwargs))
+
+    def compute(self) -> Any:  # type: ignore[override]
+        val_a = self.metric_a.compute() if isinstance(self.metric_a, Metric) else self.metric_a
+        val_b = self.metric_b.compute() if isinstance(self.metric_b, Metric) else self.metric_b
+        if val_b is None:
+            return self.op(val_a)
+        return self.op(val_a, val_b)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        val_a = self.metric_a(*args, **self.metric_a._filter_kwargs(**kwargs)) if isinstance(self.metric_a, Metric) else self.metric_a
+        val_b = self.metric_b(*args, **self.metric_b._filter_kwargs(**kwargs)) if isinstance(self.metric_b, Metric) else self.metric_b
+        if val_a is None:
+            self._forward_cache = None
+        elif val_b is None:
+            self._forward_cache = self.op(val_a) if not isinstance(self.metric_b, Metric) else None
+        else:
+            self._forward_cache = self.op(val_a, val_b)
+        return self._forward_cache
+
+    def reset(self) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.reset()
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.reset()
+        self._update_count = 0
+        self._forward_cache = None
+        self._computed = None
+
+    def persistent(self, mode: bool = False) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.persistent(mode=mode)
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.persistent(mode=mode)
+
+    def __repr__(self) -> str:
+        _op_metrics = f"(\n  {self.op.__name__ if hasattr(self.op, '__name__') else 'op'}(\n    {repr(self.metric_a)},\n    {repr(self.metric_b)}\n  )\n)"
+        return self.__class__.__name__ + _op_metrics
+
+    def __hash__(self) -> int:
+        return hash((self.__class__.__name__, id(self)))

@@ -1,0 +1,12 @@
+"""Distributed/parallel layer (reference parity: torchmetrics/utilities/distributed.py)."""
+from metrics_tpu.parallel.mesh import batch_sharded, data_parallel_mesh, make_mesh, replicated  # noqa: F401
+from metrics_tpu.parallel.sync import (  # noqa: F401
+    class_reduce,
+    current_sync_axes,
+    distributed_available,
+    gather_all_arrays,
+    reduce,
+    sync_array,
+    sync_axes,
+    sync_state,
+)

@@ -1,0 +1,41 @@
+"""Mesh construction helpers for metric-state parallelism.
+
+The reference has no mesh concept (DDP-only, SURVEY.md §2.5); this module is the
+TPU-native substrate: named meshes over which metric state is replicated (data
+axis) or sharded (model axis, e.g. the class dimension of a large confusion
+matrix), with collectives riding ICI.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def make_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str], devices=None) -> Mesh:
+    """Build a named device mesh; sizes may contain one -1 (fill remaining)."""
+    devices = devices if devices is not None else jax.devices()
+    sizes = list(axis_sizes)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    n = int(np.prod(sizes))
+    arr = np.asarray(devices[:n]).reshape(sizes)
+    return Mesh(arr, tuple(axis_names))
+
+
+def data_parallel_mesh(n: Optional[int] = None, axis_name: str = "data") -> Mesh:
+    """1-D data-parallel mesh over the first ``n`` (default: all) devices."""
+    devices = jax.devices()
+    n = n if n is not None else len(devices)
+    return make_mesh([n], [axis_name], devices)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharded(mesh: Mesh, axis_name: str = "data") -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(axis_name))

@@ -1,0 +1,17 @@
+"""Utility layer (reference parity: torchmetrics/utilities/)."""
+from metrics_tpu.utils.checks import _check_same_shape, check_forward_full_state_property  # noqa: F401
+from metrics_tpu.utils.data import (  # noqa: F401
+    METRIC_EPS,
+    apply_to_collection,
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+    get_group_indexes,
+    select_topk,
+    to_categorical,
+    to_onehot,
+)
+from metrics_tpu.utils.exceptions import MetricsUserError, MetricsUserWarning  # noqa: F401
+from metrics_tpu.utils.prints import rank_zero_debug, rank_zero_info, rank_zero_warn  # noqa: F401

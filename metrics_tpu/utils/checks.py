@@ -1,0 +1,453 @@
+"""Input validation and the classification format-canonicalization machine.
+
+Reference parity: torchmetrics/utilities/checks.py (723 LoC). Behavior contract:
+
+- ``_input_format_classification`` (reference :311) classifies ``(preds, target)``
+  into binary / multi-class / multi-label / multi-dim multi-class, validates
+  ``num_classes``/``top_k``/``multiclass`` consistency, and canonicalizes both to
+  int binary tensors of shape ``(N, C)`` or ``(N, C, X)``.
+- ``_check_retrieval_inputs`` (reference :532) / ``_check_retrieval_functional_inputs``
+  (reference :502) flatten + type-check retrieval triples.
+
+TPU-first split (SURVEY.md §7 design decision 4): *shape/type dispatch* is static
+and therefore traceable; *value checks* (label ranges, probability domain) are
+data-dependent and run only in eager mode — under ``jit`` they are skipped
+automatically (the arrays are tracers), which is the compiled-mode contract.
+Pass ``num_classes`` explicitly for fully static canonicalization under jit.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.utils.data import select_topk, to_onehot
+from metrics_tpu.utils.enums import DataType
+
+
+def _is_concrete(*arrays: Array) -> bool:
+    """True when value-dependent checks are possible (not under jit tracing)."""
+    return not any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+
+def _is_floating(x: Array) -> bool:
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def _check_for_empty_tensors(preds: Array, target: Array) -> bool:
+    return preds.size == 0 and target.size == 0
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    """Raise if shapes differ. Reference: checks.py:30-33."""
+    if preds.shape != target.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, got {preds.shape} and {target.shape}."
+        )
+
+
+def _basic_input_validation(
+    preds: Array, target: Array, threshold: float, multiclass: Optional[bool], ignore_index: Optional[int]
+) -> None:
+    """Case-independent validation. Reference: checks.py:36-63."""
+    if _check_for_empty_tensors(preds, target):
+        return
+    if _is_floating(target):
+        raise ValueError("The `target` has to be an integer tensor.")
+
+    if preds.shape[0:1] != target.shape[0:1]:
+        raise ValueError("The `preds` and `target` should have the same first dimension.")
+
+    if not _is_concrete(preds, target):
+        return  # value checks impossible under tracing
+    if ignore_index is None and target.min() < 0:
+        raise ValueError("The `target` has to be a non-negative tensor.")
+    if ignore_index is not None and ignore_index >= 0 and target.min() < 0:
+        raise ValueError("The `target` has to be a non-negative tensor.")
+    if not _is_floating(preds) and preds.min() < 0:
+        raise ValueError("If `preds` are integers, they have to be non-negative.")
+    if multiclass is False and target.max() > 1:
+        raise ValueError("If you set `multiclass=False`, then `target` should not exceed 1.")
+    if multiclass is False and not _is_floating(preds) and preds.max() > 1:
+        raise ValueError("If you set `multiclass=False` and `preds` are integers, then `preds` should not exceed 1.")
+
+
+def _check_shape_and_type_consistency(preds: Array, target: Array) -> Tuple[DataType, int]:
+    """Classify the input case from shapes/dtypes only (fully static).
+
+    Reference: checks.py:66-120. Returns (case, implied number of classes).
+    """
+    preds_float = _is_floating(preds)
+
+    if preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape,"
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+            )
+        if preds_float and target.size > 0 and _is_concrete(target) and target.max() > 1:
+            raise ValueError(
+                "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
+            )
+        if preds.ndim == 1 and preds_float:
+            case = DataType.BINARY
+        elif preds.ndim == 1 and not preds_float:
+            case = DataType.MULTICLASS
+        elif preds.ndim > 1 and preds_float:
+            case = DataType.MULTILABEL
+        else:
+            case = DataType.MULTIDIM_MULTICLASS
+        implied_classes = int(np.prod(preds.shape[1:])) if preds.size > 0 else 0
+
+    elif preds.ndim == target.ndim + 1:
+        if not preds_float:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                " (N, C, ...), and the shape of `target` should be (N, ...)."
+            )
+        implied_classes = preds.shape[1] if preds.size > 0 else 0
+        case = DataType.MULTICLASS if preds.ndim == 2 else DataType.MULTIDIM_MULTICLASS
+    else:
+        raise ValueError(
+            "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...)"
+            " and `preds` should be (N, C, ...)."
+        )
+    return case, implied_classes
+
+
+def _check_num_classes_binary(num_classes: int, multiclass: Optional[bool]) -> None:
+    """Reference: checks.py:123-138."""
+    if num_classes > 2:
+        raise ValueError("Your data is binary, but `num_classes` is larger than 2.")
+    if num_classes == 2 and not multiclass:
+        raise ValueError(
+            "Your data is binary and `num_classes=2`, but `multiclass` is not True."
+            " Set it to True if you want to transform binary data to multi-class format."
+        )
+    if num_classes == 1 and multiclass:
+        raise ValueError(
+            "You have binary data and have set `multiclass=True`, but `num_classes` is 1."
+            " Either set `multiclass=None`(default) or set `num_classes=2`"
+            " to transform binary data to multi-class format."
+        )
+
+
+def _check_num_classes_mc(
+    preds: Array, target: Array, num_classes: int, multiclass: Optional[bool], implied_classes: int
+) -> None:
+    """Reference: checks.py:141-169."""
+    if num_classes == 1 and multiclass is not False:
+        raise ValueError(
+            "You have set `num_classes=1`, but predictions are integers."
+            " If you want to convert (multi-dimensional) multi-class data with 2 classes"
+            " to binary/multi-label, set `multiclass=False`."
+        )
+    if num_classes > 1:
+        if multiclass is False and implied_classes != num_classes:
+            raise ValueError(
+                "You have set `multiclass=False`, but the implied number of classes "
+                " (from shape of inputs) does not match `num_classes`."
+            )
+        if target.size > 0 and _is_concrete(target) and num_classes <= target.max():
+            raise ValueError("The highest label in `target` should be smaller than `num_classes`.")
+        if preds.shape != target.shape and num_classes != implied_classes:
+            raise ValueError("The size of C dimension of `preds` does not match `num_classes`.")
+
+
+def _check_num_classes_ml(num_classes: int, multiclass: Optional[bool], implied_classes: int) -> None:
+    """Reference: checks.py:172-183."""
+    if multiclass and num_classes != 2:
+        raise ValueError(
+            "Your have set `multiclass=True`, but `num_classes` is not equal to 2."
+            " If you are trying to transform multi-label data to 2 class multi-dimensional"
+            " multi-class, you should set `num_classes` to either 2 or None."
+        )
+    if not multiclass and num_classes != implied_classes:
+        raise ValueError("The implied number of classes (from shape of inputs) does not match num_classes.")
+
+
+def _check_top_k(top_k: int, case: DataType, implied_classes: int, multiclass: Optional[bool], preds_float: bool) -> None:
+    """Reference: checks.py:186-201."""
+    if case == DataType.BINARY:
+        raise ValueError("You can not use `top_k` parameter with binary data.")
+    if not isinstance(top_k, int) or top_k <= 0:
+        raise ValueError("The `top_k` has to be an integer larger than 0.")
+    if not preds_float:
+        raise ValueError("You have set `top_k`, but you do not have probability predictions.")
+    if multiclass is False:
+        raise ValueError("If you set `multiclass=False`, you can not set `top_k`.")
+    if case == DataType.MULTILABEL and multiclass:
+        raise ValueError(
+            "If you want to transform multi-label data to 2 class multi-dimensional"
+            "multi-class data using `multiclass=True`, you can not use `top_k`."
+        )
+    if top_k >= implied_classes:
+        raise ValueError("The `top_k` has to be strictly smaller than the `C` dimension of `preds`.")
+
+
+def _check_classification_inputs(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    num_classes: Optional[int],
+    multiclass: Optional[bool],
+    top_k: Optional[int],
+    ignore_index: Optional[int] = None,
+) -> DataType:
+    """Full input validation; returns the detected case. Reference: checks.py:204-296."""
+    _basic_input_validation(preds, target, threshold, multiclass, ignore_index)
+    case, implied_classes = _check_shape_and_type_consistency(preds, target)
+
+    if preds.shape != target.shape:
+        if multiclass is False and implied_classes != 2:
+            raise ValueError(
+                "You have set `multiclass=False`, but have more than 2 classes in your data,"
+                " based on the C dimension of `preds`."
+            )
+        if _is_concrete(target) and target.size > 0 and target.max() >= implied_classes:
+            raise ValueError(
+                "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
+            )
+
+    if num_classes:
+        if case == DataType.BINARY:
+            _check_num_classes_binary(num_classes, multiclass)
+        elif case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+            _check_num_classes_mc(preds, target, num_classes, multiclass, implied_classes)
+        elif case == DataType.MULTILABEL:
+            _check_num_classes_ml(num_classes, multiclass, implied_classes)
+
+    if top_k is not None:
+        _check_top_k(top_k, case, implied_classes, multiclass, _is_floating(preds))
+    return case
+
+
+def _input_squeeze(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Remove size-1 dims (except a size-1 batch dim). Reference: checks.py:299-308."""
+    if preds.shape[0] == 1:
+        preds = jnp.expand_dims(jnp.squeeze(preds), 0)
+        target = jnp.expand_dims(jnp.squeeze(target), 0)
+    else:
+        preds, target = jnp.squeeze(preds), jnp.squeeze(target)
+    return preds, target
+
+
+def _input_format_classification(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, DataType]:
+    """Canonicalize ``(preds, target)`` to int binary ``(N, C)`` / ``(N, C, X)``.
+
+    Reference: checks.py:311-450 — same case semantics:
+
+    - binary: preds thresholded, returned ``(N, 1)``; with ``multiclass=True``
+      one-hot to ``(N, 2)``.
+    - multi-class: one-hot/top-k select to ``(N, C)``; ``multiclass=False``
+      keeps the positive-class column as ``(N, 1)``.
+    - multi-label: threshold (or top-k) to ``(N, C)`` with trailing dims
+      flattened; ``multiclass=True`` lifts to ``(N, 2, C)``.
+    - multi-dim multi-class: one-hot/top-k to ``(N, C, X)``.
+
+    All shape logic is static; only label->one-hot inference of ``num_classes``
+    requires concrete values (pass ``num_classes`` for jit).
+    """
+    preds, target = _input_squeeze(preds, target)
+    if preds.dtype in (jnp.float16, jnp.bfloat16):
+        preds = preds.astype(jnp.float32)
+
+    case = _check_classification_inputs(
+        preds, target, threshold=threshold, num_classes=num_classes,
+        multiclass=multiclass, top_k=top_k, ignore_index=ignore_index,
+    )
+
+    if case in (DataType.BINARY, DataType.MULTILABEL) and not top_k:
+        preds = (preds >= threshold).astype(jnp.int32) if _is_floating(preds) else preds.astype(jnp.int32)
+        num_classes = num_classes if not multiclass else 2
+
+    if case == DataType.MULTILABEL and top_k:
+        preds = select_topk(preds, top_k)
+
+    if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) or multiclass:
+        if _is_floating(preds):
+            num_classes = preds.shape[1]
+            preds = select_topk(preds, top_k or 1)
+        else:
+            if not num_classes:
+                if not _is_concrete(preds, target):
+                    raise ValueError("`num_classes` must be given for label inputs under jit tracing.")
+                num_classes = int(max(preds.max(), target.max())) + 1
+            preds = to_onehot(preds, max(2, num_classes))
+        target = to_onehot(target, max(2, int(num_classes)))
+
+        if multiclass is False:
+            preds, target = preds[:, 1, ...], target[:, 1, ...]
+
+    if not _check_for_empty_tensors(preds, target):
+        if (case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and multiclass is not False) or multiclass:
+            target = target.reshape(target.shape[0], target.shape[1], -1)
+            preds = preds.reshape(preds.shape[0], preds.shape[1], -1)
+        else:
+            target = target.reshape(target.shape[0], -1)
+            preds = preds.reshape(preds.shape[0], -1)
+
+    if preds.ndim > 2 and preds.shape[-1] == 1:
+        preds, target = jnp.squeeze(preds, -1), jnp.squeeze(target, -1)
+
+    return preds.astype(jnp.int32), target.astype(jnp.int32), case
+
+
+def _input_format_classification_one_hot(
+    num_classes: int, preds: Array, target: Array, threshold: float = 0.5, multilabel: bool = False
+) -> Tuple[Array, Array]:
+    """One-hot ``(C, -1)`` canonicalization. Reference: checks.py:453-499."""
+    if preds.ndim not in (target.ndim, target.ndim + 1):
+        raise ValueError("preds and target must have same number of dimensions, or one additional dimension for preds")
+    if preds.ndim == target.ndim + 1:
+        preds = jnp.argmax(preds, axis=1)
+
+    if preds.ndim == target.ndim and jnp.issubdtype(preds.dtype, jnp.integer) and num_classes > 1 and not multilabel:
+        preds = to_onehot(preds, num_classes=num_classes)
+        target = to_onehot(target, num_classes=num_classes)
+    elif preds.ndim == target.ndim and _is_floating(preds):
+        preds = (preds >= threshold).astype(jnp.int32)
+
+    if preds.ndim > 1:
+        preds = jnp.swapaxes(preds, 1, 0)
+        target = jnp.swapaxes(target, 1, 0)
+    return preds.reshape(num_classes, -1), target.reshape(num_classes, -1)
+
+
+# --------------------------------------------------------------------------- #
+# retrieval input checks (reference: checks.py:502-607)
+# --------------------------------------------------------------------------- #
+def _check_retrieval_target_and_prediction_types(
+    preds: Array, target: Array, allow_non_binary_target: bool = False
+) -> Tuple[Array, Array]:
+    if not (jnp.issubdtype(target.dtype, jnp.integer) or jnp.issubdtype(target.dtype, jnp.bool_) or _is_floating(target)):
+        raise ValueError("`target` must be a tensor of booleans, integers or floats")
+    if not _is_floating(preds):
+        raise ValueError("`preds` must be a tensor of floats")
+    if not allow_non_binary_target and _is_concrete(target) and (target.max() > 1 or target.min() < 0):
+        raise ValueError("`target` must contain `binary` values")
+    target = target.astype(jnp.float32) if _is_floating(target) else target.astype(jnp.int32)
+    return preds.astype(jnp.float32).reshape(-1), target.reshape(-1)
+
+
+def _check_retrieval_functional_inputs(
+    preds: Array, target: Array, allow_non_binary_target: bool = False
+) -> Tuple[Array, Array]:
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must be of the same shape")
+    if preds.size == 0 or preds.ndim == 0:
+        raise ValueError("`preds` and `target` must be non-empty and non-scalar tensors")
+    return _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
+
+
+def _allclose_recursive(res1, res2, atol: float = 1e-8) -> bool:
+    """Recursively compare two (possibly nested) results. Reference: checks.py:610-621."""
+    from collections.abc import Mapping, Sequence
+
+    if isinstance(res1, jnp.ndarray):
+        return bool(jnp.allclose(res1, res2, atol=atol))
+    if isinstance(res1, str):
+        return res1 == res2
+    if isinstance(res1, Sequence):
+        return all(_allclose_recursive(r1, r2) for r1, r2 in zip(res1, res2))
+    if isinstance(res1, Mapping):
+        return all(_allclose_recursive(res1[k], res2[k]) for k in res1.keys())
+    return res1 == res2
+
+
+def check_forward_full_state_property(
+    metric_class,
+    init_args: Optional[dict] = None,
+    input_args: Optional[dict] = None,
+    num_update_to_compare=(10, 100, 1000),
+    reps: int = 5,
+) -> bool:
+    """Probe whether ``full_state_update=False`` is safe (and faster) for a metric.
+
+    Reference: checks.py:624-723 (``check_forward_no_full_state``): runs both
+    forward variants, compares outputs, then times 10/100/1000 steps x ``reps``.
+    Returns True when the partial-state path matches and is faster on average.
+    """
+    from time import perf_counter
+
+    init_args = init_args or {}
+    input_args = input_args or {}
+
+    class FullState(metric_class):
+        full_state_update = True
+
+    class PartState(metric_class):
+        full_state_update = False
+
+    fullstate, partstate = FullState(**init_args), PartState(**init_args)
+
+    equal = True
+    for _ in range(num_update_to_compare[0]):
+        out1 = fullstate(**input_args)
+        try:
+            out2 = partstate(**input_args)
+        except RuntimeError:
+            equal = False
+            break
+        equal = equal and _allclose_recursive(out1, out2)
+    if equal:
+        res1 = fullstate.compute()
+        try:
+            res2 = partstate.compute()
+        except RuntimeError:
+            equal = False
+        else:
+            equal = equal and _allclose_recursive(res1, res2)
+    if not equal:
+        return False
+
+    res = np.zeros((2, len(num_update_to_compare), reps))
+    for i, metric in enumerate([fullstate, partstate]):
+        for j, t in enumerate(num_update_to_compare):
+            for r in range(reps):
+                start = perf_counter()
+                for _ in range(t):
+                    _ = metric(**input_args)
+                jax.block_until_ready(metric.metric_state)
+                res[i, j, r] = perf_counter() - start
+                metric.reset()
+    mean = res.mean(-1)
+    std = res.std(-1)
+    for t, n in enumerate(num_update_to_compare):
+        print(f"Full state for {n} steps took: {mean[0, t]}+-{std[0, t]:0.3f}")
+        print(f"Partial state for {n} steps took: {mean[1, t]:0.3f}+-{std[1, t]:0.3f}")
+    return bool(mean[1, -1] < mean[0, -1])
+
+
+def _check_retrieval_inputs(
+    indexes: Array,
+    preds: Array,
+    target: Array,
+    allow_non_binary_target: bool = False,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    if indexes.shape != preds.shape or preds.shape != target.shape:
+        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+    if not jnp.issubdtype(indexes.dtype, jnp.integer):
+        raise ValueError("`indexes` must be a tensor of long integers")
+    if ignore_index is not None:
+        # data-dependent filter: eager-only (compiled retrieval path uses masks)
+        valid = np.asarray(target != ignore_index)
+        indexes, preds, target = jnp.asarray(np.asarray(indexes)[valid]), jnp.asarray(np.asarray(preds)[valid]), jnp.asarray(np.asarray(target)[valid])
+    if indexes.size == 0 or indexes.ndim == 0:
+        raise ValueError("`indexes`, `preds` and `target` must be non-empty and non-scalar tensors")
+    preds, target = _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
+    return indexes.astype(jnp.int32).reshape(-1), preds, target

@@ -1,0 +1,29 @@
+"""Numerically-safe helpers.
+
+Reference parity: torchmetrics/utilities/compute.py:18-40 (`_safe_matmul`,
+`_safe_xlogy`). On TPU the matmul overflow concern is bf16 rather than fp16; we
+compute in f32 and cast back, which XLA fuses into the surrounding graph.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def safe_matmul(x: Array, y: Array) -> Array:
+    """Matmul that accumulates in f32 when inputs are half precision."""
+    if x.dtype in (jnp.float16, jnp.bfloat16):
+        return jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
+    return jnp.matmul(x, y)
+
+
+def safe_xlogy(x: Array, y: Array) -> Array:
+    """``x * log(y)`` with the convention ``0 * log(0) = 0`` and no NaN grads."""
+    y_safe = jnp.where(x == 0, jnp.ones_like(y), y)
+    return jnp.where(x == 0, jnp.zeros_like(x * y), x * jnp.log(y_safe))
+
+
+def safe_divide(num: Array, denom: Array) -> Array:
+    """``num / denom`` returning 0 where ``denom == 0`` (no NaN/Inf)."""
+    denom_safe = jnp.where(denom == 0, jnp.ones_like(denom), denom)
+    return jnp.where(denom == 0, jnp.zeros_like(num / denom_safe), num / denom_safe)

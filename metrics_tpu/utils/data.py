@@ -1,0 +1,184 @@
+"""Data/shape helpers used throughout the framework.
+
+Reference parity: torchmetrics/utilities/data.py (entire file):
+- ``dim_zero_{cat,sum,mean,max,min}`` (:36-62) — the reduction vocabulary applied
+  to gathered state,
+- ``_flatten``/``_flatten_dict`` (:65-80),
+- ``to_onehot`` (:82), ``select_topk`` (:116), ``to_categorical`` (:142),
+- ``apply_to_collection`` (:160) — replaced by ``jax.tree_util`` where possible
+  but kept for dict/list traversal with type filters,
+- ``get_group_indexes`` (:210) — retrieval query grouping; here re-expressed with
+  static shapes via segment ids (see ``metrics_tpu.ops.retrieval``),
+- ``_bincount`` (:244) — XLA's sort-based path is deterministic, so the manual
+  deterministic fallback loop is unnecessary; we use ``jnp.bincount`` with a
+  static ``length``,
+- ``_squeeze_if_scalar`` (:240), ``allclose`` (:267), ``METRIC_EPS`` (:33).
+
+Everything here is pure and jittable unless noted.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+METRIC_EPS = 1e-6
+
+
+# --------------------------------------------------------------------------- #
+# dim-zero reductions (the `dist_reduce_fx` vocabulary)
+# --------------------------------------------------------------------------- #
+def dim_zero_cat(x: Union[Array, Sequence[Array]]) -> Array:
+    """Concatenate a (list of) array(s) along dim 0; scalars are broadcast to 1-d."""
+    if isinstance(x, (jnp.ndarray, np.ndarray)) and not isinstance(x, (list, tuple)):
+        return x  # type: ignore[return-value]
+    x = [jnp.atleast_1d(jnp.asarray(el)) for el in x]
+    if not x:
+        raise ValueError("No samples to concatenate")
+    return jnp.concatenate(x, axis=0)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(jnp.asarray(x), axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(jnp.asarray(x), axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(jnp.asarray(x), axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(jnp.asarray(x), axis=0)
+
+
+def _flatten(x: Sequence) -> List:
+    """Flatten one level of nesting."""
+    return [item for sublist in x for item in sublist]
+
+
+def _flatten_dict(x: Mapping) -> dict:
+    """Flatten dict-of-dicts one level."""
+    new_dict = {}
+    for key, value in x.items():
+        if isinstance(value, Mapping):
+            for k, v in value.items():
+                new_dict[k] = v
+        else:
+            new_dict[key] = value
+    return new_dict
+
+
+# --------------------------------------------------------------------------- #
+# label-format conversions
+# --------------------------------------------------------------------------- #
+def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
+    """Convert dense ``(N, ...)`` integer labels to one-hot ``(N, C, ...)``.
+
+    Reference: utilities/data.py:82-113 (scatter-based); here ``jax.nn.one_hot``
+    which lowers to a compare-iota, ideal for the VPU.
+    """
+    if num_classes is None:
+        num_classes = int(jnp.max(label_tensor)) + 1  # data-dependent: eager only
+    oh = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32)
+    # (N, ..., C) -> (N, C, ...)
+    return jnp.moveaxis(oh, -1, 1) if oh.ndim > 2 else oh
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binary mask of the top-k entries along ``dim``.
+
+    Reference: utilities/data.py:116-139 (scatter on ``topk.indices``); here a
+    rank-based compare so the whole op is one fused XLA kernel with static shapes.
+    """
+    if topk == 1:  # fast path == argmax
+        idx = jnp.argmax(prob_tensor, axis=dim, keepdims=True)
+        mask = jnp.zeros_like(prob_tensor, dtype=jnp.int32)
+        return jnp.put_along_axis(mask, idx, 1, axis=dim, inplace=False)
+    thresh = jnp.sort(prob_tensor, axis=dim, descending=True)
+    thresh = jnp.take(thresh, jnp.array([topk - 1]), axis=dim)
+    # ties at the threshold: mimic torch.topk by breaking ties on index order
+    ge = prob_tensor >= thresh
+    # count of selected could exceed topk on ties; resolve via stable argsort rank
+    order = jnp.argsort(jnp.argsort(-prob_tensor, axis=dim, stable=True), axis=dim, stable=True)
+    return (ge & (order < topk)).astype(jnp.int32)
+
+
+def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
+    """Probabilities/one-hot -> dense labels. Reference: utilities/data.py:142-157."""
+    return jnp.argmax(x, axis=argmax_dim)
+
+
+# --------------------------------------------------------------------------- #
+# collection traversal
+# --------------------------------------------------------------------------- #
+def apply_to_collection(
+    data: Any,
+    dtype: Union[type, tuple],
+    function: Callable,
+    *args: Any,
+    wrong_dtype: Optional[Union[type, tuple]] = None,
+    **kwargs: Any,
+) -> Any:
+    """Recursively apply ``function`` to all elements of type ``dtype``.
+
+    Reference: utilities/data.py:160-207. Kept (rather than ``jax.tree_map``)
+    because metric state dicts mix arrays, lists-of-arrays, and python scalars
+    and we need the type filter semantics.
+    """
+    elem_type = type(data)
+    if isinstance(data, dtype) and (wrong_dtype is None or not isinstance(data, wrong_dtype)):
+        return function(data, *args, **kwargs)
+    if isinstance(data, Mapping):
+        return elem_type({k: apply_to_collection(v, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for k, v in data.items()})
+    if isinstance(data, tuple) and hasattr(data, "_fields"):  # namedtuple
+        return elem_type(*(apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for d in data))
+    if isinstance(data, Sequence) and not isinstance(data, str):
+        return elem_type([apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for d in data])
+    return data
+
+
+# --------------------------------------------------------------------------- #
+# grouping / misc
+# --------------------------------------------------------------------------- #
+def get_group_indexes(indexes: Array) -> List[Array]:
+    """Group positions by value of ``indexes`` (host-side, eager).
+
+    Reference: utilities/data.py:210-237. The jit-friendly equivalent used inside
+    compiled retrieval kernels is segment-sum grouping (see
+    ``metrics_tpu.ops.retrieval.base``); this version is the API-parity helper.
+    """
+    idx = np.asarray(indexes)
+    structure: dict = {}
+    for i, v in enumerate(idx.tolist()):
+        structure.setdefault(v, []).append(i)
+    return [jnp.asarray(x, dtype=jnp.int32) for x in structure.values()]
+
+
+def _squeeze_if_scalar(data: Any) -> Any:
+    """Squeeze size-1 arrays to scalars. Reference: utilities/data.py:240-242."""
+    return apply_to_collection(data, jnp.ndarray, lambda x: jnp.squeeze(x) if x.size == 1 else x)
+
+
+def bincount(x: Array, minlength: Optional[int] = None) -> Array:
+    """Deterministic bincount with a static length (jit-safe).
+
+    Reference: utilities/data.py:244-264 ships a manual loop because CUDA
+    ``bincount`` is non-deterministic; XLA's lowering is deterministic, so the
+    direct op is safe on TPU.
+    """
+    if minlength is None:
+        minlength = int(jnp.max(x)) + 1  # data-dependent: eager only
+    return jnp.bincount(x.reshape(-1), length=minlength)
+
+
+def allclose(t1: Array, t2: Array, **kwargs: Any) -> bool:
+    """Shape-then-value closeness check (host-side)."""
+    if t1.shape != t2.shape:
+        return False
+    return bool(jnp.allclose(t1.astype(t2.dtype) if t1.dtype != t2.dtype else t1, t2, **kwargs))

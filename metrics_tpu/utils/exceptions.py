@@ -1,0 +1,12 @@
+"""User-facing exceptions.
+
+Reference parity: torchmetrics/utilities/exceptions.py:15 (`TorchMetricsUserError`).
+"""
+
+
+class MetricsUserError(Exception):
+    """Error raised when a misuse of the metric state machine is detected."""
+
+
+class MetricsUserWarning(UserWarning):
+    """Warning raised for recoverable metric misuse."""

@@ -1,0 +1,48 @@
+"""Cached availability probes for optional dependencies.
+
+Reference parity: torchmetrics/utilities/imports.py:27-124 (`_package_available`,
+`_module_available`, ~20 feature flags acting as the de-facto config system).
+The TPU build keeps the same mechanism: optional deps gate metric availability
+with actionable errors, never hard imports.
+"""
+from __future__ import annotations
+
+import importlib
+import importlib.util
+from functools import lru_cache
+
+
+@lru_cache()
+def package_available(name: str) -> bool:
+    """Return True if ``name`` is importable (probe only, does not import)."""
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ModuleNotFoundError, ValueError):
+        return False
+
+
+@lru_cache()
+def module_available(path: str) -> bool:
+    """Return True if a dotted module path is importable, e.g. ``flax.linen``."""
+    parts = path.split(".")
+    if not package_available(parts[0]):
+        return False
+    try:
+        importlib.import_module(path)
+        return True
+    except Exception:
+        return False
+
+
+_JAX_AVAILABLE = package_available("jax")
+_FLAX_AVAILABLE = package_available("flax")
+_OPTAX_AVAILABLE = package_available("optax")
+_ORBAX_AVAILABLE = package_available("orbax")
+_CHEX_AVAILABLE = package_available("chex")
+_EINOPS_AVAILABLE = package_available("einops")
+_TRANSFORMERS_AVAILABLE = package_available("transformers")
+_SKLEARN_AVAILABLE = package_available("sklearn")
+_SCIPY_AVAILABLE = package_available("scipy")
+_NLTK_AVAILABLE = package_available("nltk")
+_REGEX_AVAILABLE = package_available("regex")
+_TORCH_AVAILABLE = package_available("torch")

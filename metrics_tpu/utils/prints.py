@@ -1,0 +1,51 @@
+"""Process-zero-gated logging.
+
+Reference parity: torchmetrics/utilities/prints.py:22-49 (`rank_zero_*`, rank read
+from the LOCAL_RANK env var). Here rank is `jax.process_index()` (multi-host JAX)
+with an env-var fallback so the helpers work before JAX is initialised.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import warnings
+from typing import Any, Callable
+
+log = logging.getLogger("metrics_tpu")
+
+
+def _get_rank() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("LOCAL_RANK", os.environ.get("RANK", 0)))
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Call ``fn`` only on process 0 of a multi-host run."""
+
+    @functools.wraps(fn)
+    def wrapped(*args: Any, **kwargs: Any) -> Any:
+        if _get_rank() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped
+
+
+@rank_zero_only
+def rank_zero_warn(message: str, category: type = UserWarning, stacklevel: int = 3) -> None:
+    warnings.warn(message, category, stacklevel=stacklevel)
+
+
+@rank_zero_only
+def rank_zero_info(message: str) -> None:
+    log.info(message)
+
+
+@rank_zero_only
+def rank_zero_debug(message: str) -> None:
+    log.debug(message)

@@ -1,0 +1,15 @@
+"""Test configuration: force an 8-device CPU mesh (SURVEY.md §4 TPU translation).
+
+The container's sitecustomize imports jax and registers the TPU platform before
+pytest starts, so env-var selection is too late; instead we update the (lazy)
+platform config and XLA flags before the first backend initialization.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+NUM_DEVICES = len(jax.devices())

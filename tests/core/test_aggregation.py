@@ -1,0 +1,85 @@
+"""Aggregator tests (reference parity: tests/bases/test_aggregation.py)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+
+
+@pytest.mark.parametrize(
+    "cls,values,expected",
+    [
+        (SumMetric, [1.0, 2.0, 3.0], 6.0),
+        (MaxMetric, [1.0, 5.0, 3.0], 5.0),
+        (MinMetric, [4.0, 2.0, 3.0], 2.0),
+        (MeanMetric, [1.0, 2.0, 3.0], 2.0),
+    ],
+)
+def test_simple_aggregation(cls, values, expected):
+    m = cls()
+    for v in values:
+        m.update(jnp.asarray(v))
+    assert float(m.compute()) == pytest.approx(expected)
+
+
+def test_cat_metric():
+    m = CatMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(jnp.asarray(3.0))
+    np.testing.assert_allclose(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+
+
+def test_mean_weighted():
+    m = MeanMetric()
+    m.update(jnp.asarray([1.0, 2.0]), weight=jnp.asarray([1.0, 3.0]))
+    assert float(m.compute()) == pytest.approx((1 + 6) / 4)
+
+
+def test_nan_error():
+    m = SumMetric(nan_strategy="error")
+    with pytest.raises(RuntimeError, match="nan"):
+        m.update(jnp.asarray([1.0, float("nan")]))
+
+
+@pytest.mark.parametrize("strategy,expected", [("ignore", 3.0), (0.0, 3.0), (10.0, 13.0)])
+def test_nan_strategies_sum(strategy, expected):
+    m = SumMetric(nan_strategy=strategy)
+    m.update(jnp.asarray([1.0, 2.0, float("nan")]))
+    assert float(m.compute()) == pytest.approx(expected)
+
+
+def test_nan_ignore_max():
+    m = MaxMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, float("nan"), 3.0]))
+    assert float(m.compute()) == 3.0
+
+
+def test_nan_ignore_mean():
+    m = MeanMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([2.0, float("nan"), 4.0]))
+    assert float(m.compute()) == pytest.approx(3.0)
+
+
+def test_invalid_strategy():
+    with pytest.raises(ValueError, match="nan_strategy"):
+        SumMetric(nan_strategy="bogus")
+
+
+def test_aggregators_in_forward():
+    m = SumMetric()
+    out = m(jnp.asarray([1.0, 2.0]))
+    assert float(out) == 3.0
+    m(jnp.asarray(4.0))
+    assert float(m.compute()) == 7.0
+
+
+def test_nan_in_weight_ignored():
+    m = MeanMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, 2.0]), weight=jnp.asarray([1.0, float("nan")]))
+    assert float(m.compute()) == pytest.approx(1.0)
+
+
+def test_nan_in_weight_error():
+    m = MeanMetric(nan_strategy="error")
+    with pytest.raises(RuntimeError, match="nan"):
+        m.update(jnp.asarray([1.0]), weight=jnp.asarray([float("nan")]))

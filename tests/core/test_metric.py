@@ -1,0 +1,240 @@
+"""Base-runtime lifecycle tests.
+
+Reference parity: tests/bases/test_metric.py — state registry, reset, forward
+semantics (full vs reduced), compute caching, pickling, state_dict round-trip,
+plus the pure protocol (init/update/compute/merge) that the reference lacks.
+"""
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+from metrics_tpu.utils.exceptions import MetricsUserError
+from tests.helpers.testers import DummyListMetric, DummyMetric, DummyMetricDiff, DummyMetricSum
+
+
+def test_add_state_validation():
+    m = DummyMetric()
+    with pytest.raises(ValueError, match="state variable"):
+        m.add_state("bad", 42, "sum")
+    with pytest.raises(ValueError, match="dist_reduce_fx"):
+        m.add_state("bad", jnp.asarray(0.0), "nope")
+
+
+def test_inherit():
+    DummyMetric()
+
+
+def test_add_state_sets_attributes():
+    m = DummyMetric()
+    m.add_state("a", jnp.asarray(0.0), "sum")
+    m.add_state("b", [], "cat")
+    assert float(m.a) == 0.0
+    assert m.b == []
+    assert m._reductions["a"] == "sum"
+
+
+def test_reset():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(2.0))
+    assert float(m.x) == 2.0
+    m.reset()
+    assert float(m.x) == 0.0
+    assert m._update_count == 0
+
+    lm = DummyListMetric()
+    lm.update(jnp.asarray(1.0))
+    assert len(lm.x) == 1
+    lm.reset()
+    assert lm.x == []
+
+
+def test_update_and_compute():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(1.0))
+    m.update(jnp.asarray(2.0))
+    assert float(m.compute()) == 3.0
+    assert m._update_count == 2
+
+
+def test_compute_cached_until_update():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(1.0))
+    assert float(m.compute()) == 1.0
+    assert m._computed is not None
+    m.update(jnp.asarray(1.0))
+    assert m._computed is None
+    assert float(m.compute()) == 2.0
+
+
+def test_forward_full_vs_reduced():
+    class Full(DummyMetricSum):
+        full_state_update = True
+
+    class Reduced(DummyMetricSum):
+        full_state_update = False
+
+    for cls in (Full, Reduced):
+        m = cls()
+        b1 = m(jnp.asarray(1.0))
+        assert float(b1) == 1.0  # batch value
+        b2 = m(jnp.asarray(2.0))
+        assert float(b2) == 2.0
+        assert float(m.compute()) == 3.0  # accumulated
+
+
+def test_forward_reduced_mean_state():
+    class MeanState(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("m", jnp.asarray(0.0), dist_reduce_fx="mean")
+
+        def update(self, x):
+            self.m = self.m + x  # fresh state per batch in reduced mode
+
+        def compute(self):
+            return self.m
+
+    m = MeanState()
+    m(jnp.asarray(2.0))
+    m(jnp.asarray(4.0))
+    assert float(m.compute()) == pytest.approx(3.0)
+
+
+def test_forward_while_synced_raises():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(1.0))
+    m._is_synced = True
+    with pytest.raises(MetricsUserError, match="shouldn't be synced"):
+        m(jnp.asarray(1.0))
+
+
+def test_sync_unsync_state_machine():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(1.0))
+    # single process: sync is a no-op but guards still hold
+    m.sync(should_sync=True, distributed_available=lambda: False)
+    assert not m._is_synced
+    with pytest.raises(MetricsUserError, match="un-synced"):
+        m.unsync()
+    # double sync raises
+    m._is_synced = True
+    with pytest.raises(MetricsUserError, match="already been synced"):
+        m.sync()
+    m._is_synced = False
+
+
+def test_pickle():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(3.0))
+    m2 = pickle.loads(pickle.dumps(m))
+    assert float(m2.compute()) == 3.0
+
+
+def test_state_dict_roundtrip():
+    m = DummyMetricSum()
+    m.add_state("persisted", jnp.asarray(5.0), "sum", persistent=True)
+    sd = m.state_dict()
+    assert "persisted" in sd and "x" not in sd
+    m2 = DummyMetricSum()
+    m2.add_state("persisted", jnp.asarray(0.0), "sum", persistent=True)
+    m2.load_state_dict(sd)
+    assert float(m2.persisted) == 5.0
+
+
+def test_protected_class_constants():
+    m = DummyMetric()
+    with pytest.raises(RuntimeError, match="Can't change const"):
+        m.is_differentiable = True
+    with pytest.raises(RuntimeError, match="Can't change const"):
+        m.higher_is_better = True
+    with pytest.raises(RuntimeError, match="Can't change const"):
+        m.full_state_update = False
+
+
+def test_hash():
+    m1, m2 = DummyMetric(), DummyMetric()
+    assert hash(m1) != hash(m2)
+    assert {m1, m2}  # usable in sets
+
+
+def test_metric_state_property():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(2.0))
+    assert set(m.metric_state) == {"x"}
+    assert float(m.metric_state["x"]) == 2.0
+
+
+# --------------------------------------------------------------------------- #
+# pure protocol
+# --------------------------------------------------------------------------- #
+def test_pure_protocol_matches_stateful():
+    m = DummyMetricSum()
+    state = m.init_state()
+    state = m.update_state(state, jnp.asarray(1.0))
+    state = m.update_state(state, jnp.asarray(2.0))
+    assert float(m.compute_state(state)) == 3.0
+    # facade untouched
+    assert float(m.x) == 0.0
+
+
+def test_pure_update_is_jittable():
+    m = DummyMetricSum()
+    f = jax.jit(lambda s, x: m.update_state(s, x))
+    state = m.init_state()
+    state = f(state, jnp.asarray(1.0))
+    state = f(state, jnp.asarray(2.0))
+    assert float(m.compute_state(state)) == 3.0
+
+
+def test_merge_states_reductions():
+    class Multi(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("s", jnp.asarray(1.0), "sum")
+            self.add_state("mx", jnp.asarray(1.0), "max")
+            self.add_state("mn", jnp.asarray(1.0), "min")
+            self.add_state("c", [], "cat")
+
+        def update(self):
+            pass
+
+        def compute(self):
+            return self.s
+
+    m = Multi()
+    a = {"s": jnp.asarray(1.0), "mx": jnp.asarray(1.0), "mn": jnp.asarray(1.0), "c": [jnp.asarray([1.0])]}
+    b = {"s": jnp.asarray(2.0), "mx": jnp.asarray(3.0), "mn": jnp.asarray(0.5), "c": [jnp.asarray([2.0])]}
+    merged = m.merge_states(a, b)
+    assert float(merged["s"]) == 3.0
+    assert float(merged["mx"]) == 3.0
+    assert float(merged["mn"]) == 0.5
+    assert len(merged["c"]) == 2
+
+
+def test_compute_without_update_warns():
+    m = DummyMetricSum()
+    with pytest.warns(UserWarning, match="before the ``update`` method"):
+        m.compute()
+
+
+def test_enum_from_str_with_spaces():
+    from metrics_tpu.utils.enums import DataType
+
+    assert DataType.from_str("multi-dim multi-class") is DataType.MULTIDIM_MULTICLASS
+    assert DataType.from_str("binary") is DataType.BINARY
+    assert DataType.from_str("bogus") is None
+
+
+def test_astype_survives_reset():
+    from tests.helpers.testers import DummyMetricSum
+
+    m = DummyMetricSum().astype(jnp.bfloat16)
+    m.update(jnp.asarray(1.0, jnp.bfloat16))
+    m.reset()
+    assert m.x.dtype == jnp.bfloat16

@@ -1,0 +1,5 @@
+import random
+
+import numpy as np
+
+seed_all = lambda seed=42: (random.seed(seed), np.random.seed(seed))

@@ -1,0 +1,279 @@
+"""Differential test harness.
+
+Reference parity: tests/helpers/testers.py (MetricTester :335, _class_test :111,
+_functional_test :253). Philosophy unchanged (SURVEY.md §4): differential
+testing against a trusted oracle (sklearn et al.) over a parametrized grid,
+including distributed runs with batches strided across ranks and the rank-0
+assertion comparing against the oracle on the concatenation of all ranks'
+batches — which is what validates the collective sync.
+
+The "cluster" here is the 8-device CPU mesh (`xla_force_host_platform_device_count`),
+and the distributed path exercises the *pure* protocol under `shard_map`:
+per-device state update -> `sync_states` collectives -> `compute_state`.
+"""
+from __future__ import annotations
+
+import pickle
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu.core.metric import Metric
+
+NUM_PROCESSES = 2  # logical ranks for the strided-batch ddp test
+NUM_BATCHES = 8    # divisible by NUM_PROCESSES
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+
+def _assert_allclose(tm_result: Any, sk_result: Any, atol: float = 1e-6) -> None:
+    """Recursive closeness assert over arrays / dicts / sequences."""
+    if isinstance(tm_result, dict):
+        assert isinstance(sk_result, dict), f"expected dict, got {type(sk_result)}"
+        for k in tm_result:
+            _assert_allclose(tm_result[k], sk_result[k], atol=atol)
+    elif isinstance(tm_result, (list, tuple)):
+        assert len(tm_result) == len(sk_result)
+        for t, s in zip(tm_result, sk_result):
+            _assert_allclose(t, s, atol=atol)
+    else:
+        t = np.asarray(tm_result, dtype=np.float64)
+        s = np.asarray(sk_result, dtype=np.float64)
+        np.testing.assert_allclose(t, s, atol=atol, rtol=1e-5)
+
+
+def _class_test_single(
+    preds: np.ndarray,
+    target: np.ndarray,
+    metric_class: type,
+    sk_metric: Callable,
+    metric_args: dict,
+    check_batch: bool = True,
+    atol: float = 1e-6,
+    fragment_kwargs: bool = False,
+    **kwargs_update: Any,
+) -> None:
+    """Single-device stateful test: forward per batch, compute over epoch.
+
+    Mirrors reference _class_test (testers.py:111-250): per-batch value parity,
+    end-of-epoch parity, pickling, reset behavior.
+    """
+    metric = metric_class(**metric_args)
+    # pickling round-trip (reference :175)
+    pickled = pickle.dumps(metric)
+    metric = pickle.loads(pickled)
+
+    num_batches = preds.shape[0]
+    for i in range(num_batches):
+        batch_kwargs = {
+            k: (v[i] if isinstance(v, (np.ndarray, jnp.ndarray)) and v.shape[0] == num_batches and fragment_kwargs else v)
+            for k, v in kwargs_update.items()
+        }
+        batch_result = metric(jnp.asarray(preds[i]), jnp.asarray(target[i]), **batch_kwargs)
+        if check_batch:
+            sk_batch_result = sk_metric(preds[i], target[i], **batch_kwargs)
+            _assert_allclose(batch_result, sk_batch_result, atol=atol)
+
+    result = metric.compute()
+    total_kwargs = {
+        k: (np.concatenate(list(v)) if isinstance(v, (np.ndarray, jnp.ndarray)) and v.ndim > 1 and fragment_kwargs else v)
+        for k, v in kwargs_update.items()
+    }
+    sk_result = sk_metric(np.concatenate(list(preds)), np.concatenate(list(target)), **total_kwargs)
+    _assert_allclose(result, sk_result, atol=atol)
+
+    # reset restores defaults (reference test_metric lifecycle)
+    metric.reset()
+    for name, default in metric._defaults.items():
+        current = getattr(metric, name)
+        if isinstance(default, list):
+            assert current == [] or current == default
+        else:
+            assert jnp.allclose(jnp.asarray(current, dtype=jnp.float32), jnp.asarray(default, dtype=jnp.float32))
+
+
+def _class_test_ddp(
+    preds: np.ndarray,
+    target: np.ndarray,
+    metric_class: type,
+    sk_metric: Callable,
+    metric_args: dict,
+    atol: float = 1e-6,
+    world: int = NUM_PROCESSES,
+    **kwargs_update: Any,
+) -> None:
+    """Distributed test: strided batches over a `world`-device mesh.
+
+    Device d consumes batches d, d+world, ... (reference testers.py:178); the
+    final value — computed from psum/all_gather-synced state inside shard_map —
+    must equal the oracle on ALL batches (reference :225-250), which validates
+    the collective path end to end.
+    """
+    devices = jax.devices()
+    if len(devices) < world:
+        import pytest
+
+        pytest.skip(f"needs {world} devices")
+    mesh = Mesh(np.asarray(devices[:world]), ("data",))
+    metric = metric_class(**metric_args)
+
+    num_batches = preds.shape[0]
+    assert num_batches % world == 0
+    steps = num_batches // world
+    # stride: rank r takes batches r, r+world, ... -> shape (world, steps, ...)
+    preds_strided = jnp.asarray(np.stack([preds[r::world] for r in range(world)]))
+    target_strided = jnp.asarray(np.stack([target[r::world] for r in range(world)]))
+
+    def body(p, t):  # p: (1, steps, B, ...) block per device
+        p, t = p[0], t[0]
+        state = metric.init_state()
+        for i in range(steps):
+            state = metric.update_state(state, p[i], t[i])
+        state = metric.sync_states(state, "data")
+        value = metric.compute_state(state)
+        return jax.tree.map(lambda x: jnp.expand_dims(jnp.asarray(x, jnp.float32), 0), value)
+
+    result = jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P("data"), check_vma=False)
+    )(preds_strided, target_strided)
+    result = jax.tree.map(lambda x: x[0], result)
+
+    sk_result = sk_metric(np.concatenate(list(preds)), np.concatenate(list(target)), **kwargs_update)
+    _assert_allclose(result, sk_result, atol=atol)
+
+
+def _functional_test(
+    preds: np.ndarray,
+    target: np.ndarray,
+    metric_functional: Callable,
+    sk_metric: Callable,
+    metric_args: Optional[dict] = None,
+    atol: float = 1e-6,
+    **kwargs_update: Any,
+) -> None:
+    """Stateless functional parity per batch (reference testers.py:253-301)."""
+    metric_args = metric_args or {}
+    metric = partial(metric_functional, **metric_args)
+    for i in range(preds.shape[0]):
+        tm_result = metric(jnp.asarray(preds[i]), jnp.asarray(target[i]), **kwargs_update)
+        sk_result = sk_metric(preds[i], target[i], **kwargs_update)
+        _assert_allclose(tm_result, sk_result, atol=atol)
+
+
+class MetricTester:
+    """Parity-test orchestrator (reference testers.py:335-476)."""
+
+    atol: float = 1e-6
+
+    def run_functional_metric_test(self, preds, target, metric_functional, sk_metric, metric_args=None, **kwargs_update):
+        _functional_test(
+            np.asarray(preds), np.asarray(target), metric_functional, sk_metric,
+            metric_args=metric_args, atol=self.atol, **kwargs_update,
+        )
+
+    def run_class_metric_test(
+        self,
+        ddp: bool,
+        preds,
+        target,
+        metric_class: type,
+        sk_metric: Callable,
+        metric_args: Optional[dict] = None,
+        check_batch: bool = True,
+        fragment_kwargs: bool = False,
+        **kwargs_update: Any,
+    ):
+        metric_args = metric_args or {}
+        preds, target = np.asarray(preds), np.asarray(target)
+        if ddp:
+            _class_test_ddp(preds, target, metric_class, sk_metric, metric_args, atol=self.atol, **kwargs_update)
+        else:
+            _class_test_single(
+                preds, target, metric_class, sk_metric, metric_args,
+                check_batch=check_batch, atol=self.atol, fragment_kwargs=fragment_kwargs, **kwargs_update,
+            )
+
+    def run_precision_test(self, preds, target, metric_functional, metric_args=None, dtype=jnp.bfloat16):
+        """bf16 smoke test (reference fp16 tests, testers.py:478-534)."""
+        metric_args = metric_args or {}
+        p = jnp.asarray(np.asarray(preds)[0])
+        t = jnp.asarray(np.asarray(target)[0])
+        if jnp.issubdtype(p.dtype, jnp.floating):
+            p = p.astype(dtype)
+        res = metric_functional(p, t, **metric_args)
+        assert jax.tree.all(jax.tree.map(lambda x: bool(jnp.all(jnp.isfinite(jnp.asarray(x, jnp.float32)))), res))
+
+    def run_differentiability_test(self, preds, target, metric_functional, metric_args=None):
+        """Gradients flow and are finite (reference testers.py:536-570 gradcheck)."""
+        metric_args = metric_args or {}
+        p = jnp.asarray(np.asarray(preds)[0], dtype=jnp.float32)
+        t = jnp.asarray(np.asarray(target)[0])
+
+        def scalar_fn(p_):
+            out = metric_functional(p_, t, **metric_args)
+            leaves = jax.tree.leaves(out)
+            return sum(jnp.sum(jnp.asarray(l, jnp.float32)) for l in leaves)
+
+        grad = jax.grad(scalar_fn)(p)
+        assert bool(jnp.all(jnp.isfinite(grad)))
+
+
+# --------------------------------------------------------------------------- #
+# dummy metrics for base-runtime isolation (reference testers.py:573-621)
+# --------------------------------------------------------------------------- #
+class DummyMetric(Metric):
+    name = "Dummy"
+    full_state_update = True
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, *args, **kwargs):
+        pass
+
+    def compute(self):
+        pass
+
+
+class DummyListMetric(Metric):
+    name = "DummyList"
+    full_state_update = True
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", [], dist_reduce_fx="cat")
+
+    def update(self, x=None):
+        if x is not None:
+            self.x = self.x + [jnp.asarray(x)]
+
+    def compute(self):
+        return self.x
+
+
+class DummyMetricSum(DummyMetric):
+    def update(self, x):
+        self.x = self.x + x
+
+    def compute(self):
+        return self.x
+
+
+class DummyMetricDiff(DummyMetric):
+    def update(self, y):
+        self.x = self.x - y
+
+    def compute(self):
+        return self.x
+
+
+class DummyMetricMultiOutput(DummyMetricSum):
+    def compute(self):
+        return [self.x, self.x]
