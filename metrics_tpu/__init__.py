@@ -8,4 +8,49 @@ SSIM convs) as jitted XLA programs.
 """
 from metrics_tpu.__about__ import __version__  # noqa: F401
 from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric  # noqa: F401
+from metrics_tpu.classification import (  # noqa: F401
+    AUC,
+    AUROC,
+    Accuracy,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+    CalibrationError,
+    CohenKappa,
+    ConfusionMatrix,
+    CoverageError,
+    Dice,
+    F1Score,
+    FBetaScore,
+    HammingDistance,
+    HingeLoss,
+    JaccardIndex,
+    KLDivergence,
+    LabelRankingAveragePrecision,
+    LabelRankingLoss,
+    MatthewsCorrCoef,
+    Precision,
+    PrecisionRecallCurve,
+    Recall,
+    ROC,
+    Specificity,
+    StatScores,
+)
 from metrics_tpu.core import CompositionalMetric, Metric, MetricCollection  # noqa: F401
+
+__all__ = [
+    "__version__",
+    # core
+    "Metric", "MetricCollection", "CompositionalMetric",
+    # aggregation
+    "CatMetric", "MaxMetric", "MeanMetric", "MinMetric", "SumMetric",
+    # classification
+    "AUC", "AUROC", "Accuracy", "AveragePrecision", "BinnedAveragePrecision",
+    "BinnedPrecisionRecallCurve", "BinnedRecallAtFixedPrecision",
+    "CalibrationError", "CohenKappa", "ConfusionMatrix", "CoverageError",
+    "Dice", "F1Score", "FBetaScore", "HammingDistance", "HingeLoss",
+    "JaccardIndex", "KLDivergence", "LabelRankingAveragePrecision",
+    "LabelRankingLoss", "MatthewsCorrCoef", "Precision", "PrecisionRecallCurve",
+    "Recall", "ROC", "Specificity", "StatScores",
+]

@@ -1,0 +1,30 @@
+"""Classification module metrics (reference parity: torchmetrics/classification/)."""
+from metrics_tpu.classification.accuracy import Accuracy  # noqa: F401
+from metrics_tpu.classification.auc import AUC  # noqa: F401
+from metrics_tpu.classification.auroc import AUROC  # noqa: F401
+from metrics_tpu.classification.avg_precision import AveragePrecision  # noqa: F401
+from metrics_tpu.classification.binned_precision_recall import (  # noqa: F401
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+)
+from metrics_tpu.classification.calibration_error import CalibrationError  # noqa: F401
+from metrics_tpu.classification.cohen_kappa import CohenKappa  # noqa: F401
+from metrics_tpu.classification.confusion_matrix import ConfusionMatrix  # noqa: F401
+from metrics_tpu.classification.dice import Dice  # noqa: F401
+from metrics_tpu.classification.f_beta import F1Score, FBetaScore  # noqa: F401
+from metrics_tpu.classification.hamming import HammingDistance  # noqa: F401
+from metrics_tpu.classification.hinge import HingeLoss  # noqa: F401
+from metrics_tpu.classification.jaccard import JaccardIndex  # noqa: F401
+from metrics_tpu.classification.kl_divergence import KLDivergence  # noqa: F401
+from metrics_tpu.classification.matthews_corrcoef import MatthewsCorrCoef  # noqa: F401
+from metrics_tpu.classification.precision_recall import Precision, Recall  # noqa: F401
+from metrics_tpu.classification.precision_recall_curve import PrecisionRecallCurve  # noqa: F401
+from metrics_tpu.classification.ranking import (  # noqa: F401
+    CoverageError,
+    LabelRankingAveragePrecision,
+    LabelRankingLoss,
+)
+from metrics_tpu.classification.roc import ROC  # noqa: F401
+from metrics_tpu.classification.specificity import Specificity  # noqa: F401
+from metrics_tpu.classification.stat_scores import StatScores  # noqa: F401

@@ -1,0 +1,32 @@
+"""AUC module. Reference parity: torchmetrics/classification/auc.py:24-80."""
+from __future__ import annotations
+
+from typing import Any, List
+
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.classification.auc import _auc_compute, _auc_update
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class AUC(Metric):
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update: bool = False
+
+    def __init__(self, reorder: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.reorder = reorder
+        self.add_state("x", default=[], dist_reduce_fx="cat")
+        self.add_state("y", default=[], dist_reduce_fx="cat")
+
+    def update(self, x: Array, y: Array) -> None:  # type: ignore[override]
+        x, y = _auc_update(x, y)
+        self.x = self.x + [x]
+        self.y = self.y + [y]
+
+    def compute(self) -> Array:
+        x = dim_zero_cat(self.x)
+        y = dim_zero_cat(self.y)
+        return _auc_compute(x, y, reorder=self.reorder)

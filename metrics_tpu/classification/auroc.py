@@ -1,0 +1,62 @@
+"""AUROC module. Reference parity: torchmetrics/classification/auroc.py:27-184."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.classification.auroc import _auroc_compute, _auroc_update
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.enums import DataType
+
+
+class AUROC(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        pos_label: Optional[int] = None,
+        average: Optional[str] = "macro",
+        max_fpr: Optional[float] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+        self.average = average
+        self.max_fpr = max_fpr
+
+        allowed_average = (None, "macro", "weighted", "micro")
+        if self.average not in allowed_average:
+            raise ValueError(f"Argument `average` expected to be one of the following: {allowed_average} but got {average}")
+        if self.max_fpr is not None:
+            if not isinstance(max_fpr, float) or not 0 < max_fpr <= 1:
+                raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
+
+        self.mode: Optional[DataType] = None
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        preds, target, mode = _auroc_update(preds, target)
+        self.preds = self.preds + [preds]
+        self.target = self.target + [target]
+        if self.mode and self.mode != mode:
+            raise ValueError(
+                "The mode of data (binary, multi-label, multi-class) should be constant, but changed"
+                f" between batches from {self.mode} to {mode}"
+            )
+        self.mode = mode
+
+    def compute(self) -> Array:
+        if not self.mode:
+            raise RuntimeError("You have to have determined mode.")
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _auroc_compute(
+            preds, target, self.mode, self.num_classes, self.pos_label, self.average, self.max_fpr
+        )

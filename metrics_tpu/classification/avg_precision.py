@@ -1,0 +1,48 @@
+"""AveragePrecision module. Reference parity: torchmetrics/classification/avg_precision.py:28-145."""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Union
+
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.classification.average_precision import _average_precision_compute, _average_precision_update
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class AveragePrecision(Metric):
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        pos_label: Optional[int] = None,
+        average: Optional[str] = "macro",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+        allowed_average = ("micro", "macro", "weighted", "none", None)
+        if average not in allowed_average:
+            raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
+        self.average = average
+
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        preds, target, num_classes, pos_label = _average_precision_update(
+            preds, target, self.num_classes, self.pos_label, self.average
+        )
+        self.preds = self.preds + [preds]
+        self.target = self.target + [target]
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+
+    def compute(self) -> Union[Array, List[Array]]:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _average_precision_compute(preds, target, self.num_classes, self.pos_label, self.average)

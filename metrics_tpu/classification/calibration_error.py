@@ -1,0 +1,42 @@
+"""CalibrationError module. Reference parity: torchmetrics/classification/calibration_error.py:24-110."""
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.classification.calibration_error import _ce_compute, _ce_update
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class CalibrationError(Metric):
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update: bool = False
+
+    DISTANCES = {"l1", "l2", "max"}
+
+    def __init__(self, n_bins: int = 15, norm: str = "l1", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if norm not in self.DISTANCES:
+            raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
+        if not isinstance(n_bins, int) or n_bins <= 0:
+            raise ValueError(f"Expected argument `n_bins` to be a int larger than 0 but got {n_bins}")
+        self.n_bins = n_bins
+        self.norm = norm
+        self.bin_boundaries = jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32)
+
+        self.add_state("confidences", [], dist_reduce_fx="cat")
+        self.add_state("accuracies", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        confidences, accuracies = _ce_update(preds, target)
+        self.confidences = self.confidences + [confidences]
+        self.accuracies = self.accuracies + [accuracies]
+
+    def compute(self) -> Array:
+        confidences = dim_zero_cat(self.confidences)
+        accuracies = dim_zero_cat(self.accuracies)
+        return _ce_compute(confidences, accuracies, self.bin_boundaries, norm=self.norm)

@@ -1,0 +1,44 @@
+"""CohenKappa module. Reference parity: torchmetrics/classification/cohen_kappa.py:23-103."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.classification.cohen_kappa import _cohen_kappa_compute, _cohen_kappa_update
+
+
+class CohenKappa(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        weights: Optional[str] = None,
+        threshold: float = 0.5,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.weights = weights
+        self.threshold = threshold
+
+        allowed_weights = ("linear", "quadratic", "none", None)
+        if self.weights not in allowed_weights:
+            raise ValueError(f"Argument weights needs to one of the following: {allowed_weights}")
+
+        self.add_state("confmat", default=jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def _update_signature(self):
+        return ("confmat", self.num_classes, self.threshold, False)
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        confmat = _cohen_kappa_update(preds, target, self.num_classes, self.threshold)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        return _cohen_kappa_compute(self.confmat, None if self.weights == "none" else self.weights)

@@ -1,0 +1,47 @@
+"""ConfusionMatrix module. Reference parity: torchmetrics/classification/confusion_matrix.py:23-128."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.classification.confusion_matrix import _confusion_matrix_compute, _confusion_matrix_update
+
+
+class ConfusionMatrix(Metric):
+    is_differentiable = False
+    higher_is_better: Optional[bool] = None
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        normalize: Optional[str] = None,
+        threshold: float = 0.5,
+        multilabel: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.normalize = normalize
+        self.threshold = threshold
+        self.multilabel = multilabel
+
+        allowed_normalize = ("true", "pred", "all", "none", None)
+        if normalize not in allowed_normalize:
+            raise ValueError(f"Argument average needs to one of the following: {allowed_normalize}")
+
+        default = jnp.zeros((num_classes, 2, 2), dtype=jnp.int32) if multilabel else jnp.zeros((num_classes, num_classes), dtype=jnp.int32)
+        self.add_state("confmat", default=default, dist_reduce_fx="sum")
+
+    def _update_signature(self):
+        return ("confmat", self.num_classes, self.threshold, self.multilabel)
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        confmat = _confusion_matrix_update(preds, target, self.num_classes, self.threshold, self.multilabel)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        return _confusion_matrix_compute(self.confmat, self.normalize)

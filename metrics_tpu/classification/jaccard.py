@@ -1,0 +1,33 @@
+"""JaccardIndex module. Reference parity: torchmetrics/classification/jaccard.py:23-117."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from jax import Array
+
+from metrics_tpu.classification.confusion_matrix import ConfusionMatrix
+from metrics_tpu.ops.classification.jaccard import _jaccard_from_confmat
+
+
+class JaccardIndex(ConfusionMatrix):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        average: Optional[str] = "macro",
+        ignore_index: Optional[int] = None,
+        absent_score: float = 0.0,
+        threshold: float = 0.5,
+        multilabel: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(num_classes=num_classes, normalize=None, threshold=threshold, multilabel=multilabel, **kwargs)
+        self.average = average
+        self.ignore_index = ignore_index
+        self.absent_score = absent_score
+
+    def compute(self) -> Array:
+        return _jaccard_from_confmat(self.confmat, self.num_classes, self.average, self.ignore_index, self.absent_score)

@@ -1,0 +1,45 @@
+"""KLDivergence module. Reference parity: torchmetrics/classification/kl_divergence.py:25-105."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.classification.kl_divergence import _kld_compute, _kld_update
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class KLDivergence(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update: bool = False
+
+    def __init__(self, log_prob: bool = False, reduction: Optional[str] = "mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(log_prob, bool):
+            raise TypeError(f"Expected argument `log_prob` to be bool but got {log_prob}")
+        allowed_reduction = ["mean", "sum", "none", None]
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
+        self.log_prob = log_prob
+        self.reduction = reduction
+
+        if self.reduction in ["mean", "sum"]:
+            self.add_state("measures", jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            self.add_state("measures", [], dist_reduce_fx="cat")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, p: Array, q: Array) -> None:  # type: ignore[override]
+        measures, total = _kld_update(p, q, self.log_prob)
+        if self.reduction is None or self.reduction == "none":
+            self.measures = self.measures + [measures]
+        else:
+            self.measures = self.measures + jnp.sum(measures)
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        measures = dim_zero_cat(self.measures) if self.reduction in ["none", None] else self.measures
+        return _kld_compute(measures, self.total, self.reduction)

@@ -1,0 +1,32 @@
+"""MatthewsCorrCoef module. Reference parity: torchmetrics/classification/matthews_corrcoef.py:26-95."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.classification.matthews_corrcoef import _matthews_corrcoef_compute, _matthews_corrcoef_update
+
+
+class MatthewsCorrCoef(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+
+    def __init__(self, num_classes: int, threshold: float = 0.5, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.add_state("confmat", default=jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def _update_signature(self):
+        return ("confmat", self.num_classes, self.threshold, False)
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        confmat = _matthews_corrcoef_update(preds, target, self.num_classes, self.threshold)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        return _matthews_corrcoef_compute(self.confmat)

@@ -1,0 +1,40 @@
+"""PrecisionRecallCurve module. Reference parity: torchmetrics/classification/precision_recall_curve.py:28-131."""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.classification.precision_recall_curve import (
+    _precision_recall_curve_compute,
+    _precision_recall_curve_update,
+)
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class PrecisionRecallCurve(Metric):
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update: bool = False
+
+    def __init__(self, num_classes: Optional[int] = None, pos_label: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        preds, target, num_classes, pos_label = _precision_recall_curve_update(
+            preds, target, self.num_classes, self.pos_label
+        )
+        self.preds = self.preds + [preds]
+        self.target = self.target + [target]
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+
+    def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _precision_recall_curve_compute(preds, target, self.num_classes, self.pos_label)

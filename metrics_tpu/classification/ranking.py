@@ -1,0 +1,80 @@
+"""Multilabel ranking modules.
+
+Reference parity: torchmetrics/classification/ranking.py — ``CoverageError``
+(:30), ``LabelRankingAveragePrecision`` (:85), ``LabelRankingLoss`` (:142).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.classification.ranking import (
+    _coverage_error_compute,
+    _coverage_error_update,
+    _label_ranking_average_precision_compute,
+    _label_ranking_average_precision_update,
+    _label_ranking_loss_compute,
+    _label_ranking_loss_update,
+)
+
+
+class _RankingBase(Metric):
+    is_differentiable = False
+    full_state_update: bool = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("measure", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+        self.add_state("sample_weight", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self._has_weight = False
+
+
+class CoverageError(_RankingBase):
+    higher_is_better = False
+
+    def update(self, preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:  # type: ignore[override]
+        measure, total, weight = _coverage_error_update(preds, target, sample_weight)
+        self.measure = self.measure + measure
+        self.total = self.total + total
+        if weight is not None:
+            self.sample_weight = self.sample_weight + weight
+            self._has_weight = True
+
+    def compute(self) -> Array:
+        return _coverage_error_compute(self.measure, self.total, self.sample_weight if self._has_weight else None)
+
+
+class LabelRankingAveragePrecision(_RankingBase):
+    higher_is_better = True
+
+    def update(self, preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:  # type: ignore[override]
+        measure, total, weight = _label_ranking_average_precision_update(preds, target, sample_weight)
+        self.measure = self.measure + measure
+        self.total = self.total + total
+        if weight is not None:
+            self.sample_weight = self.sample_weight + weight
+            self._has_weight = True
+
+    def compute(self) -> Array:
+        return _label_ranking_average_precision_compute(
+            self.measure, self.total, self.sample_weight if self._has_weight else None
+        )
+
+
+class LabelRankingLoss(_RankingBase):
+    higher_is_better = False
+
+    def update(self, preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:  # type: ignore[override]
+        measure, total, weight = _label_ranking_loss_update(preds, target, sample_weight)
+        self.measure = self.measure + measure
+        self.total = self.total + total
+        if weight is not None:
+            self.sample_weight = self.sample_weight + weight
+            self._has_weight = True
+
+    def compute(self) -> Array:
+        return _label_ranking_loss_compute(self.measure, self.total, self.sample_weight if self._has_weight else None)

@@ -1,0 +1,17 @@
+"""Specificity module. Reference parity: torchmetrics/classification/specificity.py:23-157."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from jax import Array
+
+from metrics_tpu.classification.precision_recall import _PrecisionRecallBase
+from metrics_tpu.ops.classification.specificity import _specificity_compute
+
+
+class Specificity(_PrecisionRecallBase):
+    """TN / (TN + FP)."""
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._get_final_stats()
+        return _specificity_compute(tp, fp, tn, fn, self.average, self.mdmc_reduce)

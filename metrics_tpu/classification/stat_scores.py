@@ -1,0 +1,95 @@
+"""StatScores module — the shared tp/fp/tn/fn engine.
+
+Reference parity: torchmetrics/classification/stat_scores.py:24-262.
+Subclasses (Accuracy, Precision, Recall, F1, FBeta, Specificity, Dice) share
+this state layout; with equal init args they land in one static compute group
+(``_update_signature``), so a MetricCollection updates the engine once per step.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.classification.stat_scores import _stat_scores_compute, _stat_scores_update
+
+
+class StatScores(Metric):
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = None
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        top_k: Optional[int] = None,
+        reduce: str = "micro",
+        num_classes: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        mdmc_reduce: Optional[str] = None,
+        multiclass: Optional[bool] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.reduce = reduce
+        self.mdmc_reduce = mdmc_reduce
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.multiclass = multiclass
+        self.ignore_index = ignore_index
+        self.top_k = top_k
+
+        if reduce not in ["micro", "macro", "samples"]:
+            raise ValueError(f"The `reduce` {reduce} is not valid.")
+        if mdmc_reduce not in [None, "samplewise", "global"]:
+            raise ValueError(f"The `mdmc_reduce` {mdmc_reduce} is not valid.")
+        if reduce == "macro" and (not num_classes or num_classes < 1):
+            raise ValueError("When you set `reduce` as 'macro', you have to provide the number of classes.")
+        if num_classes and ignore_index is not None and (not ignore_index < num_classes or num_classes == 1):
+            raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+        if mdmc_reduce != "samplewise" and reduce != "samples":
+            zeros_shape = [] if reduce == "micro" else [num_classes]
+            default, reduce_fn = lambda: jnp.zeros(zeros_shape, dtype=jnp.int32), "sum"
+        else:
+            default, reduce_fn = lambda: [], "cat"
+
+        for s in ("tp", "fp", "tn", "fn"):
+            self.add_state(s, default=default(), dist_reduce_fx=reduce_fn)
+
+    def _update_signature(self):
+        """Stat-scores family compute-group key: equal args => identical state."""
+        return (
+            "stat-scores", self.reduce, self.mdmc_reduce, self.num_classes,
+            self.threshold, self.multiclass, self.ignore_index, self.top_k,
+        )
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        tp, fp, tn, fn = _stat_scores_update(
+            preds, target, reduce=self.reduce, mdmc_reduce=self.mdmc_reduce,
+            threshold=self.threshold, num_classes=self.num_classes, top_k=self.top_k,
+            multiclass=self.multiclass, ignore_index=self.ignore_index,
+        )
+        if self.reduce != "samples" and self.mdmc_reduce != "samplewise":
+            self.tp = self.tp + tp
+            self.fp = self.fp + fp
+            self.tn = self.tn + tn
+            self.fn = self.fn + fn
+        else:
+            self.tp = self.tp + [tp]
+            self.fp = self.fp + [fp]
+            self.tn = self.tn + [tn]
+            self.fn = self.fn + [fn]
+
+    def _get_final_stats(self) -> Tuple[Array, Array, Array, Array]:
+        tp = jnp.concatenate(self.tp) if isinstance(self.tp, list) else self.tp
+        fp = jnp.concatenate(self.fp) if isinstance(self.fp, list) else self.fp
+        tn = jnp.concatenate(self.tn) if isinstance(self.tn, list) else self.tn
+        fn = jnp.concatenate(self.fn) if isinstance(self.fn, list) else self.fn
+        return tp, fp, tn, fn
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._get_final_stats()
+        return _stat_scores_compute(tp, fp, tn, fn)

@@ -1,0 +1,2 @@
+"""Alias of :mod:`metrics_tpu.ops` mirroring the reference's ``torchmetrics.functional``."""
+from metrics_tpu.ops import *  # noqa: F401,F403

@@ -1,0 +1,31 @@
+"""Functional metric kernels (reference parity: torchmetrics/functional/).
+
+Also importable as ``metrics_tpu.functional`` for API familiarity.
+"""
+from metrics_tpu.ops.classification import (  # noqa: F401
+    accuracy,
+    auc,
+    auroc,
+    average_precision,
+    calibration_error,
+    cohen_kappa,
+    confusion_matrix,
+    coverage_error,
+    dice,
+    f1_score,
+    fbeta_score,
+    hamming_distance,
+    hinge_loss,
+    jaccard_index,
+    kl_divergence,
+    label_ranking_average_precision,
+    label_ranking_loss,
+    matthews_corrcoef,
+    precision,
+    precision_recall,
+    precision_recall_curve,
+    recall,
+    roc,
+    specificity,
+    stat_scores,
+)

@@ -1,0 +1,25 @@
+"""Functional classification kernels (reference parity: torchmetrics/functional/classification/)."""
+from metrics_tpu.ops.classification.accuracy import accuracy  # noqa: F401
+from metrics_tpu.ops.classification.auc import auc  # noqa: F401
+from metrics_tpu.ops.classification.auroc import auroc  # noqa: F401
+from metrics_tpu.ops.classification.average_precision import average_precision  # noqa: F401
+from metrics_tpu.ops.classification.calibration_error import calibration_error  # noqa: F401
+from metrics_tpu.ops.classification.cohen_kappa import cohen_kappa  # noqa: F401
+from metrics_tpu.ops.classification.confusion_matrix import confusion_matrix  # noqa: F401
+from metrics_tpu.ops.classification.dice import dice  # noqa: F401
+from metrics_tpu.ops.classification.f_beta import f1_score, fbeta_score  # noqa: F401
+from metrics_tpu.ops.classification.hamming import hamming_distance  # noqa: F401
+from metrics_tpu.ops.classification.hinge import hinge_loss  # noqa: F401
+from metrics_tpu.ops.classification.jaccard import jaccard_index  # noqa: F401
+from metrics_tpu.ops.classification.kl_divergence import kl_divergence  # noqa: F401
+from metrics_tpu.ops.classification.matthews_corrcoef import matthews_corrcoef  # noqa: F401
+from metrics_tpu.ops.classification.precision_recall import precision, precision_recall, recall  # noqa: F401
+from metrics_tpu.ops.classification.precision_recall_curve import precision_recall_curve  # noqa: F401
+from metrics_tpu.ops.classification.ranking import (  # noqa: F401
+    coverage_error,
+    label_ranking_average_precision,
+    label_ranking_loss,
+)
+from metrics_tpu.ops.classification.roc import roc  # noqa: F401
+from metrics_tpu.ops.classification.specificity import specificity  # noqa: F401
+from metrics_tpu.ops.classification.stat_scores import stat_scores  # noqa: F401

@@ -1,0 +1,47 @@
+"""Shared ratio-score reduction used by precision/recall/f-beta/dice/specificity.
+
+The reference repeats a filtering idiom in every ``_X_compute`` (e.g.
+functional/classification/precision_recall.py:52-64): boolean-filter absent
+classes for ``average='macro'`` and index-assign ``-1`` for ``average='none'``.
+Both are dynamic-shape ops; here they collapse into one static ``where`` that
+feeds the ``-1`` sentinel channel of ``_reduce_stat_scores``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.classification.stat_scores import _reduce_stat_scores
+from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
+
+
+def mask_absent_and_reduce(
+    numerator: Array,
+    denominator: Array,
+    tp: Array,
+    fp: Array,
+    fn: Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    weights: Optional[Array] = None,
+    zero_division: int = 0,
+) -> Array:
+    """Apply the absent-class sentinel then reduce."""
+    if mdmc_average != MDMCAverageMethod.SAMPLEWISE and average in (
+        AverageMethod.MACRO,
+        AverageMethod.NONE,
+        None,
+    ):
+        absent = (tp + fp + fn) == 0
+        numerator = jnp.where(absent, -1, numerator)
+        denominator = jnp.where(absent, -1, denominator)
+    return _reduce_stat_scores(
+        numerator=numerator,
+        denominator=denominator,
+        weights=weights,
+        average=average,
+        mdmc_average=mdmc_average,
+        zero_division=zero_division,
+    )

@@ -1,0 +1,180 @@
+"""Accuracy (incl. subset accuracy and top-k).
+
+Reference parity: torchmetrics/functional/classification/accuracy.py —
+``_mode`` (:29), ``_accuracy_update`` (:71), ``_accuracy_compute`` (:123),
+``_subset_accuracy_update`` (:206), ``_subset_accuracy_compute`` (:247),
+public ``accuracy`` (:255).
+
+TPU-first: the reference's boolean filtering of absent classes for
+``average='macro'`` (accuracy.py:186-189) and index assignment for
+``average='none'`` (:191-195) are replaced by the ``-1`` sentinel channel of
+``_reduce_stat_scores`` — static shapes, jittable.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.classification.stat_scores import _reduce_stat_scores, _stat_scores_update
+from metrics_tpu.utils.checks import _check_classification_inputs, _input_format_classification, _input_squeeze
+from metrics_tpu.utils.enums import AverageMethod, DataType, MDMCAverageMethod
+
+
+def _check_subset_validity(mode: DataType) -> bool:
+    return mode in (DataType.MULTILABEL, DataType.MULTIDIM_MULTICLASS)
+
+
+def _mode(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    top_k: Optional[int],
+    num_classes: Optional[int],
+    multiclass: Optional[bool],
+    ignore_index: Optional[int] = None,
+) -> DataType:
+    """Classify the input case (static shape/dtype dispatch)."""
+    return _check_classification_inputs(
+        preds, target, threshold=threshold, top_k=top_k,
+        num_classes=num_classes, multiclass=multiclass, ignore_index=ignore_index,
+    )
+
+
+def _accuracy_update(
+    preds: Array,
+    target: Array,
+    reduce: Optional[str],
+    mdmc_reduce: Optional[str],
+    threshold: float,
+    num_classes: Optional[int],
+    top_k: Optional[int],
+    multiclass: Optional[bool],
+    ignore_index: Optional[int],
+    mode: DataType,
+) -> Tuple[Array, Array, Array, Array]:
+    if mode == DataType.MULTILABEL and top_k:
+        raise ValueError("You can not use the `top_k` parameter to calculate accuracy for multi-label inputs.")
+    preds, target = _input_squeeze(preds, target)
+    return _stat_scores_update(
+        preds, target, reduce=reduce, mdmc_reduce=mdmc_reduce, threshold=threshold,
+        num_classes=num_classes, top_k=top_k, multiclass=multiclass,
+        ignore_index=ignore_index, mode=mode,
+    )
+
+
+def _accuracy_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    mode: DataType,
+) -> Array:
+    simple_average = (AverageMethod.MICRO, AverageMethod.SAMPLES)
+    if (mode == DataType.BINARY and average in simple_average) or mode == DataType.MULTILABEL:
+        numerator = tp + tn
+        denominator = tp + tn + fp + fn
+    else:
+        numerator = tp
+        denominator = tp + fn
+
+    if mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        if average in (AverageMethod.MACRO, AverageMethod.NONE, None):
+            # absent classes (no tp/fp/fn) are excluded via the -1 sentinel
+            # (reference filters/index-assigns at accuracy.py:186-195)
+            absent = (tp + fp + fn) == 0
+            numerator = jnp.where(absent, -1, numerator)
+            denominator = jnp.where(absent, -1, denominator)
+
+    return _reduce_stat_scores(
+        numerator=numerator,
+        denominator=denominator,
+        weights=None if average != AverageMethod.WEIGHTED else tp + fn,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def _subset_accuracy_update(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    top_k: Optional[int],
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+) -> Tuple[Array, Array]:
+    """Exact-match (subset) accuracy counts. Reference: :206-244.
+
+    ``num_classes`` is a TPU-first extension: label inputs under jit tracing
+    cannot infer the one-hot width from data, so the module passes it through.
+    """
+    preds, target = _input_squeeze(preds, target)
+    preds, target, mode = _input_format_classification(
+        preds, target, threshold=threshold, top_k=top_k, ignore_index=ignore_index, num_classes=num_classes
+    )
+    if mode == DataType.MULTILABEL and top_k:
+        raise ValueError("You can not use the `top_k` parameter to calculate accuracy for multi-label inputs.")
+
+    if mode == DataType.MULTILABEL:
+        correct = jnp.sum(jnp.all(preds == target, axis=1))
+        total = jnp.asarray(target.shape[0])
+    elif mode == DataType.MULTICLASS:
+        correct = jnp.sum(preds * target)
+        total = jnp.sum(target)
+    elif mode == DataType.MULTIDIM_MULTICLASS:
+        sample_correct = jnp.sum(preds * target, axis=(1, 2))
+        correct = jnp.sum(sample_correct == target.shape[2])
+        total = jnp.asarray(target.shape[0])
+    else:
+        correct, total = jnp.asarray(0), jnp.asarray(0)
+    return correct, total
+
+
+def _subset_accuracy_compute(correct: Array, total: Array) -> Array:
+    return correct.astype(jnp.float32) / total
+
+
+def accuracy(
+    preds: Array,
+    target: Array,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = "global",
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    subset_accuracy: bool = False,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Accuracy over any classification input type. Reference: :255-389."""
+    allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+
+    if average in ("macro", "weighted", "none", None) and (not num_classes or num_classes < 1):
+        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+
+    allowed_mdmc_average = (None, "samplewise", "global")
+    if mdmc_average not in allowed_mdmc_average:
+        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
+
+    if num_classes and ignore_index is not None and (not ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+    if top_k is not None and (not isinstance(top_k, int) or top_k <= 0):
+        raise ValueError(f"The `top_k` should be an integer larger than 0, got {top_k}")
+
+    preds, target = _input_squeeze(preds, target)
+    mode = _mode(preds, target, threshold, top_k, num_classes, multiclass, ignore_index)
+    reduce = "macro" if average in ("weighted", "none", None) else average
+
+    if subset_accuracy and _check_subset_validity(mode):
+        correct, total = _subset_accuracy_update(preds, target, threshold, top_k, ignore_index)
+        return _subset_accuracy_compute(correct, total)
+    tp, fp, tn, fn = _accuracy_update(
+        preds, target, reduce, mdmc_average, threshold, num_classes, top_k, multiclass, ignore_index, mode
+    )
+    return _accuracy_compute(tp, fp, tn, fn, average, mdmc_average, mode)

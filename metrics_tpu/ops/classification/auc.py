@@ -1,0 +1,49 @@
+"""Area under a curve (trapezoidal rule).
+
+Reference parity: torchmetrics/functional/classification/auc.py —
+``_auc_update`` (:20), ``_auc_compute_without_check`` (:46),
+``_auc_compute`` (:67), ``auc`` (:102).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _auc_update(x: Array, y: Array) -> Tuple[Array, Array]:
+    if x.ndim > 1:
+        x = jnp.squeeze(x)
+    if y.ndim > 1:
+        y = jnp.squeeze(y)
+    if x.ndim > 1 or y.ndim > 1:
+        raise ValueError(f"Expected both `x` and `y` tensor to be 1d, but got tensors with dimension {x.ndim} and {y.ndim}")
+    if x.size != y.size:
+        raise ValueError(f"Expected the same number of elements in `x` and `y` tensor but received {x.size} and {y.size}")
+    return x, y
+
+
+def _auc_compute_without_check(x: Array, y: Array, direction: float) -> Array:
+    return jnp.trapezoid(y, x) * direction
+
+
+def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
+    if reorder:
+        x_idx = jnp.argsort(x, stable=True)
+        x, y = x[x_idx], y[x_idx]
+    dx = x[1:] - x[:-1]
+    if bool(jnp.any(dx < 0)):
+        if bool(jnp.all(dx <= 0)):
+            direction = -1.0
+        else:
+            raise ValueError("The `x` tensor is neither increasing or decreasing. Try setting the reorder argument to `True`.")
+    else:
+        direction = 1.0
+    return _auc_compute_without_check(x, y, direction)
+
+
+def auc(x: Array, y: Array, reorder: bool = False) -> Array:
+    """AUC by trapezoid. Reference: auc.py:102-130."""
+    x, y = _auc_update(x, y)
+    return _auc_compute(x, y, reorder=reorder)

@@ -1,0 +1,100 @@
+"""Average precision (area under the PR curve via step interpolation).
+
+Reference parity: torchmetrics/functional/classification/average_precision.py —
+``_average_precision_update`` (:27), ``_average_precision_compute`` (:58),
+``_average_precision_compute_with_precision_recall`` (:113),
+``average_precision`` (:162).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.classification.precision_recall_curve import (
+    _precision_recall_curve_compute,
+    _precision_recall_curve_update,
+)
+from metrics_tpu.utils.data import bincount
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _average_precision_update(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+) -> Tuple[Array, Array, int, Optional[int]]:
+    preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
+    if average == "micro":
+        if preds.ndim == target.ndim:
+            preds = preds.reshape(-1)
+            target = target.reshape(-1)
+            num_classes = 1
+        else:
+            raise ValueError("Cannot use `micro` average with multi-class input")
+    return preds, target, num_classes, pos_label
+
+
+def _average_precision_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+    sample_weights: Optional[Sequence] = None,
+) -> Union[List[Array], Array]:
+    precision, recall, _ = _precision_recall_curve_compute(preds, target, num_classes, pos_label)
+    if average == "weighted":
+        if preds.ndim == target.ndim and target.ndim > 1:
+            weights = jnp.sum(target, axis=0).astype(jnp.float32)
+        else:
+            weights = bincount(target, minlength=num_classes).astype(jnp.float32)
+        weights = weights / jnp.sum(weights)
+    else:
+        weights = None
+    return _average_precision_compute_with_precision_recall(precision, recall, num_classes, average, weights)
+
+
+def _average_precision_compute_with_precision_recall(
+    precision: Union[Array, List[Array]],
+    recall: Union[Array, List[Array]],
+    num_classes: int,
+    average: Optional[str] = "macro",
+    weights: Optional[Array] = None,
+) -> Union[List[Array], Array]:
+    """AP = -sum(dRecall * precision). Reference: :113-159."""
+    if num_classes == 1:
+        return -jnp.sum((recall[1:] - recall[:-1]) * precision[:-1])
+
+    res = [-jnp.sum((r[1:] - r[:-1]) * p[:-1]) for p, r in zip(precision, recall)]
+
+    if average == "macro":
+        res_t = jnp.stack(res)
+        if bool(jnp.any(jnp.isnan(res_t))):
+            rank_zero_warn(
+                "Average precision score for one or more classes was `nan`. Ignoring these classes in macro-average",
+                UserWarning,
+            )
+        return jnp.mean(res_t[~jnp.isnan(res_t)])
+    if average == "weighted":
+        res_t = jnp.stack(res) * weights
+        return jnp.sum(res_t[~jnp.isnan(res_t)])
+    if average in (None, "none", "micro"):
+        return res if num_classes != 1 else res[0]
+    raise ValueError(f"Expected argument `average` to be one of ['macro', 'weighted', 'micro', None] but got {average}")
+
+
+def average_precision(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+    sample_weights: Optional[Sequence] = None,
+) -> Union[List[Array], Array]:
+    """Average precision score. Reference: average_precision.py:162-217."""
+    preds, target, num_classes, pos_label = _average_precision_update(preds, target, num_classes, pos_label, average)
+    return _average_precision_compute(preds, target, num_classes, pos_label, average, sample_weights)

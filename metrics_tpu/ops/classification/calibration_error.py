@@ -1,0 +1,99 @@
+"""Top-label calibration error (ECE / MCE / RMSCE).
+
+Reference parity: torchmetrics/functional/classification/calibration_error.py —
+``_binning_bucketize`` (:51), ``_ce_compute`` (:83), ``_ce_update`` (:129),
+``calibration_error`` (:168). Binning uses weighted ``bincount`` (segment sums)
+— one fused scatter-add on TPU, matching the reference's bucketize path.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _input_format_classification, _is_concrete
+from metrics_tpu.utils.enums import DataType
+
+
+def _binning_bucketize(confidences: Array, accuracies: Array, bin_boundaries: Array) -> Tuple[Array, Array, Array]:
+    n_bins = bin_boundaries.shape[0] - 1
+    indices = jnp.clip(jnp.searchsorted(bin_boundaries, confidences, side="left") - 1, 0, n_bins - 1)
+    count_bin = jnp.bincount(indices, length=n_bins).astype(confidences.dtype)
+    conf_bin = jnp.bincount(indices, weights=confidences, length=n_bins)
+    acc_bin = jnp.bincount(indices, weights=accuracies, length=n_bins)
+    safe = jnp.where(count_bin == 0, 1.0, count_bin)
+    conf_bin = jnp.where(count_bin == 0, 0.0, conf_bin / safe)
+    acc_bin = jnp.where(count_bin == 0, 0.0, acc_bin / safe)
+    prop_bin = count_bin / jnp.sum(count_bin)
+    return acc_bin, conf_bin, prop_bin
+
+
+def _ce_compute(
+    confidences: Array,
+    accuracies: Array,
+    bin_boundaries: Array,
+    norm: str = "l1",
+    debias: bool = False,
+) -> Array:
+    if norm not in {"l1", "l2", "max"}:
+        raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
+
+    acc_bin, conf_bin, prop_bin = _binning_bucketize(confidences, accuracies, bin_boundaries)
+
+    if norm == "l1":
+        return jnp.sum(jnp.abs(acc_bin - conf_bin) * prop_bin)
+    if norm == "max":
+        return jnp.max(jnp.abs(acc_bin - conf_bin))
+    # l2
+    ce = jnp.sum((acc_bin - conf_bin) ** 2 * prop_bin)
+    if debias:
+        debias_bins = (acc_bin * (acc_bin - 1) * prop_bin) / (prop_bin * accuracies.shape[0] - 1)
+        ce = ce + jnp.sum(jnp.nan_to_num(debias_bins))
+    return jnp.where(ce > 0, jnp.sqrt(jnp.where(ce > 0, ce, 1.0)), 0.0)
+
+
+def _normalize_if_logits(preds: Array, normalizer) -> Array:
+    """Apply ``normalizer`` when any value falls outside [0, 1].
+
+    Traced-value-safe: the decision is a data-dependent ``where`` select, so
+    eager and jitted calls agree (reference uses a python-level check,
+    calibration_error.py:146-151, which cannot run while tracing).
+    """
+    out_of_range = jnp.any((preds < 0) | (preds > 1))
+    return jnp.where(out_of_range, normalizer(preds), preds)
+
+
+def _ce_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Top-1 confidences + correctness. Reference: :129-166."""
+    import jax
+
+    _, _, mode = _input_format_classification(preds, target)
+
+    if mode == DataType.BINARY:
+        preds = _normalize_if_logits(preds, jax.nn.sigmoid)
+        confidences, accuracies = preds, target
+    elif mode == DataType.MULTICLASS:
+        preds = _normalize_if_logits(preds, lambda p: jax.nn.softmax(p, axis=1))
+        confidences = jnp.max(preds, axis=1)
+        predictions = jnp.argmax(preds, axis=1)
+        accuracies = predictions == target
+    elif mode == DataType.MULTIDIM_MULTICLASS:
+        flat = jnp.swapaxes(preds, 1, -1).reshape(-1, preds.shape[1])
+        confidences = jnp.max(flat, axis=1)
+        predictions = jnp.argmax(flat, axis=1)
+        accuracies = predictions == target.reshape(-1)
+    else:
+        raise ValueError(f"Calibration error is not well-defined for data with size {preds.shape} and targets {target.shape}.")
+    return confidences.astype(jnp.float32), accuracies.astype(jnp.float32)
+
+
+def calibration_error(preds: Array, target: Array, n_bins: int = 15, norm: str = "l1") -> Array:
+    """Top-label calibration error. Reference: :168-213."""
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
+    if not isinstance(n_bins, int) or n_bins <= 0:
+        raise ValueError(f"Expected argument `n_bins` to be a int larger than 0 but got {n_bins}")
+    confidences, accuracies = _ce_update(preds, target)
+    bin_boundaries = jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32)
+    return _ce_compute(confidences, accuracies, bin_boundaries, norm=norm)

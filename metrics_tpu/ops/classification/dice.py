@@ -1,0 +1,52 @@
+"""Dice score.
+
+Reference parity: torchmetrics/functional/classification/dice.py —
+``_dice_compute`` (:107), ``dice`` (:150).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.classification._ratio import mask_absent_and_reduce
+from metrics_tpu.ops.classification.precision_recall import _check_avg_args
+from metrics_tpu.ops.classification.stat_scores import _stat_scores_update
+
+
+def _dice_compute(
+    tp: Array,
+    fp: Array,
+    fn: Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    zero_division: int = 0,
+) -> Array:
+    return mask_absent_and_reduce(
+        2 * tp, 2 * tp + fp + fn, tp, fp, fn, average, mdmc_average,
+        weights=None if average != "weighted" else tp + fn,
+        zero_division=zero_division,
+    )
+
+
+def dice(
+    preds: Array,
+    target: Array,
+    zero_division: int = 0,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = "global",
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Dice = 2*TP / (2*TP + FP + FN). Reference: dice.py:150-257."""
+    _check_avg_args(average, mdmc_average, num_classes, ignore_index)
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds, target, reduce=reduce, mdmc_reduce=mdmc_average, threshold=threshold,
+        num_classes=num_classes, top_k=top_k, multiclass=multiclass, ignore_index=ignore_index,
+    )
+    return _dice_compute(tp, fp, fn, average, mdmc_average, zero_division)

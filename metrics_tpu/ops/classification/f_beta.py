@@ -1,0 +1,105 @@
+"""F-beta and F1 scores.
+
+Reference parity: torchmetrics/functional/classification/f_beta.py —
+``_fbeta_compute`` (:30), ``fbeta_score`` (:112), ``f1_score`` (:220).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.classification.precision_recall import _check_avg_args
+from metrics_tpu.ops.classification.stat_scores import _reduce_stat_scores, _stat_scores_update
+from metrics_tpu.utils.compute import safe_divide
+from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
+
+
+def _fbeta_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    beta: float,
+    ignore_index: Optional[int],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> Array:
+    """Reference: f_beta.py:30-106; dynamic filters replaced by -1 sentinels."""
+    if average == AverageMethod.MICRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        mask = tp >= 0
+        msum = lambda x: jnp.sum(jnp.where(mask, x, 0)).astype(jnp.float32)
+        precision = safe_divide(msum(tp), msum(tp) + msum(fp))
+        recall = safe_divide(msum(tp), msum(tp) + msum(fn))
+    else:
+        precision = safe_divide(tp.astype(jnp.float32), (tp + fp).astype(jnp.float32))
+        recall = safe_divide(tp.astype(jnp.float32), (tp + fn).astype(jnp.float32))
+
+    num = (1 + beta**2) * precision * recall
+    denom = beta**2 * precision + recall
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+
+    if average not in (AverageMethod.MICRO, AverageMethod.SAMPLES):
+        # absent classes (and the ignored class, already -1-marked in tp/fp/fn
+        # by _stat_scores_update for macro reduce) get the -1 sentinel
+        if average == AverageMethod.NONE and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+            absent = ((tp + fn + fp) == 0) | ((tp + fp + fn) == -3)
+            num = jnp.where(absent, -1.0, num)
+            denom = jnp.where(absent, -1.0, denom)
+        if mdmc_average == MDMCAverageMethod.SAMPLEWISE and ignore_index is not None:
+            num = num.at[..., ignore_index].set(-1.0)
+            denom = denom.at[..., ignore_index].set(-1.0)
+        elif ignore_index is not None:
+            num = num.at[ignore_index, ...].set(-1.0)
+            denom = denom.at[ignore_index, ...].set(-1.0)
+
+    if average == AverageMethod.MACRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        cond = ((tp + fp + fn) == 0) | ((tp + fp + fn) == -3)
+        num = jnp.where(cond, -1.0, num)
+        denom = jnp.where(cond, -1.0, denom)
+
+    return _reduce_stat_scores(
+        numerator=num,
+        denominator=denom,
+        weights=None if average != AverageMethod.WEIGHTED else tp + fn,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def fbeta_score(
+    preds: Array,
+    target: Array,
+    beta: float = 1.0,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """F-beta over any classification input. Reference: f_beta.py:112-217."""
+    _check_avg_args(average, mdmc_average, num_classes, ignore_index)
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds, target, reduce=reduce, mdmc_reduce=mdmc_average, threshold=threshold,
+        num_classes=num_classes, top_k=top_k, multiclass=multiclass, ignore_index=ignore_index,
+    )
+    return _fbeta_compute(tp, fp, tn, fn, beta, ignore_index, average, mdmc_average)
+
+
+def f1_score(
+    preds: Array,
+    target: Array,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """F1 = F-beta with beta=1. Reference: f_beta.py:220-313."""
+    return fbeta_score(preds, target, 1.0, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)

@@ -1,0 +1,31 @@
+"""Hamming distance.
+
+Reference parity: torchmetrics/functional/classification/hamming.py —
+``_hamming_distance_update`` (:22), ``_hamming_distance_compute`` (:44),
+``hamming_distance`` (:62).
+"""
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _input_format_classification
+
+
+def _hamming_distance_update(preds: Array, target: Array, threshold: float = 0.5) -> Tuple[Array, int]:
+    preds, target, _ = _input_format_classification(preds, target, threshold=threshold)
+    correct = jnp.sum(preds == target)
+    total = preds.size
+    return correct, total
+
+
+def _hamming_distance_compute(correct: Array, total: Union[int, Array]) -> Array:
+    return 1 - correct.astype(jnp.float32) / total
+
+
+def hamming_distance(preds: Array, target: Array, threshold: float = 0.5) -> Array:
+    """Fraction of mismatched labels. Reference: hamming.py:62-103."""
+    correct, total = _hamming_distance_update(preds, target, threshold)
+    return _hamming_distance_compute(correct, total)

@@ -1,0 +1,45 @@
+"""KL divergence.
+
+Reference parity: torchmetrics/functional/classification/kl_divergence.py —
+``_kld_update`` (:25), ``_kld_compute`` (:51), ``kl_divergence`` (:81).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.compute import safe_xlogy
+
+
+def _kld_update(p: Array, q: Array, log_prob: bool) -> Tuple[Array, int]:
+    _check_same_shape(p, q)
+    if p.ndim != 2 or q.ndim != 2:
+        raise ValueError(f"Expected both p and q distribution to be 2D but got {p.ndim} and {q.ndim} respectively")
+
+    total = p.shape[0]
+    if log_prob:
+        measures = jnp.sum(jnp.exp(p) * (p - q), axis=-1)
+    else:
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        q = q / jnp.sum(q, axis=-1, keepdims=True)
+        measures = jnp.sum(safe_xlogy(p, p / q), axis=-1)
+    return measures, total
+
+
+def _kld_compute(measures: Array, total, reduction: Optional[str] = "mean") -> Array:
+    if reduction == "sum":
+        return jnp.sum(measures)
+    if reduction == "mean":
+        return jnp.sum(measures) / total
+    if reduction is None or reduction == "none":
+        return measures
+    return measures / total
+
+
+def kl_divergence(p: Array, q: Array, log_prob: bool = False, reduction: Optional[str] = "mean") -> Array:
+    """D_KL(P||Q). Reference: kl_divergence.py:81-123."""
+    measures, total = _kld_update(p, q, log_prob)
+    return _kld_compute(measures, total, reduction)

@@ -1,0 +1,34 @@
+"""Matthews correlation coefficient.
+
+Reference parity: torchmetrics/functional/classification/matthews_corrcoef.py —
+``_matthews_corrcoef_update`` (= confmat update), ``_matthews_corrcoef_compute``
+(:22), ``matthews_corrcoef`` (:52).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.classification.confusion_matrix import _confusion_matrix_update
+
+_matthews_corrcoef_update = _confusion_matrix_update
+
+
+def _matthews_corrcoef_compute(confmat: Array) -> Array:
+    tk = jnp.sum(confmat, axis=1).astype(jnp.float32)
+    pk = jnp.sum(confmat, axis=0).astype(jnp.float32)
+    c = jnp.trace(confmat).astype(jnp.float32)
+    s = jnp.sum(confmat).astype(jnp.float32)
+
+    cov_ytyp = c * s - jnp.sum(tk * pk)
+    cov_ypyp = s**2 - jnp.sum(pk * pk)
+    cov_ytyt = s**2 - jnp.sum(tk * tk)
+
+    denom = cov_ypyp * cov_ytyt
+    return jnp.where(denom == 0, 0.0, cov_ytyp / jnp.sqrt(jnp.where(denom == 0, 1.0, denom)))
+
+
+def matthews_corrcoef(preds: Array, target: Array, num_classes: int, threshold: float = 0.5) -> Array:
+    """General classification correlation. Reference: matthews_corrcoef.py:52-92."""
+    confmat = _matthews_corrcoef_update(preds, target, num_classes, threshold)
+    return _matthews_corrcoef_compute(confmat)

@@ -1,0 +1,101 @@
+"""Precision and recall.
+
+Reference parity: torchmetrics/functional/classification/precision_recall.py —
+``_precision_compute`` (:23), ``precision`` (:75), ``_recall_compute`` (:187),
+``recall`` (:239), ``precision_recall`` (:351).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from jax import Array
+
+from metrics_tpu.ops.classification._ratio import mask_absent_and_reduce
+from metrics_tpu.ops.classification.stat_scores import _stat_scores_update
+
+
+def _check_avg_args(average, mdmc_average, num_classes, ignore_index):
+    allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    allowed_mdmc_average = (None, "samplewise", "global")
+    if mdmc_average not in allowed_mdmc_average:
+        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
+    if average in ("macro", "weighted", "none", None) and (not num_classes or num_classes < 1):
+        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+    if num_classes and ignore_index is not None and (not ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+
+def _precision_compute(tp: Array, fp: Array, fn: Array, average: Optional[str], mdmc_average: Optional[str]) -> Array:
+    return mask_absent_and_reduce(
+        tp, tp + fp, tp, fp, fn, average, mdmc_average,
+        weights=None if average != "weighted" else tp + fn,
+    )
+
+
+def _recall_compute(tp: Array, fp: Array, fn: Array, average: Optional[str], mdmc_average: Optional[str]) -> Array:
+    return mask_absent_and_reduce(
+        tp, tp + fn, tp, fp, fn, average, mdmc_average,
+        weights=None if average != "weighted" else tp + fn,
+    )
+
+
+def _pr_update(preds, target, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass):
+    _check_avg_args(average, mdmc_average, num_classes, ignore_index)
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    return _stat_scores_update(
+        preds, target, reduce=reduce, mdmc_reduce=mdmc_average, threshold=threshold,
+        num_classes=num_classes, top_k=top_k, multiclass=multiclass, ignore_index=ignore_index,
+    )
+
+
+def precision(
+    preds: Array,
+    target: Array,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """Precision = TP / (TP + FP). Reference: precision_recall.py:75-184."""
+    tp, fp, tn, fn = _pr_update(preds, target, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)
+    return _precision_compute(tp, fp, fn, average, mdmc_average)
+
+
+def recall(
+    preds: Array,
+    target: Array,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """Recall = TP / (TP + FN). Reference: precision_recall.py:239-348."""
+    tp, fp, tn, fn = _pr_update(preds, target, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)
+    return _recall_compute(tp, fp, fn, average, mdmc_average)
+
+
+def precision_recall(
+    preds: Array,
+    target: Array,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Tuple[Array, Array]:
+    """Both from one stat-scores pass. Reference: precision_recall.py:351-467."""
+    tp, fp, tn, fn = _pr_update(preds, target, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)
+    return (
+        _precision_compute(tp, fp, fn, average, mdmc_average),
+        _recall_compute(tp, fp, fn, average, mdmc_average),
+    )

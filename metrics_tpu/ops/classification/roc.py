@@ -1,0 +1,106 @@
+"""Receiver operating characteristic.
+
+Reference parity: torchmetrics/functional/classification/roc.py —
+``_roc_update`` (:26), ``_roc_compute_single_class`` (:48),
+``_roc_compute_multi_class`` (:97), ``_roc_compute`` (:131), ``roc`` (:161).
+Eager-only exact curves; see precision_recall_curve module docstring.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.classification.precision_recall_curve import (
+    _binary_clf_curve,
+    _precision_recall_curve_update,
+)
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _roc_update(
+    preds: Array, target: Array, num_classes: Optional[int] = None, pos_label: Optional[int] = None
+) -> Tuple[Array, Array, int, Optional[int]]:
+    return _precision_recall_curve_update(preds, target, num_classes, pos_label)
+
+
+def _roc_compute_single_class(
+    preds: Array,
+    target: Array,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[Array, Array, Array]:
+    fps, tps, thresholds = _binary_clf_curve(preds, target, sample_weights, pos_label)
+    # curve starts at (0, 0)
+    tps = jnp.concatenate([jnp.zeros(1, dtype=tps.dtype), tps])
+    fps = jnp.concatenate([jnp.zeros(1, dtype=fps.dtype), fps])
+    thresholds = jnp.concatenate([thresholds[0][None] + 1, thresholds])
+
+    if fps[-1] <= 0:
+        rank_zero_warn(
+            "No negative samples in targets, false positive value should be meaningless."
+            " Returning zero tensor in false positive score",
+            UserWarning,
+        )
+        fpr = jnp.zeros_like(thresholds)
+    else:
+        fpr = fps / fps[-1]
+
+    if tps[-1] <= 0:
+        rank_zero_warn(
+            "No positive samples in targets, true positive value should be meaningless."
+            " Returning zero tensor in true positive score",
+            UserWarning,
+        )
+        tpr = jnp.zeros_like(thresholds)
+    else:
+        tpr = tps / tps[-1]
+    return fpr, tpr, thresholds
+
+
+def _roc_compute_multi_class(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[List[Array], List[Array], List[Array]]:
+    fpr, tpr, thresholds = [], [], []
+    for cls in range(num_classes):
+        if preds.shape == target.shape:
+            target_cls = target[:, cls]
+            pos_label = 1
+        else:
+            target_cls = target
+            pos_label = cls
+        res = roc(preds=preds[:, cls], target=target_cls, num_classes=1, pos_label=pos_label, sample_weights=sample_weights)
+        fpr.append(res[0])
+        tpr.append(res[1])
+        thresholds.append(res[2])
+    return fpr, tpr, thresholds
+
+
+def _roc_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    if num_classes == 1 and preds.ndim == 1:
+        if pos_label is None:
+            pos_label = 1
+        return _roc_compute_single_class(preds, target, pos_label, sample_weights)
+    return _roc_compute_multi_class(preds, target, num_classes, sample_weights)
+
+
+def roc(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """fpr/tpr/threshold curves. Reference: roc.py:161-244."""
+    preds, target, num_classes, pos_label = _roc_update(preds, target, num_classes, pos_label)
+    return _roc_compute(preds, target, num_classes, pos_label, sample_weights)

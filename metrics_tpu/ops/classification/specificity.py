@@ -1,0 +1,54 @@
+"""Specificity.
+
+Reference parity: torchmetrics/functional/classification/specificity.py —
+``_specificity_compute`` (:23), ``specificity`` (:71).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.classification.precision_recall import _check_avg_args
+from metrics_tpu.ops.classification.stat_scores import _reduce_stat_scores, _stat_scores_update
+from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
+
+
+def _specificity_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, average: Optional[str], mdmc_average: Optional[str]
+) -> Array:
+    numerator = tn
+    denominator = tn + fp
+    if average == AverageMethod.NONE and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        absent = (tp + fn + fp) == 0
+        numerator = jnp.where(absent, -1, numerator)
+        denominator = jnp.where(absent, -1, denominator)
+    return _reduce_stat_scores(
+        numerator=numerator,
+        denominator=denominator,
+        weights=None if average != AverageMethod.WEIGHTED else denominator,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def specificity(
+    preds: Array,
+    target: Array,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """Specificity = TN / (TN + FP). Reference: specificity.py:71-181."""
+    _check_avg_args(average, mdmc_average, num_classes, ignore_index)
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds, target, reduce=reduce, mdmc_reduce=mdmc_average, threshold=threshold,
+        num_classes=num_classes, top_k=top_k, multiclass=multiclass, ignore_index=ignore_index,
+    )
+    return _specificity_compute(tp, fp, tn, fn, average, mdmc_average)

@@ -1,0 +1,50 @@
+"""Seeded input fixtures (reference parity: tests/classification/inputs.py)."""
+from collections import namedtuple
+
+import numpy as np
+
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_rng = np.random.default_rng(42)
+
+_input_binary_prob = Input(
+    preds=_rng.random((NUM_BATCHES, BATCH_SIZE)).astype(np.float32),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+)
+_input_binary = Input(
+    preds=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+)
+_input_multilabel_prob = Input(
+    preds=_rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+_input_multilabel = Input(
+    preds=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+
+
+def _softmax(x, axis):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+_input_multiclass_prob = Input(
+    preds=_softmax(_rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32), axis=-1),
+    target=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+_input_multiclass = Input(
+    preds=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+    target=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+_input_multidim_multiclass_prob = Input(
+    preds=_softmax(_rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)).astype(np.float32), axis=2),
+    target=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+)
+_input_multidim_multiclass = Input(
+    preds=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+    target=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
+)

@@ -1,0 +1,103 @@
+"""Accuracy parity vs sklearn (reference parity: tests/classification/test_accuracy.py)."""
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score as sk_accuracy
+
+from metrics_tpu.classification import Accuracy
+from metrics_tpu.ops.classification import accuracy
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multidim_multiclass,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_accuracy(preds, target, subset_accuracy=False, **kw):
+    """sklearn oracle re-using our canonicalization (reference test_accuracy.py:47-59)."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.utils.checks import _input_format_classification
+
+    sk_preds, sk_target, mode = _input_format_classification(jnp.asarray(preds), jnp.asarray(target), threshold=THRESHOLD)
+    sk_preds, sk_target = np.asarray(sk_preds), np.asarray(sk_target)
+
+    if mode == "multi-dim multi-class" and not subset_accuracy:
+        sk_preds, sk_target = np.moveaxis(sk_preds, 1, -1).reshape(-1, sk_preds.shape[1]), np.moveaxis(
+            sk_target, 1, -1
+        ).reshape(-1, sk_target.shape[1])
+    elif mode == "multi-label" and not subset_accuracy:
+        sk_preds, sk_target = sk_preds.reshape(-1), sk_target.reshape(-1)
+    elif mode == "multi-dim multi-class" and subset_accuracy:
+        return np.mean([np.array_equal(p, t) for p, t in zip(sk_preds, sk_target)])
+    return sk_accuracy(y_true=sk_target, y_pred=sk_preds)
+
+
+@pytest.mark.parametrize(
+    "preds, target, subset_accuracy, num_classes",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, False, None),
+        (_input_binary.preds, _input_binary.target, False, 2),
+        (_input_multilabel_prob.preds, _input_multilabel_prob.target, False, None),
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target, False, None),
+        (_input_multiclass.preds, _input_multiclass.target, False, NUM_CLASSES),
+        (_input_multidim_multiclass.preds, _input_multidim_multiclass.target, False, NUM_CLASSES),
+        (_input_multidim_multiclass.preds, _input_multidim_multiclass.target, True, NUM_CLASSES),
+    ],
+)
+@pytest.mark.parametrize("ddp", [False, True])
+class TestAccuracy(MetricTester):
+    def test_accuracy_class(self, ddp, preds, target, subset_accuracy, num_classes):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Accuracy,
+            sk_metric=lambda p, t: _sk_accuracy(p, t, subset_accuracy),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy, "num_classes": num_classes},
+        )
+
+    def test_accuracy_fn(self, ddp, preds, target, subset_accuracy, num_classes):
+        if ddp:
+            pytest.skip("functional has no ddp")
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=lambda p, t: accuracy(
+                p, t, threshold=THRESHOLD, subset_accuracy=subset_accuracy, num_classes=num_classes
+            ),
+            sk_metric=lambda p, t: _sk_accuracy(p, t, subset_accuracy),
+        )
+
+
+def test_accuracy_topk():
+    """top-k accuracy vs hand-computed (reference test_accuracy.py top-k cases)."""
+    import jax.numpy as jnp
+
+    preds = jnp.asarray(
+        [[0.35, 0.4, 0.25], [0.1, 0.5, 0.4], [0.2, 0.1, 0.7], [0.35, 0.4, 0.25], [0.1, 0.5, 0.4], [0.2, 0.1, 0.7]]
+    )
+    target = jnp.asarray([0, 0, 0, 1, 1, 1])
+    assert float(accuracy(preds, target, top_k=2, num_classes=3)) == pytest.approx(4 / 6)
+
+
+def test_accuracy_average_none_vs_sklearn():
+    from sklearn.metrics import recall_score
+
+    preds = _input_multiclass.preds[0]
+    target = _input_multiclass.target[0]
+    import jax.numpy as jnp
+
+    res = accuracy(jnp.asarray(preds), jnp.asarray(target), average="macro", num_classes=NUM_CLASSES)
+    sk = recall_score(target, preds, average="macro")  # class-accuracy == per-class recall
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-6)
+
+
+def test_wrong_params():
+    with pytest.raises(ValueError):
+        Accuracy(average="bogus")
+    with pytest.raises(ValueError):
+        Accuracy(top_k=0)

@@ -1,0 +1,142 @@
+"""ConfusionMatrix / Jaccard / CohenKappa / Matthews / Hamming / StatScores parity.
+
+Reference parity: tests/classification/test_confusion_matrix.py, test_jaccard.py,
+test_cohen_kappa.py, test_matthews_corrcoef.py, test_hamming_distance.py,
+test_stat_scores.py (compacted).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import cohen_kappa_score as sk_kappa
+from sklearn.metrics import confusion_matrix as sk_confmat
+from sklearn.metrics import jaccard_score as sk_jaccard
+from sklearn.metrics import matthews_corrcoef as sk_mcc
+from sklearn.metrics import multilabel_confusion_matrix as sk_ml_confmat
+
+from metrics_tpu.classification import (
+    CohenKappa,
+    ConfusionMatrix,
+    HammingDistance,
+    JaccardIndex,
+    MatthewsCorrCoef,
+    StatScores,
+)
+from metrics_tpu.ops.classification import (
+    cohen_kappa,
+    confusion_matrix,
+    hamming_distance,
+    jaccard_index,
+    matthews_corrcoef,
+    stat_scores,
+)
+from tests.classification.inputs import _input_multiclass, _input_multiclass_prob, _input_multilabel_prob
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_cm(preds, target, normalize=None):
+    if preds.ndim == target.ndim + 1:
+        preds = np.argmax(preds, axis=-1)
+    return sk_confmat(target.reshape(-1), preds.reshape(-1), labels=range(NUM_CLASSES), normalize=normalize)
+
+
+@pytest.mark.parametrize("normalize", [None, "true", "pred", "all"])
+@pytest.mark.parametrize("ddp", [False, True])
+def test_confusion_matrix(ddp, normalize):
+    MetricTester().run_class_metric_test(
+        ddp=ddp,
+        preds=_input_multiclass.preds,
+        target=_input_multiclass.target,
+        metric_class=ConfusionMatrix,
+        sk_metric=lambda p, t: _sk_cm(p, t, normalize),
+        metric_args={"num_classes": NUM_CLASSES, "normalize": normalize},
+    )
+
+
+def test_confusion_matrix_multilabel():
+    preds = _input_multilabel_prob.preds[0]
+    target = _input_multilabel_prob.target[0]
+    res = confusion_matrix(jnp.asarray(preds), jnp.asarray(target), num_classes=NUM_CLASSES, threshold=THRESHOLD, multilabel=True)
+    sk = sk_ml_confmat(target, (preds >= THRESHOLD).astype(int))
+    np.testing.assert_allclose(np.asarray(res), sk)
+
+
+@pytest.mark.parametrize("average", ["macro", "micro", "weighted", None])
+def test_jaccard(average):
+    preds, target = _input_multiclass.preds[0], _input_multiclass.target[0]
+    res = jaccard_index(jnp.asarray(preds), jnp.asarray(target), num_classes=NUM_CLASSES, average=average)
+    sk = sk_jaccard(target, preds, average=average if average else None, labels=range(NUM_CLASSES))
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-6)
+
+
+@pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+def test_cohen_kappa(weights):
+    preds, target = _input_multiclass.preds[0], _input_multiclass.target[0]
+    res = cohen_kappa(jnp.asarray(preds), jnp.asarray(target), num_classes=NUM_CLASSES, weights=weights)
+    sk = sk_kappa(target, preds, weights=weights)
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-6)
+
+
+@pytest.mark.parametrize("ddp", [False, True])
+def test_matthews(ddp):
+    MetricTester().run_class_metric_test(
+        ddp=ddp,
+        preds=_input_multiclass.preds,
+        target=_input_multiclass.target,
+        metric_class=MatthewsCorrCoef,
+        sk_metric=lambda p, t: sk_mcc(t.reshape(-1), p.reshape(-1)),
+        metric_args={"num_classes": NUM_CLASSES},
+    )
+
+
+def test_hamming():
+    preds, target = _input_multilabel_prob.preds[0], _input_multilabel_prob.target[0]
+    res = hamming_distance(jnp.asarray(preds), jnp.asarray(target), threshold=THRESHOLD)
+    expected = 1 - np.mean((preds >= THRESHOLD).astype(int) == target)
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+
+def test_stat_scores_macro_vs_sklearn():
+    preds, target = _input_multiclass.preds[0], _input_multiclass.target[0]
+    res = np.asarray(stat_scores(jnp.asarray(preds), jnp.asarray(target), reduce="macro", num_classes=NUM_CLASSES))
+    mlc = sk_ml_confmat(target, preds, labels=range(NUM_CLASSES))  # (C, 2, 2): [[tn, fp], [fn, tp]]
+    expected = np.stack([mlc[:, 1, 1], mlc[:, 0, 1], mlc[:, 0, 0], mlc[:, 1, 0], mlc[:, 1, 1] + mlc[:, 1, 0]], axis=1)
+    np.testing.assert_array_equal(res, expected)
+
+
+@pytest.mark.parametrize("ddp", [False, True])
+def test_stat_scores_class(ddp):
+    def _sk(p, t):
+        mlc = sk_ml_confmat(t, p, labels=range(NUM_CLASSES))
+        return np.stack([mlc[:, 1, 1], mlc[:, 0, 1], mlc[:, 0, 0], mlc[:, 1, 0], mlc[:, 1, 1] + mlc[:, 1, 0]], axis=1)
+
+    MetricTester().run_class_metric_test(
+        ddp=ddp,
+        preds=_input_multiclass.preds,
+        target=_input_multiclass.target,
+        metric_class=StatScores,
+        sk_metric=_sk,
+        metric_args={"reduce": "macro", "num_classes": NUM_CLASSES},
+    )
+
+
+def test_stat_scores_ignore_index():
+    preds = jnp.asarray([1, 0, 2, 1])
+    target = jnp.asarray([1, 1, 2, 0])
+    res = np.asarray(stat_scores(preds, target, reduce="macro", num_classes=3, ignore_index=0))
+    assert (res[0] == -1).all()  # ignored class marked
+    # micro drops the ignored column
+    res_micro = np.asarray(stat_scores(preds, target, reduce="micro", num_classes=3, ignore_index=0))
+    expected = stat_scores(preds, target, reduce="micro", num_classes=3)
+    assert res_micro.shape == (5,)
+
+
+def test_negative_ignore_index_mdmc_labels():
+    """Regression: negative ignore_index with integer multidim-multiclass inputs."""
+    from metrics_tpu.ops.classification import accuracy
+
+    preds = jnp.asarray([[0, 1, 2, 1], [2, 0, 1, 0]])
+    target = jnp.asarray([[0, 1, -1, 1], [2, -1, 1, 0]])
+    res = accuracy(preds, target, num_classes=3, mdmc_average="global", ignore_index=-1)
+    valid = np.asarray(target) != -1
+    expected = (np.asarray(preds)[valid] == np.asarray(target)[valid]).mean()
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)

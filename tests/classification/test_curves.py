@@ -1,0 +1,146 @@
+"""Curve-metric parity: ROC / PR-curve / AUC / AUROC / AveragePrecision / binned.
+
+Reference parity: tests/classification/test_roc.py, test_precision_recall_curve.py,
+test_auc.py, test_auroc.py, test_average_precision.py, test_binned_precision_recall.py.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import auc as sk_auc
+from sklearn.metrics import average_precision_score as sk_ap
+from sklearn.metrics import precision_recall_curve as sk_prc
+from sklearn.metrics import roc_auc_score as sk_roc_auc
+from sklearn.metrics import roc_curve as sk_roc
+
+from metrics_tpu.classification import AUC, AUROC, AveragePrecision, BinnedAveragePrecision, BinnedPrecisionRecallCurve, PrecisionRecallCurve, ROC
+from metrics_tpu.ops.classification import auc, auroc, average_precision, precision_recall_curve, roc
+from tests.classification.inputs import _input_binary_prob, _input_multiclass_prob
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+def test_roc_binary():
+    preds, target = _input_binary_prob.preds[0], _input_binary_prob.target[0]
+    fpr, tpr, thr = roc(jnp.asarray(preds), jnp.asarray(target))
+    sk_fpr, sk_tpr, sk_thr = sk_roc(target, preds, drop_intermediate=False)
+    np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+
+
+def _sk_prc_tm_convention(target, preds):
+    """sklearn>=1.1 keeps all full-recall points; the reference convention
+    (torchmetrics 0.9 == sklearn<1.1) keeps only the highest-threshold one."""
+    sk_p, sk_r, sk_t = sk_prc(target, preds)
+    k = int(np.where(sk_r == 1.0)[0][-1]) if (sk_r == 1.0).any() else 0
+    return sk_p[k:], sk_r[k:], sk_t[k:]
+
+
+def test_prc_binary():
+    preds, target = _input_binary_prob.preds[0], _input_binary_prob.target[0]
+    p, r, t = precision_recall_curve(jnp.asarray(preds), jnp.asarray(target))
+    sk_p, sk_r, sk_t = _sk_prc_tm_convention(target, preds)
+    np.testing.assert_allclose(np.asarray(p), sk_p, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(r), sk_r, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(t), sk_t, atol=1e-6)
+
+
+def test_auc_vs_sklearn():
+    x = np.sort(np.random.default_rng(3).random(20))
+    y = np.random.default_rng(4).random(20)
+    np.testing.assert_allclose(np.asarray(auc(jnp.asarray(x), jnp.asarray(y))), sk_auc(x, y), atol=1e-6)
+
+
+@pytest.mark.parametrize("ddp", [False, True])
+def test_auroc_binary(ddp):
+    MetricTester().run_class_metric_test(
+        ddp=ddp,
+        preds=_input_binary_prob.preds,
+        target=_input_binary_prob.target,
+        metric_class=AUROC,
+        sk_metric=lambda p, t: sk_roc_auc(t, p),
+        metric_args={},
+        check_batch=False,
+    )
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted"])
+def test_auroc_multiclass(average):
+    preds = _input_multiclass_prob.preds.reshape(-1, NUM_CLASSES)
+    target = _input_multiclass_prob.target.reshape(-1)
+    res = auroc(jnp.asarray(preds), jnp.asarray(target), num_classes=NUM_CLASSES, average=average)
+    sk = sk_roc_auc(target, preds, multi_class="ovr", average="macro" if average == "macro" else "weighted", labels=range(NUM_CLASSES))
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-5)
+
+
+def test_auroc_max_fpr():
+    preds, target = _input_binary_prob.preds[0], _input_binary_prob.target[0]
+    res = auroc(jnp.asarray(preds), jnp.asarray(target), max_fpr=0.5)
+    sk = sk_roc_auc(target, preds, max_fpr=0.5)
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-5)
+
+
+@pytest.mark.parametrize("ddp", [False, True])
+def test_average_precision_binary(ddp):
+    MetricTester().run_class_metric_test(
+        ddp=ddp,
+        preds=_input_binary_prob.preds,
+        target=_input_binary_prob.target,
+        metric_class=AveragePrecision,
+        sk_metric=lambda p, t: sk_ap(t, p),
+        metric_args={},
+        check_batch=False,
+    )
+
+
+def test_average_precision_multiclass_macro():
+    preds = _input_multiclass_prob.preds.reshape(-1, NUM_CLASSES)
+    target = _input_multiclass_prob.target.reshape(-1)
+    res = average_precision(jnp.asarray(preds), jnp.asarray(target), num_classes=NUM_CLASSES, average="macro")
+    per_class = [sk_ap((target == c).astype(int), preds[:, c]) for c in range(NUM_CLASSES)]
+    np.testing.assert_allclose(np.asarray(res), np.nanmean(per_class), atol=1e-5)
+
+
+def test_roc_class_accumulates():
+    m = ROC()
+    for i in range(4):
+        m.update(jnp.asarray(_input_binary_prob.preds[i]), jnp.asarray(_input_binary_prob.target[i]))
+    fpr, tpr, thr = m.compute()
+    all_p = _input_binary_prob.preds[:4].reshape(-1)
+    all_t = _input_binary_prob.target[:4].reshape(-1)
+    sk_fpr, sk_tpr, _ = sk_roc(all_t, all_p, drop_intermediate=False)
+    np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+
+
+# --------------------------------------------------------------------------- #
+# binned variants (reference docstring values, binned_precision_recall.py:71-110)
+# --------------------------------------------------------------------------- #
+def test_binned_pr_curve_binary_docstring():
+    pred = jnp.asarray([0, 0.1, 0.8, 0.4])
+    target = jnp.asarray([0, 1, 1, 0])
+    pr_curve = BinnedPrecisionRecallCurve(num_classes=1, thresholds=5)
+    precision, recall, thresholds = pr_curve(pred, target)
+    np.testing.assert_allclose(np.asarray(precision), [0.5, 0.5, 1.0, 1.0, 1.0, 1.0], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(recall), [1.0, 0.5, 0.5, 0.5, 0.0, 0.0], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(thresholds), [0.0, 0.25, 0.5, 0.75, 1.0], atol=1e-6)
+
+
+def test_binned_ap_binary_docstring():
+    pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+    target = jnp.asarray([0, 1, 1, 1])
+    ap = BinnedAveragePrecision(num_classes=1, thresholds=10)
+    res = ap(pred, target)
+    np.testing.assert_allclose(np.asarray(res), 1.0, atol=1e-4)
+
+
+def test_binned_pr_is_jittable():
+    import jax
+
+    m = BinnedPrecisionRecallCurve(num_classes=NUM_CLASSES, thresholds=20)
+    f = jax.jit(lambda s, p, t: m.update_state(s, p, t))
+    state = m.init_state()
+    preds = jnp.asarray(_input_multiclass_prob.preds[0])
+    target = jnp.asarray(_input_multiclass_prob.target[0])
+    state = f(state, preds, target)
+    state = f(state, preds, target)
+    p, r, t = m.compute_state(state)
+    assert len(p) == NUM_CLASSES

@@ -1,0 +1,155 @@
+"""Calibration / Hinge / KL / ranking parity.
+
+Reference parity: tests/classification/test_calibration_error.py, test_hinge.py,
+test_kl_divergence.py, test_ranking.py.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import entropy as scipy_entropy
+from sklearn.metrics import coverage_error as sk_coverage
+from sklearn.metrics import hinge_loss as sk_hinge
+from sklearn.metrics import label_ranking_average_precision_score as sk_lrap
+from sklearn.metrics import label_ranking_loss as sk_lrl
+
+from metrics_tpu.classification import CalibrationError, CoverageError, HingeLoss, KLDivergence, LabelRankingAveragePrecision, LabelRankingLoss
+from metrics_tpu.ops.classification import calibration_error, coverage_error, hinge_loss, kl_divergence, label_ranking_average_precision, label_ranking_loss
+from tests.classification.inputs import _input_binary_prob, _input_multiclass_prob, _input_multilabel_prob
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+_rng = np.random.default_rng(11)
+
+
+def _np_ece(confidences, accuracies, n_bins=15, norm="l1"):
+    bins = np.linspace(0, 1, n_bins + 1)
+    idx = np.clip(np.searchsorted(bins, confidences, side="left") - 1, 0, n_bins - 1)
+    ce = 0.0
+    maxe = 0.0
+    for b in range(n_bins):
+        mask = idx == b
+        if mask.sum() == 0:
+            continue
+        acc, conf, prop = accuracies[mask].mean(), confidences[mask].mean(), mask.mean()
+        if norm == "l1":
+            ce += abs(acc - conf) * prop
+        elif norm == "l2":
+            ce += (acc - conf) ** 2 * prop
+        maxe = max(maxe, abs(acc - conf))
+    if norm == "max":
+        return maxe
+    if norm == "l2":
+        return np.sqrt(ce) if ce > 0 else 0.0
+    return ce
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+def test_calibration_binary(norm):
+    preds, target = _input_binary_prob.preds[0], _input_binary_prob.target[0]
+    res = calibration_error(jnp.asarray(preds), jnp.asarray(target), norm=norm)
+    expected = _np_ece(preds, target.astype(float), norm=norm)
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+
+@pytest.mark.parametrize("norm", ["l1", "max"])
+def test_calibration_multiclass(norm):
+    preds, target = _input_multiclass_prob.preds[0], _input_multiclass_prob.target[0]
+    res = calibration_error(jnp.asarray(preds), jnp.asarray(target), norm=norm)
+    conf = preds.max(-1)
+    acc = (preds.argmax(-1) == target).astype(float)
+    expected = _np_ece(conf, acc, norm=norm)
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+
+def test_calibration_class_ddp():
+    MetricTester().run_class_metric_test(
+        ddp=True,
+        preds=_input_binary_prob.preds,
+        target=_input_binary_prob.target,
+        metric_class=CalibrationError,
+        sk_metric=lambda p, t: _np_ece(p, t.astype(float)),
+        metric_args={},
+        check_batch=False,
+    )
+
+
+def test_hinge_binary():
+    preds = _rng.standard_normal(100).astype(np.float32)
+    target = _rng.integers(0, 2, 100)
+    res = hinge_loss(jnp.asarray(preds), jnp.asarray(target))
+    sk = sk_hinge(np.where(target == 0, -1, 1), preds)
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-6)
+
+
+def test_hinge_multiclass_crammer_singer():
+    preds = _rng.standard_normal((60, NUM_CLASSES)).astype(np.float32)
+    target = _rng.integers(0, NUM_CLASSES, 60)
+    res = hinge_loss(jnp.asarray(preds), jnp.asarray(target))
+    sk = sk_hinge(target, preds, labels=range(NUM_CLASSES))
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-5)
+
+
+def test_kl_divergence():
+    p = _rng.random((32, 8)).astype(np.float32)
+    q = _rng.random((32, 8)).astype(np.float32)
+    res = kl_divergence(jnp.asarray(p), jnp.asarray(q))
+    pn = p / p.sum(-1, keepdims=True)
+    qn = q / q.sum(-1, keepdims=True)
+    expected = np.mean([scipy_entropy(pn[i], qn[i]) for i in range(len(p))])
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+
+
+def test_kl_module_accumulates():
+    m = KLDivergence()
+    p = _rng.random((16, 4)).astype(np.float32)
+    q = _rng.random((16, 4)).astype(np.float32)
+    m.update(jnp.asarray(p[:8]), jnp.asarray(q[:8]))
+    m.update(jnp.asarray(p[8:]), jnp.asarray(q[8:]))
+    pn = p / p.sum(-1, keepdims=True)
+    qn = q / q.sum(-1, keepdims=True)
+    expected = np.mean([scipy_entropy(pn[i], qn[i]) for i in range(len(p))])
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "tm_fn,sk_fn",
+    [
+        (coverage_error, sk_coverage),
+        (label_ranking_average_precision, sk_lrap),
+        (label_ranking_loss, sk_lrl),
+    ],
+)
+def test_ranking_functional(tm_fn, sk_fn):
+    preds = _rng.random((40, 6)).astype(np.float32)
+    target = _rng.integers(0, 2, (40, 6))
+    res = tm_fn(jnp.asarray(preds), jnp.asarray(target))
+    sk = sk_fn(target, preds)
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-5)
+
+
+@pytest.mark.parametrize("cls,sk_fn", [(CoverageError, sk_coverage), (LabelRankingAveragePrecision, sk_lrap), (LabelRankingLoss, sk_lrl)])
+@pytest.mark.parametrize("ddp", [False, True])
+def test_ranking_class(cls, sk_fn, ddp):
+    preds = _rng.random((8, 16, 6)).astype(np.float32)
+    target = _rng.integers(0, 2, (8, 16, 6))
+    # guard against degenerate rows (all 0 / all 1) for sklearn parity
+    target[:, :, 0] = 1
+    target[:, :, 1] = 0
+    MetricTester().run_class_metric_test(
+        ddp=ddp,
+        preds=preds,
+        target=target,
+        metric_class=cls,
+        sk_metric=lambda p, t: sk_fn(t, p),
+        metric_args={},
+    )
+
+
+def test_calibration_eager_jit_agree_on_logits():
+    """Regression: logit normalization must be identical eager vs jitted."""
+    import jax
+
+    logits = jnp.asarray(_rng.standard_normal(200) * 3, dtype=jnp.float32)
+    target = jnp.asarray(_rng.integers(0, 2, 200))
+    eager = calibration_error(logits, target)
+    jitted = jax.jit(calibration_error)(logits, target)
+    np.testing.assert_allclose(np.asarray(eager), np.asarray(jitted), atol=1e-6)

@@ -1,0 +1,109 @@
+"""Precision/Recall/F-beta/Specificity/Dice parity vs sklearn.
+
+Reference parity: tests/classification/test_precision_recall.py + test_f_beta.py
++ test_specificity.py + test_dice.py (compacted grid).
+"""
+import numpy as np
+import pytest
+from sklearn.metrics import fbeta_score as sk_fbeta
+from sklearn.metrics import precision_score as sk_precision
+from sklearn.metrics import recall_score as sk_recall
+
+from metrics_tpu.classification import F1Score, FBetaScore, Precision, Recall, Specificity
+from metrics_tpu.ops.classification import f1_score, fbeta_score, precision, recall, specificity
+from tests.classification.inputs import _input_multiclass, _input_multiclass_prob, _input_multilabel_prob
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_prf(sk_fn, preds, target, average, input_type, **fn_kwargs):
+    if input_type == "mc_prob":
+        preds = np.argmax(preds, axis=-1)
+    elif input_type == "ml_prob":
+        preds = (preds >= THRESHOLD).astype(int)
+        target = target.reshape(-1, target.shape[-1])
+        preds = preds.reshape(-1, preds.shape[-1])
+    return sk_fn(target, preds, average=average, zero_division=0, **fn_kwargs)
+
+
+_CASES = [
+    ("mc", _input_multiclass.preds, _input_multiclass.target),
+    ("mc_prob", _input_multiclass_prob.preds, _input_multiclass_prob.target),
+    ("ml_prob", _input_multilabel_prob.preds, _input_multilabel_prob.target),
+]
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+@pytest.mark.parametrize("case,preds,target", _CASES)
+@pytest.mark.parametrize("ddp", [False, True])
+class TestPrecisionRecall(MetricTester):
+    def test_precision(self, ddp, case, preds, target, average):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Precision,
+            sk_metric=lambda p, t: _sk_prf(sk_precision, p, t, average, case),
+            metric_args={"average": average, "num_classes": NUM_CLASSES, "threshold": THRESHOLD},
+        )
+
+    def test_recall(self, ddp, case, preds, target, average):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Recall,
+            sk_metric=lambda p, t: _sk_prf(sk_recall, p, t, average, case),
+            metric_args={"average": average, "num_classes": NUM_CLASSES, "threshold": THRESHOLD},
+        )
+
+    def test_f1(self, ddp, case, preds, target, average):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=F1Score,
+            sk_metric=lambda p, t: _sk_prf(lambda y, yp, **k: sk_fbeta(y, yp, beta=1.0, **k), p, t, average, case),
+            metric_args={"average": average, "num_classes": NUM_CLASSES, "threshold": THRESHOLD},
+        )
+
+
+@pytest.mark.parametrize("beta", [0.5, 2.0])
+def test_fbeta_functional(beta):
+    import jax.numpy as jnp
+
+    preds, target = _input_multiclass.preds[0], _input_multiclass.target[0]
+    res = fbeta_score(jnp.asarray(preds), jnp.asarray(target), beta=beta, average="macro", num_classes=NUM_CLASSES)
+    sk = sk_fbeta(target, preds, beta=beta, average="macro", zero_division=0)
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-6)
+
+
+def test_specificity_vs_recall_of_negative():
+    """specificity == recall with pos/neg flipped (binary)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    preds = rng.integers(0, 2, 100)
+    target = rng.integers(0, 2, 100)
+    res = specificity(jnp.asarray(preds), jnp.asarray(target), average="micro", multiclass=False)
+    sk = sk_recall(1 - target, 1 - preds)
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-6)
+
+
+def test_dice_micro_equals_f1_micro():
+    import jax.numpy as jnp
+
+    from metrics_tpu.ops.classification import dice
+
+    preds, target = _input_multiclass.preds[0], _input_multiclass.target[0]
+    d = dice(jnp.asarray(preds), jnp.asarray(target), average="micro")
+    f = f1_score(jnp.asarray(preds), jnp.asarray(target), average="micro")
+    np.testing.assert_allclose(np.asarray(d), np.asarray(f), atol=1e-6)
+
+
+def test_precision_bf16_and_grad():
+    t = MetricTester()
+    t.run_precision_test(
+        _input_multiclass_prob.preds,
+        _input_multiclass_prob.target,
+        metric_functional=lambda p, tt, **k: precision(p, tt, average="micro"),
+    )
