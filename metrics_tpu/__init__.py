@@ -38,6 +38,20 @@ from metrics_tpu.classification import (  # noqa: F401
     StatScores,
 )
 from metrics_tpu.core import CompositionalMetric, Metric, MetricCollection  # noqa: F401
+from metrics_tpu.regression import (  # noqa: F401
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
 
 __all__ = [
     "__version__",
@@ -53,4 +67,10 @@ __all__ = [
     "JaccardIndex", "KLDivergence", "LabelRankingAveragePrecision",
     "LabelRankingLoss", "MatthewsCorrCoef", "Precision", "PrecisionRecallCurve",
     "Recall", "ROC", "Specificity", "StatScores",
+    # regression
+    "CosineSimilarity", "ExplainedVariance", "MeanAbsoluteError",
+    "MeanAbsolutePercentageError", "MeanSquaredError", "MeanSquaredLogError",
+    "PearsonCorrCoef", "R2Score", "SpearmanCorrCoef",
+    "SymmetricMeanAbsolutePercentageError", "TweedieDevianceScore",
+    "WeightedMeanAbsolutePercentageError",
 ]

@@ -1,0 +1,128 @@
+"""Elementwise-error regression functionals: MSE, MAE, MSLE, MAPE, SMAPE, WMAPE.
+
+Reference parity (torchmetrics/functional/regression/):
+- mse.py — ``_mean_squared_error_update`` (:22), ``_mean_squared_error_compute``
+  (:39), ``mean_squared_error`` (:59)
+- mae.py — ``mean_absolute_error`` (:53)
+- log_mse.py — ``mean_squared_log_error`` (:55)
+- mape.py — ``mean_absolute_percentage_error`` (:68), epsilon 1.17e-6 (:25)
+- symmetric_mape.py — ``symmetric_mean_absolute_percentage_error`` (:66)
+- wmape.py — ``weighted_mean_absolute_percentage_error`` (:55)
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+_EPS = 1.17e-06
+
+
+def _mean_squared_error_update(preds: Array, target: Array, num_outputs: int = 1) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    n_obs = target.shape[0]
+    return sum_squared_error, n_obs
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, n_obs, squared: bool = True) -> Array:
+    res = sum_squared_error / n_obs
+    return res if squared else jnp.sqrt(res)
+
+
+def mean_squared_error(preds: Array, target: Array, squared: bool = True, num_outputs: int = 1) -> Array:
+    """MSE (or RMSE with squared=False). Reference: mse.py:59-83."""
+    sum_squared_error, n_obs = _mean_squared_error_update(preds, target, num_outputs)
+    return _mean_squared_error_compute(sum_squared_error, n_obs, squared=squared)
+
+
+def _mean_absolute_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    preds = preds if jnp.issubdtype(preds.dtype, jnp.floating) else preds.astype(jnp.float32)
+    target = target if jnp.issubdtype(target.dtype, jnp.floating) else target.astype(jnp.float32)
+    sum_abs_error = jnp.sum(jnp.abs(preds - target))
+    return sum_abs_error, target.size
+
+
+def _mean_absolute_error_compute(sum_abs_error: Array, n_obs) -> Array:
+    return sum_abs_error / n_obs
+
+
+def mean_absolute_error(preds: Array, target: Array) -> Array:
+    """MAE. Reference: mae.py:53-72."""
+    sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)
+    return _mean_absolute_error_compute(sum_abs_error, n_obs)
+
+
+def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    sum_squared_log_error = jnp.sum((jnp.log1p(preds) - jnp.log1p(target)) ** 2)
+    return sum_squared_log_error, target.size
+
+
+def _mean_squared_log_error_compute(sum_squared_log_error: Array, n_obs) -> Array:
+    return sum_squared_log_error / n_obs
+
+
+def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    """MSLE. Reference: log_mse.py:55-77."""
+    sum_squared_log_error, n_obs = _mean_squared_log_error_update(preds, target)
+    return _mean_squared_log_error_compute(sum_squared_log_error, n_obs)
+
+
+def _mean_absolute_percentage_error_update(preds: Array, target: Array, epsilon: float = _EPS) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target), epsilon, None)
+    return jnp.sum(abs_per_error), target.size
+
+
+def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs) -> Array:
+    return sum_abs_per_error / num_obs
+
+
+def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """MAPE. Reference: mape.py:68-96."""
+    sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(preds, target)
+    return _mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)
+
+
+def _symmetric_mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = _EPS
+) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target) + jnp.abs(preds), epsilon, None)
+    return 2 * jnp.sum(abs_per_error), target.size
+
+
+def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """SMAPE. Reference: symmetric_mape.py:66-92."""
+    sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
+    return sum_abs_per_error / num_obs
+
+
+def _weighted_mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = _EPS
+) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    preds = preds.reshape(-1)
+    target = target.reshape(-1)
+    sum_abs_error = jnp.sum(jnp.abs((preds - target)))
+    sum_scale = jnp.sum(jnp.abs(target))
+    return sum_abs_error, sum_scale
+
+
+def _weighted_mean_absolute_percentage_error_compute(sum_abs_error: Array, sum_scale: Array, epsilon: float = _EPS) -> Array:
+    return sum_abs_error / jnp.clip(sum_scale, epsilon, None)
+
+
+def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """WMAPE. Reference: wmape.py:55-83."""
+    sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
+    return _weighted_mean_absolute_percentage_error_compute(sum_abs_error, sum_scale)

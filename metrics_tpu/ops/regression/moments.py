@@ -1,0 +1,241 @@
+"""Moment-based regression functionals: Pearson, Spearman, R2, ExplainedVariance.
+
+Reference parity (torchmetrics/functional/regression/):
+- pearson.py — running-moment update (:20, Welford-style mean/var/cov merge),
+  compute (:63)
+- spearman.py — ``_rank_data`` with mean-tie correction (:35, per-repeat loop),
+  compute (:78)
+- r2.py — ``_r2_score_update`` (:24), ``_r2_score_compute`` (:50)
+- explained_variance.py — update (:22), compute (:45)
+
+TPU-first: tie-aware ranking is the sort + double-searchsorted identity
+``rank = (left + right + 1) / 2`` — O(n log n), fully vectorized, no per-repeat
+python loop (reference spearman.py:46-56).
+"""
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape, _is_concrete
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+# --------------------------------------------------------------------------- #
+# pearson
+# --------------------------------------------------------------------------- #
+def _pearson_corrcoef_update(
+    preds: Array,
+    target: Array,
+    mean_x: Array,
+    mean_y: Array,
+    var_x: Array,
+    var_y: Array,
+    corr_xy: Array,
+    n_prior: Array,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """One Welford-style merge step of the running moments."""
+    _check_same_shape(preds, target)
+    preds = jnp.squeeze(preds)
+    target = jnp.squeeze(target)
+    if preds.ndim > 1 or target.ndim > 1:
+        raise ValueError("Expected both predictions and target to be 1 dimensional tensors.")
+
+    n_obs = preds.size
+    mx_new = (n_prior * mean_x + jnp.mean(preds) * n_obs) / (n_prior + n_obs)
+    my_new = (n_prior * mean_y + jnp.mean(target) * n_obs) / (n_prior + n_obs)
+    n_prior = n_prior + n_obs
+    var_x = var_x + jnp.sum((preds - mx_new) * (preds - mean_x))
+    var_y = var_y + jnp.sum((target - my_new) * (target - mean_y))
+    corr_xy = corr_xy + jnp.sum((preds - mx_new) * (target - mean_y))
+    return mx_new, my_new, var_x, var_y, corr_xy, n_prior
+
+
+def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Array) -> Array:
+    var_x = var_x / (nb - 1)
+    var_y = var_y / (nb - 1)
+    corr_xy = corr_xy / (nb - 1)
+    corrcoef = jnp.squeeze(corr_xy / jnp.sqrt(var_x * var_y))
+    return jnp.clip(corrcoef, -1.0, 1.0)
+
+
+def pearson_corrcoef(preds: Array, target: Array) -> Array:
+    """Pearson correlation. Reference: pearson.py:85-104."""
+    zero = jnp.zeros(1, dtype=preds.dtype if jnp.issubdtype(preds.dtype, jnp.floating) else jnp.float32)
+    _, _, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
+        preds, target, zero, zero, zero, zero, zero, zero
+    )
+    return _pearson_corrcoef_compute(var_x, var_y, corr_xy, nb)
+
+
+# --------------------------------------------------------------------------- #
+# spearman
+# --------------------------------------------------------------------------- #
+def _rank_data(data: Array) -> Array:
+    """Mean-tie rank (1-based): ``(left + right + 1) / 2`` via searchsorted."""
+    sorted_data = jnp.sort(data)
+    left = jnp.searchsorted(sorted_data, data, side="left")
+    right = jnp.searchsorted(sorted_data, data, side="right")
+    return (left + right + 1) / 2.0
+
+
+def _spearman_corrcoef_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    _check_same_shape(preds, target)
+    preds = jnp.squeeze(preds)
+    target = jnp.squeeze(target)
+    if preds.ndim > 1 or target.ndim > 1:
+        raise ValueError("Expected both predictions and target to be 1 dimensional tensors.")
+    return preds, target
+
+
+def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -> Array:
+    preds = _rank_data(preds)
+    target = _rank_data(target)
+
+    preds_diff = preds - jnp.mean(preds)
+    target_diff = target - jnp.mean(target)
+
+    cov = jnp.mean(preds_diff * target_diff)
+    preds_std = jnp.sqrt(jnp.mean(preds_diff * preds_diff))
+    target_std = jnp.sqrt(jnp.mean(target_diff * target_diff))
+
+    corrcoef = cov / (preds_std * target_std + eps)
+    return jnp.clip(corrcoef, -1.0, 1.0)
+
+
+def spearman_corrcoef(preds: Array, target: Array) -> Array:
+    """Spearman rank correlation. Reference: spearman.py:103-126."""
+    preds, target = _spearman_corrcoef_update(preds, target)
+    return _spearman_corrcoef_compute(preds, target)
+
+
+# --------------------------------------------------------------------------- #
+# r2
+# --------------------------------------------------------------------------- #
+def _r2_score_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, int]:
+    _check_same_shape(preds, target)
+    if preds.ndim > 2:
+        raise ValueError(
+            "Expected both prediction and target to be 1D or 2D tensors,"
+            f" but received tensors with dimension {preds.shape}"
+        )
+    sum_obs = jnp.sum(target, axis=0)
+    sum_squared_obs = jnp.sum(target * target, axis=0)
+    residual = target - preds
+    rss = jnp.sum(residual * residual, axis=0)
+    return sum_squared_obs, sum_obs, rss, target.shape[0]
+
+
+def _r2_score_compute(
+    sum_squared_obs: Array,
+    sum_obs: Array,
+    rss: Array,
+    n_obs: Union[int, Array],
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    if _is_concrete(jnp.asarray(n_obs)) and int(n_obs) < 2:
+        raise ValueError("Needs at least two samples to calculate r2 score.")
+
+    mean_obs = sum_obs / n_obs
+    tss = sum_squared_obs - sum_obs * mean_obs
+    raw_scores = 1 - (rss / tss)
+
+    if multioutput == "raw_values":
+        r2 = raw_scores
+    elif multioutput == "uniform_average":
+        r2 = jnp.mean(raw_scores)
+    elif multioutput == "variance_weighted":
+        tss_sum = jnp.sum(tss)
+        r2 = jnp.sum(tss / tss_sum * raw_scores)
+    else:
+        raise ValueError(
+            "Argument `multioutput` must be either `raw_values`,"
+            f" `uniform_average` or `variance_weighted`. Received {multioutput}."
+        )
+
+    if adjusted < 0 or not isinstance(adjusted, int):
+        raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+    if adjusted != 0:
+        if _is_concrete(jnp.asarray(n_obs)):
+            if adjusted > n_obs - 1:
+                rank_zero_warn(
+                    "More independent regressions than data points in adjusted r2 score. Falls back to standard r2 score.",
+                    UserWarning,
+                )
+            elif adjusted == n_obs - 1:
+                rank_zero_warn("Division by zero in adjusted r2 score. Falls back to standard r2 score.", UserWarning)
+            else:
+                r2 = 1 - (1 - r2) * (n_obs - 1) / (n_obs - adjusted - 1)
+        else:
+            # traced n_obs: same fallback semantics, expressed as a select
+            valid = n_obs - adjusted - 1 > 0
+            corrected = 1 - (1 - r2) * (n_obs - 1) / jnp.where(valid, n_obs - adjusted - 1, 1)
+            r2 = jnp.where(valid, corrected, r2)
+    return r2
+
+
+def r2_score(preds: Array, target: Array, adjusted: int = 0, multioutput: str = "uniform_average") -> Array:
+    """R². Reference: r2.py:118-163."""
+    sum_squared_obs, sum_obs, rss, n_obs = _r2_score_update(preds, target)
+    return _r2_score_compute(sum_squared_obs, sum_obs, rss, n_obs, adjusted, multioutput)
+
+
+# --------------------------------------------------------------------------- #
+# explained variance
+# --------------------------------------------------------------------------- #
+def _explained_variance_update(preds: Array, target: Array) -> Tuple[int, Array, Array, Array, Array]:
+    _check_same_shape(preds, target)
+    n_obs = preds.shape[0]
+    diff = target - preds
+    sum_error = jnp.sum(diff, axis=0)
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    sum_target = jnp.sum(target, axis=0)
+    sum_squared_target = jnp.sum(target * target, axis=0)
+    return n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target
+
+
+def _explained_variance_compute(
+    n_obs: Union[int, Array],
+    sum_error: Array,
+    sum_squared_error: Array,
+    sum_target: Array,
+    sum_squared_target: Array,
+    multioutput: str = "uniform_average",
+) -> Array:
+    diff_avg = sum_error / n_obs
+    numerator = sum_squared_error / n_obs - diff_avg * diff_avg
+    target_avg = sum_target / n_obs
+    denominator = sum_squared_target / n_obs - target_avg * target_avg
+
+    nonzero_numerator = numerator != 0
+    nonzero_denominator = denominator != 0
+    valid_score = nonzero_numerator & nonzero_denominator
+    output_scores = jnp.ones_like(jnp.asarray(diff_avg, dtype=jnp.float32))
+    safe_denom = jnp.where(valid_score, denominator, 1.0)
+    output_scores = jnp.where(valid_score, 1.0 - numerator / safe_denom, output_scores)
+    output_scores = jnp.where(nonzero_numerator & ~nonzero_denominator, 0.0, output_scores)
+
+    if multioutput == "raw_values":
+        return output_scores
+    if multioutput == "uniform_average":
+        return jnp.mean(output_scores)
+    if multioutput == "variance_weighted":
+        denom_sum = jnp.sum(denominator)
+        return jnp.sum(denominator / denom_sum * output_scores)
+    raise ValueError(f"Invalid input to multioutput. Received multioutput={multioutput}")
+
+
+def explained_variance(preds: Array, target: Array, multioutput: str = "uniform_average") -> Array:
+    """Explained variance. Reference: explained_variance.py:103-147."""
+    n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)
+    return _explained_variance_compute(
+        n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target, multioutput
+    )

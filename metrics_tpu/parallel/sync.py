@@ -117,8 +117,12 @@ def sync_array(x: Array, reduction: Optional[Union[str, Callable]], axis_name: A
         return lax.pmax(x, axis_name)
     if reduction == "min":
         return lax.pmin(x, axis_name)
-    if reduction == "cat" or reduction is None:
-        return lax.all_gather(x, axis_name, axis=0, tiled=True)
+    if reduction == "cat":
+        return lax.all_gather(jnp.atleast_1d(x), axis_name, axis=0, tiled=True)
+    if reduction is None:
+        # keep per-device values separate (reference stacks the gathered list,
+        # metric.py:364-365) — e.g. Pearson's moment merge consumes the stack
+        return lax.all_gather(x, axis_name, axis=0)
     if callable(reduction):
         gathered = lax.all_gather(x, axis_name, axis=0)  # (world, ...)
         return reduction(gathered)

@@ -1,0 +1,16 @@
+"""Regression module metrics (reference parity: torchmetrics/regression/)."""
+from metrics_tpu.regression.basic import (  # noqa: F401
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    SymmetricMeanAbsolutePercentageError,
+    WeightedMeanAbsolutePercentageError,
+)
+from metrics_tpu.regression.moments import (  # noqa: F401
+    ExplainedVariance,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+)
+from metrics_tpu.regression.other import CosineSimilarity, TweedieDevianceScore  # noqa: F401

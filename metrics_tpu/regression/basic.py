@@ -1,0 +1,154 @@
+"""Sum-state regression modules: MSE, MAE, MSLE, MAPE, SMAPE, WMAPE.
+
+Reference parity (torchmetrics/regression/): mse.py:23, mae.py:23,
+log_mse.py:23, mape.py:26, symmetric_mape.py:25, wmape.py:26. All six share
+the (sum_error, total) state pattern; equal-config instances of the same class
+fuse in collections via ``_update_signature``.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.regression.basic import (
+    _mean_absolute_error_update,
+    _mean_absolute_percentage_error_update,
+    _mean_squared_error_update,
+    _mean_squared_log_error_update,
+    _symmetric_mean_absolute_percentage_error_update,
+    _weighted_mean_absolute_percentage_error_update,
+)
+
+
+class MeanSquaredError(Metric):
+    """MSE / RMSE. Reference: regression/mse.py:23-85."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, squared: bool = True, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.squared = squared
+        self.num_outputs = num_outputs
+        self.add_state("sum_squared_error", default=jnp.zeros(num_outputs if num_outputs > 1 else ()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        sum_squared_error, n_obs = _mean_squared_error_update(preds, target, self.num_outputs)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.total = self.total + n_obs
+
+    def compute(self) -> Array:
+        res = self.sum_squared_error / self.total
+        return res if self.squared else jnp.sqrt(res)
+
+
+class MeanAbsoluteError(Metric):
+    """MAE. Reference: regression/mae.py:23-77."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)
+        self.sum_abs_error = self.sum_abs_error + sum_abs_error
+        self.total = self.total + n_obs
+
+    def compute(self) -> Array:
+        return self.sum_abs_error / self.total
+
+
+class MeanSquaredLogError(Metric):
+    """MSLE. Reference: regression/log_mse.py:23-78."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_squared_log_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        sum_squared_log_error, n_obs = _mean_squared_log_error_update(preds, target)
+        self.sum_squared_log_error = self.sum_squared_log_error + sum_squared_log_error
+        self.total = self.total + n_obs
+
+    def compute(self) -> Array:
+        return self.sum_squared_log_error / self.total
+
+
+class MeanAbsolutePercentageError(Metric):
+    """MAPE. Reference: regression/mape.py:26-85."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_per_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_per_error = self.sum_abs_per_error + sum_abs_per_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        return self.sum_abs_per_error / self.total
+
+
+class SymmetricMeanAbsolutePercentageError(Metric):
+    """SMAPE. Reference: regression/symmetric_mape.py:25-85."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_per_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_per_error = self.sum_abs_per_error + sum_abs_per_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        return self.sum_abs_per_error / self.total
+
+
+class WeightedMeanAbsolutePercentageError(Metric):
+    """WMAPE. Reference: regression/wmape.py:26-81."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_scale", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_error = self.sum_abs_error + sum_abs_error
+        self.sum_scale = self.sum_scale + sum_scale
+
+    def compute(self) -> Array:
+        from metrics_tpu.ops.regression.basic import _weighted_mean_absolute_percentage_error_compute
+
+        return _weighted_mean_absolute_percentage_error_compute(self.sum_abs_error, self.sum_scale)

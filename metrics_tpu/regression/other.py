@@ -1,0 +1,67 @@
+"""CosineSimilarity and TweedieDevianceScore modules.
+
+Reference parity: torchmetrics/regression/cosine_similarity.py:25,
+tweedie_deviance.py:26.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.regression.other import (
+    _cosine_similarity_compute,
+    _cosine_similarity_update,
+    _tweedie_deviance_score_compute,
+    _tweedie_deviance_score_update,
+)
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class CosineSimilarity(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        allowed_reduction = ("sum", "mean", "none", None)
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        preds, target = _cosine_similarity_update(preds, target)
+        self.preds = self.preds + [preds]
+        self.target = self.target + [target]
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _cosine_similarity_compute(preds, target, self.reduction)
+
+
+class TweedieDevianceScore(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, power: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if 0 < power < 1:
+            raise ValueError(f"Deviance Score is not defined for power={power}.")
+        self.power = power
+        self.add_state("sum_deviance_score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("num_observations", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, targets: Array) -> None:  # type: ignore[override]
+        sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, self.power)
+        self.sum_deviance_score = self.sum_deviance_score + sum_deviance_score
+        self.num_observations = self.num_observations + num_observations
+
+    def compute(self) -> Array:
+        return _tweedie_deviance_score_compute(self.sum_deviance_score, self.num_observations)

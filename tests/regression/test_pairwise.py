@@ -1,0 +1,73 @@
+"""Pairwise functional parity vs sklearn.
+
+Reference parity: tests/pairwise/test_pairwise_distance.py.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics.pairwise import cosine_similarity as sk_cosine
+from sklearn.metrics.pairwise import euclidean_distances as sk_euclidean
+from sklearn.metrics.pairwise import linear_kernel as sk_linear
+from sklearn.metrics.pairwise import manhattan_distances as sk_manhattan
+
+from metrics_tpu.ops.pairwise import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+)
+
+_rng = np.random.default_rng(5)
+X = _rng.random((10, 4)).astype(np.float32)
+Y = _rng.random((7, 4)).astype(np.float32)
+
+
+@pytest.mark.parametrize(
+    "tm_fn,sk_fn",
+    [
+        (pairwise_cosine_similarity, sk_cosine),
+        (pairwise_euclidean_distance, sk_euclidean),
+        (pairwise_linear_similarity, sk_linear),
+        (pairwise_manhattan_distance, sk_manhattan),
+    ],
+)
+def test_pairwise_xy(tm_fn, sk_fn):
+    res = tm_fn(jnp.asarray(X), jnp.asarray(Y))
+    np.testing.assert_allclose(np.asarray(res), sk_fn(X, Y), atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "tm_fn,sk_fn",
+    [
+        (pairwise_cosine_similarity, sk_cosine),
+        (pairwise_euclidean_distance, sk_euclidean),
+    ],
+)
+def test_pairwise_self_zero_diagonal(tm_fn, sk_fn):
+    res = np.asarray(tm_fn(jnp.asarray(X)))
+    expected = sk_fn(X)
+    np.fill_diagonal(expected, 0)
+    np.testing.assert_allclose(res, expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum"])
+def test_reductions(reduction):
+    res = pairwise_linear_similarity(jnp.asarray(X), jnp.asarray(Y), reduction=reduction)
+    mat = sk_linear(X, Y)
+    expected = mat.mean(-1) if reduction == "mean" else mat.sum(-1)
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-4)
+
+
+def test_bad_input():
+    with pytest.raises(ValueError, match="Expected argument `x`"):
+        pairwise_cosine_similarity(jnp.ones(3))
+    with pytest.raises(ValueError, match="Expected argument `y`"):
+        pairwise_cosine_similarity(jnp.ones((3, 2)), jnp.ones((3, 4)))
+
+
+def test_zero_row_cosine_diagonal_cleared():
+    """Regression: NaN diagonal (0/0) must be cleared by zero_diagonal."""
+    x = np.zeros((3, 4), dtype=np.float32)
+    x[1] = 1.0
+    res = np.asarray(pairwise_cosine_similarity(jnp.asarray(x)))
+    assert np.isfinite(np.diag(res)).all() and (np.diag(res) == 0).all()

@@ -1,0 +1,195 @@
+"""Regression metric parity vs sklearn/scipy.
+
+Reference parity: tests/regression/* (compacted grid).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import pearsonr, spearmanr
+from sklearn.metrics import (
+    explained_variance_score,
+    mean_absolute_error as sk_mae,
+    mean_absolute_percentage_error as sk_mape,
+    mean_squared_error as sk_mse,
+    mean_squared_log_error as sk_msle,
+    mean_tweedie_deviance,
+    r2_score as sk_r2,
+)
+
+from metrics_tpu.ops.regression import (
+    cosine_similarity,
+    explained_variance,
+    mean_absolute_error,
+    mean_absolute_percentage_error,
+    mean_squared_error,
+    mean_squared_log_error,
+    pearson_corrcoef,
+    r2_score,
+    spearman_corrcoef,
+    symmetric_mean_absolute_percentage_error,
+    tweedie_deviance_score,
+    weighted_mean_absolute_percentage_error,
+)
+from metrics_tpu.regression import (
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanSquaredError,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+    TweedieDevianceScore,
+)
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.default_rng(123)
+NB, BS = 8, 32
+_preds = _rng.random((NB, BS)).astype(np.float32) + 0.1
+_target = _rng.random((NB, BS)).astype(np.float32) + 0.1
+_preds_2d = _rng.random((NB, BS, 3)).astype(np.float32)
+_target_2d = _rng.random((NB, BS, 3)).astype(np.float32)
+
+
+@pytest.mark.parametrize(
+    "tm_fn,sk_fn",
+    [
+        (mean_squared_error, sk_mse),
+        (mean_absolute_error, sk_mae),
+        (mean_squared_log_error, sk_msle),
+        (mean_absolute_percentage_error, sk_mape),
+        (r2_score, sk_r2),
+        (explained_variance, explained_variance_score),
+    ],
+)
+def test_functional_parity(tm_fn, sk_fn):
+    res = tm_fn(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    sk = sk_fn(_target[0], _preds[0])
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-5)
+
+
+def test_rmse():
+    res = mean_squared_error(jnp.asarray(_preds[0]), jnp.asarray(_target[0]), squared=False)
+    np.testing.assert_allclose(np.asarray(res), np.sqrt(sk_mse(_target[0], _preds[0])), atol=1e-6)
+
+
+def test_pearson_functional():
+    res = pearson_corrcoef(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    np.testing.assert_allclose(np.asarray(res), pearsonr(_preds[0], _target[0])[0], atol=1e-5)
+
+
+def test_spearman_with_ties():
+    p = np.round(_preds[0], 1)  # force ties
+    t = np.round(_target[0], 1)
+    res = spearman_corrcoef(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(np.asarray(res), spearmanr(p, t)[0], atol=1e-5)
+
+
+def test_smape():
+    res = symmetric_mean_absolute_percentage_error(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    expected = np.mean(2 * np.abs(_preds[0] - _target[0]) / (np.abs(_preds[0]) + np.abs(_target[0])))
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+
+
+def test_wmape():
+    res = weighted_mean_absolute_percentage_error(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    expected = np.sum(np.abs(_preds[0] - _target[0])) / np.sum(np.abs(_target[0]))
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("power", [0, 1, 2, 3, 1.5])
+def test_tweedie(power):
+    res = tweedie_deviance_score(jnp.asarray(_preds[0]), jnp.asarray(_target[0]), power=power)
+    sk = mean_tweedie_deviance(_target[0], _preds[0], power=power)
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("reduction", ["sum", "mean", "none"])
+def test_cosine_similarity(reduction):
+    p, t = _preds_2d[0], _target_2d[0]
+    res = cosine_similarity(jnp.asarray(p), jnp.asarray(t), reduction=reduction)
+    sims = np.sum(p * t, -1) / (np.linalg.norm(p, axis=-1) * np.linalg.norm(t, axis=-1))
+    expected = {"sum": sims.sum(), "mean": sims.mean(), "none": sims}[reduction]
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+
+
+# --------------------------------------------------------------------------- #
+# module classes incl. ddp
+# --------------------------------------------------------------------------- #
+@pytest.mark.parametrize("ddp", [False, True])
+@pytest.mark.parametrize(
+    "metric_class,sk_fn",
+    [
+        (MeanSquaredError, sk_mse),
+        (MeanAbsoluteError, sk_mae),
+        (R2Score, sk_r2),
+        (ExplainedVariance, explained_variance_score),
+    ],
+)
+def test_class_parity(ddp, metric_class, sk_fn):
+    MetricTester().run_class_metric_test(
+        ddp=ddp,
+        preds=_preds,
+        target=_target,
+        metric_class=metric_class,
+        sk_metric=lambda p, t: sk_fn(t, p),
+        metric_args={},
+        check_batch=metric_class not in (R2Score, ExplainedVariance),
+    )
+
+
+@pytest.mark.parametrize("ddp", [False, True])
+def test_pearson_class(ddp):
+    MetricTester().run_class_metric_test(
+        ddp=ddp,
+        preds=_preds,
+        target=_target,
+        metric_class=PearsonCorrCoef,
+        sk_metric=lambda p, t: pearsonr(p.reshape(-1), t.reshape(-1))[0],
+        metric_args={},
+        check_batch=False,
+    )
+
+
+@pytest.mark.parametrize("ddp", [False, True])
+def test_spearman_class(ddp):
+    MetricTester().run_class_metric_test(
+        ddp=ddp,
+        preds=_preds,
+        target=_target,
+        metric_class=SpearmanCorrCoef,
+        sk_metric=lambda p, t: spearmanr(p.reshape(-1), t.reshape(-1))[0],
+        metric_args={},
+        check_batch=False,
+    )
+
+
+def test_tweedie_class_accumulates():
+    m = TweedieDevianceScore(power=1.5)
+    for i in range(4):
+        m.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    sk = mean_tweedie_deviance(_target[:4].reshape(-1), _preds[:4].reshape(-1), power=1.5)
+    np.testing.assert_allclose(np.asarray(m.compute()), sk, atol=1e-5, rtol=1e-4)
+
+
+def test_r2_adjusted():
+    res = r2_score(jnp.asarray(_preds[0]), jnp.asarray(_target[0]), adjusted=3)
+    n = BS
+    base = sk_r2(_target[0], _preds[0])
+    expected = 1 - (1 - base) * (n - 1) / (n - 3 - 1)
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+
+
+def test_grad_flows():
+    MetricTester().run_differentiability_test(_preds, _target, mean_squared_error)
+
+
+def test_r2_adjusted_under_jit():
+    """Regression: adjusted R2 must compile (traced n_obs)."""
+    import jax
+
+    m = R2Score(adjusted=3)
+    f = jax.jit(lambda s, p, t: m.compute_state(m.update_state(s, p, t)))
+    res = f(m.init_state(), jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    n = BS
+    expected = 1 - (1 - sk_r2(_target[0], _preds[0])) * (n - 1) / (n - 3 - 1)
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
