@@ -38,6 +38,25 @@ from metrics_tpu.classification import (  # noqa: F401
     StatScores,
 )
 from metrics_tpu.core import CompositionalMetric, Metric, MetricCollection  # noqa: F401
+from metrics_tpu.retrieval import (  # noqa: F401
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalPrecisionRecallCurve,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+    RetrievalRPrecision,
+)
+from metrics_tpu.wrappers import (  # noqa: F401
+    BootStrapper,
+    ClasswiseWrapper,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+)
 from metrics_tpu.regression import (  # noqa: F401
     CosineSimilarity,
     ExplainedVariance,
@@ -73,4 +92,12 @@ __all__ = [
     "PearsonCorrCoef", "R2Score", "SpearmanCorrCoef",
     "SymmetricMeanAbsolutePercentageError", "TweedieDevianceScore",
     "WeightedMeanAbsolutePercentageError",
+    # retrieval
+    "RetrievalFallOut", "RetrievalHitRate", "RetrievalMAP", "RetrievalMRR",
+    "RetrievalNormalizedDCG", "RetrievalPrecision",
+    "RetrievalPrecisionRecallCurve", "RetrievalRecall",
+    "RetrievalRecallAtFixedPrecision", "RetrievalRPrecision",
+    # wrappers
+    "BootStrapper", "ClasswiseWrapper", "MetricTracker", "MinMaxMetric",
+    "MultioutputWrapper",
 ]

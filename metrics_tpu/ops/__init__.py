@@ -49,3 +49,14 @@ from metrics_tpu.ops.regression import (  # noqa: F401
     tweedie_deviance_score,
     weighted_mean_absolute_percentage_error,
 )
+from metrics_tpu.ops.retrieval import (  # noqa: F401
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_precision_recall_curve,
+    retrieval_r_precision,
+    retrieval_reciprocal_rank,
+    retrieval_recall,
+)

@@ -107,9 +107,7 @@ def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Arr
     return sum_abs_per_error / num_obs
 
 
-def _weighted_mean_absolute_percentage_error_update(
-    preds: Array, target: Array, epsilon: float = _EPS
-) -> Tuple[Array, Array]:
+def _weighted_mean_absolute_percentage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
     _check_same_shape(preds, target)
     preds = preds.reshape(-1)
     target = target.reshape(-1)

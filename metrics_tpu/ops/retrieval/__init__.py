@@ -1,0 +1,149 @@
+"""Functional retrieval metrics (single-query scorers).
+
+Reference parity: torchmetrics/functional/retrieval/ —
+``retrieval_average_precision`` (average_precision.py), ``retrieval_reciprocal_rank``
+(reciprocal_rank.py), ``retrieval_precision`` (precision.py),
+``retrieval_recall`` (recall.py), ``retrieval_hit_rate`` (hit_rate.py),
+``retrieval_fall_out`` (fall_out.py), ``retrieval_normalized_dcg`` (ndcg.py),
+``retrieval_r_precision`` (r_precision.py), ``retrieval_precision_recall_curve``
+(precision_recall_curve.py).
+
+Each scorer takes the (preds, target) of ONE query. The grouped/batched
+evaluation lives in :mod:`metrics_tpu.retrieval`.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+
+def _sorted_by_preds(preds: Array, target: Array) -> Array:
+    return target[jnp.argsort(-preds, stable=True)]
+
+
+def retrieval_average_precision(preds: Array, target: Array) -> Array:
+    """AP of one query."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not float(jnp.sum(target)):
+        return jnp.asarray(0.0)
+    target = _sorted_by_preds(preds, target)
+    positions = jnp.arange(1, len(target) + 1, dtype=jnp.float32)[target > 0]
+    return jnp.mean((jnp.arange(len(positions), dtype=jnp.float32) + 1) / positions)
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
+    """RR of one query."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not float(jnp.sum(target)):
+        return jnp.asarray(0.0)
+    target = _sorted_by_preds(preds, target)
+    position = jnp.nonzero(target)[0]
+    return 1.0 / (position[0] + 1.0)
+
+
+def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None, adaptive_k: bool = False) -> Array:
+    """Precision@k of one query."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    if k is None or (adaptive_k and k > preds.shape[-1]):
+        k = preds.shape[-1]
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    if not float(jnp.sum(target)):
+        return jnp.asarray(0.0)
+    relevant = jnp.sum(_sorted_by_preds(preds, target)[: min(k, preds.shape[-1])]).astype(jnp.float32)
+    return relevant / k
+
+
+def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Recall@k of one query."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if k is None:
+        k = preds.shape[-1]
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    if not float(jnp.sum(target)):
+        return jnp.asarray(0.0)
+    relevant = jnp.sum(_sorted_by_preds(preds, target)[:k]).astype(jnp.float32)
+    return relevant / jnp.sum(target)
+
+
+def retrieval_hit_rate(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """HitRate@k of one query."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if k is None:
+        k = preds.shape[-1]
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    relevant = jnp.sum(_sorted_by_preds(preds, target)[:k])
+    return (relevant > 0).astype(jnp.float32)
+
+
+def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """FallOut@k of one query (non-relevant retrieved / all non-relevant)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    k = preds.shape[-1] if k is None else k
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    target = 1 - target
+    if not float(jnp.sum(target)):
+        return jnp.asarray(0.0)
+    relevant = jnp.sum(_sorted_by_preds(preds, target)[:k]).astype(jnp.float32)
+    return relevant / jnp.sum(target)
+
+
+def _dcg(target: Array) -> Array:
+    denom = jnp.log2(jnp.arange(target.shape[-1], dtype=jnp.float32) + 2.0)
+    return jnp.sum(target / denom, axis=-1)
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """nDCG@k of one query (graded relevance allowed)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
+    k = preds.shape[-1] if k is None else k
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    sorted_target = _sorted_by_preds(preds, target)[:k]
+    ideal_target = jnp.sort(target)[::-1][:k]
+    ideal_dcg = _dcg(ideal_target.astype(jnp.float32))
+    target_dcg = _dcg(sorted_target.astype(jnp.float32))
+    return jnp.where(ideal_dcg == 0, 0.0, target_dcg / jnp.where(ideal_dcg == 0, 1.0, ideal_dcg))
+
+
+def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    """R-precision of one query."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    relevant_number = int(jnp.sum(target))
+    if not relevant_number:
+        return jnp.asarray(0.0)
+    relevant = jnp.sum(_sorted_by_preds(preds, target)[:relevant_number]).astype(jnp.float32)
+    return relevant / relevant_number
+
+
+def retrieval_precision_recall_curve(
+    preds: Array, target: Array, max_k: Optional[int] = None, adaptive_k: bool = False
+) -> Tuple[Array, Array, Array]:
+    """Precision@k / recall@k for k = 1..max_k of one query."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    if max_k is None:
+        max_k = preds.shape[-1]
+    if not (isinstance(max_k, int) and max_k > 0):
+        raise ValueError("`max_k` has to be a positive integer or None")
+    if adaptive_k and max_k > preds.shape[-1]:
+        max_k = preds.shape[-1]
+    topk = jnp.arange(1, max_k + 1, dtype=jnp.float32)
+    sorted_target = _sorted_by_preds(preds, target)[:max_k].astype(jnp.float32)
+    cs = jnp.cumsum(sorted_target)
+    if len(cs) < max_k:  # fewer docs than max_k: counts saturate
+        cs = jnp.pad(cs, (0, max_k - len(cs)), mode="edge")
+    precision = cs / topk
+    total = jnp.sum(target)
+    recall = jnp.where(total == 0, 0.0, cs / jnp.where(total == 0, 1.0, total))
+    return precision, recall, jnp.arange(1, max_k + 1)

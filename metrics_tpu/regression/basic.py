@@ -14,11 +14,16 @@ from jax import Array
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.ops.regression.basic import (
+    _mean_absolute_error_compute,
     _mean_absolute_error_update,
+    _mean_absolute_percentage_error_compute,
     _mean_absolute_percentage_error_update,
+    _mean_squared_error_compute,
     _mean_squared_error_update,
+    _mean_squared_log_error_compute,
     _mean_squared_log_error_update,
     _symmetric_mean_absolute_percentage_error_update,
+    _weighted_mean_absolute_percentage_error_compute,
     _weighted_mean_absolute_percentage_error_update,
 )
 
@@ -43,8 +48,7 @@ class MeanSquaredError(Metric):
         self.total = self.total + n_obs
 
     def compute(self) -> Array:
-        res = self.sum_squared_error / self.total
-        return res if self.squared else jnp.sqrt(res)
+        return _mean_squared_error_compute(self.sum_squared_error, self.total, squared=self.squared)
 
 
 class MeanAbsoluteError(Metric):
@@ -65,7 +69,7 @@ class MeanAbsoluteError(Metric):
         self.total = self.total + n_obs
 
     def compute(self) -> Array:
-        return self.sum_abs_error / self.total
+        return _mean_absolute_error_compute(self.sum_abs_error, self.total)
 
 
 class MeanSquaredLogError(Metric):
@@ -86,7 +90,7 @@ class MeanSquaredLogError(Metric):
         self.total = self.total + n_obs
 
     def compute(self) -> Array:
-        return self.sum_squared_log_error / self.total
+        return _mean_squared_log_error_compute(self.sum_squared_log_error, self.total)
 
 
 class MeanAbsolutePercentageError(Metric):
@@ -107,7 +111,7 @@ class MeanAbsolutePercentageError(Metric):
         self.total = self.total + num_obs
 
     def compute(self) -> Array:
-        return self.sum_abs_per_error / self.total
+        return _mean_absolute_percentage_error_compute(self.sum_abs_per_error, self.total)
 
 
 class SymmetricMeanAbsolutePercentageError(Metric):
@@ -149,6 +153,4 @@ class WeightedMeanAbsolutePercentageError(Metric):
         self.sum_scale = self.sum_scale + sum_scale
 
     def compute(self) -> Array:
-        from metrics_tpu.ops.regression.basic import _weighted_mean_absolute_percentage_error_compute
-
         return _weighted_mean_absolute_percentage_error_compute(self.sum_abs_error, self.sum_scale)

@@ -1,0 +1,16 @@
+"""Retrieval module metrics (reference parity: torchmetrics/retrieval/)."""
+from metrics_tpu.retrieval.base import RetrievalMetric  # noqa: F401
+from metrics_tpu.retrieval.metrics import (  # noqa: F401
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+    RetrievalRPrecision,
+)
+from metrics_tpu.retrieval.precision_recall_curve import (  # noqa: F401
+    RetrievalPrecisionRecallCurve,
+    RetrievalRecallAtFixedPrecision,
+)

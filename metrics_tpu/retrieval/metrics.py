@@ -1,0 +1,139 @@
+"""Retrieval metric modules.
+
+Reference parity (torchmetrics/retrieval/): ``RetrievalMAP``
+(average_precision.py:20), ``RetrievalMRR`` (reciprocal_rank.py:20),
+``RetrievalPrecision`` (precision.py:22), ``RetrievalRecall`` (recall.py:22),
+``RetrievalHitRate`` (hit_rate.py:22), ``RetrievalFallOut`` (fall_out.py:24,
+empty-target semantics inverted), ``RetrievalNormalizedDCG`` (ndcg.py:22),
+``RetrievalRPrecision`` (r_precision.py:20).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.retrieval import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_r_precision,
+    retrieval_reciprocal_rank,
+    retrieval_recall,
+)
+from metrics_tpu.retrieval.base import RetrievalMetric
+from metrics_tpu.utils.data import dim_zero_cat, get_group_indexes
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Mean average precision."""
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_average_precision(preds, target)
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Mean reciprocal rank."""
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_reciprocal_rank(preds, target)
+
+
+class _TopKRetrievalMetric(RetrievalMetric):
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+
+class RetrievalPrecision(_TopKRetrievalMetric):
+    """Precision@k averaged over queries."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        adaptive_k: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, k=k, **kwargs)
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.adaptive_k = adaptive_k
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_precision(preds, target, k=self.k, adaptive_k=self.adaptive_k)
+
+
+class RetrievalRecall(_TopKRetrievalMetric):
+    """Recall@k averaged over queries."""
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_recall(preds, target, k=self.k)
+
+
+class RetrievalHitRate(_TopKRetrievalMetric):
+    """HitRate@k averaged over queries."""
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_hit_rate(preds, target, k=self.k)
+
+
+class RetrievalNormalizedDCG(_TopKRetrievalMetric):
+    """nDCG@k averaged over queries (graded relevance allowed)."""
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None, k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, k=k, **kwargs)
+        self.allow_non_binary_target = True
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_normalized_dcg(preds, target, k=self.k)
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """R-precision averaged over queries."""
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_r_precision(preds, target)
+
+
+class RetrievalFallOut(_TopKRetrievalMetric):
+    """FallOut@k — empty-target semantics INVERTED vs other retrieval metrics:
+    a query with no *negative* target is degenerate (reference fall_out.py:24,
+    compute override :103-133)."""
+
+    higher_is_better = False
+
+    def compute(self) -> Array:
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        res = []
+        groups = get_group_indexes(indexes)
+        for group in groups:
+            mini_preds = preds[group]
+            mini_target = target[group]
+            if not float(jnp.sum(1 - mini_target)):  # no negative docs
+                if self.empty_target_action == "error":
+                    raise ValueError("`compute` method was provided with a query with no negative target.")
+                if self.empty_target_action == "pos":
+                    res.append(jnp.asarray(1.0))
+                elif self.empty_target_action == "neg":
+                    res.append(jnp.asarray(0.0))
+            else:
+                res.append(self._metric(mini_preds, mini_target))
+        return jnp.mean(jnp.stack(res)) if res else jnp.asarray(0.0)
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_fall_out(preds, target, k=self.k)

@@ -1,0 +1,110 @@
+"""Retrieval precision-recall curve over top-k cutoffs.
+
+Reference parity: torchmetrics/retrieval/precision_recall_curve.py —
+``_retrieval_recall_at_fixed_precision`` (:30), ``RetrievalPrecisionRecallCurve``
+(:55), ``RetrievalRecallAtFixedPrecision`` (:212).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.retrieval import retrieval_precision_recall_curve
+from metrics_tpu.retrieval.base import RetrievalMetric
+from metrics_tpu.utils.data import dim_zero_cat, get_group_indexes
+
+
+def _retrieval_recall_at_fixed_precision(
+    precision: Array, recall: Array, top_k: Array, min_precision: float
+) -> Tuple[Array, Array]:
+    """Max recall subject to precision >= min_precision (mask-based)."""
+    qualify = precision >= min_precision
+    masked = jnp.where(qualify, recall, -jnp.inf)
+    # break recall ties with larger k (reference max over (r, k) tuples)
+    best = jnp.argmax(masked + jnp.asarray(top_k, jnp.float32) * 1e-9)
+    max_recall = jnp.where(jnp.any(qualify), recall[best], 0.0)
+    best_k = jnp.where(max_recall == 0.0, len(top_k), top_k[best])
+    return max_recall, best_k
+
+
+class RetrievalPrecisionRecallCurve(RetrievalMetric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if (max_k is not None) and not (isinstance(max_k, int) and max_k > 0):
+            raise ValueError("`max_k` has to be a positive integer or None")
+        self.max_k = max_k
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.adaptive_k = adaptive_k
+
+    def _metric(self, preds: Array, target: Array) -> Array:  # pragma: no cover - unused
+        raise NotImplementedError
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        groups = get_group_indexes(indexes)
+
+        max_k = self.max_k or max(len(g) for g in groups)
+
+        precisions, recalls = [], []
+        for group in groups:
+            mini_preds = preds[group]
+            mini_target = target[group]
+            if not float(jnp.sum(mini_target)):
+                if self.empty_target_action == "error":
+                    raise ValueError("`compute` method was provided with a query with no positive target.")
+                if self.empty_target_action == "pos":
+                    recalls.append(jnp.ones(max_k))
+                    precisions.append(jnp.ones(max_k))
+                elif self.empty_target_action == "neg":
+                    recalls.append(jnp.zeros(max_k))
+                    precisions.append(jnp.zeros(max_k))
+            else:
+                precision, recall, _ = retrieval_precision_recall_curve(mini_preds, mini_target, max_k, self.adaptive_k)
+                precisions.append(precision)
+                recalls.append(recall)
+
+        precision = jnp.mean(jnp.stack(precisions), axis=0) if precisions else jnp.zeros(max_k)
+        recall = jnp.mean(jnp.stack(recalls), axis=0) if recalls else jnp.zeros(max_k)
+        top_k = jnp.arange(1, max_k + 1)
+        return precision, recall, top_k
+
+
+class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
+    higher_is_better = True
+
+    def __init__(
+        self,
+        min_precision: float = 0.0,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            max_k=max_k, adaptive_k=adaptive_k, empty_target_action=empty_target_action,
+            ignore_index=ignore_index, **kwargs,
+        )
+        if not (isinstance(min_precision, float) and 0.0 <= min_precision <= 1.0):
+            raise ValueError("`min_precision` has to be a positive float between 0 and 1")
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
+        precisions, recalls, top_k = super().compute()
+        return _retrieval_recall_at_fixed_precision(precisions, recalls, top_k, self.min_precision)

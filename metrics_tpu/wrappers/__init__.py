@@ -1,0 +1,12 @@
+"""Metric wrappers.
+
+Reference parity: torchmetrics/wrappers/ (706 LoC) — ``BootStrapper``
+(bootstrapping.py:49), ``ClasswiseWrapper`` (classwise.py:8), ``MinMaxMetric``
+(minmax.py:23), ``MultioutputWrapper`` (multioutput.py:24), ``MetricTracker``
+(tracker.py:26).
+"""
+from metrics_tpu.wrappers.bootstrapping import BootStrapper  # noqa: F401
+from metrics_tpu.wrappers.classwise import ClasswiseWrapper  # noqa: F401
+from metrics_tpu.wrappers.minmax import MinMaxMetric  # noqa: F401
+from metrics_tpu.wrappers.multioutput import MultioutputWrapper  # noqa: F401
+from metrics_tpu.wrappers.tracker import MetricTracker  # noqa: F401

@@ -1,0 +1,44 @@
+"""Per-class dict output wrapper.
+
+Reference parity: torchmetrics/wrappers/classwise.py:8-80.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+
+
+class ClasswiseWrapper(Metric):
+    full_state_update: Optional[bool] = True
+
+    def __init__(self, metric: Metric, labels: Optional[List[str]] = None) -> None:
+        super().__init__()
+        if not isinstance(metric, Metric):
+            raise ValueError(f"Expected argument `metric` to be an instance of `metrics_tpu.Metric` but got {metric}")
+        if labels is not None and not (isinstance(labels, list) and all(isinstance(lab, str) for lab in labels)):
+            raise ValueError(f"Expected argument `labels` to either be `None` or a list of strings but got {labels}")
+        self.metric = metric
+        self.labels = labels
+
+    def _convert(self, x: Array) -> Dict[str, Array]:
+        name = self.metric.__class__.__name__.lower()
+        if self.labels is None:
+            return {f"{name}_{i}": val for i, val in enumerate(x)}
+        return {f"{name}_{lab}": val for lab, val in zip(self.labels, x)}
+
+    def update(self, *args: Any, **kwargs: Any) -> None:  # type: ignore[override]
+        self.metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        return self._convert(self.metric.compute())
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        return self._convert(self.metric(*args, **kwargs))
+
+    def reset(self) -> None:
+        self.metric.reset()
+        self._update_count = 0
+        self._computed = None

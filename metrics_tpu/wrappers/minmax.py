@@ -1,0 +1,49 @@
+"""Min/max tracking wrapper.
+
+Reference parity: torchmetrics/wrappers/minmax.py:23-110.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+
+
+class MinMaxMetric(Metric):
+    full_state_update: bool = True
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(f"Expected base metric to be an instance of `metrics_tpu.Metric` but received {base_metric}")
+        self._base_metric = base_metric
+        self.min_val = jnp.asarray(jnp.inf)
+        self.max_val = jnp.asarray(-jnp.inf)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:  # type: ignore[override]
+        self._base_metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        val = self._base_metric.compute()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}.")
+        self.max_val = jnp.where(self.max_val < val, jnp.asarray(val, dtype=jnp.float32), self.max_val)
+        self.min_val = jnp.where(self.min_val > val, jnp.asarray(val, dtype=jnp.float32), self.min_val)
+        return {"raw": val, "max": self.max_val, "min": self.min_val}
+
+    @staticmethod
+    def _is_suitable_val(val: Any) -> bool:
+        if isinstance(val, (int, float)):
+            return True
+        if isinstance(val, jnp.ndarray):
+            return val.size == 1
+        return False
+
+    def reset(self) -> None:
+        super().reset()
+        self._base_metric.reset()
+        self.min_val = jnp.asarray(jnp.inf)
+        self.max_val = jnp.asarray(-jnp.inf)

@@ -1,0 +1,99 @@
+"""Per-output metric clones.
+
+Reference parity: torchmetrics/wrappers/multioutput.py:24-150 (per-output
+``index_select`` along ``output_dim`` + joint NaN-row removal).
+"""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.data import apply_to_collection
+
+
+def _get_nan_indices(*tensors: Array) -> Array:
+    """Rows where any tensor has a NaN (eager; used for dynamic row removal)."""
+    if len(tensors) == 0:
+        raise ValueError("Must pass at least one tensor as argument")
+    sentinel = tensors[0]
+    nan_idxs = jnp.zeros(len(sentinel), dtype=bool)
+    for tensor in tensors:
+        permuted = tensor.reshape(len(sentinel), -1)
+        nan_idxs = nan_idxs | jnp.any(jnp.isnan(permuted), axis=1)
+    return nan_idxs
+
+
+class MultioutputWrapper(Metric):
+    is_differentiable = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array) -> List[Tuple]:
+        """Slice inputs per output index (reference :97-115)."""
+        args_kwargs_by_output = []
+        for i in range(len(self.metrics)):
+            selected_args = apply_to_collection(
+                args, jnp.ndarray, lambda t: jnp.take(t, jnp.asarray([i]), axis=self.output_dim)
+            )
+            selected_kwargs = apply_to_collection(
+                kwargs, jnp.ndarray, lambda t: jnp.take(t, jnp.asarray([i]), axis=self.output_dim)
+            )
+            if self.remove_nans:
+                tensors = [t for t in list(selected_args) + list(selected_kwargs.values()) if isinstance(t, jnp.ndarray)]
+                if tensors:
+                    nan_idxs = np.asarray(_get_nan_indices(*tensors))
+                    keep = jnp.asarray(~nan_idxs)
+                    selected_args = [t[keep] if isinstance(t, jnp.ndarray) else t for t in selected_args]
+                    selected_kwargs = {
+                        k: (t[keep] if isinstance(t, jnp.ndarray) else t) for k, t in selected_kwargs.items()
+                    }
+            if self.squeeze_outputs:
+                selected_args = [jnp.squeeze(t, axis=self.output_dim) if isinstance(t, jnp.ndarray) else t for t in selected_args]
+                selected_kwargs = {
+                    k: (jnp.squeeze(t, axis=self.output_dim) if isinstance(t, jnp.ndarray) else t)
+                    for k, t in selected_kwargs.items()
+                }
+            args_kwargs_by_output.append((selected_args, selected_kwargs))
+        return args_kwargs_by_output
+
+    def update(self, *args: Any, **kwargs: Any) -> None:  # type: ignore[override]
+        reshaped = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped):
+            metric.update(*selected_args, **selected_kwargs)
+
+    def compute(self) -> Array:
+        return jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Array:
+        reshaped = self._get_args_kwargs_by_output(*args, **kwargs)
+        results = [
+            metric(*selected_args, **selected_kwargs)
+            for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped)
+        ]
+        if any(r is None for r in results):
+            return None
+        return jnp.stack([jnp.asarray(r) for r in results], axis=0)
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()
+        self._update_count = 0
+        self._computed = None

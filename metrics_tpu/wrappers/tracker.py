@@ -1,0 +1,105 @@
+"""Track a metric (or collection) over multiple timesteps.
+
+Reference parity: torchmetrics/wrappers/tracker.py:26-190 — ``increment``,
+``compute_all``, ``best_metric`` with maximize flag.
+"""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class MetricTracker:
+    """Keeps one copy of the base metric per ``increment()`` call."""
+
+    def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                "Metric arg need to be an instance of a metrics_tpu"
+                f" `Metric` or `MetricCollection` but got {metric}"
+            )
+        self._base_metric = metric
+        self._metrics: List[Union[Metric, MetricCollection]] = []
+        if not isinstance(maximize, (bool, list)):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        if isinstance(maximize, list) and isinstance(metric, MetricCollection) and len(maximize) != len(metric):
+            raise ValueError("The len of argument `maximize` should match the length of the metric collection")
+        self.maximize = maximize
+        self._increment_called = False
+
+    @property
+    def n_steps(self) -> int:
+        return len(self._metrics)
+
+    def increment(self) -> None:
+        """Start a new timestep."""
+        self._increment_called = True
+        self._metrics.append(deepcopy(self._base_metric))
+        self._metrics[-1].reset()
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self._check_for_increment("forward")
+        return self._metrics[-1](*args, **kwargs)
+
+    __call__ = forward
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._check_for_increment("update")
+        self._metrics[-1].update(*args, **kwargs)
+
+    def compute(self) -> Any:
+        self._check_for_increment("compute")
+        return self._metrics[-1].compute()
+
+    def compute_all(self) -> Union[Array, Dict[str, Array]]:
+        """Stack computes over all timesteps."""
+        self._check_for_increment("compute_all")
+        res = [metric.compute() for metric in self._metrics]
+        if isinstance(self._base_metric, MetricCollection):
+            keys = res[0].keys()
+            return {k: jnp.stack([jnp.asarray(r[k]) for r in res], axis=0) for k in keys}
+        return jnp.stack([jnp.asarray(r) for r in res], axis=0)
+
+    def reset(self) -> None:
+        self._metrics[-1].reset()
+
+    def reset_all(self) -> None:
+        for metric in self._metrics:
+            metric.reset()
+
+    def best_metric(
+        self, return_step: bool = False
+    ) -> Union[float, Tuple[int, float], Dict[str, float], Tuple[Dict[str, int], Dict[str, float]]]:
+        """Best value (and optionally its step) over time."""
+        res = self.compute_all()
+        if isinstance(res, dict):
+            maximize = self.maximize if isinstance(self.maximize, list) else [self.maximize] * len(res)
+            value, idx = {}, {}
+            for i, (k, v) in enumerate(res.items()):
+                v = np.asarray(v)
+                fn = np.nanargmax if maximize[i] else np.nanargmin
+                try:
+                    best_i = int(fn(v))
+                except ValueError:
+                    rank_zero_warn(f"Encountered all-nan values in metric {k}; returning None")
+                    value[k], idx[k] = None, None
+                    continue
+                value[k] = float(v[best_i])
+                idx[k] = best_i
+            return (idx, value) if return_step else value
+        v = np.asarray(res)
+        fn = np.nanargmax if self.maximize else np.nanargmin
+        best_i = int(fn(v))
+        return (best_i, float(v[best_i])) if return_step else float(v[best_i])
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called")

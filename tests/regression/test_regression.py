@@ -193,3 +193,15 @@ def test_r2_adjusted_under_jit():
     n = BS
     expected = 1 - (1 - sk_r2(_target[0], _preds[0])) * (n - 1) / (n - 3 - 1)
     np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("ddp", [False, True])
+def test_cosine_similarity_class(ddp):
+    MetricTester().run_class_metric_test(
+        ddp=ddp,
+        preds=_preds_2d,
+        target=_target_2d,
+        metric_class=CosineSimilarity,
+        sk_metric=lambda p, t: np.sum(np.sum(p * t, -1) / (np.linalg.norm(p, axis=-1) * np.linalg.norm(t, axis=-1))),
+        metric_args={"reduction": "sum"},
+    )

@@ -1,0 +1,119 @@
+"""Wrapper tests (reference parity: tests/wrappers/*)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+from metrics_tpu.wrappers import BootStrapper, ClasswiseWrapper, MetricTracker, MinMaxMetric, MultioutputWrapper
+
+_rng = np.random.default_rng(21)
+
+
+def test_bootstrapper_mean_close_to_base():
+    preds = jnp.asarray(_rng.integers(0, 5, 200))
+    target = jnp.asarray(_rng.integers(0, 5, 200))
+    base = Accuracy(num_classes=5)
+    base.update(preds, target)
+    boot = BootStrapper(Accuracy(num_classes=5), num_bootstraps=50, seed=0)
+    boot.update(preds, target)
+    out = boot.compute()
+    assert set(out) == {"mean", "std"}
+    assert abs(float(out["mean"]) - float(base.compute())) < 0.05
+    assert float(out["std"]) > 0
+
+
+def test_bootstrapper_quantile_raw():
+    boot = BootStrapper(MeanSquaredError(), num_bootstraps=10, quantile=0.5, raw=True, seed=1)
+    boot.update(jnp.asarray(_rng.random(64)), jnp.asarray(_rng.random(64)))
+    out = boot.compute()
+    assert out["raw"].shape == (10,)
+    assert "quantile" in out
+
+
+def test_bootstrapper_rejects_non_metric():
+    with pytest.raises(ValueError, match="base metric"):
+        BootStrapper(lambda x: x)
+
+
+def test_classwise_wrapper_keys_and_values():
+    m = ClasswiseWrapper(Accuracy(num_classes=3, average="none"), labels=["horse", "fish", "dog"])
+    preds = jnp.asarray(_rng.random((10, 3)), dtype=jnp.float32)
+    target = jnp.asarray(_rng.integers(0, 3, 10))
+    out = m(preds, target)
+    assert set(out) == {"accuracy_horse", "accuracy_fish", "accuracy_dog"}
+    plain = Accuracy(num_classes=3, average="none")
+    plain.update(preds, target)
+    np.testing.assert_allclose(
+        np.asarray([out["accuracy_horse"], out["accuracy_fish"], out["accuracy_dog"]]),
+        np.asarray(plain.compute()),
+        atol=1e-6,
+    )
+
+
+def test_classwise_in_collection_flattens():
+    col = MetricCollection({"acc": ClasswiseWrapper(Accuracy(num_classes=3, average="none"))})
+    preds = jnp.asarray(_rng.random((10, 3)), dtype=jnp.float32)
+    target = jnp.asarray(_rng.integers(0, 3, 10))
+    col.update(preds, target)
+    res = col.compute()
+    assert set(res) == {"accuracy_0", "accuracy_1", "accuracy_2"}
+
+
+def test_minmax_tracks():
+    mm = MinMaxMetric(MeanSquaredError())
+    t = jnp.asarray([1.0, 2.0, 3.0])
+    out1 = mm(t + 0.5, t)
+    assert float(out1["min"]) == float(out1["max"]) == float(out1["raw"]) == pytest.approx(0.25)
+    mm.update(t + 1.0, t)
+    out2 = mm.compute()
+    assert float(out2["max"]) > 0.25
+    assert float(out2["min"]) == pytest.approx(0.25)
+
+
+def test_multioutput_wrapper():
+    m = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    preds = jnp.asarray(_rng.random((16, 2)), dtype=jnp.float32)
+    target = jnp.asarray(_rng.random((16, 2)), dtype=jnp.float32)
+    m.update(preds, target)
+    res = np.asarray(m.compute())
+    expected = [np.mean((np.asarray(preds)[:, i] - np.asarray(target)[:, i]) ** 2) for i in range(2)]
+    np.testing.assert_allclose(res, expected, atol=1e-6)
+
+
+def test_multioutput_removes_nan_rows():
+    m = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    preds = np.asarray([[1.0, 1.0], [np.nan, 2.0], [3.0, 3.0]], dtype=np.float32)
+    target = np.asarray([[1.0, 2.0], [2.0, 2.0], [3.0, 4.0]], dtype=np.float32)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    res = np.asarray(m.compute())
+    np.testing.assert_allclose(res[0], 0.0, atol=1e-6)  # nan row dropped for output 0
+    np.testing.assert_allclose(res[1], np.mean((preds[:, 1] - target[:, 1]) ** 2), atol=1e-6)
+
+
+def test_tracker():
+    tracker = MetricTracker(MeanSquaredError(), maximize=False)
+    t = jnp.asarray(_rng.random(32), dtype=jnp.float32)
+    for shift in [0.5, 0.1, 0.3]:
+        tracker.increment()
+        tracker.update(t + shift, t)
+    all_vals = np.asarray(tracker.compute_all())
+    assert all_vals.shape == (3,)
+    best_step, best_val = tracker.best_metric(return_step=True)
+    assert best_step == 1
+    assert best_val == pytest.approx(0.01, abs=1e-5)
+
+
+def test_tracker_requires_increment():
+    tracker = MetricTracker(MeanSquaredError())
+    with pytest.raises(ValueError, match="increment"):
+        tracker.update(jnp.asarray([1.0]), jnp.asarray([1.0]))
+
+
+def test_tracker_with_collection():
+    tracker = MetricTracker(MetricCollection({"mse": MeanSquaredError()}), maximize=[False])
+    tracker.increment()
+    tracker.update(jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 2.0]))
+    res = tracker.compute_all()
+    assert "mse" in res
+    best = tracker.best_metric()
+    assert best["mse"] == pytest.approx(0.0)
