@@ -1,0 +1,128 @@
+"""Driver benchmark: fused MetricCollection update+compute, 1k classes.
+
+BASELINE.md config 2 — MetricCollection(Accuracy, F1, Precision, Recall) over a
+1000-class, 64k-sample sweep. Ours: one jitted XLA call per step (fused
+compute-group update). Baseline: the reference TorchMetrics implementation
+(/root/reference, torch CPU — the reference publishes no absolute numbers, so
+its own implementation on the host is the measured baseline).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+NUM_CLASSES = 1000
+BATCH = 1024
+STEPS = 64
+WARMUP = 3
+
+
+def bench_ours() -> float:
+    """µs/step for the fused jitted collection update (+ final compute)."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, Recall
+
+    coll = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES, average="micro"),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "precision": Precision(num_classes=NUM_CLASSES, average="macro"),
+            "recall": Recall(num_classes=NUM_CLASSES, average="macro"),
+        }
+    )
+
+    @jax.jit
+    def step(states, logits, target):
+        return coll.update_state(states, logits, target)
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(BATCH, NUM_CLASSES)), dtype=jnp.float32)
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=jnp.int32)
+
+    states = coll.init_state()
+    for _ in range(WARMUP):
+        states = step(states, logits, target)
+    jax.block_until_ready(states)
+
+    states = coll.init_state()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        states = step(states, logits, target)
+    jax.block_until_ready(states)
+    t1 = time.perf_counter()
+    results = coll.compute_state(states)
+    jax.block_until_ready(results)
+    return (t1 - t0) / STEPS * 1e6
+
+
+def bench_reference() -> float:
+    """µs/step for the reference TorchMetrics collection (torch CPU)."""
+    sys.path.insert(0, "/root/reference")
+    if "pkg_resources" not in sys.modules:  # removed from setuptools; shim the two names the reference uses
+        import types
+
+        shim = types.ModuleType("pkg_resources")
+
+        class DistributionNotFound(Exception):
+            pass
+
+        def get_distribution(name):
+            raise DistributionNotFound(name)
+
+        shim.DistributionNotFound = DistributionNotFound
+        shim.get_distribution = get_distribution
+        sys.modules["pkg_resources"] = shim
+    import torch
+    from torchmetrics import Accuracy, F1Score, MetricCollection, Precision, Recall
+
+    coll = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES, average="micro"),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "precision": Precision(num_classes=NUM_CLASSES, average="macro"),
+            "recall": Recall(num_classes=NUM_CLASSES, average="macro"),
+        }
+    )
+    rng = np.random.default_rng(0)
+    logits = torch.as_tensor(rng.normal(size=(BATCH, NUM_CLASSES)), dtype=torch.float32)
+    target = torch.as_tensor(rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=torch.long)
+
+    for _ in range(WARMUP):
+        coll.update(logits, target)
+    coll.reset()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        coll.update(logits, target)
+    t1 = time.perf_counter()
+    coll.compute()
+    return (t1 - t0) / STEPS * 1e6
+
+
+def main() -> None:
+    ours_us = bench_ours()
+    try:
+        ref_us = bench_reference()
+        vs_baseline = ref_us / ours_us  # >1 == faster than the reference
+    except Exception:
+        vs_baseline = 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "metric_collection_update_us_per_step",
+                "value": round(ours_us, 2),
+                "unit": "us/step",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

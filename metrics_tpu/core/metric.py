@@ -167,6 +167,32 @@ class Metric:
         for k, v in state.items():
             setattr(self, k, _copy_state_value(v))
 
+    def _child_metrics(self) -> List["Metric"]:
+        """Metric instances held as attributes (wrappers: BootStrapper copies,
+        MinMaxMetric base, ...). Their state lives outside ``_defaults``, so the
+        forward snapshot/restore must cover them too."""
+        out: List[Metric] = []
+        for val in vars(self).values():
+            if isinstance(val, Metric):
+                out.append(val)
+            elif isinstance(val, (list, tuple)):
+                out.extend(v for v in val if isinstance(v, Metric))
+        return out
+
+    def _deep_snapshot(self) -> List[Tuple["Metric", StateDict, int]]:
+        snap: List[Tuple[Metric, StateDict, int]] = [(self, self.get_state(), self._update_count)]
+        for child in self._child_metrics():
+            snap.extend(child._deep_snapshot())
+        return snap
+
+    @staticmethod
+    def _deep_restore(snap: List[Tuple["Metric", StateDict, int]]) -> None:
+        for metric, state, count in snap:
+            metric.set_state(state)
+            metric._update_count = count
+            metric._computed = None
+            metric._is_synced = False
+
     def update_state(self, state: StateDict, *args: Any, **kwargs: Any) -> StateDict:
         """Pure: return ``state`` advanced by one batch. Jittable (``self`` is
         closed over as static config). The stateful ``update`` and this function
@@ -256,12 +282,12 @@ class Metric:
         _temp_compute_on_cpu = self.compute_on_cpu
         self.compute_on_cpu = False
 
-        cache = self.get_state()  # free: arrays are immutable
+        cache = self._deep_snapshot()  # free: arrays are immutable
         self.reset()
         self.update(*args, **kwargs)
         batch_val = self.compute()
 
-        self.set_state(cache)
+        self._deep_restore(cache)
         self._update_count = _update_count
         self._is_synced = False
         self._should_unsync = True

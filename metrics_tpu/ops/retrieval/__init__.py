@@ -136,9 +136,13 @@ def retrieval_precision_recall_curve(
         max_k = preds.shape[-1]
     if not (isinstance(max_k, int) and max_k > 0):
         raise ValueError("`max_k` has to be a positive integer or None")
-    if adaptive_k and max_k > preds.shape[-1]:
-        max_k = preds.shape[-1]
-    topk = jnp.arange(1, max_k + 1, dtype=jnp.float32)
+    n = preds.shape[-1]
+    if adaptive_k and max_k > n:
+        # curves keep length max_k: k clamps at the query's document count so
+        # precision/recall saturate past it (reference functional :83-86)
+        topk = jnp.concatenate([jnp.arange(1, n + 1), jnp.full((max_k - n,), n)]).astype(jnp.float32)
+    else:
+        topk = jnp.arange(1, max_k + 1, dtype=jnp.float32)
     sorted_target = _sorted_by_preds(preds, target)[:max_k].astype(jnp.float32)
     cs = jnp.cumsum(sorted_target)
     if len(cs) < max_k:  # fewer docs than max_k: counts saturate
@@ -146,4 +150,4 @@ def retrieval_precision_recall_curve(
     precision = cs / topk
     total = jnp.sum(target)
     recall = jnp.where(total == 0, 0.0, cs / jnp.where(total == 0, 1.0, total))
-    return precision, recall, jnp.arange(1, max_k + 1)
+    return precision, recall, topk.astype(jnp.int32)

@@ -25,7 +25,7 @@ from metrics_tpu.ops.retrieval import (
     retrieval_recall,
 )
 from metrics_tpu.retrieval.base import RetrievalMetric
-from metrics_tpu.utils.data import dim_zero_cat, get_group_indexes
+
 
 
 class RetrievalMAP(RetrievalMetric):
@@ -114,26 +114,10 @@ class RetrievalFallOut(_TopKRetrievalMetric):
     compute override :103-133)."""
 
     higher_is_better = False
+    _empty_kind = "negative"
 
-    def compute(self) -> Array:
-        indexes = dim_zero_cat(self.indexes)
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
-        res = []
-        groups = get_group_indexes(indexes)
-        for group in groups:
-            mini_preds = preds[group]
-            mini_target = target[group]
-            if not float(jnp.sum(1 - mini_target)):  # no negative docs
-                if self.empty_target_action == "error":
-                    raise ValueError("`compute` method was provided with a query with no negative target.")
-                if self.empty_target_action == "pos":
-                    res.append(jnp.asarray(1.0))
-                elif self.empty_target_action == "neg":
-                    res.append(jnp.asarray(0.0))
-            else:
-                res.append(self._metric(mini_preds, mini_target))
-        return jnp.mean(jnp.stack(res)) if res else jnp.asarray(0.0)
+    def _is_empty_query(self, target: Array) -> bool:
+        return not float(jnp.sum(1 - target))
 
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_fall_out(preds, target, k=self.k)

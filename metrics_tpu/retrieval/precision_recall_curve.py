@@ -22,10 +22,11 @@ def _retrieval_recall_at_fixed_precision(
     """Max recall subject to precision >= min_precision (mask-based)."""
     qualify = precision >= min_precision
     masked = jnp.where(qualify, recall, -jnp.inf)
-    # break recall ties with larger k (reference max over (r, k) tuples)
-    best = jnp.argmax(masked + jnp.asarray(top_k, jnp.float32) * 1e-9)
-    max_recall = jnp.where(jnp.any(qualify), recall[best], 0.0)
-    best_k = jnp.where(max_recall == 0.0, len(top_k), top_k[best])
+    rmax = jnp.max(masked)
+    # recall ties break toward the larger k (reference max over (r, k) tuples)
+    best_k = jnp.max(jnp.where(qualify & (masked == rmax), top_k, 0))
+    max_recall = jnp.where(jnp.any(qualify), rmax, 0.0)
+    best_k = jnp.where(max_recall == 0.0, len(top_k), best_k)
     return max_recall, best_k
 
 

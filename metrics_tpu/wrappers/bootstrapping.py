@@ -64,15 +64,15 @@ class BootStrapper(Metric):
 
     def update(self, *args: Any, **kwargs: Any) -> None:  # type: ignore[override]
         """Resample inputs along dim 0 once per bootstrap copy (reference :122-136)."""
+        args_sizes = apply_to_collection(args, jnp.ndarray, lambda x: x.shape[0])
+        kwargs_sizes = apply_to_collection(kwargs, jnp.ndarray, lambda x: x.shape[0])
+        if len(args_sizes) > 0:
+            size = args_sizes[0]
+        elif len(kwargs_sizes) > 0:
+            size = list(kwargs_sizes.values())[0]
+        else:
+            raise ValueError("None of the input contained tensors, so could not determine the sampling size")
         for idx in range(self.num_bootstraps):
-            args_sizes = apply_to_collection(args, jnp.ndarray, lambda x: x.shape[0])
-            kwargs_sizes = apply_to_collection(kwargs, jnp.ndarray, lambda x: x.shape[0])
-            if len(args_sizes) > 0:
-                size = args_sizes[0]
-            elif len(kwargs_sizes) > 0:
-                size = list(kwargs_sizes.values())[0]
-            else:
-                raise ValueError("None of the input contained tensors, so could not determine the sampling size")
             sample_idx = _bootstrap_sampler(size, sampling_strategy=self.sampling_strategy, rng=self._rng)
             if sample_idx.size == 0:
                 continue
@@ -95,7 +95,6 @@ class BootStrapper(Metric):
         return output_dict
 
     def reset(self) -> None:
+        super().reset()
         for m in self.metrics:
             m.reset()
-        self._update_count = 0
-        self._computed = None

@@ -36,9 +36,13 @@ class ClasswiseWrapper(Metric):
         return self._convert(self.metric.compute())
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
-        return self._convert(self.metric(*args, **kwargs))
+        # inner forward advances the inner metric; the wrapper's own cache and
+        # update count must track it or compute() returns stale values
+        self._computed = None
+        self._update_count += 1
+        self._forward_cache = self._convert(self.metric(*args, **kwargs))
+        return self._forward_cache
 
     def reset(self) -> None:
+        super().reset()
         self.metric.reset()
-        self._update_count = 0
-        self._computed = None

@@ -20,11 +20,24 @@ class MinMaxMetric(Metric):
         if not isinstance(base_metric, Metric):
             raise ValueError(f"Expected base metric to be an instance of `metrics_tpu.Metric` but received {base_metric}")
         self._base_metric = base_metric
-        self.min_val = jnp.asarray(jnp.inf)
-        self.max_val = jnp.asarray(-jnp.inf)
+        # registered states (unlike the reference's plain attrs, minmax.py:69-70)
+        # so reset/snapshot/dist-sync all cover them
+        self.add_state("min_val", jnp.asarray(jnp.inf), dist_reduce_fx="min")
+        self.add_state("max_val", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
 
     def update(self, *args: Any, **kwargs: Any) -> None:  # type: ignore[override]
         self._base_metric.update(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        """Batch value, with the batch's computed value folded into the tracked
+        bounds — the reference gets this implicitly because its inner compute
+        mutates unreset plain attrs (minmax.py:88-89); here the fold is explicit
+        since min/max are registered states restored by the forward snapshot."""
+        val = super().forward(*args, **kwargs)
+        self.min_val = jnp.minimum(self.min_val, val["min"])
+        self.max_val = jnp.maximum(self.max_val, val["max"])
+        self._forward_cache = {"raw": val["raw"], "min": self.min_val, "max": self.max_val}
+        return self._forward_cache
 
     def compute(self) -> Dict[str, Array]:
         val = self._base_metric.compute()
@@ -45,5 +58,3 @@ class MinMaxMetric(Metric):
     def reset(self) -> None:
         super().reset()
         self._base_metric.reset()
-        self.min_val = jnp.asarray(jnp.inf)
-        self.max_val = jnp.asarray(-jnp.inf)

@@ -83,17 +83,21 @@ class MultioutputWrapper(Metric):
         return jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
 
     def forward(self, *args: Any, **kwargs: Any) -> Array:
+        # per-output forwards advance the clones; invalidate the wrapper cache
+        self._computed = None
+        self._update_count += 1
         reshaped = self._get_args_kwargs_by_output(*args, **kwargs)
         results = [
             metric(*selected_args, **selected_kwargs)
             for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped)
         ]
         if any(r is None for r in results):
+            self._forward_cache = None
             return None
-        return jnp.stack([jnp.asarray(r) for r in results], axis=0)
+        self._forward_cache = jnp.stack([jnp.asarray(r) for r in results], axis=0)
+        return self._forward_cache
 
     def reset(self) -> None:
+        super().reset()
         for m in self.metrics:
             m.reset()
-        self._update_count = 0
-        self._computed = None

@@ -30,8 +30,11 @@ class MetricTracker:
         self._metrics: List[Union[Metric, MetricCollection]] = []
         if not isinstance(maximize, (bool, list)):
             raise ValueError("Argument `maximize` should either be a single bool or list of bool")
-        if isinstance(maximize, list) and isinstance(metric, MetricCollection) and len(maximize) != len(metric):
-            raise ValueError("The len of argument `maximize` should match the length of the metric collection")
+        if isinstance(maximize, list):
+            if not isinstance(metric, MetricCollection):
+                raise ValueError("Argument `maximize` can only be a list when `metric` is a MetricCollection")
+            if len(maximize) != len(metric):
+                raise ValueError("The len of argument `maximize` should match the length of the metric collection")
         self.maximize = maximize
         self._increment_called = False
 
@@ -97,7 +100,11 @@ class MetricTracker:
             return (idx, value) if return_step else value
         v = np.asarray(res)
         fn = np.nanargmax if self.maximize else np.nanargmin
-        best_i = int(fn(v))
+        try:
+            best_i = int(fn(v))
+        except ValueError:
+            rank_zero_warn("Encountered all-nan values; returning None")
+            return (None, None) if return_step else None
         return (best_i, float(v[best_i])) if return_step else float(v[best_i])
 
     def _check_for_increment(self, method: str) -> None:

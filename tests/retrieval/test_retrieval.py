@@ -182,3 +182,32 @@ def test_map_ddp_sync():
     result = m.compute_state(synced)
     expected = np.mean([sk_ap(_target[i], _preds[i]) for i in range(N_QUERIES)])
     np.testing.assert_allclose(np.asarray(result), expected, atol=1e-6)
+
+
+def test_pr_curve_adaptive_k_unequal_groups():
+    """Regression: adaptive_k with different docs-per-query pads curves to
+    max_k with saturated values (reference functional :83-86) instead of
+    producing unstackable ragged curves."""
+    r = RetrievalPrecisionRecallCurve(adaptive_k=True)
+    r.update(
+        jnp.asarray([0.4, 0.01, 0.5, 0.6, 0.2, 0.3, 0.5]),
+        jnp.asarray([1, 0, 0, 1, 1, 0, 1]),
+        indexes=jnp.asarray([0, 0, 0, 0, 1, 1, 1]),
+    )
+    p, rec, k = r.compute()
+    assert p.shape == (4,) and rec.shape == (4,)
+    np.testing.assert_allclose(np.asarray(p), [1.0, 0.5, 2 / 3, 0.583333], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(rec), [0.5, 0.5, 1.0, 1.0], atol=1e-5)
+
+
+def test_recall_at_fixed_precision_tie_breaks_to_larger_k():
+    """Regression: equal recalls at several k must report the LARGEST k
+    (reference max over (r, k) tuples, precision_recall_curve.py:43)."""
+    from metrics_tpu.retrieval.precision_recall_curve import _retrieval_recall_at_fixed_precision
+
+    precision = jnp.asarray([1.0, 1.0])
+    recall = jnp.asarray([1.0, 1.0])
+    top_k = jnp.asarray([1, 2])
+    max_recall, best_k = _retrieval_recall_at_fixed_precision(precision, recall, top_k, 0.5)
+    assert float(max_recall) == 1.0
+    assert int(best_k) == 2

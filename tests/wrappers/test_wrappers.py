@@ -117,3 +117,52 @@ def test_tracker_with_collection():
     assert "mse" in res
     best = tracker.best_metric()
     assert best["mse"] == pytest.approx(0.0)
+
+
+def test_minmax_forward_accumulates():
+    """Regression: forward() must not wipe child-metric state (deep snapshot)."""
+    mm = MinMaxMetric(MeanSquaredError())
+    t = jnp.zeros(4)
+    mm(t + 1.0, t)
+    mm(t + 0.0, t)
+    out = mm.compute()
+    assert float(out["raw"]) == pytest.approx(0.5, abs=1e-6)
+
+
+def test_bootstrapper_forward_accumulates():
+    """Regression: forward() must not wipe the bootstrap copies' state."""
+    bs = BootStrapper(MeanSquaredError(), num_bootstraps=8, seed=0)
+    t = jnp.zeros(16)
+    bs(t + 1.0, t)
+    bs(t + 0.0, t)
+    assert float(bs.compute()["mean"]) == pytest.approx(0.5, abs=0.25)
+
+
+def test_tracker_rejects_maximize_list_for_single_metric():
+    with pytest.raises(ValueError, match="MetricCollection"):
+        MetricTracker(MeanSquaredError(), maximize=[False])
+
+
+def test_classwise_forward_invalidates_cache():
+    """Regression: compute() after forward() must not return a stale cache."""
+    from metrics_tpu import Accuracy
+
+    m = ClasswiseWrapper(Accuracy(num_classes=3, average=None))
+    p1 = jnp.asarray([[0.8, 0.1, 0.1], [0.1, 0.8, 0.1]])
+    t1 = jnp.asarray([0, 1])
+    m.update(p1, t1)
+    first = m.compute()
+    m(jnp.asarray([[0.8, 0.1, 0.1]]), jnp.asarray([1]))  # forward: acc_1 drops
+    second = m.compute()
+    assert float(second["accuracy_1"]) == pytest.approx(0.5)
+    assert float(first["accuracy_1"]) == pytest.approx(1.0)
+
+
+def test_multioutput_forward_invalidates_cache():
+    m = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    p = jnp.asarray([[1.0, 2.0]])
+    t = jnp.asarray([[1.0, 2.0]])
+    m.update(p, t)
+    assert np.allclose(np.asarray(m.compute()), [0.0, 0.0])
+    m(p + 1.0, t)  # forward adds per-output squared error of 1.0
+    np.testing.assert_allclose(np.asarray(m.compute()), [0.5, 0.5], atol=1e-6)
