@@ -71,6 +71,21 @@ from metrics_tpu.regression import (  # noqa: F401
     TweedieDevianceScore,
     WeightedMeanAbsolutePercentageError,
 )
+from metrics_tpu.text import (  # noqa: F401
+    BERTScore,
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    ExtendedEditDistance,
+    MatchErrorRate,
+    ROUGEScore,
+    SacreBLEUScore,
+    SQuAD,
+    TranslationEditRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
 
 __all__ = [
     "__version__",
@@ -100,4 +115,9 @@ __all__ = [
     # wrappers
     "BootStrapper", "ClasswiseWrapper", "MetricTracker", "MinMaxMetric",
     "MultioutputWrapper",
+    # text
+    "BERTScore", "BLEUScore", "CharErrorRate", "CHRFScore",
+    "ExtendedEditDistance", "MatchErrorRate", "ROUGEScore", "SacreBLEUScore",
+    "SQuAD", "TranslationEditRate", "WordErrorRate", "WordInfoLost",
+    "WordInfoPreserved",
 ]

@@ -1,0 +1,16 @@
+"""Text functional metrics (reference parity: torchmetrics/functional/text/)."""
+from metrics_tpu.ops.text.bert import bert_score  # noqa: F401
+from metrics_tpu.ops.text.bleu import bleu_score  # noqa: F401
+from metrics_tpu.ops.text.chrf import chrf_score  # noqa: F401
+from metrics_tpu.ops.text.eed import extended_edit_distance  # noqa: F401
+from metrics_tpu.ops.text.error_rates import (  # noqa: F401
+    char_error_rate,
+    match_error_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+from metrics_tpu.ops.text.rouge import rouge_score  # noqa: F401
+from metrics_tpu.ops.text.sacre_bleu import sacre_bleu_score  # noqa: F401
+from metrics_tpu.ops.text.squad import squad  # noqa: F401
+from metrics_tpu.ops.text.ter import translation_edit_rate  # noqa: F401

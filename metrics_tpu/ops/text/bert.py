@@ -1,0 +1,273 @@
+"""BERTScore (Zhang et al. 2020): greedy cosine matching of contextual embeddings.
+
+Reference parity: torchmetrics/functional/text/bert.py — ``_preprocess_text``
+(:41), special-token masking (:87), ``_get_embeddings_and_idf_scale`` (:249),
+``_get_scaled_precision_or_recall`` (:329), ``_get_precision_recall_f1``
+(:338), baseline rescale (:420), ``bert_score`` (:438).
+
+TPU-first: the encoder forward and the whole matching pipeline (normalize →
+``bpd,brd->bpr`` cosine einsum → masked max → idf-weighted sum) run as one
+jitted XLA program per fixed (batch, seq-len) bucket; the host only tokenizes.
+Any Flax encoder can be plugged via ``model``/``user_forward_fn`` (mirroring
+the reference's ``tm_examples/bert_score-own_model.py`` hook).
+"""
+from __future__ import annotations
+
+import csv
+import math
+from collections import Counter, defaultdict
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
+from metrics_tpu.utils.prints import rank_zero_warn
+
+_DEFAULT_MODEL = "roberta-large"
+
+
+def _preprocess_text(
+    text: List[str],
+    tokenizer: Any,
+    max_length: int = 512,
+    truncation: bool = True,
+) -> Dict[str, np.ndarray]:
+    """Tokenize to fixed-width numpy ``input_ids``/``attention_mask``."""
+    try:
+        out = tokenizer(text, padding="max_length", max_length=max_length, truncation=truncation, return_tensors="np")
+        return {"input_ids": np.asarray(out["input_ids"]), "attention_mask": np.asarray(out["attention_mask"])}
+    except TypeError:
+        out = tokenizer(text)
+        input_ids = np.asarray(out["input_ids"])
+        attention_mask = np.asarray(out["attention_mask"])
+        if input_ids.shape[1] < max_length:
+            pad = max_length - input_ids.shape[1]
+            input_ids = np.pad(input_ids, ((0, 0), (0, pad)))
+            attention_mask = np.pad(attention_mask, ((0, 0), (0, pad)))
+        return {"input_ids": input_ids[:, :max_length], "attention_mask": attention_mask[:, :max_length]}
+
+
+def _get_tokens_idf(input_ids: np.ndarray, attention_mask: np.ndarray) -> Dict[int, float]:
+    """IDF over the reference corpus: log((N+1)/(df+1)); unseen -> log(N+1)."""
+    num_sentences = input_ids.shape[0]
+    counter: Counter = Counter()
+    for ids, mask in zip(input_ids, attention_mask):
+        counter.update(set(int(i) for i in ids[mask.astype(bool)]))
+    tokens_idf: Dict[int, float] = defaultdict(lambda: math.log((num_sentences + 1) / 1))
+    tokens_idf.update({idx: math.log((num_sentences + 1) / (occ + 1)) for idx, occ in counter.items()})
+    return tokens_idf
+
+
+def _process_attention_mask_for_special_tokens(attention_mask: Array) -> Array:
+    """Zero out [CLS] (first) and [SEP] (last attended) positions."""
+    attention_mask = attention_mask.at[:, 0].set(0)
+    sep_pos = jnp.argmax(jnp.cumsum(attention_mask - 0.1, axis=-1), axis=-1)
+    return attention_mask.at[jnp.arange(attention_mask.shape[0]), sep_pos].set(0)
+
+
+def _embed_and_scale(
+    model: Any,
+    input_ids: Array,
+    attention_mask: Array,
+    input_ids_idf: Optional[Array],
+    num_layers: Optional[int],
+    all_layers: bool,
+    user_forward_fn: Optional[Callable],
+) -> Tuple[Array, Array]:
+    """Normalized, special-token-masked embeddings + per-token idf scale.
+
+    Output embeddings: (B, L_layers, S, D); idf scale: (B, S) summing to 1.
+    """
+    if user_forward_fn is not None:
+        if all_layers:
+            raise ValueError("The option `all_layers=True` can be used only with default `transformers` models.")
+        out = user_forward_fn(model, {"input_ids": input_ids, "attention_mask": attention_mask})
+        out = jnp.asarray(out)[:, None]  # add layer dim
+    else:
+        outputs = model(input_ids=input_ids, attention_mask=attention_mask, output_hidden_states=True)
+        hidden = outputs.hidden_states
+        if all_layers:
+            out = jnp.stack([jnp.asarray(h) for h in hidden], axis=1)
+        else:
+            out = jnp.asarray(hidden[num_layers if num_layers is not None else -1])[:, None]
+
+    out = out / jnp.linalg.norm(out, axis=-1, keepdims=True)
+    processed_mask = _process_attention_mask_for_special_tokens(jnp.asarray(attention_mask))
+    out = jnp.einsum("blsd,bs->blsd", out, processed_mask.astype(out.dtype))
+
+    idf = input_ids_idf * processed_mask if input_ids_idf is not None else processed_mask.astype(out.dtype)
+    idf = idf / jnp.sum(idf, axis=-1, keepdims=True)
+    return out, idf
+
+
+@partial(jax.jit, static_argnames=())
+def _precision_recall_f1(
+    preds_embeddings: Array, target_embeddings: Array, preds_idf_scale: Array, target_idf_scale: Array
+) -> Tuple[Array, Array, Array]:
+    """Greedy-matching P/R/F1 (reference bert.py:338-362); shapes (L, B) squeezed."""
+    cos_sim = jnp.einsum("blpd,blrd->blpr", preds_embeddings, target_embeddings)
+    precision = jnp.einsum("bls,bs->bls", jnp.max(cos_sim, axis=3), preds_idf_scale).sum(-1)
+    recall = jnp.einsum("bls,bs->bls", jnp.max(cos_sim, axis=2), target_idf_scale).sum(-1)
+    f1 = 2 * precision * recall / (precision + recall)
+    f1 = jnp.where(jnp.isnan(f1), 0.0, f1)
+    return precision.T.squeeze(), recall.T.squeeze(), f1.T.squeeze()
+
+
+def _read_csv_baseline(baseline_path: str) -> Array:
+    with open(baseline_path) as fname:
+        rows = [[float(item) for item in row] for idx, row in enumerate(csv.reader(fname)) if idx > 0]
+    return jnp.asarray(rows)[:, 1:]
+
+
+def _load_baseline(
+    lang: str = "en",
+    model_name_or_path: Optional[str] = None,
+    baseline_path: Optional[str] = None,
+    baseline_url: Optional[str] = None,
+) -> Optional[Array]:
+    if baseline_path:
+        return _read_csv_baseline(baseline_path)
+    rank_zero_warn(
+        "Baseline was not successfully loaded (remote baselines are unavailable without network access). "
+        "No baseline is going to be used."
+    )
+    return None
+
+
+def _rescale_metrics_with_baseline(
+    precision: Array, recall: Array, f1: Array, baseline: Array, num_layers: Optional[int] = None, all_layers: bool = False
+) -> Tuple[Array, Array, Array]:
+    if num_layers is None and all_layers is False:
+        num_layers = -1
+    all_metrics = jnp.stack([precision, recall, f1], axis=-1)
+    baseline_scale = baseline[:, None] if all_layers else baseline[num_layers]
+    all_metrics = (all_metrics - baseline_scale) / (1 - baseline_scale)
+    return all_metrics[..., 0], all_metrics[..., 1], all_metrics[..., 2]
+
+
+def bert_score(
+    preds: Union[List[str], Dict[str, Any]],
+    target: Union[List[str], Dict[str, Any]],
+    model_name_or_path: Optional[str] = None,
+    num_layers: Optional[int] = None,
+    all_layers: bool = False,
+    model: Optional[Any] = None,
+    user_tokenizer: Any = None,
+    user_forward_fn: Optional[Callable] = None,
+    verbose: bool = False,
+    idf: bool = False,
+    device: Optional[Any] = None,
+    max_length: int = 512,
+    batch_size: int = 64,
+    num_threads: int = 0,
+    return_hash: bool = False,
+    lang: str = "en",
+    rescale_with_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+    baseline_url: Optional[str] = None,
+) -> Dict[str, Union[List[float], str]]:
+    """BERTScore precision/recall/f1 per sentence pair (reference: bert.py:438-573).
+
+    ``preds``/``target`` are lists of sentences, or pre-tokenized dicts with
+    ``input_ids``/``attention_mask`` (arrays). A Flax encoder is used on
+    device; pass ``model`` (+ ``user_tokenizer``/``user_forward_fn``) to
+    supply your own, as in the reference's own-model example.
+    """
+    if isinstance(preds, (list, tuple)) and isinstance(target, (list, tuple)) and len(preds) != len(target):
+        raise ValueError("Number of predicted and reference sententes must be the same!")
+
+    if model is None:
+        if not _TRANSFORMERS_AVAILABLE:
+            raise ModuleNotFoundError(
+                "`bert_score` metric with default models requires `transformers` package be installed."
+            )
+        if model_name_or_path is None:
+            rank_zero_warn(
+                "The argument `model_name_or_path` was not specified while it is required when the default"
+                " `transformers` model is used."
+                f" It will use the default recommended model - {_DEFAULT_MODEL!r}."
+            )
+        from transformers import AutoTokenizer, FlaxAutoModel
+
+        model_name_or_path = model_name_or_path or _DEFAULT_MODEL
+        tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
+        model = FlaxAutoModel.from_pretrained(model_name_or_path)
+    else:
+        tokenizer = user_tokenizer
+    _are_empty_lists = all(isinstance(text, list) and len(text) == 0 for text in (preds, target))
+    _are_valid_lists = all(
+        isinstance(text, list) and len(text) > 0 and isinstance(text[0], str) for text in (preds, target)
+    )
+    _are_valid_tensors = all(
+        isinstance(text, dict) and hasattr(text["input_ids"], "shape") for text in (preds, target)
+    )
+    if _are_empty_lists:
+        rank_zero_warn("Predictions and references are empty.")
+        output_dict: Dict[str, Union[List[float], str]] = {"precision": [0.0], "recall": [0.0], "f1": [0.0]}
+        if return_hash:
+            output_dict.update({"hash": _get_hash(model_name_or_path, num_layers, idf)})
+        return output_dict
+    if not (_are_valid_lists or _are_valid_tensors):
+        raise ValueError("Invalid input provided.")
+
+    if _are_valid_lists:
+        target_tok = _preprocess_text(list(target), tokenizer, max_length)
+        preds_tok = _preprocess_text(list(preds), tokenizer, max_length)
+    else:
+        target_tok = {k: np.asarray(v) for k, v in target.items()}  # type: ignore[union-attr]
+        preds_tok = {k: np.asarray(v) for k, v in preds.items()}  # type: ignore[union-attr]
+
+    tokens_idf = _get_tokens_idf(target_tok["input_ids"], target_tok["attention_mask"]) if idf else None
+
+    def idf_array(tok: Dict[str, np.ndarray]) -> Optional[Array]:
+        if tokens_idf is None:
+            return None
+        return jnp.asarray(np.vectorize(lambda i: tokens_idf[int(i)])(tok["input_ids"]).astype(np.float32))
+
+    def embed(tok: Dict[str, np.ndarray]) -> Tuple[Array, Array]:
+        embs, scales = [], []
+        n = tok["input_ids"].shape[0]
+        idf_full = idf_array(tok)
+        for start in range(0, n, batch_size):
+            sl = slice(start, min(start + batch_size, n))
+            e, s = _embed_and_scale(
+                model,
+                jnp.asarray(tok["input_ids"][sl]),
+                jnp.asarray(tok["attention_mask"][sl]),
+                idf_full[sl] if idf_full is not None else None,
+                num_layers,
+                all_layers,
+                user_forward_fn,
+            )
+            embs.append(e)
+            scales.append(s)
+        return jnp.concatenate(embs), jnp.concatenate(scales)
+
+    target_emb, target_idf_scale = embed(target_tok)
+    preds_emb, preds_idf_scale = embed(preds_tok)
+
+    precision, recall, f1 = _precision_recall_f1(preds_emb, target_emb, preds_idf_scale, target_idf_scale)
+
+    if rescale_with_baseline:
+        baseline = _load_baseline(lang, model_name_or_path, baseline_path, baseline_url)
+        if baseline is not None:
+            precision, recall, f1 = _rescale_metrics_with_baseline(
+                precision, recall, f1, baseline, num_layers, all_layers
+            )
+
+    output_dict = {
+        "precision": [float(x) for x in jnp.atleast_1d(precision)],
+        "recall": [float(x) for x in jnp.atleast_1d(recall)],
+        "f1": [float(x) for x in jnp.atleast_1d(f1)],
+    }
+    if return_hash:
+        output_dict.update({"hash": _get_hash(model_name_or_path, num_layers, idf)})
+    return output_dict
+
+
+def _get_hash(model_name_or_path: Optional[str] = None, num_layers: Optional[int] = None, idf: bool = False) -> str:
+    return f"{model_name_or_path}_L{num_layers}{'_idf' if idf else '_no-idf'}"

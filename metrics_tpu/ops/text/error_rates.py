@@ -1,0 +1,119 @@
+"""Edit-distance error rates: WER, CER, MER, WIL, WIP.
+
+Reference parity: torchmetrics/functional/text/{wer,cer,mer,wil,wip}.py —
+``_wer_update`` (wer.py:23)/``_wer_compute`` (wer.py:51), ``_cer_update``
+(cer.py:23), ``_mer_update`` (mer.py:23), ``_wil_update`` (wil.py:22),
+``_wip_update`` (wip.py:21).
+
+All five share one device-side batched Levenshtein kernel
+(:func:`metrics_tpu.ops.text.helper.batch_edit_distances`); states are scalar
+sums, so distributed sync is a single fused ``psum``.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.text.helper import batch_edit_distances
+
+_Corpus = Union[str, List[str]]
+
+
+def _as_list(x: _Corpus) -> List[str]:
+    return [x] if isinstance(x, str) else list(x)
+
+
+def _check_corpus_sizes(preds: List[str], target: List[str]) -> None:
+    if len(preds) != len(target):
+        raise ValueError(f"Corpus has different size {len(preds)} != {len(target)}")
+
+
+def _word_stats(preds: _Corpus, target: _Corpus) -> Tuple[Array, Array, Array, Array]:
+    """Per-corpus sums of (edit errors, target words, pred words, max-length totals)."""
+    preds, target = _as_list(preds), _as_list(target)
+    _check_corpus_sizes(preds, target)
+    pred_tokens = [p.split() for p in preds]
+    tgt_tokens = [t.split() for t in target]
+    errors = jnp.sum(batch_edit_distances(pred_tokens, tgt_tokens)).astype(jnp.float32)
+    tgt_total = jnp.asarray(float(sum(len(t) for t in tgt_tokens)))
+    pred_total = jnp.asarray(float(sum(len(p) for p in pred_tokens)))
+    max_total = jnp.asarray(float(sum(max(len(p), len(t)) for p, t in zip(pred_tokens, tgt_tokens))))
+    return errors, tgt_total, pred_total, max_total
+
+
+def _wer_update(preds: _Corpus, target: _Corpus) -> Tuple[Array, Array]:
+    errors, tgt_total, _, _ = _word_stats(preds, target)
+    return errors, tgt_total
+
+
+def _wer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def word_error_rate(preds: _Corpus, target: _Corpus) -> Array:
+    """WER = word edit distance / reference words (reference: wer.py:65-83)."""
+    return _wer_compute(*_wer_update(preds, target))
+
+
+def _cer_update(preds: _Corpus, target: _Corpus) -> Tuple[Array, Array]:
+    preds, target = _as_list(preds), _as_list(target)
+    _check_corpus_sizes(preds, target)
+    pred_chars = [list(p) for p in preds]
+    tgt_chars = [list(t) for t in target]
+    errors = jnp.sum(batch_edit_distances(pred_chars, tgt_chars)).astype(jnp.float32)
+    total = jnp.asarray(float(sum(len(t) for t in tgt_chars)))
+    return errors, total
+
+
+def _cer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def char_error_rate(preds: _Corpus, target: _Corpus) -> Array:
+    """CER = char edit distance / reference chars (reference: cer.py:66-84)."""
+    return _cer_compute(*_cer_update(preds, target))
+
+
+def _mer_update(preds: _Corpus, target: _Corpus) -> Tuple[Array, Array]:
+    errors, _, _, max_total = _word_stats(preds, target)
+    return errors, max_total
+
+
+def _mer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def match_error_rate(preds: _Corpus, target: _Corpus) -> Array:
+    """MER = edits / max(ref, pred) words (reference: mer.py:66-89)."""
+    return _mer_compute(*_mer_update(preds, target))
+
+
+def _wil_update(preds: _Corpus, target: _Corpus) -> Tuple[Array, Array, Array]:
+    errors, tgt_total, pred_total, max_total = _word_stats(preds, target)
+    return errors - max_total, tgt_total, pred_total
+
+
+def _wil_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    return 1 - ((errors / target_total) * (errors / preds_total))
+
+
+def word_information_lost(preds: _Corpus, target: _Corpus) -> Array:
+    """WIL = 1 - (H/N_ref)(H/N_hyp) with H = max-len total minus edits
+    (reference: wil.py:70-93)."""
+    return _wil_compute(*_wil_update(preds, target))
+
+
+def _wip_update(preds: _Corpus, target: _Corpus) -> Tuple[Array, Array, Array]:
+    errors, tgt_total, pred_total, max_total = _word_stats(preds, target)
+    return errors - max_total, tgt_total, pred_total
+
+
+def _wip_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    return (errors / target_total) * (errors / preds_total)
+
+
+def word_information_preserved(preds: _Corpus, target: _Corpus) -> Array:
+    """WIP = (H/N_ref)(H/N_hyp) (reference: wip.py:69-92)."""
+    return _wip_compute(*_wip_update(preds, target))

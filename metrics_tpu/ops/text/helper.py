@@ -1,0 +1,142 @@
+"""Shared text helpers: input validation, tokenization-to-ids, edit distance.
+
+Reference parity: torchmetrics/functional/text/helper.py — `_validate_inputs`
+(:298), `_edit_distance` (:333). The reference computes Levenshtein distance as
+a per-sentence-pair Python DP; here the hot path is a **batched jittable XLA
+kernel**: sentences are encoded to padded int32 id arrays on the host, and the
+whole batch of DP recurrences runs on device.
+
+TPU-first design note: the row recurrence
+``row[j] = min(prev[j]+1, prev[j-1]+cost_j, row[j-1]+1)`` has a sequential
+dependency on ``row[j-1]``, which would serialize the inner loop. Because the
+insertion cost is a constant (+1 per step), it factors into a min-plus prefix
+scan: with ``c_j = min(prev[j]+1, prev[j-1]+cost_j)`` (and ``c_0 = i``),
+
+    row[j] = min_{k<=j} (c_k + (j - k)) = j + cummin(c_k - k).
+
+``jnp.minimum.accumulate`` vectorizes that, so one `lax.scan` step per
+prediction token does O(R) vector work — MXU/VPU-friendly, no scalar loop.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Dict, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+_PAD = -1
+
+
+def _validate_text_inputs(
+    target: Union[Sequence[str], Sequence[Sequence[str]]],
+    preds: Union[str, Sequence[str]],
+) -> Tuple[Sequence[Sequence[str]], Sequence[str]]:
+    """Canonicalize (target, preds) corpora to (Sequence[Sequence[str]], Sequence[str]).
+
+    Reference: functional/text/helper.py:298-330.
+    """
+    if isinstance(preds, str):
+        preds = [preds]
+    if all(isinstance(ref, str) for ref in target):
+        target = [target] if len(preds) == 1 else [[ref] for ref in target]  # type: ignore[list-item]
+    if preds and all(ref for ref in target) and len(target) != len(preds):
+        raise ValueError(f"Corpus has different size {len(target)} != {len(preds)}")
+    return target, preds  # type: ignore[return-value]
+
+
+def _edit_distance_host(prediction_tokens: List[str], reference_tokens: List[str]) -> int:
+    """Plain host-side Levenshtein DP (reference helper.py:333-353); used for
+    tiny inputs and as the differential oracle for the device kernel."""
+    dp = list(range(len(reference_tokens) + 1))
+    for i in range(1, len(prediction_tokens) + 1):
+        prev_diag, dp[0] = dp[0], i
+        for j in range(1, len(reference_tokens) + 1):
+            cost = 0 if prediction_tokens[i - 1] == reference_tokens[j - 1] else 1
+            prev_diag, dp[j] = dp[j], min(dp[j] + 1, dp[j - 1] + 1, prev_diag + cost)
+    return dp[-1]
+
+
+@lru_cache(maxsize=64)
+def _compiled_edit_kernel(pred_width: int, ref_width: int):
+    """Jitted batched Levenshtein over padded id arrays, cached per pad shape."""
+
+    def _single(pred_ids: Array, pred_len: Array, ref_ids: Array, ref_len: Array) -> Array:
+        js = jnp.arange(ref_width + 1)
+        init_row = js.astype(jnp.int32)  # dp[0, j] = j
+
+        def step(prev_row, inputs):
+            i, p_tok = inputs
+            cost = jnp.where(p_tok == ref_ids, 0, 1)  # (R,)
+            cand = jnp.minimum(prev_row[1:] + 1, prev_row[:-1] + cost)
+            c = jnp.concatenate([i[None].astype(jnp.int32), cand])  # c_0 = i boundary
+            row = jnp.minimum.accumulate(c - js) + js  # min-plus prefix scan
+            return row, row
+
+        _, rows = jax.lax.scan(step, init_row, (jnp.arange(1, pred_width + 1), pred_ids))
+        full = jnp.concatenate([init_row[None], rows])  # (P+1, R+1)
+        return full[pred_len, ref_len]
+
+    return jax.jit(jax.vmap(_single))
+
+
+def edit_distance_batch(
+    pred_ids: Array, pred_lens: Array, ref_ids: Array, ref_lens: Array
+) -> Array:
+    """Batched Levenshtein distances for padded token-id arrays.
+
+    Args:
+        pred_ids: (B, P) int32, padded with any value beyond ``pred_lens``.
+        pred_lens: (B,) actual prediction lengths.
+        ref_ids: (B, R) int32 padded reference ids.
+        ref_lens: (B,) actual reference lengths.
+
+    Returns:
+        (B,) int32 edit distances ``dp[pred_len, ref_len]`` per pair.
+    """
+    kernel = _compiled_edit_kernel(int(pred_ids.shape[1]), int(ref_ids.shape[1]))
+    return kernel(pred_ids, pred_lens, ref_ids, ref_lens)
+
+
+def _round_up(n: int, multiple: int = 16) -> int:
+    return max(multiple, ((n + multiple - 1) // multiple) * multiple)
+
+
+def encode_token_batch(
+    preds_tokens: Sequence[Sequence[str]], target_tokens: Sequence[Sequence[str]]
+) -> Tuple[Array, Array, Array, Array]:
+    """Host-side: map tokens to dense int ids and pad to bucketed widths.
+
+    Padding ids differ between the two sides (-1 vs -2) so padded positions can
+    never produce spurious matches; widths are rounded up to multiples of 16 to
+    bound XLA recompilation across batches.
+    """
+    vocab: Dict[str, int] = {}
+
+    def ids(tokens: Sequence[str]) -> List[int]:
+        return [vocab.setdefault(t, len(vocab)) for t in tokens]
+
+    pred_id_lists = [ids(t) for t in preds_tokens]
+    ref_id_lists = [ids(t) for t in target_tokens]
+    p_width = _round_up(max((len(t) for t in pred_id_lists), default=0))
+    r_width = _round_up(max((len(t) for t in ref_id_lists), default=0))
+    pred_arr = np.full((len(pred_id_lists), p_width), _PAD, dtype=np.int32)
+    ref_arr = np.full((len(ref_id_lists), r_width), _PAD - 1, dtype=np.int32)
+    for i, t in enumerate(pred_id_lists):
+        pred_arr[i, : len(t)] = t
+    for i, t in enumerate(ref_id_lists):
+        ref_arr[i, : len(t)] = t
+    pred_lens = np.asarray([len(t) for t in pred_id_lists], dtype=np.int32)
+    ref_lens = np.asarray([len(t) for t in ref_id_lists], dtype=np.int32)
+    return jnp.asarray(pred_arr), jnp.asarray(pred_lens), jnp.asarray(ref_arr), jnp.asarray(ref_lens)
+
+
+def batch_edit_distances(
+    preds_tokens: Sequence[Sequence[str]], target_tokens: Sequence[Sequence[str]]
+) -> Array:
+    """Edit distance per (pred, target) token-list pair, computed on device."""
+    if not preds_tokens:
+        return jnp.zeros((0,), dtype=jnp.int32)
+    return edit_distance_batch(*encode_token_batch(preds_tokens, target_tokens))

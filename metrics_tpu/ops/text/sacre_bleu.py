@@ -1,0 +1,133 @@
+"""SacreBLEU: BLEU over standard mteval tokenizers.
+
+Reference parity: torchmetrics/functional/text/sacre_bleu.py —
+``_SacreBLEUTokenizer`` (:80) with tokenizers ``none``/``13a``/``zh``/
+``intl``/``char`` (:113-117), ``sacre_bleu_score`` (:280).
+
+The tokenizers implement the published mteval-v13a / v14-international specs
+(Post 2018, "A Call for Clarity in Reporting BLEU Scores"); unicode-property
+rules are expressed via :mod:`unicodedata` categories since the stdlib ``re``
+lacks ``\\p{...}`` classes.
+"""
+from __future__ import annotations
+
+import re
+import unicodedata
+from typing import ClassVar, Dict, Sequence
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.text.bleu import _bleu_score_compute, _bleu_score_update
+
+AVAILABLE_TOKENIZERS = ("none", "13a", "zh", "intl", "char")
+
+
+def _is_chinese_char(char: str) -> bool:
+    cp = ord(char)
+    return any(lo <= cp <= hi for lo, hi in (
+        (0x4E00, 0x9FFF), (0x3400, 0x4DBF), (0x20000, 0x2A6DF), (0x2A700, 0x2B73F),
+        (0x2B740, 0x2B81F), (0x2B820, 0x2CEAF), (0xF900, 0xFAFF), (0x2F800, 0x2FA1F),
+    ))
+
+
+class _SacreBLEUTokenizer:
+    """Line -> token list for each supported scheme (reference sacre_bleu.py:80-278)."""
+
+    _REGEX_13A = (
+        (re.compile(r"([\{-\~\[-\` -\&\(-\+\:-\@\/])"), r" \1 "),  # non .,- punctuation
+        (re.compile(r"([^0-9])([\.,])"), r"\1 \2 "),  # . , unless preceded by a digit
+        (re.compile(r"([\.,])([^0-9])"), r" \1 \2"),  # . , unless followed by a digit
+        (re.compile(r"([0-9])(-)"), r"\1 \2 "),  # dash preceded by a digit
+    )
+
+    _TOKENIZE_FN: ClassVar[Dict[str, str]] = {
+        "none": "_tokenize_base",
+        "13a": "_tokenize_13a",
+        "zh": "_tokenize_zh",
+        "intl": "_tokenize_international",
+        "char": "_tokenize_char",
+    }
+
+    def __init__(self, tokenize: str = "13a", lowercase: bool = False) -> None:
+        if tokenize not in self._TOKENIZE_FN:
+            raise ValueError(f"Unsupported tokenizer {tokenize!r}, expected one of {AVAILABLE_TOKENIZERS}")
+        self.tokenize_fn = getattr(self, self._TOKENIZE_FN[tokenize])
+        self.lowercase = lowercase
+
+    def __call__(self, line: str) -> Sequence[str]:
+        tokenized = self.tokenize_fn(line)
+        return (tokenized.lower() if self.lowercase else tokenized).split()
+
+    @classmethod
+    def _tokenize_regex(cls, line: str) -> str:
+        for pattern, replacement in cls._REGEX_13A:
+            line = pattern.sub(replacement, line)
+        return " ".join(line.split())
+
+    @classmethod
+    def _tokenize_base(cls, line: str) -> str:
+        return line
+
+    @classmethod
+    def _tokenize_13a(cls, line: str) -> str:
+        line = line.replace("<skipped>", "")
+        line = line.replace("-\n", "")
+        line = line.replace("\n", " ")
+        if "&" in line:
+            line = line.replace("&quot;", '"').replace("&amp;", "&").replace("&lt;", "<").replace("&gt;", ">")
+        return cls._tokenize_regex(f" {line} ")
+
+    @classmethod
+    def _tokenize_zh(cls, line: str) -> str:
+        line = line.strip()
+        out = []
+        for char in line:
+            if _is_chinese_char(char):
+                out.append(f" {char} ")
+            else:
+                out.append(char)
+        return cls._tokenize_regex("".join(out))
+
+    @classmethod
+    def _tokenize_international(cls, line: str) -> str:
+        # mteval-v14: split unicode punctuation unless adjacent to a digit; split symbols
+        out = []
+        for i, char in enumerate(line):
+            cat = unicodedata.category(char)
+            if cat.startswith("P"):
+                # split unless flanked by digits (matching the \P{N}\p{P} / \p{P}\P{N} rules)
+                prev_nondigit = i > 0 and not unicodedata.category(line[i - 1]).startswith("N")
+                next_nondigit = i + 1 < len(line) and not unicodedata.category(line[i + 1]).startswith("N")
+                if prev_nondigit or next_nondigit:
+                    out.append(f" {char} ")
+                    continue
+            if cat.startswith("S"):
+                out.append(f" {char} ")
+                continue
+            out.append(char)
+        return " ".join("".join(out).split())
+
+    @classmethod
+    def _tokenize_char(cls, line: str) -> str:
+        return " ".join(char for char in line)
+
+
+def sacre_bleu_score(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    tokenize: str = "13a",
+    lowercase: bool = False,
+) -> Array:
+    """SacreBLEU corpus score (reference: sacre_bleu.py:280-337)."""
+    if len(preds) != len(target):
+        raise ValueError(f"Corpus has different size {len(preds)} != {len(target)}")
+    tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)
+    numerator = jnp.zeros(n_gram)
+    denominator = jnp.zeros(n_gram)
+    numerator, denominator, preds_len, target_len = _bleu_score_update(
+        preds, target, numerator, denominator, 0.0, 0.0, n_gram, tokenizer
+    )
+    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, smooth)

@@ -1,0 +1,15 @@
+"""Text module metrics (reference parity: torchmetrics/text/)."""
+from metrics_tpu.text.bert import BERTScore  # noqa: F401
+from metrics_tpu.text.bleu import BLEUScore, SacreBLEUScore  # noqa: F401
+from metrics_tpu.text.chrf import CHRFScore  # noqa: F401
+from metrics_tpu.text.eed import ExtendedEditDistance  # noqa: F401
+from metrics_tpu.text.error_rates import (  # noqa: F401
+    CharErrorRate,
+    MatchErrorRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+from metrics_tpu.text.rouge import ROUGEScore  # noqa: F401
+from metrics_tpu.text.squad import SQuAD  # noqa: F401
+from metrics_tpu.text.ter import TranslationEditRate  # noqa: F401

@@ -1,0 +1,128 @@
+"""BERTScore module.
+
+Reference parity: torchmetrics/text/bert.py:41 — tokenized
+``input_ids``/``attention_mask`` list states (:170-173); compute runs the
+encoder + greedy matching (here: jitted Flax forward, ops/text/bert.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.text.bert import _DEFAULT_MODEL, _preprocess_text, bert_score
+from metrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class BERTScore(Metric):
+    """BERTScore. Reference: text/bert.py:41-225.
+
+    Pass ``model``/``user_tokenizer``/``user_forward_fn`` to use your own Flax
+    encoder (the reference's own-model example, tm_examples/bert_score-own_model.py).
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        num_layers: Optional[int] = None,
+        all_layers: bool = False,
+        model: Optional[Any] = None,
+        user_tokenizer: Optional[Any] = None,
+        user_forward_fn: Optional[Callable] = None,
+        verbose: bool = False,
+        idf: bool = False,
+        max_length: int = 512,
+        batch_size: int = 64,
+        num_threads: int = 0,
+        return_hash: bool = False,
+        lang: str = "en",
+        rescale_with_baseline: bool = False,
+        baseline_path: Optional[str] = None,
+        baseline_url: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path
+        self.num_layers = num_layers
+        self.all_layers = all_layers
+        self.model = model
+        self.user_forward_fn = user_forward_fn
+        self.verbose = verbose
+        self.idf = idf
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.num_threads = num_threads
+        self.return_hash = return_hash
+        self.lang = lang
+        self.rescale_with_baseline = rescale_with_baseline
+        self.baseline_path = baseline_path
+        self.baseline_url = baseline_url
+
+        if model is None:
+            if not _TRANSFORMERS_AVAILABLE:
+                raise ModuleNotFoundError(
+                    "`BERTScore` metric with default models requires `transformers` package be installed."
+                )
+            if model_name_or_path is None:
+                rank_zero_warn(
+                    "The argument `model_name_or_path` was not specified while it is required when default"
+                    " `transformers` model are used."
+                    f" It will use the default recommended model - {_DEFAULT_MODEL!r}."
+                )
+            from transformers import AutoTokenizer, FlaxAutoModel
+
+            self.model_name_or_path = model_name_or_path or _DEFAULT_MODEL
+            self.tokenizer = AutoTokenizer.from_pretrained(self.model_name_or_path)
+            # load once here so repeated compute() calls don't re-read the weights
+            self.model = FlaxAutoModel.from_pretrained(self.model_name_or_path)
+        else:
+            self.tokenizer = user_tokenizer
+
+        self.add_state("preds_input_ids", default=[], dist_reduce_fx="cat")
+        self.add_state("preds_attention_mask", default=[], dist_reduce_fx="cat")
+        self.add_state("target_input_ids", default=[], dist_reduce_fx="cat")
+        self.add_state("target_attention_mask", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: List[str], target: List[str]) -> None:  # type: ignore[override]
+        preds_dict = _preprocess_text(list(preds), self.tokenizer, self.max_length)
+        target_dict = _preprocess_text(list(target), self.tokenizer, self.max_length)
+        self.preds_input_ids = self.preds_input_ids + [jnp.asarray(preds_dict["input_ids"])]
+        self.preds_attention_mask = self.preds_attention_mask + [jnp.asarray(preds_dict["attention_mask"])]
+        self.target_input_ids = self.target_input_ids + [jnp.asarray(target_dict["input_ids"])]
+        self.target_attention_mask = self.target_attention_mask + [jnp.asarray(target_dict["attention_mask"])]
+
+    def compute(self) -> Dict[str, Union[List[float], str]]:
+        preds = {
+            "input_ids": np.concatenate([np.asarray(x) for x in self.preds_input_ids]),
+            "attention_mask": np.concatenate([np.asarray(x) for x in self.preds_attention_mask]),
+        }
+        target = {
+            "input_ids": np.concatenate([np.asarray(x) for x in self.target_input_ids]),
+            "attention_mask": np.concatenate([np.asarray(x) for x in self.target_attention_mask]),
+        }
+        return bert_score(
+            preds=preds,
+            target=target,
+            model_name_or_path=self.model_name_or_path,
+            num_layers=self.num_layers,
+            all_layers=self.all_layers,
+            model=self.model,
+            user_tokenizer=self.tokenizer if self.model is not None else None,
+            user_forward_fn=self.user_forward_fn,
+            verbose=self.verbose,
+            idf=self.idf,
+            max_length=self.max_length,
+            batch_size=self.batch_size,
+            return_hash=self.return_hash,
+            lang=self.lang,
+            rescale_with_baseline=self.rescale_with_baseline,
+            baseline_path=self.baseline_path,
+            baseline_url=self.baseline_url,
+        )

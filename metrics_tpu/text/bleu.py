@@ -1,0 +1,65 @@
+"""BLEUScore / SacreBLEUScore modules.
+
+Reference parity: torchmetrics/text/bleu.py:28, torchmetrics/text/sacre_bleu.py:32.
+State = two (n_gram,) count vectors + two length scalars, all ``psum``.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.text.bleu import _bleu_score_compute, _bleu_score_update, _tokenize_fn
+from metrics_tpu.ops.text.sacre_bleu import AVAILABLE_TOKENIZERS, _SacreBLEUTokenizer
+
+
+class BLEUScore(Metric):
+    """Corpus BLEU. Reference: text/bleu.py:28-119."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+
+    def __init__(self, n_gram: int = 4, smooth: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.n_gram = n_gram
+        self.smooth = smooth
+        self.tokenizer = _tokenize_fn
+        self.add_state("preds_len", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_len", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("numerator", default=jnp.zeros(n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", default=jnp.zeros(n_gram), dist_reduce_fx="sum")
+
+    def update(self, preds: Sequence[str], target: Sequence[Sequence[str]]) -> None:  # type: ignore[override]
+        preds = [preds] if isinstance(preds, str) else preds
+        target = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+        if len(preds) != len(target):
+            raise ValueError(f"Corpus has different size {len(preds)} != {len(target)}")
+        self.numerator, self.denominator, self.preds_len, self.target_len = _bleu_score_update(
+            preds, target, self.numerator, self.denominator, self.preds_len, self.target_len,
+            self.n_gram, self.tokenizer,
+        )
+
+    def compute(self) -> Array:
+        return _bleu_score_compute(
+            self.preds_len, self.target_len, self.numerator, self.denominator, self.n_gram, self.smooth
+        )
+
+
+class SacreBLEUScore(BLEUScore):
+    """Corpus BLEU with mteval tokenizers. Reference: text/sacre_bleu.py:32-112."""
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        tokenize: str = "13a",
+        lowercase: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(n_gram=n_gram, smooth=smooth, **kwargs)
+        if tokenize not in AVAILABLE_TOKENIZERS:
+            raise ValueError(f"Argument `tokenize` expected to be one of {AVAILABLE_TOKENIZERS} but got {tokenize}.")
+        self.tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)

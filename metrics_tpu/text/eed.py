@@ -1,0 +1,57 @@
+"""ExtendedEditDistance module.
+
+Reference parity: torchmetrics/text/eed.py:24 — per-sentence score list state
+(``cat`` reduce), compute = mean.
+"""
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.text.eed import _eed_compute, _eed_update
+
+
+class ExtendedEditDistance(Metric):
+    """EED. Reference: text/eed.py:24-106."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        language: str = "en",
+        return_sentence_level_score: bool = False,
+        alpha: float = 2.0,
+        rho: float = 0.3,
+        deletion: float = 0.2,
+        insertion: float = 1.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if language not in ("en", "ja"):
+            raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
+        self.language = language
+        self.return_sentence_level_score = return_sentence_level_score
+        for name, val in (("alpha", alpha), ("rho", rho), ("deletion", deletion), ("insertion", insertion)):
+            if not isinstance(val, float) or val < 0:
+                raise ValueError(f"Expected argument `{name}` to be a non-negative float")
+        self.alpha = alpha
+        self.rho = rho
+        self.deletion = deletion
+        self.insertion = insertion
+        self.add_state("sentence_eed", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]) -> None:  # type: ignore[override]
+        self.sentence_eed = _eed_update(
+            preds, target, self.language, self.alpha, self.rho, self.deletion, self.insertion, self.sentence_eed
+        )
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        average = _eed_compute(self.sentence_eed)
+        if self.return_sentence_level_score:
+            return average, jnp.stack(self.sentence_eed) if self.sentence_eed else jnp.zeros(0)
+        return average

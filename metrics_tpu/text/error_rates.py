@@ -1,0 +1,137 @@
+"""Error-rate text modules: WordErrorRate, CharErrorRate, MatchErrorRate,
+WordInfoLost, WordInfoPreserved.
+
+Reference parity: torchmetrics/text/{wer.py:23, cer.py:24, mer.py:24,
+wil.py:23, wip.py:23}. All states are psum-able scalars.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.text.error_rates import (
+    _cer_compute,
+    _cer_update,
+    _mer_compute,
+    _mer_update,
+    _wer_compute,
+    _wer_update,
+    _wil_compute,
+    _wil_update,
+    _wip_compute,
+    _wip_update,
+)
+
+_Corpus = Union[str, List[str]]
+
+
+class WordErrorRate(Metric):
+    """Word error rate. Reference: text/wer.py:23-95."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: _Corpus, target: _Corpus) -> None:  # type: ignore[override]
+        errors, total = _wer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _wer_compute(self.errors, self.total)
+
+
+class CharErrorRate(Metric):
+    """Character error rate. Reference: text/cer.py:24-97."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: _Corpus, target: _Corpus) -> None:  # type: ignore[override]
+        errors, total = _cer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _cer_compute(self.errors, self.total)
+
+
+class MatchErrorRate(Metric):
+    """Match error rate. Reference: text/mer.py:24-94."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: _Corpus, target: _Corpus) -> None:  # type: ignore[override]
+        errors, total = _mer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _mer_compute(self.errors, self.total)
+
+
+class WordInfoLost(Metric):
+    """Word information lost. Reference: text/wil.py:23-95."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("preds_total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: _Corpus, target: _Corpus) -> None:  # type: ignore[override]
+        errors, target_total, preds_total = _wil_update(preds, target)
+        self.errors = self.errors + errors
+        self.target_total = self.target_total + target_total
+        self.preds_total = self.preds_total + preds_total
+
+    def compute(self) -> Array:
+        return _wil_compute(self.errors, self.target_total, self.preds_total)
+
+
+class WordInfoPreserved(Metric):
+    """Word information preserved. Reference: text/wip.py:23-95."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("preds_total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: _Corpus, target: _Corpus) -> None:  # type: ignore[override]
+        errors, target_total, preds_total = _wip_update(preds, target)
+        self.errors = self.errors + errors
+        self.target_total = self.target_total + target_total
+        self.preds_total = self.preds_total + preds_total
+
+    def compute(self) -> Array:
+        return _wip_compute(self.errors, self.target_total, self.preds_total)

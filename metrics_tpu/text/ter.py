@@ -1,0 +1,56 @@
+"""TranslationEditRate module.
+
+Reference parity: torchmetrics/text/ter.py:24 — scalar (edits, length) states.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.text.ter import _TercomTokenizer, _ter_compute, _ter_update
+
+
+class TranslationEditRate(Metric):
+    """TER. Reference: text/ter.py:24-119."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        for name, val in (("normalize", normalize), ("no_punctuation", no_punctuation),
+                          ("lowercase", lowercase), ("asian_support", asian_support)):
+            if not isinstance(val, bool):
+                raise ValueError(f"Expected argument `{name}` to be of type boolean")
+        self.tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+        self.return_sentence_level_score = return_sentence_level_score
+        self.add_state("total_num_edits", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_tgt_length", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        if self.return_sentence_level_score:
+            self.add_state("sentence_ter", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]) -> None:  # type: ignore[override]
+        sentence_ter: Optional[List[Array]] = [] if self.return_sentence_level_score else None
+        self.total_num_edits, self.total_tgt_length, sentence_ter = _ter_update(
+            preds, target, self.tokenizer, self.total_num_edits, self.total_tgt_length, sentence_ter
+        )
+        if sentence_ter is not None:
+            self.sentence_ter = self.sentence_ter + sentence_ter
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        score = _ter_compute(self.total_num_edits, self.total_tgt_length)
+        if self.return_sentence_level_score:
+            return score, jnp.stack(self.sentence_ter) if self.sentence_ter else jnp.zeros(0)
+        return score

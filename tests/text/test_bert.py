@@ -1,0 +1,122 @@
+"""BERTScore tests with a deterministic toy encoder (no network access),
+mirroring the reference's own-model example
+(tm_examples/bert_score-own_model.py): user tokenizer + user_forward_fn.
+
+Oracle: a plain numpy implementation of greedy cosine matching.
+"""
+import math
+
+import numpy as np
+import pytest
+
+from metrics_tpu import BERTScore
+from metrics_tpu.ops.text import bert_score
+
+VOCAB = ["[CLS]", "[SEP]", "[PAD]", "hello", "there", "general", "kenobi", "master", "world", "hi"]
+DIM = 16
+MAX_LEN = 8
+
+_rng = np.random.RandomState(0)
+EMBED_TABLE = _rng.randn(len(VOCAB), DIM).astype(np.float32)
+
+
+class ToyTokenizer:
+    def __call__(self, sentences):
+        ids = np.full((len(sentences), MAX_LEN), VOCAB.index("[PAD]"), dtype=np.int32)
+        mask = np.zeros((len(sentences), MAX_LEN), dtype=np.int32)
+        for row, sent in enumerate(sentences):
+            tokens = ["[CLS]"] + sent.split()[: MAX_LEN - 2] + ["[SEP]"]
+            for col, tok in enumerate(tokens):
+                ids[row, col] = VOCAB.index(tok)
+                mask[row, col] = 1
+        return {"input_ids": ids, "attention_mask": mask}
+
+
+def toy_forward_fn(model, batch):
+    return EMBED_TABLE[np.asarray(batch["input_ids"])]
+
+
+def _oracle_bertscore(preds, target, idf=False):
+    tok = ToyTokenizer()
+    p = tok(preds)
+    t = tok(target)
+
+    def sent_embs(ids, mask):
+        out = []
+        for row_ids, row_mask in zip(ids, mask):
+            seq_len = int(row_mask.sum())
+            content = row_ids[1 : seq_len - 1]  # drop CLS/SEP
+            e = EMBED_TABLE[content]
+            e = e / np.linalg.norm(e, axis=-1, keepdims=True)
+            out.append((content, e))
+        return out
+
+    p_embs = sent_embs(p["input_ids"], p["attention_mask"])
+    t_embs = sent_embs(t["input_ids"], t["attention_mask"])
+
+    if idf:
+        n = len(target)
+        df = {}
+        for row_ids, row_mask in zip(t["input_ids"], t["attention_mask"]):
+            for i in set(row_ids[row_mask.astype(bool)].tolist()):
+                df[i] = df.get(i, 0) + 1
+        idf_map = lambda i: math.log((n + 1) / (df.get(i, 0) + 1))
+    else:
+        idf_map = lambda i: 1.0
+
+    precisions, recalls, f1s = [], [], []
+    for (p_ids, pe), (t_ids, te) in zip(p_embs, t_embs):
+        sim = pe @ te.T
+        pw = np.array([idf_map(i) for i in p_ids])
+        tw = np.array([idf_map(i) for i in t_ids])
+        prec = float((sim.max(axis=1) * (pw / pw.sum())).sum())
+        rec = float((sim.max(axis=0) * (tw / tw.sum())).sum())
+        f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+        precisions.append(prec)
+        recalls.append(rec)
+        f1s.append(f1)
+    return {"precision": precisions, "recall": recalls, "f1": f1s}
+
+
+PREDS = ["hello there", "master kenobi", "hello world"]
+TARGET = ["hello there", "general kenobi", "hi world"]
+
+
+@pytest.mark.parametrize("idf", [False, True])
+def test_bert_score_vs_numpy_oracle(idf):
+    got = bert_score(
+        PREDS, TARGET, model="toy", user_tokenizer=ToyTokenizer(), user_forward_fn=toy_forward_fn, idf=idf
+    )
+    want = _oracle_bertscore(PREDS, TARGET, idf=idf)
+    for key in ("precision", "recall", "f1"):
+        np.testing.assert_allclose(got[key], want[key], atol=1e-5, err_msg=key)
+
+
+def test_exact_match_scores_one():
+    got = bert_score(
+        ["hello there"], ["hello there"], model="toy", user_tokenizer=ToyTokenizer(), user_forward_fn=toy_forward_fn
+    )
+    np.testing.assert_allclose(got["f1"], [1.0], atol=1e-5)
+
+
+def test_module_accumulates_batches():
+    metric = BERTScore(model="toy", user_tokenizer=ToyTokenizer(), user_forward_fn=toy_forward_fn, max_length=MAX_LEN)
+    metric.update(PREDS[:2], TARGET[:2])
+    metric.update(PREDS[2:], TARGET[2:])
+    got = metric.compute()
+    want = _oracle_bertscore(PREDS, TARGET)
+    for key in ("precision", "recall", "f1"):
+        np.testing.assert_allclose(got[key], want[key], atol=1e-5, err_msg=key)
+
+
+def test_return_hash():
+    got = bert_score(
+        ["hello there"], ["hello there"], model="toy", user_tokenizer=ToyTokenizer(),
+        user_forward_fn=toy_forward_fn, return_hash=True,
+    )
+    assert "hash" in got
+
+
+def test_mismatched_lengths_raise():
+    with pytest.raises(ValueError):
+        bert_score(["a", "b"], ["a"], model="toy", user_tokenizer=ToyTokenizer(), user_forward_fn=toy_forward_fn)

@@ -1,0 +1,156 @@
+"""BLEU / SacreBLEU / chrF / TER parity against nltk and sacrebleu oracles."""
+import numpy as np
+import pytest
+from nltk.translate.bleu_score import SmoothingFunction, corpus_bleu as nltk_corpus_bleu
+from sacrebleu.metrics import CHRF as SacreCHRF, TER as SacreTER, BLEU as SacreBLEU
+
+from metrics_tpu import BLEUScore, CHRFScore, SacreBLEUScore, TranslationEditRate
+from metrics_tpu.ops.text import bleu_score, chrf_score, sacre_bleu_score, translation_edit_rate
+
+# corpus of (preds, list-of-reference-lists)
+PREDS = [
+    "the cat is on the mat",
+    "there is a big tree near the house",
+    "hello there general kenobi",
+    "it is a guide to action which ensures that the military always obeys the commands of the party",
+    "the dog, which was lazy, slept all day; the cat did not.",
+]
+TARGETS = [
+    ["there is a cat on the mat", "a cat is on the mat"],
+    ["a big tree is near the house", "there is a tall tree by the house"],
+    ["hello there general kenobi", "hi there master kenobi"],
+    [
+        "it is a guide to action that ensures that the military will forever heed party commands",
+        "it is the guiding principle which guarantees the military forces always being under the command of the party",
+    ],
+    ["the lazy dog slept all day, but the cat did not.", "the dog, being lazy, slept; the cat didn't."],
+]
+
+
+class TestBLEU:
+    def test_vs_nltk(self):
+        for n_gram in (2, 4):
+            weights = tuple(1.0 / n_gram for _ in range(n_gram))
+            want = nltk_corpus_bleu(
+                [[t.split() for t in refs] for refs in TARGETS],
+                [p.split() for p in PREDS],
+                weights=weights,
+            )
+            got = float(bleu_score(PREDS, TARGETS, n_gram=n_gram))
+            np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_smooth_vs_nltk(self):
+        # smooth=True matches nltk smoothing method2 (add-1 for n>1)
+        want = nltk_corpus_bleu(
+            [[t.split() for t in refs] for refs in TARGETS],
+            [p.split() for p in PREDS],
+            smoothing_function=SmoothingFunction().method2,
+        )
+        got = float(bleu_score(PREDS, TARGETS, smooth=True))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_golden(self):
+        got = float(bleu_score(["the cat is on the mat"], [["there is a cat on the mat", "a cat is on the mat"]]))
+        np.testing.assert_allclose(got, 0.7598, atol=1e-4)
+
+    def test_module_accumulation(self):
+        metric = BLEUScore()
+        metric.update(PREDS[:2], TARGETS[:2])
+        metric.update(PREDS[2:], TARGETS[2:])
+        np.testing.assert_allclose(float(metric.compute()), float(bleu_score(PREDS, TARGETS)), atol=1e-6)
+
+    def test_empty_ngram_returns_zero(self):
+        assert float(bleu_score(["xyz"], [["abc"]])) == 0.0
+
+
+class TestSacreBLEU:
+    @pytest.mark.parametrize("tokenize", ["none", "13a", "char", "intl"])
+    @pytest.mark.parametrize("lowercase", [False, True])
+    def test_vs_sacrebleu(self, tokenize, lowercase):
+        sb = SacreBLEU(tokenize=tokenize, lowercase=lowercase)
+        # sacrebleu wants refs transposed: one list per reference position
+        max_refs = max(len(r) for r in TARGETS)
+        refs_t = [[refs[i] if i < len(refs) else refs[0] for refs in TARGETS] for i in range(max_refs)]
+        want = sb.corpus_score(PREDS, refs_t).score / 100.0
+        padded_targets = [refs + [refs[0]] * (max_refs - len(refs)) for refs in TARGETS]
+        got = float(sacre_bleu_score(PREDS, padded_targets, tokenize=tokenize, lowercase=lowercase))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_module(self):
+        metric = SacreBLEUScore()
+        metric.update(PREDS, TARGETS)
+        np.testing.assert_allclose(float(metric.compute()), float(sacre_bleu_score(PREDS, TARGETS)), atol=1e-6)
+
+
+class TestCHRF:
+    @pytest.mark.parametrize("n_word_order", [0, 2])
+    def test_vs_sacrebleu_single_ref(self, n_word_order):
+        # we implement the eps-smoothing chrF variant (like the reference)
+        single_refs = [[refs[0]] for refs in TARGETS]
+        sb = SacreCHRF(word_order=n_word_order, eps_smoothing=True)
+        want = sb.corpus_score(PREDS, [[r[0] for r in single_refs]]).score / 100.0
+        got = float(chrf_score(PREDS, single_refs, n_word_order=n_word_order))
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_golden_multi_ref(self):
+        got = float(chrf_score(["the cat is on the mat"], [["there is a cat on the mat", "a cat is on the mat"]]))
+        np.testing.assert_allclose(got, 0.8640, atol=1e-3)
+
+    def test_module_accumulation(self):
+        metric = CHRFScore()
+        metric.update(PREDS[:2], TARGETS[:2])
+        metric.update(PREDS[2:], TARGETS[2:])
+        np.testing.assert_allclose(float(metric.compute()), float(chrf_score(PREDS, TARGETS)), atol=1e-6)
+
+    def test_sentence_level_scores(self):
+        score, sentence_scores = chrf_score(PREDS, TARGETS, return_sentence_level_score=True)
+        assert sentence_scores.shape == (len(PREDS),)
+        assert float(sentence_scores[2]) > 0.9  # near-exact match sentence
+
+    def test_arg_validation(self):
+        with pytest.raises(ValueError):
+            chrf_score(PREDS, TARGETS, n_char_order=0)
+        with pytest.raises(ValueError):
+            chrf_score(PREDS, TARGETS, n_word_order=-1)
+        with pytest.raises(ValueError):
+            chrf_score(PREDS, TARGETS, beta=-1.0)
+
+
+class TestTER:
+    @pytest.mark.parametrize("normalize", [False, True])
+    @pytest.mark.parametrize("lowercase", [False, True])
+    def test_vs_sacrebleu_single_ref(self, normalize, lowercase):
+        sb = SacreTER(normalized=normalize, case_sensitive=not lowercase)
+        want = sb.corpus_score(PREDS, [[refs[0] for refs in TARGETS]]).score / 100.0
+        got = float(
+            translation_edit_rate(PREDS, [[refs[0]] for refs in TARGETS], normalize=normalize, lowercase=lowercase)
+        )
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_vs_sacrebleu_multi_ref(self):
+        sb = SacreTER()
+        max_refs = max(len(r) for r in TARGETS)
+        refs_t = [[refs[i] if i < len(refs) else refs[0] for refs in TARGETS] for i in range(max_refs)]
+        want = sb.corpus_score(PREDS, refs_t).score / 100.0
+        padded_targets = [refs + [refs[0]] * (max_refs - len(refs)) for refs in TARGETS]
+        got = float(translation_edit_rate(PREDS, padded_targets))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_golden(self):
+        got = float(
+            translation_edit_rate(["the cat is on the mat"], [["there is a cat on the mat", "a cat is on the mat"]])
+        )
+        np.testing.assert_allclose(got, 0.1538, atol=1e-4)
+
+    def test_module_accumulation(self):
+        metric = TranslationEditRate()
+        metric.update(PREDS[:2], TARGETS[:2])
+        metric.update(PREDS[2:], TARGETS[2:])
+        np.testing.assert_allclose(
+            float(metric.compute()), float(translation_edit_rate(PREDS, TARGETS)), atol=1e-6
+        )
+
+    def test_shifts_reduce_edits(self):
+        # a pure transposition should cost 1 shift, not multiple substitutions
+        got = float(translation_edit_rate(["b c d e a"], [["a b c d e"]]))
+        np.testing.assert_allclose(got, 1 / 5, atol=1e-6)

@@ -1,0 +1,157 @@
+"""ROUGE (oracle: google rouge_score), SQuAD, and EED parity tests."""
+import numpy as np
+import pytest
+from rouge_score.rouge_scorer import RougeScorer
+from rouge_score.scoring import BootstrapAggregator
+
+from metrics_tpu import ExtendedEditDistance, ROUGEScore, SQuAD
+from metrics_tpu.ops.text import extended_edit_distance, rouge_score as tm_rouge_score, squad
+
+PREDS = [
+    "the cat was found under the bed",
+    "my life is a drama",
+    "the quick brown fox jumps over the lazy dog",
+]
+TARGETS = [
+    "the cat was under the bed",
+    "my life is a mess and a drama",
+    "a quick brown fox jumped over lazy dogs",
+]
+
+ROUGE_KEYS = ("rouge1", "rouge2", "rougeL", "rougeLsum")
+
+
+def _oracle_rouge(preds, targets, use_stemmer=False):
+    scorer = RougeScorer(list(ROUGE_KEYS), use_stemmer=use_stemmer)
+    aggregator = BootstrapAggregator()
+    for p, t in zip(preds, targets):
+        aggregator.add_scores(scorer.score(t, p))
+    # mid of bootstrap == mean only approximately; compute plain means instead
+    out = {}
+    per_sentence = [scorer.score(t, p) for p, t in zip(preds, targets)]
+    for key in ROUGE_KEYS:
+        out[f"{key}_precision"] = np.mean([s[key].precision for s in per_sentence])
+        out[f"{key}_recall"] = np.mean([s[key].recall for s in per_sentence])
+        out[f"{key}_fmeasure"] = np.mean([s[key].fmeasure for s in per_sentence])
+    return out
+
+
+class TestROUGE:
+    @pytest.mark.parametrize("use_stemmer", [False, True])
+    def test_vs_rouge_score(self, use_stemmer):
+        want = _oracle_rouge(PREDS, TARGETS, use_stemmer=use_stemmer)
+        got = tm_rouge_score(PREDS, TARGETS, use_stemmer=use_stemmer, rouge_keys=ROUGE_KEYS)
+        for key, val in want.items():
+            np.testing.assert_allclose(float(got[key]), val, atol=1e-6, err_msg=key)
+
+    def test_module_accumulation(self):
+        metric = ROUGEScore(rouge_keys=ROUGE_KEYS)
+        metric.update(PREDS[:2], TARGETS[:2])
+        metric.update(PREDS[2:], TARGETS[2:])
+        got = metric.compute()
+        want = tm_rouge_score(PREDS, TARGETS, rouge_keys=ROUGE_KEYS)
+        for key in want:
+            np.testing.assert_allclose(float(got[key]), float(want[key]), atol=1e-6)
+
+    def test_multi_reference_best(self):
+        got = tm_rouge_score(
+            ["the cat is on the mat"],
+            [["a cat sat on a mat", "the cat is on the mat"]],
+            accumulate="best",
+            rouge_keys="rouge1",
+        )
+        np.testing.assert_allclose(float(got["rouge1_fmeasure"]), 1.0, atol=1e-6)
+
+    def test_multi_reference_avg(self):
+        got = tm_rouge_score(
+            ["the cat is on the mat"],
+            [["the cat is on the mat", "the cat is on the mat"]],
+            accumulate="avg",
+            rouge_keys="rouge1",
+        )
+        np.testing.assert_allclose(float(got["rouge1_fmeasure"]), 1.0, atol=1e-6)
+
+    def test_invalid_key_raises(self):
+        with pytest.raises(ValueError):
+            tm_rouge_score(PREDS, TARGETS, rouge_keys="rouge42")
+
+    def test_pickle_roundtrip_with_stemmer(self):
+        import pickle
+
+        metric = ROUGEScore(use_stemmer=True, rouge_keys="rouge1")
+        metric.update(PREDS, TARGETS)
+        metric2 = pickle.loads(pickle.dumps(metric))
+        got, want = metric2.compute(), metric.compute()
+        np.testing.assert_allclose(float(got["rouge1_fmeasure"]), float(want["rouge1_fmeasure"]))
+
+
+class TestSQuAD:
+    def test_perfect(self):
+        preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
+        target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
+        got = squad(preds, target)
+        np.testing.assert_allclose(float(got["exact_match"]), 100.0)
+        np.testing.assert_allclose(float(got["f1"]), 100.0)
+
+    def test_partial_f1(self):
+        preds = {"prediction_text": "big red cat", "id": "1"}
+        target = {"answers": {"answer_start": [0], "text": ["big cat"]}, "id": "1"}
+        got = squad(preds, target)
+        assert float(got["exact_match"]) == 0.0
+        # overlap = {big, cat}: p = 2/3, r = 2/2 -> f1 = 0.8
+        np.testing.assert_allclose(float(got["f1"]), 80.0, atol=1e-4)
+
+    def test_max_over_ground_truths(self):
+        preds = {"prediction_text": "Paris", "id": "q"}
+        target = {"answers": {"answer_start": [0, 5], "text": ["London", "Paris"]}, "id": "q"}
+        got = squad(preds, target)
+        np.testing.assert_allclose(float(got["exact_match"]), 100.0)
+
+    def test_module_accumulation(self):
+        metric = SQuAD()
+        metric.update({"prediction_text": "a", "id": "1"}, {"answers": {"text": ["a"]}, "id": "1"})
+        metric.update({"prediction_text": "b", "id": "2"}, {"answers": {"text": ["c"]}, "id": "2"})
+        got = metric.compute()
+        np.testing.assert_allclose(float(got["exact_match"]), 50.0)
+
+    def test_bad_keys_raise(self):
+        with pytest.raises(KeyError):
+            squad({"wrong": "x", "id": "1"}, {"answers": {"text": ["a"]}, "id": "1"})
+        with pytest.raises(KeyError):
+            squad({"prediction_text": "x", "id": "1"}, {"id": "1"})
+
+
+class TestEED:
+    def test_reference_golden(self):
+        preds = ["this is the prediction", "here is an other sample"]
+        target = ["this is the reference", "here is another one"]
+        got = float(extended_edit_distance(preds, target))
+        np.testing.assert_allclose(got, 0.3078, atol=1e-4)
+
+    def test_identical_is_near_zero(self):
+        # EED keeps a small coverage penalty even for identical strings
+        got = float(extended_edit_distance(["same text"], [["same text"]]))
+        assert 0.0 < got < 0.05
+
+    def test_multi_ref_takes_best(self):
+        best = float(extended_edit_distance(["good morning"], [["good morning", "totally different"]]))
+        ident = float(extended_edit_distance(["good morning"], [["good morning"]]))
+        assert best == ident
+
+    def test_module_matches_functional(self):
+        preds = ["this is the prediction", "here is an other sample"]
+        target = ["this is the reference", "here is another one"]
+        metric = ExtendedEditDistance()
+        metric.update(preds[:1], [[target[0]]])
+        metric.update(preds[1:], [[target[1]]])
+        np.testing.assert_allclose(float(metric.compute()), float(extended_edit_distance(preds, target)), atol=1e-6)
+
+    def test_ja_language_path(self):
+        got = float(extended_edit_distance(["こんにちは"], [["こんにちは"]], language="ja"))
+        assert 0.0 <= got < 0.1
+
+    def test_arg_validation(self):
+        with pytest.raises(ValueError):
+            extended_edit_distance(["a"], [["b"]], alpha=-1.0)
+        with pytest.raises(ValueError):
+            ExtendedEditDistance(language="de")
