@@ -38,6 +38,15 @@ from metrics_tpu.classification import (  # noqa: F401
     StatScores,
 )
 from metrics_tpu.core import CompositionalMetric, Metric, MetricCollection  # noqa: F401
+from metrics_tpu.image import (  # noqa: F401
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    MultiScaleStructuralSimilarityIndexMeasure,
+    PeakSignalNoiseRatio,
+    SpectralAngleMapper,
+    SpectralDistortionIndex,
+    StructuralSimilarityIndexMeasure,
+    UniversalImageQualityIndex,
+)
 from metrics_tpu.retrieval import (  # noqa: F401
     RetrievalFallOut,
     RetrievalHitRate,
@@ -101,6 +110,11 @@ __all__ = [
     "JaccardIndex", "KLDivergence", "LabelRankingAveragePrecision",
     "LabelRankingLoss", "MatthewsCorrCoef", "Precision", "PrecisionRecallCurve",
     "Recall", "ROC", "Specificity", "StatScores",
+    # image
+    "ErrorRelativeGlobalDimensionlessSynthesis",
+    "MultiScaleStructuralSimilarityIndexMeasure", "PeakSignalNoiseRatio",
+    "SpectralAngleMapper", "SpectralDistortionIndex",
+    "StructuralSimilarityIndexMeasure", "UniversalImageQualityIndex",
     # regression
     "CosineSimilarity", "ExplainedVariance", "MeanAbsoluteError",
     "MeanAbsolutePercentageError", "MeanSquaredError", "MeanSquaredLogError",

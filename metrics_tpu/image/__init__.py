@@ -1,0 +1,22 @@
+"""Image domain metrics (reference: torchmetrics/image/)."""
+from metrics_tpu.image.psnr import PeakSignalNoiseRatio
+from metrics_tpu.image.quality import (
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    SpectralAngleMapper,
+    SpectralDistortionIndex,
+    UniversalImageQualityIndex,
+)
+from metrics_tpu.image.ssim import (
+    MultiScaleStructuralSimilarityIndexMeasure,
+    StructuralSimilarityIndexMeasure,
+)
+
+__all__ = [
+    "ErrorRelativeGlobalDimensionlessSynthesis",
+    "MultiScaleStructuralSimilarityIndexMeasure",
+    "PeakSignalNoiseRatio",
+    "SpectralAngleMapper",
+    "SpectralDistortionIndex",
+    "StructuralSimilarityIndexMeasure",
+    "UniversalImageQualityIndex",
+]

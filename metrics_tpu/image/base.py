@@ -1,0 +1,30 @@
+"""Shared base for list-state image metrics.
+
+Reference pattern (torchmetrics/image/{ssim,uqi,ergas,sam,d_lambda}.py): the
+module accumulates full ``preds``/``target`` image batches as ``cat`` list
+states and delegates the math to the functional kernel at ``compute()`` time.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class _ImagePairMetric(Metric):
+    """Accumulates (preds, target) image batches in ``cat`` list states."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def _append(self, preds: Array, target: Array) -> None:
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def _cat_states(self):
+        return dim_zero_cat(self.preds), dim_zero_cat(self.target)

@@ -1,0 +1,118 @@
+"""UQI, SpectralDistortionIndex, ERGAS and SpectralAngleMapper modules.
+
+Reference parity: torchmetrics/image/uqi.py:25, d_lambda.py:25, ergas.py:26,
+sam.py:25 — all accumulate image batches as ``cat`` list states.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+from jax import Array
+
+from metrics_tpu.image.base import _ImagePairMetric
+from metrics_tpu.ops.image.d_lambda import (
+    _spectral_distortion_index_check_inputs,
+    _spectral_distortion_index_compute,
+)
+from metrics_tpu.ops.image.ergas import _ergas_check_inputs, _ergas_compute
+from metrics_tpu.ops.image.sam import _sam_check_inputs, _sam_compute
+from metrics_tpu.ops.image.uqi import _uqi_check_inputs, _uqi_compute
+
+
+class UniversalImageQualityIndex(_ImagePairMetric):
+    """UQI. Reference: image/uqi.py:25-100."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        kernel_size: Sequence[int] = (11, 11),
+        sigma: Sequence[float] = (1.5, 1.5),
+        reduction: Optional[str] = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+        self.reduction = reduction
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        preds, target = _uqi_check_inputs(preds, target)
+        self._append(preds, target)
+
+    def compute(self) -> Array:
+        preds, target = self._cat_states()
+        return _uqi_compute(preds, target, self.kernel_size, self.sigma, self.reduction)
+
+
+class SpectralDistortionIndex(_ImagePairMetric):
+    """D-lambda. Reference: image/d_lambda.py:25-100."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, p: int = 1, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(p, int) or p <= 0:
+            raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+        self.p = p
+        if reduction not in ("elementwise_mean", "sum", "none"):
+            raise ValueError(f"Expected argument `reduction` be one of ['elementwise_mean','sum','none'] but got {reduction}")
+        self.reduction = reduction
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        preds, target = _spectral_distortion_index_check_inputs(preds, target)
+        self._append(preds, target)
+
+    def compute(self) -> Array:
+        preds, target = self._cat_states()
+        return _spectral_distortion_index_compute(preds, target, self.p, self.reduction)
+
+
+class ErrorRelativeGlobalDimensionlessSynthesis(_ImagePairMetric):
+    """ERGAS. Reference: image/ergas.py:26-106."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        ratio: Union[int, float] = 4,
+        reduction: Optional[str] = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.ratio = ratio
+        self.reduction = reduction
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        preds, target = _ergas_check_inputs(preds, target)
+        self._append(preds, target)
+
+    def compute(self) -> Array:
+        preds, target = self._cat_states()
+        return _ergas_compute(preds, target, self.ratio, self.reduction)
+
+
+class SpectralAngleMapper(_ImagePairMetric):
+    """SAM. Reference: image/sam.py:25-102."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.reduction = reduction
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        preds, target = _sam_check_inputs(preds, target)
+        self._append(preds, target)
+
+    def compute(self) -> Array:
+        preds, target = self._cat_states()
+        return _sam_compute(preds, target, self.reduction)

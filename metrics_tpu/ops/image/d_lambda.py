@@ -1,0 +1,72 @@
+"""Spectral Distortion Index (D-lambda).
+
+Reference parity (torchmetrics/functional/image/d_lambda.py):
+``_spectral_distortion_index_update`` (:13), ``_spectral_distortion_index_compute``
+(:34 — pairwise UQI matrices over channel pairs of preds/target),
+``spectral_distortion_index`` (:79).
+
+TPU-first: the reference runs a Python double loop with one conv per channel
+pair (O(C^2) kernel launches); here all C*(C+1)/2 pairs are stacked into one
+(B, P, H, W) tensor and scored with a single fused depthwise conv.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.ops.image.helper import _check_image_pair
+from metrics_tpu.ops.image.uqi import _uqi_map
+from metrics_tpu.parallel.sync import reduce
+
+
+def _spectral_distortion_index_check_inputs(preds: Array, target: Array):
+    return _check_image_pair(preds, target, names=("ms", "fused"))
+
+
+def _pairwise_uqi_matrix(x: Array) -> Array:
+    """(C, C) symmetric matrix of UQI between every channel pair of ``x``."""
+    length = x.shape[1]
+    idx_k, idx_r = np.triu_indices(length)
+    # stack all unique pairs into the channel dim: one conv for the whole matrix
+    a = x[:, idx_k]  # (B, P, H, W)
+    b = x[:, idx_r]
+    pair_vals = _uqi_map(a, b).mean(axis=(0, 2, 3))  # (P,)
+    mat = jnp.zeros((length, length), dtype=pair_vals.dtype)
+    mat = mat.at[idx_k, idx_r].set(pair_vals)
+    mat = mat.at[idx_r, idx_k].set(pair_vals)
+    return mat
+
+
+def _spectral_distortion_index_compute(
+    preds: Array,
+    target: Array,
+    p: int = 1,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """D-lambda from pairwise UQI matrices (reference d_lambda.py:34-77)."""
+    length = preds.shape[1]
+    m1 = _pairwise_uqi_matrix(target)
+    m2 = _pairwise_uqi_matrix(preds)
+
+    diff = jnp.abs(m1 - m2) ** p
+    if length == 1:
+        output = diff ** (1.0 / p)
+    else:
+        output = (jnp.sum(diff) / (length * (length - 1))) ** (1.0 / p)
+    return reduce(output, reduction)
+
+
+def spectral_distortion_index(
+    preds: Array,
+    target: Array,
+    p: int = 1,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Spectral Distortion Index. Reference: d_lambda.py:79-131."""
+    if not isinstance(p, int) or p <= 0:
+        raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+    preds, target = _spectral_distortion_index_check_inputs(preds, target)
+    return _spectral_distortion_index_compute(preds, target, p, reduction)

@@ -1,0 +1,49 @@
+"""ERGAS — Erreur Relative Globale Adimensionnelle de Synthèse.
+
+Reference parity (torchmetrics/functional/image/ergas.py): ``_ergas_update``
+(:11), ``_ergas_compute`` (:34), ``error_relative_global_dimensionless_synthesis``
+(:73).
+"""
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.image.helper import _check_image_pair
+from metrics_tpu.parallel.sync import reduce
+
+
+def _ergas_check_inputs(preds: Array, target: Array):
+    return _check_image_pair(preds, target)
+
+
+def _ergas_compute(
+    preds: Array,
+    target: Array,
+    ratio: Union[int, float] = 4,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    b, c, h, w = preds.shape
+    preds = preds.reshape(b, c, h * w)
+    target = target.reshape(b, c, h * w)
+
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=2)
+    rmse_per_band = jnp.sqrt(sum_squared_error / (h * w))
+    mean_target = jnp.mean(target, axis=2)
+
+    ergas_score = 100 * ratio * jnp.sqrt(jnp.sum((rmse_per_band / mean_target) ** 2, axis=1) / c)
+    return reduce(ergas_score, reduction)
+
+
+def error_relative_global_dimensionless_synthesis(
+    preds: Array,
+    target: Array,
+    ratio: Union[int, float] = 4,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """ERGAS. Reference: ergas.py:73-115."""
+    preds, target = _ergas_check_inputs(preds, target)
+    return _ergas_compute(preds, target, ratio, reduction)

@@ -1,0 +1,34 @@
+"""Image gradients via 1-step finite differences.
+
+Reference parity (torchmetrics/functional/image/gradients.py):
+``_image_gradients_validate`` (:8), ``_compute_image_gradients`` (:17),
+``image_gradients`` (:36).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _image_gradients_validate(img: Array) -> None:
+    if not isinstance(img, (jnp.ndarray,)):
+        raise TypeError(f"The `img` expects a value of <Array> type but got {type(img)}")
+    if img.ndim != 4:
+        raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")
+
+
+def _compute_image_gradients(img: Array) -> Tuple[Array, Array]:
+    dy = img[..., 1:, :] - img[..., :-1, :]
+    dx = img[..., :, 1:] - img[..., :, :-1]
+    # zero-pad the last row/column so gradients keep the input shape
+    dy = jnp.pad(dy, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(dx, ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """``(dy, dx)`` finite-difference gradients. Reference: gradients.py:36-69."""
+    _image_gradients_validate(img)
+    return _compute_image_gradients(img)

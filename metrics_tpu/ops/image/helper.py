@@ -1,0 +1,122 @@
+"""Shared image-kernel helpers: separable gaussian kernels + depthwise conv.
+
+Reference parity (torchmetrics/functional/image/helper.py): ``_gaussian`` (:11),
+``_gaussian_kernel_2d`` (:29), ``_gaussian_kernel_3d`` (:62), reflection pad 3d
+(:102, here just ``jnp.pad(mode='reflect')``).
+
+TPU-first notes: kernels are built host-side from static config (kernel size and
+sigma are constructor constants), so under jit they are compile-time constants
+folded into the conv weights; the depthwise convolution itself is a single
+``lax.conv_general_dilated`` with ``feature_group_count=C`` which XLA tiles onto
+the MXU.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from jax import Array, lax
+
+
+def _gaussian(kernel_size: int, sigma: float, dtype) -> Array:
+    """1D gaussian window of length ``kernel_size``, normalized to sum 1."""
+    dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, dtype=dtype)
+    gauss = jnp.exp(-((dist / sigma) ** 2) / 2)
+    return (gauss / gauss.sum())[None, :]  # (1, kernel_size)
+
+
+def _gaussian_kernel_2d(channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype) -> Array:
+    """Depthwise 2D gaussian kernel, shape (C, 1, kh, kw) (OIHW, I=1 per group)."""
+    kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
+    kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
+    kernel = kernel_x.T @ kernel_y  # (kh, kw)
+    return jnp.broadcast_to(kernel, (channel, 1, kernel_size[0], kernel_size[1]))
+
+
+def _gaussian_kernel_3d(channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype) -> Array:
+    """Depthwise 3D gaussian kernel, shape (C, 1, kd, kh, kw)."""
+    kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
+    kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
+    kernel_z = _gaussian(kernel_size[2], sigma[2], dtype)
+    kernel_xy = kernel_x.T @ kernel_y  # (kx, ky)
+    kernel = kernel_xy[:, :, None] * kernel_z[0][None, None, :]
+    return jnp.broadcast_to(kernel, (channel, 1, *kernel.shape))
+
+
+def _uniform_kernel_2d(channel: int, kernel_size: Sequence[int], dtype) -> Array:
+    kernel = jnp.ones(tuple(kernel_size), dtype=dtype) / float(jnp.prod(jnp.asarray(kernel_size)))
+    return jnp.broadcast_to(kernel, (channel, 1, *kernel_size))
+
+
+def _depthwise_conv(x: Array, kernel: Array) -> Array:
+    """Depthwise (per-channel) valid conv: x (N,C,*spatial), kernel (C,1,*k)."""
+    nd = x.ndim - 2
+    dims = ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCDHW", "OIDHW", "NCDHW")
+    return lax.conv_general_dilated(
+        x,
+        kernel.astype(x.dtype),
+        window_strides=(1,) * nd,
+        padding="VALID",
+        dimension_numbers=dims,
+        feature_group_count=x.shape[1],
+    )
+
+
+def _reflection_pad(x: Array, pads: Sequence[int]) -> Array:
+    """Reflection-pad the trailing spatial dims by ``pads`` on both sides."""
+    pad_width = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    return jnp.pad(x, pad_width, mode="reflect")
+
+
+def _check_image_pair(preds, target, allowed_ndims=(4,), min_channels=1, names=("preds", "target")):
+    """Shared validator for (preds, target) image metrics: same dtype/shape,
+    allowed rank, minimum channel count. Reference analog: the per-metric
+    ``_*_update`` checks (functional/image/{ssim,uqi,ergas,sam,d_lambda}.py)."""
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            f"Expected `{names[0]}` and `{names[1]}` to have the same data type."
+            f" Got {names[0]}: {preds.dtype} and {names[1]}: {target.dtype}."
+        )
+    if preds.shape != target.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, got {preds.shape} and {target.shape}."
+        )
+    if preds.ndim not in allowed_ndims:
+        expected = " or ".join("BxCxHxW" if n == 4 else "BxCxDxHxW" for n in allowed_ndims)
+        raise ValueError(
+            f"Expected `preds` and `target` to have {expected} shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    if preds.shape[1] < min_channels:
+        raise ValueError(
+            "Expected channel dimension of `preds` and `target` to be larger than 1."
+            f" Got preds: {preds.shape[1]} and target: {target.shape[1]}."
+        )
+    return preds, target
+
+
+def _windowed_moments(preds: Array, target: Array, kernel: Array, pads: Sequence[int]):
+    """Windowed first/second moments via ONE fused depthwise conv.
+
+    Reflection-pads both images, stacks ``[p, t, p*p, t*t, p*t]`` along batch
+    and runs a single depthwise conv (reference pattern:
+    functional/image/ssim.py:160-175, uqi.py:94-104), so XLA emits one
+    MXU-tiled convolution for all five statistics. Returns
+    ``(mu_p, mu_t, sigma_pp, sigma_tt, sigma_pt)`` maps at the padded size.
+    """
+    preds_p = _reflection_pad(preds, pads)
+    target_p = _reflection_pad(target, pads)
+    stacked = jnp.concatenate(
+        (preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p)
+    )
+    outputs = _depthwise_conv(stacked, kernel)
+    b = preds.shape[0]
+    mu_p, mu_t, s_pp, s_tt, s_pt = (outputs[i * b : (i + 1) * b] for i in range(5))
+    return mu_p, mu_t, s_pp - mu_p ** 2, s_tt - mu_t ** 2, s_pt - mu_p * mu_t
+
+
+def _avg_pool(x: Array, window: int = 2) -> Array:
+    """Non-overlapping average pool over all spatial dims (N,C,*spatial)."""
+    nd = x.ndim - 2
+    win = (1, 1) + (window,) * nd
+    return lax.reduce_window(x, 0.0, lax.add, win, win, "VALID") / (window ** nd)

@@ -1,0 +1,69 @@
+"""Peak Signal-to-Noise Ratio.
+
+Reference parity (torchmetrics/functional/image/psnr.py): ``_psnr_compute``
+(:10), ``_psnr_update`` (:46), ``peak_signal_noise_ratio`` (:82).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.parallel.sync import reduce
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _psnr_compute(
+    sum_squared_error: Array,
+    n_obs: Array,
+    data_range: Array,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / n_obs)
+    psnr_vals = psnr_base_e * (10 / np.log(base))
+    return reduce(psnr_vals, reduction=reduction)
+
+
+def _psnr_update(
+    preds: Array,
+    target: Array,
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Tuple[Array, Array]:
+    if dim is None:
+        sum_squared_error = jnp.sum((preds - target) ** 2)
+        n_obs = jnp.asarray(target.size)
+        return sum_squared_error, n_obs
+
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=dim)
+    dim_list = [dim] if isinstance(dim, int) else list(dim)
+    if not dim_list:
+        n_obs = jnp.asarray(target.size)
+    else:
+        n_obs = jnp.asarray(np.prod([target.shape[d] for d in dim_list]))
+        n_obs = jnp.broadcast_to(n_obs, sum_squared_error.shape)
+    return sum_squared_error, n_obs
+
+
+def peak_signal_noise_ratio(
+    preds: Array,
+    target: Array,
+    data_range: Optional[float] = None,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Array:
+    """PSNR. Reference: psnr.py:82-139."""
+    if dim is None and reduction != "elementwise_mean":
+        rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+    if data_range is None:
+        if dim is not None:
+            raise ValueError("The `data_range` must be given when `dim` is not None.")
+        data_range = target.max() - target.min()
+    else:
+        data_range = jnp.asarray(float(data_range))
+    sum_squared_error, n_obs = _psnr_update(preds, target, dim=dim)
+    return _psnr_compute(sum_squared_error, n_obs, data_range, base=base, reduction=reduction)

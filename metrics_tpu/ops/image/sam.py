@@ -1,0 +1,32 @@
+"""Spectral Angle Mapper.
+
+Reference parity (torchmetrics/functional/image/sam.py): ``_sam_update`` (:11),
+``_sam_compute`` (:39), ``spectral_angle_mapper`` (:69).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.image.helper import _check_image_pair
+from metrics_tpu.parallel.sync import reduce
+
+
+def _sam_check_inputs(preds: Array, target: Array):
+    return _check_image_pair(preds, target, min_channels=2)
+
+
+def _sam_compute(preds: Array, target: Array, reduction: Optional[str] = "elementwise_mean") -> Array:
+    dot_product = (preds * target).sum(axis=1)
+    preds_norm = jnp.linalg.norm(preds, axis=1)
+    target_norm = jnp.linalg.norm(target, axis=1)
+    sam_score = jnp.arccos(jnp.clip(dot_product / (preds_norm * target_norm), -1, 1))
+    return reduce(sam_score, reduction)
+
+
+def spectral_angle_mapper(preds: Array, target: Array, reduction: Optional[str] = "elementwise_mean") -> Array:
+    """SAM (radians). Reference: sam.py:69-110."""
+    preds, target = _sam_check_inputs(preds, target)
+    return _sam_compute(preds, target, reduction)

@@ -1,0 +1,79 @@
+"""Universal Image Quality Index.
+
+Reference parity (torchmetrics/functional/image/uqi.py): ``_uqi_update`` (:13),
+``_uqi_compute`` (:36 — SSIM machinery with c1=c2=0, full-map reduction),
+``universal_image_quality_index`` (:115).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.image.helper import _check_image_pair, _gaussian_kernel_2d, _windowed_moments
+from metrics_tpu.parallel.sync import reduce
+
+
+def _uqi_check_inputs(preds: Array, target: Array):
+    return _check_image_pair(preds, target)
+
+
+def _uqi_map(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+) -> Array:
+    """Per-pixel UQI map of shape (B, C, H', W') (halo trimmed).
+
+    Shared by :func:`universal_image_quality_index` and the vectorized
+    spectral-distortion-index pair computation (d_lambda.py).
+    """
+    if len(kernel_size) != 2 or len(sigma) != 2:
+        raise ValueError(
+            "Expected `kernel_size` and `sigma` to have the length of two."
+            f" Got kernel_size: {len(kernel_size)} and sigma: {len(sigma)}."
+        )
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {list(kernel_size)}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {list(sigma)}.")
+
+    channel = preds.shape[1]
+    kernel = _gaussian_kernel_2d(channel, kernel_size, sigma, preds.dtype)
+    pads = [(k - 1) // 2 for k in kernel_size]
+    mu_pred, mu_target, sigma_pred_sq, sigma_target_sq, sigma_pred_target = _windowed_moments(
+        preds, target, kernel, pads
+    )
+    mu_pred_sq = mu_pred ** 2
+    mu_target_sq = mu_target ** 2
+    mu_pred_target = mu_pred * mu_target
+
+    upper = 2 * sigma_pred_target
+    lower = sigma_pred_sq + sigma_target_sq
+    uqi_idx = ((2 * mu_pred_target) * upper) / ((mu_pred_sq + mu_target_sq) * lower)
+    slc = (...,) + tuple(slice(p, -p if p else None) for p in pads)
+    return uqi_idx[slc]
+
+
+def _uqi_compute(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    return reduce(_uqi_map(preds, target, kernel_size, sigma), reduction)
+
+
+def universal_image_quality_index(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """UQI. Reference: uqi.py:115-160."""
+    preds, target = _uqi_check_inputs(preds, target)
+    return _uqi_compute(preds, target, kernel_size, sigma, reduction)

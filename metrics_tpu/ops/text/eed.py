@@ -6,10 +6,12 @@ Reference parity: torchmetrics/functional/text/eed.py — ``_eed_function``
 ``extended_edit_distance`` (:357).
 
 EED is a character-level CDER-style grid walk with a long-jump operation at
-blank positions plus a coverage penalty for repeated visits. The DP row update
-has the same prefix structure as Levenshtein, so the device kernel uses the
-min-plus cummin factorization (see ops/text/helper.py); the jump relaxation is
-a row-wide ``minimum`` against a scalar, which stays vectorized.
+blank positions plus a coverage penalty for repeated visits. Unlike the
+Levenshtein-family rates (error_rates.py), the long-jump term makes each DP
+cell depend on the whole previous row's minimum at blank columns, so this
+implementation keeps the reference's per-sentence host-side DP loop; strings
+are host data anyway, and EED is an eval-time corpus metric, not a step-time
+device kernel.
 """
 from __future__ import annotations
 

@@ -23,12 +23,37 @@ from metrics_tpu.ops.text.bleu import _bleu_score_compute, _bleu_score_update
 AVAILABLE_TOKENIZERS = ("none", "13a", "zh", "intl", "char")
 
 
+# sacrebleu's zh tokenizer splits on more than ideographs: full-width ASCII,
+# CJK punctuation, radicals, strokes, bopomofo etc. (reference sacre_bleu.py:53-77)
+_UCODE_RANGES = (
+    (0x3400, 0x4DB5),   # CJK Unified Ideographs Extension A
+    (0x4E00, 0x9FA5),   # CJK Unified Ideographs
+    (0x9FA6, 0x9FBB),   # CJK Unified Ideographs, release 4.1
+    (0xF900, 0xFA2D),   # CJK Compatibility Ideographs
+    (0xFA30, 0xFA6A),   # CJK Compatibility Ideographs, release 3.2
+    (0xFA70, 0xFAD9),   # CJK Compatibility Ideographs, release 4.1
+    (0x20000, 0x2A6D6), # CJK Unified Ideographs Extension B
+    (0x2F800, 0x2FA1D), # CJK Compatibility Supplement
+    (0xFF00, 0xFFEF),   # full-width ASCII & punctuation, half-width kana/hangul
+    (0x2E80, 0x2EFF),   # CJK Radicals Supplement
+    (0x3000, 0x303F),   # CJK punctuation marks
+    (0x31C0, 0x31EF),   # CJK strokes
+    (0x2F00, 0x2FDF),   # Kangxi Radicals
+    (0x2FF0, 0x2FFF),   # Chinese character structure
+    (0x3100, 0x312F),   # phonetic symbols
+    (0x31A0, 0x31BF),   # phonetic symbols (Taiwanese and Hakka expansion)
+    (0xFE10, 0xFE1F),
+    (0xFE30, 0xFE4F),
+    (0x2600, 0x26FF),
+    (0x2700, 0x27BF),
+    (0x3200, 0x32FF),
+    (0x3300, 0x33FF),
+)
+
+
 def _is_chinese_char(char: str) -> bool:
     cp = ord(char)
-    return any(lo <= cp <= hi for lo, hi in (
-        (0x4E00, 0x9FFF), (0x3400, 0x4DBF), (0x20000, 0x2A6DF), (0x2A700, 0x2B73F),
-        (0x2B740, 0x2B81F), (0x2B820, 0x2CEAF), (0xF900, 0xFAFF), (0x2F800, 0x2FA1F),
-    ))
+    return any(lo <= cp <= hi for lo, hi in _UCODE_RANGES)
 
 
 class _SacreBLEUTokenizer:

@@ -64,7 +64,7 @@ class TestBLEU:
 
 
 class TestSacreBLEU:
-    @pytest.mark.parametrize("tokenize", ["none", "13a", "char", "intl"])
+    @pytest.mark.parametrize("tokenize", ["none", "13a", "char", "intl", "zh"])
     @pytest.mark.parametrize("lowercase", [False, True])
     def test_vs_sacrebleu(self, tokenize, lowercase):
         sb = SacreBLEU(tokenize=tokenize, lowercase=lowercase)
@@ -74,6 +74,15 @@ class TestSacreBLEU:
         want = sb.corpus_score(PREDS, refs_t).score / 100.0
         padded_targets = [refs + [refs[0]] * (max_refs - len(refs)) for refs in TARGETS]
         got = float(sacre_bleu_score(PREDS, padded_targets, tokenize=tokenize, lowercase=lowercase))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_zh_chinese_corpus(self):
+        # exercises the CJK ranges beyond ideographs: full-width ASCII, CJK punctuation
+        preds = ["猫在垫子上 12.5 度", "hello。world 你好", "ＡＢＣ 你好"]
+        targets = [["猫在垫子上有 12.5 度"], ["hello 。 world 你好"], ["ＡＢＣ 你好"]]
+        sb = SacreBLEU(tokenize="zh")
+        want = sb.corpus_score(preds, [[t[0] for t in targets]]).score / 100.0
+        got = float(sacre_bleu_score(preds, targets, tokenize="zh"))
         np.testing.assert_allclose(got, want, atol=1e-5)
 
     def test_module(self):
