@@ -40,6 +40,10 @@ from metrics_tpu.classification import (  # noqa: F401
 from metrics_tpu.core import CompositionalMetric, Metric, MetricCollection  # noqa: F401
 from metrics_tpu.image import (  # noqa: F401
     ErrorRelativeGlobalDimensionlessSynthesis,
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    LearnedPerceptualImagePatchSimilarity,
     MultiScaleStructuralSimilarityIndexMeasure,
     PeakSignalNoiseRatio,
     SpectralAngleMapper,
@@ -111,7 +115,9 @@ __all__ = [
     "LabelRankingLoss", "MatthewsCorrCoef", "Precision", "PrecisionRecallCurve",
     "Recall", "ROC", "Specificity", "StatScores",
     # image
-    "ErrorRelativeGlobalDimensionlessSynthesis",
+    "ErrorRelativeGlobalDimensionlessSynthesis", "FrechetInceptionDistance",
+    "InceptionScore", "KernelInceptionDistance",
+    "LearnedPerceptualImagePatchSimilarity",
     "MultiScaleStructuralSimilarityIndexMeasure", "PeakSignalNoiseRatio",
     "SpectralAngleMapper", "SpectralDistortionIndex",
     "StructuralSimilarityIndexMeasure", "UniversalImageQualityIndex",

@@ -1,4 +1,8 @@
 """Image domain metrics (reference: torchmetrics/image/)."""
+from metrics_tpu.image.fid import FrechetInceptionDistance
+from metrics_tpu.image.inception import InceptionScore
+from metrics_tpu.image.kid import KernelInceptionDistance
+from metrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity
 from metrics_tpu.image.psnr import PeakSignalNoiseRatio
 from metrics_tpu.image.quality import (
     ErrorRelativeGlobalDimensionlessSynthesis,
@@ -13,6 +17,10 @@ from metrics_tpu.image.ssim import (
 
 __all__ = [
     "ErrorRelativeGlobalDimensionlessSynthesis",
+    "FrechetInceptionDistance",
+    "InceptionScore",
+    "KernelInceptionDistance",
+    "LearnedPerceptualImagePatchSimilarity",
     "MultiScaleStructuralSimilarityIndexMeasure",
     "PeakSignalNoiseRatio",
     "SpectralAngleMapper",

@@ -1,0 +1,58 @@
+"""Feature-extractor resolution shared by FID / IS / KID.
+
+Reference analog: the ``feature: Union[int, Module]`` argument handling in
+torchmetrics/image/{fid,inception,kid}.py — an int selects an InceptionV3 tap,
+a module is used as-is. Here a callable ``imgs -> [N, d]`` plays the module
+role; ints build the flax InceptionV3 with weights from (in order) the
+``variables`` argument, a torch checkpoint at ``$METRICS_TPU_INCEPTION_WEIGHTS``,
+or random init with a loud warning (architecture-only mode).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+_WEIGHTS_ENV = "METRICS_TPU_INCEPTION_WEIGHTS"
+
+
+def _load_env_weights() -> Optional[dict]:
+    path = os.environ.get(_WEIGHTS_ENV)
+    if not path or not os.path.exists(path):
+        return None
+    import torch  # CPU-only torch is fine: used purely as a checkpoint reader
+
+    from metrics_tpu.nets.inception import load_inception_torch_state_dict
+
+    state_dict = torch.load(path, map_location="cpu")
+    return load_inception_torch_state_dict(state_dict)
+
+
+def resolve_feature_extractor(
+    feature: Any,
+    metric_name: str,
+    valid_features: tuple,
+    variables: Optional[dict] = None,
+) -> Callable:
+    """Return a callable ``imgs -> [N, d]`` feature extractor."""
+    if callable(feature):
+        return feature
+    if not isinstance(feature, (int, str)):
+        raise TypeError("Got unknown input to argument `feature`")
+    if feature not in valid_features:
+        raise ValueError(
+            f"Integer input to argument `feature` must be one of {valid_features}, but got {feature}."
+        )
+    from metrics_tpu.nets.inception import InceptionV3FeatureExtractor
+
+    if variables is None:
+        variables = _load_env_weights()
+    if variables is None:
+        rank_zero_warn(
+            f"Metric `{metric_name}` is using a randomly initialized InceptionV3: no `variables` were"
+            f" given and ${_WEIGHTS_ENV} does not point to a checkpoint. Scores will NOT be comparable"
+            " to published numbers; pass converted weights for that.",
+            UserWarning,
+        )
+    return InceptionV3FeatureExtractor(feature, variables=variables)

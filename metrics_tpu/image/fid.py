@@ -1,0 +1,130 @@
+"""FrechetInceptionDistance.
+
+Reference parity: torchmetrics/image/fid.py:128-289 (``feature`` int/module
+argument, ``real`` flag routing, ``reset_real_features`` caching :282-289).
+
+TPU-first redesign: instead of the reference's unbounded feature lists
+(fid.py:243-244, with its "large memory footprint" warning :205), state is the
+streaming Welford triple ``(n, mean, centered-M2)`` per distribution —
+fixed-shape, exact, float32-stable (the centered form avoids the catastrophic
+cancellation of raw ``sum(xx^T)`` moments), and O(D^2) memory independent of
+sample count. Cross-batch and cross-device merges both use Chan's parallel
+combine, so ``merge_states``/``sync_states`` are overridden to combine the
+triples jointly (per-state independent reductions cannot express it). The
+matrix sqrt runs on device via a symmetric eigendecomposition (ops/image/
+fid.py) instead of the reference's CPU scipy round-trip (fid.py:61-95).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array, lax
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.image._extractor import resolve_feature_extractor
+from metrics_tpu.ops.image.fid import _compute_fid, _mean_cov_from_moments, welford_combine, welford_update
+from metrics_tpu.parallel import sync as _sync
+
+_VALID_FID_FEATURES = (64, 192, 768, 2048)
+_TRIPLES = {prefix: tuple(f"{prefix}_{leaf}" for leaf in ("n", "mean", "m2")) for prefix in ("real", "fake")}
+
+
+class FrechetInceptionDistance(Metric):
+    """FID. Reference: image/fid.py:128."""
+
+    higher_is_better = False
+    is_differentiable = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        feature: Union[int, Callable] = 2048,
+        reset_real_features: bool = True,
+        variables: Optional[dict] = None,
+        feature_size: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.inception = resolve_feature_extractor(feature, "FrechetInceptionDistance", _VALID_FID_FEATURES, variables)
+        if not isinstance(reset_real_features, bool):
+            raise ValueError("Argument `reset_real_features` expected to be a bool")
+        self.reset_real_features = reset_real_features
+
+        if feature_size is None:
+            feature_size = getattr(self.inception, "num_features", None) or (feature if isinstance(feature, int) else None)
+        if feature_size is None:
+            raise ValueError("Pass `feature_size` when using a custom feature extractor callable.")
+        d = int(feature_size)
+
+        # reductions are handled jointly by the overridden merge/sync below
+        for prefix in ("real", "fake"):
+            self.add_state(f"{prefix}_n", default=jnp.asarray(0.0), dist_reduce_fx=None)
+            self.add_state(f"{prefix}_mean", default=jnp.zeros(d), dist_reduce_fx=None)
+            self.add_state(f"{prefix}_m2", default=jnp.zeros((d, d)), dist_reduce_fx=None)
+
+    def update(self, imgs: Array, real: bool) -> None:  # type: ignore[override]
+        """Extract features and fold them into the streaming moments."""
+        features = jnp.asarray(self.inception(imgs), dtype=jnp.float32)
+        prefix = "real" if real else "fake"
+        n, mean, m2 = (getattr(self, name) for name in _TRIPLES[prefix])
+        n, mean, m2 = welford_update(n, mean, m2, features)
+        for name, value in zip(_TRIPLES[prefix], (n, mean, m2)):
+            setattr(self, name, value)
+
+    def compute(self) -> Array:
+        mean1, cov1 = _mean_cov_from_moments(self.real_n, self.real_mean, self.real_m2)
+        mean2, cov2 = _mean_cov_from_moments(self.fake_n, self.fake_mean, self.fake_m2)
+        return _compute_fid(mean1, cov1, mean2, cov2)
+
+    # ------------------------------------------------------------------ #
+    # joint moment combination: cross-batch merge and cross-device sync
+    # ------------------------------------------------------------------ #
+    def merge_states(self, state: Dict, incoming: Dict, update_counts: Tuple[int, int] = (1, 1)) -> Dict:
+        out: Dict[str, Array] = {}
+        for names in _TRIPLES.values():
+            combined = welford_combine(
+                tuple(state[n] for n in names), tuple(incoming[n] for n in names)
+            )
+            out.update(dict(zip(names, combined)))
+        return out
+
+    def sync_states(self, state: Dict, axis_name) -> Dict:
+        """All-gather the triples over the mesh axis and fold with Chan's combine."""
+        stacks = {k: lax.all_gather(v, axis_name, axis=0) for k, v in state.items()}
+        world = stacks["real_n"].shape[0]
+        out: Dict[str, Array] = {}
+        for names in _TRIPLES.values():
+            acc = tuple(stacks[n][0] for n in names)
+            for w in range(1, world):
+                acc = welford_combine(acc, tuple(stacks[n][w] for n in names))
+            out.update(dict(zip(names, acc)))
+        return out
+
+    def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
+        if dist_sync_fn is not None:
+            return super()._sync_dist(dist_sync_fn, process_group)
+        axes = process_group or self.process_group or _sync.current_sync_axes()
+        state = self.metric_state
+        if axes is not None:
+            self.set_state(self.sync_states(state, axes))
+            return
+        gathered = {k: _sync.gather_all_arrays(v) for k, v in state.items()}
+        world = len(gathered["real_n"])
+        synced: Dict[str, Array] = {}
+        for names in _TRIPLES.values():
+            acc = tuple(gathered[n][0] for n in names)
+            for w in range(1, world):
+                acc = welford_combine(acc, tuple(gathered[n][w] for n in names))
+            synced.update(dict(zip(names, acc)))
+        self.set_state(synced)
+
+    def reset(self) -> None:
+        if not self.reset_real_features:
+            # keep the cached real-distribution moments (reference fid.py:282-289)
+            kept = {name: getattr(self, name) for name in _TRIPLES["real"]}
+            super().reset()
+            for name, value in kept.items():
+                setattr(self, name, value)
+        else:
+            super().reset()

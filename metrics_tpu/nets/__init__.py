@@ -1,0 +1,16 @@
+"""Frozen feature-extractor networks for model-based metrics (FID/IS/KID/LPIPS).
+
+TPU-native replacements for the reference's delegated torch packages
+(SURVEY.md §2.4): torch-fidelity's InceptionV3 (image/fid.py:27-34) and the
+``lpips`` nets (image/lpip.py:34-45) re-implemented in flax.linen with
+converters for the original torch weights.
+"""
+from metrics_tpu.nets.inception import InceptionV3FeatureExtractor, load_inception_torch_state_dict
+from metrics_tpu.nets.lpips import LPIPSNet, load_lpips_torch_state_dict
+
+__all__ = [
+    "InceptionV3FeatureExtractor",
+    "LPIPSNet",
+    "load_inception_torch_state_dict",
+    "load_lpips_torch_state_dict",
+]

@@ -1,0 +1,298 @@
+"""Flax InceptionV3, FID-compat variant ("inception-v3-compat").
+
+TPU-native replacement for torch-fidelity's ``FeatureExtractorInceptionV3``
+that the reference wraps as ``NoTrainInceptionV3`` (torchmetrics/image/fid.py:
+28-46). Architecture follows the original TF-Slim InceptionV3 *with the
+FID-community bug-compat quirks* that the published FID statistics depend on:
+
+- average pools exclude padding from the divisor (``count_include_pad=False``),
+- the second InceptionE block (Mixed_7c) uses a MAX pool in its pool branch,
+- the classifier has 1008 outputs (original TF checkpoint classes),
+- input is bilinear-resized to 299x299 (half-pixel centers, i.e.
+  ``align_corners=False``) and normalized as ``(x - 128) / 128``.
+
+Layout is NHWC throughout (TPU-native); the public entry accepts the
+reference's NCHW uint8 batches. Feature taps mirror torch-fidelity's
+``features_list``: '64', '192', '768', '2048', 'logits_unbiased', 'logits'.
+
+Weights: ``load_inception_torch_state_dict`` converts the community
+``pt_inception-2015-12-05`` torch checkpoint (torchvision-style key names) into
+this module's param pytree. No network download is attempted.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import Array, lax
+
+VALID_FEATURES = ("64", "192", "768", "2048", "logits_unbiased", "logits")
+
+
+def _avg_pool_3x3_exclude_pad(x: Array) -> Array:
+    """3x3 stride-1 SAME avg pool with pad-excluded divisor (NHWC).
+
+    Matches ``F.avg_pool2d(..., count_include_pad=False)`` in the FID nets.
+    """
+    window = (1, 3, 3, 1)
+    strides = (1, 1, 1, 1)
+    pads = ((0, 0), (1, 1), (1, 1), (0, 0))
+    summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+    counts = lax.reduce_window(jnp.ones_like(x[..., :1]), 0.0, lax.add, window, strides, pads)
+    return summed / counts
+
+
+def _max_pool(x: Array, window: int, stride: int, pad: int = 0) -> Array:
+    pads = ((0, 0), (pad, pad), (pad, pad), (0, 0))
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, window, window, 1), (1, stride, stride, 1), pads
+    )
+
+
+class BasicConv2d(nn.Module):
+    """Conv (no bias) + frozen BatchNorm(eps=1e-3) + ReLU."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = ((0, 0), (0, 0))
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        x = nn.Conv(self.features, self.kernel, self.strides, padding=self.padding, use_bias=False, name="conv")(x)
+        x = nn.BatchNorm(use_running_average=True, epsilon=1e-3, momentum=0.9, name="bn")(x)
+        return nn.relu(x)
+
+
+def _conv(features: int, k: int, stride: int = 1, pad: int = 0, name: str = None) -> BasicConv2d:
+    return BasicConv2d(features, (k, k), (stride, stride), ((pad, pad), (pad, pad)), name=name)
+
+
+def _conv_hw(features: int, kh: int, kw: int, ph: int, pw: int, name: str = None) -> BasicConv2d:
+    return BasicConv2d(features, (kh, kw), (1, 1), ((ph, ph), (pw, pw)), name=name)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b1 = _conv(64, 1, name="branch1x1")(x)
+        b5 = _conv(48, 1, name="branch5x5_1")(x)
+        b5 = _conv(64, 5, pad=2, name="branch5x5_2")(b5)
+        b3 = _conv(64, 1, name="branch3x3dbl_1")(x)
+        b3 = _conv(96, 3, pad=1, name="branch3x3dbl_2")(b3)
+        b3 = _conv(96, 3, pad=1, name="branch3x3dbl_3")(b3)
+        bp = _avg_pool_3x3_exclude_pad(x)
+        bp = _conv(self.pool_features, 1, name="branch_pool")(bp)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b3 = _conv(384, 3, stride=2, name="branch3x3")(x)
+        bd = _conv(64, 1, name="branch3x3dbl_1")(x)
+        bd = _conv(96, 3, pad=1, name="branch3x3dbl_2")(bd)
+        bd = _conv(96, 3, stride=2, name="branch3x3dbl_3")(bd)
+        bp = _max_pool(x, 3, 2)
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        c7 = self.channels_7x7
+        b1 = _conv(192, 1, name="branch1x1")(x)
+        b7 = _conv(c7, 1, name="branch7x7_1")(x)
+        b7 = _conv_hw(c7, 1, 7, 0, 3, name="branch7x7_2")(b7)
+        b7 = _conv_hw(192, 7, 1, 3, 0, name="branch7x7_3")(b7)
+        bd = _conv(c7, 1, name="branch7x7dbl_1")(x)
+        bd = _conv_hw(c7, 7, 1, 3, 0, name="branch7x7dbl_2")(bd)
+        bd = _conv_hw(c7, 1, 7, 0, 3, name="branch7x7dbl_3")(bd)
+        bd = _conv_hw(c7, 7, 1, 3, 0, name="branch7x7dbl_4")(bd)
+        bd = _conv_hw(192, 1, 7, 0, 3, name="branch7x7dbl_5")(bd)
+        bp = _avg_pool_3x3_exclude_pad(x)
+        bp = _conv(192, 1, name="branch_pool")(bp)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b3 = _conv(192, 1, name="branch3x3_1")(x)
+        b3 = _conv(320, 3, stride=2, name="branch3x3_2")(b3)
+        b7 = _conv(192, 1, name="branch7x7x3_1")(x)
+        b7 = _conv_hw(192, 1, 7, 0, 3, name="branch7x7x3_2")(b7)
+        b7 = _conv_hw(192, 7, 1, 3, 0, name="branch7x7x3_3")(b7)
+        b7 = _conv(192, 3, stride=2, name="branch7x7x3_4")(b7)
+        bp = _max_pool(x, 3, 2)
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    pool: str  # 'avg' (Mixed_7b) or 'max' (Mixed_7c — FID bug-compat)
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b1 = _conv(320, 1, name="branch1x1")(x)
+        b3 = _conv(384, 1, name="branch3x3_1")(x)
+        b3a = _conv_hw(384, 1, 3, 0, 1, name="branch3x3_2a")(b3)
+        b3b = _conv_hw(384, 3, 1, 1, 0, name="branch3x3_2b")(b3)
+        b3 = jnp.concatenate([b3a, b3b], axis=-1)
+        bd = _conv(448, 1, name="branch3x3dbl_1")(x)
+        bd = _conv(384, 3, pad=1, name="branch3x3dbl_2")(bd)
+        bda = _conv_hw(384, 1, 3, 0, 1, name="branch3x3dbl_3a")(bd)
+        bdb = _conv_hw(384, 3, 1, 1, 0, name="branch3x3dbl_3b")(bd)
+        bd = jnp.concatenate([bda, bdb], axis=-1)
+        if self.pool == "max":
+            bp = _max_pool(x, 3, 1, pad=1)
+        else:
+            bp = _avg_pool_3x3_exclude_pad(x)
+        bp = _conv(192, 1, name="branch_pool")(bp)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """FID-compat InceptionV3 trunk returning the requested feature taps."""
+
+    features_list: Sequence[str] = ("2048",)
+
+    @nn.compact
+    def __call__(self, x: Array) -> Dict[str, Array]:
+        remaining = set(self.features_list)
+        out: Dict[str, Array] = {}
+
+        def tap(name: str, value: Array) -> bool:
+            if name in remaining:
+                out[name] = value
+                remaining.discard(name)
+            return not remaining
+
+        x = _conv(32, 3, stride=2, name="Conv2d_1a_3x3")(x)
+        x = _conv(32, 3, name="Conv2d_2a_3x3")(x)
+        x = _conv(64, 3, pad=1, name="Conv2d_2b_3x3")(x)
+        x = _max_pool(x, 3, 2)
+        if tap("64", x.mean(axis=(1, 2))):
+            return out
+        x = _conv(80, 1, name="Conv2d_3b_1x1")(x)
+        x = _conv(192, 3, name="Conv2d_4a_3x3")(x)
+        x = _max_pool(x, 3, 2)
+        if tap("192", x.mean(axis=(1, 2))):
+            return out
+        x = InceptionA(32, name="Mixed_5b")(x)
+        x = InceptionA(64, name="Mixed_5c")(x)
+        x = InceptionA(64, name="Mixed_5d")(x)
+        x = InceptionB(name="Mixed_6a")(x)
+        x = InceptionC(128, name="Mixed_6b")(x)
+        x = InceptionC(160, name="Mixed_6c")(x)
+        x = InceptionC(160, name="Mixed_6d")(x)
+        x = InceptionC(192, name="Mixed_6e")(x)
+        if tap("768", x.mean(axis=(1, 2))):
+            return out
+        x = InceptionD(name="Mixed_7a")(x)
+        x = InceptionE("avg", name="Mixed_7b")(x)
+        x = InceptionE("max", name="Mixed_7c")(x)
+        pooled = x.mean(axis=(1, 2))
+        if tap("2048", pooled):
+            return out
+        if "logits_unbiased" in remaining:
+            kernel = self.param("fc_kernel", nn.initializers.lecun_normal(), (2048, 1008))
+            bias = self.param("fc_bias", nn.initializers.zeros, (1008,))
+            logits_unbiased = pooled @ kernel
+            tap("logits_unbiased", logits_unbiased)
+            tap("logits", logits_unbiased + bias)
+        elif "logits" in remaining:
+            kernel = self.param("fc_kernel", nn.initializers.lecun_normal(), (2048, 1008))
+            bias = self.param("fc_bias", nn.initializers.zeros, (1008,))
+            tap("logits", pooled @ kernel + bias)
+        return out
+
+
+class InceptionV3FeatureExtractor:
+    """Jitted frozen feature extractor: NCHW uint8/float batches -> [N, d].
+
+    Reference analog: ``NoTrainInceptionV3`` (torchmetrics/image/fid.py:28-46).
+    ``variables`` may come from :func:`load_inception_torch_state_dict`; if
+    omitted the net is randomly initialized (architecture-only mode — fine for
+    pipeline tests, NOT for comparable FID numbers; a warning is emitted by the
+    metric layer).
+    """
+
+    def __init__(self, feature: Any = "2048", variables: Dict | None = None, dtype=jnp.float32) -> None:
+        name = str(feature)
+        if name not in VALID_FEATURES:
+            raise ValueError(f"Integer input to argument `feature` must be one of {VALID_FEATURES}, but got {feature}.")
+        self.feature = name
+        self.module = InceptionV3(features_list=(name,))
+        if variables is None:
+            variables = self.module.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3), dtype))
+        self.variables = variables
+
+        def _forward(variables, imgs):
+            x = imgs.astype(jnp.float32)
+            if x.ndim != 4:
+                raise ValueError(f"Expected 4D image batch, got shape {imgs.shape}")
+            x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC
+            x = jax.image.resize(x, (x.shape[0], 299, 299, x.shape[3]), method="bilinear")
+            x = (x - 128.0) / 128.0
+            out = self.module.apply(variables, x)
+            return out[name].reshape(imgs.shape[0], -1)
+
+        self._forward = jax.jit(_forward)
+
+    @property
+    def num_features(self) -> int:
+        return {"64": 64, "192": 192, "768": 768, "2048": 2048, "logits_unbiased": 1008, "logits": 1008}[self.feature]
+
+    def __call__(self, imgs: Array) -> Array:
+        return self._forward(self.variables, imgs)
+
+
+def load_inception_torch_state_dict(state_dict: Dict[str, Any], features_list: Sequence[str] = ("2048",)) -> Dict:
+    """Convert a torchvision-style InceptionV3 ``state_dict`` (the community
+    ``pt_inception-2015-12-05`` FID checkpoint) into this module's variables.
+
+    Key mapping: ``<Block>.<branch>.conv.weight`` (O,I,kh,kw) ->
+    ``params/<Block>/<branch>/conv/kernel`` (kh,kw,I,O); BatchNorm
+    weight/bias/running_mean/running_var -> scale/bias/mean/var; ``fc.weight``
+    (1008,2048) -> ``fc_kernel`` (2048,1008).
+    """
+    import numpy as np
+
+    params: Dict[str, Any] = {}
+    batch_stats: Dict[str, Any] = {}
+
+    def set_nested(tree: Dict, path: Sequence[str], value) -> None:
+        node = tree
+        for key in path[:-1]:
+            node = node.setdefault(key, {})
+        node[path[-1]] = jnp.asarray(value)
+
+    for key, value in state_dict.items():
+        value = np.asarray(value)
+        parts = key.split(".")
+        if parts[0] == "fc":
+            if parts[1] == "weight":
+                params["fc_kernel"] = jnp.asarray(value.T)
+            else:
+                params["fc_bias"] = jnp.asarray(value)
+            continue
+        *scope, layer, attr = parts  # e.g. Mixed_5b, branch1x1, conv, weight
+        if layer == "conv" and attr == "weight":
+            set_nested(params, (*scope, "conv", "kernel"), value.transpose(2, 3, 1, 0))
+        elif layer == "bn":
+            if attr == "weight":
+                set_nested(params, (*scope, "bn", "scale"), value)
+            elif attr == "bias":
+                set_nested(params, (*scope, "bn", "bias"), value)
+            elif attr == "running_mean":
+                set_nested(batch_stats, (*scope, "bn", "mean"), value)
+            elif attr == "running_var":
+                set_nested(batch_stats, (*scope, "bn", "var"), value)
+            # num_batches_tracked: not used by frozen BN
+    return {"params": params, "batch_stats": batch_stats}

@@ -1,0 +1,92 @@
+"""Frechet distance between feature Gaussians, with on-device matrix sqrt.
+
+Reference parity (torchmetrics/image/fid.py): ``MatrixSquareRoot`` (:48 — the
+reference round-trips to CPU ``scipy.linalg.sqrtm`` and solves a Sylvester
+equation for the backward pass), ``_compute_fid`` (:98).
+
+TPU-first redesign: both inputs to the FID trace term are covariance matrices
+(symmetric PSD), so ``trace(sqrtm(S1 @ S2))`` is computed entirely on device as
+``sum(sqrt(eigvals(S1^1/2 @ S2 @ S1^1/2)))`` — the product is similar to a PSD
+matrix, giving real non-negative eigenvalues. ``jnp.linalg.eigh`` is
+XLA-native, batched, and differentiable, so there is no host round-trip and no
+custom VJP: the Sylvester machinery exists in the reference only because scipy
+breaks the autograd graph. Near-singular products are handled by clamping tiny
+negative eigenvalues instead of the reference's retry-with-diagonal-offset.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def sqrtm_psd(mat: Array) -> Array:
+    """Matrix square root of a symmetric PSD matrix via eigendecomposition."""
+    vals, vecs = jnp.linalg.eigh(mat)
+    vals = jnp.clip(vals, 0.0, None)
+    return (vecs * jnp.sqrt(vals)) @ vecs.T
+
+
+def trace_sqrtm_product(sigma1: Array, sigma2: Array) -> Array:
+    """``trace(sqrtm(sigma1 @ sigma2))`` for symmetric PSD inputs.
+
+    Uses the similarity ``S1 S2 ~ S1^1/2 S2 S1^1/2`` (symmetric PSD), so the
+    trace is the sum of the square roots of a *symmetric* eigenproblem —
+    numerically far better conditioned than Schur/Newton iterations on the
+    non-symmetric product (reference fid.py:61-95).
+    """
+    s1_half = sqrtm_psd(sigma1)
+    inner = s1_half @ sigma2 @ s1_half
+    inner = (inner + inner.T) / 2  # enforce symmetry against fp drift
+    vals = jnp.linalg.eigvalsh(inner)
+    return jnp.sum(jnp.sqrt(jnp.clip(vals, 0.0, None)))
+
+
+def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
+    """``|mu1-mu2|^2 + tr(S1 + S2 - 2 sqrtm(S1 S2))`` (reference fid.py:98-117)."""
+    diff = mu1 - mu2
+    tr_covmean = trace_sqrtm_product(sigma1, sigma2)
+    return diff @ diff + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean
+
+
+def welford_combine(a, b):
+    """Chan's parallel combine of two (n, mean, M2) moment triples.
+
+    M2 is the *centered* second moment ``sum((x-mean)(x-mean)^T)``, so the
+    combine never subtracts large near-equal quantities — float32-safe even
+    when feature means dominate their spread (raw ``sum(xx^T) - n mu mu^T``
+    moments cancel catastrophically there). This is the fixed-shape streaming
+    replacement for the reference's unbounded feature lists (fid.py:243-244)
+    and its epoch-end float64 cast (fid.py:262-267).
+    """
+    n_a, mean_a, m2_a = a
+    n_b, mean_b, m2_b = b
+    n = n_a + n_b
+    safe_n = jnp.maximum(n, 1.0)
+    delta = mean_b - mean_a
+    mean = mean_a + delta * (n_b / safe_n)
+    m2 = m2_a + m2_b + jnp.outer(delta, delta) * (n_a * n_b / safe_n)
+    return n, mean, m2
+
+
+def welford_update(n: Array, mean: Array, m2: Array, x: Array):
+    """Fold a feature batch ``x: [N, D]`` into the (n, mean, M2) triple."""
+    n_b = jnp.asarray(x.shape[0], dtype=jnp.float32)
+    mean_b = x.mean(axis=0)
+    diff = x - mean_b
+    return welford_combine((n, mean, m2), (n_b, mean_b, diff.T @ diff))
+
+
+def _mean_cov_from_moments(n: Array, mean: Array, m2: Array):
+    """Mean and unbiased covariance from a Welford triple."""
+    return mean, m2 / jnp.maximum(n - 1.0, 1.0)
+
+
+def frechet_distance(features_real: Array, features_fake: Array) -> Array:
+    """FID directly from two ``[N, D]`` feature matrices."""
+    mu1 = features_real.mean(axis=0)
+    mu2 = features_fake.mean(axis=0)
+    d1 = features_real - mu1
+    d2 = features_fake - mu2
+    cov1 = d1.T @ d1 / (features_real.shape[0] - 1)
+    cov2 = d2.T @ d2 / (features_fake.shape[0] - 1)
+    return _compute_fid(mu1, cov1, mu2, cov2)

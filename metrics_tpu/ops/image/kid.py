@@ -1,0 +1,50 @@
+"""Polynomial-kernel MMD for Kernel Inception Distance.
+
+Reference parity (torchmetrics/image/kid.py): ``maximum_mean_discrepancy``
+(:29), ``poly_kernel`` (:49), ``poly_mmd`` (:57).
+
+TPU-first: the subset loop in the module is expressed as one batched gather +
+``vmap`` over subsets, so all ``subsets`` MMD evaluations compile to a single
+batched matmul program instead of a Python loop of kernel launches.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def maximum_mean_discrepancy(k_xx: Array, k_xy: Array, k_yy: Array) -> Array:
+    m = k_xx.shape[0]
+    kt_xx_sum = k_xx.sum() - jnp.trace(k_xx)
+    kt_yy_sum = k_yy.sum() - jnp.trace(k_yy)
+    k_xy_sum = k_xy.sum()
+    return (kt_xx_sum + kt_yy_sum) / (m * (m - 1)) - 2 * k_xy_sum / (m ** 2)
+
+
+def poly_kernel(f1: Array, f2: Array, degree: int = 3, gamma: Optional[float] = None, coef: float = 1.0) -> Array:
+    if gamma is None:
+        gamma = 1.0 / f1.shape[1]
+    return (f1 @ f2.T * gamma + coef) ** degree
+
+
+def poly_mmd(
+    f_real: Array, f_fake: Array, degree: int = 3, gamma: Optional[float] = None, coef: float = 1.0
+) -> Array:
+    k_11 = poly_kernel(f_real, f_real, degree, gamma, coef)
+    k_22 = poly_kernel(f_fake, f_fake, degree, gamma, coef)
+    k_12 = poly_kernel(f_real, f_fake, degree, gamma, coef)
+    return maximum_mean_discrepancy(k_11, k_12, k_22)
+
+
+def batched_poly_mmd(
+    f_real_subsets: Array,  # (S, subset_size, D)
+    f_fake_subsets: Array,  # (S, subset_size, D)
+    degree: int = 3,
+    gamma: Optional[float] = None,
+    coef: float = 1.0,
+) -> Array:
+    """MMD per subset, vmapped: one fused program for all S subsets."""
+    return jax.vmap(lambda r, f: poly_mmd(r, f, degree, gamma, coef))(f_real_subsets, f_fake_subsets)

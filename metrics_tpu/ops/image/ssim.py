@@ -158,30 +158,32 @@ def _multiscale_ssim_compute(
     normalize: Optional[str] = None,
 ) -> Array:
     """MS-SSIM over ``len(betas)`` scales (reference ssim.py:433-543)."""
-    kernel_size_l, _ = _normalize_kernel_args(preds.ndim, kernel_size, sigma)
+    kernel_size_l, sigma_l = _normalize_kernel_args(preds.ndim, kernel_size, sigma)
+    # size guard on the EFFECTIVE kernel (gaussian support is derived from
+    # sigma, not kernel_size) at the smallest scale. The reference's guard
+    # (ssim.py:500-515) divides by (len(betas)-1)**2 and uses kernel_size even
+    # for gaussian kernels, which lets small images reach a scale where the
+    # halo trim exceeds the image and the result is silently NaN.
+    eff_kernel = [int(3.5 * s + 0.5) * 2 + 1 for s in sigma_l] if gaussian_kernel else kernel_size_l
+    _betas_div = 2 ** max(0, len(betas) - 1)
+    for axis, k in zip((-2, -1), eff_kernel[:2]):
+        if preds.shape[axis] // _betas_div <= k - 1:
+            raise ValueError(
+                f"For a given number of `betas` parameters {len(betas)} and kernel size {k},"
+                f" the image height and width must be larger than {(k - 1) * _betas_div}."
+            )
 
-    if preds.shape[-1] < 2 ** len(betas) or preds.shape[-2] < 2 ** len(betas):
-        raise ValueError(
-            f"For a given number of `betas` parameters {len(betas)}, the image height and width dimensions must be"
-            f" larger than or equal to {2 ** len(betas)}."
-        )
-    _betas_div = max(1, (len(betas) - 1)) ** 2
-    if preds.shape[-2] // _betas_div <= kernel_size_l[0] - 1:
-        raise ValueError(
-            f"For a given number of `betas` parameters {len(betas)} and kernel size {kernel_size_l[0]},"
-            f" the image height must be larger than {(kernel_size_l[0] - 1) * _betas_div}."
-        )
-    if preds.shape[-1] // _betas_div <= kernel_size_l[1] - 1:
-        raise ValueError(
-            f"For a given number of `betas` parameters {len(betas)} and kernel size {kernel_size_l[1]},"
-            f" the image width must be larger than {(kernel_size_l[1] - 1) * _betas_div}."
-        )
-
+    # Per-scale statistics are kept PER IMAGE (reduction applied only at the
+    # end). The pinned reference reduces each scale before the beta product
+    # (ssim.py:517-543), making batched results mean-of-scale-means instead of
+    # the canonical mean of per-image MS-SSIM (Wang et al.) — a defect fixed in
+    # later torchmetrics; here the per-image definition is used for every
+    # reduction mode, so 'none' and 'elementwise_mean' are consistent.
     sim_list = []
     cs_list = []
     for _ in range(len(betas)):
         sim, cs = _ssim_compute(
-            preds, target, gaussian_kernel, sigma, kernel_size, reduction, data_range, k1, k2,
+            preds, target, gaussian_kernel, sigma, kernel_size, "none", data_range, k1, k2,
             return_contrast_sensitivity=True,
         )
         if normalize == "relu":
@@ -192,20 +194,17 @@ def _multiscale_ssim_compute(
         preds = _avg_pool(preds, 2)
         target = _avg_pool(target, 2)
 
-    sim_stack = jnp.stack(sim_list)
+    sim_stack = jnp.stack(sim_list)  # (S, B)
     cs_stack = jnp.stack(cs_list)
     if normalize == "simple":
         sim_stack = (sim_stack + 1) / 2
         cs_stack = (cs_stack + 1) / 2
 
     betas_arr = jnp.asarray(betas, dtype=sim_stack.dtype)
-    if reduction in (None, "none"):
-        sim_stack = sim_stack ** betas_arr[:, None]
-        cs_stack = cs_stack ** betas_arr[:, None]
-        return jnp.prod(jnp.concatenate((cs_stack[:-1], sim_stack[-1:]), axis=0), axis=0)
-    sim_stack = sim_stack ** betas_arr
-    cs_stack = cs_stack ** betas_arr
-    return jnp.prod(cs_stack[:-1]) * sim_stack[-1]
+    sim_stack = sim_stack ** betas_arr[:, None]
+    cs_stack = cs_stack ** betas_arr[:, None]
+    per_image = jnp.prod(jnp.concatenate((cs_stack[:-1], sim_stack[-1:]), axis=0), axis=0)
+    return reduce(per_image, reduction)
 
 
 def multiscale_structural_similarity_index_measure(

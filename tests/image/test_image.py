@@ -90,20 +90,21 @@ def _np_avg_pool2(x):
 
 
 def _np_ms_ssim(preds, target, sigma, betas, data_range=None, normalize=None):
+    """Per-image MS-SSIM (canonical Wang et al. form), then batch mean."""
     sims, css = [], []
     for _ in betas:
-        s, c = _np_ssim_cs(preds, target, sigma=sigma, data_range=data_range)
-        s, c = s.mean(), c.mean()
+        s, c = _np_ssim_cs(preds, target, sigma=sigma, data_range=data_range)  # (B,)
         if normalize == "relu":
-            s, c = max(s, 0.0), max(c, 0.0)
+            s, c = np.maximum(s, 0.0), np.maximum(c, 0.0)
         sims.append(s)
         css.append(c)
         preds, target = _np_avg_pool2(preds), _np_avg_pool2(target)
-    sims, css = np.asarray(sims), np.asarray(css)
+    sims, css = np.stack(sims), np.stack(css)  # (S, B)
     if normalize == "simple":
         sims, css = (sims + 1) / 2, (css + 1) / 2
-    betas = np.asarray(betas)
-    return np.prod(css[:-1] ** betas[:-1]) * sims[-1] ** betas[-1]
+    betas = np.asarray(betas)[:, None]
+    per_image = np.prod(css[:-1] ** betas[:-1], axis=0) * sims[-1] ** betas[-1]
+    return per_image.mean()
 
 
 def _np_psnr(preds, target, data_range=None, base=10.0):
@@ -208,6 +209,12 @@ def test_ms_ssim_functional(normalize):
     )
     expected = _np_ms_ssim(p, t, sigma=0.5, betas=betas, normalize=normalize)
     np.testing.assert_allclose(np.asarray(res), expected, atol=1e-4)
+
+
+def test_ms_ssim_small_image_guard():
+    p = jnp.asarray(_rng.random((1, 1, 11, 11)).astype(np.float32))
+    with pytest.raises(ValueError, match="must be larger than"):
+        multiscale_structural_similarity_index_measure(p, p, betas=(0.5, 0.5))
 
 
 @pytest.mark.parametrize("base", [10.0, 2.0])
