@@ -8,6 +8,15 @@ SSIM convs) as jitted XLA programs.
 """
 from metrics_tpu.__about__ import __version__  # noqa: F401
 from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric  # noqa: F401
+from metrics_tpu.audio import (  # noqa: F401
+    PerceptualEvaluationSpeechQuality,
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    ShortTimeObjectiveIntelligibility,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+)
 from metrics_tpu.classification import (  # noqa: F401
     AUC,
     AUROC,
@@ -106,6 +115,11 @@ __all__ = [
     "Metric", "MetricCollection", "CompositionalMetric",
     # aggregation
     "CatMetric", "MaxMetric", "MeanMetric", "MinMetric", "SumMetric",
+    # audio
+    "PerceptualEvaluationSpeechQuality",
+    "PermutationInvariantTraining", "ScaleInvariantSignalDistortionRatio",
+    "ScaleInvariantSignalNoiseRatio", "ShortTimeObjectiveIntelligibility",
+    "SignalDistortionRatio", "SignalNoiseRatio",
     # classification
     "AUC", "AUROC", "Accuracy", "AveragePrecision", "BinnedAveragePrecision",
     "BinnedPrecisionRecallCurve", "BinnedRecallAtFixedPrecision",

@@ -1,0 +1,16 @@
+"""Audio domain metrics (reference: torchmetrics/audio/)."""
+from metrics_tpu.audio.pesq import PerceptualEvaluationSpeechQuality
+from metrics_tpu.audio.pit import PermutationInvariantTraining
+from metrics_tpu.audio.sdr import ScaleInvariantSignalDistortionRatio, SignalDistortionRatio
+from metrics_tpu.audio.snr import ScaleInvariantSignalNoiseRatio, SignalNoiseRatio
+from metrics_tpu.audio.stoi import ShortTimeObjectiveIntelligibility
+
+__all__ = [
+    "PerceptualEvaluationSpeechQuality",
+    "PermutationInvariantTraining",
+    "ScaleInvariantSignalDistortionRatio",
+    "ScaleInvariantSignalNoiseRatio",
+    "ShortTimeObjectiveIntelligibility",
+    "SignalDistortionRatio",
+    "SignalNoiseRatio",
+]

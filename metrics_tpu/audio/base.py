@@ -1,0 +1,31 @@
+"""Shared base for mean-of-batch audio metrics.
+
+Reference pattern (torchmetrics/audio/*.py): every audio module accumulates
+``(sum_metric, total)`` with ``sum`` reduction and computes the mean.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+
+
+class _MeanAudioMetric(Metric):
+    """Accumulates per-sample metric values into (sum, count) states."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_metric", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def _accumulate(self, values: Array) -> None:
+        self.sum_metric = self.sum_metric + values.sum()
+        self.total = self.total + values.size
+
+    def compute(self) -> Array:
+        return self.sum_metric / self.total

@@ -1,0 +1,38 @@
+"""PerceptualEvaluationSpeechQuality module.
+
+Reference parity: torchmetrics/audio/pesq.py:25-118 — delegates to the
+``pesq`` C extension per sample on the host and gates on its availability,
+exactly as the reference does (see ops/audio/pesq.py for the rationale).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from jax import Array
+
+from metrics_tpu.audio.base import _MeanAudioMetric
+from metrics_tpu.ops.audio.pesq import _PESQ_AVAILABLE, perceptual_evaluation_speech_quality
+
+
+class PerceptualEvaluationSpeechQuality(_MeanAudioMetric):
+    """PESQ. Reference: audio/pesq.py:25."""
+
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(self, fs: int, mode: str, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not _PESQ_AVAILABLE:
+            raise ModuleNotFoundError(
+                "PerceptualEvaluationSpeechQuality metric requires that `pesq` is installed."
+                " Either install as `pip install metrics-tpu[audio]` or `pip install pesq`."
+            )
+        if fs not in (8000, 16000):
+            raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+        self.fs = fs
+        if mode not in ("wb", "nb"):
+            raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+        self.mode = mode
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        self._accumulate(perceptual_evaluation_speech_quality(preds, target, self.fs, self.mode))

@@ -1,0 +1,34 @@
+"""PermutationInvariantTraining module.
+
+Reference parity: torchmetrics/audio/pit.py:22-103.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+from jax import Array
+
+from metrics_tpu.audio.base import _MeanAudioMetric
+from metrics_tpu.ops.audio.pit import permutation_invariant_training
+
+
+class PermutationInvariantTraining(_MeanAudioMetric):
+    """PIT wrapper around any pairwise audio metric. Reference: audio/pit.py:22."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, metric_func: Callable, eval_func: str = "max", **kwargs: Any) -> None:
+        base_kwargs: Dict[str, Any] = {
+            k: kwargs.pop(k)
+            for k in ("compute_on_cpu", "dist_sync_on_step", "process_group", "dist_sync_fn", "sync_on_compute")
+            if k in kwargs
+        }
+        super().__init__(**base_kwargs)
+        self.metric_func = metric_func
+        self.eval_func = eval_func
+        self.kwargs = kwargs  # forwarded to metric_func (reference pit.py:83)
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        pit_metric = permutation_invariant_training(preds, target, self.metric_func, self.eval_func, **self.kwargs)[0]
+        self._accumulate(pit_metric)

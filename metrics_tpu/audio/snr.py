@@ -1,0 +1,36 @@
+"""SignalNoiseRatio and ScaleInvariantSignalNoiseRatio modules.
+
+Reference parity: torchmetrics/audio/snr.py:22 (SNR), :97 (SI-SNR).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from jax import Array
+
+from metrics_tpu.audio.base import _MeanAudioMetric
+from metrics_tpu.ops.audio.snr import scale_invariant_signal_noise_ratio, signal_noise_ratio
+
+
+class SignalNoiseRatio(_MeanAudioMetric):
+    """SNR. Reference: audio/snr.py:22-95."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        self._accumulate(signal_noise_ratio(preds=preds, target=target, zero_mean=self.zero_mean))
+
+
+class ScaleInvariantSignalNoiseRatio(_MeanAudioMetric):
+    """SI-SNR. Reference: audio/snr.py:97-155."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        self._accumulate(scale_invariant_signal_noise_ratio(preds=preds, target=target))

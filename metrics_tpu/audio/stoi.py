@@ -1,0 +1,30 @@
+"""ShortTimeObjectiveIntelligibility module.
+
+Reference parity: torchmetrics/audio/stoi.py:25-121 (there a pystoi
+delegation; here backed by the native jax DSP in ops/audio/stoi.py).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from jax import Array
+
+from metrics_tpu.audio.base import _MeanAudioMetric
+from metrics_tpu.ops.audio.stoi import short_time_objective_intelligibility
+
+
+class ShortTimeObjectiveIntelligibility(_MeanAudioMetric):
+    """STOI. Reference: audio/stoi.py:25."""
+
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if fs <= 0:
+            raise ValueError(f"Expected argument `fs` to be a positive integer, but got {fs}")
+        self.fs = fs
+        self.extended = extended
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        self._accumulate(short_time_objective_intelligibility(preds, target, self.fs, self.extended))

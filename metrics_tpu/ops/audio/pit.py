@@ -1,0 +1,89 @@
+"""Permutation Invariant Training (PIT).
+
+Reference parity (torchmetrics/functional/audio/pit.py):
+``_find_best_perm_by_linear_sum_assignment`` (:28 — scipy, host),
+``_find_best_perm_by_exhaustive_method`` (:52), ``permutation_invariant_training``
+(:95), ``pit_permutate`` (:170).
+
+TPU-first redesign: the reference fills the [B, S, S] metric matrix with an
+S^2 Python loop of metric calls (pit.py:141-153); here all speaker pairs are
+evaluated in ONE batched call by broadcasting preds/target to [B*S*S, ...].
+The assignment search is the exhaustive method over the static permutation
+table — fully vectorized/jittable and exact (the reference's scipy Hungarian
+path exists only as a large-S speedup; it breaks jit with a host round-trip).
+For eager calls with S > ``_HUNGARIAN_CUTOVER`` speakers the scipy path is
+used automatically, matching the reference's cutover behavior.
+"""
+from __future__ import annotations
+
+from itertools import permutations
+from typing import Any, Callable, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.utils.checks import _is_concrete
+
+_HUNGARIAN_CUTOVER = 7  # 7! = 5040 permutations; beyond this use scipy eagerly
+
+
+def _metric_matrix(preds: Array, target: Array, metric_func: Callable, **kwargs: Any) -> Array:
+    """[B, S, S] matrix with mtx[b, t, p] = metric(preds[b, p], target[b, t])."""
+    batch_size, spk_num = target.shape[0:2]
+    # broadcast every (target_idx, preds_idx) pair into the batch dim: one call
+    preds_rep = jnp.broadcast_to(preds[:, None, :, ...], (batch_size, spk_num, spk_num) + preds.shape[2:])
+    target_rep = jnp.broadcast_to(target[:, :, None, ...], (batch_size, spk_num, spk_num) + target.shape[2:])
+    flat_preds = preds_rep.reshape((batch_size * spk_num * spk_num,) + preds.shape[2:])
+    flat_target = target_rep.reshape((batch_size * spk_num * spk_num,) + target.shape[2:])
+    vals = metric_func(flat_preds, flat_target, **kwargs)
+    return vals.reshape(batch_size, spk_num, spk_num)
+
+
+def _find_best_perm_exhaustive(metric_mtx: Array, eval_max: bool) -> Tuple[Array, Array]:
+    """Vectorized exhaustive search over the static S! permutation table."""
+    spk_num = metric_mtx.shape[-1]
+    ps = jnp.asarray(list(permutations(range(spk_num))))  # (P, S): target t -> preds ps[:, t]
+    # metric_of_ps[b, p] = mean_t mtx[b, t, ps[p, t]]
+    metric_of_ps = metric_mtx[:, jnp.arange(spk_num)[None, :], ps].mean(axis=-1)  # (B, P)
+    best_idx = jnp.argmax(metric_of_ps, axis=-1) if eval_max else jnp.argmin(metric_of_ps, axis=-1)
+    best_metric = jnp.take_along_axis(metric_of_ps, best_idx[:, None], axis=-1)[:, 0]
+    best_perm = ps[best_idx]
+    return best_metric, best_perm
+
+
+def _find_best_perm_hungarian(metric_mtx: Array, eval_max: bool) -> Tuple[Array, Array]:
+    """Host-side scipy linear-sum-assignment (eager only, large S)."""
+    from scipy.optimize import linear_sum_assignment
+
+    mtx = np.asarray(metric_mtx)
+    best_perm = np.stack([linear_sum_assignment(m, eval_max)[1] for m in mtx])
+    best_perm_j = jnp.asarray(best_perm)
+    best_metric = jnp.take_along_axis(metric_mtx, best_perm_j[:, :, None], axis=2).mean(axis=(-1, -2))
+    return best_metric, best_perm_j
+
+
+def permutation_invariant_training(
+    preds: Array, target: Array, metric_func: Callable, eval_func: str = "max", **kwargs: Any
+) -> Tuple[Array, Array]:
+    """PIT: best metric value and permutation per sample. Reference: pit.py:95-167."""
+    if preds.shape[0:2] != target.shape[0:2]:
+        raise RuntimeError(
+            "Predictions and targets are expected to have the same shape at the batch and speaker dimensions"
+        )
+    if eval_func not in ("max", "min"):
+        raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')
+    if target.ndim < 2:
+        raise ValueError(f"Inputs must be of shape [batch, spk, ...], got {target.shape} and {preds.shape} instead")
+
+    metric_mtx = _metric_matrix(preds, target, metric_func, **kwargs)
+    spk_num = target.shape[1]
+    eval_max = eval_func == "max"
+    if spk_num > _HUNGARIAN_CUTOVER and _is_concrete(metric_mtx):
+        return _find_best_perm_hungarian(metric_mtx, eval_max)
+    return _find_best_perm_exhaustive(metric_mtx, eval_max)
+
+
+def pit_permutate(preds: Array, perm: Array) -> Array:
+    """Reorder ``preds[b, s]`` as ``preds[b, perm[b, s]]``. Reference: pit.py:170-181."""
+    return jnp.take_along_axis(preds, perm.reshape(perm.shape + (1,) * (preds.ndim - 2)), axis=1)

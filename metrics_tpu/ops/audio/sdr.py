@@ -1,0 +1,130 @@
+"""Signal-to-Distortion Ratio (SDR) and scale-invariant SDR.
+
+Reference parity (torchmetrics/functional/audio/sdr.py):
+``_symmetric_toeplitz`` (:45), ``_compute_autocorr_crosscorr`` (:60 — FFT
+auto/cross correlation), ``signal_distortion_ratio`` (:107),
+``scale_invariant_signal_distortion_ratio`` (:222).
+
+TPU-first notes: the reference offers two solvers — direct Gaussian
+elimination on the materialized Toeplitz matrix, or fast_bss_eval's
+preconditioned conjugate gradient (sdr.py:38-42). Here the CG path is native:
+the Toeplitz matvec is expressed as an FFT convolution so CG never
+materializes the [L, L] system, and the whole solve jits onto the device. The
+reference's float64 island (sdr.py:169-171) is kept when x64 is enabled and
+degrades gracefully to float32 otherwise (TPU-preferred).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array, lax
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _symmetric_toeplitz(vector: Array) -> Array:
+    """Symmetric Toeplitz matrix from its first row: out[..., i, j] = v[|i-j|]."""
+    v_len = vector.shape[-1]
+    idx = jnp.abs(jnp.arange(v_len)[:, None] - jnp.arange(v_len)[None, :])
+    return vector[..., idx]
+
+
+def _compute_autocorr_crosscorr(target: Array, preds: Array, corr_len: int) -> Tuple[Array, Array]:
+    """FFT-based autocorrelation of target and cross-correlation with preds."""
+    n_fft = 2 ** math.ceil(math.log2(preds.shape[-1] + target.shape[-1] - 1))
+    t_fft = jnp.fft.rfft(target, n=n_fft, axis=-1)
+    r_0 = jnp.fft.irfft(t_fft.real ** 2 + t_fft.imag ** 2, n=n_fft)[..., :corr_len]
+    p_fft = jnp.fft.rfft(preds, n=n_fft, axis=-1)
+    b = jnp.fft.irfft(jnp.conj(t_fft) * p_fft, n=n_fft, axis=-1)[..., :corr_len]
+    return r_0, b
+
+
+def _toeplitz_matvec(r_0: Array, x: Array) -> Array:
+    """Matvec ``T(r_0) @ x`` via FFT circular embedding — no [L, L] matrix."""
+    l = r_0.shape[-1]
+    # first column of the circulant embedding: [r0, r1.. r_{l-1}, 0, r_{l-1}.. r1]
+    c = jnp.concatenate([r_0, jnp.zeros_like(r_0[..., :1]), jnp.flip(r_0[..., 1:], axis=-1)], axis=-1)
+    n = c.shape[-1]
+    prod = jnp.fft.irfft(jnp.fft.rfft(c, axis=-1) * jnp.fft.rfft(x, n=n, axis=-1), n=n, axis=-1)
+    return prod[..., :l]
+
+
+def _toeplitz_conjugate_gradient(r_0: Array, b: Array, n_iter: int = 10) -> Array:
+    """Solve ``T(r_0) x = b`` with ``n_iter`` CG steps (static unrolled scan)."""
+
+    def step(carry, _):
+        x, r, p, rs = carry
+        ap = _toeplitz_matvec(r_0, p)
+        denom = jnp.sum(p * ap, axis=-1, keepdims=True)
+        alpha = rs / jnp.where(denom == 0, 1.0, denom)
+        x = x + alpha * p
+        r = r - alpha * ap
+        rs_new = jnp.sum(r * r, axis=-1, keepdims=True)
+        beta = rs_new / jnp.where(rs == 0, 1.0, rs)
+        p = r + beta * p
+        return (x, r, p, rs_new), None
+
+    x0 = jnp.zeros_like(b)
+    rs0 = jnp.sum(b * b, axis=-1, keepdims=True)
+    (x, _, _, _), _ = lax.scan(step, (x0, b, b, rs0), None, length=n_iter)
+    return x
+
+
+def signal_distortion_ratio(
+    preds: Array,
+    target: Array,
+    use_cg_iter: Optional[int] = None,
+    filter_length: int = 512,
+    zero_mean: bool = False,
+    load_diag: Optional[float] = None,
+) -> Array:
+    """SDR. Reference: sdr.py:107-220."""
+    _check_same_shape(preds, target)
+    orig_dtype = preds.dtype
+    # float64 island when enabled (reference sdr.py:169-171); f32 otherwise
+    wide = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    preds = preds.astype(wide)
+    target = target.astype(wide)
+
+    if zero_mean:
+        preds = preds - preds.mean(axis=-1, keepdims=True)
+        target = target - target.mean(axis=-1, keepdims=True)
+
+    target = target / jnp.clip(jnp.linalg.norm(target, axis=-1, keepdims=True), 1e-6, None)
+    preds = preds / jnp.clip(jnp.linalg.norm(preds, axis=-1, keepdims=True), 1e-6, None)
+
+    r_0, b = _compute_autocorr_crosscorr(target, preds, corr_len=filter_length)
+    if load_diag is not None:
+        r_0 = r_0.at[..., 0].add(load_diag)
+
+    if use_cg_iter is not None:
+        sol = _toeplitz_conjugate_gradient(r_0, b, n_iter=use_cg_iter)
+    else:
+        r = _symmetric_toeplitz(r_0)
+        sol = jnp.linalg.solve(r, b[..., None])[..., 0]
+
+    coh = jnp.einsum("...l,...l->...", b, sol)
+    ratio = coh / (1 - coh)
+    val = 10.0 * jnp.log10(ratio)
+    return val if orig_dtype == jnp.float64 else val.astype(jnp.float32)
+
+
+def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SI-SDR. Reference: sdr.py:222-268."""
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    alpha = (jnp.sum(preds * target, axis=-1, keepdims=True) + eps) / (
+        jnp.sum(target ** 2, axis=-1, keepdims=True) + eps
+    )
+    target_scaled = alpha * target
+    noise = target_scaled - preds
+    val = (jnp.sum(target_scaled ** 2, axis=-1) + eps) / (jnp.sum(noise ** 2, axis=-1) + eps)
+    return 10 * jnp.log10(val)

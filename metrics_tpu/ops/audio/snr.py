@@ -1,0 +1,30 @@
+"""Signal-to-Noise Ratio and scale-invariant SNR.
+
+Reference parity (torchmetrics/functional/audio/snr.py):
+``signal_noise_ratio`` (:22), ``scale_invariant_signal_noise_ratio`` (:73 —
+SI-SDR with forced zero-mean).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.audio.sdr import scale_invariant_signal_distortion_ratio
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SNR in dB over the last (time) axis. Reference: snr.py:22-70."""
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+    noise = target - preds
+    snr_value = (jnp.sum(target ** 2, axis=-1) + eps) / (jnp.sum(noise ** 2, axis=-1) + eps)
+    return 10 * jnp.log10(snr_value)
+
+
+def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
+    """SI-SNR. Reference: snr.py:73-102."""
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)

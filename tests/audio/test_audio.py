@@ -1,0 +1,360 @@
+"""Audio metric parity vs independent numpy/scipy oracles.
+
+Reference parity: tests/audio/test_snr.py, test_sdr.py, test_si_sdr.py,
+test_pit.py, test_stoi.py. Oracles are hand-rolled numpy (SNR family), a
+scipy ``solve_toeplitz`` SDR implementation (an independent solver path from
+the FFT+linalg.solve/CG used in the library), scipy ``linear_sum_assignment``
+for PIT, and a dynamic-shape numpy STOI following the published algorithm.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.linalg import solve_toeplitz
+from scipy.optimize import linear_sum_assignment
+
+from metrics_tpu.audio import (
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    ShortTimeObjectiveIntelligibility,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+)
+from metrics_tpu.ops.audio import (
+    permutation_invariant_training,
+    pit_permutate,
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    short_time_objective_intelligibility,
+    signal_distortion_ratio,
+    signal_noise_ratio,
+)
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.default_rng(11)
+NB, BS, T = 4, 4, 2000
+PREDS = _rng.normal(size=(NB, BS, T)).astype(np.float32)
+TARGET = (0.8 * PREDS + 0.4 * _rng.normal(size=(NB, BS, T))).astype(np.float32)
+
+
+# --------------------------------------------------------------------------- #
+# oracles
+# --------------------------------------------------------------------------- #
+def _np_snr(preds, target, zero_mean=False):
+    p, t = preds.astype(np.float64), target.astype(np.float64)
+    if zero_mean:
+        p = p - p.mean(-1, keepdims=True)
+        t = t - t.mean(-1, keepdims=True)
+    noise = t - p
+    return 10 * np.log10(np.sum(t ** 2, -1) / np.sum(noise ** 2, -1))
+
+
+def _np_si_sdr(preds, target, zero_mean=False):
+    p, t = preds.astype(np.float64), target.astype(np.float64)
+    if zero_mean:
+        p = p - p.mean(-1, keepdims=True)
+        t = t - t.mean(-1, keepdims=True)
+    alpha = np.sum(p * t, -1, keepdims=True) / np.sum(t ** 2, -1, keepdims=True)
+    ts = alpha * t
+    return 10 * np.log10(np.sum(ts ** 2, -1) / np.sum((ts - p) ** 2, -1))
+
+
+def _np_sdr(preds, target, filter_length=512):
+    """Projection-based SDR via scipy solve_toeplitz (independent solver)."""
+    out = np.empty(preds.shape[:-1])
+    flat_p = preds.reshape(-1, preds.shape[-1]).astype(np.float64)
+    flat_t = target.reshape(-1, target.shape[-1]).astype(np.float64)
+    for i, (p, t) in enumerate(zip(flat_p, flat_t)):
+        t = t / max(np.linalg.norm(t), 1e-6)
+        p = p / max(np.linalg.norm(p), 1e-6)
+        n_fft = 2 ** int(np.ceil(np.log2(p.shape[-1] + t.shape[-1] - 1)))
+        t_fft = np.fft.rfft(t, n=n_fft)
+        r = np.fft.irfft(np.abs(t_fft) ** 2, n=n_fft)[:filter_length]
+        b = np.fft.irfft(np.conj(t_fft) * np.fft.rfft(p, n=n_fft), n=n_fft)[:filter_length]
+        sol = solve_toeplitz(r, b)
+        coh = b @ sol
+        out.reshape(-1)[i] = 10 * np.log10(coh / (1 - coh))
+    return out
+
+
+def _np_stoi(x, y, extended=False):
+    """Dynamic-shape numpy STOI (published algorithm, pystoi constants)."""
+    FS, NF, NFFT_, J, MIN_F, N, BETA, DYN = 10000, 256, 512, 15, 150, 30, -15.0, 40.0
+    EPS = np.finfo(np.float64).eps
+    x, y = x.astype(np.float64), y.astype(np.float64)
+
+    w = np.hanning(NF + 2)[1:-1]
+    hop = NF // 2
+    frames = range(0, len(x) - NF + 1, hop)
+    x_frames = np.array([w * x[i : i + NF] for i in frames])
+    y_frames = np.array([w * y[i : i + NF] for i in frames])
+    energies = 20 * np.log10(np.linalg.norm(x_frames, axis=1) + EPS)
+    mask = (np.max(energies) - DYN - energies) < 0
+    x_frames, y_frames = x_frames[mask], y_frames[mask]
+
+    def ola(frames):
+        buf = np.zeros((len(frames) - 1) * hop + NF)
+        for i, f in enumerate(frames):
+            buf[i * hop : i * hop + NF] += f
+        return buf
+
+    x_sil, y_sil = ola(x_frames), ola(y_frames)
+
+    f = np.linspace(0, FS / 2, NFFT_ // 2 + 1)
+    k = np.arange(J)
+    fl = MIN_F * 2.0 ** ((2 * k - 1) / 6.0)
+    fh = MIN_F * 2.0 ** ((2 * k + 1) / 6.0)
+    obm = np.zeros((J, len(f)))
+    for i in range(J):
+        obm[i, np.argmin((f - fl[i]) ** 2) : np.argmin((f - fh[i]) ** 2)] = 1
+
+    def bands(sig):
+        frames = np.array([w * sig[i : i + NF] for i in range(0, len(sig) - NF + 1, hop)])
+        spec = np.fft.rfft(frames, n=NFFT_, axis=-1)
+        return np.sqrt((np.abs(spec) ** 2) @ obm.T).T  # (J, M)
+
+    X, Y = bands(x_sil), bands(y_sil)
+    M = X.shape[1]
+    scores = []
+    for m in range(N, M + 1):
+        Xs, Ys = X[:, m - N : m], Y[:, m - N : m]
+        if extended:
+            def rcnorm(a):
+                a = a - a.mean(-1, keepdims=True)
+                a = a / (np.linalg.norm(a, axis=-1, keepdims=True) + EPS)
+                a = a - a.mean(0, keepdims=True)
+                return a / (np.linalg.norm(a, axis=0, keepdims=True) + EPS)
+            scores.append(np.sum(rcnorm(Xs) * rcnorm(Ys)) / N)
+        else:
+            alpha = np.linalg.norm(Xs, axis=-1, keepdims=True) / (np.linalg.norm(Ys, axis=-1, keepdims=True) + EPS)
+            Yp = np.minimum(alpha * Ys, Xs * (1 + 10 ** (-BETA / 20)))
+            xn = Xs - Xs.mean(-1, keepdims=True)
+            yn = Yp - Yp.mean(-1, keepdims=True)
+            corr = np.sum(xn * yn, -1) / (np.linalg.norm(xn, axis=-1) * np.linalg.norm(yn, axis=-1) + EPS)
+            scores.append(corr.mean())
+    return np.mean(scores)
+
+
+# --------------------------------------------------------------------------- #
+# functional parity
+# --------------------------------------------------------------------------- #
+@pytest.mark.parametrize("zero_mean", [False, True])
+def test_snr_functional(zero_mean):
+    res = signal_noise_ratio(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]), zero_mean=zero_mean)
+    np.testing.assert_allclose(np.asarray(res), _np_snr(PREDS[0], TARGET[0], zero_mean), rtol=1e-4)
+
+
+@pytest.mark.parametrize("zero_mean", [False, True])
+def test_si_sdr_functional(zero_mean):
+    res = scale_invariant_signal_distortion_ratio(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]), zero_mean=zero_mean)
+    np.testing.assert_allclose(np.asarray(res), _np_si_sdr(PREDS[0], TARGET[0], zero_mean), rtol=1e-4)
+
+
+def test_si_snr_functional():
+    res = scale_invariant_signal_noise_ratio(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+    np.testing.assert_allclose(np.asarray(res), _np_si_sdr(PREDS[0], TARGET[0], zero_mean=True), rtol=1e-4)
+
+
+@pytest.mark.parametrize("filter_length", [128, 512])
+def test_sdr_functional_vs_scipy_toeplitz(filter_length):
+    res = signal_distortion_ratio(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]), filter_length=filter_length)
+    want = _np_sdr(PREDS[0], TARGET[0], filter_length)
+    np.testing.assert_allclose(np.asarray(res), want, rtol=1e-2, atol=5e-3)
+
+
+def test_sdr_cg_close_to_direct():
+    direct = signal_distortion_ratio(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]), filter_length=128)
+    cg = signal_distortion_ratio(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]), filter_length=128, use_cg_iter=50)
+    np.testing.assert_allclose(np.asarray(cg), np.asarray(direct), atol=2e-2)
+
+
+def test_sdr_jittable():
+    f = jax.jit(lambda p, t: signal_distortion_ratio(p, t, filter_length=128, use_cg_iter=10))
+    out = f(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
+# --------------------------------------------------------------------------- #
+# PIT
+# --------------------------------------------------------------------------- #
+@pytest.mark.parametrize("n_spk", [2, 3])
+@pytest.mark.parametrize("eval_func", ["max", "min"])
+def test_pit_vs_scipy(n_spk, eval_func):
+    rng = np.random.default_rng(77 + n_spk)
+    preds = jnp.asarray(rng.normal(size=(5, n_spk, 500)).astype(np.float32))
+    target = jnp.asarray(rng.normal(size=(5, n_spk, 500)).astype(np.float32))
+    best_metric, best_perm = permutation_invariant_training(
+        preds, target, scale_invariant_signal_distortion_ratio, eval_func
+    )
+    # oracle: metric matrix + scipy assignment
+    mtx = np.empty((5, n_spk, n_spk))
+    for t in range(n_spk):
+        for p in range(n_spk):
+            mtx[:, t, p] = _np_si_sdr(np.asarray(preds)[:, p], np.asarray(target)[:, t])
+    for b in range(5):
+        rows, cols = linear_sum_assignment(mtx[b], maximize=(eval_func == "max"))
+        want = mtx[b][rows, cols].mean()
+        np.testing.assert_allclose(float(best_metric[b]), want, rtol=1e-3, atol=1e-2)
+        np.testing.assert_allclose(np.asarray(best_perm[b]), cols)
+
+
+def test_pit_permutate():
+    preds = jnp.asarray(_rng.normal(size=(2, 3, 10)).astype(np.float32))
+    perm = jnp.asarray([[2, 0, 1], [0, 1, 2]])
+    out = pit_permutate(preds, perm)
+    np.testing.assert_allclose(np.asarray(out[0, 0]), np.asarray(preds[0, 2]))
+    np.testing.assert_allclose(np.asarray(out[1]), np.asarray(preds[1]))
+
+
+def test_pit_jittable():
+    preds = jnp.asarray(_rng.normal(size=(3, 2, 200)).astype(np.float32))
+    target = jnp.asarray(_rng.normal(size=(3, 2, 200)).astype(np.float32))
+    f = jax.jit(
+        lambda p, t: permutation_invariant_training(p, t, scale_invariant_signal_distortion_ratio, "max")[0]
+    )
+    eager = permutation_invariant_training(preds, target, scale_invariant_signal_distortion_ratio, "max")[0]
+    np.testing.assert_allclose(np.asarray(f(preds, target)), np.asarray(eager), rtol=1e-5)
+
+
+def test_pit_validation():
+    with pytest.raises(ValueError, match="eval_func"):
+        permutation_invariant_training(
+            jnp.zeros((2, 2, 10)), jnp.zeros((2, 2, 10)), scale_invariant_signal_distortion_ratio, "med"
+        )
+    with pytest.raises(RuntimeError, match="same shape"):
+        permutation_invariant_training(
+            jnp.zeros((2, 2, 10)), jnp.zeros((2, 3, 10)), scale_invariant_signal_distortion_ratio
+        )
+
+
+# --------------------------------------------------------------------------- #
+# STOI
+# --------------------------------------------------------------------------- #
+@pytest.mark.parametrize("extended", [False, True])
+def test_stoi_vs_numpy_oracle(extended):
+    t = np.sin(2 * np.pi * 440 * np.arange(20000) / 10000) + 0.1 * _rng.normal(size=20000)
+    # insert silence so the silent-frame removal path is exercised
+    t[5000:8000] = 1e-6 * _rng.normal(size=3000)
+    p = t + 0.5 * _rng.normal(size=20000)
+    got = float(short_time_objective_intelligibility(jnp.asarray(p, dtype=jnp.float32), jnp.asarray(t, dtype=jnp.float32), fs=10000, extended=extended))
+    want = _np_stoi(t, p, extended=extended)
+    np.testing.assert_allclose(got, want, atol=2e-3)
+
+
+def test_stoi_perfect_signal_high():
+    t = np.sin(2 * np.pi * 300 * np.arange(16000) / 10000).astype(np.float32)
+    got = float(short_time_objective_intelligibility(jnp.asarray(t), jnp.asarray(t), fs=10000))
+    assert got > 0.99
+
+
+def test_stoi_resample_path():
+    t = _rng.normal(size=(2, 16000)).astype(np.float32)
+    p = (t + 0.3 * _rng.normal(size=(2, 16000))).astype(np.float32)
+    vals = short_time_objective_intelligibility(jnp.asarray(p), jnp.asarray(t), fs=16000)
+    assert vals.shape == (2,)
+    assert bool(jnp.all(jnp.isfinite(vals)))
+
+
+# --------------------------------------------------------------------------- #
+# module classes incl. ddp
+# --------------------------------------------------------------------------- #
+class TestAudioModules(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_snr_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=PREDS,
+            target=TARGET,
+            metric_class=SignalNoiseRatio,
+            sk_metric=lambda p, t: _np_snr(p, t).mean(),
+            check_batch=True,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_si_sdr_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=PREDS,
+            target=TARGET,
+            metric_class=ScaleInvariantSignalDistortionRatio,
+            sk_metric=lambda p, t: _np_si_sdr(p, t).mean(),
+            check_batch=True,
+        )
+
+    def test_si_snr_class(self):
+        self.run_class_metric_test(
+            ddp=False,
+            preds=PREDS,
+            target=TARGET,
+            metric_class=ScaleInvariantSignalNoiseRatio,
+            sk_metric=lambda p, t: _np_si_sdr(p, t, zero_mean=True).mean(),
+            check_batch=True,
+        )
+
+    def test_sdr_class(self):
+        self.run_class_metric_test(
+            ddp=False,
+            preds=PREDS,
+            target=TARGET,
+            metric_class=SignalDistortionRatio,
+            sk_metric=lambda p, t: _np_sdr(p, t, filter_length=128).mean(),
+            metric_args={"filter_length": 128},
+            check_batch=False,
+        )
+
+    def test_pit_class(self):
+        preds = _rng.normal(size=(2, 3, 2, 400)).astype(np.float32)
+        target = _rng.normal(size=(2, 3, 2, 400)).astype(np.float32)
+
+        def oracle(p, t):
+            vals = []
+            for b in range(p.shape[0]):
+                mtx = np.empty((2, 2))
+                for ti in range(2):
+                    for pi in range(2):
+                        mtx[ti, pi] = _np_si_sdr(p[b, pi], t[b, ti])
+                rows, cols = linear_sum_assignment(mtx, maximize=True)
+                vals.append(mtx[rows, cols].mean())
+            return np.mean(vals)
+
+        self.run_class_metric_test(
+            ddp=False,
+            preds=preds,
+            target=target,
+            metric_class=PermutationInvariantTraining,
+            sk_metric=oracle,
+            metric_args={"metric_func": scale_invariant_signal_distortion_ratio, "eval_func": "max"},
+            check_batch=True,
+        )
+
+    def test_stoi_class(self):
+        t = _rng.normal(size=(2, 2, 12000)).astype(np.float32)
+        p = (t + 0.5 * _rng.normal(size=(2, 2, 12000))).astype(np.float32)
+        self.run_class_metric_test(
+            ddp=False,
+            preds=p,
+            target=t,
+            metric_class=ShortTimeObjectiveIntelligibility,
+            sk_metric=lambda pp, tt: np.mean([_np_stoi(tt[i], pp[i]) for i in range(pp.shape[0])]),
+            metric_args={"fs": 10000},
+            check_batch=True,
+        )
+
+    def test_pesq_gating(self):
+        from metrics_tpu.ops.audio.pesq import _PESQ_AVAILABLE
+
+        if not _PESQ_AVAILABLE:
+            from metrics_tpu.audio import PerceptualEvaluationSpeechQuality
+
+            with pytest.raises(ModuleNotFoundError, match="pesq"):
+                PerceptualEvaluationSpeechQuality(fs=16000, mode="wb")
+
+    def test_differentiability(self):
+        self.run_differentiability_test(PREDS, TARGET, signal_noise_ratio)
+        self.run_differentiability_test(PREDS, TARGET, scale_invariant_signal_distortion_ratio)
+
+    def test_precision_bf16(self):
+        self.run_precision_test(PREDS, TARGET, lambda p, t: signal_noise_ratio(p, t.astype(p.dtype)))
