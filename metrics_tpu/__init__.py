@@ -47,6 +47,7 @@ from metrics_tpu.classification import (  # noqa: F401
     StatScores,
 )
 from metrics_tpu.core import CompositionalMetric, Metric, MetricCollection  # noqa: F401
+from metrics_tpu.detection import MeanAveragePrecision  # noqa: F401
 from metrics_tpu.image import (  # noqa: F401
     ErrorRelativeGlobalDimensionlessSynthesis,
     FrechetInceptionDistance,
@@ -128,6 +129,8 @@ __all__ = [
     "JaccardIndex", "KLDivergence", "LabelRankingAveragePrecision",
     "LabelRankingLoss", "MatthewsCorrCoef", "Precision", "PrecisionRecallCurve",
     "Recall", "ROC", "Specificity", "StatScores",
+    # detection
+    "MeanAveragePrecision",
     # image
     "ErrorRelativeGlobalDimensionlessSynthesis", "FrechetInceptionDistance",
     "InceptionScore", "KernelInceptionDistance",

@@ -1,0 +1,4 @@
+"""Detection domain metrics (reference: torchmetrics/detection/)."""
+from metrics_tpu.detection.mean_ap import MeanAveragePrecision
+
+__all__ = ["MeanAveragePrecision"]

@@ -1,0 +1,388 @@
+"""MeanAveragePrecision (COCO mAP / mAR).
+
+Reference parity: torchmetrics/detection/mean_ap.py:199-944 — COCO-faithful
+mAP/mAR over 10 IoU x 101 recall thresholds, 4 area ranges, 3 max-detection
+thresholds, bbox and segm IoU types, ``class_metrics`` per-class mode.
+
+TPU-first redesign (SURVEY.md §7 hard part 2):
+
+- the reference's per-(image, class) Python loops with ragged tensors
+  (mean_ap.py:711-745) become ONE padded device kernel per image
+  (ops/detection/matching.py) evaluating all classes x area ranges x IoU
+  thresholds with a single score-ordered scan; IoU matrices are computed once
+  per image for all pairs (ops/detection/boxes.py) instead of per class;
+- masks are dense device arrays matched on the MXU via one matmul
+  (boxes.py:mask_iou) instead of pycocotools RLE strings (mean_ap.py:113-142);
+- the final precision/recall-curve interpolation over the fixed
+  [T, R, K, A, M] grid is vectorized numpy on host — it is O(grid) tiny and
+  inherently ragged across images, exactly the reference's epoch-end code path
+  (mean_ap.py:803-871).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.detection.boxes import box_area, box_convert, box_iou, mask_area, mask_iou
+from metrics_tpu.ops.detection.matching import match_image
+from metrics_tpu.parallel import sync as _sync
+
+_BBOX_AREA_RANGES = {
+    "all": (0.0, 1e10),
+    "small": (0.0, 32.0 ** 2),
+    "medium": (32.0 ** 2, 96.0 ** 2),
+    "large": (96.0 ** 2, 1e10),
+}
+
+
+def _fix_empty_tensors(boxes: Array) -> Array:
+    """Empty tensors get a (0, 4) shape so downstream ops don't crash
+    (reference mean_ap.py:191-196)."""
+    boxes = jnp.asarray(boxes)
+    if boxes.size == 0 and boxes.ndim == 1:
+        return boxes.reshape(0, 4)
+    return boxes
+
+
+def _input_validator(preds: Sequence[Dict], targets: Sequence[Dict], iou_type: str = "bbox") -> None:
+    """Validate the COCO-style list-of-dicts inputs (reference mean_ap.py:146-188)."""
+    item_val_name = "boxes" if iou_type == "bbox" else "masks"
+    if not isinstance(preds, Sequence):
+        raise ValueError("Expected argument `preds` to be of type Sequence")
+    if not isinstance(targets, Sequence):
+        raise ValueError("Expected argument `target` to be of type Sequence")
+    if len(preds) != len(targets):
+        raise ValueError("Expected argument `preds` and `target` to have the same length")
+    for k in (item_val_name, "scores", "labels"):
+        if any(k not in p for p in preds):
+            raise ValueError(f"Expected all dicts in `preds` to contain the `{k}` key")
+    for k in (item_val_name, "labels"):
+        if any(k not in p for p in targets):
+            raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
+    for item in preds:
+        if len(item[item_val_name]) != len(item["scores"]) or len(item[item_val_name]) != len(item["labels"]):
+            raise ValueError(
+                f"Input {item_val_name}, scores and labels of sample must have a length equal to each other"
+            )
+    for item in targets:
+        if len(item[item_val_name]) != len(item["labels"]):
+            raise ValueError(f"Input {item_val_name} and labels of sample must have a length equal to each other")
+
+
+def _next_bucket(n: int, minimum: int = 8) -> int:
+    """Pad sizes to power-of-2 buckets to bound jit recompilation."""
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+class MeanAveragePrecision(Metric):
+    """COCO mAP/mAR. Reference: detection/mean_ap.py:199."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_type: str = "bbox",
+        iou_thresholds: Optional[List[float]] = None,
+        rec_thresholds: Optional[List[float]] = None,
+        max_detection_thresholds: Optional[List[int]] = None,
+        class_metrics: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+
+        allowed_box_formats = ("xyxy", "xywh", "cxcywh")
+        if box_format not in allowed_box_formats:
+            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+        self.box_format = box_format
+        allowed_iou_types = ("segm", "bbox")
+        if iou_type not in allowed_iou_types:
+            raise ValueError(f"Expected argument `iou_type` to be one of {allowed_iou_types} but got {iou_type}")
+        self.iou_type = iou_type
+
+        self.iou_thresholds = iou_thresholds or np.arange(0.5, 1.0, 0.05).round(2).tolist()
+        self.rec_thresholds = rec_thresholds or np.linspace(0.0, 1.00, int(np.round((1.00 - 0.0) / 0.01)) + 1).tolist()
+        max_det_thr = sorted(max_detection_thresholds or [1, 10, 100])
+        self.max_detection_thresholds = max_det_thr
+        self.bbox_area_ranges = _BBOX_AREA_RANGES
+
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+
+        self.add_state("detections", default=[], dist_reduce_fx=None)
+        self.add_state("detection_scores", default=[], dist_reduce_fx=None)
+        self.add_state("detection_labels", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruths", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
+
+    # ------------------------------------------------------------------ #
+    # update
+    # ------------------------------------------------------------------ #
+    def _get_safe_item_values(self, item: Dict) -> Array:
+        if self.iou_type == "bbox":
+            boxes = _fix_empty_tensors(jnp.asarray(item["boxes"], dtype=jnp.float32))
+            return box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+        # segm: dense binary masks [N, H, W] (device-native; RLE is a CPU
+        # string format — see ops/detection/boxes.py:mask_iou)
+        return jnp.asarray(item["masks"], dtype=bool)
+
+    def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:  # type: ignore[override]
+        _input_validator(preds, target, iou_type=self.iou_type)
+        for item in preds:
+            self.detections.append(self._get_safe_item_values(item))
+            self.detection_labels.append(jnp.asarray(item["labels"], dtype=jnp.int32).reshape(-1))
+            self.detection_scores.append(jnp.asarray(item["scores"], dtype=jnp.float32).reshape(-1))
+        for item in target:
+            self.groundtruths.append(self._get_safe_item_values(item))
+            self.groundtruth_labels.append(jnp.asarray(item["labels"], dtype=jnp.int32).reshape(-1))
+
+    def _get_classes(self) -> List[int]:
+        if len(self.detection_labels) > 0 or len(self.groundtruth_labels) > 0:
+            all_labels = np.concatenate(
+                [np.asarray(lab).reshape(-1) for lab in self.detection_labels + self.groundtruth_labels]
+            )
+            return np.unique(all_labels).astype(int).tolist()
+        return []
+
+    # ------------------------------------------------------------------ #
+    # per-image device evaluation
+    # ------------------------------------------------------------------ #
+    def _evaluate_image_device(self, idx: int, classes: List[int]) -> Optional[Dict[str, np.ndarray]]:
+        """Run the padded matching kernel for one image; return numpy results.
+
+        Output dict (K = len(classes), A = areas, T = iou thresholds):
+        ``det_matches (K, A, T, D)``, plus the sorted scores/labels/area-ignore
+        of the image's detections and the gt labels/area-ignore flags.
+        """
+        det = self.detections[idx]
+        gt = self.groundtruths[idx]
+        det_labels = np.asarray(self.detection_labels[idx])
+        gt_labels = np.asarray(self.groundtruth_labels[idx])
+        scores = np.asarray(self.detection_scores[idx])
+        n_det, n_gt = len(det_labels), len(gt_labels)
+        if n_det == 0 and n_gt == 0:
+            return None
+
+        order = np.argsort(-scores, kind="stable")
+        scores_sorted = scores[order]
+        det_labels_sorted = det_labels[order]
+
+        if self.iou_type == "bbox":
+            det_areas = np.asarray(box_area(det)) if n_det else np.zeros(0)
+            gt_areas = np.asarray(box_area(gt)) if n_gt else np.zeros(0)
+        else:
+            det_areas = np.asarray(mask_area(det)) if n_det else np.zeros(0)
+            gt_areas = np.asarray(mask_area(gt)) if n_gt else np.zeros(0)
+        det_areas_sorted = det_areas[order]
+
+        area_ranges = np.asarray(list(self.bbox_area_ranges.values()))  # (A, 2)
+        det_area_ignore = (det_areas_sorted[None, :] < area_ranges[:, :1]) | (
+            det_areas_sorted[None, :] > area_ranges[:, 1:]
+        )  # (A, D)
+        gt_area_ignore = (gt_areas[None, :] < area_ranges[:, :1]) | (gt_areas[None, :] > area_ranges[:, 1:])
+
+        max_det = self.max_detection_thresholds[-1]
+        classes_arr = np.asarray(classes)
+        det_class = det_labels_sorted[None, :] == classes_arr[:, None]  # (K, D)
+        # per-class rank cap at the largest max-detection threshold
+        rank_in_class = np.cumsum(det_class, axis=1)
+        det_class_valid = det_class & (rank_in_class <= max_det)
+        gt_class_valid = gt_labels[None, :] == classes_arr[:, None]  # (K, G)
+
+        if n_det > 0 and n_gt > 0:
+            # pad to buckets for the jitted kernel; reorder on device (masks
+            # especially are H*W-sized — no host round-trip)
+            pd, pg = _next_bucket(n_det), _next_bucket(n_gt)
+            det_sorted = jnp.asarray(det)[jnp.asarray(order)]
+            ious = (box_iou if self.iou_type == "bbox" else mask_iou)(det_sorted, jnp.asarray(gt))  # (D, G)
+            ious_p = jnp.zeros((pd, pg), dtype=jnp.float32).at[:n_det, :n_gt].set(ious)
+            dcv = jnp.zeros((len(classes), pd), dtype=bool).at[:, :n_det].set(det_class_valid)
+            gcv = jnp.zeros((len(classes), pg), dtype=bool).at[:, :n_gt].set(gt_class_valid)
+            gia = jnp.zeros((len(area_ranges), pg), dtype=bool).at[:, :n_gt].set(gt_area_ignore)
+            det_matches, _ = match_image(ious_p, dcv, gcv, gia, jnp.asarray(self.iou_thresholds))
+            det_matches = np.asarray(det_matches)[..., :n_det]  # (K, A, T, D)
+        else:
+            det_matches = np.zeros((len(classes), len(area_ranges), len(self.iou_thresholds), n_det), dtype=bool)
+
+        return {
+            "det_matches": det_matches,
+            "scores_sorted": scores_sorted,
+            "det_class_valid": det_class_valid,  # (K, D) incl. top-maxdet cap
+            "det_area_ignore": det_area_ignore,  # (A, D)
+            "gt_class_valid": gt_class_valid,  # (K, G)
+            "gt_area_ignore": gt_area_ignore,  # (A, G)
+        }
+
+    # ------------------------------------------------------------------ #
+    # host-side curve aggregation (reference mean_ap.py:803-871)
+    # ------------------------------------------------------------------ #
+    def _calculate(self, class_ids: List[int]) -> Tuple[np.ndarray, np.ndarray]:
+        nb_iou_thrs = len(self.iou_thresholds)
+        nb_rec_thrs = len(self.rec_thresholds)
+        nb_classes = len(class_ids)
+        nb_areas = len(self.bbox_area_ranges)
+        nb_mdt = len(self.max_detection_thresholds)
+
+        precision = -np.ones((nb_iou_thrs, nb_rec_thrs, nb_classes, nb_areas, nb_mdt))
+        recall = -np.ones((nb_iou_thrs, nb_classes, nb_areas, nb_mdt))
+        rec_thrs = np.asarray(self.rec_thresholds)
+
+        evals = [self._evaluate_image_device(i, class_ids) for i in range(len(self.groundtruths))]
+
+        for idx_cls in range(nb_classes):
+            for idx_area in range(nb_areas):
+                # gather per-image per-class results once; the max-det loop trims
+                img_data = []
+                npig = 0
+                for ev in evals:
+                    if ev is None:
+                        continue
+                    det_sel = ev["det_class_valid"][idx_cls]  # (D,) bool
+                    gt_sel = ev["gt_class_valid"][idx_cls]
+                    if not det_sel.any() and not gt_sel.any():
+                        continue
+                    npig += int(np.sum(gt_sel & ~ev["gt_area_ignore"][idx_area]))
+                    img_data.append(
+                        (
+                            ev["scores_sorted"][det_sel],
+                            ev["det_matches"][idx_cls, idx_area, :, det_sel].T,  # (T, n)
+                            ev["det_area_ignore"][idx_area][det_sel],  # (n,)
+                        )
+                    )
+                if npig == 0 or not img_data:
+                    continue
+                for idx_mdt, max_det in enumerate(self.max_detection_thresholds):
+                    det_scores = np.concatenate([s[:max_det] for s, _, _ in img_data])
+                    matches = np.concatenate([m[:, :max_det] for _, m, _ in img_data], axis=1)  # (T, N)
+                    area_ign = np.concatenate([a[:max_det] for _, _, a in img_data])  # (N,)
+                    inds = np.argsort(-det_scores, kind="stable")
+                    matches = matches[:, inds]
+                    area_ign_s = area_ign[inds]
+                    # unmatched dets outside the area range are ignored
+                    # (reference mean_ap.py:625-630; matched-gt ignore is
+                    # impossible since ignored gts are excluded from matching)
+                    det_ignore = (~matches) & area_ign_s[None, :]
+
+                    tps = matches & ~det_ignore
+                    fps = (~matches) & ~det_ignore
+                    tp_sum = np.cumsum(tps, axis=1, dtype=np.float64)
+                    fp_sum = np.cumsum(fps, axis=1, dtype=np.float64)
+                    for idx_iou in range(nb_iou_thrs):
+                        tp, fp = tp_sum[idx_iou], fp_sum[idx_iou]
+                        nd = len(tp)
+                        rc = tp / npig
+                        pr = tp / (fp + tp + np.finfo(np.float64).eps)
+                        recall[idx_iou, idx_cls, idx_area, idx_mdt] = rc[-1] if nd else 0
+                        # monotone envelope from the right (zigzag removal)
+                        pr = np.maximum.accumulate(pr[::-1])[::-1]
+                        i_thr = np.searchsorted(rc, rec_thrs, side="left")
+                        num_inds = int(i_thr.argmax()) if i_thr.max() >= nd else nb_rec_thrs
+                        prec = np.zeros(nb_rec_thrs)
+                        prec[:num_inds] = pr[i_thr[:num_inds]]
+                        precision[idx_iou, :, idx_cls, idx_area, idx_mdt] = prec
+        return precision, recall
+
+    def _summarize(
+        self,
+        precision: np.ndarray,
+        recall: np.ndarray,
+        avg_prec: bool = True,
+        iou_threshold: Optional[float] = None,
+        area_range: str = "all",
+        max_dets: int = 100,
+    ) -> Array:
+        area_idx = list(self.bbox_area_ranges.keys()).index(area_range)
+        mdet_idx = self.max_detection_thresholds.index(max_dets)
+        if avg_prec:
+            prec = precision[..., area_idx, mdet_idx]
+            if iou_threshold is not None:
+                prec = prec[self.iou_thresholds.index(iou_threshold)]
+        else:
+            prec = recall[..., area_idx, mdet_idx]
+            if iou_threshold is not None:
+                prec = prec[self.iou_thresholds.index(iou_threshold)]
+        valid = prec[prec > -1]
+        return jnp.asarray(-1.0 if valid.size == 0 else valid.mean(), dtype=jnp.float32)
+
+    def _summarize_results(self, precision: np.ndarray, recall: np.ndarray) -> Dict[str, Array]:
+        last_mdt = self.max_detection_thresholds[-1]
+        res: Dict[str, Array] = {}
+        res["map"] = self._summarize(precision, recall, True, max_dets=last_mdt)
+        res["map_50"] = (
+            self._summarize(precision, recall, True, iou_threshold=0.5, max_dets=last_mdt)
+            if 0.5 in self.iou_thresholds
+            else jnp.asarray(-1.0)
+        )
+        res["map_75"] = (
+            self._summarize(precision, recall, True, iou_threshold=0.75, max_dets=last_mdt)
+            if 0.75 in self.iou_thresholds
+            else jnp.asarray(-1.0)
+        )
+        res["map_small"] = self._summarize(precision, recall, True, area_range="small", max_dets=last_mdt)
+        res["map_medium"] = self._summarize(precision, recall, True, area_range="medium", max_dets=last_mdt)
+        res["map_large"] = self._summarize(precision, recall, True, area_range="large", max_dets=last_mdt)
+        for max_det in self.max_detection_thresholds:
+            res[f"mar_{max_det}"] = self._summarize(precision, recall, False, max_dets=max_det)
+        res["mar_small"] = self._summarize(precision, recall, False, area_range="small", max_dets=last_mdt)
+        res["mar_medium"] = self._summarize(precision, recall, False, area_range="medium", max_dets=last_mdt)
+        res["mar_large"] = self._summarize(precision, recall, False, area_range="large", max_dets=last_mdt)
+        return res
+
+    def compute(self) -> Dict[str, Array]:
+        classes = self._get_classes()
+        precision, recall = self._calculate(classes)
+        metrics = self._summarize_results(precision, recall)
+
+        map_per_class = jnp.asarray([-1.0])
+        mar_per_class = jnp.asarray([-1.0])
+        if self.class_metrics:
+            map_list, mar_list = [], []
+            for class_idx in range(len(classes)):
+                cls_prec = precision[:, :, class_idx : class_idx + 1]
+                cls_rec = recall[:, class_idx : class_idx + 1]
+                cls_res = self._summarize_results(cls_prec, cls_rec)
+                map_list.append(cls_res["map"])
+                mar_list.append(cls_res[f"mar_{self.max_detection_thresholds[-1]}"])
+            map_per_class = jnp.stack(map_list) if map_list else map_per_class
+            mar_per_class = jnp.stack(mar_list) if mar_list else mar_per_class
+        metrics["map_per_class"] = map_per_class
+        metrics[f"mar_{self.max_detection_thresholds[-1]}_per_class"] = mar_per_class
+        return metrics
+
+    # ------------------------------------------------------------------ #
+    # distributed sync: per-image arrays must keep their boundaries, so the
+    # gather extends the lists element-wise (reference gathers each list
+    # state with gather_all_tensors, metric.py:350-354)
+    # ------------------------------------------------------------------ #
+    def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
+        if dist_sync_fn is not None:
+            return super()._sync_dist(dist_sync_fn, process_group)
+        # every rank must execute the SAME number of collectives: agree on the
+        # per-rank image counts first; ranks short of the max contribute dummy
+        # empties that are dropped by count (NOT by emptiness — an image with
+        # zero boxes is legitimate and must stay aligned across the lists)
+        n_local = len(self.detections)
+        counts = [int(c) for c in np.asarray(_sync.gather_all_arrays(jnp.asarray(n_local))).reshape(-1).tolist()]
+        n_rounds = max(counts)
+        synced: Dict[str, list] = {}
+        for name in self._defaults:
+            local = getattr(self, name)
+            template = local[0] if local else jnp.zeros((0,))
+            rounds: List[list] = []
+            for i in range(n_rounds):
+                per_image = local[i] if i < len(local) else jnp.zeros((0,) + template.shape[1:], template.dtype)
+                gathered = _sync.gather_all_arrays(per_image)
+                rounds.append(gathered if isinstance(gathered, list) else [gathered])
+            # rank-major order so the per-image lists of all states stay aligned
+            synced[name] = [rounds[i][r] for r in range(len(counts)) for i in range(counts[r])]
+        self.set_state(synced)

@@ -52,4 +52,9 @@ def perceptual_evaluation_speech_quality(
         vals = np.asarray(
             [pesq_backend.pesq(fs, t, p, mode) for t, p in zip(flat_t, flat_p)]
         ).reshape(preds_np.shape[:-1])
-    return jnp.asarray(vals, dtype=jnp.float32)
+    out = jnp.asarray(vals, dtype=jnp.float32)
+    if keep_same_device and isinstance(preds, jnp.ndarray):
+        import jax
+
+        out = jax.device_put(out, list(preds.devices())[0])
+    return out

@@ -102,6 +102,11 @@ def _band_envelopes(x: Array) -> Array:
 def _stoi_single(x: Array, y: Array, extended: bool) -> Array:
     """STOI for one utterance pair at 10 kHz (jit/vmap friendly)."""
     eps = jnp.finfo(x.dtype).eps
+    # shorter than one frame, or than one N_SEG segment: degenerate (static
+    # shape decision, so the NaN path below is reachable before any size-0
+    # reduction could crash)
+    if (x.shape[-1] - N_FRAME) // (N_FRAME // 2) + 1 < N_SEG:
+        return jnp.asarray(jnp.nan, dtype=x.dtype)
     x_sil, y_sil, n_active = _remove_silent_frames(x, y)
 
     x_bands = _band_envelopes(x_sil)  # (J, M)

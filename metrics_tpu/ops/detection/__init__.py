@@ -1,0 +1,5 @@
+"""Detection kernels: box/mask ops and the COCO matching kernel."""
+from metrics_tpu.ops.detection.boxes import box_area, box_convert, box_iou, mask_area, mask_iou
+from metrics_tpu.ops.detection.matching import match_image
+
+__all__ = ["box_area", "box_convert", "box_iou", "mask_area", "mask_iou", "match_image"]

@@ -1,0 +1,75 @@
+"""Vectorized box and mask operations.
+
+TPU-native replacements for the torchvision ops the reference imports
+(torchmetrics/detection/mean_ap.py:12 — ``box_area``/``box_convert``/
+``box_iou``) and for pycocotools mask IoU (:30-33, :127-142). All ops are
+pure jnp, batched, and jittable.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+_FORMATS = ("xyxy", "xywh", "cxcywh")
+
+
+def box_convert(boxes: Array, in_fmt: str, out_fmt: str) -> Array:
+    """Convert [N, 4] boxes between xyxy / xywh / cxcywh formats."""
+    if in_fmt not in _FORMATS or out_fmt not in _FORMATS:
+        raise ValueError(f"Unsupported box format: {in_fmt} -> {out_fmt}; supported: {_FORMATS}")
+    if in_fmt == out_fmt:
+        return boxes
+    if boxes.size == 0:
+        return boxes.reshape(0, 4)
+    if in_fmt == "xywh":
+        x, y, w, h = jnp.split(boxes, 4, axis=-1)
+        xyxy = jnp.concatenate([x, y, x + w, y + h], axis=-1)
+    elif in_fmt == "cxcywh":
+        cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+        xyxy = jnp.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    else:
+        xyxy = boxes
+    if out_fmt == "xyxy":
+        return xyxy
+    x1, y1, x2, y2 = jnp.split(xyxy, 4, axis=-1)
+    if out_fmt == "xywh":
+        return jnp.concatenate([x1, y1, x2 - x1, y2 - y1], axis=-1)
+    return jnp.concatenate([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], axis=-1)
+
+
+def box_area(boxes: Array) -> Array:
+    """[..., 4] xyxy boxes -> [...] areas."""
+    return (boxes[..., 2] - boxes[..., 0]) * (boxes[..., 3] - boxes[..., 1])
+
+
+def box_iou(boxes1: Array, boxes2: Array) -> Array:
+    """Pairwise IoU of xyxy boxes: [N, 4] x [M, 4] -> [N, M]."""
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def mask_iou(masks1: Array, masks2: Array) -> Array:
+    """Pairwise IoU of dense binary masks: [N, H, W] x [M, H, W] -> [N, M].
+
+    Device-native replacement for pycocotools RLE IoU (reference
+    mean_ap.py:113-142): flatten to [N, HW] / [M, HW] and compute
+    intersections as one matmul (MXU-friendly), unions from per-mask areas.
+    """
+    m1 = masks1.reshape(masks1.shape[0], -1).astype(jnp.float32)
+    m2 = masks2.reshape(masks2.shape[0], -1).astype(jnp.float32)
+    inter = m1 @ m2.T
+    area1 = m1.sum(axis=-1)
+    area2 = m2.sum(axis=-1)
+    union = area1[:, None] + area2[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def mask_area(masks: Array) -> Array:
+    """[N, H, W] binary masks -> [N] pixel areas."""
+    return masks.reshape(masks.shape[0], -1).sum(axis=-1).astype(jnp.float32)

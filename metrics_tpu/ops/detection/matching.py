@@ -1,0 +1,73 @@
+"""COCO-style greedy detection-to-groundtruth matching as a jittable kernel.
+
+Reference parity: ``MeanAveragePrecision._evaluate_image`` and
+``_find_best_gt_match`` (torchmetrics/detection/mean_ap.py:537-663) — a
+Python triple loop over (iou_threshold, detection, groundtruth) per image,
+class and area range.
+
+TPU-first redesign: one padded kernel per image evaluates ALL classes x area
+ranges x IoU thresholds at once — ``vmap(vmap(vmap(scan)))`` where the only
+sequential dimension is the score-ordered detection scan that greedy matching
+fundamentally requires. Class selection is expressed as validity masks over
+the full [D, G] IoU matrix (computed once per image) instead of ragged
+per-class slicing, so shapes stay static; detections/groundtruths are padded
+to bucket sizes to bound recompilation.
+
+Greedy semantics match the reference exactly: for each detection in
+descending score order, the candidate set is unmatched, non-ignored, valid
+GTs; the best candidate by IoU wins if its IoU exceeds the threshold
+(mean_ap.py:638-663; note the reference excludes area-ignored GTs from
+matching entirely).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array, lax
+
+
+def _match_single(
+    ious: Array,  # (D, G), score-desc det order
+    det_valid: Array,  # (D,) bool
+    gt_valid: Array,  # (G,) bool
+    gt_ignore: Array,  # (G,) bool (area-ignored)
+    threshold: Array,  # scalar
+) -> Tuple[Array, Array]:
+    """Greedy match for one (class, area, threshold): -> det_matches (D,), gt_matches (G,)."""
+
+    def step(gt_matched: Array, d: Array):
+        candidates = (~gt_matched) & (~gt_ignore) & gt_valid
+        gt_ious = ious[d] * candidates
+        m = jnp.argmax(gt_ious)
+        ok = (gt_ious[m] > threshold) & det_valid[d]
+        gt_matched = gt_matched.at[m].set(gt_matched[m] | ok)
+        return gt_matched, ok
+
+    gt_matched, det_matches = lax.scan(step, jnp.zeros(ious.shape[1], dtype=bool), jnp.arange(ious.shape[0]))
+    return det_matches, gt_matched
+
+
+@partial(jax.jit, static_argnames=())
+def match_image(
+    ious: Array,  # (D, G) full-image IoU matrix, dets in score-desc order
+    det_class_valid: Array,  # (K, D) det belongs to class k and within per-class max_det
+    gt_class_valid: Array,  # (K, G)
+    gt_ignore_area: Array,  # (A, G) area-ignored flags per area range
+    thresholds: Array,  # (T,)
+) -> Tuple[Array, Array]:
+    """All (class, area, threshold) matchings for one image.
+
+    Returns ``det_matches (K, A, T, D)`` and ``gt_matches (K, A, T, G)``.
+    """
+
+    def for_class(det_v, gt_v):
+        def for_area(gt_ign):
+            return jax.vmap(lambda thr: _match_single(ious, det_v, gt_v, gt_ign & gt_v, thr))(thresholds)
+
+        return jax.vmap(for_area)(gt_ignore_area)
+
+    det_matches, gt_matches = jax.vmap(for_class)(det_class_valid, gt_class_valid)
+    return det_matches, gt_matches

@@ -248,6 +248,13 @@ def test_stoi_perfect_signal_high():
     assert got > 0.99
 
 
+def test_stoi_too_short_returns_nan():
+    clip = jnp.asarray(_rng.normal(size=200).astype(np.float32))  # < one frame
+    assert np.isnan(float(short_time_objective_intelligibility(clip, clip, fs=10000)))
+    clip2 = jnp.asarray(_rng.normal(size=2000).astype(np.float32))  # < one segment
+    assert np.isnan(float(short_time_objective_intelligibility(clip2, clip2, fs=10000)))
+
+
 def test_stoi_resample_path():
     t = _rng.normal(size=(2, 16000)).astype(np.float32)
     p = (t + 0.3 * _rng.normal(size=(2, 16000))).astype(np.float32)

@@ -1,0 +1,126 @@
+"""Independent numpy COCO mAP oracle (dynamic shapes, per-image loops).
+
+A straightforward reimplementation of the COCO evaluation protocol as the
+reference implements it (torchmetrics/detection/mean_ap.py:537-871): ragged
+per-image/per-class greedy matching with Python loops — deliberately the
+opposite code shape from the library's padded/vmapped kernel, so the two
+paths cross-check each other (tests/helpers parity philosophy, SURVEY.md §4).
+"""
+import numpy as np
+
+IOU_THRS = np.round(np.arange(0.5, 1.0, 0.05), 2)
+REC_THRS = np.linspace(0, 1, 101)
+AREA_RANGES = {"all": (0, 1e10), "small": (0, 32 ** 2), "medium": (32 ** 2, 96 ** 2), "large": (96 ** 2, 1e10)}
+MAX_DETS = [1, 10, 100]
+
+
+def box_iou_np(a, b):
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return np.where(union > 0, inter / union, 0.0)
+
+
+def _evaluate_image(det_boxes, det_scores, gt_boxes, area_range, max_det):
+    """Greedy matching for one image+class; returns dt/gt data or None."""
+    if len(det_boxes) == 0 and len(gt_boxes) == 0:
+        return None
+    order = np.argsort(-det_scores, kind="stable")[:max_det]
+    det_boxes = det_boxes[order]
+    det_scores = det_scores[order]
+    gt_areas = (gt_boxes[:, 2] - gt_boxes[:, 0]) * (gt_boxes[:, 3] - gt_boxes[:, 1]) if len(gt_boxes) else np.zeros(0)
+    gt_ignore = (gt_areas < area_range[0]) | (gt_areas > area_range[1])
+    T, D, G = len(IOU_THRS), len(det_boxes), len(gt_boxes)
+    dt_m = np.zeros((T, D), dtype=bool)
+    gt_m = np.zeros((T, G), dtype=bool)
+    if D and G:
+        ious = box_iou_np(det_boxes, gt_boxes)
+        for ti, thr in enumerate(IOU_THRS):
+            for d in range(D):
+                cand = ~gt_m[ti] & ~gt_ignore
+                vals = ious[d] * cand
+                if vals.size == 0:
+                    continue
+                m = int(np.argmax(vals))
+                if vals[m] > thr:
+                    dt_m[ti, d] = True
+                    gt_m[ti, m] = True
+    det_areas = (det_boxes[:, 2] - det_boxes[:, 0]) * (det_boxes[:, 3] - det_boxes[:, 1]) if D else np.zeros(0)
+    det_area_ignore = (det_areas < area_range[0]) | (det_areas > area_range[1])
+    dt_ig = (~dt_m) & det_area_ignore[None, :]
+    return {"dtm": dt_m, "dtIg": dt_ig, "scores": det_scores, "gtIg": gt_ignore}
+
+
+def coco_map(preds, targets):
+    """preds/targets: lists of dicts with numpy boxes/scores/labels (xyxy)."""
+    classes = sorted(
+        set(np.concatenate([p["labels"] for p in preds] + [t["labels"] for t in targets]).astype(int).tolist())
+    )
+    K, A, M, T, R = len(classes), len(AREA_RANGES), len(MAX_DETS), len(IOU_THRS), len(REC_THRS)
+    precision = -np.ones((T, R, K, A, M))
+    recall = -np.ones((T, K, A, M))
+
+    for ki, cls in enumerate(classes):
+        for ai, area in enumerate(AREA_RANGES.values()):
+            evals = []
+            for p, t in zip(preds, targets):
+                dm = p["labels"] == cls
+                gm = t["labels"] == cls
+                e = _evaluate_image(p["boxes"][dm], p["scores"][dm], t["boxes"][gm], area, MAX_DETS[-1])
+                if e is not None:
+                    evals.append(e)
+            if not evals:
+                continue
+            npig = int(sum((~e["gtIg"]).sum() for e in evals))
+            if npig == 0:
+                continue
+            for mi, mdet in enumerate(MAX_DETS):
+                scores = np.concatenate([e["scores"][:mdet] for e in evals])
+                dtm = np.concatenate([e["dtm"][:, :mdet] for e in evals], axis=1)
+                dtig = np.concatenate([e["dtIg"][:, :mdet] for e in evals], axis=1)
+                inds = np.argsort(-scores, kind="stable")
+                dtm, dtig = dtm[:, inds], dtig[:, inds]
+                tps = dtm & ~dtig
+                fps = ~dtm & ~dtig
+                tp_sum = np.cumsum(tps, axis=1).astype(float)
+                fp_sum = np.cumsum(fps, axis=1).astype(float)
+                for ti in range(T):
+                    tp, fp = tp_sum[ti], fp_sum[ti]
+                    nd = len(tp)
+                    rc = tp / npig
+                    pr = tp / (fp + tp + np.finfo(np.float64).eps)
+                    recall[ti, ki, ai, mi] = rc[-1] if nd else 0
+                    pr = np.maximum.accumulate(pr[::-1])[::-1]
+                    i_thr = np.searchsorted(rc, REC_THRS, side="left")
+                    num = int(i_thr.argmax()) if (i_thr.size and i_thr.max() >= nd) else R
+                    prec = np.zeros(R)
+                    prec[:num] = pr[i_thr[:num]]
+                    precision[ti, :, ki, ai, mi] = prec
+
+    def summarize(avg_prec, iou=None, area="all", mdet=100):
+        ai = list(AREA_RANGES).index(area)
+        mi = MAX_DETS.index(mdet)
+        arr = precision[..., ai, mi] if avg_prec else recall[..., ai, mi]
+        if iou is not None:
+            arr = arr[list(IOU_THRS).index(iou)]
+        valid = arr[arr > -1]
+        return -1.0 if valid.size == 0 else float(valid.mean())
+
+    return {
+        "map": summarize(True),
+        "map_50": summarize(True, iou=0.5),
+        "map_75": summarize(True, iou=0.75),
+        "map_small": summarize(True, area="small"),
+        "map_medium": summarize(True, area="medium"),
+        "map_large": summarize(True, area="large"),
+        "mar_1": summarize(False, mdet=1),
+        "mar_10": summarize(False, mdet=10),
+        "mar_100": summarize(False, mdet=100),
+        "mar_small": summarize(False, area="small"),
+        "mar_medium": summarize(False, area="medium"),
+        "mar_large": summarize(False, area="large"),
+    }
