@@ -1,0 +1,71 @@
+"""BERTScore with a user-defined model and tokenizer.
+
+Reference parity: tm_examples/bert_score-own_model.py — the user plugs a
+custom encoder through ``model``/``user_tokenizer``/``user_forward_fn``. Here
+the "model" is a tiny jax function over word embeddings; any Flax module works
+the same way (its ``__call__``/apply output plays the last-hidden-state role).
+
+To run: python examples/bert_score_own_model.py
+"""
+from pprint import pprint
+from typing import Dict, List, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.text import BERTScore
+
+_MODEL_DIM = 4
+_MAX_LEN = 6
+
+
+class UserTokenizer:
+    """Maps words to fixed embeddings; returns input_ids as embeddings plus an
+    attention mask, the structure BERTScore's user hooks expect."""
+
+    CLS_TOKEN = "<cls>"
+    SEP_TOKEN = "<sep>"
+    PAD_TOKEN = "<pad>"
+
+    def __init__(self) -> None:
+        self.word2vec = {
+            "hello": 0.5 * jnp.ones((1, _MODEL_DIM)),
+            "world": -0.5 * jnp.ones((1, _MODEL_DIM)),
+            self.CLS_TOKEN: jnp.zeros((1, _MODEL_DIM)),
+            self.SEP_TOKEN: jnp.zeros((1, _MODEL_DIM)),
+            self.PAD_TOKEN: jnp.zeros((1, _MODEL_DIM)),
+        }
+
+    def __call__(self, sentences: Union[str, List[str]], max_len: int = _MAX_LEN) -> Dict[str, Array]:
+        if isinstance(sentences, str):
+            sentences = [sentences]
+        output_ids = []
+        attention_mask = []
+        for sentence in sentences:
+            tokens = [self.CLS_TOKEN, *sentence.lower().split(), self.SEP_TOKEN]
+            tokens += [self.PAD_TOKEN] * (max_len - len(tokens))
+            output_ids.append(jnp.concatenate([self.word2vec[t] for t in tokens[:max_len]], axis=0))
+            attention_mask.append(jnp.asarray([1 if t != self.PAD_TOKEN else 0 for t in tokens[:max_len]]))
+        return {
+            "input_ids": jnp.stack(output_ids),
+            "attention_mask": jnp.stack(attention_mask).astype(jnp.int32),
+        }
+
+
+def user_forward_fn(model, batch: Dict[str, Array]) -> Array:
+    """Run the user model; returns [batch, seq_len, dim] embeddings."""
+    return model(batch["input_ids"])
+
+
+def toy_model(embeddings: Array) -> Array:
+    # identity "encoder": the embeddings ARE the hidden states
+    return embeddings
+
+
+if __name__ == "__main__":
+    tokenizer = UserTokenizer()
+    scorer = BERTScore(
+        model=toy_model, user_tokenizer=tokenizer, user_forward_fn=user_forward_fn, max_length=_MAX_LEN
+    )
+    scorer.update(["hello world", "world world"], ["hello world", "hello hello"])
+    pprint(scorer.compute())

@@ -1,0 +1,31 @@
+"""MeanAveragePrecision quickstart on toy detections.
+
+Reference parity: tm_examples/detection_map.py — same shape of example, with
+jax arrays and the metrics_tpu MeanAveragePrecision.
+
+To run: python examples/detection_map.py
+"""
+from pprint import pprint
+
+import jax.numpy as jnp
+
+from metrics_tpu.detection import MeanAveragePrecision
+
+preds = [
+    {
+        "boxes": jnp.asarray([[258.0, 41.0, 606.0, 285.0]]),
+        "scores": jnp.asarray([0.536]),
+        "labels": jnp.asarray([0]),
+    }
+]
+target = [
+    {
+        "boxes": jnp.asarray([[214.0, 41.0, 562.0, 285.0]]),
+        "labels": jnp.asarray([0]),
+    }
+]
+
+if __name__ == "__main__":
+    metric = MeanAveragePrecision()
+    metric.update(preds, target)
+    pprint({k: float(v) if v.ndim == 0 else v.tolist() for k, v in metric.compute().items()})

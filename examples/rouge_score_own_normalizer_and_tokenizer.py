@@ -1,0 +1,37 @@
+"""ROUGEScore with a custom normalizer and tokenizer.
+
+Reference parity: tm_examples/rouge_score-own_normalizer_and_tokenizer.py —
+the user replaces the default text normalization/tokenization, e.g. to handle
+non-alphanumeric scripts.
+
+To run: python examples/rouge_score_own_normalizer_and_tokenizer.py
+"""
+import re
+from pprint import pprint
+from typing import Sequence
+
+from metrics_tpu.text import ROUGEScore
+
+
+class UserNormalizer:
+    """Keeps digits and word characters, lowercases (the default drops
+    non-ascii; a user normalizer can keep any script)."""
+
+    def __init__(self) -> None:
+        self.pattern = re.compile(r"[^\w\d]+")
+
+    def __call__(self, text: str) -> str:
+        return self.pattern.sub(" ", text.lower()).strip()
+
+
+class UserTokenizer:
+    """Whitespace tokenizer."""
+
+    def __call__(self, text: str) -> Sequence[str]:
+        return text.split()
+
+
+if __name__ == "__main__":
+    rouge = ROUGEScore(normalizer=UserNormalizer(), tokenizer=UserTokenizer())
+    rouge.update(["Is your name John?"], ["Is your name John"])
+    pprint({k: float(v) for k, v in rouge.compute().items()})

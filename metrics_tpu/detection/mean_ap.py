@@ -134,7 +134,10 @@ class MeanAveragePrecision(Metric):
             return box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
         # segm: dense binary masks [N, H, W] (device-native; RLE is a CPU
         # string format — see ops/detection/boxes.py:mask_iou)
-        return jnp.asarray(item["masks"], dtype=bool)
+        masks = jnp.asarray(item["masks"], dtype=bool)
+        if masks.size == 0 and masks.ndim != 3:
+            return masks.reshape(0, 0, 0)
+        return masks
 
     def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:  # type: ignore[override]
         _input_validator(preds, target, iou_type=self.iou_type)
@@ -373,14 +376,44 @@ class MeanAveragePrecision(Metric):
         # zero boxes is legitimate and must stay aligned across the lists)
         n_local = len(self.detections)
         counts = [int(c) for c in np.asarray(_sync.gather_all_arrays(jnp.asarray(n_local))).reshape(-1).tolist()]
+        if len(counts) == 1:
+            return  # single process: nothing to gather
         n_rounds = max(counts)
+
+        if self.iou_type == "segm":
+            # gather pads only axis 0, so mask batches must agree on (H, W):
+            # agree on the global max once, pad every local batch to it
+            local_hw = np.zeros(2, dtype=np.int64)
+            for m in list(self.detections) + list(self.groundtruths):
+                if np.ndim(m) == 3 and m.shape[0] > 0:
+                    local_hw = np.maximum(local_hw, m.shape[1:])
+            all_hw = np.stack([np.asarray(a) for a in _sync.gather_all_arrays(jnp.asarray(local_hw))])
+            h_max, w_max = (int(v) for v in all_hw.max(axis=0))
+
+            def _pad_masks(m):
+                m = jnp.asarray(m, dtype=bool).reshape((-1,) + (m.shape[1:] if np.ndim(m) == 3 else (0, 0)))
+                return jnp.pad(m, ((0, 0), (0, h_max - m.shape[1]), (0, w_max - m.shape[2])))
+
+            self.detections = [_pad_masks(m) for m in self.detections]
+            self.groundtruths = [_pad_masks(m) for m in self.groundtruths]
+            geom_empty = jnp.zeros((0, h_max, w_max), dtype=bool)
+        else:
+            geom_empty = jnp.zeros((0, 4), dtype=jnp.float32)
+
+        # dtype/shape-correct dummies so every rank's gather round agrees
+        empties = {
+            "detections": geom_empty,
+            "groundtruths": geom_empty,
+            "detection_scores": jnp.zeros((0,), dtype=jnp.float32),
+            "detection_labels": jnp.zeros((0,), dtype=jnp.int32),
+            "groundtruth_labels": jnp.zeros((0,), dtype=jnp.int32),
+        }
         synced: Dict[str, list] = {}
         for name in self._defaults:
             local = getattr(self, name)
-            template = local[0] if local else jnp.zeros((0,))
             rounds: List[list] = []
             for i in range(n_rounds):
-                per_image = local[i] if i < len(local) else jnp.zeros((0,) + template.shape[1:], template.dtype)
+                per_image = local[i] if i < len(local) else empties[name]
                 gathered = _sync.gather_all_arrays(per_image)
                 rounds.append(gathered if isinstance(gathered, list) else [gathered])
             # rank-major order so the per-image lists of all states stay aligned

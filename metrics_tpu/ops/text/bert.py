@@ -95,7 +95,11 @@ def _embed_and_scale(
         else:
             out = jnp.asarray(hidden[num_layers if num_layers is not None else -1])[:, None]
 
-    out = out / jnp.linalg.norm(out, axis=-1, keepdims=True)
+    # guarded norm: zero vectors (e.g. a user model embedding pad/cls to 0)
+    # stay zero instead of becoming NaN and poisoning the masked einsum below;
+    # the where (not an eps clamp) also survives fp16, where 1e-12 rounds to 0
+    norm = jnp.linalg.norm(out, axis=-1, keepdims=True)
+    out = out / jnp.where(norm == 0, 1.0, norm)
     processed_mask = _process_attention_mask_for_special_tokens(jnp.asarray(attention_mask))
     out = jnp.einsum("blsd,bs->blsd", out, processed_mask.astype(out.dtype))
 

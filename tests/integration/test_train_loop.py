@@ -1,0 +1,142 @@
+"""Trainer-contract integration tests: metrics inside a real jax train loop.
+
+Reference parity: integrations/test_lightning.py + integrations/lightning/
+boring_model.py — the contract a trainer framework relies on: per-step
+``forward`` logging, epoch-end ``compute`` parity with the concatenated
+epoch data, ``reset`` between epochs, collections in the loop, and
+checkpoint save/restore of metric state mid-epoch. The "trainer" here is a
+plain optax SGD loop with the whole train step (model grad + metric update)
+in ONE jitted XLA program — the TPU-native replacement for Lightning's
+callback-driven loop.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from sklearn.metrics import accuracy_score
+
+from metrics_tpu import Accuracy, F1Score, MeanMetric, MetricCollection, MeanSquaredError
+
+_rng = np.random.default_rng(99)
+N_CLASSES = 5
+FEAT = 8
+BATCH = 32
+N_BATCHES = 6
+
+
+def _data():
+    w_true = _rng.normal(size=(FEAT, N_CLASSES))
+    x = _rng.normal(size=(N_BATCHES, BATCH, FEAT)).astype(np.float32)
+    y = np.argmax(x @ w_true + 0.5 * _rng.normal(size=(N_BATCHES, BATCH, N_CLASSES)), axis=-1).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def test_metric_inside_jitted_train_step():
+    """Model grad step + metric update compile to one XLA program; epoch-end
+    compute matches sklearn on the epoch's predictions (reference
+    test_lightning.py:30-61 epoch accumulation parity)."""
+    x, y = _data()
+    acc = Accuracy(num_classes=N_CLASSES)
+    opt = optax.sgd(0.1)
+    params = jnp.zeros((FEAT, N_CLASSES))
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, metric_state, xb, yb):
+        def loss_fn(p):
+            logits = xb @ p
+            return -jnp.mean(jnp.take_along_axis(jax.nn.log_softmax(logits), yb[:, None], axis=1)), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        params = optax.apply_updates(params, updates)
+        metric_state = acc.update_state(metric_state, logits, yb)
+        return params, opt_state, metric_state, loss, logits
+
+    metric_state = acc.init_state()
+    all_logits = []
+    for i in range(N_BATCHES):
+        params, opt_state, metric_state, loss, logits = train_step(params, opt_state, metric_state, x[i], y[i])
+        all_logits.append(np.asarray(logits))
+
+    got = float(acc.compute_state(metric_state))
+    preds = np.concatenate(all_logits).argmax(-1)
+    want = accuracy_score(np.asarray(y).reshape(-1), preds)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_epoch_accumulation_and_reset():
+    """Stateful facade across epochs: per-step forward returns batch values,
+    compute() the epoch value, reset() starts the next epoch clean
+    (reference test_metrics_reset, integrations/test_lightning.py:64-178)."""
+    x, y = _data()
+    acc = Accuracy(num_classes=N_CLASSES)
+    for epoch in range(2):
+        batch_vals = []
+        for i in range(N_BATCHES):
+            logits = x[i] @ jnp.zeros((FEAT, N_CLASSES))  # untrained model
+            batch_vals.append(float(acc(logits, y[i])))
+        epoch_val = float(acc.compute())
+        assert acc._update_count == N_BATCHES
+        # epoch value is the pooled accuracy, not the mean of batch values
+        np.testing.assert_allclose(
+            epoch_val, accuracy_score(np.asarray(y).reshape(-1), np.zeros(N_BATCHES * BATCH)), atol=1e-6
+        )
+        acc.reset()
+        assert acc._update_count == 0
+
+
+def test_collection_logging_dict():
+    """log_dict-style consumption of a MetricCollection inside the loop
+    (reference test_metric_collection_lightning_log, :220-257)."""
+    x, y = _data()
+    coll = MetricCollection([Accuracy(num_classes=N_CLASSES), F1Score(num_classes=N_CLASSES, average="macro")])
+    tracker = MeanMetric()
+    for i in range(N_BATCHES):
+        logits = x[i] @ jnp.zeros((FEAT, N_CLASSES))
+        coll.update(logits, y[i])
+        tracker.update(jnp.mean((logits.argmax(-1) == y[i]).astype(jnp.float32)))
+    res = coll.compute()
+    assert set(res) == {"Accuracy", "F1Score"}
+    np.testing.assert_allclose(float(res["Accuracy"]), float(tracker.compute()), atol=1e-6)
+
+
+def test_checkpoint_mid_epoch_resume():
+    """Persistent metric state checkpoints mid-epoch and resumes exactly
+    (reference tests/bases/test_ddp.py:135-241 save/restore semantics)."""
+    x, y = _data()
+    m1 = MeanSquaredError()
+    m1.persistent(True)
+    for i in range(3):
+        m1.update(x[i].sum(-1), y[i].astype(jnp.float32))
+    ckpt = m1.state_dict()
+
+    m2 = MeanSquaredError()
+    m2.load_state_dict(ckpt)
+    m2._update_count = 3
+    for i in range(3, N_BATCHES):
+        m2.update(x[i].sum(-1), y[i].astype(jnp.float32))
+
+    m_full = MeanSquaredError()
+    for i in range(N_BATCHES):
+        m_full.update(x[i].sum(-1), y[i].astype(jnp.float32))
+    np.testing.assert_allclose(float(m2.compute()), float(m_full.compute()), rtol=1e-6)
+
+
+def test_examples_run():
+    """The examples/ directory doubles as API documentation (reference
+    tm_examples/); each must execute end to end."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[2]
+    env_path = f"{repo}"
+    import os
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = env_path + (":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    for example in sorted((repo / "examples").glob("*.py")):
+        proc = subprocess.run([sys.executable, str(example)], capture_output=True, env=env, timeout=600)
+        assert proc.returncode == 0, f"{example.name} failed: {proc.stderr.decode()[-500:]}"
