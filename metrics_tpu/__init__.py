@@ -7,6 +7,7 @@ mesh-axis collectives, and heavy kernels (Inception forwards, IoU matching,
 SSIM convs) as jitted XLA programs.
 """
 from metrics_tpu.__about__ import __version__  # noqa: F401
+from metrics_tpu import functional  # noqa: F401
 from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric  # noqa: F401
 from metrics_tpu.audio import (  # noqa: F401
     PerceptualEvaluationSpeechQuality,
@@ -112,6 +113,7 @@ from metrics_tpu.text import (  # noqa: F401
 
 __all__ = [
     "__version__",
+    "functional",
     # core
     "Metric", "MetricCollection", "CompositionalMetric",
     # aggregation

@@ -6,7 +6,7 @@ from metrics_tpu.ops.classification.average_precision import average_precision  
 from metrics_tpu.ops.classification.calibration_error import calibration_error  # noqa: F401
 from metrics_tpu.ops.classification.cohen_kappa import cohen_kappa  # noqa: F401
 from metrics_tpu.ops.classification.confusion_matrix import confusion_matrix  # noqa: F401
-from metrics_tpu.ops.classification.dice import dice  # noqa: F401
+from metrics_tpu.ops.classification.dice import dice, dice_score  # noqa: F401
 from metrics_tpu.ops.classification.f_beta import f1_score, fbeta_score  # noqa: F401
 from metrics_tpu.ops.classification.hamming import hamming_distance  # noqa: F401
 from metrics_tpu.ops.classification.hinge import hinge_loss  # noqa: F401

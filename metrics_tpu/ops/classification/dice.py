@@ -50,3 +50,41 @@ def dice(
         num_classes=num_classes, top_k=top_k, multiclass=multiclass, ignore_index=ignore_index,
     )
     return _dice_compute(tp, fp, fn, average, mdmc_average, zero_division)
+
+
+def dice_score(
+    preds: Array,
+    target: Array,
+    bg: bool = False,
+    nan_score: float = 0.0,
+    no_fg_score: float = 0.0,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    """Deprecated macro dice alias. Reference: dice.py:27-104 (deprecated in
+    v0.9 in favor of :func:`dice`; kept for public-API parity — non-default
+    ``no_fg_score``/``reduction`` fall back to defaults as the reference does).
+    """
+    import math
+
+    from metrics_tpu.utils.prints import rank_zero_warn
+
+    rank_zero_warn(
+        "The `dice_score` function was deprecated in v0.9 and will be removed in v0.10. Use `dice` function instead.",
+        DeprecationWarning,
+    )
+    num_classes = preds.shape[1]
+    if no_fg_score != 0.0:
+        rank_zero_warn("Deprecated parameter. Switched to default `no_fg_score` = 0.0.")
+    if reduction != "elementwise_mean":
+        rank_zero_warn("Deprecated parameter. Switched to default `reduction` = elementwise_mean.")
+    zero_division = math.floor(nan_score)
+    if zero_division != nan_score:
+        rank_zero_warn(f"Deprecated parameter. `nan_score` converted to integer {zero_division}.")
+    return dice(
+        preds,
+        target,
+        ignore_index=None if bg else 0,
+        average="macro",
+        num_classes=num_classes,
+        zero_division=zero_division,
+    )

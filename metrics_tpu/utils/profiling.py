@@ -1,0 +1,103 @@
+"""Profiling helpers: trace annotations and a compiled-vs-eager step timer.
+
+Reference parity: the reference has no tracer — only the usage-logging hook
+(metric.py:86) and the ``check_forward_no_full_state`` micro-benchmark
+(utilities/checks.py:625-723, ported as
+``utils.checks.check_forward_full_state_property``). SURVEY.md §5.1 calls for
+the TPU build to add ``jax.profiler`` trace annotations and a
+compiled-vs-traced step timer; this module is that addition.
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Generator, Optional
+
+import jax
+
+
+@contextmanager
+def annotate(name: str) -> Generator:
+    """Named region in the jax profiler timeline (XPlane/TensorBoard).
+
+    Wrap metric updates in eval loops so device traces show which metric a
+    kernel belongs to::
+
+        with annotate("metrics/accuracy.update"):
+            state = acc.update_state(state, logits, target)
+    """
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def trace_metric(metric: Any, method: str = "update") -> None:
+    """Wrap ``metric.update``/``compute`` with a profiler annotation in place."""
+    fn: Callable = getattr(metric, method)
+    name = f"metrics/{type(metric).__name__}.{method}"
+
+    def wrapped(*args: Any, **kwargs: Any) -> Any:
+        with jax.profiler.TraceAnnotation(name):
+            return fn(*args, **kwargs)
+
+    setattr(metric, method, wrapped)
+
+
+def time_update(
+    metric: Any,
+    *args: Any,
+    steps: int = 100,
+    warmup: int = 3,
+    **kwargs: Any,
+) -> Dict[str, float]:
+    """Time the eager stateful ``update`` vs the jit-compiled pure
+    ``update_state`` for the same inputs.
+
+    Returns ``{"eager_us", "compiled_us", "compile_s", "speedup"}`` — the
+    per-step microseconds of each path, the one-off trace+compile latency, and
+    their ratio. This quantifies what moving a metric inside the jitted train
+    step buys (SURVEY.md §5.1 "compiled-vs-traced step timer").
+    """
+    state = metric.init_state()
+
+    # compiled path
+    step = jax.jit(lambda s, *a: metric.update_state(s, *a, **kwargs))
+    t0 = time.perf_counter()
+    state = step(state, *args)
+    jax.block_until_ready(state)
+    compile_s = time.perf_counter() - t0
+    for _ in range(warmup):
+        state = step(state, *args)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state = step(state, *args)
+    jax.block_until_ready(state)
+    compiled_us = (time.perf_counter() - t0) / steps * 1e6
+
+    # eager stateful path
+    metric.reset()
+    for _ in range(warmup):
+        metric.update(*args, **kwargs)
+    jax.block_until_ready(metric.metric_state)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        metric.update(*args, **kwargs)
+    jax.block_until_ready(metric.metric_state)
+    eager_us = (time.perf_counter() - t0) / steps * 1e6
+    metric.reset()
+
+    return {
+        "eager_us": eager_us,
+        "compiled_us": compiled_us,
+        "compile_s": compile_s,
+        "speedup": eager_us / compiled_us if compiled_us > 0 else float("inf"),
+    }
+
+
+def start_trace(log_dir: str, host_tracer_level: Optional[int] = None) -> None:
+    """Start a jax profiler trace (view in TensorBoard / xprof)."""
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace() -> None:
+    jax.profiler.stop_trace()

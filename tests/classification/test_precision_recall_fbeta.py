@@ -107,3 +107,20 @@ def test_precision_bf16_and_grad():
         _input_multiclass_prob.target,
         metric_functional=lambda p, tt, **k: precision(p, tt, average="micro"),
     )
+
+
+def test_dice_score_deprecated_alias():
+    """dice_score golden from the reference docstring (functional/classification/dice.py:64-72)."""
+    import warnings
+
+    import jax.numpy as jnp
+
+    from metrics_tpu.ops import dice_score
+
+    pred = jnp.asarray(
+        [[0.85, 0.05, 0.05, 0.05], [0.05, 0.85, 0.05, 0.05], [0.05, 0.05, 0.85, 0.05], [0.05, 0.05, 0.05, 0.85]]
+    )
+    target = jnp.asarray([0, 1, 3, 2])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        np.testing.assert_allclose(float(dice_score(pred, target)), 0.3333, atol=1e-4)

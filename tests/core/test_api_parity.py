@@ -1,0 +1,69 @@
+"""Public-API parity lock vs the reference's export lists.
+
+Reference: torchmetrics/__init__.py (~85 exported names) and
+torchmetrics/functional/__init__.py (~77 functions). Every reference export
+must resolve on metrics_tpu (modulo the reference's optional-dependency
+guards, which metrics_tpu exports unconditionally).
+"""
+import metrics_tpu
+import metrics_tpu.ops as ops
+
+REF_TOP_LEVEL = [
+    "functional", "Accuracy", "AUC", "AUROC", "AveragePrecision", "BinnedAveragePrecision",
+    "BinnedPrecisionRecallCurve", "BinnedRecallAtFixedPrecision", "BLEUScore", "BootStrapper",
+    "CalibrationError", "CatMetric", "CharErrorRate", "CHRFScore", "ClasswiseWrapper", "CohenKappa",
+    "ConfusionMatrix", "CosineSimilarity", "CoverageError", "Dice", "ErrorRelativeGlobalDimensionlessSynthesis",
+    "ExplainedVariance", "ExtendedEditDistance", "F1Score", "FBetaScore", "HammingDistance", "HingeLoss",
+    "JaccardIndex", "KLDivergence", "LabelRankingAveragePrecision", "LabelRankingLoss", "MatchErrorRate",
+    "MatthewsCorrCoef", "MaxMetric", "MeanAbsoluteError", "MeanAbsolutePercentageError", "MeanMetric",
+    "MeanSquaredError", "MeanSquaredLogError", "Metric", "MetricCollection", "MetricTracker", "MinMaxMetric",
+    "MinMetric", "MultiScaleStructuralSimilarityIndexMeasure", "MultioutputWrapper", "PearsonCorrCoef",
+    "PeakSignalNoiseRatio", "PermutationInvariantTraining", "Precision", "PrecisionRecallCurve", "R2Score",
+    "Recall", "RetrievalFallOut", "RetrievalHitRate", "RetrievalMAP", "RetrievalMRR", "RetrievalNormalizedDCG",
+    "RetrievalPrecision", "RetrievalPrecisionRecallCurve", "RetrievalRecall", "RetrievalRecallAtFixedPrecision",
+    "RetrievalRPrecision", "ROC", "SacreBLEUScore", "ScaleInvariantSignalDistortionRatio",
+    "ScaleInvariantSignalNoiseRatio", "SignalDistortionRatio", "SignalNoiseRatio", "SpearmanCorrCoef",
+    "Specificity", "SpectralAngleMapper", "SpectralDistortionIndex", "SQuAD", "StatScores",
+    "StructuralSimilarityIndexMeasure", "SumMetric", "SymmetricMeanAbsolutePercentageError",
+    "TranslationEditRate", "TweedieDevianceScore", "UniversalImageQualityIndex", "WeightedMeanAbsolutePercentageError",
+    "WordErrorRate", "WordInfoLost", "WordInfoPreserved",
+]
+
+REF_FUNCTIONAL = [
+    "accuracy", "auc", "auroc", "average_precision", "bleu_score", "calibration_error", "char_error_rate",
+    "chrf_score", "cohen_kappa", "confusion_matrix", "cosine_similarity", "coverage_error", "tweedie_deviance_score",
+    "dice_score", "dice", "error_relative_global_dimensionless_synthesis", "explained_variance",
+    "extended_edit_distance", "f1_score", "fbeta_score", "hamming_distance", "hinge_loss", "image_gradients",
+    "jaccard_index", "kl_divergence", "label_ranking_average_precision", "label_ranking_loss", "match_error_rate",
+    "matthews_corrcoef", "mean_absolute_error", "mean_absolute_percentage_error", "mean_squared_error",
+    "mean_squared_log_error", "multiscale_structural_similarity_index_measure", "pairwise_cosine_similarity",
+    "pairwise_euclidean_distance", "pairwise_linear_similarity", "pairwise_manhattan_distance", "pearson_corrcoef",
+    "peak_signal_noise_ratio", "permutation_invariant_training", "pit_permutate", "precision", "precision_recall",
+    "precision_recall_curve", "psnr", "r2_score", "recall", "retrieval_average_precision", "retrieval_fall_out",
+    "retrieval_hit_rate", "retrieval_normalized_dcg", "retrieval_precision", "retrieval_r_precision",
+    "retrieval_recall", "retrieval_reciprocal_rank", "roc", "rouge_score", "sacre_bleu_score",
+    "scale_invariant_signal_distortion_ratio", "scale_invariant_signal_noise_ratio", "signal_distortion_ratio",
+    "signal_noise_ratio", "spearman_corrcoef", "specificity", "spectral_angle_mapper", "spectral_distortion_index",
+    "squad", "structural_similarity_index_measure", "stat_scores", "symmetric_mean_absolute_percentage_error",
+    "translation_edit_rate", "universal_image_quality_index", "word_error_rate", "word_information_lost",
+    "word_information_preserved",
+]
+
+
+def test_top_level_exports():
+    missing = [n for n in REF_TOP_LEVEL if not hasattr(metrics_tpu, n)]
+    assert not missing, f"missing top-level exports: {missing}"
+
+
+def test_functional_exports():
+    # psnr is a pre-0.9 alias the reference still exports; accept either name
+    missing = [
+        n for n in REF_FUNCTIONAL if not hasattr(ops, n) and not (n == "psnr" and hasattr(ops, "peak_signal_noise_ratio"))
+    ]
+    assert not missing, f"missing functional exports: {missing}"
+
+
+def test_functional_alias_module():
+    import metrics_tpu.functional as F
+
+    assert F.accuracy is ops.accuracy

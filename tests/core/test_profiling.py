@@ -1,0 +1,30 @@
+"""Profiling helper tests (SURVEY.md §5.1 additions)."""
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import Accuracy
+from metrics_tpu.utils.profiling import annotate, time_update, trace_metric
+
+_rng = np.random.default_rng(0)
+
+
+def test_annotate_and_trace_metric():
+    acc = Accuracy(num_classes=4)
+    trace_metric(acc, "update")
+    logits = jnp.asarray(_rng.normal(size=(16, 4)).astype(np.float32))
+    target = jnp.asarray(_rng.integers(0, 4, 16))
+    with annotate("metrics/test"):
+        acc.update(logits, target)
+    assert acc._update_count == 1
+    assert float(acc.compute()) >= 0
+
+
+def test_time_update_reports():
+    acc = Accuracy(num_classes=4)
+    logits = jnp.asarray(_rng.normal(size=(16, 4)).astype(np.float32))
+    target = jnp.asarray(_rng.integers(0, 4, 16))
+    res = time_update(acc, logits, target, steps=10, warmup=1)
+    assert set(res) == {"eager_us", "compiled_us", "compile_s", "speedup"}
+    assert res["compiled_us"] > 0 and res["eager_us"] > 0
+    # timer must leave the metric reset
+    assert acc._update_count == 0
