@@ -77,11 +77,13 @@ class MetricCollection:
             for m in additional_metrics:
                 (metrics if isinstance(m, Metric) else remain).append(m)
             if remain:
-                raise ValueError(f"You have passes extra arguments {remain} which are not `Metric` so they will be ignored.")
+                raise ValueError(
+                    f"MetricCollection received positional arguments that are not Metric instances: {remain}"
+                )
         elif additional_metrics:
             raise ValueError(
-                f"You have passes extra arguments {additional_metrics} which are not compatible"
-                f" with first passed dictionary {metrics} so they will be ignored."
+                f"MetricCollection was given a dict of metrics plus extra positional arguments "
+                f"{additional_metrics}; pass either a single dict or a sequence of metrics, not both."
             )
 
         if isinstance(metrics, dict):
@@ -89,8 +91,8 @@ class MetricCollection:
                 metric = metrics[name]
                 if not isinstance(metric, (Metric, MetricCollection)):
                     raise ValueError(
-                        f"Value {metric} belonging to key {name} is not an instance of"
-                        " `metrics_tpu.Metric` or `metrics_tpu.MetricCollection`"
+                        f"MetricCollection entry {name!r} must be a metrics_tpu.Metric or "
+                        f"MetricCollection, got {type(metric).__name__}: {metric!r}"
                     )
                 if isinstance(metric, Metric):
                     self[name] = metric
@@ -101,13 +103,16 @@ class MetricCollection:
             for metric in metrics:
                 if not isinstance(metric, (Metric, MetricCollection)):
                     raise ValueError(
-                        f"Input {metric} to `MetricCollection` is not a instance of"
-                        " `metrics_tpu.Metric` or `metrics_tpu.MetricCollection`"
+                        f"MetricCollection members must be metrics_tpu.Metric or MetricCollection "
+                        f"instances, got {type(metric).__name__}: {metric!r}"
                     )
                 if isinstance(metric, Metric):
                     name = metric.__class__.__name__
                     if name in self:
-                        raise ValueError(f"Encountered two metrics both named {name}")
+                        raise ValueError(
+                            f"Two metrics in the sequence share the class name {name!r}; "
+                            "use a dict of metrics to give them distinct keys."
+                        )
                     self[name] = metric
                 else:
                     for k, v in metric.items(keep_base=False):

@@ -59,8 +59,15 @@ class InceptionScore(Metric):
         prob = jax.nn.softmax(features, axis=1)
         log_prob = jax.nn.log_softmax(features, axis=1)
 
-        prob_chunks = jnp.array_split(prob, self.splits, axis=0)
-        log_prob_chunks = jnp.array_split(log_prob, self.splits, axis=0)
+        # torch.chunk semantics (reference inception.py:133): groups of
+        # ceil(N/splits) with a smaller trailing group — NOT jnp.array_split,
+        # which balances group sizes and can even produce a different number of
+        # groups (e.g. N=25, splits=10: chunk -> 9 groups, array_split -> 10).
+        n = prob.shape[0]
+        chunk = max(-(-n // self.splits), 1)
+        bounds = list(range(chunk, n, chunk))
+        prob_chunks = jnp.split(prob, bounds, axis=0)
+        log_prob_chunks = jnp.split(log_prob, bounds, axis=0)
 
         kl_scores = []
         for p, log_p in zip(prob_chunks, log_prob_chunks):

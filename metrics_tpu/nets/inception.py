@@ -213,6 +213,29 @@ class InceptionV3(nn.Module):
         return out
 
 
+def _resize_bilinear_tf1(x: Array, out_h: int, out_w: int) -> Array:
+    """TF1-style asymmetric bilinear resize of an NHWC batch.
+
+    The FID-compat pipeline this net reproduces (torch-fidelity's
+    ``interpolate_bilinear_2d_like_tensorflow1x``, used by the reference's
+    ``NoTrainInceptionV3`` — torchmetrics/image/fid.py:28-46) maps destination
+    coordinate ``i`` to source coordinate ``i * in/out`` with NO half-pixel
+    offset, which differs from ``jax.image.resize``'s half-pixel-center
+    convention. Implemented as two 1-D gathers + lerps (XLA fuses these).
+    """
+    n, h, w, c = x.shape
+    ys = jnp.arange(out_h, dtype=jnp.float32) * (h / out_h)
+    xs = jnp.arange(out_w, dtype=jnp.float32) * (w / out_w)
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0.astype(jnp.float32))[None, :, None, None]
+    wx = (xs - x0.astype(jnp.float32))[None, None, :, None]
+    rows = x[:, y0, :, :] * (1.0 - wy) + x[:, y1, :, :] * wy
+    return rows[:, :, x0, :] * (1.0 - wx) + rows[:, :, x1, :] * wx
+
+
 class InceptionV3FeatureExtractor:
     """Jitted frozen feature extractor: NCHW uint8/float batches -> [N, d].
 
@@ -238,7 +261,7 @@ class InceptionV3FeatureExtractor:
             if x.ndim != 4:
                 raise ValueError(f"Expected 4D image batch, got shape {imgs.shape}")
             x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC
-            x = jax.image.resize(x, (x.shape[0], 299, 299, x.shape[3]), method="bilinear")
+            x = _resize_bilinear_tf1(x, 299, 299)
             x = (x - 128.0) / 128.0
             out = self.module.apply(variables, x)
             return out[name].reshape(imgs.shape[0], -1)

@@ -51,8 +51,13 @@ def _third_octave_matrix(fs: int = FS, nfft: int = NFFT, num_bands: int = NUMBAN
 
 
 def _frame(x: Array, frame_len: int = N_FRAME, hop: int = N_FRAME // 2) -> Array:
-    """[..., T] -> [..., M, frame_len] sliding frames."""
-    n_frames = max((x.shape[-1] - frame_len) // hop + 1, 0)
+    """[..., T] -> [..., M, frame_len] sliding frames.
+
+    Frame count replicates pystoi's ``range(0, len(x) - framelen, hop)``, whose
+    exclusive stop drops the final full frame when (T - frame_len) is an exact
+    multiple of the hop.
+    """
+    n_frames = max((x.shape[-1] - frame_len - 1) // hop + 1, 0)
     idx = jnp.arange(n_frames)[:, None] * hop + jnp.arange(frame_len)[None, :]
     return x[..., idx]
 
@@ -105,7 +110,7 @@ def _stoi_single(x: Array, y: Array, extended: bool) -> Array:
     # shorter than one frame, or than one N_SEG segment: degenerate (static
     # shape decision, so the NaN path below is reachable before any size-0
     # reduction could crash)
-    if (x.shape[-1] - N_FRAME) // (N_FRAME // 2) + 1 < N_SEG:
+    if max((x.shape[-1] - N_FRAME - 1) // (N_FRAME // 2) + 1, 0) < N_SEG:
         return jnp.asarray(jnp.nan, dtype=x.dtype)
     x_sil, y_sil, n_active = _remove_silent_frames(x, y)
 

@@ -22,8 +22,54 @@ def make_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str], devices=None
         known = int(np.prod([s for s in sizes if s != -1]))
         sizes[sizes.index(-1)] = len(devices) // known
     n = int(np.prod(sizes))
+    if len(devices) < n:
+        raise ValueError(
+            f"make_mesh needs {n} devices for axes {dict(zip(axis_names, sizes))} but only "
+            f"{len(devices)} are available ({[d.platform for d in devices]}). For CPU-hosted "
+            "multi-device testing, provision virtual devices BEFORE the first jax backend use: "
+            "append '--xla_force_host_platform_device_count=N' to XLA_FLAGS and call "
+            "jax.config.update('jax_platforms', 'cpu') (see metrics_tpu.parallel.mesh."
+            "ensure_virtual_devices)."
+        )
     arr = np.asarray(devices[:n]).reshape(sizes)
     return Mesh(arr, tuple(axis_names))
+
+
+def backend_initialized() -> bool:
+    """True once any XLA backend has been instantiated in this process.
+
+    Platform selection (``jax_platforms`` config, ``XLA_FLAGS`` device-count
+    flags) only takes effect before the first backend initialization, so
+    callers that want to provision virtual CPU devices must check this first.
+    """
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:  # pragma: no cover - private API moved; assume initialized
+        return True
+
+
+def ensure_virtual_devices(n: int, prefer_existing: bool = True) -> bool:
+    """Best-effort provisioning of >= ``n`` local devices; True on success.
+
+    With ``prefer_existing`` (default), real accelerators win: the default
+    backend is initialized and checked, so a host that actually has ``n``
+    chips runs on them. Only with ``prefer_existing=False`` — and only while
+    the backend is still uninitialized — is the CPU platform forced with ``n``
+    virtual host devices (the recipe tests/conftest.py uses). Returns False
+    when the backend is already up with fewer than ``n`` devices; a fresh
+    process is then required (see ``__graft_entry__.dryrun_multichip``).
+    """
+    import os
+
+    if backend_initialized() or prefer_existing:
+        return len(jax.devices()) >= n
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " " + flag
+    jax.config.update("jax_platforms", "cpu")
+    return len(jax.devices()) >= n
 
 
 def data_parallel_mesh(n: Optional[int] = None, axis_name: str = "data") -> Mesh:

@@ -86,7 +86,7 @@ def _np_stoi(x, y, extended=False):
 
     w = np.hanning(NF + 2)[1:-1]
     hop = NF // 2
-    frames = range(0, len(x) - NF + 1, hop)
+    frames = range(0, len(x) - NF, hop)  # pystoi's exclusive stop
     x_frames = np.array([w * x[i : i + NF] for i in frames])
     y_frames = np.array([w * y[i : i + NF] for i in frames])
     energies = 20 * np.log10(np.linalg.norm(x_frames, axis=1) + EPS)
@@ -110,7 +110,7 @@ def _np_stoi(x, y, extended=False):
         obm[i, np.argmin((f - fl[i]) ** 2) : np.argmin((f - fh[i]) ** 2)] = 1
 
     def bands(sig):
-        frames = np.array([w * sig[i : i + NF] for i in range(0, len(sig) - NF + 1, hop)])
+        frames = np.array([w * sig[i : i + NF] for i in range(0, len(sig) - NF, hop)])
         spec = np.fft.rfft(frames, n=NFFT_, axis=-1)
         return np.sqrt((np.abs(spec) ** 2) @ obm.T).T  # (J, M)
 

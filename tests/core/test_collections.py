@@ -19,7 +19,7 @@ def test_from_list_and_dict():
 
 
 def test_duplicate_names_raise():
-    with pytest.raises(ValueError, match="two metrics both named"):
+    with pytest.raises(ValueError, match="share the class name"):
         MetricCollection([DummyMetricSum(), DummyMetricSum()])
 
 

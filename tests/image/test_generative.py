@@ -241,20 +241,27 @@ def test_inception_score_module():
         def __call__(self, imgs):
             return imgs.reshape(imgs.shape[0], -1)[:, :10]
 
-    is_metric = InceptionScore(feature=_LogitStub(), splits=4, seed=0)
-    logits = _rng.normal(size=(64, 3, 4, 4)).astype(np.float32)
+    # n=25, splits=10: torch.chunk gives 9 groups of ceil(25/10)=3 (last of 1)
+    # while array_split would give 10 balanced groups — exercises the
+    # chunk-semantics path (reference inception.py:133).
+    n, splits = 25, 10
+    is_metric = InceptionScore(feature=_LogitStub(), splits=splits, seed=0)
+    logits = _rng.normal(size=(n, 3, 4, 4)).astype(np.float32)
     is_metric.update(jnp.asarray(logits))
     mean, std = is_metric.compute()
 
-    feats = logits.reshape(64, -1)[:, :10]
-    idx = np.random.default_rng(0).permutation(64)
+    feats = logits.reshape(n, -1)[:, :10]
+    idx = np.random.default_rng(0).permutation(n)
     feats = feats[idx].astype(np.float64)
     prob = np.exp(feats) / np.exp(feats).sum(1, keepdims=True)
     log_prob = feats - np.log(np.exp(feats).sum(1, keepdims=True))
     scores = []
-    for p, lp in zip(np.array_split(prob, 4), np.array_split(log_prob, 4)):
+    chunk = -(-n // splits)
+    for start in range(0, n, chunk):
+        p, lp = prob[start : start + chunk], log_prob[start : start + chunk]
         mp = p.mean(0, keepdims=True)
         scores.append(np.exp((p * (lp - np.log(mp))).sum(1).mean()))
+    assert len(scores) == 9  # torch.chunk group count, not array_split's 10
     np.testing.assert_allclose(float(mean), np.mean(scores), rtol=1e-4)
     np.testing.assert_allclose(float(std), np.std(scores, ddof=1), rtol=1e-3)
 
