@@ -47,7 +47,7 @@ from metrics_tpu.classification import (  # noqa: F401
     Specificity,
     StatScores,
 )
-from metrics_tpu.core import CompositionalMetric, Metric, MetricCollection  # noqa: F401
+from metrics_tpu.core import CatBuffer, CompositionalMetric, Metric, MetricCollection  # noqa: F401
 from metrics_tpu.detection import MeanAveragePrecision  # noqa: F401
 from metrics_tpu.image import (  # noqa: F401
     ErrorRelativeGlobalDimensionlessSynthesis,
@@ -115,7 +115,7 @@ __all__ = [
     "__version__",
     "functional",
     # core
-    "Metric", "MetricCollection", "CompositionalMetric",
+    "Metric", "MetricCollection", "CompositionalMetric", "CatBuffer",
     # aggregation
     "CatMetric", "MaxMetric", "MeanMetric", "MinMetric", "SumMetric",
     # audio

@@ -138,6 +138,10 @@ class CatMetric(BaseAggregator):
             self.value = self.value + [value]
 
     def compute(self) -> Array:
+        from metrics_tpu.core.buffers import CatBuffer
+
+        if isinstance(self.value, CatBuffer):
+            return self.value.to_array() if self.value else jnp.zeros((0,))
         if isinstance(self.value, list) and self.value:
             return dim_zero_cat(self.value)
         return self.value

@@ -1,3 +1,4 @@
 """Core metric runtime (reference parity: torchmetrics/metric.py + collections.py)."""
 from metrics_tpu.core.collections import MetricCollection  # noqa: F401
+from metrics_tpu.core.buffers import CatBuffer  # noqa: F401
 from metrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: F401

@@ -1,0 +1,263 @@
+"""Fixed-capacity device buffers for ``cat`` states — the jit-compatible
+replacement for the reference's unbounded python-list states
+(torchmetrics/metric.py:350-352 concatenates list states before every sync;
+the TPU-preferred bounded alternative the reference itself points to is the
+binned curve family, classification/binned_precision_recall.py:45).
+
+Design (SURVEY.md §7 hard part 1): a ``CatBuffer`` is a pytree of
+``(data: (capacity, *item), count: int32)``. Appends are
+``lax.dynamic_update_slice`` at the current count, so ``update_state`` of any
+curve/feature metric traces into a single static-shape XLA program. Cross-batch
+merge and cross-device gather both reduce to one static-shape *compaction*
+primitive: concatenate the buffers, build a validity mask, and stable-argsort
+valid rows to the front — no ragged shapes anywhere.
+
+Overflow contract:
+- **Eager** appends/merges grow the buffer geometrically (the analog of the
+  reference's ``compute_on_cpu`` host-spill escape valve — metric.py:381-391 —
+  except the spill target is a larger device buffer).
+- **Traced** appends cannot grow (static shapes). ``dynamic_update_slice``
+  clamps the write offset, but ``count`` keeps the *true* total, so overflow is
+  detectable after the step: ``count > capacity``. ``to_array()`` (and thus any
+  eager ``compute()``) raises an actionable error instead of returning silently
+  truncated data.
+
+Metrics opt in by passing ``buffer_capacity=N`` to any metric whose states are
+registered as ``default=[]`` (see ``Metric.add_state``).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array, lax
+
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+__all__ = ["CatBuffer"]
+
+
+def _is_traced(x: Any) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+@jax.tree_util.register_pytree_node_class
+class CatBuffer:
+    """Preallocated ``(capacity, *item_shape)`` device buffer with a fill count.
+
+    Item shape/dtype are fixed by the first append (static under tracing: taken
+    from the abstract value). The buffer supports the two accumulation idioms
+    metric ``update`` methods use — ``buf.append(x)`` and ``buf = buf + [x]`` —
+    so a metric's update code is identical for list and buffer states.
+    """
+
+    def __init__(
+        self,
+        data: Optional[Array],
+        count: Union[Array, int],
+        capacity: Optional[int] = None,
+        overflowed: Union[Array, bool] = False,
+    ) -> None:
+        if data is None and (capacity is None or capacity <= 0):
+            raise ValueError(f"An unmaterialized CatBuffer needs a positive capacity, got {capacity}")
+        self.data = data
+        self.count = jnp.asarray(count, jnp.int32) if not isinstance(count, jnp.ndarray) else count
+        # sticky: once a traced append exceeds capacity the tail is corrupt, and
+        # later merges/gathers/appends may enlarge capacity past count — the
+        # flag survives all of them so to_array() still raises
+        self.overflowed = jnp.asarray(overflowed, jnp.bool_) if not isinstance(overflowed, jnp.ndarray) else overflowed
+        self._capacity = None if data is not None else int(capacity)
+
+    @property
+    def capacity(self) -> int:
+        """Row capacity. For materialized buffers this is ``data.shape[0]`` —
+        deliberately NOT pytree metadata, so buffers of different capacities
+        (e.g. pre- and post-``gather``) share one pytree structure and
+        ``shard_map`` in/out specs line up."""
+        return self.data.shape[0] if self.data is not None else self._capacity
+
+    # -------------------------------------------------------------- pytree --
+    def tree_flatten(self) -> Tuple[Tuple[Any, Any, Any], Optional[int]]:
+        return (self.data, self.count, self.overflowed), self._capacity
+
+    @classmethod
+    def tree_unflatten(cls, capacity: Optional[int], children: Tuple[Any, Any, Any]) -> "CatBuffer":
+        data, count, overflowed = children
+        obj = object.__new__(cls)
+        obj.data = data
+        obj.count = count
+        obj.overflowed = overflowed
+        obj._capacity = capacity
+        return obj
+
+    # ------------------------------------------------------------ creation --
+    @classmethod
+    def empty(cls, capacity: int, item_shape: Optional[Sequence[int]] = None, dtype: Any = None) -> "CatBuffer":
+        """Unmaterialized buffer (item shape fixed by first append), or a
+        materialized zero buffer when ``item_shape``/``dtype`` are given."""
+        data = None if item_shape is None else jnp.zeros((capacity, *item_shape), dtype or jnp.float32)
+        return cls(data, 0, capacity)
+
+    @classmethod
+    def from_array(cls, values: Array, capacity: Optional[int] = None) -> "CatBuffer":
+        values = jnp.atleast_1d(jnp.asarray(values))
+        n = values.shape[0]
+        capacity = max(capacity or 0, n, 1)
+        data = jnp.zeros((capacity,) + values.shape[1:], values.dtype)
+        data = lax.dynamic_update_slice(data, values, (0,) * values.ndim)
+        return cls(data, n, capacity)
+
+    def copy(self) -> "CatBuffer":
+        return CatBuffer.tree_unflatten(self._capacity, (self.data, self.count, self.overflowed))
+
+    # ------------------------------------------------------------- queries --
+    @property
+    def materialized(self) -> bool:
+        return self.data is not None
+
+    @property
+    def item_shape(self) -> Optional[Tuple[int, ...]]:
+        return None if self.data is None else tuple(self.data.shape[1:])
+
+    def valid_mask(self) -> Array:
+        """(capacity,) bool — True for filled rows (overflow clamps to all-True)."""
+        return jnp.arange(self.capacity) < jnp.minimum(self.count, self.capacity)
+
+    def __bool__(self) -> bool:
+        if not self.materialized:
+            return False
+        if _is_traced(self.count):
+            return True  # conservatively non-empty under tracing
+        return int(self.count) > 0
+
+    def __len__(self) -> int:
+        if _is_traced(self.count):
+            raise MetricsUserError("len(CatBuffer) requires a concrete count; not available under tracing.")
+        return int(self.count)
+
+    def to_array(self) -> Array:
+        """The valid prefix ``data[:count]``. Eager-only (dynamic shape)."""
+        if not self.materialized:
+            raise MetricsUserError("CatBuffer is empty: no state has been appended yet.")
+        if _is_traced(self.count) or _is_traced(self.data):
+            raise MetricsUserError(
+                "CatBuffer.to_array() has a data-dependent shape and cannot run under jit. "
+                "Call compute() outside the compiled step (the fixed-shape buffer state "
+                "itself flows through jit freely)."
+            )
+        count = int(self.count)
+        if count > self.capacity or bool(self.overflowed):
+            raise MetricsUserError(
+                f"CatBuffer overflow: more samples were appended (count={count}) than its capacity "
+                f"({self.capacity}) held at the time, inside a compiled program (which cannot grow "
+                "buffers); the overflowing appends overwrote the buffer tail. Raise "
+                "`buffer_capacity` to at least the per-device total sample count, or accumulate "
+                "eagerly (eager appends grow the buffer automatically)."
+            )
+        return self.data[:count]
+
+    # ----------------------------------------------------------- mutation --
+    def _grow_to(self, needed: int) -> None:
+        new_cap = self.capacity
+        while new_cap < needed:
+            new_cap *= 2
+        if new_cap != self.capacity:
+            pad = [(0, new_cap - self.capacity)] + [(0, 0)] * (self.data.ndim - 1)
+            self.data = jnp.pad(self.data, pad)  # capacity tracks data.shape[0]
+
+    def append(self, x: Array) -> None:
+        """Append a batch (rows of ``x`` along dim 0; scalars count as one row).
+
+        In-place idiom (rebinds fields, arrays stay immutable). Traced appends
+        keep static shapes; eager appends grow the buffer geometrically on
+        overflow (the host-spill escape valve).
+        """
+        x = jnp.atleast_1d(jnp.asarray(x))
+        n = x.shape[0]
+        if self.data is None:
+            self.data = jnp.zeros((self.capacity,) + x.shape[1:], x.dtype)
+            self._capacity = None  # capacity now tracks data.shape[0]
+        elif x.shape[1:] != self.data.shape[1:]:
+            raise MetricsUserError(
+                f"CatBuffer item shape mismatch: buffer holds items of shape {self.data.shape[1:]}, "
+                f"got a batch of items of shape {x.shape[1:]}. Buffered (jit-compatible) cat states "
+                "need a uniform per-item shape; pad inputs to a static shape first."
+            )
+        eager = not (_is_traced(self.count) or _is_traced(self.data) or _is_traced(x))
+        if eager:
+            self._grow_to(int(self.count) + n)
+        else:
+            # static shapes: the write below clamps, so flag the corruption
+            self.overflowed = self.overflowed | (self.count + n > self.capacity)
+        start = (self.count,) + (0,) * (x.ndim - 1)
+        self.data = lax.dynamic_update_slice(self.data, x.astype(self.data.dtype), start)
+        self.count = self.count + n
+
+    def __add__(self, other: Union["CatBuffer", List[Array]]) -> "CatBuffer":
+        new = self.copy()
+        if isinstance(other, CatBuffer):
+            return new.merge(other)
+        for v in other:
+            new.append(v)
+        return new
+
+    def __iadd__(self, other: Union["CatBuffer", List[Array]]) -> "CatBuffer":
+        return self.__add__(other)
+
+    # ---------------------------------------------------- merge and gather --
+    @staticmethod
+    def _compact(data: Array, valid: Array, total: Array, capacity: int, overflowed: Array) -> "CatBuffer":
+        """Stable-move valid rows to the front. One sort, fully static shapes."""
+        order = jnp.argsort(~valid, stable=True)
+        return CatBuffer(data[order], total, capacity, overflowed)
+
+    def merge(self, other: "CatBuffer") -> "CatBuffer":
+        """Cross-batch/cross-shard merge (the `merge_states` cat branch).
+
+        Eager: appends ``other``'s valid rows into (a grown copy of) this
+        buffer — capacity stays geometric, not additive. Traced: static-shape
+        concat + compaction; capacities add, so prefer merging eagerly or
+        syncing via collectives in long-running compiled loops.
+        """
+        if not other.materialized:
+            return self.copy()
+        if not self.materialized:
+            return other.copy()
+        eager = not any(_is_traced(v) for v in (self.count, self.data, other.count, other.data))
+        if eager and not (bool(self.overflowed) or bool(other.overflowed)):
+            new = self.copy()
+            new.append(other.to_array())
+            return new
+        data = jnp.concatenate([self.data, other.data.astype(self.data.dtype)], axis=0)
+        valid = jnp.concatenate([self.valid_mask(), other.valid_mask()])
+        return self._compact(
+            data, valid, self.count + other.count, self.capacity + other.capacity,
+            self.overflowed | other.overflowed,
+        )
+
+    def gather(self, axis_name: Union[str, Tuple[str, ...]]) -> "CatBuffer":
+        """All-gather across a mesh axis into one compacted buffer.
+
+        The reference's ragged gather (pad-to-max + trim, utilities/
+        distributed.py:128-151) is replaced by equal static shapes per device
+        plus one compaction sort — jit/shard_map native.
+        """
+        if not self.materialized:
+            raise MetricsUserError("Cannot gather an empty CatBuffer (no appends before sync).")
+        data = lax.all_gather(self.data, axis_name, axis=0, tiled=True)  # (W*cap, *item)
+        counts = lax.all_gather(self.count, axis_name, axis=0)  # (W,)
+        overflowed = jnp.any(lax.all_gather(self.overflowed, axis_name, axis=0))
+        world = data.shape[0] // self.capacity
+        valid = (jnp.arange(self.capacity)[None, :] < jnp.minimum(counts, self.capacity)[:, None]).reshape(-1)
+        # a device whose count exceeded its capacity has a corrupt tail — the
+        # sticky flag (or'ed across devices) keeps the gathered buffer poisoned
+        overflowed = overflowed | jnp.any(counts > self.capacity)
+        return self._compact(data, valid, jnp.sum(counts), world * self.capacity, overflowed)
+
+    # -------------------------------------------------------------- dunder --
+    def __repr__(self) -> str:
+        shape = None if self.data is None else tuple(self.data.shape)
+        count = "?" if _is_traced(self.count) else int(self.count)
+        return f"CatBuffer(capacity={self.capacity}, count={count}, data={shape})"

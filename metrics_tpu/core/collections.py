@@ -264,9 +264,16 @@ class MetricCollection:
     # ------------------------------------------------------------------ #
     # fused pure protocol (the compiled hot path)
     # ------------------------------------------------------------------ #
-    def init_state(self) -> Dict[str, StateDict]:
-        """One state pytree per compute group, keyed by leader name."""
-        return {g[0]: self._metrics.__getitem__(g[0]).init_state() for g in self._groups}
+    def init_state(self, *example_args: Any, **example_kwargs: Any) -> Dict[str, StateDict]:
+        """One state pytree per compute group, keyed by leader name.
+
+        Example update arguments (see ``Metric.init_state``) materialize any
+        lazily-shaped ``CatBuffer`` states for compiled flows."""
+        out = {}
+        for g in self._groups:
+            leader = self._metrics.__getitem__(g[0])
+            out[g[0]] = leader.init_state(*example_args, **leader._filter_kwargs(**example_kwargs))
+        return out
 
     def update_state(self, states: Dict[str, StateDict], *args: Any, **kwargs: Any) -> Dict[str, StateDict]:
         """Pure fused update — jit this (optionally together with the model

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from metrics_tpu.core.buffers import CatBuffer, _is_traced
 from metrics_tpu.parallel import sync as _sync
 from metrics_tpu.utils.data import (
     _flatten,
@@ -48,15 +49,19 @@ from metrics_tpu.utils.data import (
 from metrics_tpu.utils.exceptions import MetricsUserError
 from metrics_tpu.utils.prints import rank_zero_warn
 
-StateValue = Union[Array, List[Array]]
+StateValue = Union[Array, List[Array], CatBuffer]
 StateDict = Dict[str, StateValue]
 
 _PROTECTED_PROPERTIES = ("is_differentiable", "higher_is_better", "full_state_update")
 
 
 def _copy_state_value(value: StateValue) -> StateValue:
-    """Snapshot a state leaf. Arrays are immutable (free); lists are re-wrapped."""
-    return list(value) if isinstance(value, list) else value
+    """Snapshot a state leaf. Arrays are immutable (free); lists/buffers are re-wrapped."""
+    if isinstance(value, list):
+        return list(value)
+    if isinstance(value, CatBuffer):
+        return value.copy()
+    return value
 
 
 class Metric:
@@ -72,6 +77,14 @@ class Metric:
         dist_sync_fn: custom callable ``(state_dict, reductions, axis) -> state_dict``
             replacing the built-in collective sync.
         sync_on_compute: whether ``compute()`` synchronizes automatically.
+        buffer_capacity: when set, every state registered with ``default=[]``
+            becomes a fixed-capacity :class:`CatBuffer` instead of an unbounded
+            python list, making ``update_state`` jittable for curve/feature
+            metrics (AUROC, PR-curve, IS/KID features, retrieval, CatMetric).
+            Capacity is per-device rows; eager appends grow it on overflow,
+            compiled appends require it to cover the full run (overflow is
+            detected and raised at ``compute``). TPU-first replacement for the
+            reference's unbounded list states (metric.py:350-352).
     """
 
     __jit_unwrapped__ = True  # marker: methods close over self as static config
@@ -87,10 +100,13 @@ class Metric:
         process_group: Optional[Union[str, Tuple[str, ...]]] = None,
         dist_sync_fn: Optional[Callable] = None,
         sync_on_compute: bool = True,
+        buffer_capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         if kwargs:
             raise ValueError(f"Unexpected keyword arguments: {list(kwargs)}")
+        if buffer_capacity is not None and (not isinstance(buffer_capacity, int) or buffer_capacity <= 0):
+            raise ValueError(f"Expected keyword argument `buffer_capacity` to be a positive int but got {buffer_capacity}")
         if not isinstance(compute_on_cpu, bool):
             raise ValueError(f"Expected keyword argument `compute_on_cpu` to be a `bool` but got {compute_on_cpu}")
         if not isinstance(dist_sync_on_step, bool):
@@ -102,6 +118,7 @@ class Metric:
         self.process_group = process_group
         self.dist_sync_fn = dist_sync_fn
         self.sync_on_compute = sync_on_compute
+        self.buffer_capacity = buffer_capacity
 
         self._defaults: Dict[str, StateValue] = {}
         self._persistent: Dict[str, bool] = {}
@@ -128,20 +145,45 @@ class Metric:
         default: StateValue,
         dist_reduce_fx: Optional[Union[str, Callable]] = None,
         persistent: bool = False,
+        bufferable: Optional[bool] = None,
     ) -> None:
         """Register a state variable (reference: metric.py:149-217).
 
-        ``default`` must be a jax array (fixed-shape state) or an empty list
-        (unbounded ``cat`` buffer). ``dist_reduce_fx`` is one of
+        ``default`` must be a jax array (fixed-shape state), an empty list
+        (unbounded ``cat`` buffer), or a :class:`CatBuffer` (fixed-capacity
+        jittable ``cat`` buffer). ``dist_reduce_fx`` is one of
         ``"sum"|"mean"|"max"|"min"|"cat"``, a custom callable applied to the
         cross-device stack, or None (all-gather, keep per-device values).
+        When the metric was constructed with ``buffer_capacity``, ``default=[]``
+        is promoted to a ``CatBuffer`` of that capacity — but only if the state
+        is *bufferable*: consumed as a flat dim-0 concatenation (``dim_zero_cat``),
+        not as a list of per-element entries (e.g. mAP's per-image box lists).
+        ``bufferable`` defaults to ``dist_reduce_fx == "cat"``; metrics whose
+        ``None``-reduce list states are nonetheless flat (IS/KID features,
+        retrieval) pass ``bufferable=True`` explicitly.
         """
-        if not isinstance(default, (jnp.ndarray, np.ndarray)) and not (isinstance(default, list) and default == []):
-            raise ValueError("state variable must be a jax array or an empty list (any other type would not be supported by jit)")
+        if (
+            not isinstance(default, (jnp.ndarray, np.ndarray, CatBuffer))
+            and not (isinstance(default, list) and default == [])
+        ):
+            raise ValueError(
+                "state variable must be a jax array, an empty list, or a CatBuffer"
+                " (any other type would not be supported by jit)"
+            )
         if dist_reduce_fx not in ("sum", "mean", "cat", "max", "min", None) and not callable(dist_reduce_fx):
             raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")
         if isinstance(default, np.ndarray):
             default = jnp.asarray(default)
+        if isinstance(default, list) and default == [] and self.buffer_capacity is not None:
+            if bufferable is None:
+                bufferable = dist_reduce_fx == "cat"
+            if not bufferable:
+                raise MetricsUserError(
+                    f"{type(self).__name__} does not support `buffer_capacity`: state {name!r} is "
+                    "a list of per-element entries (not a flat dim-0 concatenation), so it cannot "
+                    "be stored in a fixed-capacity CatBuffer. Remove the `buffer_capacity` argument."
+                )
+            default = CatBuffer.empty(self.buffer_capacity)
 
         self._defaults[name] = _copy_state_value(default)
         self._persistent[name] = persistent
@@ -156,9 +198,27 @@ class Metric:
     # ------------------------------------------------------------------ #
     # pure functional protocol
     # ------------------------------------------------------------------ #
-    def init_state(self) -> StateDict:
-        """Fresh state pytree from the registered defaults."""
-        return {k: _copy_state_value(v) for k, v in self._defaults.items()}
+    def init_state(self, *example_args: Any, **example_kwargs: Any) -> StateDict:
+        """Fresh state pytree from the registered defaults.
+
+        ``CatBuffer`` states are lazily shaped (the per-item shape comes from
+        the first batch). Pass example update arguments — arrays or
+        ``jax.ShapeDtypeStruct``s — to materialize them up front via
+        ``jax.eval_shape``; compiled flows (``jit``/``shard_map`` in/out specs,
+        ``lax.scan`` carries) need this so the state pytree structure is stable
+        from the first step.
+        """
+        state = {k: _copy_state_value(v) for k, v in self._defaults.items()}
+        needs_shapes = any(isinstance(v, CatBuffer) and not v.materialized for v in state.values())
+        if needs_shapes and (example_args or example_kwargs):
+            out = jax.eval_shape(
+                lambda s, a, kw: self.update_state(s, *a, **kw), state, example_args, example_kwargs
+            )
+            for k, v in state.items():
+                ref = out[k]
+                if isinstance(v, CatBuffer) and not v.materialized and ref.data is not None:
+                    state[k] = CatBuffer(jnp.zeros(ref.data.shape, ref.data.dtype), 0)
+        return state
 
     def get_state(self) -> StateDict:
         return {k: _copy_state_value(getattr(self, k)) for k in self._defaults}
@@ -197,6 +257,27 @@ class Metric:
         """Pure: return ``state`` advanced by one batch. Jittable (``self`` is
         closed over as static config). The stateful ``update`` and this function
         share one implementation, so there is a single code path to test."""
+        # A list state is a pytree whose structure grows with every update:
+        # carrying it across separate compiled steps recompiles each step, and
+        # lax.scan rejects the changing carry outright. Accumulating *within*
+        # one trace (the ddp sync pattern) is fine and indistinguishable from
+        # here, so this is a once-per-instance warning, not an error; the
+        # static capability signal is `supports_compiled_update`.
+        nonempty_lists = [k for k, v in state.items() if isinstance(v, list) and v]
+        if (
+            nonempty_lists
+            and not getattr(self, "_warned_list_state_trace", False)
+            and any(_is_traced(leaf) for leaf in jax.tree_util.tree_leaves((args, kwargs)))
+        ):
+            self._warned_list_state_trace = True
+            rank_zero_warn(
+                f"{type(self).__name__}.update_state is being traced (jit/shard_map/vmap) with "
+                f"already-populated unbounded list state(s) {nonempty_lists}. If this state is "
+                "carried across compiled steps, every step changes its pytree structure — forcing "
+                "a recompile per step (lax.scan rejects it outright). Construct the metric with "
+                "`buffer_capacity=N` for a fixed-capacity device buffer instead.",
+                UserWarning,
+            )
         prev = self.get_state()
         try:
             self.set_state(state)
@@ -204,6 +285,13 @@ class Metric:
             return self.get_state()
         finally:
             self.set_state(prev)
+
+    @property
+    def supports_compiled_update(self) -> bool:
+        """True when every state is a fixed-shape array or :class:`CatBuffer`,
+        i.e. ``update_state`` may run under jit/shard_map. List-state metrics
+        become compilable by constructing them with ``buffer_capacity=N``."""
+        return not any(isinstance(v, list) for v in self._defaults.values())
 
     def compute_state(self, state: StateDict) -> Any:
         """Pure: metric value from a state pytree (no sync, no cache)."""
@@ -234,6 +322,8 @@ class Metric:
                 out[attr] = jnp.maximum(a, b)
             elif reduce_fn == "min":
                 out[attr] = jnp.minimum(a, b)
+            elif isinstance(a, CatBuffer) and (reduce_fn == "cat" or reduce_fn is None):
+                out[attr] = a.merge(b)
             elif reduce_fn == "cat":
                 out[attr] = list(a) + list(b) if isinstance(a, list) else jnp.concatenate([jnp.atleast_1d(a), jnp.atleast_1d(b)])
             elif reduce_fn is None and isinstance(a, list):
@@ -312,7 +402,9 @@ class Metric:
         batch_val = self.compute()
 
         self._update_count = _update_count + 1
-        self.set_state(self.merge_states(self.get_state(), global_state, (1, _update_count)))
+        # global state first — cat states must keep accumulation order
+        # (reference: _reduce_states, metric.py:327-344)
+        self.set_state(self.merge_states(global_state, self.get_state(), (_update_count, 1)))
 
         self._is_synced = False
         self._should_unsync = True
@@ -336,10 +428,13 @@ class Metric:
     def _move_list_states_to_cpu(self) -> None:
         """Device->host offload of list states (reference: metric.py:386-391)."""
         cpu = jax.devices("cpu")[0] if any(d.platform == "cpu" for d in jax.local_devices()) else None
+        move = lambda v: jax.device_put(v, cpu) if cpu else jax.device_get(v)
         for key in self._defaults:
             val = getattr(self, key)
             if isinstance(val, list):
-                setattr(self, key, [jax.device_put(v, cpu) if cpu else jax.device_get(v) for v in val])
+                setattr(self, key, [move(v) for v in val])
+            elif isinstance(val, CatBuffer) and val.materialized:
+                setattr(self, key, CatBuffer(move(val.data), val.count, val.capacity))
 
     # ------------------------------------------------------------------ #
     # distributed sync (reference: metric.py:346-483)
@@ -356,6 +451,13 @@ class Metric:
             synced = {}
             for attr, red in self._reductions.items():
                 val = state[attr]
+                if isinstance(val, CatBuffer):
+                    if not val.materialized:
+                        synced[attr] = val
+                        continue
+                    gathered = _sync.gather_all_arrays(val.to_array())
+                    synced[attr] = CatBuffer.from_array(dim_zero_cat(gathered), capacity=val.capacity)
+                    continue
                 if isinstance(val, list):
                     val = dim_zero_cat(val) if val else val
                     if isinstance(val, list):
@@ -493,6 +595,8 @@ class Metric:
     @property
     def device(self):
         for v in self.metric_state.values():
+            if isinstance(v, CatBuffer):
+                v = v.data
             arr = v[0] if isinstance(v, list) and v else v
             if isinstance(arr, jnp.ndarray):
                 try:
@@ -504,24 +608,34 @@ class Metric:
     def to(self, device) -> "Metric":
         """Move all states (and defaults) to ``device``."""
         move = lambda x: jax.device_put(x, device)
+
+        def apply(val):
+            if isinstance(val, list):
+                return [move(v) for v in val]
+            if isinstance(val, CatBuffer):
+                return val if not val.materialized else CatBuffer(move(val.data), val.count, val.capacity)
+            return move(val)
+
         for attr in self._defaults:
-            val = getattr(self, attr)
-            setattr(self, attr, [move(v) for v in val] if isinstance(val, list) else move(val))
-        self._defaults = {
-            k: ([move(v) for v in d] if isinstance(d, list) else move(d)) for k, d in self._defaults.items()
-        }
+            setattr(self, attr, apply(getattr(self, attr)))
+        self._defaults = {k: apply(d) for k, d in self._defaults.items()}
         return self
 
     def astype(self, dtype) -> "Metric":
         """Cast floating-point states to ``dtype`` (half/float/double analogs)."""
         def cast(x):
             return x.astype(dtype) if isinstance(x, jnp.ndarray) and jnp.issubdtype(x.dtype, jnp.floating) else x
+
+        def apply(val):
+            if isinstance(val, list):
+                return [cast(v) for v in val]
+            if isinstance(val, CatBuffer):
+                return val if not val.materialized else CatBuffer(cast(val.data), val.count, val.capacity)
+            return cast(val)
+
         for attr in self._defaults:
-            val = getattr(self, attr)
-            setattr(self, attr, [cast(v) for v in val] if isinstance(val, list) else cast(val))
-        self._defaults = {
-            k: ([cast(v) for v in d] if isinstance(d, list) else cast(d)) for k, d in self._defaults.items()
-        }
+            setattr(self, attr, apply(getattr(self, attr)))
+        self._defaults = {k: apply(d) for k, d in self._defaults.items()}
         return self
 
     # ------------------------------------------------------------------ #
@@ -539,6 +653,11 @@ class Metric:
                 current = getattr(self, key)
                 if isinstance(current, list):
                     out[prefix + key] = [np.asarray(v) for v in current]
+                elif isinstance(current, CatBuffer):
+                    # checkpoint the compact valid prefix — same on-disk format
+                    # as a concatenated list state, so buffer/list checkpoints
+                    # interconvert
+                    out[prefix + key] = np.asarray(current.to_array()) if current else np.zeros((0,), np.float32)
                 else:
                     out[prefix + key] = np.asarray(current)
         return out
@@ -548,7 +667,16 @@ class Metric:
             name = prefix + key
             if name in state_dict:
                 val = state_dict[name]
-                setattr(self, key, [jnp.asarray(v) for v in val] if isinstance(val, list) else jnp.asarray(val))
+                if isinstance(self._defaults[key], CatBuffer):
+                    cap = self._defaults[key].capacity
+                    if isinstance(val, list):
+                        val = np.concatenate([np.atleast_1d(v) for v in val]) if val else np.zeros((0,), np.float32)
+                    arr = jnp.asarray(val)
+                    setattr(self, key, CatBuffer.empty(cap) if arr.shape[0] == 0 else CatBuffer.from_array(arr, capacity=cap))
+                elif isinstance(val, list):
+                    setattr(self, key, [jnp.asarray(v) for v in val])
+                else:
+                    setattr(self, key, jnp.asarray(val))
             elif strict and self._persistent[key]:
                 raise KeyError(f"Missing key {name!r} in state_dict")
 

@@ -45,7 +45,7 @@ class InceptionScore(Metric):
         self.inception = resolve_feature_extractor(feature, "InceptionScore", _VALID_IS_FEATURES, variables)
         self.splits = splits
         self.seed = seed
-        self.add_state("features", [], dist_reduce_fx=None)
+        self.add_state("features", [], dist_reduce_fx=None, bufferable=True)
 
     def update(self, imgs: Array) -> None:  # type: ignore[override]
         self.features.append(jnp.asarray(self.inception(imgs), dtype=jnp.float32))

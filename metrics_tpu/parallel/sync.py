@@ -140,9 +140,18 @@ def sync_state(
     each state costs exactly one collective — same optimization the reference
     applies at metric.py:350-352.
     """
+    from metrics_tpu.core.buffers import CatBuffer
+
     out = {}
     for name, val in state.items():
         red = reductions.get(name)
+        if isinstance(val, CatBuffer):
+            if red not in ("cat", None):
+                raise ValueError(
+                    f"CatBuffer state {name!r} only supports dist_reduce_fx 'cat'/None, got {red!r}"
+                )
+            out[name] = val.gather(axis_name) if val.materialized else val
+            continue
         if isinstance(val, (list, tuple)):
             if len(val) == 0:
                 out[name] = val

@@ -32,7 +32,15 @@ METRIC_EPS = 1e-6
 # dim-zero reductions (the `dist_reduce_fx` vocabulary)
 # --------------------------------------------------------------------------- #
 def dim_zero_cat(x: Union[Array, Sequence[Array]]) -> Array:
-    """Concatenate a (list of) array(s) along dim 0; scalars are broadcast to 1-d."""
+    """Concatenate a (list of) array(s) along dim 0; scalars are broadcast to 1-d.
+
+    Accepts a :class:`~metrics_tpu.core.buffers.CatBuffer` (fixed-capacity cat
+    state) and returns its valid prefix.
+    """
+    from metrics_tpu.core.buffers import CatBuffer
+
+    if isinstance(x, CatBuffer):
+        return x.to_array()
     if isinstance(x, (jnp.ndarray, np.ndarray)) and not isinstance(x, (list, tuple)):
         return x  # type: ignore[return-value]
     x = [jnp.atleast_1d(jnp.asarray(el)) for el in x]
