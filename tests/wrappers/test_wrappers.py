@@ -1,4 +1,5 @@
 """Wrapper tests (reference parity: tests/wrappers/*)."""
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -166,3 +167,63 @@ def test_multioutput_forward_invalidates_cache():
     assert np.allclose(np.asarray(m.compute()), [0.0, 0.0])
     m(p + 1.0, t)  # forward adds per-output squared error of 1.0
     np.testing.assert_allclose(np.asarray(m.compute()), [0.5, 0.5], atol=1e-6)
+
+
+def test_bootstrapper_vmap_path_active():
+    """TPU redesign (SURVEY.md §7 build order 6): stacked state, no copies."""
+    from metrics_tpu import MeanSquaredError
+
+    bs = BootStrapper(MeanSquaredError(), num_bootstraps=6, seed=0)
+    assert bs._vmapped and bs.metrics == []
+    # state is one stacked pytree with a leading bootstrap axis
+    assert all(getattr(bs, k).shape[0] == 6 for k in bs._defaults)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(32,)).astype(np.float32))
+    y = x + 0.1
+    bs.update(x, y)
+    out = bs.compute()
+    assert np.isfinite(float(out["mean"])) and np.isfinite(float(out["std"]))
+
+
+@pytest.mark.parametrize("strategy", ["poisson", "multinomial"])
+def test_bootstrapper_vmap_matches_copies_design(strategy):
+    """Same seed => the stacked vmap path reproduces the reference's
+    N-deepcopies design exactly (same host RNG draw order)."""
+    from copy import deepcopy
+
+    from metrics_tpu import MeanSquaredError
+
+    rng = np.random.default_rng(5)
+    batches = [(rng.normal(size=(16,)).astype(np.float32), rng.normal(size=(16,)).astype(np.float32)) for _ in range(3)]
+
+    bs = BootStrapper(MeanSquaredError(), num_bootstraps=8, sampling_strategy=strategy, seed=11, raw=True)
+    assert bs._vmapped
+    # same wrapper forced onto the reference copies path
+    bs_ref = BootStrapper(MeanSquaredError(), num_bootstraps=8, sampling_strategy=strategy, seed=11, raw=True)
+    bs_ref._vmapped = False
+    bs_ref.metrics = [deepcopy(MeanSquaredError()) for _ in range(8)]
+
+    for x, y in batches:
+        bs.update(jnp.asarray(x), jnp.asarray(y))
+        bs_ref.update(jnp.asarray(x), jnp.asarray(y))
+    got, want = bs.compute(), bs_ref.compute()
+    np.testing.assert_allclose(np.asarray(got["raw"]), np.asarray(want["raw"]), rtol=1e-6)
+
+
+def test_bootstrapper_update_rejects_tracing():
+    from metrics_tpu import MeanSquaredError
+    from metrics_tpu.utils.exceptions import MetricsUserError
+
+    bs = BootStrapper(MeanSquaredError(), num_bootstraps=4, seed=0)
+    with pytest.raises(MetricsUserError, match="resampling indices"):
+        jax.jit(bs.update_state)(bs.init_state(), jnp.zeros((8,)), jnp.zeros((8,)))
+
+
+def test_bootstrapper_inherits_base_state():
+    """Review regression: replicas must start from the base metric's current
+    (possibly pre-accumulated) state, like the deepcopy design."""
+    base = MeanSquaredError()
+    base.update(jnp.ones((4,)), jnp.zeros((4,)))  # sse=4, n=4
+    bs = BootStrapper(base, num_bootstraps=3, seed=0, mean=True, std=False)
+    assert bs._vmapped
+    out = bs.compute()
+    np.testing.assert_allclose(float(out["mean"]), 1.0)  # all replicas carry mse=1
