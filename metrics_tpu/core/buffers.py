@@ -191,6 +191,8 @@ class CatBuffer:
         else:
             # static shapes: the write below clamps, so flag the corruption
             self.overflowed = self.overflowed | (self.count + n > self.capacity)
+            if n > self.capacity:  # a single batch larger than the whole buffer
+                x = x[: self.capacity]
         start = (self.count,) + (0,) * (x.ndim - 1)
         self.data = lax.dynamic_update_slice(self.data, x.astype(self.data.dtype), start)
         self.count = self.count + n

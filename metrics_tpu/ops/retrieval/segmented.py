@@ -1,0 +1,198 @@
+"""Compiled (static-shape) retrieval evaluation — SURVEY.md §7 decision 3.
+
+The reference (and the eager path in :mod:`metrics_tpu.retrieval.base`) groups
+documents by query with a host-side dict of index lists
+(torchmetrics/utilities/data.py:210-233) and scores each query in a python
+loop — O(#queries) host dispatches at ``compute()``. Here the whole evaluation
+is one XLA program with static bounds ``(max_queries, max_docs_per_query)``:
+
+1. ``bucketize_queries``: sort the flat ``(N,)`` streams by query id (stable,
+   so within-query document order is preserved), derive dense query ids and
+   within-query positions with cumulative ops, and scatter into dense
+   ``(Q, D)`` matrices plus validity masks. Invalid/overflowing entries are
+   dropped by out-of-bounds scatter semantics and *reported* via an overflow
+   flag — never silently folded into scores.
+2. ``*_rows`` scorers: masked, fully vectorized row-wise re-expressions of the
+   single-query functionals in :mod:`metrics_tpu.ops.retrieval` (each is
+   parity-tested against its eager counterpart). Sorting puts invalid docs
+   last by giving them ``-inf`` preds; dynamic per-query ``k`` (adaptive k,
+   R-precision's R, k=None) becomes a position mask instead of a slice.
+3. ``segmented_mean``: ``empty_target_action`` handling as masked selection.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+__all__ = [
+    "bucketize_queries",
+    "average_precision_rows",
+    "reciprocal_rank_rows",
+    "precision_rows",
+    "recall_rows",
+    "hit_rate_rows",
+    "fall_out_rows",
+    "normalized_dcg_rows",
+    "r_precision_rows",
+    "segmented_mean",
+]
+
+
+def bucketize_queries(
+    indexes: Array,
+    preds: Array,
+    target: Array,
+    valid: Optional[Array],
+    max_queries: int,
+    max_docs: int,
+) -> Tuple[Array, Array, Array, Array, Array]:
+    """Scatter flat (N,) streams into dense (Q, D) per-query matrices.
+
+    Returns ``(P, T, M, query_mask, overflow)``: preds (-inf outside ``M``),
+    targets (0 outside ``M``), doc validity mask, per-row query-exists mask,
+    and a scalar bool that is True when the static bounds were exceeded
+    (too many distinct queries, or a query with more than ``max_docs`` docs).
+    """
+    n = indexes.shape[0]
+    if valid is None:
+        valid = jnp.ones((n,), bool)
+    sentinel = jnp.iinfo(jnp.int32).max
+    idxs = jnp.where(valid, indexes.astype(jnp.int32), sentinel)
+    order = jnp.argsort(idxs, stable=True)
+    si, sp, st, sv = idxs[order], preds[order], target[order], valid[order]
+
+    first = jnp.concatenate([jnp.ones((1,), bool), si[1:] != si[:-1]])
+    new_q = first & sv
+    dense = jnp.cumsum(new_q) - 1
+    dense = jnp.where(sv & (dense < max_queries), dense, max_queries)  # -> dropped
+    group_start = jax.lax.associative_scan(jnp.maximum, jnp.where(new_q, jnp.arange(n), 0))
+    pos = jnp.arange(n) - group_start
+
+    n_queries = jnp.sum(new_q)
+    overflow = (n_queries > max_queries) | jnp.any(sv & (pos >= max_docs))
+
+    p_mat = jnp.full((max_queries + 1, max_docs), -jnp.inf, preds.dtype).at[dense, pos].set(sp, mode="drop")
+    t_mat = jnp.zeros((max_queries + 1, max_docs), target.dtype).at[dense, pos].set(st, mode="drop")
+    m_mat = jnp.zeros((max_queries + 1, max_docs), bool).at[dense, pos].set(sv, mode="drop")
+    qmask = jnp.arange(max_queries) < jnp.minimum(n_queries, max_queries)
+    return p_mat[:max_queries], t_mat[:max_queries], m_mat[:max_queries], qmask, overflow
+
+
+# --------------------------------------------------------------------------- #
+# masked row scorers
+# --------------------------------------------------------------------------- #
+def _sort_rows(p_mat: Array, t_mat: Array, m_mat: Array) -> Tuple[Array, Array]:
+    """Per-row targets sorted by preds desc (invalid docs last), + sorted mask."""
+    masked_p = jnp.where(m_mat, p_mat, -jnp.inf)
+    order = jnp.argsort(-masked_p, axis=1, stable=True)
+    tt = jnp.take_along_axis(jnp.where(m_mat, t_mat, 0), order, axis=1).astype(jnp.float32)
+    mm = jnp.take_along_axis(m_mat, order, axis=1)
+    return tt, mm
+
+
+def _positions(d: int) -> Array:
+    return jnp.arange(d, dtype=jnp.float32)
+
+
+def average_precision_rows(p_mat: Array, t_mat: Array, m_mat: Array) -> Array:
+    tt, _ = _sort_rows(p_mat, t_mat, m_mat)
+    cs = jnp.cumsum(tt, axis=1)
+    prec_at = cs / (_positions(tt.shape[1]) + 1.0)
+    n_pos = jnp.sum(tt, axis=1)
+    return jnp.where(n_pos > 0, jnp.sum(prec_at * tt, axis=1) / jnp.maximum(n_pos, 1.0), 0.0)
+
+
+def reciprocal_rank_rows(p_mat: Array, t_mat: Array, m_mat: Array) -> Array:
+    tt, _ = _sort_rows(p_mat, t_mat, m_mat)
+    first = jnp.argmax(tt > 0, axis=1)
+    has_pos = jnp.any(tt > 0, axis=1)
+    return jnp.where(has_pos, 1.0 / (first + 1.0), 0.0)
+
+
+def _relevant_at(tt: Array, k_eff: Array) -> Array:
+    """Sum of sorted targets within the first ``k_eff`` (per-row) positions."""
+    return jnp.sum(tt * (_positions(tt.shape[1])[None, :] < k_eff[:, None]), axis=1)
+
+
+def _k_eff(k: Optional[int], adaptive_k: bool, n_docs: Array, d: int) -> Array:
+    if k is None:
+        return n_docs
+    k_arr = jnp.full_like(n_docs, float(min(k, d) if not adaptive_k else k))
+    if adaptive_k:
+        k_arr = jnp.where(k_arr > n_docs, n_docs, k_arr)
+    return k_arr
+
+
+def precision_rows(p_mat: Array, t_mat: Array, m_mat: Array, k: Optional[int] = None, adaptive_k: bool = False) -> Array:
+    tt, mm = _sort_rows(p_mat, t_mat, m_mat)
+    n_docs = jnp.sum(mm, axis=1).astype(jnp.float32)
+    # non-adaptive static k keeps the full k as denominator (reference
+    # precision.py: relevant[:k].sum() / k even when the query has < k docs)
+    denom = jnp.full_like(n_docs, float(k)) if k is not None and not adaptive_k else _k_eff(k, adaptive_k, n_docs, tt.shape[1])
+    rel = _relevant_at(tt, _k_eff(k, adaptive_k, n_docs, tt.shape[1]))
+    return jnp.where(denom > 0, rel / jnp.maximum(denom, 1.0), 0.0)
+
+
+def recall_rows(p_mat: Array, t_mat: Array, m_mat: Array, k: Optional[int] = None) -> Array:
+    tt, mm = _sort_rows(p_mat, t_mat, m_mat)
+    n_docs = jnp.sum(mm, axis=1).astype(jnp.float32)
+    n_pos = jnp.sum(tt, axis=1)
+    rel = _relevant_at(tt, _k_eff(k, False, n_docs, tt.shape[1]))
+    return jnp.where(n_pos > 0, rel / jnp.maximum(n_pos, 1.0), 0.0)
+
+
+def hit_rate_rows(p_mat: Array, t_mat: Array, m_mat: Array, k: Optional[int] = None) -> Array:
+    tt, mm = _sort_rows(p_mat, t_mat, m_mat)
+    n_docs = jnp.sum(mm, axis=1).astype(jnp.float32)
+    rel = _relevant_at(tt, _k_eff(k, False, n_docs, tt.shape[1]))
+    return (rel > 0).astype(jnp.float32)
+
+
+def fall_out_rows(p_mat: Array, t_mat: Array, m_mat: Array, k: Optional[int] = None) -> Array:
+    inv = jnp.where(m_mat, 1 - t_mat, 0)
+    tt, mm = _sort_rows(p_mat, inv, m_mat)
+    n_docs = jnp.sum(mm, axis=1).astype(jnp.float32)
+    n_neg = jnp.sum(tt, axis=1)
+    rel = _relevant_at(tt, _k_eff(k, False, n_docs, tt.shape[1]))
+    return jnp.where(n_neg > 0, rel / jnp.maximum(n_neg, 1.0), 0.0)
+
+
+def normalized_dcg_rows(p_mat: Array, t_mat: Array, m_mat: Array, k: Optional[int] = None) -> Array:
+    tt, mm = _sort_rows(p_mat, t_mat, m_mat)
+    n_docs = jnp.sum(mm, axis=1).astype(jnp.float32)
+    # eager slices sorted_target[:k], which python-caps at the query's n docs
+    k_eff = n_docs if k is None else jnp.minimum(jnp.full_like(n_docs, float(k)), n_docs)
+    gain_mask = _positions(tt.shape[1])[None, :] < k_eff[:, None]
+    denom = jnp.log2(_positions(tt.shape[1]) + 2.0)
+    dcg = jnp.sum(jnp.where(gain_mask, tt / denom, 0.0), axis=1)
+    ideal = -jnp.sort(-jnp.where(m_mat, t_mat, 0).astype(jnp.float32), axis=1)
+    idcg = jnp.sum(jnp.where(gain_mask, ideal / denom, 0.0), axis=1)
+    return jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-38), 0.0)
+
+
+def r_precision_rows(p_mat: Array, t_mat: Array, m_mat: Array) -> Array:
+    tt, _ = _sort_rows(p_mat, t_mat, m_mat)
+    n_pos = jnp.sum(tt, axis=1)
+    rel = _relevant_at(tt, n_pos)
+    return jnp.where(n_pos > 0, rel / jnp.maximum(n_pos, 1.0), 0.0)
+
+
+# --------------------------------------------------------------------------- #
+# aggregation with empty_target_action semantics
+# --------------------------------------------------------------------------- #
+def segmented_mean(scores: Array, empty: Array, qmask: Array, empty_target_action: str) -> Array:
+    """Mean over queries with the reference's empty-query policy
+    (torchmetrics/retrieval/base.py:128-137) expressed as masking."""
+    if empty_target_action == "pos":
+        scores = jnp.where(empty, 1.0, scores)
+        include = qmask
+    elif empty_target_action == "neg":
+        scores = jnp.where(empty, 0.0, scores)
+        include = qmask
+    else:  # "skip" ("error" is rejected before tracing)
+        include = qmask & ~empty
+    n = jnp.sum(include)
+    return jnp.where(n > 0, jnp.sum(jnp.where(include, scores, 0.0)) / jnp.maximum(n, 1), 0.0)

@@ -24,6 +24,7 @@ from metrics_tpu.ops.retrieval import (
     retrieval_reciprocal_rank,
     retrieval_recall,
 )
+from metrics_tpu.ops.retrieval import segmented as _seg
 from metrics_tpu.retrieval.base import RetrievalMetric
 
 
@@ -34,12 +35,18 @@ class RetrievalMAP(RetrievalMetric):
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_average_precision(preds, target)
 
+    def _metric_rows(self, p_mat: Array, t_mat: Array, m_mat: Array) -> Array:
+        return _seg.average_precision_rows(p_mat, t_mat, m_mat)
+
 
 class RetrievalMRR(RetrievalMetric):
     """Mean reciprocal rank."""
 
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_reciprocal_rank(preds, target)
+
+    def _metric_rows(self, p_mat: Array, t_mat: Array, m_mat: Array) -> Array:
+        return _seg.reciprocal_rank_rows(p_mat, t_mat, m_mat)
 
 
 class _TopKRetrievalMetric(RetrievalMetric):
@@ -75,6 +82,9 @@ class RetrievalPrecision(_TopKRetrievalMetric):
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_precision(preds, target, k=self.k, adaptive_k=self.adaptive_k)
 
+    def _metric_rows(self, p_mat: Array, t_mat: Array, m_mat: Array) -> Array:
+        return _seg.precision_rows(p_mat, t_mat, m_mat, k=self.k, adaptive_k=self.adaptive_k)
+
 
 class RetrievalRecall(_TopKRetrievalMetric):
     """Recall@k averaged over queries."""
@@ -82,12 +92,18 @@ class RetrievalRecall(_TopKRetrievalMetric):
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_recall(preds, target, k=self.k)
 
+    def _metric_rows(self, p_mat: Array, t_mat: Array, m_mat: Array) -> Array:
+        return _seg.recall_rows(p_mat, t_mat, m_mat, k=self.k)
+
 
 class RetrievalHitRate(_TopKRetrievalMetric):
     """HitRate@k averaged over queries."""
 
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_hit_rate(preds, target, k=self.k)
+
+    def _metric_rows(self, p_mat: Array, t_mat: Array, m_mat: Array) -> Array:
+        return _seg.hit_rate_rows(p_mat, t_mat, m_mat, k=self.k)
 
 
 class RetrievalNormalizedDCG(_TopKRetrievalMetric):
@@ -100,12 +116,18 @@ class RetrievalNormalizedDCG(_TopKRetrievalMetric):
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_normalized_dcg(preds, target, k=self.k)
 
+    def _metric_rows(self, p_mat: Array, t_mat: Array, m_mat: Array) -> Array:
+        return _seg.normalized_dcg_rows(p_mat, t_mat, m_mat, k=self.k)
+
 
 class RetrievalRPrecision(RetrievalMetric):
     """R-precision averaged over queries."""
 
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_r_precision(preds, target)
+
+    def _metric_rows(self, p_mat: Array, t_mat: Array, m_mat: Array) -> Array:
+        return _seg.r_precision_rows(p_mat, t_mat, m_mat)
 
 
 class RetrievalFallOut(_TopKRetrievalMetric):
@@ -119,5 +141,11 @@ class RetrievalFallOut(_TopKRetrievalMetric):
     def _is_empty_query(self, target: Array) -> bool:
         return not float(jnp.sum(1 - target))
 
+    def _empty_rows(self, t_mat: Array, m_mat: Array) -> Array:
+        return jnp.sum(jnp.where(m_mat, 1 - t_mat, 0), axis=1) == 0
+
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_fall_out(preds, target, k=self.k)
+
+    def _metric_rows(self, p_mat: Array, t_mat: Array, m_mat: Array) -> Array:
+        return _seg.fall_out_rows(p_mat, t_mat, m_mat, k=self.k)
