@@ -1,0 +1,323 @@
+"""Pure-torch oracle networks for weight-converter differential tests.
+
+The reference obtains FID/IS/KID features from torch-fidelity's
+``FeatureExtractorInceptionV3`` (torchmetrics/image/fid.py:27-46) and LPIPS
+scores from the ``lpips`` package (torchmetrics/image/lpip.py:34-45). Neither
+package is installed offline, so these oracles re-implement the exact same
+forward semantics in plain torch (which IS installed), with state-dict key
+names matching the community checkpoints (``pt_inception-2015-12-05`` /
+torchvision ``features.N``). The tests then:
+
+  torch random-init -> state_dict() -> metrics_tpu converter -> flax forward
+                    -> must equal the torch forward tap-for-tap.
+
+That proves the converter key-mapping AND the flax architecture reproduce
+torch numerics — something a flax-side synthesized round-trip cannot show.
+Test-only code: nothing here ships in the package.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import torch
+import torch.nn.functional as F
+from torch import nn
+
+
+# --------------------------------------------------------------------------- #
+# FID-compat InceptionV3 (torch-fidelity semantics)
+# --------------------------------------------------------------------------- #
+class BasicConv2d(nn.Module):
+    def __init__(self, in_ch: int, out_ch: int, **conv_kwargs) -> None:
+        super().__init__()
+        self.conv = nn.Conv2d(in_ch, out_ch, bias=False, **conv_kwargs)
+        self.bn = nn.BatchNorm2d(out_ch, eps=1e-3)
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:
+        return F.relu(self.bn(self.conv(x)))
+
+
+def _avg3(x: torch.Tensor) -> torch.Tensor:
+    return F.avg_pool2d(x, kernel_size=3, stride=1, padding=1, count_include_pad=False)
+
+
+class InceptionA(nn.Module):
+    def __init__(self, in_ch: int, pool_features: int) -> None:
+        super().__init__()
+        self.branch1x1 = BasicConv2d(in_ch, 64, kernel_size=1)
+        self.branch5x5_1 = BasicConv2d(in_ch, 48, kernel_size=1)
+        self.branch5x5_2 = BasicConv2d(48, 64, kernel_size=5, padding=2)
+        self.branch3x3dbl_1 = BasicConv2d(in_ch, 64, kernel_size=1)
+        self.branch3x3dbl_2 = BasicConv2d(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = BasicConv2d(96, 96, kernel_size=3, padding=1)
+        self.branch_pool = BasicConv2d(in_ch, pool_features, kernel_size=1)
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:
+        b1 = self.branch1x1(x)
+        b5 = self.branch5x5_2(self.branch5x5_1(x))
+        bd = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        bp = self.branch_pool(_avg3(x))
+        return torch.cat([b1, b5, bd, bp], 1)
+
+
+class InceptionB(nn.Module):
+    def __init__(self, in_ch: int) -> None:
+        super().__init__()
+        self.branch3x3 = BasicConv2d(in_ch, 384, kernel_size=3, stride=2)
+        self.branch3x3dbl_1 = BasicConv2d(in_ch, 64, kernel_size=1)
+        self.branch3x3dbl_2 = BasicConv2d(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = BasicConv2d(96, 96, kernel_size=3, stride=2)
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:
+        b3 = self.branch3x3(x)
+        bd = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        bp = F.max_pool2d(x, kernel_size=3, stride=2)
+        return torch.cat([b3, bd, bp], 1)
+
+
+class InceptionC(nn.Module):
+    def __init__(self, in_ch: int, c7: int) -> None:
+        super().__init__()
+        self.branch1x1 = BasicConv2d(in_ch, 192, kernel_size=1)
+        self.branch7x7_1 = BasicConv2d(in_ch, c7, kernel_size=1)
+        self.branch7x7_2 = BasicConv2d(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7_3 = BasicConv2d(c7, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_1 = BasicConv2d(in_ch, c7, kernel_size=1)
+        self.branch7x7dbl_2 = BasicConv2d(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_3 = BasicConv2d(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7dbl_4 = BasicConv2d(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_5 = BasicConv2d(c7, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch_pool = BasicConv2d(in_ch, 192, kernel_size=1)
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:
+        b1 = self.branch1x1(x)
+        b7 = self.branch7x7_3(self.branch7x7_2(self.branch7x7_1(x)))
+        bd = self.branch7x7dbl_5(
+            self.branch7x7dbl_4(self.branch7x7dbl_3(self.branch7x7dbl_2(self.branch7x7dbl_1(x))))
+        )
+        bp = self.branch_pool(_avg3(x))
+        return torch.cat([b1, b7, bd, bp], 1)
+
+
+class InceptionD(nn.Module):
+    def __init__(self, in_ch: int) -> None:
+        super().__init__()
+        self.branch3x3_1 = BasicConv2d(in_ch, 192, kernel_size=1)
+        self.branch3x3_2 = BasicConv2d(192, 320, kernel_size=3, stride=2)
+        self.branch7x7x3_1 = BasicConv2d(in_ch, 192, kernel_size=1)
+        self.branch7x7x3_2 = BasicConv2d(192, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7x3_3 = BasicConv2d(192, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7x3_4 = BasicConv2d(192, 192, kernel_size=3, stride=2)
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:
+        b3 = self.branch3x3_2(self.branch3x3_1(x))
+        b7 = self.branch7x7x3_4(self.branch7x7x3_3(self.branch7x7x3_2(self.branch7x7x3_1(x))))
+        bp = F.max_pool2d(x, kernel_size=3, stride=2)
+        return torch.cat([b3, b7, bp], 1)
+
+
+class InceptionE(nn.Module):
+    def __init__(self, in_ch: int, pool: str) -> None:
+        super().__init__()
+        self.pool = pool
+        self.branch1x1 = BasicConv2d(in_ch, 320, kernel_size=1)
+        self.branch3x3_1 = BasicConv2d(in_ch, 384, kernel_size=1)
+        self.branch3x3_2a = BasicConv2d(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3_2b = BasicConv2d(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = BasicConv2d(in_ch, 448, kernel_size=1)
+        self.branch3x3dbl_2 = BasicConv2d(448, 384, kernel_size=3, padding=1)
+        self.branch3x3dbl_3a = BasicConv2d(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3dbl_3b = BasicConv2d(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch_pool = BasicConv2d(in_ch, 192, kernel_size=1)
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:
+        b1 = self.branch1x1(x)
+        b3 = self.branch3x3_1(x)
+        b3 = torch.cat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], 1)
+        bd = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
+        bd = torch.cat([self.branch3x3dbl_3a(bd), self.branch3x3dbl_3b(bd)], 1)
+        if self.pool == "max":
+            bp = F.max_pool2d(x, kernel_size=3, stride=1, padding=1)
+        else:
+            bp = _avg3(x)
+        bp = self.branch_pool(bp)
+        return torch.cat([b1, b3, bd, bp], 1)
+
+
+def resize_bilinear_tf1_torch(x: torch.Tensor, out_h: int, out_w: int) -> torch.Tensor:
+    """TF1 asymmetric bilinear resize of an NCHW float batch (torch side).
+
+    Same convention as torch-fidelity's interpolate_bilinear_2d_like_tensorflow1x:
+    dest coordinate i maps to source i * in/out with no half-pixel offset.
+    """
+    n, c, h, w = x.shape
+    ys = torch.arange(out_h, dtype=torch.float32) * (h / out_h)
+    xs = torch.arange(out_w, dtype=torch.float32) * (w / out_w)
+    y0 = torch.floor(ys).long()
+    x0 = torch.floor(xs).long()
+    y1 = torch.clamp(y0 + 1, max=h - 1)
+    x1 = torch.clamp(x0 + 1, max=w - 1)
+    wy = (ys - y0.float()).view(1, 1, out_h, 1)
+    wx = (xs - x0.float()).view(1, 1, 1, out_w)
+    rows = x[:, :, y0, :] * (1 - wy) + x[:, :, y1, :] * wy
+    return rows[:, :, :, x0] * (1 - wx) + rows[:, :, :, x1] * wx
+
+
+class TorchFIDInception(nn.Module):
+    """FID-compat InceptionV3 oracle; state_dict keys match the converter input.
+
+    Forward returns every feature tap the flax net exposes.
+    """
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.Conv2d_1a_3x3 = BasicConv2d(3, 32, kernel_size=3, stride=2)
+        self.Conv2d_2a_3x3 = BasicConv2d(32, 32, kernel_size=3)
+        self.Conv2d_2b_3x3 = BasicConv2d(32, 64, kernel_size=3, padding=1)
+        self.Conv2d_3b_1x1 = BasicConv2d(64, 80, kernel_size=1)
+        self.Conv2d_4a_3x3 = BasicConv2d(80, 192, kernel_size=3)
+        self.Mixed_5b = InceptionA(192, 32)
+        self.Mixed_5c = InceptionA(256, 64)
+        self.Mixed_5d = InceptionA(288, 64)
+        self.Mixed_6a = InceptionB(288)
+        self.Mixed_6b = InceptionC(768, 128)
+        self.Mixed_6c = InceptionC(768, 160)
+        self.Mixed_6d = InceptionC(768, 160)
+        self.Mixed_6e = InceptionC(768, 192)
+        self.Mixed_7a = InceptionD(768)
+        self.Mixed_7b = InceptionE(1280, "avg")
+        self.Mixed_7c = InceptionE(2048, "max")
+        self.fc = nn.Linear(2048, 1008)
+
+    @torch.no_grad()
+    def forward(self, imgs: torch.Tensor) -> Dict[str, torch.Tensor]:
+        """NCHW uint8/float batch -> dict of all taps (same pipeline as flax)."""
+        out: Dict[str, torch.Tensor] = {}
+        x = imgs.float()
+        x = resize_bilinear_tf1_torch(x, 299, 299)
+        x = (x - 128.0) / 128.0
+        x = self.Conv2d_2b_3x3(self.Conv2d_2a_3x3(self.Conv2d_1a_3x3(x)))
+        x = F.max_pool2d(x, kernel_size=3, stride=2)
+        out["64"] = x.mean(dim=(2, 3))
+        x = self.Conv2d_4a_3x3(self.Conv2d_3b_1x1(x))
+        x = F.max_pool2d(x, kernel_size=3, stride=2)
+        out["192"] = x.mean(dim=(2, 3))
+        x = self.Mixed_5d(self.Mixed_5c(self.Mixed_5b(x)))
+        x = self.Mixed_6e(self.Mixed_6d(self.Mixed_6c(self.Mixed_6b(self.Mixed_6a(x)))))
+        out["768"] = x.mean(dim=(2, 3))
+        x = self.Mixed_7c(self.Mixed_7b(self.Mixed_7a(x)))
+        pooled = x.mean(dim=(2, 3))
+        out["2048"] = pooled
+        out["logits_unbiased"] = pooled @ self.fc.weight.T
+        out["logits"] = out["logits_unbiased"] + self.fc.bias
+        return out
+
+
+def randomize_inception_(net: TorchFIDInception, seed: int = 0) -> None:
+    """Seeded, numerically tame random weights (BN stats must be sane)."""
+    gen = torch.Generator().manual_seed(seed)
+    for mod in net.modules():
+        if isinstance(mod, nn.Conv2d):
+            fan_in = mod.in_channels * mod.kernel_size[0] * mod.kernel_size[1]
+            mod.weight.data = torch.randn(mod.weight.shape, generator=gen) / fan_in**0.5
+        elif isinstance(mod, nn.BatchNorm2d):
+            mod.weight.data = 0.5 + torch.rand(mod.weight.shape, generator=gen)
+            mod.bias.data = 0.1 * torch.randn(mod.bias.shape, generator=gen)
+            mod.running_mean.data = 0.1 * torch.randn(mod.running_mean.shape, generator=gen)
+            mod.running_var.data = 0.5 + torch.rand(mod.running_var.shape, generator=gen)
+        elif isinstance(mod, nn.Linear):
+            mod.weight.data = torch.randn(mod.weight.shape, generator=gen) / mod.in_features**0.5
+            mod.bias.data = 0.1 * torch.randn(mod.bias.shape, generator=gen)
+    net.eval()
+
+
+# --------------------------------------------------------------------------- #
+# LPIPS oracle (lpips-package semantics, torchvision trunk key names)
+# --------------------------------------------------------------------------- #
+_LPIPS_SHIFT = torch.tensor([-0.030, -0.088, -0.188]).view(1, 3, 1, 1)
+_LPIPS_SCALE = torch.tensor([0.458, 0.448, 0.450]).view(1, 3, 1, 1)
+
+# torchvision `features` indices of conv layers per trunk
+ALEX_CONV_IDX = (0, 3, 6, 8, 10)
+VGG16_CONV_IDX = (0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28)
+ALEX_CFG = ((64, 11, 4, 2), (192, 5, 1, 2), (384, 3, 1, 1), (256, 3, 1, 1), (256, 3, 1, 1))
+VGG16_CHANNELS = (64, 64, 128, 128, 256, 256, 256, 512, 512, 512, 512, 512, 512)
+
+
+def make_lpips_backbone_state_dict(net_type: str, seed: int = 0) -> Dict[str, torch.Tensor]:
+    """Random torchvision-style ``features.N.weight/bias`` dict for a trunk."""
+    gen = torch.Generator().manual_seed(seed)
+    sd: Dict[str, torch.Tensor] = {}
+
+    def add_conv(idx: int, out_ch: int, in_ch: int, k: int) -> None:
+        fan_in = in_ch * k * k
+        sd[f"features.{idx}.weight"] = torch.randn((out_ch, in_ch, k, k), generator=gen) / fan_in**0.5
+        sd[f"features.{idx}.bias"] = 0.1 * torch.randn((out_ch,), generator=gen)
+
+    if net_type == "alex":
+        in_ch = 3
+        for idx, (out_ch, k, _s, _p) in zip(ALEX_CONV_IDX, ALEX_CFG):
+            add_conv(idx, out_ch, in_ch, k)
+            in_ch = out_ch
+    elif net_type == "vgg":
+        in_ch = 3
+        for idx, out_ch in zip(VGG16_CONV_IDX, VGG16_CHANNELS):
+            add_conv(idx, out_ch, in_ch, 3)
+            in_ch = out_ch
+    else:
+        raise ValueError(net_type)
+    return sd
+
+
+def make_lpips_lin_state_dict(channels, seed: int = 0) -> Dict[str, torch.Tensor]:
+    """Random non-negative 1x1 lin heads, lpips checkpoint key format."""
+    gen = torch.Generator().manual_seed(seed)
+    return {
+        f"lin{i}.model.1.weight": torch.rand((1, c, 1, 1), generator=gen) for i, c in enumerate(channels)
+    }
+
+
+def _normalize_tensor(x: torch.Tensor) -> torch.Tensor:
+    norm = torch.sqrt(torch.sum(x**2, dim=1, keepdim=True))
+    return x / (norm + 1e-10)
+
+
+@torch.no_grad()
+def torch_lpips_forward(
+    backbone_sd: Dict[str, torch.Tensor],
+    lin_sd: Dict[str, torch.Tensor],
+    net_type: str,
+    img1: torch.Tensor,
+    img2: torch.Tensor,
+) -> torch.Tensor:
+    """LPIPS distance oracle on NCHW [-1,1] batches using raw state dicts."""
+
+    def trunk(x: torch.Tensor) -> List[torch.Tensor]:
+        taps: List[torch.Tensor] = []
+        if net_type == "alex":
+            for i, (idx, (_c, _k, stride, pad)) in enumerate(zip(ALEX_CONV_IDX, ALEX_CFG)):
+                if i in (1, 2):  # maxpool precedes conv2 and conv3
+                    x = F.max_pool2d(x, kernel_size=3, stride=2)
+                x = F.relu(F.conv2d(x, backbone_sd[f"features.{idx}.weight"], backbone_sd[f"features.{idx}.bias"], stride=stride, padding=pad))
+                taps.append(x)
+        else:  # vgg
+            tap_positions = {1, 3, 6, 9, 12}  # conv1_2, conv2_2, conv3_3, conv4_3, conv5_3
+            pool_before = {2, 4, 7, 10}  # pools precede conv2_1, conv3_1, conv4_1, conv5_1
+            for i, idx in enumerate(VGG16_CONV_IDX):
+                if i in pool_before:
+                    x = F.max_pool2d(x, kernel_size=2, stride=2)
+                x = F.relu(F.conv2d(x, backbone_sd[f"features.{idx}.weight"], backbone_sd[f"features.{idx}.bias"], stride=1, padding=1))
+                if i in tap_positions:
+                    taps.append(x)
+        return taps
+
+    def scale(x: torch.Tensor) -> torch.Tensor:
+        return (x - _LPIPS_SHIFT) / _LPIPS_SCALE
+
+    taps1, taps2 = trunk(scale(img1.float())), trunk(scale(img2.float()))
+    total = torch.zeros(img1.shape[0])
+    for i, (f1, f2) in enumerate(zip(taps1, taps2)):
+        diff = (_normalize_tensor(f1) - _normalize_tensor(f2)) ** 2
+        w = lin_sd[f"lin{i}.model.1.weight"]
+        total = total + F.conv2d(diff, w).mean(dim=(2, 3)).squeeze(1)
+    return total

@@ -332,26 +332,101 @@ def test_inception_full_trunk_shapes():
     }
 
 
-def test_inception_weight_converter_roundtrip():
-    # synthesize a torchvision-style state_dict with the right shapes for the
-    # stem and check the converter produces apply-able variables
-    module = InceptionV3(features_list=("64",))
-    x = jnp.zeros((1, 75, 75, 3))
-    ref_vars = module.init(jax.random.PRNGKey(1), x)
+# --------------------------------------------------------------------------- #
+# torch-forward differentials: converter + flax architecture vs a pure-torch
+# oracle with the exact torch-fidelity / lpips forward semantics (the packages
+# themselves are unavailable offline; see tests/helpers/torch_nets.py)
+# --------------------------------------------------------------------------- #
+def _torch_inception_fixture():
+    torch = pytest.importorskip("torch")
 
-    state_dict = {}
-    for block, p in ref_vars["params"].items():
-        kernel = np.asarray(p["conv"]["kernel"])  # (kh,kw,I,O)
-        state_dict[f"{block}.conv.weight"] = kernel.transpose(3, 2, 0, 1)
-        state_dict[f"{block}.bn.weight"] = np.asarray(p["bn"]["scale"])
-        state_dict[f"{block}.bn.bias"] = np.asarray(p["bn"]["bias"])
-    for block, s in ref_vars["batch_stats"].items():
-        state_dict[f"{block}.bn.running_mean"] = np.asarray(s["bn"]["mean"])
-        state_dict[f"{block}.bn.running_var"] = np.asarray(s["bn"]["var"])
+    from tests.helpers.torch_nets import TorchFIDInception, randomize_inception_
 
+    net = TorchFIDInception()
+    randomize_inception_(net, seed=3)
     from metrics_tpu.nets.inception import load_inception_torch_state_dict
 
-    converted = load_inception_torch_state_dict(state_dict)
-    out_ref = module.apply(ref_vars, x)["64"]
-    out_conv = module.apply(converted, x)["64"]
-    np.testing.assert_allclose(np.asarray(out_ref), np.asarray(out_conv), atol=1e-6)
+    taps = ("64", "192", "768", "2048", "logits_unbiased", "logits")
+    variables = load_inception_torch_state_dict(
+        {k: v.numpy() for k, v in net.state_dict().items()}, features_list=taps
+    )
+    return net, variables, taps
+
+
+def test_inception_torch_forward_differential():
+    """flax(convert(torch_state_dict)) must equal the torch forward per tap."""
+    torch = pytest.importorskip("torch")
+
+    net, variables, taps = _torch_inception_fixture()
+    imgs = _rng.integers(0, 255, size=(3, 3, 96, 96)).astype(np.uint8)
+    want = net(torch.as_tensor(imgs))
+
+    from metrics_tpu.nets.inception import _resize_bilinear_tf1
+
+    module = InceptionV3(features_list=taps)
+    x = jnp.transpose(jnp.asarray(imgs, jnp.float32), (0, 2, 3, 1))
+    x = _resize_bilinear_tf1(x, 299, 299)
+    x = (x - 128.0) / 128.0
+    got = module.apply(variables, x)
+    for tap in taps:
+        w = want[tap].numpy()
+        scale = np.abs(w).max()
+        np.testing.assert_allclose(
+            np.asarray(got[tap]), w, rtol=1e-3, atol=1e-3 * scale, err_msg=f"tap {tap}"
+        )
+
+
+def test_fid_end_to_end_torch_differential():
+    """Same images through both full FID pipelines -> same number."""
+    torch = pytest.importorskip("torch")
+
+    net, variables, _ = _torch_inception_fixture()
+    real = _rng.integers(0, 255, size=(16, 3, 64, 64)).astype(np.uint8)
+    fake = _rng.integers(0, 255, size=(16, 3, 64, 64)).astype(np.uint8)
+
+    ext = InceptionV3FeatureExtractor("64", variables=variables)
+    fid = FrechetInceptionDistance(feature=ext)
+    for i in range(0, 16, 8):
+        fid.update(jnp.asarray(real[i : i + 8]), real=True)
+        fid.update(jnp.asarray(fake[i : i + 8]), real=False)
+    got = float(fid.compute())
+
+    rf = net(torch.as_tensor(real))["64"].numpy().astype(np.float64)
+    ff = net(torch.as_tensor(fake))["64"].numpy().astype(np.float64)
+    want = _np_fid(rf.mean(0), np.cov(rf, rowvar=False), ff.mean(0), np.cov(ff, rowvar=False))
+    assert abs(got - want) / max(1.0, abs(want)) < 2e-2
+
+
+@pytest.mark.parametrize("net_type", ["alex", "vgg"])
+def test_lpips_torch_forward_differential(net_type):
+    """flax(convert(torch trunk + lin heads)) must equal the torch LPIPS oracle."""
+    torch = pytest.importorskip("torch")
+
+    from metrics_tpu.nets.lpips import NET_CHANNELS, load_lpips_torch_state_dict
+    from tests.helpers.torch_nets import (
+        make_lpips_backbone_state_dict,
+        make_lpips_lin_state_dict,
+        torch_lpips_forward,
+    )
+
+    backbone = make_lpips_backbone_state_dict(net_type, seed=5)
+    lin = make_lpips_lin_state_dict(NET_CHANNELS[net_type], seed=6)
+    variables = load_lpips_torch_state_dict(backbone, lin, net_type)
+
+    a = _rng.uniform(-1, 1, size=(3, 3, 64, 64)).astype(np.float32)
+    b = _rng.uniform(-1, 1, size=(3, 3, 64, 64)).astype(np.float32)
+    want = torch_lpips_forward(backbone, lin, net_type, torch.as_tensor(a), torch.as_tensor(b)).numpy()
+
+    net = LPIPSNet(net_type, variables=variables)
+    got = np.asarray(net(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    # end to end through the metric module: running mean over two updates
+    c = _rng.uniform(-1, 1, size=(3, 3, 64, 64)).astype(np.float32)
+    want2 = torch_lpips_forward(backbone, lin, net_type, torch.as_tensor(a), torch.as_tensor(c)).numpy()
+    lp = LearnedPerceptualImagePatchSimilarity(net=net)
+    lp.update(jnp.asarray(a), jnp.asarray(b))
+    lp.update(jnp.asarray(a), jnp.asarray(c))
+    np.testing.assert_allclose(
+        float(lp.compute()), np.concatenate([want, want2]).mean(), rtol=1e-4
+    )
