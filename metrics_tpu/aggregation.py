@@ -148,7 +148,17 @@ class CatMetric(BaseAggregator):
 
 
 class MeanMetric(BaseAggregator):
-    """Weighted running mean. Reference: aggregation.py:290-356."""
+    """Weighted running mean. Reference: aggregation.py:290-356.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanMetric
+        >>> mean = MeanMetric()
+        >>> mean.update(1.0)
+        >>> mean.update(jnp.asarray([2.0, 3.0]))
+        >>> round(float(mean.compute()), 4)
+        2.0
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)

@@ -12,6 +12,19 @@ from metrics_tpu.utils.enums import DataType
 
 
 class AUROC(Metric):
+    """Area under the ROC curve. Reference: classification/auroc.py:27.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AUROC
+        >>> preds = jnp.asarray([0.13, 0.26, 0.08, 0.19, 0.34])
+        >>> target = jnp.asarray([0, 0, 1, 1, 1])
+        >>> auroc = AUROC(pos_label=1)
+        >>> auroc.update(preds, target)
+        >>> round(float(auroc.compute()), 4)
+        0.5
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False

@@ -11,6 +11,19 @@ from metrics_tpu.ops.classification.cohen_kappa import _cohen_kappa_compute, _co
 
 
 class CohenKappa(Metric):
+    """Cohen's kappa. Reference: classification/cohen_kappa.py:23.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CohenKappa
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> kappa = CohenKappa(num_classes=2)
+        >>> kappa.update(preds, target)
+        >>> round(float(kappa.compute()), 4)
+        0.5
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False

@@ -11,6 +11,19 @@ from metrics_tpu.ops.classification.confusion_matrix import _confusion_matrix_co
 
 
 class ConfusionMatrix(Metric):
+    """Confusion matrix. Reference: classification/confusion_matrix.py:23.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ConfusionMatrix
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> confmat = ConfusionMatrix(num_classes=2)
+        >>> confmat.update(preds, target)
+        >>> confmat.compute().astype(int).tolist()
+        [[2, 0], [1, 1]]
+    """
+
     is_differentiable = False
     higher_is_better: Optional[bool] = None
     full_state_update: bool = False

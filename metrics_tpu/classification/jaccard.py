@@ -10,6 +10,19 @@ from metrics_tpu.ops.classification.jaccard import _jaccard_from_confmat
 
 
 class JaccardIndex(ConfusionMatrix):
+    """Intersection-over-union from the confusion matrix. Reference: jaccard.py:23.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import JaccardIndex
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> jaccard = JaccardIndex(num_classes=2)
+        >>> jaccard.update(preds, target)
+        >>> round(float(jaccard.compute()), 4)
+        0.5833
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False

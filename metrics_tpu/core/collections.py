@@ -39,6 +39,19 @@ class MetricCollection:
         additional_metrics: more metrics when ``metrics`` is a single one.
         prefix / postfix: added to every output key.
         compute_groups: enable static compute-group fusion (default True).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, MetricCollection, Recall
+        >>> target = jnp.asarray([0, 2, 0, 2, 0, 1, 0, 2])
+        >>> preds = jnp.asarray([2, 1, 2, 0, 1, 2, 2, 2])
+        >>> metrics = MetricCollection([
+        ...     Accuracy(),
+        ...     Recall(num_classes=3, average="macro"),
+        ... ])
+        >>> metrics.update(preds, target)
+        >>> {k: round(float(v), 4) for k, v in metrics.compute().items()}
+        {'Accuracy': 0.125, 'Recall': 0.1111}
     """
 
     _modules: Dict[str, Metric]

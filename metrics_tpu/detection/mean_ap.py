@@ -82,7 +82,26 @@ def _next_bucket(n: int, minimum: int = 8) -> int:
 
 
 class MeanAveragePrecision(Metric):
-    """COCO mAP/mAR. Reference: detection/mean_ap.py:199."""
+    """COCO mAP/mAR. Reference: detection/mean_ap.py:199.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.detection import MeanAveragePrecision
+        >>> preds = [dict(
+        ...     boxes=jnp.asarray([[258.0, 41.0, 606.0, 285.0]]),
+        ...     scores=jnp.asarray([0.536]),
+        ...     labels=jnp.asarray([0]),
+        ... )]
+        >>> target = [dict(
+        ...     boxes=jnp.asarray([[214.0, 41.0, 562.0, 285.0]]),
+        ...     labels=jnp.asarray([0]),
+        ... )]
+        >>> metric = MeanAveragePrecision()
+        >>> metric.update(preds, target)
+        >>> result = metric.compute()
+        >>> round(float(result["map"]), 2), round(float(result["map_50"]), 2)
+        (0.6, 1.0)
+    """
 
     is_differentiable = False
     higher_is_better = True

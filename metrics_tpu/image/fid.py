@@ -31,7 +31,24 @@ _TRIPLES = {prefix: tuple(f"{prefix}_{leaf}" for leaf in ("n", "mean", "m2")) fo
 
 
 class FrechetInceptionDistance(Metric):
-    """FID. Reference: image/fid.py:128."""
+    """FID. Reference: image/fid.py:128.
+
+    ``feature`` may be an InceptionV3 tap (64/192/768/2048 — pass converted
+    torch-checkpoint weights for published-comparable numbers) or any callable
+    ``imgs -> [N, d]`` with a ``feature_size``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.image import FrechetInceptionDistance
+        >>> fid = FrechetInceptionDistance(
+        ...     feature=lambda imgs: imgs.reshape(imgs.shape[0], -1), feature_size=4
+        ... )
+        >>> imgs = jnp.arange(2 * 4, dtype=jnp.float32).reshape(2, 1, 2, 2)
+        >>> fid.update(imgs, real=True)
+        >>> fid.update(imgs + 1.0, real=False)
+        >>> int(round(float(fid.compute())))
+        4
+    """
 
     higher_is_better = False
     is_differentiable = False

@@ -17,7 +17,18 @@ from metrics_tpu.utils.prints import rank_zero_warn
 
 
 class PeakSignalNoiseRatio(Metric):
-    """PSNR. Reference: image/psnr.py:25."""
+    """PSNR. Reference: image/psnr.py:25.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PeakSignalNoiseRatio
+        >>> psnr = PeakSignalNoiseRatio()
+        >>> preds = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])
+        >>> target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])
+        >>> psnr.update(preds, target)
+        >>> round(float(psnr.compute()), 4)
+        2.5527
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -20,7 +20,17 @@ from metrics_tpu.ops.image.ssim import (
 
 
 class StructuralSimilarityIndexMeasure(_ImagePairMetric):
-    """SSIM. Reference: image/ssim.py:25-132."""
+    """SSIM. Reference: image/ssim.py:25-132.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import StructuralSimilarityIndexMeasure
+        >>> imgs = jnp.linspace(0.0, 1.0, 1 * 1 * 16 * 16).reshape(1, 1, 16, 16)
+        >>> ssim = StructuralSimilarityIndexMeasure(data_range=1.0)
+        >>> ssim.update(imgs, imgs)
+        >>> round(float(ssim.compute()), 4)
+        1.0
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -29,7 +29,18 @@ from metrics_tpu.ops.regression.basic import (
 
 
 class MeanSquaredError(Metric):
-    """MSE / RMSE. Reference: regression/mse.py:23-85."""
+    """MSE / RMSE. Reference: regression/mse.py:23-85.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredError
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> mse = MeanSquaredError()
+        >>> mse.update(preds, target)
+        >>> round(float(mse.compute()), 4)
+        0.375
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -52,7 +63,18 @@ class MeanSquaredError(Metric):
 
 
 class MeanAbsoluteError(Metric):
-    """MAE. Reference: regression/mae.py:23-77."""
+    """MAE. Reference: regression/mae.py:23-77.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanAbsoluteError
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> mae = MeanAbsoluteError()
+        >>> mae.update(preds, target)
+        >>> round(float(mae.compute()), 4)
+        0.5
+    """
 
     is_differentiable = True
     higher_is_better = False

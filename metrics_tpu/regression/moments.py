@@ -61,7 +61,18 @@ def _final_aggregation(
 
 
 class PearsonCorrCoef(Metric):
-    """Running-moment Pearson correlation. Reference: regression/pearson.py:66-140."""
+    """Running-moment Pearson correlation. Reference: regression/pearson.py:66-140.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PearsonCorrCoef
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> pearson = PearsonCorrCoef()
+        >>> pearson.update(preds, target)
+        >>> round(float(pearson.compute()), 4)
+        0.9849
+    """
 
     is_differentiable = True
     higher_is_better = None
@@ -90,7 +101,18 @@ class PearsonCorrCoef(Metric):
 
 
 class SpearmanCorrCoef(Metric):
-    """Spearman rank correlation (list state). Reference: regression/spearman.py:25-90."""
+    """Spearman rank correlation (list state). Reference: regression/spearman.py:25-90.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SpearmanCorrCoef
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> spearman = SpearmanCorrCoef()
+        >>> spearman.update(preds, target)
+        >>> round(float(spearman.compute()), 4)
+        1.0
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -113,7 +135,18 @@ class SpearmanCorrCoef(Metric):
 
 
 class R2Score(Metric):
-    """R². Reference: regression/r2.py:23-133."""
+    """R². Reference: regression/r2.py:23-133.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import R2Score
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> r2 = R2Score()
+        >>> r2.update(preds, target)
+        >>> round(float(r2.compute()), 4)
+        0.9486
+    """
 
     is_differentiable = True
     higher_is_better = True

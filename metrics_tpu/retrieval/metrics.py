@@ -30,7 +30,19 @@ from metrics_tpu.retrieval.base import RetrievalMetric
 
 
 class RetrievalMAP(RetrievalMetric):
-    """Mean average precision."""
+    """Mean average precision over queries. Reference: retrieval/average_precision.py:20.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalMAP
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> rmap = RetrievalMAP()
+        >>> rmap.update(preds, target, indexes=indexes)
+        >>> round(float(rmap.compute()), 4)
+        0.7917
+    """
 
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_average_precision(preds, target)

@@ -16,7 +16,17 @@ from metrics_tpu.ops.text.sacre_bleu import AVAILABLE_TOKENIZERS, _SacreBLEUToke
 
 
 class BLEUScore(Metric):
-    """Corpus BLEU. Reference: text/bleu.py:28-119."""
+    """Corpus BLEU. Reference: text/bleu.py:28-119.
+
+    Example:
+        >>> from metrics_tpu import BLEUScore
+        >>> preds = ["the cat is on the mat"]
+        >>> target = [["there is a cat on the mat", "a cat is on the mat"]]
+        >>> bleu = BLEUScore()
+        >>> bleu.update(preds, target)
+        >>> round(float(bleu.compute()), 4)
+        0.7598
+    """
 
     is_differentiable = False
     higher_is_better = True

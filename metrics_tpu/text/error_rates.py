@@ -29,7 +29,17 @@ _Corpus = Union[str, List[str]]
 
 
 class WordErrorRate(Metric):
-    """Word error rate. Reference: text/wer.py:23-95."""
+    """Word error rate. Reference: text/wer.py:23-95.
+
+    Example:
+        >>> from metrics_tpu import WordErrorRate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> wer = WordErrorRate()
+        >>> wer.update(preds, target)
+        >>> round(float(wer.compute()), 4)
+        0.5
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -50,7 +60,17 @@ class WordErrorRate(Metric):
 
 
 class CharErrorRate(Metric):
-    """Character error rate. Reference: text/cer.py:24-97."""
+    """Character error rate. Reference: text/cer.py:24-97.
+
+    Example:
+        >>> from metrics_tpu import CharErrorRate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> cer = CharErrorRate()
+        >>> cer.update(preds, target)
+        >>> round(float(cer.compute()), 4)
+        0.3415
+    """
 
     is_differentiable = False
     higher_is_better = False

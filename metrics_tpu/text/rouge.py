@@ -20,7 +20,15 @@ from metrics_tpu.utils.imports import _NLTK_AVAILABLE
 
 
 class ROUGEScore(Metric):
-    """ROUGE-N / ROUGE-L / ROUGE-Lsum. Reference: text/rouge.py:31-169."""
+    """ROUGE-N / ROUGE-L / ROUGE-Lsum. Reference: text/rouge.py:31-169.
+
+    Example:
+        >>> from metrics_tpu import ROUGEScore
+        >>> rouge = ROUGEScore()
+        >>> rouge.update(["My name is John"], ["Is your name John"])
+        >>> round(float(rouge.compute()["rouge1_fmeasure"]), 4)
+        0.75
+    """
 
     is_differentiable = False
     higher_is_better = True
