@@ -365,3 +365,91 @@ class TestAudioModules(MetricTester):
 
     def test_precision_bf16(self):
         self.run_precision_test(PREDS, TARGET, lambda p, t: signal_noise_ratio(p, t.astype(p.dtype)))
+
+
+# --------------------------------------------------------------------------- #
+# PESQ delegation path (VERDICT r2 item 5): the host-side plumbing — batch
+# reshape, device round-trip, (sum, count) accumulation — asserted numerically
+# against an injected deterministic backend; a live differential runs when the
+# real `pesq` C extension is present.
+# --------------------------------------------------------------------------- #
+class _FakePesqBackend:
+    """Deterministic stand-in with the `pesq.pesq(fs, ref, deg, mode)` signature."""
+
+    @staticmethod
+    def pesq(fs, ref, deg, mode):
+        ref = np.asarray(ref, dtype=np.float64)
+        deg = np.asarray(deg, dtype=np.float64)
+        corr = float(np.corrcoef(ref, deg)[0, 1])
+        return 2.0 + corr + (0.25 if mode == "wb" else 0.0) + fs / 80000.0
+
+
+@pytest.fixture
+def fake_pesq(monkeypatch):
+    import sys as _sys
+
+    import metrics_tpu.audio.pesq as pesq_module
+    import metrics_tpu.ops.audio.pesq as pesq_ops
+
+    monkeypatch.setitem(_sys.modules, "pesq", _FakePesqBackend)
+    monkeypatch.setattr(pesq_ops, "_PESQ_AVAILABLE", True)
+    monkeypatch.setattr(pesq_module, "_PESQ_AVAILABLE", True)
+    return _FakePesqBackend
+
+
+def _pesq_waveforms(shape=(2, 3), n=4000, seed=11):
+    rng = np.random.default_rng(seed)
+    t = np.sin(2 * np.pi * 440 * np.arange(n) / 8000).astype(np.float32)
+    target = np.broadcast_to(t, (*shape, n)).copy()
+    preds = target + 0.3 * rng.normal(size=(*shape, n)).astype(np.float32)
+    return preds, target
+
+
+def test_pesq_batch_reshape_numeric(fake_pesq):
+    from metrics_tpu.ops.audio.pesq import perceptual_evaluation_speech_quality
+
+    preds, target = _pesq_waveforms()
+    out = perceptual_evaluation_speech_quality(jnp.asarray(preds), jnp.asarray(target), 8000, "nb")
+    assert out.shape == (2, 3)
+    want = np.asarray(
+        [[fake_pesq.pesq(8000, target[i, j], preds[i, j], "nb") for j in range(3)] for i in range(2)]
+    )
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
+
+    # 1-D path
+    one = perceptual_evaluation_speech_quality(jnp.asarray(preds[0, 0]), jnp.asarray(target[0, 0]), 16000, "wb")
+    np.testing.assert_allclose(float(one), fake_pesq.pesq(16000, target[0, 0], preds[0, 0], "wb"), rtol=1e-6)
+
+
+def test_pesq_module_accumulation(fake_pesq):
+    from metrics_tpu.audio import PerceptualEvaluationSpeechQuality
+
+    preds, target = _pesq_waveforms(shape=(4,))
+    metric = PerceptualEvaluationSpeechQuality(fs=8000, mode="nb")
+    metric.update(jnp.asarray(preds[:2]), jnp.asarray(target[:2]))
+    metric.update(jnp.asarray(preds[2:]), jnp.asarray(target[2:]))
+    want = np.mean([fake_pesq.pesq(8000, target[i], preds[i], "nb") for i in range(4)])
+    np.testing.assert_allclose(float(metric.compute()), want, rtol=1e-6)
+
+
+def test_pesq_argument_validation(fake_pesq):
+    from metrics_tpu.ops.audio.pesq import perceptual_evaluation_speech_quality
+
+    preds, target = _pesq_waveforms(shape=(1,))
+    p, t = jnp.asarray(preds), jnp.asarray(target)
+    with pytest.raises(ValueError, match="to either be 8000 or 16000"):
+        perceptual_evaluation_speech_quality(p, t, 44100, "nb")
+    with pytest.raises(ValueError, match="to either be 'wb' or 'nb'"):
+        perceptual_evaluation_speech_quality(p, t, 16000, "speech")
+    with pytest.raises(ValueError, match="'nb' for a 8000Hz signal"):
+        perceptual_evaluation_speech_quality(p, t, 8000, "wb")
+
+
+def test_pesq_live_differential():
+    pesq_backend = pytest.importorskip("pesq")
+    from metrics_tpu.ops.audio.pesq import perceptual_evaluation_speech_quality
+
+    preds, target = _pesq_waveforms(shape=(3,), n=16000)
+    got = perceptual_evaluation_speech_quality(jnp.asarray(preds), jnp.asarray(target), 8000, "nb")
+    want = [pesq_backend.pesq(8000, target[i], preds[i], "nb") for i in range(3)]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
