@@ -48,3 +48,28 @@ _input_multidim_multiclass = Input(
     preds=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
     target=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)),
 )
+
+# logit-valued and multi-dim multilabel variants + the no-match edge case
+# (reference inputs.py:33-35,43-67)
+_input_binary_logits = Input(
+    preds=_rng.normal(size=(NUM_BATCHES, BATCH_SIZE)).astype(np.float32),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+)
+_input_multilabel_logits = Input(
+    preds=_rng.normal(size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+)
+_input_multiclass_logits = Input(
+    preds=(10 * _rng.normal(size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))).astype(np.float32),
+    target=_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+_input_multilabel_multidim_prob = Input(
+    preds=_rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)).astype(np.float32),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+)
+_input_multilabel_multidim = Input(
+    preds=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+    target=_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+)
+__no_match_preds = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
+_input_multilabel_no_match = Input(preds=__no_match_preds, target=1 - __no_match_preds)

@@ -7,10 +7,15 @@ from metrics_tpu.classification import Accuracy
 from metrics_tpu.ops.classification import accuracy
 from tests.classification.inputs import (
     _input_binary,
+    _input_binary_logits,
     _input_binary_prob,
     _input_multiclass,
+    _input_multiclass_logits,
     _input_multiclass_prob,
     _input_multidim_multiclass,
+    _input_multilabel_logits,
+    _input_multilabel_multidim,
+    _input_multilabel_no_match,
     _input_multilabel_prob,
 )
 from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
@@ -41,8 +46,15 @@ def _sk_accuracy(preds, target, subset_accuracy=False, **kw):
     [
         (_input_binary_prob.preds, _input_binary_prob.target, False, None),
         (_input_binary.preds, _input_binary.target, False, 2),
+        (_input_binary_logits.preds, _input_binary_logits.target, False, None),
         (_input_multilabel_prob.preds, _input_multilabel_prob.target, False, None),
+        (_input_multilabel_logits.preds, _input_multilabel_logits.target, False, None),
+        # integer same-rank inputs classify as multi-dim multi-class, whose
+        # one-hot lift needs a static num_classes (=2, binary labels) under jit
+        (_input_multilabel_no_match.preds, _input_multilabel_no_match.target, False, 2),
+        (_input_multilabel_multidim.preds, _input_multilabel_multidim.target, False, 2),
         (_input_multiclass_prob.preds, _input_multiclass_prob.target, False, None),
+        (_input_multiclass_logits.preds, _input_multiclass_logits.target, False, None),
         (_input_multiclass.preds, _input_multiclass.target, False, NUM_CLASSES),
         (_input_multidim_multiclass.preds, _input_multidim_multiclass.target, False, NUM_CLASSES),
         (_input_multidim_multiclass.preds, _input_multidim_multiclass.target, True, NUM_CLASSES),

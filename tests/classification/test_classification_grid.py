@@ -1,0 +1,66 @@
+"""bf16-precision and differentiability grid over classification functionals.
+
+Reference parity: every class metric in the reference runs fp16 + gradcheck
+variants (tests/helpers/testers.py:478-570); here the same two properties —
+finite results under bfloat16 inputs, finite gradients where the math is
+differentiable — are asserted across the whole functional surface.
+"""
+import numpy as np
+import pytest
+
+from metrics_tpu import ops
+from tests.classification.inputs import (
+    _input_binary_prob,
+    _input_multiclass_prob,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+_t = MetricTester()
+_BIN = _input_binary_prob
+_MC = _input_multiclass_prob
+_ML = _input_multilabel_prob
+
+
+BF16_CASES = [
+    ("accuracy", lambda p, t: ops.accuracy(p, t), _MC),
+    ("f1", lambda p, t: ops.f1_score(p, t, num_classes=NUM_CLASSES, average="macro"), _MC),
+    ("precision", lambda p, t: ops.precision(p, t, num_classes=NUM_CLASSES, average="macro"), _MC),
+    ("recall", lambda p, t: ops.recall(p, t, num_classes=NUM_CLASSES, average="macro"), _MC),
+    ("specificity", lambda p, t: ops.specificity(p, t, num_classes=NUM_CLASSES, average="macro"), _MC),
+    ("stat_scores", lambda p, t: ops.stat_scores(p, t, num_classes=NUM_CLASSES, reduce="macro"), _MC),
+    ("dice", lambda p, t: ops.dice(p, t), _MC),
+    ("hamming", lambda p, t: ops.hamming_distance(p, t), _ML),
+    ("confusion_matrix", lambda p, t: ops.confusion_matrix(p, t, num_classes=NUM_CLASSES), _MC),
+    ("cohen_kappa", lambda p, t: ops.cohen_kappa(p, t, num_classes=NUM_CLASSES), _MC),
+    ("jaccard", lambda p, t: ops.jaccard_index(p, t, num_classes=NUM_CLASSES), _MC),
+    ("matthews", lambda p, t: ops.matthews_corrcoef(p, t, num_classes=NUM_CLASSES), _MC),
+    ("auroc_binary", lambda p, t: ops.auroc(p, t, pos_label=1), _BIN),
+    ("average_precision", lambda p, t: ops.average_precision(p, t, pos_label=1), _BIN),
+    ("roc", lambda p, t: ops.roc(p, t, pos_label=1), _BIN),
+    ("calibration_error", lambda p, t: ops.calibration_error(p, t), _BIN),
+    ("hinge", lambda p, t: ops.hinge_loss(p, (t > 0).astype(np.int32)), _BIN),
+    ("kl_divergence", None, None),  # special-cased below: needs two distributions
+]
+
+
+@pytest.mark.parametrize("name,fn,fixture", BF16_CASES[:-1], ids=[c[0] for c in BF16_CASES[:-1]])
+def test_bf16_precision(name, fn, fixture):
+    _t.run_precision_test(fixture.preds, fixture.target, fn)
+
+
+def test_bf16_precision_kl_divergence():
+    p = _MC.preds
+    q = np.roll(_MC.preds, 1, axis=1)
+    _t.run_precision_test(p, q, lambda a, b: ops.kl_divergence(a, b))
+
+
+def test_differentiability_hinge():
+    _t.run_differentiability_test(
+        _BIN.preds, (_BIN.target > 0).astype(np.int32), lambda p, t: ops.hinge_loss(p, t)
+    )
+
+
+def test_differentiability_kl_divergence():
+    q = np.roll(_MC.preds, 1, axis=1)
+    _t.run_differentiability_test(_MC.preds, q, lambda p, t: ops.kl_divergence(p, t))

@@ -1,0 +1,35 @@
+"""bf16-precision and differentiability grid over the pure-tensor image
+functionals not already covered in test_image.py (SSIM/PSNR live there).
+
+Reference parity: tests/helpers/testers.py:478-570.
+"""
+import numpy as np
+import pytest
+
+from metrics_tpu import ops
+from tests.helpers.testers import MetricTester
+
+_t = MetricTester()
+_rng = np.random.default_rng(37)
+
+PREDS = _rng.random((2, 4, 3, 16, 16)).astype(np.float32)
+TARGET = _rng.random((2, 4, 3, 16, 16)).astype(np.float32)
+
+# image metrics enforce matching dtypes, so the target is cast alongside the
+# bf16 preds (same pattern as test_image.py's ssim_cast)
+CASES = [
+    ("uqi", lambda p, t: ops.universal_image_quality_index(p, t.astype(p.dtype))),
+    ("sam", lambda p, t: ops.spectral_angle_mapper(p, t.astype(p.dtype))),
+    ("ergas", lambda p, t: ops.error_relative_global_dimensionless_synthesis(p, t.astype(p.dtype))),
+    ("d_lambda", lambda p, t: ops.spectral_distortion_index(p, t.astype(p.dtype))),
+]
+
+
+@pytest.mark.parametrize("name,fn", CASES, ids=[c[0] for c in CASES])
+def test_bf16_precision(name, fn):
+    _t.run_precision_test(PREDS, TARGET, fn)
+
+
+@pytest.mark.parametrize("name,fn", CASES, ids=[c[0] for c in CASES])
+def test_differentiability(name, fn):
+    _t.run_differentiability_test(PREDS, TARGET, fn)

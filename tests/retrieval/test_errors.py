@@ -1,0 +1,109 @@
+"""Argument-error matrix for retrieval metrics.
+
+Reference parity: tests/retrieval/helpers.py:429 (`_errors_test_class_metric` /
+`_errors_test_functional_metric` parametrizations) — every retrieval class and
+functional must reject malformed indexes/preds/target and bad constructor
+arguments with the documented exception types.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+    RetrievalRPrecision,
+    ops,
+)
+
+ALL_CLASSES = [
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalPrecision,
+    RetrievalRecall,
+    RetrievalHitRate,
+    RetrievalFallOut,
+    RetrievalNormalizedDCG,
+    RetrievalRPrecision,
+]
+K_CLASSES = [RetrievalPrecision, RetrievalRecall, RetrievalHitRate, RetrievalFallOut, RetrievalNormalizedDCG]
+ALL_FUNCTIONALS = [
+    ops.retrieval_average_precision,
+    ops.retrieval_reciprocal_rank,
+    ops.retrieval_precision,
+    ops.retrieval_recall,
+    ops.retrieval_hit_rate,
+    ops.retrieval_fall_out,
+    ops.retrieval_r_precision,
+]
+
+_PREDS = jnp.asarray([0.2, 0.7, 0.4])
+_TARGET = jnp.asarray([0, 1, 0])
+_INDEXES = jnp.asarray([0, 0, 0])
+
+
+@pytest.mark.parametrize("metric_cls", ALL_CLASSES, ids=lambda c: c.__name__)
+class TestClassArgErrors:
+    def test_invalid_empty_target_action(self, metric_cls):
+        with pytest.raises(ValueError, match="empty_target_action"):
+            metric_cls(empty_target_action="casual_videos")
+
+    def test_invalid_ignore_index(self, metric_cls):
+        with pytest.raises(ValueError, match="ignore_index"):
+            metric_cls(ignore_index=-1.5)
+
+    def test_indexes_none(self, metric_cls):
+        with pytest.raises(ValueError, match="`indexes` cannot be None"):
+            metric_cls().update(_PREDS, _TARGET, indexes=None)
+
+    def test_indexes_wrong_dtype(self, metric_cls):
+        with pytest.raises(ValueError, match="integer"):
+            metric_cls().update(_PREDS, _TARGET, indexes=jnp.asarray([0.0, 0.0, 0.0]))
+
+    def test_mismatched_shapes(self, metric_cls):
+        with pytest.raises(ValueError, match="shape"):
+            metric_cls().update(_PREDS, _TARGET[:2], indexes=_INDEXES)
+        with pytest.raises(ValueError, match="shape"):
+            metric_cls().update(_PREDS, _TARGET, indexes=_INDEXES[:2])
+
+    def test_empty_inputs(self, metric_cls):
+        with pytest.raises(ValueError, match="at least one element"):
+            metric_cls().update(jnp.zeros((0,)), jnp.zeros((0,), jnp.int32), indexes=jnp.zeros((0,), jnp.int32))
+
+    def test_preds_not_float(self, metric_cls):
+        with pytest.raises(ValueError, match="float"):
+            metric_cls().update(jnp.asarray([1, 0, 2]), _TARGET, indexes=_INDEXES)
+
+    def test_non_binary_target(self, metric_cls):
+        if metric_cls is RetrievalNormalizedDCG:
+            pytest.skip("NDCG allows graded relevance")
+        with pytest.raises(ValueError, match="binary"):
+            metric_cls().update(_PREDS, jnp.asarray([0, 3, 1]), indexes=_INDEXES)
+
+
+@pytest.mark.parametrize("metric_cls", K_CLASSES, ids=lambda c: c.__name__)
+def test_invalid_k(metric_cls):
+    with pytest.raises(ValueError, match="`k`"):
+        metric_cls(k=-2)
+    with pytest.raises(ValueError, match="`k`"):
+        metric_cls(k=1.5)
+
+
+@pytest.mark.parametrize("fn", ALL_FUNCTIONALS, ids=lambda f: f.__name__)
+class TestFunctionalArgErrors:
+    def test_mismatched_shapes(self, fn):
+        with pytest.raises(ValueError, match="shape"):
+            fn(_PREDS, _TARGET[:2])
+
+    def test_empty_inputs(self, fn):
+        with pytest.raises(ValueError, match="at least one element"):
+            fn(jnp.zeros((0,)), jnp.zeros((0,), jnp.int32))
+
+    def test_non_binary_target(self, fn):
+        with pytest.raises(ValueError, match="binary"):
+            fn(_PREDS, jnp.asarray([0, 3, 1]))
