@@ -84,6 +84,13 @@ def _next_bucket(n: int, minimum: int = 8) -> int:
 class MeanAveragePrecision(Metric):
     """COCO mAP/mAR. Reference: detection/mean_ap.py:199.
 
+    Matching semantics follow the REFERENCE, which excludes area-ignored
+    ground truths from matching (reference mean_ap.py:659-663); pycocotools
+    instead matches against them and discounts afterwards. The two agree when
+    GTs lie inside the evaluated area range and can differ on size-binned
+    metrics when GT areas straddle range boundaries — deviation quantified in
+    tests/detection/test_pycoco.py (gated on pycocotools availability).
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu.detection import MeanAveragePrecision
