@@ -49,11 +49,11 @@ def _auroc_compute(
 
     if max_fpr is not None:
         if not isinstance(max_fpr, float) or not 0 < max_fpr <= 1:
-            raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
+            raise ValueError(f"`max_fpr` must be a float in (0, 1]; got {max_fpr}")
         if mode != DataType.BINARY:
             raise ValueError(
-                "Partial AUC computation not available in multilabel/multiclass setting,"
-                f" 'max_fpr' must be set to `None`, received `{max_fpr}`."
+                "Partial AUC (`max_fpr`) is only defined for binary inputs; leave it as"
+                f" None for multiclass/multilabel data (got {max_fpr})."
             )
 
     if mode == DataType.MULTILABEL:
@@ -67,11 +67,11 @@ def _auroc_compute(
             fpr = [o[0] for o in output]
             tpr = [o[1] for o in output]
         else:
-            raise ValueError("Detected input to be `multilabel` but you did not provide `num_classes` argument")
+            raise ValueError("Multilabel input needs an explicit `num_classes` argument")
     else:
         if mode != DataType.BINARY:
             if num_classes is None:
-                raise ValueError("Detected input to `multiclass` but you did not provide `num_classes` argument")
+                raise ValueError("Multiclass input needs an explicit `num_classes` argument")
             if average == AverageMethod.WEIGHTED and len(np.unique(np.asarray(target))) < num_classes:
                 # exclude unobserved classes (their weight would be 0)
                 target_bool_mat = np.zeros((len(target), num_classes), dtype=bool)
@@ -79,12 +79,12 @@ def _auroc_compute(
                 class_observed = target_bool_mat.sum(axis=0) > 0
                 for c in range(num_classes):
                     if not class_observed[c]:
-                        warnings.warn(f"Class {c} had 0 observations, omitted from AUROC calculation", UserWarning)
+                        warnings.warn(f"Class {c} has no observations and is dropped from the AUROC average", UserWarning)
                 preds = preds[:, jnp.asarray(class_observed)]
                 target = jnp.asarray(np.where(target_bool_mat[:, class_observed])[1])
                 num_classes = int(class_observed.sum())
                 if num_classes == 1:
-                    raise ValueError("Found 1 non-empty class in `multiclass` AUROC calculation")
+                    raise ValueError("Only one observed class remains; multiclass AUROC is undefined")
         fpr, tpr, _ = roc(preds, target, num_classes, pos_label, sample_weights)
 
     if max_fpr is None or max_fpr == 1:
@@ -103,7 +103,7 @@ def _auroc_compute(
                     support = bincount(target.reshape(-1), minlength=num_classes)
                 return jnp.sum(jnp.stack(auc_scores) * support / jnp.sum(support))
             allowed_average = (AverageMethod.NONE.value, AverageMethod.MACRO.value, AverageMethod.WEIGHTED.value)
-            raise ValueError(f"Argument `average` expected to be one of the following: {allowed_average} but got {average}")
+            raise ValueError(f"`average` must be one of {allowed_average}; got {average}")
         return _auc_compute_without_check(fpr, tpr, 1.0)
 
     max_area = jnp.asarray(max_fpr, dtype=jnp.float32)

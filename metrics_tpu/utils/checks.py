@@ -45,7 +45,7 @@ def _check_same_shape(preds: Array, target: Array) -> None:
     """Raise if shapes differ. Reference: checks.py:30-33."""
     if preds.shape != target.shape:
         raise RuntimeError(
-            f"Predictions and targets are expected to have the same shape, got {preds.shape} and {target.shape}."
+            f"`preds` and `target` must have the same shape; got {preds.shape} vs {target.shape}."
         )
 
 
@@ -56,23 +56,23 @@ def _basic_input_validation(
     if _check_for_empty_tensors(preds, target):
         return
     if _is_floating(target):
-        raise ValueError("The `target` has to be an integer tensor.")
+        raise ValueError("`target` must hold integer (or boolean) labels, not floats.")
 
     if preds.shape[0:1] != target.shape[0:1]:
-        raise ValueError("The `preds` and `target` should have the same first dimension.")
+        raise ValueError("`preds` and `target` must agree in their leading (batch) dimension.")
 
     if not _is_concrete(preds, target):
         return  # value checks impossible under tracing
     if ignore_index is None and target.min() < 0:
-        raise ValueError("The `target` has to be a non-negative tensor.")
+        raise ValueError("Negative labels found in `target`; labels must be non-negative here.")
     if ignore_index is not None and ignore_index >= 0 and target.min() < 0:
-        raise ValueError("The `target` has to be a non-negative tensor.")
+        raise ValueError("Negative labels found in `target`; labels must be non-negative here.")
     if not _is_floating(preds) and preds.min() < 0:
-        raise ValueError("If `preds` are integers, they have to be non-negative.")
+        raise ValueError("Integer `preds` must be non-negative.")
     if multiclass is False and target.max() > 1:
-        raise ValueError("If you set `multiclass=False`, then `target` should not exceed 1.")
+        raise ValueError("`multiclass=False` requires binary `target` values (0 or 1).")
     if multiclass is False and not _is_floating(preds) and preds.max() > 1:
-        raise ValueError("If you set `multiclass=False` and `preds` are integers, then `preds` should not exceed 1.")
+        raise ValueError("`multiclass=False` with integer `preds` requires binary prediction values (0 or 1).")
 
 
 def _check_shape_and_type_consistency(preds: Array, target: Array) -> Tuple[DataType, int]:
@@ -85,12 +85,12 @@ def _check_shape_and_type_consistency(preds: Array, target: Array) -> Tuple[Data
     if preds.ndim == target.ndim:
         if preds.shape != target.shape:
             raise ValueError(
-                "The `preds` and `target` should have the same shape,"
-                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+                "Equal-rank `preds` and `target` must have identical shapes;"
+                f" got preds={preds.shape}, target={target.shape}."
             )
         if preds_float and target.size > 0 and _is_concrete(target) and target.max() > 1:
             raise ValueError(
-                "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
+                "Float `preds` at the same rank as `target` imply a binary/multi-label task, so `target` may only hold 0/1."
             )
         if preds.ndim == 1 and preds_float:
             case = DataType.BINARY
@@ -104,18 +104,18 @@ def _check_shape_and_type_consistency(preds: Array, target: Array) -> Tuple[Data
 
     elif preds.ndim == target.ndim + 1:
         if not preds_float:
-            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+            raise ValueError("An extra class dimension on `preds` only makes sense for float (probability/logit) predictions.")
         if preds.shape[2:] != target.shape[1:]:
             raise ValueError(
-                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
-                " (N, C, ...), and the shape of `target` should be (N, ...)."
+                "When `preds` carries a class dimension, the shapes must line up as"
+                " preds (N, C, ...) against target (N, ...)."
             )
         implied_classes = preds.shape[1] if preds.size > 0 else 0
         case = DataType.MULTICLASS if preds.ndim == 2 else DataType.MULTIDIM_MULTICLASS
     else:
         raise ValueError(
-            "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...)"
-            " and `preds` should be (N, C, ...)."
+            "Unsupported rank combination: expected `preds`/`target` both shaped (N, ...), or"
+            " `preds` shaped (N, C, ...) with `target` shaped (N, ...)."
         )
     return case, implied_classes
 
@@ -123,17 +123,17 @@ def _check_shape_and_type_consistency(preds: Array, target: Array) -> Tuple[Data
 def _check_num_classes_binary(num_classes: int, multiclass: Optional[bool]) -> None:
     """Reference: checks.py:123-138."""
     if num_classes > 2:
-        raise ValueError("Your data is binary, but `num_classes` is larger than 2.")
+        raise ValueError("Binary data detected, yet `num_classes` exceeds 2.")
     if num_classes == 2 and not multiclass:
         raise ValueError(
-            "Your data is binary and `num_classes=2`, but `multiclass` is not True."
-            " Set it to True if you want to transform binary data to multi-class format."
+            "Binary data with `num_classes=2` only makes sense together with `multiclass=True`"
+            " (which lifts binary inputs to 2-class multi-class format)."
         )
     if num_classes == 1 and multiclass:
         raise ValueError(
-            "You have binary data and have set `multiclass=True`, but `num_classes` is 1."
-            " Either set `multiclass=None`(default) or set `num_classes=2`"
-            " to transform binary data to multi-class format."
+            "Binary data with `multiclass=True` needs two classes, but `num_classes` is 1."
+            " Leave `multiclass=None` (default) or pass `num_classes=2` to lift binary"
+            " data to multi-class format."
         )
 
 
@@ -143,51 +143,49 @@ def _check_num_classes_mc(
     """Reference: checks.py:141-169."""
     if num_classes == 1 and multiclass is not False:
         raise ValueError(
-            "You have set `num_classes=1`, but predictions are integers."
-            " If you want to convert (multi-dimensional) multi-class data with 2 classes"
-            " to binary/multi-label, set `multiclass=False`."
+            "`num_classes=1` with integer predictions is ambiguous. To fold 2-class"
+            " (multi-dim) multi-class data down to binary/multi-label, pass `multiclass=False`."
         )
     if num_classes > 1:
         if multiclass is False and implied_classes != num_classes:
             raise ValueError(
-                "You have set `multiclass=False`, but the implied number of classes "
-                " (from shape of inputs) does not match `num_classes`."
+                "With `multiclass=False` the class count implied by the input shapes"
+                " must equal `num_classes`, but it does not."
             )
         if target.size > 0 and _is_concrete(target) and num_classes <= target.max():
-            raise ValueError("The highest label in `target` should be smaller than `num_classes`.")
+            raise ValueError("`target` contains a label >= `num_classes`.")
         if preds.shape != target.shape and num_classes != implied_classes:
-            raise ValueError("The size of C dimension of `preds` does not match `num_classes`.")
+            raise ValueError("The class (C) dimension of `preds` disagrees with `num_classes`.")
 
 
 def _check_num_classes_ml(num_classes: int, multiclass: Optional[bool], implied_classes: int) -> None:
     """Reference: checks.py:172-183."""
     if multiclass and num_classes != 2:
         raise ValueError(
-            "Your have set `multiclass=True`, but `num_classes` is not equal to 2."
-            " If you are trying to transform multi-label data to 2 class multi-dimensional"
-            " multi-class, you should set `num_classes` to either 2 or None."
+            "Multi-label data with `multiclass=True` lifts to exactly 2 classes, so"
+            " `num_classes` must be 2 or None."
         )
     if not multiclass and num_classes != implied_classes:
-        raise ValueError("The implied number of classes (from shape of inputs) does not match num_classes.")
+        raise ValueError("The class count implied by the input shapes disagrees with `num_classes`.")
 
 
 def _check_top_k(top_k: int, case: DataType, implied_classes: int, multiclass: Optional[bool], preds_float: bool) -> None:
     """Reference: checks.py:186-201."""
     if case == DataType.BINARY:
-        raise ValueError("You can not use `top_k` parameter with binary data.")
+        raise ValueError("`top_k` is meaningless for binary data.")
     if not isinstance(top_k, int) or top_k <= 0:
-        raise ValueError("The `top_k` has to be an integer larger than 0.")
+        raise ValueError("`top_k` must be a positive integer.")
     if not preds_float:
-        raise ValueError("You have set `top_k`, but you do not have probability predictions.")
+        raise ValueError("`top_k` requires float (probability/logit) predictions.")
     if multiclass is False:
-        raise ValueError("If you set `multiclass=False`, you can not set `top_k`.")
+        raise ValueError("`top_k` cannot be combined with `multiclass=False`.")
     if case == DataType.MULTILABEL and multiclass:
         raise ValueError(
-            "If you want to transform multi-label data to 2 class multi-dimensional"
-            "multi-class data using `multiclass=True`, you can not use `top_k`."
+            "`top_k` cannot be combined with lifting multi-label data to 2-class"
+            " multi-class via `multiclass=True`."
         )
     if top_k >= implied_classes:
-        raise ValueError("The `top_k` has to be strictly smaller than the `C` dimension of `preds`.")
+        raise ValueError("`top_k` must be strictly smaller than the class (C) dimension of `preds`.")
 
 
 def _check_classification_inputs(
@@ -206,12 +204,12 @@ def _check_classification_inputs(
     if preds.shape != target.shape:
         if multiclass is False and implied_classes != 2:
             raise ValueError(
-                "You have set `multiclass=False`, but have more than 2 classes in your data,"
-                " based on the C dimension of `preds`."
+                "`multiclass=False` requires at most 2 classes, but the class (C) dimension"
+                " of `preds` implies more."
             )
         if _is_concrete(target) and target.size > 0 and target.max() >= implied_classes:
             raise ValueError(
-                "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
+                "`target` contains a label >= the class (C) dimension of `preds`."
             )
 
     if num_classes:
@@ -311,7 +309,7 @@ def _input_format_classification_one_hot(
 ) -> Tuple[Array, Array]:
     """One-hot ``(C, -1)`` canonicalization. Reference: checks.py:453-499."""
     if preds.ndim not in (target.ndim, target.ndim + 1):
-        raise ValueError("preds and target must have same number of dimensions, or one additional dimension for preds")
+        raise ValueError("`preds` must match `target` in rank, or carry exactly one extra (class) dimension")
     if preds.ndim == target.ndim + 1:
         preds = jnp.argmax(preds, axis=1)
 
@@ -334,11 +332,11 @@ def _check_retrieval_target_and_prediction_types(
     preds: Array, target: Array, allow_non_binary_target: bool = False
 ) -> Tuple[Array, Array]:
     if not (jnp.issubdtype(target.dtype, jnp.integer) or jnp.issubdtype(target.dtype, jnp.bool_) or _is_floating(target)):
-        raise ValueError("`target` must be a tensor of booleans, integers or floats")
+        raise ValueError("`target` must hold boolean, integer or float values")
     if not _is_floating(preds):
-        raise ValueError("`preds` must be a tensor of floats")
+        raise ValueError("`preds` must hold float scores")
     if not allow_non_binary_target and _is_concrete(target) and (target.max() > 1 or target.min() < 0):
-        raise ValueError("`target` must contain `binary` values")
+        raise ValueError("`target` must be binary (0/1) for this metric")
     target = target.astype(jnp.float32) if _is_floating(target) else target.astype(jnp.int32)
     return preds.astype(jnp.float32).reshape(-1), target.reshape(-1)
 
@@ -347,9 +345,9 @@ def _check_retrieval_functional_inputs(
     preds: Array, target: Array, allow_non_binary_target: bool = False
 ) -> Tuple[Array, Array]:
     if preds.shape != target.shape:
-        raise ValueError("`preds` and `target` must be of the same shape")
+        raise ValueError("`preds` and `target` shapes must match")
     if preds.size == 0 or preds.ndim == 0:
-        raise ValueError("`preds` and `target` must be non-empty and non-scalar tensors")
+        raise ValueError("`preds` and `target` must be non-scalar and contain at least one element")
     return _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
 
 
@@ -440,14 +438,14 @@ def _check_retrieval_inputs(
     ignore_index: Optional[int] = None,
 ) -> Tuple[Array, Array, Array]:
     if indexes.shape != preds.shape or preds.shape != target.shape:
-        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+        raise ValueError("`indexes`, `preds` and `target` shapes must all match")
     if not jnp.issubdtype(indexes.dtype, jnp.integer):
-        raise ValueError("`indexes` must be a tensor of long integers")
+        raise ValueError("`indexes` must hold integer query ids")
     if ignore_index is not None:
         # data-dependent filter: eager-only (compiled retrieval path uses masks)
         valid = np.asarray(target != ignore_index)
         indexes, preds, target = jnp.asarray(np.asarray(indexes)[valid]), jnp.asarray(np.asarray(preds)[valid]), jnp.asarray(np.asarray(target)[valid])
     if indexes.size == 0 or indexes.ndim == 0:
-        raise ValueError("`indexes`, `preds` and `target` must be non-empty and non-scalar tensors")
+        raise ValueError("`indexes`, `preds` and `target` must be non-scalar and contain at least one element")
     preds, target = _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
     return indexes.astype(jnp.int32).reshape(-1), preds, target
