@@ -41,13 +41,12 @@ def _preprocess_text(
         out = tokenizer(text, padding="max_length", max_length=max_length, truncation=truncation, return_tensors="np")
         return {"input_ids": np.asarray(out["input_ids"]), "attention_mask": np.asarray(out["attention_mask"])}
     except TypeError:
+        # user tokenizer without the transformers kwargs: it owns the padded
+        # width (reference bert.py:41-63 semantics) — padding up to max_length
+        # here would blow the matching einsum up with dead positions
         out = tokenizer(text)
         input_ids = np.asarray(out["input_ids"])
         attention_mask = np.asarray(out["attention_mask"])
-        if input_ids.shape[1] < max_length:
-            pad = max_length - input_ids.shape[1]
-            input_ids = np.pad(input_ids, ((0, 0), (0, pad)))
-            attention_mask = np.pad(attention_mask, ((0, 0), (0, pad)))
         return {"input_ids": input_ids[:, :max_length], "attention_mask": attention_mask[:, :max_length]}
 
 
@@ -67,6 +66,25 @@ def _process_attention_mask_for_special_tokens(attention_mask: Array) -> Array:
     attention_mask = attention_mask.at[:, 0].set(0)
     sep_pos = jnp.argmax(jnp.cumsum(attention_mask - 0.1, axis=-1), axis=-1)
     return attention_mask.at[jnp.arange(attention_mask.shape[0]), sep_pos].set(0)
+
+
+@jax.jit
+def _finalize_embeddings(out: Array, attention_mask: Array, token_idf: Array) -> Tuple[Array, Array]:
+    """One fused XLA program per (batch, seq) bucket: guarded normalize,
+    special-token masking, idf scaling. Keeping this jitted matters — the hot
+    loop would otherwise pay ~a dozen eager dispatches per batch.
+
+    The guarded norm keeps zero vectors (e.g. a user model embedding pad/cls
+    to 0) zero instead of NaN; the where (not an eps clamp) also survives
+    fp16, where 1e-12 rounds to 0.
+    """
+    norm = jnp.linalg.norm(out, axis=-1, keepdims=True)
+    out = out / jnp.where(norm == 0, 1.0, norm)
+    processed_mask = _process_attention_mask_for_special_tokens(attention_mask)
+    out = jnp.einsum("blsd,bs->blsd", out, processed_mask.astype(out.dtype))
+    idf = token_idf * processed_mask
+    idf = idf / jnp.sum(idf, axis=-1, keepdims=True)
+    return out, idf
 
 
 def _embed_and_scale(
@@ -95,17 +113,10 @@ def _embed_and_scale(
         else:
             out = jnp.asarray(hidden[num_layers if num_layers is not None else -1])[:, None]
 
-    # guarded norm: zero vectors (e.g. a user model embedding pad/cls to 0)
-    # stay zero instead of becoming NaN and poisoning the masked einsum below;
-    # the where (not an eps clamp) also survives fp16, where 1e-12 rounds to 0
-    norm = jnp.linalg.norm(out, axis=-1, keepdims=True)
-    out = out / jnp.where(norm == 0, 1.0, norm)
-    processed_mask = _process_attention_mask_for_special_tokens(jnp.asarray(attention_mask))
-    out = jnp.einsum("blsd,bs->blsd", out, processed_mask.astype(out.dtype))
-
-    idf = input_ids_idf * processed_mask if input_ids_idf is not None else processed_mask.astype(out.dtype)
-    idf = idf / jnp.sum(idf, axis=-1, keepdims=True)
-    return out, idf
+    attention_mask = jnp.asarray(attention_mask)
+    # disabled idf degenerates to the processed mask, so ones keep one code path
+    token_idf = input_ids_idf if input_ids_idf is not None else jnp.ones(attention_mask.shape, out.dtype)
+    return _finalize_embeddings(out, attention_mask, token_idf)
 
 
 @partial(jax.jit, static_argnames=())
@@ -263,10 +274,11 @@ def bert_score(
                 precision, recall, f1, baseline, num_layers, all_layers
             )
 
+    # one host transfer per output (per-element float() would round-trip 3N times)
     output_dict = {
-        "precision": [float(x) for x in jnp.atleast_1d(precision)],
-        "recall": [float(x) for x in jnp.atleast_1d(recall)],
-        "f1": [float(x) for x in jnp.atleast_1d(f1)],
+        "precision": np.asarray(jnp.atleast_1d(precision), dtype=np.float64).tolist(),
+        "recall": np.asarray(jnp.atleast_1d(recall), dtype=np.float64).tolist(),
+        "f1": np.asarray(jnp.atleast_1d(f1), dtype=np.float64).tolist(),
     }
     if return_hash:
         output_dict.update({"hash": _get_hash(model_name_or_path, num_layers, idf)})

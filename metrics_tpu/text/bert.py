@@ -10,6 +10,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 
 import jax.numpy as jnp
 import numpy as np
+from jax import Array
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.ops.text.bert import _DEFAULT_MODEL, _preprocess_text, bert_score
@@ -98,14 +99,25 @@ class BERTScore(Metric):
         self.target_input_ids = self.target_input_ids + [jnp.asarray(target_dict["input_ids"])]
         self.target_attention_mask = self.target_attention_mask + [jnp.asarray(target_dict["attention_mask"])]
 
+    @staticmethod
+    def _cat_padded(batches: List[Array]) -> np.ndarray:
+        """Concatenate token batches whose padded widths may differ between
+        ``update`` calls (a user tokenizer may pad each batch to its own
+        longest sentence); right-pad everything to the widest batch."""
+        arrs = [np.asarray(x) for x in batches]
+        width = max(a.shape[1] for a in arrs)
+        return np.concatenate(
+            [np.pad(a, ((0, 0), (0, width - a.shape[1]))) for a in arrs]
+        )
+
     def compute(self) -> Dict[str, Union[List[float], str]]:
         preds = {
-            "input_ids": np.concatenate([np.asarray(x) for x in self.preds_input_ids]),
-            "attention_mask": np.concatenate([np.asarray(x) for x in self.preds_attention_mask]),
+            "input_ids": self._cat_padded(self.preds_input_ids),
+            "attention_mask": self._cat_padded(self.preds_attention_mask),
         }
         target = {
-            "input_ids": np.concatenate([np.asarray(x) for x in self.target_input_ids]),
-            "attention_mask": np.concatenate([np.asarray(x) for x in self.target_attention_mask]),
+            "input_ids": self._cat_padded(self.target_input_ids),
+            "attention_mask": self._cat_padded(self.target_attention_mask),
         }
         return bert_score(
             preds=preds,

@@ -120,3 +120,36 @@ def test_return_hash():
 def test_mismatched_lengths_raise():
     with pytest.raises(ValueError):
         bert_score(["a", "b"], ["a"], model="toy", user_tokenizer=ToyTokenizer(), user_forward_fn=toy_forward_fn)
+
+
+def test_bertscore_variable_width_tokenizer():
+    """A user tokenizer padding each batch to its own longest sentence must
+    still accumulate across updates (widths are right-padded at compute)."""
+
+    class VarWidthTok:
+        def __call__(self, sentences):
+            width = max(len(s.split()) for s in sentences) + 2
+            ids = np.full((len(sentences), width), VOCAB.index("[PAD]"), dtype=np.int32)
+            mask = np.zeros((len(sentences), width), dtype=np.int32)
+            for row, sent in enumerate(sentences):
+                tokens = ["[CLS]"] + sent.split()[: width - 2] + ["[SEP]"]
+                for col, tok in enumerate(tokens):
+                    ids[row, col] = VOCAB.index(tok)
+                    mask[row, col] = 1
+            return {"input_ids": ids, "attention_mask": mask}
+
+    preds = ["hello there", "general kenobi master hello world"]
+    target = ["hello there", "master kenobi"]
+    metric = BERTScore(model=object(), user_tokenizer=VarWidthTok(), user_forward_fn=toy_forward_fn, max_length=MAX_LEN)
+    metric.update(preds[:1], target[:1])  # width 4
+    metric.update(preds[1:], target[1:])  # width 7
+    got = metric.compute()
+
+    # same pairs through a fixed-width tokenizer in one update: the ragged
+    # accumulation must be width-invariant (the oracle is not the yardstick
+    # here — matching over padded widths floors negative cosines at 0, a
+    # reference-parity behavior both paths share)
+    fixed = BERTScore(model=object(), user_tokenizer=ToyTokenizer(), user_forward_fn=toy_forward_fn, max_length=MAX_LEN)
+    fixed.update(preds, target)
+    want = fixed.compute()
+    np.testing.assert_allclose(np.asarray(got["f1"]), np.asarray(want["f1"]), rtol=1e-5)
