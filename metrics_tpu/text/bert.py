@@ -106,9 +106,15 @@ class BERTScore(Metric):
         longest sentence); right-pad everything to the widest batch."""
         arrs = [np.asarray(x) for x in batches]
         width = max(a.shape[1] for a in arrs)
-        return np.concatenate(
-            [np.pad(a, ((0, 0), (0, width - a.shape[1]))) for a in arrs]
-        )
+
+        def pad(a: np.ndarray) -> np.ndarray:
+            # pad the token axis only; ids may be (B, S) or embedding-valued
+            # (B, S, D) as in the reference's word2vec-style UserTokenizer
+            widths = [(0, 0)] * a.ndim
+            widths[1] = (0, width - a.shape[1])
+            return np.pad(a, widths)
+
+        return np.concatenate([pad(a) for a in arrs])
 
     def compute(self) -> Dict[str, Union[List[float], str]]:
         preds = {

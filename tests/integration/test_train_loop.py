@@ -138,5 +138,12 @@ def test_examples_run():
     env = dict(os.environ)
     env["PYTHONPATH"] = env_path + (":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     for example in sorted((repo / "examples").glob("*.py")):
-        proc = subprocess.run([sys.executable, str(example)], capture_output=True, env=env, timeout=600)
+        # pin the subprocess to CPU like the conftest pins this process: a
+        # config update, not env (sitecustomize preloads the TPU plugin, and a
+        # wedged tunnel would hang the child at backend init)
+        shim = (
+            "import jax, runpy; jax.config.update('jax_platforms', 'cpu'); "
+            f"runpy.run_path({str(example)!r}, run_name='__main__')"
+        )
+        proc = subprocess.run([sys.executable, "-c", shim], capture_output=True, env=env, timeout=600)
         assert proc.returncode == 0, f"{example.name} failed: {proc.stderr.decode()[-500:]}"
