@@ -20,8 +20,10 @@ TPU-first redesign (SURVEY.md §7 hard part 2):
 """
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import Array
@@ -71,6 +73,22 @@ def _input_validator(preds: Sequence[Dict], targets: Sequence[Dict], iou_type: s
     for item in targets:
         if len(item[item_val_name]) != len(item["labels"]):
             raise ValueError(f"Input {item_val_name} and labels of sample must have a length equal to each other")
+
+
+@functools.lru_cache(maxsize=None)
+def _bbox_eval_kernel(pd: int, pg: int):
+    """One fused jitted program per (det, gt) bucket: masked box IoU over the
+    padded boxes + the greedy matcher. Counts are dynamic scalars, so every
+    image sharing a bucket shares the compiled program."""
+
+    @jax.jit
+    def kernel(det_pad, gt_pad, n_det, n_gt, dcv, gcv, gia, thresholds):
+        ious = box_iou(det_pad, gt_pad)  # (pd, pg), garbage in padded rows/cols
+        valid = (jnp.arange(pd) < n_det)[:, None] & (jnp.arange(pg) < n_gt)[None, :]
+        ious = jnp.where(valid, ious, 0.0)
+        return match_image(ious, dcv, gcv, gia, thresholds)
+
+    return kernel
 
 
 def _next_bucket(n: int, minimum: int = 8) -> int:
@@ -229,16 +247,35 @@ class MeanAveragePrecision(Metric):
         gt_class_valid = gt_labels[None, :] == classes_arr[:, None]  # (K, G)
 
         if n_det > 0 and n_gt > 0:
-            # pad to buckets for the jitted kernel; reorder on device (masks
-            # especially are H*W-sized — no host round-trip)
             pd, pg = _next_bucket(n_det), _next_bucket(n_gt)
-            det_sorted = jnp.asarray(det)[jnp.asarray(order)]
-            ious = (box_iou if self.iou_type == "bbox" else mask_iou)(det_sorted, jnp.asarray(gt))  # (D, G)
-            ious_p = jnp.zeros((pd, pg), dtype=jnp.float32).at[:n_det, :n_gt].set(ious)
-            dcv = jnp.zeros((len(classes), pd), dtype=bool).at[:, :n_det].set(det_class_valid)
-            gcv = jnp.zeros((len(classes), pg), dtype=bool).at[:, :n_gt].set(gt_class_valid)
-            gia = jnp.zeros((len(area_ranges), pg), dtype=bool).at[:, :n_gt].set(gt_area_ignore)
-            det_matches, _ = match_image(ious_p, dcv, gcv, gia, jnp.asarray(self.iou_thresholds))
+            if self.iou_type == "bbox":
+                # boxes are tiny: pad on host (numpy memcpy) and run ONE jitted
+                # program per (pd, pg) bucket — padding/IoU/matching fused,
+                # instead of ~8 eager dispatches per image
+                det_pad = np.zeros((pd, 4), np.float32)
+                det_pad[:n_det] = np.asarray(det)[order]
+                gt_pad = np.zeros((pg, 4), np.float32)
+                gt_pad[:n_gt] = np.asarray(gt)
+                dcv = np.zeros((len(classes), pd), bool)
+                dcv[:, :n_det] = det_class_valid
+                gcv = np.zeros((len(classes), pg), bool)
+                gcv[:, :n_gt] = gt_class_valid
+                gia = np.zeros((len(area_ranges), pg), bool)
+                gia[:, :n_gt] = gt_area_ignore
+                kernel = _bbox_eval_kernel(pd, pg)
+                det_matches, _ = kernel(
+                    det_pad, gt_pad, np.int32(n_det), np.int32(n_gt), dcv, gcv, gia,
+                    np.asarray(self.iou_thresholds, np.float32),
+                )
+            else:
+                # masks are H*W-sized: reorder/pad on device, no host round-trip
+                det_sorted = jnp.asarray(det)[jnp.asarray(order)]
+                ious = mask_iou(det_sorted, jnp.asarray(gt))  # (D, G)
+                ious_p = jnp.zeros((pd, pg), dtype=jnp.float32).at[:n_det, :n_gt].set(ious)
+                dcv = jnp.zeros((len(classes), pd), dtype=bool).at[:, :n_det].set(det_class_valid)
+                gcv = jnp.zeros((len(classes), pg), dtype=bool).at[:, :n_gt].set(gt_class_valid)
+                gia = jnp.zeros((len(area_ranges), pg), dtype=bool).at[:, :n_gt].set(gt_area_ignore)
+                det_matches, _ = match_image(ious_p, dcv, gcv, gia, jnp.asarray(self.iou_thresholds))
             det_matches = np.asarray(det_matches)[..., :n_det]  # (K, A, T, D)
         else:
             det_matches = np.zeros((len(classes), len(area_ranges), len(self.iou_thresholds), n_det), dtype=bool)
