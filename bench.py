@@ -615,7 +615,16 @@ def bench_catbuffer_auroc() -> dict:
 
 
 # --------------------------------------------------------------------------- #
+_BENCH_START = time.perf_counter()
+_BENCH_BUDGET = float(os.environ.get("BENCH_BUDGET_SECONDS", "1500"))
+
+
 def _safe(fn, *args):
+    """Run one sub-benchmark, isolated; skip when the soft time budget is
+    spent so the headline line always lands within the driver's window."""
+    if time.perf_counter() - _BENCH_START > _BENCH_BUDGET:
+        print(f"[bench] {fn.__name__} skipped: budget exhausted", file=sys.stderr)
+        return {"skipped": "budget"}
     t0 = time.perf_counter()
     try:
         out = fn(*args)
