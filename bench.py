@@ -669,11 +669,18 @@ def main() -> None:
         if not ok:
             force_cpu = True
             print("[bench] device-init probe failed or hung; falling back to CPU", file=sys.stderr)
+    import jax
+
     if force_cpu:
         # the config update is the only reliable platform override here
-        import jax
-
         jax.config.update("jax_platforms", "cpu")
+    try:
+        # persistent compile cache: repeated bench runs (and the driver's)
+        # skip recompilation of the big programs (inception, matcher, sweeps)
+        jax.config.update("jax_compilation_cache_dir", os.path.expanduser("~/.cache/metrics_tpu_xla"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
 
     ours_us = bench_collection_ours()
     ref_us = _safe(bench_collection_ref)

@@ -1,0 +1,142 @@
+"""Metric arithmetic / CompositionalMetric matrix.
+
+Reference parity: tests/bases/test_composition.py (554 LoC) — every operator
+overload composes lazily, routes updates to both operands, and computes the
+op over the children's computes. Exercised here over metric-vs-metric,
+metric-vs-scalar, and reflected scalar-vs-metric operands plus the unary set.
+"""
+import operator
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.core.metric import CompositionalMetric
+from tests.helpers.testers import DummyMetricDiff, DummyMetricSum
+
+# (python operator, value-level oracle) — applied to compute() results 5.0 / 3.0
+BINARY_OPS = [
+    ("add", operator.add, lambda a, b: a + b),
+    ("sub", operator.sub, lambda a, b: a - b),
+    ("mul", operator.mul, lambda a, b: a * b),
+    ("truediv", operator.truediv, lambda a, b: a / b),
+    ("floordiv", operator.floordiv, lambda a, b: a // b),
+    ("mod", operator.mod, lambda a, b: a % b),
+    ("pow", operator.pow, lambda a, b: a**b),
+    ("lt", operator.lt, lambda a, b: float(a < b)),
+    ("le", operator.le, lambda a, b: float(a <= b)),
+    ("gt", operator.gt, lambda a, b: float(a > b)),
+    ("ge", operator.ge, lambda a, b: float(a >= b)),
+    ("eq", operator.eq, lambda a, b: float(a == b)),
+    ("ne", operator.ne, lambda a, b: float(a != b)),
+]
+
+A_VAL, B_VAL = 5.0, 3.0
+
+
+def _sum_metric(value):
+    m = DummyMetricSum()
+    m.update(jnp.asarray(value))
+    return m
+
+
+@pytest.mark.parametrize("name,op,oracle", BINARY_OPS, ids=[c[0] for c in BINARY_OPS])
+def test_binary_metric_metric(name, op, oracle):
+    comp = op(_sum_metric(A_VAL), _sum_metric(B_VAL))
+    assert isinstance(comp, CompositionalMetric)
+    np.testing.assert_allclose(float(comp.compute()), oracle(A_VAL, B_VAL))
+
+
+@pytest.mark.parametrize("name,op,oracle", BINARY_OPS, ids=[c[0] for c in BINARY_OPS])
+def test_binary_metric_scalar(name, op, oracle):
+    comp = op(_sum_metric(A_VAL), B_VAL)
+    np.testing.assert_allclose(float(comp.compute()), oracle(A_VAL, B_VAL))
+
+
+@pytest.mark.parametrize("name,op,oracle", BINARY_OPS, ids=[c[0] for c in BINARY_OPS])
+def test_binary_reflected_scalar_metric(name, op, oracle):
+    # scalar OP metric hits the __r<op>__ overloads (except comparisons,
+    # which python resolves by swapping — the oracle swap covers both)
+    comp = op(A_VAL, _sum_metric(B_VAL))
+    np.testing.assert_allclose(float(comp.compute()), oracle(A_VAL, B_VAL))
+
+
+def test_bitwise_ops():
+    a, b = 0b1100, 0b1010
+    ma = DummyMetricSum()
+    ma.x = jnp.asarray(a)
+    mb = DummyMetricSum()
+    mb.x = jnp.asarray(b)
+    assert int((ma & mb).compute()) == a & b
+    assert int((ma | mb).compute()) == a | b
+    assert int((ma ^ mb).compute()) == a ^ b
+    assert int((a & mb).compute()) == a & b
+    assert int((a | mb).compute()) == a | b
+    assert int((a ^ mb).compute()) == a ^ b
+
+
+def test_matmul():
+    ma = DummyMetricSum()
+    ma.x = jnp.asarray([1.0, 2.0])
+    mb = DummyMetricSum()
+    mb.x = jnp.asarray([3.0, 4.0])
+    np.testing.assert_allclose(float((ma @ mb).compute()), 11.0)
+
+
+def test_unary_ops():
+    m = _sum_metric(-A_VAL)
+    np.testing.assert_allclose(float(abs(m).compute()), A_VAL)
+    # reference quirk kept for parity: __neg__ is -abs, __pos__ is abs
+    np.testing.assert_allclose(float((-m).compute()), -A_VAL)
+    np.testing.assert_allclose(float((+m).compute()), A_VAL)
+    mi = DummyMetricSum()
+    mi.x = jnp.asarray(0)
+    assert bool((~mi).compute()) is True
+
+
+def test_getitem():
+    m = DummyMetricSum()
+    m.update(jnp.asarray([1.0, 4.0, 9.0]))
+    np.testing.assert_allclose(float(m[2].compute()), 9.0)
+
+
+def test_update_routes_to_both_children():
+    comp = _sum_metric(0.0) + _sum_metric(0.0)
+    comp.update(jnp.asarray(2.0))
+    comp.update(jnp.asarray(3.0))
+    np.testing.assert_allclose(float(comp.compute()), 10.0)  # both sides saw 5
+
+
+def test_update_filters_kwargs_per_child():
+    # children with different update signatures receive only their kwargs
+    comp = DummyMetricSum() + DummyMetricDiff()
+    comp.update(x=jnp.asarray(4.0), y=jnp.asarray(1.0))
+    np.testing.assert_allclose(float(comp.compute()), 3.0)  # (+4) + (-1)
+
+
+def test_nested_composition():
+    comp = (_sum_metric(A_VAL) + _sum_metric(B_VAL)) / 2.0
+    np.testing.assert_allclose(float(comp.compute()), (A_VAL + B_VAL) / 2)
+
+
+def test_reset_propagates():
+    ma, mb = _sum_metric(A_VAL), _sum_metric(B_VAL)
+    comp = ma + mb
+    comp.reset()
+    np.testing.assert_allclose(float(comp.compute()), 0.0)
+    assert float(ma.x) == 0.0 and float(mb.x) == 0.0
+
+
+def test_forward_composes_batch_values():
+    comp = DummyMetricSum() + DummyMetricSum()
+    out = comp(jnp.asarray(2.0))
+    np.testing.assert_allclose(float(out), 4.0)  # batch value on both sides
+    out = comp(jnp.asarray(3.0))
+    np.testing.assert_allclose(float(out), 6.0)  # forward = batch-only value
+    np.testing.assert_allclose(float(comp.compute()), 10.0)  # compute = accumulated
+
+
+def test_repr_mentions_op_and_children():
+    comp = DummyMetricSum() + DummyMetricSum()
+    text = repr(comp)
+    assert "CompositionalMetric" in text and "add" in text and "DummyMetricSum" in text
