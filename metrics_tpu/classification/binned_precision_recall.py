@@ -6,9 +6,10 @@ Reference parity: torchmetrics/classification/binned_precision_recall.py —
 The reference flags these as the DDP/TPU-friendly alternative to list-state
 curves; here they are also the *compiled-path* curve metrics: fixed
 ``(C, T)`` state, fully jittable update (the reference iterates thresholds in
-a python loop "to conserve memory" — on TPU one broadcast over a
-``(N, C, T)`` compare is a single fused VPU kernel; for very large N XLA
-splits it anyway).
+a python loop "to conserve memory"). The threshold counting dispatches per
+backend: a pallas kernel on TPU that streams ``(N, C)`` tiles through VMEM
+once (ops/classification/binned_pallas.py), the fused XLA ``(N, C, T)``
+broadcast compare elsewhere and under outer jit transforms.
 """
 from __future__ import annotations
 
@@ -19,6 +20,7 @@ from jax import Array
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.ops.classification.average_precision import _average_precision_compute_with_precision_recall
+from metrics_tpu.ops.classification.binned_pallas import binned_stat_counts
 from metrics_tpu.utils.data import METRIC_EPS, to_onehot
 
 
@@ -73,12 +75,13 @@ class BinnedPrecisionRecallCurve(Metric):
             target = to_onehot(target, num_classes=self.num_classes)
         target = target == 1
 
-        # one broadcast compare over (N, C, T): a single fused kernel on TPU
-        predictions = preds[:, :, None] >= self.thresholds[None, None, :]
-        t = target[:, :, None]
-        self.TPs = self.TPs + jnp.sum(t & predictions, axis=0)
-        self.FPs = self.FPs + jnp.sum((~t) & predictions, axis=0)
-        self.FNs = self.FNs + jnp.sum(t & (~predictions), axis=0)
+        # hot op: on TPU a pallas kernel streams (N, C) tiles once and sweeps
+        # thresholds in VMEM (ops/classification/binned_pallas.py); elsewhere
+        # the XLA broadcast compare over (N, C, T)
+        tp, fp, fn = binned_stat_counts(preds, target, self.thresholds)
+        self.TPs = self.TPs + tp
+        self.FPs = self.FPs + fp
+        self.FNs = self.FNs + fn
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
         precisions = (self.TPs + METRIC_EPS) / (self.TPs + self.FPs + METRIC_EPS)

@@ -1,0 +1,137 @@
+"""Pallas TPU kernel for binned threshold counting — the binned-curve hot op.
+
+``BinnedPrecisionRecallCurve.update`` needs, for every class c and threshold
+t, the counts ``TP/FP/FN = sum_n f(target[n,c], preds[n,c] >= thr[t])``. The
+XLA formulation broadcasts a ``(N, C, T)`` compare and reduces over N —
+simple, but the reduction re-reads the ``(N, C)`` inputs once per threshold:
+``T x`` the minimal HBM traffic.
+
+This kernel streams ``(block_n, C)`` tiles of preds/target through VMEM once
+and sweeps the threshold grid in-register (VPU compares + row reductions),
+accumulating directly into the ``(T, C)`` count buffers — input traffic drops
+from ``O(N*C*T)`` to ``O(N*C)``. The TPU grid is sequential, so revisiting
+the same output block across grid steps is the standard accumulation pattern
+(pallas_guide.md: Grid/BlockSpec).
+
+``binned_stat_counts`` dispatches: Pallas on TPU backends (or when
+``METRICS_TPU_PALLAS=1`` forces the interpreter elsewhere), the XLA broadcast
+otherwise. Differential tests in tests/classification/test_binned_pallas.py
+run the kernel in interpret mode against the XLA path.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+try:  # pallas ships with jax; keep the metric importable if it ever doesn't
+    from jax.experimental import pallas as pl
+except Exception:  # pragma: no cover
+    pl = None
+
+_BLOCK_N = 256
+
+
+def _counts_kernel(thr_ref, preds_ref, target_ref, tp_ref, fp_ref, fn_ref):
+    """One grid step: fold a (block_n, C) tile into the (T, C) counters."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        tp_ref[:] = jnp.zeros_like(tp_ref)
+        fp_ref[:] = jnp.zeros_like(fp_ref)
+        fn_ref[:] = jnp.zeros_like(fn_ref)
+
+    p = preds_ref[:]  # (block_n, C) f32; padding rows hold -1.0 (< all thresholds)
+    t = target_ref[:]  # (block_n, C) f32 in {0, 1}; padding rows hold 0
+    n_thresholds = tp_ref.shape[0]
+    t_sum = jnp.sum(t, axis=0)  # (C,) — FN = positives - TP, saves one product
+
+    def body(j, _):
+        th = thr_ref[0, j]
+        pred = (p >= th).astype(jnp.float32)
+        tp = jnp.sum(t * pred, axis=0)
+        fp = jnp.sum(pred, axis=0) - tp
+        tp_ref[pl.ds(j, 1), :] += tp[None, :]
+        fp_ref[pl.ds(j, 1), :] += fp[None, :]
+        fn_ref[pl.ds(j, 1), :] += (t_sum - tp)[None, :]
+        return 0
+
+    jax.lax.fori_loop(0, n_thresholds, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _binned_counts_pallas(preds: Array, target: Array, thresholds: Array, interpret: bool = False):
+    n, c = preds.shape
+    n_thresholds = thresholds.shape[0]
+    pad = (-n) % _BLOCK_N
+    if pad:
+        # -inf preds fall below ANY threshold (users may pass thresholds
+        # outside [0, 1]); 0 targets add nothing
+        preds = jnp.concatenate([preds, jnp.full((pad, c), -jnp.inf, preds.dtype)])
+        target = jnp.concatenate([target, jnp.zeros((pad, c), target.dtype)])
+    grid = (preds.shape[0] // _BLOCK_N,)
+    out_shape = jax.ShapeDtypeStruct((n_thresholds, c), jnp.float32)
+    tp, fp, fn = pl.pallas_call(
+        _counts_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, n_thresholds), lambda i: (0, 0)),
+            pl.BlockSpec((_BLOCK_N, c), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_N, c), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((n_thresholds, c), lambda i: (0, 0)),
+            pl.BlockSpec((n_thresholds, c), lambda i: (0, 0)),
+            pl.BlockSpec((n_thresholds, c), lambda i: (0, 0)),
+        ],
+        out_shape=[out_shape, out_shape, out_shape],
+        interpret=interpret,
+    )(thresholds.reshape(1, -1).astype(jnp.float32), preds.astype(jnp.float32), target.astype(jnp.float32))
+    # state layout is (C, T)
+    return tp.T, fp.T, fn.T
+
+
+def _binned_counts_xla(preds: Array, target_bool: Array, thresholds: Array):
+    """Reference XLA broadcast: one fused (N, C, T) compare + reduce."""
+    predictions = preds[:, :, None] >= thresholds[None, None, :]
+    t = target_bool[:, :, None]
+    tp = jnp.sum(t & predictions, axis=0)
+    fp = jnp.sum((~t) & predictions, axis=0)
+    fn = jnp.sum(t & (~predictions), axis=0)
+    return tp, fp, fn
+
+
+def binned_stat_counts(preds: Array, target_bool: Array, thresholds: Array, use_pallas: str = "auto"):
+    """``(TP, FP, FN)`` of shape ``(C, T)`` from ``(N, C)`` scores/targets.
+
+    ``use_pallas``: ``"auto"`` (TPU backends only), ``"force"`` (interpret
+    mode off-TPU — for tests), ``"never"``.
+    """
+    env = os.environ.get("METRICS_TPU_PALLAS")
+    if use_pallas == "auto" and env is not None:
+        use_pallas = "never" if env in ("0", "never") else "force"
+    if preds.shape[0] == 0:
+        # zero grid steps would skip the kernel's init; the counts are zeros
+        shape = (preds.shape[1], thresholds.shape[0])
+        return jnp.zeros(shape), jnp.zeros(shape), jnp.zeros(shape)
+    on_tpu = jax.default_backend() not in ("cpu", "gpu")
+    # auto mode stays on XLA under an outer trace (jit/vmap/shard_map of
+    # update_state): a pallas lowering failure there would surface at the
+    # OUTER compile, past the fallback below; eager facade updates — the
+    # common stateful-loop usage — get the kernel. "force" keeps it under
+    # tracing for tests and for users who have validated their shapes.
+    tracing = isinstance(preds, jax.core.Tracer)
+    if use_pallas == "never" or (use_pallas == "auto" and (not on_tpu or tracing)) or pl is None:
+        return _binned_counts_xla(preds, target_bool, thresholds)
+    interpret = not on_tpu
+    try:
+        return _binned_counts_pallas(preds, target_bool.astype(jnp.float32), thresholds, interpret=interpret)
+    except Exception:  # lowering/compile failure on an untested shape: stay correct
+        from metrics_tpu.utils.prints import rank_zero_warn
+
+        rank_zero_warn("pallas binned-count kernel failed to compile; falling back to the XLA path.")
+        return _binned_counts_xla(preds, target_bool, thresholds)
