@@ -80,7 +80,17 @@ class BaseAggregator(Metric):
 
 
 class MaxMetric(BaseAggregator):
-    """Running max. Reference: aggregation.py:94-141."""
+    """Running max. Reference: aggregation.py:94-141.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MaxMetric
+        >>> metric = MaxMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.asarray([2.0, 3.0]))
+        >>> float(metric.compute())
+        3.0
+    """
 
     full_state_update = True
 
@@ -110,7 +120,17 @@ class MinMetric(BaseAggregator):
 
 
 class SumMetric(BaseAggregator):
-    """Running sum. Reference: aggregation.py:192-238."""
+    """Running sum. Reference: aggregation.py:192-238.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SumMetric
+        >>> metric = SumMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.asarray([2.0, 3.0]))
+        >>> float(metric.compute())
+        6.0
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)

@@ -13,7 +13,18 @@ from metrics_tpu.ops.audio.snr import scale_invariant_signal_noise_ratio, signal
 
 
 class SignalNoiseRatio(_MeanAudioMetric):
-    """SNR. Reference: audio/snr.py:22-95."""
+    """SNR. Reference: audio/snr.py:22-95.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SignalNoiseRatio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> snr = SignalNoiseRatio()
+        >>> snr.update(preds, target)
+        >>> round(float(snr.compute()), 4)
+        16.1805
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -11,6 +11,17 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class AUC(Metric):
+    """Trapezoidal area under (x, y) pairs. Reference: classification/auc.py:24.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AUC
+        >>> auc = AUC(reorder=True)
+        >>> auc.update(jnp.asarray([0, 1, 2, 3]), jnp.asarray([0, 1, 2, 2]))
+        >>> round(float(auc.compute()), 4)
+        4.0
+    """
+
     is_differentiable = False
     higher_is_better = None
     full_state_update: bool = False

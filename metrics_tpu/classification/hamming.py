@@ -11,6 +11,19 @@ from metrics_tpu.ops.classification.hamming import _hamming_distance_compute, _h
 
 
 class HammingDistance(Metric):
+    """Share of wrong labels. Reference: classification/hamming.py:23.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import HammingDistance
+        >>> target = jnp.asarray([[0, 1], [1, 1]])
+        >>> preds = jnp.asarray([[0, 1], [0, 1]])
+        >>> hamming = HammingDistance()
+        >>> hamming.update(preds, target)
+        >>> round(float(hamming.compute()), 4)
+        0.25
+    """
+
     is_differentiable = False
     higher_is_better = False
     full_state_update: bool = False

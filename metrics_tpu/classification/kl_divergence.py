@@ -12,6 +12,19 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class KLDivergence(Metric):
+    """KL(P || Q) over distribution batches. Reference: kl_divergence.py:25.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import KLDivergence
+        >>> p = jnp.asarray([[0.36, 0.48, 0.16]])
+        >>> q = jnp.asarray([[1 / 3, 1 / 3, 1 / 3]])
+        >>> kl = KLDivergence()
+        >>> kl.update(p, q)
+        >>> round(float(kl.compute()), 4)
+        0.0853
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update: bool = False

@@ -11,6 +11,19 @@ from metrics_tpu.ops.classification.matthews_corrcoef import _matthews_corrcoef_
 
 
 class MatthewsCorrCoef(Metric):
+    """Matthews correlation coefficient. Reference: matthews_corrcoef.py:26.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MatthewsCorrCoef
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> matthews = MatthewsCorrCoef(num_classes=2)
+        >>> matthews.update(preds, target)
+        >>> round(float(matthews.compute()), 4)
+        0.5774
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update: bool = False

@@ -10,7 +10,18 @@ from metrics_tpu.ops.classification.specificity import _specificity_compute
 
 
 class Specificity(_PrecisionRecallBase):
-    """TN / (TN + FP)."""
+    """TN / (TN + FP). Reference: classification/specificity.py:23.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Specificity
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> specificity = Specificity(average="macro", num_classes=3)
+        >>> specificity.update(preds, target)
+        >>> round(float(specificity.compute()), 4)
+        0.6111
+    """
 
     def compute(self) -> Array:
         tp, fp, tn, fn = self._get_final_stats()

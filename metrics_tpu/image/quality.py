@@ -20,7 +20,17 @@ from metrics_tpu.ops.image.uqi import _uqi_check_inputs, _uqi_compute
 
 
 class UniversalImageQualityIndex(_ImagePairMetric):
-    """UQI. Reference: image/uqi.py:25-100."""
+    """UQI. Reference: image/uqi.py:25-100.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import UniversalImageQualityIndex
+        >>> imgs = jnp.linspace(0.0, 1.0, 2 * 1 * 16 * 16).reshape(2, 1, 16, 16)
+        >>> uqi = UniversalImageQualityIndex()
+        >>> uqi.update(imgs, imgs)
+        >>> round(float(uqi.compute()), 4)
+        1.0
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -95,7 +95,18 @@ class MeanAbsoluteError(Metric):
 
 
 class MeanSquaredLogError(Metric):
-    """MSLE. Reference: regression/log_mse.py:23-78."""
+    """MSLE. Reference: regression/log_mse.py:23-78.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredLogError
+        >>> target = jnp.asarray([2.5, 5.0, 4.0, 8.0])
+        >>> preds = jnp.asarray([3.0, 5.0, 2.5, 7.0])
+        >>> msle = MeanSquaredLogError()
+        >>> msle.update(preds, target)
+        >>> round(float(msle.compute()), 4)
+        0.0397
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -116,7 +127,18 @@ class MeanSquaredLogError(Metric):
 
 
 class MeanAbsolutePercentageError(Metric):
-    """MAPE. Reference: regression/mape.py:26-85."""
+    """MAPE. Reference: regression/mape.py:26-85.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanAbsolutePercentageError
+        >>> target = jnp.asarray([1.0, 10.0, 1e6])
+        >>> preds = jnp.asarray([0.9, 15.0, 1.2e6])
+        >>> mape = MeanAbsolutePercentageError()
+        >>> mape.update(preds, target)
+        >>> round(float(mape.compute()), 4)
+        0.2667
+    """
 
     is_differentiable = True
     higher_is_better = False

@@ -183,7 +183,18 @@ class R2Score(Metric):
 
 
 class ExplainedVariance(Metric):
-    """Explained variance. Reference: regression/explained_variance.py:26-106."""
+    """Explained variance. Reference: regression/explained_variance.py:26-106.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ExplainedVariance
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> ev = ExplainedVariance()
+        >>> ev.update(preds, target)
+        >>> round(float(ev.compute()), 4)
+        0.9572
+    """
 
     is_differentiable = True
     higher_is_better = True

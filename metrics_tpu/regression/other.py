@@ -21,6 +21,19 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class CosineSimilarity(Metric):
+    """Row-wise cosine similarity. Reference: regression/cosine_similarity.py:25.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CosineSimilarity
+        >>> target = jnp.asarray([[0.0, 1.0], [1.0, 1.0]])
+        >>> preds = jnp.asarray([[0.0, 1.0], [0.0, 1.0]])
+        >>> cosine = CosineSimilarity(reduction="mean")
+        >>> cosine.update(preds, target)
+        >>> round(float(cosine.compute()), 4)
+        0.8536
+    """
+
     is_differentiable = True
     higher_is_better = True
     full_state_update = False

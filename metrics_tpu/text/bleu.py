@@ -59,7 +59,17 @@ class BLEUScore(Metric):
 
 
 class SacreBLEUScore(BLEUScore):
-    """Corpus BLEU with mteval tokenizers. Reference: text/sacre_bleu.py:32-112."""
+    """Corpus BLEU with mteval tokenizers. Reference: text/sacre_bleu.py:32-112.
+
+    Example:
+        >>> from metrics_tpu import SacreBLEUScore
+        >>> preds = ["the cat is on the mat"]
+        >>> target = [["there is a cat on the mat", "a cat is on the mat"]]
+        >>> sacre_bleu = SacreBLEUScore()
+        >>> sacre_bleu.update(preds, target)
+        >>> round(float(sacre_bleu.compute()), 4)
+        0.7598
+    """
 
     def __init__(
         self,

@@ -16,7 +16,17 @@ from metrics_tpu.ops.text.chrf import _chrf_score_compute, _chrf_score_update
 
 
 class CHRFScore(Metric):
-    """chrF / chrF++. Reference: text/chrf.py:46-162."""
+    """chrF / chrF++. Reference: text/chrf.py:46-162.
+
+    Example:
+        >>> from metrics_tpu import CHRFScore
+        >>> preds = ["the cat is on the mat"]
+        >>> target = [["there is a cat on the mat"]]
+        >>> chrf = CHRFScore()
+        >>> chrf.update(preds, target)
+        >>> round(float(chrf.compute()), 4)
+        0.4942
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -14,7 +14,17 @@ from metrics_tpu.ops.text.squad import PREDS_TYPE, TARGETS_TYPE, _squad_compute,
 
 
 class SQuAD(Metric):
-    """SQuAD EM/F1. Reference: text/squad.py:29-92."""
+    """SQuAD EM/F1. Reference: text/squad.py:29-92.
+
+    Example:
+        >>> from metrics_tpu import SQuAD
+        >>> preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
+        >>> target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
+        >>> squad = SQuAD()
+        >>> squad.update(preds, target)
+        >>> {k: round(float(v), 1) for k, v in squad.compute().items()}
+        {'exact_match': 100.0, 'f1': 100.0}
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -14,7 +14,17 @@ from metrics_tpu.ops.text.ter import _TercomTokenizer, _ter_compute, _ter_update
 
 
 class TranslationEditRate(Metric):
-    """TER. Reference: text/ter.py:24-119."""
+    """TER. Reference: text/ter.py:24-119.
+
+    Example:
+        >>> from metrics_tpu import TranslationEditRate
+        >>> preds = ["the cat is on the mat"]
+        >>> target = [["there is a cat on the mat", "a cat is on the mat"]]
+        >>> ter = TranslationEditRate()
+        >>> ter.update(preds, target)
+        >>> round(float(ter.compute()), 4)
+        0.1538
+    """
 
     is_differentiable = False
     higher_is_better = False

@@ -12,6 +12,18 @@ import pytest
 
 MODULES = [
     "metrics_tpu.aggregation",
+    "metrics_tpu.audio.snr",
+    "metrics_tpu.classification.auc",
+    "metrics_tpu.classification.dice",
+    "metrics_tpu.classification.hamming",
+    "metrics_tpu.classification.kl_divergence",
+    "metrics_tpu.classification.matthews_corrcoef",
+    "metrics_tpu.classification.specificity",
+    "metrics_tpu.image.quality",
+    "metrics_tpu.regression.other",
+    "metrics_tpu.text.chrf",
+    "metrics_tpu.text.squad",
+    "metrics_tpu.text.ter",
     "metrics_tpu.classification.accuracy",
     "metrics_tpu.classification.auroc",
     "metrics_tpu.classification.cohen_kappa",
