@@ -12,6 +12,17 @@ from metrics_tpu.core.metric import Metric
 
 
 class ClasswiseWrapper(Metric):
+    """Unroll a ``average=None`` metric's output into a per-class dict. Reference: wrappers/classwise.py:8.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, ClasswiseWrapper
+        >>> wrapped = ClasswiseWrapper(Accuracy(num_classes=3, average=None), labels=["a", "b", "c"])
+        >>> wrapped.update(jnp.asarray([0, 1, 2, 0]), jnp.asarray([0, 1, 1, 0]))
+        >>> {k: round(float(v), 2) for k, v in wrapped.compute().items()}
+        {'accuracy_a': 1.0, 'accuracy_b': 0.5, 'accuracy_c': 0.0}
+    """
+
     full_state_update: Optional[bool] = True
 
     def __init__(self, metric: Metric, labels: Optional[List[str]] = None) -> None:

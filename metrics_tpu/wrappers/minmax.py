@@ -13,6 +13,20 @@ from metrics_tpu.core.metric import Metric
 
 
 class MinMaxMetric(Metric):
+    """Track the min/max of a base metric's compute over time. Reference: wrappers/minmax.py:23.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, MinMaxMetric
+        >>> wrapped = MinMaxMetric(Accuracy())
+        >>> wrapped.update(jnp.asarray([1, 0, 1, 1]), jnp.asarray([1, 1, 1, 1]))
+        >>> {k: round(float(v), 2) for k, v in wrapped.compute().items()}
+        {'raw': 0.75, 'max': 0.75, 'min': 0.75}
+        >>> wrapped.update(jnp.asarray([1, 1, 1, 1]), jnp.asarray([1, 1, 1, 1]))
+        >>> {k: round(float(v), 2) for k, v in wrapped.compute().items()}
+        {'raw': 0.88, 'max': 0.88, 'min': 0.75}
+    """
+
     full_state_update: bool = True
 
     def __init__(self, base_metric: Metric, **kwargs: Any) -> None:

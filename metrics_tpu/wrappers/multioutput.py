@@ -29,6 +29,19 @@ def _get_nan_indices(*tensors: Array) -> Array:
 
 
 class MultioutputWrapper(Metric):
+    """Per-output clones of a base metric over the last dim. Reference: wrappers/multioutput.py:24.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredError, MultioutputWrapper
+        >>> target = jnp.asarray([[1.0, 10.0], [2.0, 20.0]])
+        >>> preds = jnp.asarray([[1.0, 11.0], [2.0, 22.0]])
+        >>> mse = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        >>> mse.update(preds, target)
+        >>> [round(float(v), 2) for v in mse.compute()]
+        [0.0, 2.5]
+    """
+
     is_differentiable = False
 
     def __init__(
