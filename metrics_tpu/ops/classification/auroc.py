@@ -13,6 +13,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.ops.classification.auc import _auc_compute_without_check
+from metrics_tpu.ops.classification.precision_recall_curve import _raise_if_traced
 from metrics_tpu.ops.classification.roc import roc
 from metrics_tpu.utils.checks import _input_format_classification
 from metrics_tpu.utils.data import bincount
@@ -44,6 +45,7 @@ def _auroc_compute(
 ) -> Array:
     """Reference: auroc.py:52-194 (incl. unobserved-class exclusion and the
     McClish-corrected partial AUC)."""
+    _raise_if_traced(preds, target)  # exact-curve math: eager-only by design
     if mode == DataType.BINARY:
         num_classes = 1
 

@@ -1,0 +1,90 @@
+"""jit-vs-eager parity sweep over the classification functional surface.
+
+The canonicalization machine splits static shape dispatch (always traceable)
+from value checks (eager-only) — utils/checks.py. This sweep asserts that,
+with ``num_classes`` given, jitting each functional neither raises nor
+changes the result on any input type. Tracer leaks (python branches on
+concrete values, host round-trips) fail loudly here.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import ops
+from tests.classification.inputs import (
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multidim_multiclass,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES
+
+_MC = dict(num_classes=NUM_CLASSES)
+
+CASES = [
+    ("accuracy_mc_prob", lambda p, t: ops.accuracy(p, t, **_MC), _input_multiclass_prob),
+    ("accuracy_mc_labels", lambda p, t: ops.accuracy(p, t, **_MC), _input_multiclass),
+    ("accuracy_mdmc", lambda p, t: ops.accuracy(p, t, mdmc_average="global", **_MC), _input_multidim_multiclass),
+    ("accuracy_binary", lambda p, t: ops.accuracy(p, t, num_classes=1), _input_binary_prob),
+    ("accuracy_multilabel", lambda p, t: ops.accuracy(p, t), _input_multilabel_prob),
+    ("accuracy_top2", lambda p, t: ops.accuracy(p, t, top_k=2, **_MC), _input_multiclass_prob),
+    ("f1_macro", lambda p, t: ops.f1_score(p, t, average="macro", **_MC), _input_multiclass_prob),
+    ("fbeta_weighted", lambda p, t: ops.fbeta_score(p, t, beta=0.5, average="weighted", **_MC), _input_multiclass_prob),
+    ("precision_none", lambda p, t: ops.precision(p, t, average="none", **_MC), _input_multiclass_prob),
+    ("recall_samples", lambda p, t: ops.recall(p, t, average="samples", **_MC), _input_multilabel_prob),
+    ("specificity", lambda p, t: ops.specificity(p, t, average="macro", **_MC), _input_multiclass_prob),
+    ("stat_scores", lambda p, t: ops.stat_scores(p, t, reduce="macro", **_MC), _input_multiclass_prob),
+    ("stat_scores_ignore", lambda p, t: ops.stat_scores(p, t, reduce="macro", ignore_index=0, **_MC), _input_multiclass),
+    ("dice", lambda p, t: ops.dice(p, t, **_MC), _input_multiclass),
+    ("hamming", lambda p, t: ops.hamming_distance(p, t), _input_multilabel_prob),
+    ("confusion_matrix", lambda p, t: ops.confusion_matrix(p, t, **_MC), _input_multiclass),
+    ("confmat_normalized", lambda p, t: ops.confusion_matrix(p, t, normalize="true", **_MC), _input_multiclass),
+    ("cohen_kappa", lambda p, t: ops.cohen_kappa(p, t, **_MC), _input_multiclass),
+    ("jaccard", lambda p, t: ops.jaccard_index(p, t, **_MC), _input_multiclass),
+    ("matthews", lambda p, t: ops.matthews_corrcoef(p, t, **_MC), _input_multiclass),
+    ("hinge", lambda p, t: ops.hinge_loss(p, (t > 0).astype(np.int32)), _input_binary_prob),
+    ("kl_div", lambda p, t: ops.kl_divergence(p, jnp.roll(p, 1, axis=0)), _input_multiclass_prob),
+    ("calibration", lambda p, t: ops.calibration_error(p, t), _input_binary_prob),
+]
+
+
+@pytest.mark.parametrize("name,fn,fixture", CASES, ids=[c[0] for c in CASES])
+def test_jit_matches_eager(name, fn, fixture):
+    preds = jnp.asarray(fixture.preds[0])
+    target = jnp.asarray(fixture.target[0])
+    eager = fn(preds, target)
+    jitted = jax.jit(fn)(preds, target)
+    np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager), rtol=1e-6, atol=1e-6)
+
+
+def test_curve_functionals_raise_actionably_under_jit():
+    """Exact curves are eager-only by design (data-dependent shapes); under
+    jit they must raise the actionable pointer to the Binned* variants, not
+    an opaque tracer error."""
+    from metrics_tpu.utils.exceptions import MetricsUserError
+
+    preds = jnp.asarray(_input_binary_prob.preds[0])
+    target = jnp.asarray(_input_binary_prob.target[0])
+    for fn in (
+        lambda p, t: ops.auroc(p, t, pos_label=1),
+        lambda p, t: ops.average_precision(p, t, pos_label=1),
+        lambda p, t: ops.roc(p, t, pos_label=1),
+    ):
+        fn(preds, target)  # eager path stays fine
+        with pytest.raises(MetricsUserError, match="Binned"):
+            jax.jit(fn)(preds, target)
+
+
+def test_weighted_multiclass_auroc_raises_actionably_under_jit():
+    from metrics_tpu.utils.exceptions import MetricsUserError
+
+    preds = jnp.asarray(_input_multiclass_prob.preds[0])
+    target = jnp.asarray(_input_multiclass_prob.target[0])
+    fn = lambda p, t: ops.auroc(p, t, num_classes=NUM_CLASSES, average="weighted")
+    fn(preds, target)  # eager fine
+    with pytest.raises(MetricsUserError, match="Binned"):
+        jax.jit(fn)(preds, target)
