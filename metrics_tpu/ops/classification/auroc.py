@@ -13,8 +13,8 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.ops.classification.auc import _auc_compute_without_check
-from metrics_tpu.ops.classification.precision_recall_curve import _raise_if_traced
 from metrics_tpu.ops.classification.roc import roc
+from metrics_tpu.utils.checks import _raise_if_traced_dynamic_shape as _raise_if_traced
 from metrics_tpu.utils.checks import _input_format_classification
 from metrics_tpu.utils.data import bincount
 from metrics_tpu.utils.enums import AverageMethod, DataType

@@ -19,21 +19,8 @@ from typing import List, Optional, Sequence, Tuple, Union
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.utils.checks import _is_concrete
-from metrics_tpu.utils.exceptions import MetricsUserError
+from metrics_tpu.utils.checks import _raise_if_traced_dynamic_shape as _raise_if_traced
 from metrics_tpu.utils.prints import rank_zero_warn
-
-
-def _raise_if_traced(*arrays: Array) -> None:
-    """Exact curves are eager-only (data-dependent shapes); raise an
-    actionable error instead of an opaque tracer failure under jit."""
-    if not _is_concrete(*arrays):
-        raise MetricsUserError(
-            "Exact ROC/PR curves (and metrics built on them, e.g. AUROC, AveragePrecision) have"
-            " data-dependent output shapes and cannot run under jit. Compute them outside the"
-            " compiled step (buffered `update_state` still jits with `buffer_capacity=`), or use"
-            " the fixed-shape Binned* curve variants inside compiled programs."
-        )
 
 
 def _binary_clf_curve(

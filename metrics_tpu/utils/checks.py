@@ -28,9 +28,48 @@ from metrics_tpu.utils.data import select_topk, to_onehot
 from metrics_tpu.utils.enums import DataType
 
 
+try:  # resolved once: per-call failure would silently revert the trace guard
+    from jax._src.core import trace_state_clean as _trace_state_clean
+except ImportError:  # pragma: no cover - private API moved; degrade loudly at import
+    import warnings
+
+    warnings.warn(
+        "jax._src.core.trace_state_clean is unavailable; value checks on concrete"
+        " closure constants inside jit may raise tracer errors instead of skipping."
+    )
+
+    def _trace_state_clean() -> bool:
+        return True
+
+
+def _tracing_active() -> bool:
+    """True while any jit/vmap/grad trace is being staged. Ops on CONCRETE
+    arrays still yield tracers inside a trace (closure constants get lifted),
+    so argument types alone cannot tell whether ``bool(jnp.any(...))`` is
+    safe."""
+    return not _trace_state_clean()
+
+
 def _is_concrete(*arrays: Array) -> bool:
     """True when value-dependent checks are possible (not under jit tracing)."""
-    return not any(isinstance(a, jax.core.Tracer) for a in arrays)
+    if any(isinstance(a, jax.core.Tracer) for a in arrays):
+        return False
+    return not _tracing_active()
+
+
+def _raise_if_traced_dynamic_shape(*arrays: Array) -> None:
+    """Guard for eager-only ops whose OUTPUT shape depends on data (exact
+    ROC/PR curves and metrics built on them): raise an actionable error
+    instead of an opaque tracer failure under jit."""
+    if not _is_concrete(*arrays):
+        from metrics_tpu.utils.exceptions import MetricsUserError
+
+        raise MetricsUserError(
+            "Exact ROC/PR curves (and metrics built on them, e.g. AUROC, AveragePrecision) have"
+            " data-dependent output shapes and cannot run under jit. Compute them outside the"
+            " compiled step (buffered `update_state` still jits with `buffer_capacity=`), or use"
+            " the fixed-shape Binned* curve variants inside compiled programs."
+        )
 
 
 def _is_floating(x: Array) -> bool:

@@ -1,0 +1,60 @@
+"""jit-vs-eager parity across the regression/image/audio/pairwise functional
+surface — the compiled-path guarantee beyond classification
+(tests/classification/test_jit_parity.py covers that domain).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import ops
+
+_rng = np.random.default_rng(53)
+
+_P = jnp.asarray((0.2 + _rng.random(24)).astype(np.float32))
+_T = jnp.asarray((0.2 + _rng.random(24)).astype(np.float32))
+_P2 = jnp.asarray((0.2 + _rng.random((8, 6))).astype(np.float32))
+_T2 = jnp.asarray((0.2 + _rng.random((8, 6))).astype(np.float32))
+_IMG_P = jnp.asarray(_rng.random((2, 3, 16, 16)).astype(np.float32))
+_IMG_T = jnp.asarray(_rng.random((2, 3, 16, 16)).astype(np.float32))
+_AUD_T = jnp.asarray(_rng.normal(size=(2, 2000)).astype(np.float32))
+_AUD_P = _AUD_T + 0.3 * jnp.asarray(_rng.normal(size=(2, 2000)).astype(np.float32))
+_MIX_T = jnp.asarray(_rng.normal(size=(2, 3, 1500)).astype(np.float32))  # (B, S, T)
+_MIX_P = _MIX_T[:, ::-1] + 0.2 * jnp.asarray(_rng.normal(size=(2, 3, 1500)).astype(np.float32))
+
+CASES = [
+    ("mse", lambda: ops.mean_squared_error(_P, _T)),
+    ("mae", lambda: ops.mean_absolute_error(_P, _T)),
+    ("msle", lambda: ops.mean_squared_log_error(_P, _T)),
+    ("mape", lambda: ops.mean_absolute_percentage_error(_P, _T)),
+    ("smape", lambda: ops.symmetric_mean_absolute_percentage_error(_P, _T)),
+    ("wmape", lambda: ops.weighted_mean_absolute_percentage_error(_P, _T)),
+    ("explained_variance", lambda: ops.explained_variance(_P, _T)),
+    ("r2", lambda: ops.r2_score(_P, _T)),
+    ("pearson", lambda: ops.pearson_corrcoef(_P, _T)),
+    ("spearman", lambda: ops.spearman_corrcoef(_P, _T)),
+    ("cosine", lambda: ops.cosine_similarity(_P2, _T2)),
+    ("tweedie", lambda: ops.tweedie_deviance_score(_P, _T, power=1.5)),
+    ("psnr", lambda: ops.peak_signal_noise_ratio(_IMG_P, _IMG_T, data_range=1.0)),
+    ("ssim", lambda: ops.structural_similarity_index_measure(_IMG_P, _IMG_T, data_range=1.0)),
+    ("uqi", lambda: ops.universal_image_quality_index(_IMG_P, _IMG_T)),
+    ("sam", lambda: ops.spectral_angle_mapper(_IMG_P, _IMG_T)),
+    ("ergas", lambda: ops.error_relative_global_dimensionless_synthesis(_IMG_P, _IMG_T)),
+    ("d_lambda", lambda: ops.spectral_distortion_index(_IMG_P, _IMG_T)),
+    ("snr", lambda: ops.signal_noise_ratio(_AUD_P, _AUD_T)),
+    ("si_snr", lambda: ops.scale_invariant_signal_noise_ratio(_AUD_P, _AUD_T)),
+    ("si_sdr", lambda: ops.scale_invariant_signal_distortion_ratio(_AUD_P, _AUD_T)),
+    ("sdr", lambda: ops.signal_distortion_ratio(_AUD_P, _AUD_T)),
+    ("pit", lambda: ops.permutation_invariant_training(_MIX_P, _MIX_T, ops.scale_invariant_signal_noise_ratio)[0]),
+    ("pairwise_cosine", lambda: ops.pairwise_cosine_similarity(_P2, _T2)),
+    ("pairwise_euclidean", lambda: ops.pairwise_euclidean_distance(_P2, _T2)),
+    ("pairwise_linear", lambda: ops.pairwise_linear_similarity(_P2, _T2)),
+    ("pairwise_manhattan", lambda: ops.pairwise_manhattan_distance(_P2, _T2)),
+]
+
+
+@pytest.mark.parametrize("name,thunk", CASES, ids=[c[0] for c in CASES])
+def test_jit_matches_eager(name, thunk):
+    eager = thunk()
+    jitted = jax.jit(thunk)()
+    np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager), rtol=2e-5, atol=1e-5)
