@@ -579,6 +579,38 @@ def bench_retrieval() -> dict:
     return {"docs": n, "compiled_compute_ms": compiled_ms, "eager_compute_ms": eager_ms, "speedup": eager_ms / compiled_ms}
 
 
+def bench_binned_curve() -> dict:
+    """Binned PR-curve update: pallas kernel vs XLA broadcast (the kernel only
+    engages on TPU backends; elsewhere only the XLA number is reported)."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.ops.classification.binned_pallas import binned_stat_counts
+
+    n, c, t = 4096, 128, 101
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.uniform(size=(n, c)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 2, size=(n, c)).astype(bool))
+    thresholds = jnp.linspace(0.0, 1.0, t)
+
+    def timed(mode):
+        # jit both paths: the comparison is compiled-kernel vs the FUSED XLA
+        # program jitted pipelines actually run, not eager dispatch
+        fn = jax.jit(lambda p, t: binned_stat_counts(p, t, thresholds, use_pallas=mode))
+        jax.block_until_ready(fn(preds, target))  # compile
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(preds, target))
+            best = min(best, time.perf_counter() - t0)
+        return best * 1e6
+
+    out = {"n": n, "classes": c, "thresholds": t, "xla_us": timed("never")}
+    if jax.default_backend() not in ("cpu", "gpu"):
+        out["pallas_us"] = timed("force")
+    return out
+
+
 def bench_catbuffer_auroc() -> dict:
     import jax
     import jax.numpy as jnp
@@ -708,6 +740,7 @@ def main() -> None:
         },
         "retrieval_compiled_50k_docs": _safe(bench_retrieval),
         "catbuffer_auroc": _safe(bench_catbuffer_auroc),
+        "binned_curve_counts": _safe(bench_binned_curve),
     }
 
     import jax

@@ -63,6 +63,11 @@ def ensure_virtual_devices(n: int, prefer_existing: bool = True) -> bool:
     """
     import os
 
+    if not backend_initialized() and "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
+        # the caller already forces virtual host devices (the driver's
+        # documented invocation): honor it WITHOUT probing the accelerator —
+        # a wedged/slow device tunnel must not hang a CPU-mesh dry-run
+        prefer_existing = False
     if backend_initialized() or prefer_existing:
         return len(jax.devices()) >= n
     flag = f"--xla_force_host_platform_device_count={n}"
