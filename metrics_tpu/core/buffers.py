@@ -34,13 +34,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array, lax
 
+from metrics_tpu.utils.checks import _is_traced
 from metrics_tpu.utils.exceptions import MetricsUserError
 
 __all__ = ["CatBuffer"]
-
-
-def _is_traced(x: Any) -> bool:
-    return isinstance(x, jax.core.Tracer)
 
 
 @jax.tree_util.register_pytree_node_class

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.utils.checks import _is_traced
+
 try:  # pallas ships with jax; keep the metric importable if it ever doesn't
     from jax.experimental import pallas as pl
 except Exception:  # pragma: no cover
@@ -124,7 +126,7 @@ def binned_stat_counts(preds: Array, target_bool: Array, thresholds: Array, use_
     # OUTER compile, past the fallback below; eager facade updates — the
     # common stateful-loop usage — get the kernel. "force" keeps it under
     # tracing for tests and for users who have validated their shapes.
-    tracing = isinstance(preds, jax.core.Tracer)
+    tracing = _is_traced(preds)
     if use_pallas == "never" or (use_pallas == "auto" and (not on_tpu or tracing)) or pl is None:
         return _binned_counts_xla(preds, target_bool, thresholds)
     interpret = not on_tpu

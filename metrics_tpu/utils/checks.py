@@ -50,6 +50,12 @@ def _tracing_active() -> bool:
     return not _trace_state_clean()
 
 
+def _is_traced(x) -> bool:
+    """Single-value tracer predicate (cf. ``_is_concrete``, which additionally
+    accounts for ambient trace state when deciding whether value checks run)."""
+    return isinstance(x, jax.core.Tracer)
+
+
 def _is_concrete(*arrays: Array) -> bool:
     """True when value-dependent checks are possible (not under jit tracing)."""
     if any(isinstance(a, jax.core.Tracer) for a in arrays):
