@@ -51,6 +51,19 @@ def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optio
 
 
 class BootStrapper(Metric):
+    """Bootstrap resampling over a base metric: one vmap-stacked state instead
+    of the reference's N module copies (wrappers/bootstrapping.py:49).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, BootStrapper
+        >>> boot = BootStrapper(Accuracy(num_classes=5), num_bootstraps=20, seed=0)
+        >>> boot.update(jnp.asarray([0, 1, 2, 3, 4]), jnp.asarray([0, 1, 2, 3, 3]))
+        >>> out = boot.compute()
+        >>> sorted(out) == ["mean", "std"] and bool(0.0 <= out["mean"] <= 1.0)
+        True
+    """
+
     full_state_update: bool = True
 
     def __init__(

@@ -18,7 +18,21 @@ from metrics_tpu.utils.prints import rank_zero_warn
 
 
 class MetricTracker:
-    """Keeps one copy of the base metric per ``increment()`` call."""
+    """Keeps one copy of the base metric per ``increment()`` call.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, MetricTracker
+        >>> tracker = MetricTracker(Accuracy(num_classes=3))
+        >>> for epoch_preds in ([0, 1, 1], [0, 1, 2]):
+        ...     tracker.increment()
+        ...     tracker.update(jnp.asarray(epoch_preds), jnp.asarray([0, 1, 2]))
+        >>> [round(float(v), 4) for v in tracker.compute_all()]
+        [0.6667, 1.0]
+        >>> step, best = tracker.best_metric(return_step=True)
+        >>> step, round(float(best), 2)
+        (1, 1.0)
+    """
 
     def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
         if not isinstance(metric, (Metric, MetricCollection)):

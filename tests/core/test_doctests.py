@@ -25,8 +25,10 @@ MODULES = [
     "metrics_tpu.text.squad",
     "metrics_tpu.text.ter",
     "metrics_tpu.wrappers.classwise",
+    "metrics_tpu.wrappers.bootstrapping",
     "metrics_tpu.wrappers.minmax",
     "metrics_tpu.wrappers.multioutput",
+    "metrics_tpu.wrappers.tracker",
     "metrics_tpu.classification.accuracy",
     "metrics_tpu.classification.auroc",
     "metrics_tpu.classification.cohen_kappa",
