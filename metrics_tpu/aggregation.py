@@ -105,7 +105,17 @@ class MaxMetric(BaseAggregator):
 
 
 class MinMetric(BaseAggregator):
-    """Running min. Reference: aggregation.py:143-190."""
+    """Running min. Reference: aggregation.py:143-190.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MinMetric
+        >>> metric = MinMetric()
+        >>> metric.update(jnp.asarray([2.0, 1.0]))
+        >>> metric.update(jnp.asarray(3.0))
+        >>> float(metric.compute())
+        1.0
+    """
 
     full_state_update = True
 
@@ -142,7 +152,17 @@ class SumMetric(BaseAggregator):
 
 
 class CatMetric(BaseAggregator):
-    """Concatenate all seen values. Reference: aggregation.py:240-288."""
+    """Concatenate all seen values. Reference: aggregation.py:240-288.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CatMetric
+        >>> metric = CatMetric()
+        >>> metric.update(jnp.asarray([1.0, 2.0]))
+        >>> metric.update(jnp.asarray(3.0))
+        >>> metric.compute().tolist()
+        [1.0, 2.0, 3.0]
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("cat", [], nan_strategy, **kwargs)

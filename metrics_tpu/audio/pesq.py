@@ -15,7 +15,17 @@ from metrics_tpu.ops.audio.pesq import _PESQ_AVAILABLE, perceptual_evaluation_sp
 
 
 class PerceptualEvaluationSpeechQuality(_MeanAudioMetric):
-    """PESQ. Reference: audio/pesq.py:25."""
+    """PESQ. Reference: audio/pesq.py:25.
+
+    Requires the ``pesq`` C-extension package; construction raises an
+    actionable error when it is absent (same gate as the reference).
+
+    Example:
+        >>> from metrics_tpu import PerceptualEvaluationSpeechQuality
+        >>> from metrics_tpu.ops.audio.pesq import _PESQ_AVAILABLE   # availability gate
+        >>> nb_pesq = PerceptualEvaluationSpeechQuality(8000, 'nb')  # doctest: +SKIP
+        >>> nb_pesq.update(preds, target)                            # doctest: +SKIP
+    """
 
     is_differentiable = False
     higher_is_better = True

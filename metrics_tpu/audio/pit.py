@@ -13,7 +13,19 @@ from metrics_tpu.ops.audio.pit import permutation_invariant_training
 
 
 class PermutationInvariantTraining(_MeanAudioMetric):
-    """PIT wrapper around any pairwise audio metric. Reference: audio/pit.py:22."""
+    """PIT wrapper around any pairwise audio metric. Reference: audio/pit.py:22.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu import PermutationInvariantTraining
+        >>> from metrics_tpu.ops.audio import scale_invariant_signal_noise_ratio
+        >>> preds = jax.random.normal(jax.random.PRNGKey(3), (2, 2, 16))   # (batch, spk, time)
+        >>> target = jax.random.normal(jax.random.PRNGKey(4), (2, 2, 16))
+        >>> pit = PermutationInvariantTraining(scale_invariant_signal_noise_ratio)
+        >>> pit.update(preds, target)
+        >>> round(float(pit.compute()), 4)
+        -16.8378
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -13,7 +13,18 @@ from metrics_tpu.ops.audio.sdr import scale_invariant_signal_distortion_ratio, s
 
 
 class SignalDistortionRatio(_MeanAudioMetric):
-    """SDR. Reference: audio/sdr.py:24-117."""
+    """SDR. Reference: audio/sdr.py:24-117.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu import SignalDistortionRatio
+        >>> target = jax.random.normal(jax.random.PRNGKey(1), (8000,))
+        >>> preds = target + 0.1 * jax.random.normal(jax.random.PRNGKey(2), (8000,))
+        >>> sdr = SignalDistortionRatio()
+        >>> sdr.update(preds, target)
+        >>> round(float(sdr.compute()), 4)
+        20.0742
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -40,7 +51,18 @@ class SignalDistortionRatio(_MeanAudioMetric):
 
 
 class ScaleInvariantSignalDistortionRatio(_MeanAudioMetric):
-    """SI-SDR. Reference: audio/sdr.py:119-180."""
+    """SI-SDR. Reference: audio/sdr.py:119-180.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ScaleInvariantSignalDistortionRatio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> si_sdr = ScaleInvariantSignalDistortionRatio()
+        >>> si_sdr.update(preds, target)
+        >>> round(float(si_sdr.compute()), 4)
+        18.403
+    """
 
     is_differentiable = True
     higher_is_better = True

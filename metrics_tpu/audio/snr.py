@@ -38,7 +38,18 @@ class SignalNoiseRatio(_MeanAudioMetric):
 
 
 class ScaleInvariantSignalNoiseRatio(_MeanAudioMetric):
-    """SI-SNR. Reference: audio/snr.py:97-155."""
+    """SI-SNR. Reference: audio/snr.py:97-155.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ScaleInvariantSignalNoiseRatio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> si_snr = ScaleInvariantSignalNoiseRatio()
+        >>> si_snr.update(preds, target)
+        >>> round(float(si_snr.compute()), 4)
+        15.0918
+    """
 
     is_differentiable = True
     higher_is_better = True

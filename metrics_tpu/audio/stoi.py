@@ -14,7 +14,18 @@ from metrics_tpu.ops.audio.stoi import short_time_objective_intelligibility
 
 
 class ShortTimeObjectiveIntelligibility(_MeanAudioMetric):
-    """STOI. Reference: audio/stoi.py:25."""
+    """STOI. Reference: audio/stoi.py:25.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu import ShortTimeObjectiveIntelligibility
+        >>> target = jax.random.normal(jax.random.PRNGKey(1), (8000,))
+        >>> preds = target + 0.1 * jax.random.normal(jax.random.PRNGKey(2), (8000,))
+        >>> stoi = ShortTimeObjectiveIntelligibility(8000)
+        >>> stoi.update(preds, target)
+        >>> round(float(stoi.compute()), 4)
+        0.9888
+    """
 
     is_differentiable = False
     higher_is_better = True

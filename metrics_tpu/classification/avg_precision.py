@@ -11,6 +11,19 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class AveragePrecision(Metric):
+    """Average precision over the exact PR curve. Reference: avg_precision.py:28.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AveragePrecision
+        >>> preds = jnp.asarray([0.0, 0.1, 0.8, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 1])
+        >>> average_precision = AveragePrecision(pos_label=1)
+        >>> average_precision.update(preds, target)
+        >>> round(float(average_precision.compute()), 4)
+        1.0
+    """
+
     is_differentiable = False
     higher_is_better = None
     full_state_update: bool = False

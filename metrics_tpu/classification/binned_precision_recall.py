@@ -39,7 +39,23 @@ def _recall_at_precision(
 
 
 class BinnedPrecisionRecallCurve(Metric):
-    """Constant-memory PR curve over fixed thresholds. Reference: :45-180."""
+    """Constant-memory PR curve over fixed thresholds. Reference: :45-180.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BinnedPrecisionRecallCurve
+        >>> preds = jnp.asarray([0.0, 0.1, 0.8, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 1])
+        >>> curve = BinnedPrecisionRecallCurve(num_classes=1, thresholds=5)
+        >>> curve.update(preds, target)
+        >>> precision, recall, thresholds = curve.compute()
+        >>> [round(float(p), 4) for p in precision]
+        [0.75, 1.0, 1.0, 1.0, 1.0, 1.0]
+        >>> [round(float(r), 4) for r in recall]
+        [1.0, 0.6667, 0.3333, 0.3333, 0.0, 0.0]
+        >>> [round(float(t), 4) for t in thresholds]
+        [0.0, 0.25, 0.5, 0.75, 1.0]
+    """
 
     is_differentiable: bool = False
     higher_is_better = None
@@ -95,7 +111,18 @@ class BinnedPrecisionRecallCurve(Metric):
 
 
 class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
-    """Reference: :182-230."""
+    """Average precision over a binned PR curve. Reference: :182-230.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BinnedAveragePrecision
+        >>> preds = jnp.asarray([0.0, 0.1, 0.8, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 1])
+        >>> metric = BinnedAveragePrecision(num_classes=1, thresholds=5)
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.9167
+    """
 
     def compute(self) -> Union[List[Array], Array]:  # type: ignore[override]
         precisions, recalls, _ = super().compute()
@@ -103,7 +130,19 @@ class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
 
 
 class BinnedRecallAtFixedPrecision(BinnedPrecisionRecallCurve):
-    """Reference: :233-305."""
+    """Max recall meeting a precision floor, over binned thresholds. Reference: :233-305.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BinnedRecallAtFixedPrecision
+        >>> preds = jnp.asarray([0.0, 0.1, 0.8, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 1])
+        >>> metric = BinnedRecallAtFixedPrecision(num_classes=1, thresholds=5, min_precision=0.8)
+        >>> metric.update(preds, target)
+        >>> recall, threshold = metric.compute()
+        >>> round(float(recall), 4), round(float(threshold), 4)
+        (0.6667, 0.25)
+    """
 
     def __init__(
         self,

@@ -12,6 +12,19 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class CalibrationError(Metric):
+    """Top-1 calibration error over binned confidences. Reference: calibration_error.py:24.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CalibrationError
+        >>> preds = jnp.asarray([0.25, 0.35, 0.75, 0.95])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> metric = CalibrationError(n_bins=3)
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.225
+    """
+
     is_differentiable = False
     higher_is_better = False
     full_state_update: bool = False

@@ -11,6 +11,19 @@ from metrics_tpu.ops.classification.hinge import MulticlassMode, _hinge_compute,
 
 
 class HingeLoss(Metric):
+    """Mean hinge loss (binary decision values or multiclass logits). Reference: hinge.py:22.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import HingeLoss
+        >>> preds = jnp.asarray([-2.2, 2.4, 0.1])
+        >>> target = jnp.asarray([0, 1, 1])
+        >>> hinge = HingeLoss()
+        >>> hinge.update(preds, target)
+        >>> round(float(hinge.compute()), 4)
+        0.3
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update: bool = False

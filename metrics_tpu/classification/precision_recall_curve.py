@@ -14,6 +14,24 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class PrecisionRecallCurve(Metric):
+    """Exact precision-recall curve at every unique score. Reference: precision_recall_curve.py:28.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PrecisionRecallCurve
+        >>> preds = jnp.asarray([0.0, 0.1, 0.8, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> curve = PrecisionRecallCurve(pos_label=1)
+        >>> curve.update(preds, target)
+        >>> precision, recall, thresholds = curve.compute()
+        >>> [round(float(p), 4) for p in precision]
+        [0.6667, 0.5, 1.0, 1.0]
+        >>> [round(float(r), 4) for r in recall]
+        [1.0, 0.5, 0.5, 0.0]
+        >>> [round(float(t), 4) for t in thresholds]
+        [0.1, 0.4, 0.8]
+    """
+
     is_differentiable = False
     higher_is_better = None
     full_state_update: bool = False

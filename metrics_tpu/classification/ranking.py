@@ -34,6 +34,19 @@ class _RankingBase(Metric):
 
 
 class CoverageError(_RankingBase):
+    """How far down the ranking one must go to cover all true labels. Reference: ranking.py:30.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CoverageError
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35, 0.75, 0.05], [0.05, 0.75, 0.35, 0.05, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 0, 0, 1], [0, 1, 0, 1, 0]])
+        >>> metric = CoverageError()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        5.0
+    """
+
     higher_is_better = False
 
     def update(self, preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:  # type: ignore[override]
@@ -49,6 +62,19 @@ class CoverageError(_RankingBase):
 
 
 class LabelRankingAveragePrecision(_RankingBase):
+    """Mean fraction of higher-ranked labels that are true, per true label. Reference: ranking.py:85.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import LabelRankingAveragePrecision
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35, 0.75, 0.05], [0.05, 0.75, 0.35, 0.05, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 0, 0, 1], [0, 1, 0, 1, 0]])
+        >>> metric = LabelRankingAveragePrecision()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.45
+    """
+
     higher_is_better = True
 
     def update(self, preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:  # type: ignore[override]
@@ -66,6 +92,19 @@ class LabelRankingAveragePrecision(_RankingBase):
 
 
 class LabelRankingLoss(_RankingBase):
+    """Fraction of wrongly ordered label pairs, averaged over samples. Reference: ranking.py:142.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import LabelRankingLoss
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35, 0.75, 0.05], [0.05, 0.75, 0.35, 0.05, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 0, 0, 1], [0, 1, 0, 1, 0]])
+        >>> metric = LabelRankingLoss()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.5
+    """
+
     higher_is_better = False
 
     def update(self, preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:  # type: ignore[override]

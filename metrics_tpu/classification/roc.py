@@ -11,6 +11,24 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class ROC(Metric):
+    """Receiver operating characteristic curve. Reference: roc.py:25.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ROC
+        >>> preds = jnp.asarray([0.0, 0.1, 0.8, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> roc = ROC(pos_label=1)
+        >>> roc.update(preds, target)
+        >>> fpr, tpr, thresholds = roc.compute()
+        >>> [round(float(x), 4) for x in fpr]
+        [0.0, 0.0, 0.5, 0.5, 1.0]
+        >>> [round(float(x), 4) for x in tpr]
+        [0.0, 0.5, 0.5, 1.0, 1.0]
+        >>> [round(float(t), 4) for t in thresholds]
+        [1.8, 0.8, 0.4, 0.1, 0.0]
+    """
+
     is_differentiable = False
     higher_is_better = None
     full_state_update: bool = False

@@ -17,6 +17,19 @@ from metrics_tpu.ops.classification.stat_scores import _stat_scores_compute, _st
 
 
 class StatScores(Metric):
+    """True/false positives and negatives plus support, any reduce mode. Reference: stat_scores.py:24.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import StatScores
+        >>> preds = jnp.asarray([1, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> stat_scores = StatScores(reduce='micro')
+        >>> stat_scores.update(preds, target)
+        >>> stat_scores.compute().tolist()  # [tp, fp, tn, fn, support]
+        [2, 2, 6, 2, 4]
+    """
+
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = None
     full_state_update: bool = False

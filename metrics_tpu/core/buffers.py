@@ -48,6 +48,17 @@ class CatBuffer:
     from the abstract value). The buffer supports the two accumulation idioms
     metric ``update`` methods use — ``buf.append(x)`` and ``buf = buf + [x]`` —
     so a metric's update code is identical for list and buffer states.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CatBuffer
+        >>> buf = CatBuffer.empty(capacity=4)
+        >>> buf.append(jnp.asarray([1.0, 2.0]))
+        >>> buf.append(jnp.asarray([3.0]))
+        >>> len(buf)
+        3
+        >>> buf.to_array().tolist()
+        [1.0, 2.0, 3.0]
     """
 
     def __init__(

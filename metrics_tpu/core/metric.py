@@ -85,6 +85,23 @@ class Metric:
             compiled appends require it to cover the full run (overflow is
             detected and raised at ``compute``). TPU-first replacement for the
             reference's unbounded list states (metric.py:350-352).
+
+    Example (implementing a custom metric):
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Metric
+        >>> class SumOfInputs(Metric):
+        ...     full_state_update = False
+        ...     def __init__(self, **kwargs):
+        ...         super().__init__(**kwargs)
+        ...         self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        ...     def update(self, x):
+        ...         self.total = self.total + jnp.sum(x)
+        ...     def compute(self):
+        ...         return self.total
+        >>> metric = SumOfInputs()
+        >>> metric.update(jnp.asarray([1.0, 2.0]))
+        >>> float(metric.compute())
+        3.0
     """
 
     __jit_unwrapped__ = True  # marker: methods close over self as static config
@@ -753,7 +770,23 @@ def _neg(x: Array) -> Array:
 
 
 class CompositionalMetric(Metric):
-    """Lazy arithmetic composition of metrics (reference: metric.py:830-938)."""
+    """Lazy arithmetic composition of metrics (reference: metric.py:830-938).
+
+    Built by applying python operators to metrics; ``compute`` evaluates the
+    operands first, then the operator.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanMetric
+        >>> first, second = MeanMetric(), MeanMetric()
+        >>> combined = first + second
+        >>> type(combined).__name__
+        'CompositionalMetric'
+        >>> first.update(jnp.asarray([1.0, 3.0]))
+        >>> second.update(jnp.asarray(2.0))
+        >>> float(combined.compute())
+        4.0
+    """
 
     full_state_update = True
 

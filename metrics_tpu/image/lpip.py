@@ -28,7 +28,23 @@ def _valid_img(img: Array) -> bool:
 
 
 class LearnedPerceptualImagePatchSimilarity(Metric):
-    """LPIPS. Reference: image/lpip.py:32."""
+    """LPIPS. Reference: image/lpip.py:32.
+
+    ``net`` may be one of the built-in Flax trunks (``'alex'``/``'vgg'``/
+    ``'squeeze'``) or any callable mapping two image batches to per-pair
+    distances — used below to keep the example tiny and deterministic.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import LearnedPerceptualImagePatchSimilarity
+        >>> lpips = LearnedPerceptualImagePatchSimilarity(
+        ...     net=lambda a, b: jnp.mean((a - b) ** 2, axis=(1, 2, 3)))
+        >>> img1 = jnp.zeros((2, 3, 16, 16))
+        >>> img2 = jnp.full((2, 3, 16, 16), 0.5)
+        >>> lpips.update(img1, img2)
+        >>> round(float(lpips.compute()), 4)
+        0.25
+    """
 
     is_differentiable = True
     higher_is_better = False

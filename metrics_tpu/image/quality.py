@@ -58,7 +58,18 @@ class UniversalImageQualityIndex(_ImagePairMetric):
 
 
 class SpectralDistortionIndex(_ImagePairMetric):
-    """D-lambda. Reference: image/d_lambda.py:25-100."""
+    """D-lambda. Reference: image/d_lambda.py:25-100.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu import SpectralDistortionIndex
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (2, 3, 16, 16))
+        >>> target = jax.random.uniform(jax.random.PRNGKey(43), (2, 3, 16, 16))
+        >>> sdi = SpectralDistortionIndex()
+        >>> sdi.update(preds, target)
+        >>> round(float(sdi.compute()), 4)
+        0.0507
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -83,7 +94,18 @@ class SpectralDistortionIndex(_ImagePairMetric):
 
 
 class ErrorRelativeGlobalDimensionlessSynthesis(_ImagePairMetric):
-    """ERGAS. Reference: image/ergas.py:26-106."""
+    """ERGAS. Reference: image/ergas.py:26-106.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu import ErrorRelativeGlobalDimensionlessSynthesis
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (2, 3, 16, 16))
+        >>> target = jax.random.uniform(jax.random.PRNGKey(43), (2, 3, 16, 16))
+        >>> ergas = ErrorRelativeGlobalDimensionlessSynthesis()
+        >>> ergas.update(preds, target)
+        >>> round(float(ergas.compute()), 4)
+        320.8529
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -109,7 +131,18 @@ class ErrorRelativeGlobalDimensionlessSynthesis(_ImagePairMetric):
 
 
 class SpectralAngleMapper(_ImagePairMetric):
-    """SAM. Reference: image/sam.py:25-102."""
+    """SAM. Reference: image/sam.py:25-102.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu import SpectralAngleMapper
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (2, 3, 16, 16))
+        >>> target = jax.random.uniform(jax.random.PRNGKey(43), (2, 3, 16, 16))
+        >>> sam = SpectralAngleMapper()
+        >>> sam.update(preds, target)
+        >>> round(float(sam.compute()), 4)
+        0.575
+    """
 
     is_differentiable = True
     higher_is_better = False

@@ -159,7 +159,18 @@ class MeanAbsolutePercentageError(Metric):
 
 
 class SymmetricMeanAbsolutePercentageError(Metric):
-    """SMAPE. Reference: regression/symmetric_mape.py:25-85."""
+    """SMAPE. Reference: regression/symmetric_mape.py:25-85.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SymmetricMeanAbsolutePercentageError
+        >>> preds = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0.5, 1.0, 2.5, 3.0])
+        >>> smape = SymmetricMeanAbsolutePercentageError()
+        >>> smape.update(preds, target)
+        >>> round(float(smape.compute()), 4)
+        0.5556
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -180,7 +191,18 @@ class SymmetricMeanAbsolutePercentageError(Metric):
 
 
 class WeightedMeanAbsolutePercentageError(Metric):
-    """WMAPE. Reference: regression/wmape.py:26-81."""
+    """WMAPE. Reference: regression/wmape.py:26-81.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import WeightedMeanAbsolutePercentageError
+        >>> preds = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0.5, 1.0, 2.5, 3.0])
+        >>> wmape = WeightedMeanAbsolutePercentageError()
+        >>> wmape.update(preds, target)
+        >>> round(float(wmape.compute()), 4)
+        0.1429
+    """
 
     is_differentiable = True
     higher_is_better = False

@@ -59,6 +59,19 @@ class CosineSimilarity(Metric):
 
 
 class TweedieDevianceScore(Metric):
+    """Tweedie deviance for a given power. Reference: regression/tweedie_deviance.py:26.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import TweedieDevianceScore
+        >>> preds = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        >>> target = jnp.asarray([1.5, 2.5, 3.5, 4.5])
+        >>> deviance = TweedieDevianceScore(power=2)
+        >>> deviance.update(preds, target)
+        >>> round(float(deviance.compute()), 4)
+        0.0706
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

@@ -52,7 +52,19 @@ class RetrievalMAP(RetrievalMetric):
 
 
 class RetrievalMRR(RetrievalMetric):
-    """Mean reciprocal rank."""
+    """Mean reciprocal rank.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalMRR
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> mrr = RetrievalMRR()
+        >>> mrr.update(preds, target, indexes=indexes)
+        >>> round(float(mrr.compute()), 4)
+        0.75
+    """
 
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_reciprocal_rank(preds, target)
@@ -76,7 +88,19 @@ class _TopKRetrievalMetric(RetrievalMetric):
 
 
 class RetrievalPrecision(_TopKRetrievalMetric):
-    """Precision@k averaged over queries."""
+    """Precision@k averaged over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalPrecision
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> precision = RetrievalPrecision(k=2)
+        >>> precision.update(preds, target, indexes=indexes)
+        >>> round(float(precision.compute()), 4)
+        0.5
+    """
 
     def __init__(
         self,
@@ -99,7 +123,19 @@ class RetrievalPrecision(_TopKRetrievalMetric):
 
 
 class RetrievalRecall(_TopKRetrievalMetric):
-    """Recall@k averaged over queries."""
+    """Recall@k averaged over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalRecall
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> recall = RetrievalRecall(k=2)
+        >>> recall.update(preds, target, indexes=indexes)
+        >>> round(float(recall.compute()), 4)
+        0.75
+    """
 
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_recall(preds, target, k=self.k)
@@ -109,7 +145,19 @@ class RetrievalRecall(_TopKRetrievalMetric):
 
 
 class RetrievalHitRate(_TopKRetrievalMetric):
-    """HitRate@k averaged over queries."""
+    """HitRate@k averaged over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalHitRate
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> hit_rate = RetrievalHitRate(k=2)
+        >>> hit_rate.update(preds, target, indexes=indexes)
+        >>> round(float(hit_rate.compute()), 4)
+        1.0
+    """
 
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_hit_rate(preds, target, k=self.k)
@@ -119,7 +167,19 @@ class RetrievalHitRate(_TopKRetrievalMetric):
 
 
 class RetrievalNormalizedDCG(_TopKRetrievalMetric):
-    """nDCG@k averaged over queries (graded relevance allowed)."""
+    """nDCG@k averaged over queries (graded relevance allowed).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalNormalizedDCG
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> ndcg = RetrievalNormalizedDCG()
+        >>> ndcg.update(preds, target, indexes=indexes)
+        >>> round(float(ndcg.compute()), 4)
+        0.8467
+    """
 
     def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None, k: Optional[int] = None, **kwargs: Any) -> None:
         super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, k=k, **kwargs)
@@ -133,7 +193,19 @@ class RetrievalNormalizedDCG(_TopKRetrievalMetric):
 
 
 class RetrievalRPrecision(RetrievalMetric):
-    """R-precision averaged over queries."""
+    """R-precision averaged over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalRPrecision
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> r_precision = RetrievalRPrecision()
+        >>> r_precision.update(preds, target, indexes=indexes)
+        >>> round(float(r_precision.compute()), 4)
+        0.75
+    """
 
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_r_precision(preds, target)
@@ -145,7 +217,19 @@ class RetrievalRPrecision(RetrievalMetric):
 class RetrievalFallOut(_TopKRetrievalMetric):
     """FallOut@k — empty-target semantics INVERTED vs other retrieval metrics:
     a query with no *negative* target is degenerate (reference fall_out.py:24,
-    compute override :103-133)."""
+    compute override :103-133).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalFallOut
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> fall_out = RetrievalFallOut(k=2)
+        >>> fall_out.update(preds, target, indexes=indexes)
+        >>> round(float(fall_out.compute()), 4)
+        0.5
+    """
 
     higher_is_better = False
     _empty_kind = "negative"

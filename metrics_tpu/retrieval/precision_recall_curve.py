@@ -31,6 +31,25 @@ def _retrieval_recall_at_fixed_precision(
 
 
 class RetrievalPrecisionRecallCurve(RetrievalMetric):
+    """Precision/recall averaged over queries at each top-k cutoff. Reference: precision_recall_curve.py:55.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalPrecisionRecallCurve
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> curve = RetrievalPrecisionRecallCurve(max_k=2)
+        >>> curve.update(preds, target, indexes=indexes)
+        >>> precisions, recalls, top_k = curve.compute()
+        >>> [round(float(p), 4) for p in precisions]
+        [0.5, 0.5]
+        >>> [round(float(r), 4) for r in recalls]
+        [0.5, 0.75]
+        >>> top_k.tolist()
+        [1, 2]
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -87,6 +106,21 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
 
 
 class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
+    """Max recall@k whose precision@k meets a floor, plus the k. Reference: precision_recall_curve.py:212.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalRecallAtFixedPrecision
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalRecallAtFixedPrecision(min_precision=0.5)
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> recall, best_k = metric.compute()
+        >>> round(float(recall), 4), int(best_k)
+        (1.0, 3)
+    """
+
     higher_is_better = True
 
     def __init__(

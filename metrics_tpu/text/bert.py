@@ -23,6 +23,29 @@ class BERTScore(Metric):
 
     Pass ``model``/``user_tokenizer``/``user_forward_fn`` to use your own Flax
     encoder (the reference's own-model example, tm_examples/bert_score-own_model.py).
+
+    Example (own encoder — here a plain embedding table):
+        >>> import numpy as np
+        >>> from metrics_tpu import BERTScore
+        >>> VOCAB = ["[CLS]", "[SEP]", "[PAD]", "hello", "there", "master", "kenobi"]
+        >>> table = np.random.default_rng(0).normal(size=(len(VOCAB), 8)).astype(np.float32)
+        >>> def tokenizer(sentences):
+        ...     ids = np.full((len(sentences), 6), VOCAB.index("[PAD]"), dtype=np.int32)
+        ...     mask = np.zeros((len(sentences), 6), dtype=np.int32)
+        ...     for row, sent in enumerate(sentences):
+        ...         for col, word in enumerate(["[CLS]"] + sent.split()[:4] + ["[SEP]"]):
+        ...             ids[row, col] = VOCAB.index(word)
+        ...             mask[row, col] = 1
+        ...     return {"input_ids": ids, "attention_mask": mask}
+        >>> score = BERTScore(
+        ...     model=object(),
+        ...     user_tokenizer=tokenizer,
+        ...     user_forward_fn=lambda model, batch: table[np.asarray(batch["input_ids"])],
+        ...     max_length=6,
+        ... )
+        >>> score.update(["hello there", "master kenobi"], ["hello there", "hello kenobi"])
+        >>> {key: [round(float(v), 4) for v in values] for key, values in score.compute().items()}
+        {'precision': [1.0, 0.5], 'recall': [1.0, 0.8545], 'f1': [1.0, 0.6309]}
     """
 
     is_differentiable = False

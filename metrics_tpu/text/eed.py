@@ -15,7 +15,17 @@ from metrics_tpu.ops.text.eed import _eed_compute, _eed_update
 
 
 class ExtendedEditDistance(Metric):
-    """EED. Reference: text/eed.py:24-106."""
+    """EED. Reference: text/eed.py:24-106.
+
+    Example:
+        >>> from metrics_tpu import ExtendedEditDistance
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> eed = ExtendedEditDistance()
+        >>> eed.update(preds, target)
+        >>> round(float(eed.compute()), 4)
+        0.3031
+    """
 
     is_differentiable = False
     higher_is_better = False

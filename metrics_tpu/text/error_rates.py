@@ -91,7 +91,17 @@ class CharErrorRate(Metric):
 
 
 class MatchErrorRate(Metric):
-    """Match error rate. Reference: text/mer.py:24-94."""
+    """Match error rate. Reference: text/mer.py:24-94.
+
+    Example:
+        >>> from metrics_tpu import MatchErrorRate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> mer = MatchErrorRate()
+        >>> mer.update(preds, target)
+        >>> round(float(mer.compute()), 4)
+        0.4444
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -112,7 +122,17 @@ class MatchErrorRate(Metric):
 
 
 class WordInfoLost(Metric):
-    """Word information lost. Reference: text/wil.py:23-95."""
+    """Word information lost. Reference: text/wil.py:23-95.
+
+    Example:
+        >>> from metrics_tpu import WordInfoLost
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> wil = WordInfoLost()
+        >>> wil.update(preds, target)
+        >>> round(float(wil.compute()), 4)
+        0.6528
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -135,7 +155,17 @@ class WordInfoLost(Metric):
 
 
 class WordInfoPreserved(Metric):
-    """Word information preserved. Reference: text/wip.py:23-95."""
+    """Word information preserved. Reference: text/wip.py:23-95.
+
+    Example:
+        >>> from metrics_tpu import WordInfoPreserved
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> wip = WordInfoPreserved()
+        >>> wip.update(preds, target)
+        >>> round(float(wip.compute()), 4)
+        0.3472
+    """
 
     is_differentiable = False
     higher_is_better = True

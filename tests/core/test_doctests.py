@@ -12,7 +12,27 @@ import pytest
 
 MODULES = [
     "metrics_tpu.aggregation",
+    "metrics_tpu.audio.pesq",
+    "metrics_tpu.audio.pit",
+    "metrics_tpu.audio.sdr",
     "metrics_tpu.audio.snr",
+    "metrics_tpu.audio.stoi",
+    "metrics_tpu.classification.avg_precision",
+    "metrics_tpu.classification.binned_precision_recall",
+    "metrics_tpu.classification.calibration_error",
+    "metrics_tpu.classification.hinge",
+    "metrics_tpu.classification.precision_recall_curve",
+    "metrics_tpu.classification.ranking",
+    "metrics_tpu.classification.roc",
+    "metrics_tpu.classification.stat_scores",
+    "metrics_tpu.core.buffers",
+    "metrics_tpu.core.metric",
+    "metrics_tpu.image.inception",
+    "metrics_tpu.image.kid",
+    "metrics_tpu.image.lpip",
+    "metrics_tpu.retrieval.precision_recall_curve",
+    "metrics_tpu.text.bert",
+    "metrics_tpu.text.eed",
     "metrics_tpu.classification.auc",
     "metrics_tpu.classification.dice",
     "metrics_tpu.classification.hamming",
