@@ -31,8 +31,10 @@ from metrics_tpu.utils.checks import _is_traced
 
 try:  # pallas ships with jax; keep the metric importable if it ever doesn't
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 except Exception:  # pragma: no cover
     pl = None
+    pltpu = None
 
 _BLOCK_N = 256
 
@@ -81,7 +83,10 @@ def _binned_counts_pallas(preds: Array, target: Array, thresholds: Array, interp
         _counts_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, n_thresholds), lambda i: (0, 0)),
+            # thresholds live in SMEM: the kernel reads thr_ref[0, j] at a
+            # loop-carried index, and dynamic lane indexing into a VMEM
+            # vector is not supported by Mosaic (it must prove 128-alignment)
+            pl.BlockSpec((1, n_thresholds), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((_BLOCK_N, c), lambda i: (i, 0)),
             pl.BlockSpec((_BLOCK_N, c), lambda i: (i, 0)),
         ],
