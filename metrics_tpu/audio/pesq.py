@@ -21,8 +21,10 @@ class PerceptualEvaluationSpeechQuality(_MeanAudioMetric):
     actionable error when it is absent (same gate as the reference).
 
     Example:
+        >>> import jax
         >>> from metrics_tpu import PerceptualEvaluationSpeechQuality
-        >>> from metrics_tpu.ops.audio.pesq import _PESQ_AVAILABLE   # availability gate
+        >>> target = jax.random.normal(jax.random.PRNGKey(1), (8000,))
+        >>> preds = target + 0.1 * jax.random.normal(jax.random.PRNGKey(2), (8000,))
         >>> nb_pesq = PerceptualEvaluationSpeechQuality(8000, 'nb')  # doctest: +SKIP
         >>> nb_pesq.update(preds, target)                            # doctest: +SKIP
     """

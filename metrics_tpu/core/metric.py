@@ -799,6 +799,12 @@ class CompositionalMetric(Metric):
     def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
         return kwargs
 
+    def _wrap_compute(self, compute: Callable) -> Callable:
+        # staleness/sync/caching belong to the operand metrics; the operands'
+        # own compute() calls warn if THEY were never updated (reference
+        # metric.py:861-863)
+        return compute
+
     def update(self, *args: Any, **kwargs: Any) -> None:  # type: ignore[override]
         if isinstance(self.metric_a, Metric):
             self.metric_a.update(*args, **self.metric_a._filter_kwargs(**kwargs))
