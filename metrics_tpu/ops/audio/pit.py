@@ -66,7 +66,19 @@ def _find_best_perm_hungarian(metric_mtx: Array, eval_max: bool) -> Tuple[Array,
 def permutation_invariant_training(
     preds: Array, target: Array, metric_func: Callable, eval_func: str = "max", **kwargs: Any
 ) -> Tuple[Array, Array]:
-    """PIT: best metric value and permutation per sample. Reference: pit.py:95-167."""
+    """PIT: best metric value and permutation per sample. Reference: pit.py:95-167.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu.ops import permutation_invariant_training, scale_invariant_signal_noise_ratio
+        >>> preds = jax.random.normal(jax.random.PRNGKey(3), (2, 2, 16))   # (batch, spk, time)
+        >>> target = jax.random.normal(jax.random.PRNGKey(4), (2, 2, 16))
+        >>> best, perm = permutation_invariant_training(preds, target, scale_invariant_signal_noise_ratio)
+        >>> [round(float(x), 4) for x in best]
+        [-15.6326, -18.043]
+        >>> perm.tolist()
+        [[1, 0], [0, 1]]
+    """
     if preds.shape[0:2] != target.shape[0:2]:
         raise RuntimeError(
             "Predictions and targets are expected to have the same shape at the batch and speaker dimensions"
@@ -85,5 +97,15 @@ def permutation_invariant_training(
 
 
 def pit_permutate(preds: Array, perm: Array) -> Array:
-    """Reorder ``preds[b, s]`` as ``preds[b, perm[b, s]]``. Reference: pit.py:170-181."""
+    """Reorder ``preds[b, s]`` as ``preds[b, perm[b, s]]``. Reference: pit.py:170-181.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu.ops import permutation_invariant_training, pit_permutate, scale_invariant_signal_noise_ratio
+        >>> preds = jax.random.normal(jax.random.PRNGKey(3), (2, 2, 16))
+        >>> target = jax.random.normal(jax.random.PRNGKey(4), (2, 2, 16))
+        >>> _, perm = permutation_invariant_training(preds, target, scale_invariant_signal_noise_ratio)
+        >>> pit_permutate(preds, perm).shape
+        (2, 2, 16)
+    """
     return jnp.take_along_axis(preds, perm.reshape(perm.shape + (1,) * (preds.ndim - 2)), axis=1)

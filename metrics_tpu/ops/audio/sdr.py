@@ -81,7 +81,16 @@ def signal_distortion_ratio(
     zero_mean: bool = False,
     load_diag: Optional[float] = None,
 ) -> Array:
-    """SDR. Reference: sdr.py:107-220."""
+    """SDR. Reference: sdr.py:107-220.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu.ops import signal_distortion_ratio
+        >>> target = jax.random.normal(jax.random.PRNGKey(1), (8000,))
+        >>> preds = target + 0.1 * jax.random.normal(jax.random.PRNGKey(2), (8000,))
+        >>> round(float(signal_distortion_ratio(preds, target)), 4)
+        20.0742
+    """
     _check_same_shape(preds, target)
     orig_dtype = preds.dtype
     # float64 island when enabled (reference sdr.py:169-171); f32 otherwise
@@ -113,7 +122,16 @@ def signal_distortion_ratio(
 
 
 def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
-    """SI-SDR. Reference: sdr.py:222-268."""
+    """SI-SDR. Reference: sdr.py:222-268.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import scale_invariant_signal_distortion_ratio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(scale_invariant_signal_distortion_ratio(preds, target)), 4)
+        18.403
+    """
     _check_same_shape(preds, target)
     eps = jnp.finfo(preds.dtype).eps
 

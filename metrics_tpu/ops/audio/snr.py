@@ -14,7 +14,16 @@ from metrics_tpu.utils.checks import _check_same_shape
 
 
 def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
-    """SNR in dB over the last (time) axis. Reference: snr.py:22-70."""
+    """SNR in dB over the last (time) axis. Reference: snr.py:22-70.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import signal_noise_ratio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(signal_noise_ratio(preds, target)), 4)
+        16.1805
+    """
     _check_same_shape(preds, target)
     eps = jnp.finfo(preds.dtype).eps
     if zero_mean:
@@ -26,5 +35,14 @@ def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> 
 
 
 def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
-    """SI-SNR. Reference: snr.py:73-102."""
+    """SI-SNR. Reference: snr.py:73-102.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import scale_invariant_signal_noise_ratio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(scale_invariant_signal_noise_ratio(preds, target)), 4)
+        15.0918
+    """
     return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)

@@ -166,6 +166,14 @@ def short_time_objective_intelligibility(
     Reference: functional/audio/stoi.py (pystoi delegation); this is a native
     implementation — resampling happens host-side via scipy (the only
     non-jittable step, and only when ``fs != 10000``).
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu.ops import short_time_objective_intelligibility
+        >>> target = jax.random.normal(jax.random.PRNGKey(1), (8000,))
+        >>> preds = target + 0.1 * jax.random.normal(jax.random.PRNGKey(2), (8000,))
+        >>> round(float(short_time_objective_intelligibility(preds, target, 8000)), 4)
+        0.9888
     """
     _check_same_shape(preds, target)
     if fs != FS:

@@ -149,7 +149,14 @@ def accuracy(
     multiclass: Optional[bool] = None,
     ignore_index: Optional[int] = None,
 ) -> Array:
-    """Accuracy over any classification input type. Reference: :255-389."""
+    """Accuracy over any classification input type. Reference: :255-389.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import accuracy
+        >>> round(float(accuracy(jnp.asarray([0, 2, 1, 3]), jnp.asarray([0, 1, 2, 3]))), 4)
+        0.5
+    """
     allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
     if average not in allowed_average:
         raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")

@@ -44,6 +44,13 @@ def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
 
 
 def auc(x: Array, y: Array, reorder: bool = False) -> Array:
-    """AUC by trapezoid. Reference: auc.py:102-130."""
+    """AUC by trapezoid. Reference: auc.py:102-130.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import auc
+        >>> round(float(auc(jnp.asarray([0, 1, 2, 3]), jnp.asarray([0, 1, 2, 2]), reorder=True)), 4)
+        4.0
+    """
     x, y = _auc_update(x, y)
     return _auc_compute(x, y, reorder=reorder)

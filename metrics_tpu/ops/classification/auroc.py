@@ -129,6 +129,15 @@ def auroc(
     max_fpr: Optional[float] = None,
     sample_weights: Optional[Sequence] = None,
 ) -> Array:
-    """ROC-AUC. Reference: auroc.py:197-281."""
+    """ROC-AUC. Reference: auroc.py:197-281.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import auroc
+        >>> preds = jnp.asarray([0.13, 0.26, 0.08, 0.19, 0.34])
+        >>> target = jnp.asarray([0, 0, 1, 1, 1])
+        >>> round(float(auroc(preds, target, pos_label=1)), 4)
+        0.5
+    """
     preds, target, mode = _auroc_update(preds, target)
     return _auroc_compute(preds, target, mode, num_classes, pos_label, average, max_fpr, sample_weights)

@@ -95,6 +95,15 @@ def average_precision(
     average: Optional[str] = "macro",
     sample_weights: Optional[Sequence] = None,
 ) -> Union[List[Array], Array]:
-    """Average precision score. Reference: average_precision.py:162-217."""
+    """Average precision score. Reference: average_precision.py:162-217.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import average_precision
+        >>> preds = jnp.asarray([0.0, 0.1, 0.8, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 1])
+        >>> round(float(average_precision(preds, target, pos_label=1)), 4)
+        1.0
+    """
     preds, target, num_classes, pos_label = _average_precision_update(preds, target, num_classes, pos_label, average)
     return _average_precision_compute(preds, target, num_classes, pos_label, average, sample_weights)

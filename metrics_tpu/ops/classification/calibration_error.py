@@ -89,7 +89,16 @@ def _ce_update(preds: Array, target: Array) -> Tuple[Array, Array]:
 
 
 def calibration_error(preds: Array, target: Array, n_bins: int = 15, norm: str = "l1") -> Array:
-    """Top-label calibration error. Reference: :168-213."""
+    """Top-label calibration error. Reference: :168-213.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import calibration_error
+        >>> preds = jnp.asarray([0.25, 0.35, 0.75, 0.95])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> round(float(calibration_error(preds, target, n_bins=3)), 4)
+        0.225
+    """
     if norm not in ("l1", "l2", "max"):
         raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
     if not isinstance(n_bins, int) or n_bins <= 0:

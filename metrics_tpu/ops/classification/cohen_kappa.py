@@ -43,6 +43,13 @@ def cohen_kappa(
     weights: Optional[str] = None,
     threshold: float = 0.5,
 ) -> Array:
-    """Inter-annotator agreement. Reference: cohen_kappa.py:70-116."""
+    """Inter-annotator agreement. Reference: cohen_kappa.py:70-116.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import cohen_kappa
+        >>> round(float(cohen_kappa(jnp.asarray([0, 1, 0, 0]), jnp.asarray([1, 1, 0, 0]), num_classes=2)), 4)
+        0.5
+    """
     confmat = _cohen_kappa_update(preds, target, num_classes, threshold)
     return _cohen_kappa_compute(confmat, weights)

@@ -63,6 +63,13 @@ def confusion_matrix(
     threshold: float = 0.5,
     multilabel: bool = False,
 ) -> Array:
-    """``(C, C)`` (or ``(C, 2, 2)`` multilabel) confusion matrix. Reference: :118-186."""
+    """``(C, C)`` (or ``(C, 2, 2)`` multilabel) confusion matrix. Reference: :118-186.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import confusion_matrix
+        >>> confusion_matrix(jnp.asarray([0, 1, 0, 0]), jnp.asarray([1, 1, 0, 0]), num_classes=2).astype(int).tolist()
+        [[2, 0], [1, 1]]
+    """
     confmat = _confusion_matrix_update(preds, target, num_classes, threshold, multilabel)
     return _confusion_matrix_compute(confmat, normalize)

@@ -42,7 +42,14 @@ def dice(
     multiclass: Optional[bool] = None,
     ignore_index: Optional[int] = None,
 ) -> Array:
-    """Dice = 2*TP / (2*TP + FP + FN). Reference: dice.py:150-257."""
+    """Dice = 2*TP / (2*TP + FP + FN). Reference: dice.py:150-257.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import dice
+        >>> round(float(dice(jnp.asarray([2, 0, 2, 1]), jnp.asarray([1, 1, 2, 0]), average='micro')), 4)
+        0.25
+    """
     _check_avg_args(average, mdmc_average, num_classes, ignore_index)
     reduce = "macro" if average in ("weighted", "none", None) else average
     tp, fp, tn, fn = _stat_scores_update(
@@ -63,6 +70,13 @@ def dice_score(
     """Deprecated macro dice alias. Reference: dice.py:27-104 (deprecated in
     v0.9 in favor of :func:`dice`; kept for public-API parity — non-default
     ``no_fg_score``/``reduction`` fall back to defaults as the reference does).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import dice_score
+        >>> preds = jnp.asarray([[0.1, 0.9], [0.8, 0.2]])
+        >>> round(float(dice_score(preds, jnp.asarray([1, 0]))), 4)
+        1.0
     """
     import math
 

@@ -80,7 +80,16 @@ def fbeta_score(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    """F-beta over any classification input. Reference: f_beta.py:112-217."""
+    """F-beta over any classification input. Reference: f_beta.py:112-217.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import fbeta_score
+        >>> preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+        >>> target = jnp.asarray([0, 1, 2, 0, 1, 2])
+        >>> round(float(fbeta_score(preds, target, num_classes=3, beta=0.5)), 4)
+        0.3333
+    """
     _check_avg_args(average, mdmc_average, num_classes, ignore_index)
     reduce = "macro" if average in ("weighted", "none", None) else average
     tp, fp, tn, fn = _stat_scores_update(
@@ -101,5 +110,14 @@ def f1_score(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    """F1 = F-beta with beta=1. Reference: f_beta.py:220-313."""
+    """F1 = F-beta with beta=1. Reference: f_beta.py:220-313.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import f1_score
+        >>> preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+        >>> target = jnp.asarray([0, 1, 2, 0, 1, 2])
+        >>> round(float(f1_score(preds, target, num_classes=3)), 4)
+        0.3333
+    """
     return fbeta_score(preds, target, 1.0, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)

@@ -26,6 +26,15 @@ def _hamming_distance_compute(correct: Array, total: Union[int, Array]) -> Array
 
 
 def hamming_distance(preds: Array, target: Array, threshold: float = 0.5) -> Array:
-    """Fraction of mismatched labels. Reference: hamming.py:62-103."""
+    """Fraction of mismatched labels. Reference: hamming.py:62-103.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import hamming_distance
+        >>> preds = jnp.asarray([[0, 1], [0, 1]])
+        >>> target = jnp.asarray([[0, 1], [1, 1]])
+        >>> round(float(hamming_distance(preds, target)), 4)
+        0.25
+    """
     correct, total = _hamming_distance_update(preds, target, threshold)
     return _hamming_distance_compute(correct, total)

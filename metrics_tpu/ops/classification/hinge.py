@@ -88,6 +88,13 @@ def hinge_loss(
     squared: bool = False,
     multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
 ) -> Array:
-    """Mean hinge loss. Reference: hinge.py:150-215."""
+    """Mean hinge loss. Reference: hinge.py:150-215.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import hinge_loss
+        >>> round(float(hinge_loss(jnp.asarray([-2.2, 2.4, 0.1]), jnp.asarray([0, 1, 1]))), 4)
+        0.3
+    """
     measure, total = _hinge_update(preds, target, squared=squared, multiclass_mode=multiclass_mode)
     return _hinge_compute(measure, total)

@@ -67,6 +67,13 @@ def jaccard_index(
     absent_score: float = 0.0,
     threshold: float = 0.5,
 ) -> Array:
-    """IoU. Reference: jaccard.py:94-167."""
+    """IoU. Reference: jaccard.py:94-167.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import jaccard_index
+        >>> round(float(jaccard_index(jnp.asarray([0, 1, 0, 0]), jnp.asarray([1, 1, 0, 0]), num_classes=2)), 4)
+        0.5833
+    """
     confmat = _confusion_matrix_update(preds, target, num_classes, threshold)
     return _jaccard_from_confmat(confmat, num_classes, average, ignore_index, absent_score)

@@ -40,6 +40,15 @@ def _kld_compute(measures: Array, total, reduction: Optional[str] = "mean") -> A
 
 
 def kl_divergence(p: Array, q: Array, log_prob: bool = False, reduction: Optional[str] = "mean") -> Array:
-    """D_KL(P||Q). Reference: kl_divergence.py:81-123."""
+    """D_KL(P||Q). Reference: kl_divergence.py:81-123.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import kl_divergence
+        >>> p = jnp.asarray([[0.36, 0.48, 0.16]])
+        >>> q = jnp.asarray([[1 / 3, 1 / 3, 1 / 3]])
+        >>> round(float(kl_divergence(p, q)), 4)
+        0.0853
+    """
     measures, total = _kld_update(p, q, log_prob)
     return _kld_compute(measures, total, reduction)

@@ -29,6 +29,13 @@ def _matthews_corrcoef_compute(confmat: Array) -> Array:
 
 
 def matthews_corrcoef(preds: Array, target: Array, num_classes: int, threshold: float = 0.5) -> Array:
-    """General classification correlation. Reference: matthews_corrcoef.py:52-92."""
+    """General classification correlation. Reference: matthews_corrcoef.py:52-92.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import matthews_corrcoef
+        >>> round(float(matthews_corrcoef(jnp.asarray([0, 1, 0, 0]), jnp.asarray([1, 1, 0, 0]), num_classes=2)), 4)
+        0.5774
+    """
     confmat = _matthews_corrcoef_update(preds, target, num_classes, threshold)
     return _matthews_corrcoef_compute(confmat)

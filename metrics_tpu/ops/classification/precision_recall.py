@@ -61,7 +61,16 @@ def precision(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    """Precision = TP / (TP + FP). Reference: precision_recall.py:75-184."""
+    """Precision = TP / (TP + FP). Reference: precision_recall.py:75-184.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import precision
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> round(float(precision(preds, target, average='macro', num_classes=3)), 4)
+        0.1667
+    """
     tp, fp, tn, fn = _pr_update(preds, target, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)
     return _precision_compute(tp, fp, fn, average, mdmc_average)
 
@@ -77,7 +86,16 @@ def recall(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    """Recall = TP / (TP + FN). Reference: precision_recall.py:239-348."""
+    """Recall = TP / (TP + FN). Reference: precision_recall.py:239-348.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import recall
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> round(float(recall(preds, target, average='macro', num_classes=3)), 4)
+        0.3333
+    """
     tp, fp, tn, fn = _pr_update(preds, target, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)
     return _recall_compute(tp, fp, fn, average, mdmc_average)
 
@@ -93,7 +111,17 @@ def precision_recall(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Tuple[Array, Array]:
-    """Both from one stat-scores pass. Reference: precision_recall.py:351-467."""
+    """Both from one stat-scores pass. Reference: precision_recall.py:351-467.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import precision_recall
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> p, r = precision_recall(preds, target, average='macro', num_classes=3)
+        >>> round(float(p), 4), round(float(r), 4)
+        (0.1667, 0.3333)
+    """
     tp, fp, tn, fn = _pr_update(preds, target, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)
     return (
         _precision_compute(tp, fp, fn, average, mdmc_average),

@@ -158,6 +158,18 @@ def precision_recall_curve(
     pos_label: Optional[int] = None,
     sample_weights: Optional[Sequence] = None,
 ) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
-    """Precision-recall pairs at all distinct thresholds. Reference: :207-279."""
+    """Precision-recall pairs at all distinct thresholds. Reference: :207-279.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import precision_recall_curve
+        >>> preds = jnp.asarray([0.0, 0.1, 0.8, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> precision, recall, thresholds = precision_recall_curve(preds, target, pos_label=1)
+        >>> [round(float(p), 4) for p in precision]
+        [0.6667, 0.5, 1.0, 1.0]
+        >>> [round(float(r), 4) for r in recall]
+        [1.0, 0.5, 0.5, 0.0]
+    """
     preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
     return _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)

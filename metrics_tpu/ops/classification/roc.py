@@ -101,6 +101,18 @@ def roc(
     pos_label: Optional[int] = None,
     sample_weights: Optional[Sequence] = None,
 ) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
-    """fpr/tpr/threshold curves. Reference: roc.py:161-244."""
+    """fpr/tpr/threshold curves. Reference: roc.py:161-244.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import roc
+        >>> preds = jnp.asarray([0.0, 0.1, 0.8, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> fpr, tpr, thresholds = roc(preds, target, pos_label=1)
+        >>> [round(float(x), 4) for x in fpr]
+        [0.0, 0.0, 0.5, 0.5, 1.0]
+        >>> [round(float(x), 4) for x in tpr]
+        [0.0, 0.5, 0.5, 1.0, 1.0]
+    """
     preds, target, num_classes, pos_label = _roc_update(preds, target, num_classes, pos_label)
     return _roc_compute(preds, target, num_classes, pos_label, sample_weights)

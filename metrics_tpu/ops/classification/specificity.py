@@ -44,7 +44,16 @@ def specificity(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    """Specificity = TN / (TN + FP). Reference: specificity.py:71-181."""
+    """Specificity = TN / (TN + FP). Reference: specificity.py:71-181.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import specificity
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> round(float(specificity(preds, target, average='macro', num_classes=3)), 4)
+        0.6111
+    """
     _check_avg_args(average, mdmc_average, num_classes, ignore_index)
     reduce = "macro" if average in ("weighted", "none", None) else average
     tp, fp, tn, fn = _stat_scores_update(

@@ -14,7 +14,14 @@ _FORMATS = ("xyxy", "xywh", "cxcywh")
 
 
 def box_convert(boxes: Array, in_fmt: str, out_fmt: str) -> Array:
-    """Convert [N, 4] boxes between xyxy / xywh / cxcywh formats."""
+    """Convert [N, 4] boxes between xyxy / xywh / cxcywh formats.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops.detection.boxes import box_convert
+        >>> box_convert(jnp.asarray([[1.0, 1.0, 2.0, 2.0]]), 'xywh', 'xyxy').tolist()
+        [[1.0, 1.0, 3.0, 3.0]]
+    """
     if in_fmt not in _FORMATS or out_fmt not in _FORMATS:
         raise ValueError(f"Unsupported box format: {in_fmt} -> {out_fmt}; supported: {_FORMATS}")
     if in_fmt == out_fmt:
@@ -38,12 +45,28 @@ def box_convert(boxes: Array, in_fmt: str, out_fmt: str) -> Array:
 
 
 def box_area(boxes: Array) -> Array:
-    """[..., 4] xyxy boxes -> [...] areas."""
+    """[..., 4] xyxy boxes -> [...] areas.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops.detection.boxes import box_area
+        >>> box_area(jnp.asarray([[0.0, 0.0, 2.0, 2.0], [1.0, 1.0, 3.0, 3.0]])).tolist()
+        [4.0, 4.0]
+    """
     return (boxes[..., 2] - boxes[..., 0]) * (boxes[..., 3] - boxes[..., 1])
 
 
 def box_iou(boxes1: Array, boxes2: Array) -> Array:
-    """Pairwise IoU of xyxy boxes: [N, 4] x [M, 4] -> [N, M]."""
+    """Pairwise IoU of xyxy boxes: [N, 4] x [M, 4] -> [N, M].
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops.detection.boxes import box_iou
+        >>> a = jnp.asarray([[0.0, 0.0, 2.0, 2.0], [1.0, 1.0, 3.0, 3.0]])
+        >>> b = jnp.asarray([[1.0, 1.0, 2.0, 2.0]])
+        >>> [[round(float(v), 4) for v in row] for row in box_iou(a, b)]
+        [[0.25], [0.25]]
+    """
     area1 = box_area(boxes1)
     area2 = box_area(boxes2)
     lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
@@ -60,6 +83,14 @@ def mask_iou(masks1: Array, masks2: Array) -> Array:
     Device-native replacement for pycocotools RLE IoU (reference
     mean_ap.py:113-142): flatten to [N, HW] / [M, HW] and compute
     intersections as one matmul (MXU-friendly), unions from per-mask areas.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops.detection.boxes import mask_iou
+        >>> m1 = jnp.zeros((1, 4, 4)).at[0, :2, :2].set(1)
+        >>> m2 = jnp.zeros((1, 4, 4)).at[0, :4, :2].set(1)
+        >>> [[round(float(v), 4) for v in row] for row in mask_iou(m1, m2)]
+        [[0.5]]
     """
     m1 = masks1.reshape(masks1.shape[0], -1).astype(jnp.float32)
     m2 = masks2.reshape(masks2.shape[0], -1).astype(jnp.float32)
@@ -71,5 +102,12 @@ def mask_iou(masks1: Array, masks2: Array) -> Array:
 
 
 def mask_area(masks: Array) -> Array:
-    """[N, H, W] binary masks -> [N] pixel areas."""
+    """[N, H, W] binary masks -> [N] pixel areas.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops.detection.boxes import mask_area
+        >>> mask_area(jnp.zeros((1, 4, 4)).at[0, :4, :2].set(1)).tolist()
+        [8.0]
+    """
     return masks.reshape(masks.shape[0], -1).sum(axis=-1).astype(jnp.float32)

@@ -65,7 +65,16 @@ def spectral_distortion_index(
     p: int = 1,
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """Spectral Distortion Index. Reference: d_lambda.py:79-131."""
+    """Spectral Distortion Index. Reference: d_lambda.py:79-131.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu.ops import spectral_distortion_index
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (2, 3, 16, 16))
+        >>> target = jax.random.uniform(jax.random.PRNGKey(43), (2, 3, 16, 16))
+        >>> round(float(spectral_distortion_index(preds, target)), 4)
+        0.0507
+    """
     if not isinstance(p, int) or p <= 0:
         raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
     preds, target = _spectral_distortion_index_check_inputs(preds, target)

@@ -44,6 +44,15 @@ def error_relative_global_dimensionless_synthesis(
     ratio: Union[int, float] = 4,
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """ERGAS. Reference: ergas.py:73-115."""
+    """ERGAS. Reference: ergas.py:73-115.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu.ops import error_relative_global_dimensionless_synthesis
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (2, 3, 16, 16))
+        >>> target = jax.random.uniform(jax.random.PRNGKey(43), (2, 3, 16, 16))
+        >>> round(float(error_relative_global_dimensionless_synthesis(preds, target)), 4)
+        320.8529
+    """
     preds, target = _ergas_check_inputs(preds, target)
     return _ergas_compute(preds, target, ratio, reduction)

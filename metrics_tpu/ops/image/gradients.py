@@ -29,6 +29,17 @@ def _compute_image_gradients(img: Array) -> Tuple[Array, Array]:
 
 
 def image_gradients(img: Array) -> Tuple[Array, Array]:
-    """``(dy, dx)`` finite-difference gradients. Reference: gradients.py:36-69."""
+    """``(dy, dx)`` finite-difference gradients. Reference: gradients.py:36-69.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import image_gradients
+        >>> img = jnp.arange(25, dtype=jnp.float32).reshape(1, 1, 5, 5)
+        >>> dy, dx = image_gradients(img)
+        >>> dy[0, 0, 0].tolist()
+        [5.0, 5.0, 5.0, 5.0, 5.0]
+        >>> dx[0, 0, 0].tolist()
+        [1.0, 1.0, 1.0, 1.0, 0.0]
+    """
     _image_gradients_validate(img)
     return _compute_image_gradients(img)

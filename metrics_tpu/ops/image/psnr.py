@@ -56,7 +56,16 @@ def peak_signal_noise_ratio(
     reduction: Optional[str] = "elementwise_mean",
     dim: Optional[Union[int, Tuple[int, ...]]] = None,
 ) -> Array:
-    """PSNR. Reference: psnr.py:82-139."""
+    """PSNR. Reference: psnr.py:82-139.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import peak_signal_noise_ratio
+        >>> preds = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])
+        >>> target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])
+        >>> round(float(peak_signal_noise_ratio(preds, target)), 4)
+        2.5527
+    """
     if dim is None and reduction != "elementwise_mean":
         rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
     if data_range is None:

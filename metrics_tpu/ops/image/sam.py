@@ -27,6 +27,15 @@ def _sam_compute(preds: Array, target: Array, reduction: Optional[str] = "elemen
 
 
 def spectral_angle_mapper(preds: Array, target: Array, reduction: Optional[str] = "elementwise_mean") -> Array:
-    """SAM (radians). Reference: sam.py:69-110."""
+    """SAM (radians). Reference: sam.py:69-110.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu.ops import spectral_angle_mapper
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (2, 3, 16, 16))
+        >>> target = jax.random.uniform(jax.random.PRNGKey(43), (2, 3, 16, 16))
+        >>> round(float(spectral_angle_mapper(preds, target)), 4)
+        0.575
+    """
     preds, target = _sam_check_inputs(preds, target)
     return _sam_compute(preds, target, reduction)

@@ -133,7 +133,15 @@ def structural_similarity_index_measure(
     return_full_image: bool = False,
     return_contrast_sensitivity: bool = False,
 ) -> Union[Array, Tuple[Array, Array]]:
-    """SSIM. Reference: ssim.py:197-270."""
+    """SSIM. Reference: ssim.py:197-270.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import structural_similarity_index_measure
+        >>> imgs = jnp.linspace(0.0, 1.0, 1 * 1 * 16 * 16).reshape(1, 1, 16, 16)
+        >>> round(float(structural_similarity_index_measure(imgs, imgs, data_range=1.0)), 4)
+        1.0
+    """
     preds, target = _ssim_check_inputs(preds, target)
     return _ssim_compute(
         preds, target, gaussian_kernel, sigma, kernel_size, reduction, data_range, k1, k2,
@@ -220,7 +228,16 @@ def multiscale_structural_similarity_index_measure(
     betas: Tuple[float, ...] = _MS_SSIM_BETAS,
     normalize: Optional[str] = None,
 ) -> Array:
-    """Multi-scale SSIM. Reference: ssim.py:545-638."""
+    """Multi-scale SSIM. Reference: ssim.py:545-638.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu.ops import multiscale_structural_similarity_index_measure
+        >>> target = jax.random.uniform(jax.random.PRNGKey(42), (1, 1, 256, 256))
+        >>> preds = target * 0.75
+        >>> round(float(multiscale_structural_similarity_index_measure(preds, target, data_range=1.0)), 4)
+        0.9631
+    """
     if not isinstance(betas, tuple):
         raise ValueError("Argument `betas` is expected to be of a type tuple.")
     if not all(isinstance(beta, float) for beta in betas):

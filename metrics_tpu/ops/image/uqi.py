@@ -74,6 +74,14 @@ def universal_image_quality_index(
     sigma: Sequence[float] = (1.5, 1.5),
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """UQI. Reference: uqi.py:115-160."""
+    """UQI. Reference: uqi.py:115-160.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import universal_image_quality_index
+        >>> imgs = jnp.linspace(0.0, 1.0, 2 * 1 * 16 * 16).reshape(2, 1, 16, 16)
+        >>> round(float(universal_image_quality_index(imgs, imgs)), 4)
+        1.0
+    """
     preds, target = _uqi_check_inputs(preds, target)
     return _uqi_compute(preds, target, kernel_size, sigma, reduction)

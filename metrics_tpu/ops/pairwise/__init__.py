@@ -55,7 +55,16 @@ def _zero_diag(distmat: Array, zero_diagonal: bool) -> Array:
 def pairwise_cosine_similarity(
     x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> Array:
-    """Pairwise cosine similarity matrix. Reference: pairwise/cosine.py."""
+    """Pairwise cosine similarity matrix. Reference: pairwise/cosine.py.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import pairwise_cosine_similarity
+        >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]])
+        >>> y = jnp.asarray([[1.0, 1.0], [2.0, 1.0]])
+        >>> [[round(float(v), 4) for v in row] for row in pairwise_cosine_similarity(x, y)]
+        [[0.9806, 0.8682], [0.9701, 0.8437], [0.9744, 0.8533]]
+    """
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
     norm_x = jnp.linalg.norm(x, ord=2, axis=1)
     norm_y = jnp.linalg.norm(y, ord=2, axis=1)
@@ -67,7 +76,16 @@ def pairwise_cosine_similarity(
 def pairwise_euclidean_distance(
     x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> Array:
-    """Pairwise euclidean distance matrix. Reference: pairwise/euclidean.py."""
+    """Pairwise euclidean distance matrix. Reference: pairwise/euclidean.py.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import pairwise_euclidean_distance
+        >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]])
+        >>> y = jnp.asarray([[1.0, 1.0], [2.0, 1.0]])
+        >>> [[round(float(v), 4) for v in row] for row in pairwise_euclidean_distance(x, y)]
+        [[2.2361, 2.0], [4.4721, 4.1231], [8.0623, 7.6158]]
+    """
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
     x_norm = jnp.sum(x * x, axis=1, keepdims=True)
     y_norm = jnp.sum(y * y, axis=1)
@@ -80,7 +98,16 @@ def pairwise_euclidean_distance(
 def pairwise_linear_similarity(
     x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> Array:
-    """Pairwise dot-product matrix. Reference: pairwise/linear.py."""
+    """Pairwise dot-product matrix. Reference: pairwise/linear.py.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import pairwise_linear_similarity
+        >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]])
+        >>> y = jnp.asarray([[1.0, 1.0], [2.0, 1.0]])
+        >>> [[round(float(v), 4) for v in row] for row in pairwise_linear_similarity(x, y)]
+        [[5.0, 7.0], [8.0, 11.0], [13.0, 18.0]]
+    """
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
     distmat = safe_matmul(x, y.T)
     distmat = _zero_diag(distmat, zero_diagonal)
@@ -90,7 +117,16 @@ def pairwise_linear_similarity(
 def pairwise_manhattan_distance(
     x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> Array:
-    """Pairwise L1 distance matrix. Reference: pairwise/manhattan.py."""
+    """Pairwise L1 distance matrix. Reference: pairwise/manhattan.py.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import pairwise_manhattan_distance
+        >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]])
+        >>> y = jnp.asarray([[1.0, 1.0], [2.0, 1.0]])
+        >>> [[round(float(v), 4) for v in row] for row in pairwise_manhattan_distance(x, y)]
+        [[3.0, 2.0], [6.0, 5.0], [11.0, 10.0]]
+    """
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
     distmat = jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
     distmat = _zero_diag(distmat, zero_diagonal)

@@ -38,7 +38,16 @@ def _mean_squared_error_compute(sum_squared_error: Array, n_obs, squared: bool =
 
 
 def mean_squared_error(preds: Array, target: Array, squared: bool = True, num_outputs: int = 1) -> Array:
-    """MSE (or RMSE with squared=False). Reference: mse.py:59-83."""
+    """MSE (or RMSE with squared=False). Reference: mse.py:59-83.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import mean_squared_error
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(mean_squared_error(preds, target)), 4)
+        0.375
+    """
     sum_squared_error, n_obs = _mean_squared_error_update(preds, target, num_outputs)
     return _mean_squared_error_compute(sum_squared_error, n_obs, squared=squared)
 
@@ -56,7 +65,16 @@ def _mean_absolute_error_compute(sum_abs_error: Array, n_obs) -> Array:
 
 
 def mean_absolute_error(preds: Array, target: Array) -> Array:
-    """MAE. Reference: mae.py:53-72."""
+    """MAE. Reference: mae.py:53-72.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import mean_absolute_error
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(mean_absolute_error(preds, target)), 4)
+        0.5
+    """
     sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)
     return _mean_absolute_error_compute(sum_abs_error, n_obs)
 
@@ -72,7 +90,16 @@ def _mean_squared_log_error_compute(sum_squared_log_error: Array, n_obs) -> Arra
 
 
 def mean_squared_log_error(preds: Array, target: Array) -> Array:
-    """MSLE. Reference: log_mse.py:55-77."""
+    """MSLE. Reference: log_mse.py:55-77.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import mean_squared_log_error
+        >>> target = jnp.asarray([2.5, 5.0, 4.0, 8.0])
+        >>> preds = jnp.asarray([3.0, 5.0, 2.5, 7.0])
+        >>> round(float(mean_squared_log_error(preds, target)), 4)
+        0.0397
+    """
     sum_squared_log_error, n_obs = _mean_squared_log_error_update(preds, target)
     return _mean_squared_log_error_compute(sum_squared_log_error, n_obs)
 
@@ -88,7 +115,16 @@ def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs) -
 
 
 def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """MAPE. Reference: mape.py:68-96."""
+    """MAPE. Reference: mape.py:68-96.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import mean_absolute_percentage_error
+        >>> target = jnp.asarray([1.0, 10.0, 1e6])
+        >>> preds = jnp.asarray([0.9, 15.0, 1.2e6])
+        >>> round(float(mean_absolute_percentage_error(preds, target)), 4)
+        0.2667
+    """
     sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(preds, target)
     return _mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)
 
@@ -102,7 +138,16 @@ def _symmetric_mean_absolute_percentage_error_update(
 
 
 def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """SMAPE. Reference: symmetric_mape.py:66-92."""
+    """SMAPE. Reference: symmetric_mape.py:66-92.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import symmetric_mean_absolute_percentage_error
+        >>> preds = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0.5, 1.0, 2.5, 3.0])
+        >>> round(float(symmetric_mean_absolute_percentage_error(preds, target)), 4)
+        0.5556
+    """
     sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
     return sum_abs_per_error / num_obs
 
@@ -121,6 +166,15 @@ def _weighted_mean_absolute_percentage_error_compute(sum_abs_error: Array, sum_s
 
 
 def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """WMAPE. Reference: wmape.py:55-83."""
+    """WMAPE. Reference: wmape.py:55-83.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import weighted_mean_absolute_percentage_error
+        >>> preds = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0.5, 1.0, 2.5, 3.0])
+        >>> round(float(weighted_mean_absolute_percentage_error(preds, target)), 4)
+        0.1429
+    """
     sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
     return _weighted_mean_absolute_percentage_error_compute(sum_abs_error, sum_scale)

@@ -62,7 +62,16 @@ def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Ar
 
 
 def pearson_corrcoef(preds: Array, target: Array) -> Array:
-    """Pearson correlation. Reference: pearson.py:85-104."""
+    """Pearson correlation. Reference: pearson.py:85-104.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import pearson_corrcoef
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(pearson_corrcoef(preds, target)), 4)
+        0.9849
+    """
     zero = jnp.zeros(1, dtype=preds.dtype if jnp.issubdtype(preds.dtype, jnp.floating) else jnp.float32)
     _, _, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
         preds, target, zero, zero, zero, zero, zero, zero
@@ -111,7 +120,16 @@ def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -
 
 
 def spearman_corrcoef(preds: Array, target: Array) -> Array:
-    """Spearman rank correlation. Reference: spearman.py:103-126."""
+    """Spearman rank correlation. Reference: spearman.py:103-126.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import spearman_corrcoef
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(spearman_corrcoef(preds, target)), 4)
+        1.0
+    """
     preds, target = _spearman_corrcoef_update(preds, target)
     return _spearman_corrcoef_compute(preds, target)
 
@@ -183,7 +201,16 @@ def _r2_score_compute(
 
 
 def r2_score(preds: Array, target: Array, adjusted: int = 0, multioutput: str = "uniform_average") -> Array:
-    """R². Reference: r2.py:118-163."""
+    """R². Reference: r2.py:118-163.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import r2_score
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(r2_score(preds, target)), 4)
+        0.9486
+    """
     sum_squared_obs, sum_obs, rss, n_obs = _r2_score_update(preds, target)
     return _r2_score_compute(sum_squared_obs, sum_obs, rss, n_obs, adjusted, multioutput)
 
@@ -234,7 +261,16 @@ def _explained_variance_compute(
 
 
 def explained_variance(preds: Array, target: Array, multioutput: str = "uniform_average") -> Array:
-    """Explained variance. Reference: explained_variance.py:103-147."""
+    """Explained variance. Reference: explained_variance.py:103-147.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import explained_variance
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(explained_variance(preds, target)), 4)
+        0.9572
+    """
     n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)
     return _explained_variance_compute(
         n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target, multioutput

@@ -31,7 +31,16 @@ def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[
 
 
 def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
-    """Batchwise cosine similarity. Reference: cosine_similarity.py:69-103."""
+    """Batchwise cosine similarity. Reference: cosine_similarity.py:69-103.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import cosine_similarity
+        >>> target = jnp.asarray([[0.0, 1.0], [1.0, 1.0]])
+        >>> preds = jnp.asarray([[0.0, 1.0], [0.0, 1.0]])
+        >>> round(float(cosine_similarity(preds, target, reduction='mean')), 4)
+        0.8536
+    """
     preds, target = _cosine_similarity_update(preds, target)
     return _cosine_similarity_compute(preds, target, reduction)
 
@@ -76,6 +85,15 @@ def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations:
 
 
 def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> Array:
-    """Tweedie deviance. Reference: tweedie_deviance.py:99-142."""
+    """Tweedie deviance. Reference: tweedie_deviance.py:99-142.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import tweedie_deviance_score
+        >>> preds = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        >>> target = jnp.asarray([1.5, 2.5, 3.5, 4.5])
+        >>> round(float(tweedie_deviance_score(preds, target, power=2)), 4)
+        0.0706
+    """
     sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, power)
     return _tweedie_deviance_score_compute(sum_deviance_score, num_observations)

@@ -26,7 +26,16 @@ def _sorted_by_preds(preds: Array, target: Array) -> Array:
 
 
 def retrieval_average_precision(preds: Array, target: Array) -> Array:
-    """AP of one query."""
+    """AP of one query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import retrieval_average_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> round(float(retrieval_average_precision(preds, target)), 4)
+        0.8333
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if not float(jnp.sum(target)):
         return jnp.asarray(0.0)
@@ -36,7 +45,16 @@ def retrieval_average_precision(preds: Array, target: Array) -> Array:
 
 
 def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
-    """RR of one query."""
+    """RR of one query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import retrieval_reciprocal_rank
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> round(float(retrieval_reciprocal_rank(preds, target)), 4)
+        1.0
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if not float(jnp.sum(target)):
         return jnp.asarray(0.0)
@@ -46,7 +64,16 @@ def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
 
 
 def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None, adaptive_k: bool = False) -> Array:
-    """Precision@k of one query."""
+    """Precision@k of one query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import retrieval_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> round(float(retrieval_precision(preds, target, k=2)), 4)
+        0.5
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if not isinstance(adaptive_k, bool):
         raise ValueError("`adaptive_k` has to be a boolean")
@@ -61,7 +88,16 @@ def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None, ad
 
 
 def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Array:
-    """Recall@k of one query."""
+    """Recall@k of one query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import retrieval_recall
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> round(float(retrieval_recall(preds, target, k=2)), 4)
+        0.5
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if k is None:
         k = preds.shape[-1]
@@ -74,7 +110,16 @@ def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Ar
 
 
 def retrieval_hit_rate(preds: Array, target: Array, k: Optional[int] = None) -> Array:
-    """HitRate@k of one query."""
+    """HitRate@k of one query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import retrieval_hit_rate
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> round(float(retrieval_hit_rate(preds, target, k=2)), 4)
+        1.0
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if k is None:
         k = preds.shape[-1]
@@ -85,7 +130,16 @@ def retrieval_hit_rate(preds: Array, target: Array, k: Optional[int] = None) -> 
 
 
 def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> Array:
-    """FallOut@k of one query (non-relevant retrieved / all non-relevant)."""
+    """FallOut@k of one query (non-relevant retrieved / all non-relevant).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import retrieval_fall_out
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> round(float(retrieval_fall_out(preds, target, k=2)), 4)
+        1.0
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     k = preds.shape[-1] if k is None else k
     if not (isinstance(k, int) and k > 0):
@@ -103,7 +157,16 @@ def _dcg(target: Array) -> Array:
 
 
 def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = None) -> Array:
-    """nDCG@k of one query (graded relevance allowed)."""
+    """nDCG@k of one query (graded relevance allowed).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import retrieval_normalized_dcg
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> round(float(retrieval_normalized_dcg(preds, target)), 4)
+        0.9197
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
     k = preds.shape[-1] if k is None else k
     if not (isinstance(k, int) and k > 0):
@@ -116,7 +179,16 @@ def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = Non
 
 
 def retrieval_r_precision(preds: Array, target: Array) -> Array:
-    """R-precision of one query."""
+    """R-precision of one query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import retrieval_r_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> round(float(retrieval_r_precision(preds, target)), 4)
+        0.5
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     relevant_number = int(jnp.sum(target))
     if not relevant_number:
@@ -128,7 +200,21 @@ def retrieval_r_precision(preds: Array, target: Array) -> Array:
 def retrieval_precision_recall_curve(
     preds: Array, target: Array, max_k: Optional[int] = None, adaptive_k: bool = False
 ) -> Tuple[Array, Array, Array]:
-    """Precision@k / recall@k for k = 1..max_k of one query."""
+    """Precision@k / recall@k for k = 1..max_k of one query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.ops import retrieval_precision_recall_curve
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> precisions, recalls, top_k = retrieval_precision_recall_curve(preds, target, max_k=2)
+        >>> [round(float(p), 4) for p in precisions]
+        [1.0, 0.5]
+        >>> [round(float(r), 4) for r in recalls]
+        [0.5, 0.5]
+        >>> top_k.tolist()
+        [1, 2]
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if not isinstance(adaptive_k, bool):
         raise ValueError("`adaptive_k` has to be a boolean")

@@ -98,7 +98,11 @@ def bleu_score(
     """Corpus BLEU with one or more references per sample (reference: bleu.py:146-189).
 
     Example:
-        >>> bleu_score(['the cat is on the mat'], [['there is a cat on the mat', 'a cat is on the mat']])
+        >>> from metrics_tpu.ops import bleu_score
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> round(float(bleu_score(preds, target)), 4)
+        0.7598
     """
     preds = [preds] if isinstance(preds, str) else preds
     target = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]

@@ -164,6 +164,13 @@ def chrf_score(
     """Corpus chrF (``n_word_order=0``) / chrF++ (``n_word_order=2``).
 
     Reference: chrf.py:523-599.
+
+    Example:
+        >>> from metrics_tpu.ops import chrf_score
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat']]
+        >>> round(float(chrf_score(preds, target)), 4)
+        0.4942
     """
     if not isinstance(n_char_order, int) or n_char_order < 1:
         raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")

@@ -144,7 +144,15 @@ def extended_edit_distance(
     deletion: float = 0.2,
     insertion: float = 1.0,
 ) -> Union[Array, Tuple[Array, Array]]:
-    """Corpus EED = mean sentence EED (reference: eed.py:357-412)."""
+    """Corpus EED = mean sentence EED (reference: eed.py:357-412).
+
+    Example:
+        >>> from metrics_tpu.ops import extended_edit_distance
+        >>> preds = ['this is the prediction', 'there is an other sample']
+        >>> target = ['this is the reference', 'there is another one']
+        >>> round(float(extended_edit_distance(preds, target)), 4)
+        0.3031
+    """
     for name, val in (("alpha", alpha), ("rho", rho), ("deletion", deletion), ("insertion", insertion)):
         if not isinstance(val, float) or val < 0:
             raise ValueError(f"Expected argument `{name}` to be a non-negative float")

@@ -53,7 +53,15 @@ def _wer_compute(errors: Array, total: Array) -> Array:
 
 
 def word_error_rate(preds: _Corpus, target: _Corpus) -> Array:
-    """WER = word edit distance / reference words (reference: wer.py:65-83)."""
+    """WER = word edit distance / reference words (reference: wer.py:65-83).
+
+    Example:
+        >>> from metrics_tpu.ops import word_error_rate
+        >>> preds = ['this is the prediction', 'there is an other sample']
+        >>> target = ['this is the reference', 'there is another one']
+        >>> round(float(word_error_rate(preds, target)), 4)
+        0.5
+    """
     return _wer_compute(*_wer_update(preds, target))
 
 
@@ -72,7 +80,15 @@ def _cer_compute(errors: Array, total: Array) -> Array:
 
 
 def char_error_rate(preds: _Corpus, target: _Corpus) -> Array:
-    """CER = char edit distance / reference chars (reference: cer.py:66-84)."""
+    """CER = char edit distance / reference chars (reference: cer.py:66-84).
+
+    Example:
+        >>> from metrics_tpu.ops import char_error_rate
+        >>> preds = ['this is the prediction', 'there is an other sample']
+        >>> target = ['this is the reference', 'there is another one']
+        >>> round(float(char_error_rate(preds, target)), 4)
+        0.3415
+    """
     return _cer_compute(*_cer_update(preds, target))
 
 
@@ -86,7 +102,15 @@ def _mer_compute(errors: Array, total: Array) -> Array:
 
 
 def match_error_rate(preds: _Corpus, target: _Corpus) -> Array:
-    """MER = edits / max(ref, pred) words (reference: mer.py:66-89)."""
+    """MER = edits / max(ref, pred) words (reference: mer.py:66-89).
+
+    Example:
+        >>> from metrics_tpu.ops import match_error_rate
+        >>> preds = ['this is the prediction', 'there is an other sample']
+        >>> target = ['this is the reference', 'there is another one']
+        >>> round(float(match_error_rate(preds, target)), 4)
+        0.4444
+    """
     return _mer_compute(*_mer_update(preds, target))
 
 
@@ -101,7 +125,16 @@ def _wil_compute(errors: Array, target_total: Array, preds_total: Array) -> Arra
 
 def word_information_lost(preds: _Corpus, target: _Corpus) -> Array:
     """WIL = 1 - (H/N_ref)(H/N_hyp) with H = max-len total minus edits
-    (reference: wil.py:70-93)."""
+
+    (reference: wil.py:70-93).
+
+    Example:
+        >>> from metrics_tpu.ops import word_information_lost
+        >>> preds = ['this is the prediction', 'there is an other sample']
+        >>> target = ['this is the reference', 'there is another one']
+        >>> round(float(word_information_lost(preds, target)), 4)
+        0.6528
+    """
     return _wil_compute(*_wil_update(preds, target))
 
 
@@ -115,5 +148,13 @@ def _wip_compute(errors: Array, target_total: Array, preds_total: Array) -> Arra
 
 
 def word_information_preserved(preds: _Corpus, target: _Corpus) -> Array:
-    """WIP = (H/N_ref)(H/N_hyp) (reference: wip.py:69-92)."""
+    """WIP = (H/N_ref)(H/N_hyp) (reference: wip.py:69-92).
+
+    Example:
+        >>> from metrics_tpu.ops import word_information_preserved
+        >>> preds = ['this is the prediction', 'there is an other sample']
+        >>> target = ['this is the reference', 'there is another one']
+        >>> round(float(word_information_preserved(preds, target)), 4)
+        0.3472
+    """
     return _wip_compute(*_wip_update(preds, target))

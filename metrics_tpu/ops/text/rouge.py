@@ -232,7 +232,15 @@ def rouge_score(
     rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
 ) -> Dict[str, Array]:
     """Aggregated ROUGE scores: mean P/R/F per key over sentences
-    (reference: rouge.py:390-489)."""
+
+    (reference: rouge.py:390-489).
+
+    Example:
+        >>> from metrics_tpu.ops import rouge_score
+        >>> scores = rouge_score(['My name is John'], ['Is your name John'])
+        >>> round(float(scores['rouge1_fmeasure']), 4)
+        0.75
+    """
     if use_stemmer and not _NLTK_AVAILABLE:
         raise ModuleNotFoundError("Stemmer requires that `nltk` is installed.")
     stemmer = None

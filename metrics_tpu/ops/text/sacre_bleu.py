@@ -146,7 +146,15 @@ def sacre_bleu_score(
     tokenize: str = "13a",
     lowercase: bool = False,
 ) -> Array:
-    """SacreBLEU corpus score (reference: sacre_bleu.py:280-337)."""
+    """SacreBLEU corpus score (reference: sacre_bleu.py:280-337).
+
+    Example:
+        >>> from metrics_tpu.ops import sacre_bleu_score
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> round(float(sacre_bleu_score(preds, target)), 4)
+        0.7598
+    """
     if len(preds) != len(target):
         raise ValueError(f"Corpus has different size {len(preds)} != {len(target)}")
     tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)

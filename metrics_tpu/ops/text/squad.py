@@ -116,7 +116,15 @@ def _squad_compute(f1: Array, exact_match: Array, total: Array) -> Dict[str, Arr
 
 
 def squad(preds: PREDS_TYPE, target: TARGETS_TYPE) -> Dict[str, Array]:
-    """SQuAD metric over prediction/target dicts (reference: squad.py:197-255)."""
+    """SQuAD metric over prediction/target dicts (reference: squad.py:197-255).
+
+    Example:
+        >>> from metrics_tpu.ops import squad
+        >>> preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
+        >>> target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
+        >>> {k: round(float(v), 1) for k, v in squad(preds, target).items()}
+        {'exact_match': 100.0, 'f1': 100.0}
+    """
     preds_dict, target_dict = _squad_input_check(preds, target)
     f1, exact_match, total = _squad_update(preds_dict, target_dict)
     return _squad_compute(f1, exact_match, total)

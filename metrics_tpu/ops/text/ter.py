@@ -333,7 +333,15 @@ def translation_edit_rate(
     asian_support: bool = False,
     return_sentence_level_score: bool = False,
 ) -> Union[Array, Tuple[Array, Array]]:
-    """Corpus TER (reference: ter.py:523-595)."""
+    """Corpus TER (reference: ter.py:523-595).
+
+    Example:
+        >>> from metrics_tpu.ops import translation_edit_rate
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> round(float(translation_edit_rate(preds, target)), 4)
+        0.1538
+    """
     for name, val in (("normalize", normalize), ("no_punctuation", no_punctuation),
                       ("lowercase", lowercase), ("asian_support", asian_support)):
         if not isinstance(val, bool):
