@@ -1,0 +1,7 @@
+"""Thin alias: the benchmark grid lives in bench.py at the repo root."""
+import os
+import runpy
+import sys
+
+sys.argv = [os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")] + sys.argv[1:]
+runpy.run_path(sys.argv[0], run_name="__main__")
