@@ -1,0 +1,190 @@
+"""Property-based tests of the merge/sync algebra (hypothesis).
+
+``merge_states`` is the load-bearing primitive: cross-device sync IS a merge
+of per-device partial states (SURVEY.md §7 design decision 2). The property
+that makes distributed results correct is the accumulation homomorphism —
+updating on a data split and merging must equal updating sequentially —
+plus merge commutativity for order-independent metrics. Hypothesis searches
+the input space instead of relying on a handful of fixtures.
+"""
+import numpy as np
+import pytest
+from hypothesis import HealthCheck, assume, given, settings
+from hypothesis import strategies as st
+from hypothesis.extra.numpy import arrays
+
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    Accuracy,
+    MaxMetric,
+    MeanMetric,
+    MeanSquaredError,
+    MinMetric,
+    PearsonCorrCoef,
+    R2Score,
+    StatScores,
+    SumMetric,
+)
+
+SETTINGS = settings(
+    max_examples=25,
+    deadline=None,  # jit compiles on first example
+    suppress_health_check=[HealthCheck.too_slow, HealthCheck.data_too_large],
+    derandomize=True,  # CI-stable example sequence
+)
+
+floats = st.floats(min_value=-1e3, max_value=1e3, allow_nan=False, width=32)
+
+
+def batches_strategy(n_batches=2):
+    """A list of float batches with independent lengths in [1, 16]."""
+    return st.lists(
+        st.integers(min_value=1, max_value=16).flatmap(
+            lambda n: arrays(np.float32, (n,), elements=floats)
+        ),
+        min_size=n_batches,
+        max_size=n_batches,
+    )
+
+
+def _accumulate(metric, batches, update):
+    state = metric.init_state()
+    for batch in batches:
+        state = update(state, batch)
+    return state
+
+
+@pytest.mark.parametrize("metric_cls", [SumMetric, MeanMetric, MaxMetric, MinMetric])
+@SETTINGS
+@given(batches=batches_strategy(4))
+def test_aggregator_split_merge_equals_sequential(metric_cls, batches):
+    metric = metric_cls(nan_strategy="ignore")
+
+    def update(state, batch):
+        return metric.update_state(state, jnp.asarray(batch))
+
+    sequential = _accumulate(metric, batches, update)
+    left = _accumulate(metric, batches[:2], update)
+    right = _accumulate(metric, batches[2:], update)
+    merged = metric.merge_states(left, right, update_counts=(2, 2))
+    np.testing.assert_allclose(
+        np.asarray(metric.compute_state(merged)),
+        np.asarray(metric.compute_state(sequential)),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("metric_cls", [SumMetric, MaxMetric, MinMetric])
+@SETTINGS
+@given(batches=batches_strategy(2))
+def test_aggregator_merge_commutes(metric_cls, batches):
+    metric = metric_cls(nan_strategy="ignore")
+
+    def one(batch):
+        return metric.update_state(metric.init_state(), jnp.asarray(batch))
+
+    a, b = one(batches[0]), one(batches[1])
+    ab = metric.compute_state(metric.merge_states(a, b))
+    ba = metric.compute_state(metric.merge_states(b, a))
+    np.testing.assert_allclose(np.asarray(ab), np.asarray(ba), rtol=1e-6)
+
+
+@SETTINGS
+@given(
+    preds=arrays(np.float32, (24,), elements=floats),
+    target=arrays(np.float32, (24,), elements=floats),
+)
+def test_mse_split_merge_equals_sequential(preds, target):
+    metric = MeanSquaredError()
+
+    def upd(state, lo, hi):
+        return metric.update_state(state, jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
+
+    sequential = upd(upd(metric.init_state(), 0, 12), 12, 24)
+    merged = metric.merge_states(upd(metric.init_state(), 0, 12), upd(metric.init_state(), 12, 24))
+    np.testing.assert_allclose(
+        np.asarray(metric.compute_state(merged)),
+        np.asarray(metric.compute_state(sequential)),
+        rtol=1e-5,
+    )
+
+
+@SETTINGS
+@given(
+    preds=arrays(np.float32, (32,), elements=floats),
+    target=arrays(np.float32, (32,), elements=floats),
+)
+def test_pearson_running_moments_merge(preds, target):
+    """Chan-style moment merging must match single-pass accumulation — the
+    trickiest merge in the library (reference pearson.py:66 running update).
+
+    Zero-variance draws are excluded: with var(x) = 0 the correlation is 0/0,
+    mathematically undefined, and the two accumulation orders legitimately
+    produce different f32 noise there.
+    """
+    for arr in (preds, target):
+        for chunk in (arr[:20], arr[20:]):
+            assume(float(np.std(chunk.astype(np.float64))) > 1e-2)
+    metric = PearsonCorrCoef()
+
+    def upd(state, lo, hi):
+        return metric.update_state(state, jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
+
+    sequential = upd(upd(metric.init_state(), 0, 20), 20, 32)
+    merged = metric.merge_states(upd(metric.init_state(), 0, 20), upd(metric.init_state(), 20, 32))
+    seq_val = np.asarray(metric.compute_state(sequential))
+    mrg_val = np.asarray(metric.compute_state(merged))
+    if np.isnan(seq_val) or np.isnan(mrg_val):  # degenerate zero-variance draws
+        assert np.isnan(seq_val) and np.isnan(mrg_val)
+    else:
+        np.testing.assert_allclose(mrg_val, seq_val, rtol=1e-3, atol=1e-5)
+
+
+@SETTINGS
+@given(
+    preds=arrays(np.int64, (40,), elements=st.integers(min_value=0, max_value=4)),
+    target=arrays(np.int64, (40,), elements=st.integers(min_value=0, max_value=4)),
+    split=st.integers(min_value=1, max_value=39),
+)
+def test_stat_scores_split_merge_equals_sequential(preds, target, split):
+    metric = StatScores(reduce="macro", num_classes=5)
+
+    def upd(state, lo, hi):
+        return metric.update_state(state, jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
+
+    sequential = upd(upd(metric.init_state(), 0, split), split, 40)
+    merged = metric.merge_states(upd(metric.init_state(), 0, split), upd(metric.init_state(), split, 40))
+    np.testing.assert_array_equal(
+        np.asarray(metric.compute_state(merged)), np.asarray(metric.compute_state(sequential))
+    )
+
+
+@SETTINGS
+@given(
+    preds=arrays(np.int64, (30,), elements=st.integers(min_value=0, max_value=3)),
+    target=arrays(np.int64, (30,), elements=st.integers(min_value=0, max_value=3)),
+)
+def test_accuracy_matches_numpy_anywhere(preds, target):
+    metric = Accuracy(num_classes=4)
+    metric.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(float(metric.compute()), float((preds == target).mean()), rtol=1e-6)
+
+
+@SETTINGS
+@given(
+    preds=arrays(np.float32, (16,), elements=floats),
+    target=arrays(np.float32, (16,), elements=floats),
+)
+def test_r2_split_merge_equals_sequential(preds, target):
+    metric = R2Score()
+
+    def upd(state, lo, hi):
+        return metric.update_state(state, jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
+
+    sequential = upd(upd(metric.init_state(), 0, 8), 8, 16)
+    merged = metric.merge_states(upd(metric.init_state(), 0, 8), upd(metric.init_state(), 8, 16))
+    seq_val = np.asarray(metric.compute_state(sequential))
+    mrg_val = np.asarray(metric.compute_state(merged))
+    np.testing.assert_allclose(mrg_val, seq_val, rtol=1e-4, atol=1e-5)
