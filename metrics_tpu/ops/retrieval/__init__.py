@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+from metrics_tpu.utils.compute import safe_divide
 
 
 def _sorted_by_preds(preds: Array, target: Array) -> Array:
@@ -37,11 +38,12 @@ def retrieval_average_precision(preds: Array, target: Array) -> Array:
         0.8333
     """
     preds, target = _check_retrieval_functional_inputs(preds, target)
-    if not float(jnp.sum(target)):
-        return jnp.asarray(0.0)
-    target = _sorted_by_preds(preds, target)
-    positions = jnp.arange(1, len(target) + 1, dtype=jnp.float32)[target > 0]
-    return jnp.mean((jnp.arange(len(positions), dtype=jnp.float32) + 1) / positions)
+    # fully traceable (no data-dependent python branches): for the i-th ranked
+    # document, precision@i = cumsum(rel)/rank; AP averages it over relevant
+    # ranks; an all-negative query scores 0
+    rel = _sorted_by_preds(preds, target).astype(jnp.float32)
+    ranks = jnp.arange(1, rel.shape[-1] + 1, dtype=jnp.float32)
+    return safe_divide(jnp.sum(rel * jnp.cumsum(rel) / ranks), jnp.sum(rel))
 
 
 def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
@@ -56,11 +58,9 @@ def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
         1.0
     """
     preds, target = _check_retrieval_functional_inputs(preds, target)
-    if not float(jnp.sum(target)):
-        return jnp.asarray(0.0)
-    target = _sorted_by_preds(preds, target)
-    position = jnp.nonzero(target)[0]
-    return 1.0 / (position[0] + 1.0)
+    rel = _sorted_by_preds(preds, target)
+    first = jnp.argmax(rel > 0)  # first positive's rank (argmax = first max)
+    return jnp.where(jnp.sum(rel) == 0, 0.0, 1.0 / (first + 1.0))
 
 
 def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None, adaptive_k: bool = False) -> Array:
@@ -81,10 +81,9 @@ def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None, ad
         k = preds.shape[-1]
     if not (isinstance(k, int) and k > 0):
         raise ValueError("`k` has to be a positive integer or None")
-    if not float(jnp.sum(target)):
-        return jnp.asarray(0.0)
-    relevant = jnp.sum(_sorted_by_preds(preds, target)[: min(k, preds.shape[-1])]).astype(jnp.float32)
-    return relevant / k
+    # no zero-positives guard needed: with no relevant documents the top-k sum
+    # is already 0 and k is a positive python int
+    return jnp.sum(_sorted_by_preds(preds, target)[: min(k, preds.shape[-1])]).astype(jnp.float32) / k
 
 
 def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Array:
@@ -103,10 +102,7 @@ def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Ar
         k = preds.shape[-1]
     if not (isinstance(k, int) and k > 0):
         raise ValueError("`k` has to be a positive integer or None")
-    if not float(jnp.sum(target)):
-        return jnp.asarray(0.0)
-    relevant = jnp.sum(_sorted_by_preds(preds, target)[:k]).astype(jnp.float32)
-    return relevant / jnp.sum(target)
+    return safe_divide(jnp.sum(_sorted_by_preds(preds, target)[:k]).astype(jnp.float32), jnp.sum(target))
 
 
 def retrieval_hit_rate(preds: Array, target: Array, k: Optional[int] = None) -> Array:
@@ -145,10 +141,7 @@ def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> 
     if not (isinstance(k, int) and k > 0):
         raise ValueError("`k` has to be a positive integer or None")
     target = 1 - target
-    if not float(jnp.sum(target)):
-        return jnp.asarray(0.0)
-    relevant = jnp.sum(_sorted_by_preds(preds, target)[:k]).astype(jnp.float32)
-    return relevant / jnp.sum(target)
+    return safe_divide(jnp.sum(_sorted_by_preds(preds, target)[:k]).astype(jnp.float32), jnp.sum(target))
 
 
 def _dcg(target: Array) -> Array:
@@ -190,11 +183,12 @@ def retrieval_r_precision(preds: Array, target: Array) -> Array:
         0.5
     """
     preds, target = _check_retrieval_functional_inputs(preds, target)
-    relevant_number = int(jnp.sum(target))
-    if not relevant_number:
-        return jnp.asarray(0.0)
-    relevant = jnp.sum(_sorted_by_preds(preds, target)[:relevant_number]).astype(jnp.float32)
-    return relevant / relevant_number
+    # traceable top-R selection: count hits at ranks < R with a mask instead
+    # of a data-dependent slice
+    rel = _sorted_by_preds(preds, target).astype(jnp.float32)
+    total = jnp.sum(rel)
+    in_top_r = jnp.arange(rel.shape[-1], dtype=jnp.float32) < total
+    return safe_divide(jnp.sum(rel * in_top_r), total)
 
 
 def retrieval_precision_recall_curve(

@@ -21,6 +21,25 @@ _AUD_T = jnp.asarray(_rng.normal(size=(2, 2000)).astype(np.float32))
 _AUD_P = _AUD_T + 0.3 * jnp.asarray(_rng.normal(size=(2, 2000)).astype(np.float32))
 _MIX_T = jnp.asarray(_rng.normal(size=(2, 3, 1500)).astype(np.float32))  # (B, S, T)
 _MIX_P = _MIX_T[:, ::-1] + 0.2 * jnp.asarray(_rng.normal(size=(2, 3, 1500)).astype(np.float32))
+_STOI_T = jnp.asarray(_rng.normal(size=(8000,)).astype(np.float32))
+_STOI_P = _STOI_T + 0.2 * jnp.asarray(_rng.normal(size=(8000,)).astype(np.float32))
+_BIG_T = jnp.asarray(_rng.random((1, 1, 192, 192)).astype(np.float32))
+_BIG_P = 0.8 * _BIG_T + 0.2 * jnp.asarray(_rng.random((1, 1, 192, 192)).astype(np.float32))
+_RP = jnp.asarray(_rng.random(10).astype(np.float32))
+_RT = jnp.asarray(_rng.integers(0, 2, 10).astype(bool))
+_BOXES_A = jnp.asarray((_rng.random((4, 2)) * 50).astype(np.float32))
+_BOXES_A = jnp.concatenate([_BOXES_A, _BOXES_A + 10], axis=1)
+_BOXES_B = _BOXES_A[:2] + 5.0
+_MASKS = jnp.asarray(_rng.integers(0, 2, (3, 8, 8)).astype(bool))
+__sq_a = jnp.asarray(_rng.normal(size=(6, 6)).astype(np.float32))
+__sq_b = jnp.asarray(_rng.normal(size=(6, 6)).astype(np.float32))
+_COV_A = __sq_a @ __sq_a.T  # symmetric PSD
+_COV_B = __sq_b @ __sq_b.T
+_FEAT_A = jnp.asarray(_rng.normal(size=(32, 6)).astype(np.float32))
+_FEAT_B = jnp.asarray(_rng.normal(size=(32, 6)).astype(np.float32))
+
+from metrics_tpu.ops.detection import boxes as _boxes  # noqa: E402
+from metrics_tpu.ops.image import fid as _fid_ops  # noqa: E402
 
 CASES = [
     ("mse", lambda: ops.mean_squared_error(_P, _T)),
@@ -50,6 +69,23 @@ CASES = [
     ("pairwise_euclidean", lambda: ops.pairwise_euclidean_distance(_P2, _T2)),
     ("pairwise_linear", lambda: ops.pairwise_linear_similarity(_P2, _T2)),
     ("pairwise_manhattan", lambda: ops.pairwise_manhattan_distance(_P2, _T2)),
+    ("stoi", lambda: ops.short_time_objective_intelligibility(_STOI_P, _STOI_T, 8000)),
+    ("msssim", lambda: ops.multiscale_structural_similarity_index_measure(_BIG_P, _BIG_T, data_range=1.0)),
+    ("image_gradients_dy", lambda: ops.image_gradients(_IMG_P)[0]),
+    ("retrieval_ap", lambda: ops.retrieval_average_precision(_RP, _RT)),
+    ("retrieval_mrr", lambda: ops.retrieval_reciprocal_rank(_RP, _RT)),
+    ("retrieval_ndcg", lambda: ops.retrieval_normalized_dcg(_RP, _RT)),
+    ("retrieval_precision", lambda: ops.retrieval_precision(_RP, _RT, k=3)),
+    ("retrieval_recall", lambda: ops.retrieval_recall(_RP, _RT, k=3)),
+    ("retrieval_fall_out", lambda: ops.retrieval_fall_out(_RP, _RT, k=3)),
+    ("retrieval_hit_rate", lambda: ops.retrieval_hit_rate(_RP, _RT, k=3)),
+    ("retrieval_r_precision", lambda: ops.retrieval_r_precision(_RP, _RT)),
+    ("box_iou", lambda: _boxes.box_iou(_BOXES_A, _BOXES_B)),
+    ("box_area", lambda: _boxes.box_area(_BOXES_A)),
+    ("box_convert", lambda: _boxes.box_convert(_BOXES_A, "xyxy", "cxcywh")),
+    ("mask_iou", lambda: _boxes.mask_iou(_MASKS, _MASKS)),
+    ("fid_trace_sqrtm", lambda: _fid_ops.trace_sqrtm_product(_COV_A, _COV_B)),
+    ("fid_frechet", lambda: _fid_ops.frechet_distance(_FEAT_A, _FEAT_B)),
 ]
 
 
