@@ -80,6 +80,8 @@ CASES = [
     ("retrieval_fall_out", lambda: ops.retrieval_fall_out(_RP, _RT, k=3)),
     ("retrieval_hit_rate", lambda: ops.retrieval_hit_rate(_RP, _RT, k=3)),
     ("retrieval_r_precision", lambda: ops.retrieval_r_precision(_RP, _RT)),
+    ("retrieval_curve_precision", lambda: ops.retrieval_precision_recall_curve(_RP, _RT, max_k=5)[0]),
+    ("retrieval_curve_recall", lambda: ops.retrieval_precision_recall_curve(_RP, _RT, max_k=5)[1]),
     ("box_iou", lambda: _boxes.box_iou(_BOXES_A, _BOXES_B)),
     ("box_area", lambda: _boxes.box_area(_BOXES_A)),
     ("box_convert", lambda: _boxes.box_convert(_BOXES_A, "xyxy", "cxcywh")),
