@@ -365,6 +365,8 @@ class TestAudioModules(MetricTester):
 
     def test_precision_bf16(self):
         self.run_precision_test(PREDS, TARGET, lambda p, t: signal_noise_ratio(p, t.astype(p.dtype)))
+        self.run_precision_test(PREDS, TARGET, lambda p, t: scale_invariant_signal_noise_ratio(p, t.astype(p.dtype)))
+        self.run_precision_test(PREDS, TARGET, lambda p, t: scale_invariant_signal_distortion_ratio(p, t.astype(p.dtype)))
 
 
 # --------------------------------------------------------------------------- #

@@ -1,0 +1,58 @@
+"""BootStrapper distributed semantics: cross-device sync IS a state merge.
+
+The vmap-stacked bootstrap states register per-state reductions, so the same
+``merge_states`` that powers collective sync must combine two workers' partial
+bootstrap states into the state one worker would have produced seeing all the
+data (up to resampling noise). Reference analog: N module copies each synced
+like a normal metric (wrappers/bootstrapping.py:49).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, BootStrapper, MeanSquaredError
+
+
+def _states(metric):
+    return {name: getattr(metric, name) for name in metric._defaults}
+
+
+def test_bootstrap_merge_matches_single_worker_accuracy():
+    rng = np.random.default_rng(0)
+    preds = rng.integers(0, 5, size=(4, 64)).astype(np.int32)
+    target = np.where(rng.uniform(size=(4, 64)) < 0.7, preds, rng.integers(0, 5, size=(4, 64))).astype(np.int32)
+
+    worker_a = BootStrapper(Accuracy(num_classes=5), num_bootstraps=32, seed=1)
+    worker_b = BootStrapper(Accuracy(num_classes=5), num_bootstraps=32, seed=2)
+    for i in range(2):
+        worker_a.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    for i in range(2, 4):
+        worker_b.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+
+    merged = worker_a.merge_states(_states(worker_a), _states(worker_b))
+    out = worker_a.compute_state(merged)
+
+    global_acc = float((preds == target).mean())
+    # the bootstrap mean over 32 resamples of all 256 samples concentrates
+    # around the global accuracy; std stays small but positive
+    assert out["mean"] == pytest.approx(global_acc, abs=0.05)
+    assert 0.0 < float(out["std"]) < 0.1
+
+
+def test_bootstrap_merge_is_commutative():
+    rng = np.random.default_rng(3)
+    preds = rng.normal(size=(4, 32)).astype(np.float32)
+    target = preds + 0.1 * rng.normal(size=(4, 32)).astype(np.float32)
+
+    worker_a = BootStrapper(MeanSquaredError(), num_bootstraps=16, seed=5)
+    worker_b = BootStrapper(MeanSquaredError(), num_bootstraps=16, seed=6)
+    for i in range(2):
+        worker_a.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    for i in range(2, 4):
+        worker_b.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+
+    ab = worker_a.compute_state(worker_a.merge_states(_states(worker_a), _states(worker_b)))
+    ba = worker_a.compute_state(worker_a.merge_states(_states(worker_b), _states(worker_a)))
+    np.testing.assert_allclose(float(ab["mean"]), float(ba["mean"]), rtol=1e-6)
+    np.testing.assert_allclose(float(ab["std"]), float(ba["std"]), rtol=1e-5)
