@@ -187,6 +187,25 @@ def bert_score(
 ) -> Dict[str, Union[List[float], str]]:
     """BERTScore precision/recall/f1 per sentence pair (reference: bert.py:438-573).
 
+    Example (own encoder — a plain embedding table):
+        >>> import numpy as np
+        >>> from metrics_tpu.ops import bert_score
+        >>> VOCAB = ["[CLS]", "[SEP]", "[PAD]", "hello", "there", "master", "kenobi"]
+        >>> table = np.random.default_rng(0).normal(size=(len(VOCAB), 8)).astype(np.float32)
+        >>> def tokenizer(sentences):
+        ...     ids = np.full((len(sentences), 6), VOCAB.index("[PAD]"), dtype=np.int32)
+        ...     mask = np.zeros((len(sentences), 6), dtype=np.int32)
+        ...     for row, sent in enumerate(sentences):
+        ...         for col, word in enumerate(["[CLS]"] + sent.split()[:4] + ["[SEP]"]):
+        ...             ids[row, col] = VOCAB.index(word)
+        ...             mask[row, col] = 1
+        ...     return {"input_ids": ids, "attention_mask": mask}
+        >>> out = bert_score(["hello there", "master kenobi"], ["hello there", "hello kenobi"],
+        ...                  model=object(), user_tokenizer=tokenizer, max_length=6,
+        ...                  user_forward_fn=lambda model, batch: table[np.asarray(batch["input_ids"])])
+        >>> {key: [round(float(v), 4) for v in values] for key, values in out.items()}
+        {'precision': [1.0, 0.5], 'recall': [1.0, 0.8545], 'f1': [1.0, 0.6309]}
+
     ``preds``/``target`` are lists of sentences, or pre-tokenized dicts with
     ``input_ids``/``attention_mask`` (arrays). A Flax encoder is used on
     device; pass ``model`` (+ ``user_tokenizer``/``user_forward_fn``) to
