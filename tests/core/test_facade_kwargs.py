@@ -1,0 +1,87 @@
+"""Facade constructor kwargs that had no dedicated coverage.
+
+``compute_on_cpu`` (the reference's GPU-memory relief valve, metric.py:90,
+381-391 — here device->host offload of list states), ``sync_on_compute``
+(whether ``compute()`` synchronizes automatically, metric.py:96), and
+``dist_sync_fn`` (the injection point Lightning uses for its fused gather,
+metric.py:104) — the three §5.6/§5.8 config mechanisms of the base class.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import AUROC, Accuracy, CatMetric, MeanMetric
+from metrics_tpu.parallel.sync import sync_axes
+
+
+def test_compute_on_cpu_offloads_list_states_and_computes_correctly():
+    metric = CatMetric(compute_on_cpu=True)
+    metric.update(jnp.asarray([1.0, 2.0]))
+    metric.update(jnp.asarray([3.0]))
+    cpu_devices = {d for d in jax.devices("cpu")}
+    for chunk in metric.value:
+        assert next(iter(chunk.devices())) in cpu_devices
+    np.testing.assert_array_equal(np.asarray(metric.compute()), [1.0, 2.0, 3.0])
+
+
+def test_compute_on_cpu_curve_metric_matches_default():
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.uniform(size=(64,)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 2, size=(64,)).astype(np.int32))
+    offloaded, default = AUROC(compute_on_cpu=True), AUROC()
+    offloaded.update(preds, target)
+    default.update(preds, target)
+    assert float(offloaded.compute()) == pytest.approx(float(default.compute()), abs=1e-7)
+
+
+def test_compute_on_cpu_rejects_non_bool():
+    with pytest.raises(ValueError, match="compute_on_cpu"):
+        Accuracy(compute_on_cpu="yes")
+
+
+def test_sync_on_compute_false_skips_automatic_sync():
+    calls = []
+
+    def spy_sync_fn(state, reductions, axes):
+        calls.append(axes)
+        return state
+
+    metric = MeanMetric(sync_on_compute=False, dist_sync_fn=spy_sync_fn)
+    metric.update(jnp.asarray([1.0, 3.0]))
+    with sync_axes("data"):  # a collective context is active...
+        assert float(metric.compute()) == pytest.approx(2.0)
+    assert calls == []  # ...but sync_on_compute=False must not sync
+
+    synced = MeanMetric(sync_on_compute=True, dist_sync_fn=spy_sync_fn)
+    synced.update(jnp.asarray([1.0, 3.0]))
+    with sync_axes("data"):
+        synced.compute()
+    assert len(calls) == 1  # the default cadence does sync
+
+
+def test_dist_sync_fn_injection_replaces_builtin_sync():
+    """A custom sync callable sees (state, reductions, axes) and its returned
+    state is what compute() consumes — the Lightning fused-gather contract."""
+    seen = {}
+
+    def doubling_sync(state, reductions, axes):
+        seen["reductions"] = dict(reductions)
+        seen["axes"] = axes
+        return {k: jax.tree.map(lambda x: x * 2, v) if not isinstance(v, list) else v for k, v in state.items()}
+
+    metric = MeanMetric(dist_sync_fn=doubling_sync, process_group="data")
+    metric.update(jnp.asarray([1.0, 3.0]))
+    # sum-reduced states doubled on both sides: the mean is unchanged,
+    # proving compute() ran on the injected function's output
+    with sync_axes("data"):
+        assert float(metric.compute()) == pytest.approx(2.0)
+    assert seen["axes"] == "data"
+    assert set(seen["reductions"]) == {"value", "weight"}
+    assert metric._is_synced is False  # unsync restored local state after compute
+
+
+def test_dist_sync_fn_rejects_non_callable():
+    with pytest.raises(ValueError, match="dist_sync_fn"):
+        Accuracy(dist_sync_fn="not-a-function")
