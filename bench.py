@@ -632,12 +632,24 @@ def bench_retrieval() -> dict:
     jax.block_until_ready(compiled.compute())
     compiled_ms = (time.perf_counter() - t0) * 1e3
 
+    # the eager per-query python loop is timed on a subset and scaled: its cost
+    # is strictly linear in queries, and the full 4096-query loop through a
+    # remote-device tunnel takes tens of minutes (each query is dozens of tiny
+    # dispatches — the very overhead the compiled path removes)
+    eager_queries = 256
+    sub = eager_queries * docs_per_query
     eager = RetrievalMAP()
-    eager.update(preds, target, indexes=indexes)
+    eager.update(preds[:sub], target[:sub], indexes=indexes[:sub])
     t0 = time.perf_counter()
     jax.block_until_ready(eager.compute())
-    eager_ms = (time.perf_counter() - t0) * 1e3
-    return {"docs": n, "compiled_compute_ms": compiled_ms, "eager_compute_ms": eager_ms, "speedup": eager_ms / compiled_ms}
+    eager_ms = (time.perf_counter() - t0) * 1e3 * (n_queries / eager_queries)
+    return {
+        "docs": n,
+        "compiled_compute_ms": compiled_ms,
+        "eager_compute_ms_extrapolated": eager_ms,
+        "eager_sample_queries": eager_queries,
+        "speedup": eager_ms / compiled_ms,
+    }
 
 
 def bench_binned_curve() -> dict:
