@@ -1,0 +1,72 @@
+"""Direct tests for the small utility surfaces (prints, mesh helpers).
+
+These modules were only exercised indirectly; the reference ships dedicated
+utilities tests (tests/test_utilities.py), so the gated-logging contract and
+the mesh constructors get their own assertions here.
+"""
+import logging
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+
+from metrics_tpu.parallel.mesh import batch_sharded, data_parallel_mesh, make_mesh, replicated
+from metrics_tpu.utils.prints import rank_zero_debug, rank_zero_info, rank_zero_only, rank_zero_warn
+
+
+def test_rank_zero_warn_fires_on_process_zero():
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        rank_zero_warn("a warning for rank zero")
+    assert any("a warning for rank zero" in str(w.message) for w in caught)
+
+
+def test_rank_zero_only_suppresses_nonzero_rank(monkeypatch):
+    import metrics_tpu.utils.prints as prints
+
+    monkeypatch.setattr(prints, "_get_rank", lambda: 1)
+
+    calls = []
+
+    @rank_zero_only
+    def record():
+        calls.append(1)
+        return "ran"
+
+    assert record() is None
+    assert calls == []
+
+
+def test_rank_zero_log_levels(caplog):
+    with caplog.at_level(logging.DEBUG, logger="metrics_tpu"):
+        rank_zero_info("informational")
+        rank_zero_debug("debugging")
+    messages = [r.message for r in caplog.records]
+    assert "informational" in messages and "debugging" in messages
+
+
+def test_make_mesh_shapes_and_names():
+    mesh = make_mesh((4, 2), ("data", "model"))
+    assert mesh.axis_names == ("data", "model")
+    assert mesh.devices.shape == (4, 2)
+
+
+def test_make_mesh_rejects_mismatched_sizes():
+    with pytest.raises(ValueError):
+        make_mesh((3,), ("data",), devices=jax.devices()[:2])
+
+
+def test_data_parallel_mesh_and_shardings():
+    mesh = data_parallel_mesh(4)
+    assert mesh.axis_names == ("data",)
+    assert mesh.devices.shape == (4,)
+    repl = replicated(mesh)
+    shard = batch_sharded(mesh)
+    x = np.zeros((8, 3), dtype=np.float32)
+    replicated_x = jax.device_put(x, repl)
+    sharded_x = jax.device_put(x, shard)
+    assert len(replicated_x.sharding.device_set) == 4
+    # batch axis split 4 ways: each shard holds 2 of the 8 rows
+    assert sharded_x.addressable_shards[0].data.shape == (2, 3)
