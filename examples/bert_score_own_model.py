@@ -7,6 +7,11 @@ the same way (its ``__call__``/apply output plays the last-hidden-state role).
 
 To run: python examples/bert_score_own_model.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 from pprint import pprint
 from typing import Dict, List, Union
 

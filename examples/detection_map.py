@@ -5,6 +5,11 @@ jax arrays and the metrics_tpu MeanAveragePrecision.
 
 To run: python examples/detection_map.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 from pprint import pprint
 
 import jax.numpy as jnp

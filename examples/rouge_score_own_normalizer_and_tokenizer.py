@@ -6,6 +6,11 @@ non-alphanumeric scripts.
 
 To run: python examples/rouge_score_own_normalizer_and_tokenizer.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import re
 from pprint import pprint
 from typing import Sequence

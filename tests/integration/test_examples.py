@@ -1,0 +1,43 @@
+"""Smoke-run the examples/ scripts — they double as API documentation
+(reference parity: tm_examples/ scripts exercised as docs).
+
+Each example runs as ``__main__`` in its own interpreter with the platform
+forced to CPU *via the config* before any backend use (the container's
+sitecustomize registers the accelerator platform before env vars can, see
+tests/conftest.py).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXAMPLES = [
+    "detection_map.py",
+    "bert_score_own_model.py",
+    "rouge_score_own_normalizer_and_tokenizer.py",
+    "distributed_eval.py",
+]
+
+
+@pytest.mark.parametrize("name", EXAMPLES)
+def test_example_runs_clean(name):
+    path = os.path.join(REPO, "examples", name)
+    runner = (
+        "import jax, runpy, sys; "
+        "jax.config.update('jax_platforms', 'cpu'); "
+        f"runpy.run_path({path!r}, run_name='__main__')"
+    )
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    out = subprocess.run(
+        [sys.executable, "-c", runner],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env=env,
+        cwd=REPO,
+    )
+    assert out.returncode == 0, f"{name} failed:\n{out.stderr[-2000:]}"
