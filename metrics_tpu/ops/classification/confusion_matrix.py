@@ -14,7 +14,7 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.checks import _input_format_classification, _is_traced
 from metrics_tpu.utils.enums import DataType
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -23,7 +23,13 @@ def _confusion_matrix_update(
     preds: Array, target: Array, num_classes: int, threshold: float = 0.5, multilabel: bool = False
 ) -> Array:
     """Count pair occurrences into an un-normalized confusion matrix."""
-    preds, target, mode = _input_format_classification(preds, target, threshold, num_classes=num_classes)
+    # eager: canonicalize WITHOUT num_classes, exactly like the reference
+    # (:38) — its binary/num_classes consistency check must not fire here
+    # (binary probs + num_classes=2 are accepted); the one-hot width is
+    # irrelevant because this path argmaxes back to labels. Under tracing the
+    # machine needs the static num_classes for the one-hot lift.
+    kwargs = {"num_classes": num_classes} if (_is_traced(preds) or _is_traced(target)) else {}
+    preds, target, mode = _input_format_classification(preds, target, threshold, **kwargs)
     if mode not in (DataType.BINARY, DataType.MULTILABEL):
         preds = jnp.argmax(preds, axis=1)
         target = jnp.argmax(target, axis=1)

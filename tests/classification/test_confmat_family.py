@@ -140,3 +140,59 @@ def test_negative_ignore_index_mdmc_labels():
     valid = np.asarray(target) != -1
     expected = (np.asarray(preds)[valid] == np.asarray(target)[valid]).mean()
     np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+
+# ---- input-zoo extensions (binary + logits + multilabel variants) ---------- #
+def test_confusion_matrix_binary_prob():
+    from tests.classification.inputs import _input_binary_prob
+
+    preds, target = _input_binary_prob.preds[0], _input_binary_prob.target[0]
+    res = confusion_matrix(jnp.asarray(preds), jnp.asarray(target), num_classes=2, threshold=THRESHOLD)
+    sk = sk_confmat(target, (preds >= THRESHOLD).astype(int), labels=[0, 1])
+    np.testing.assert_array_equal(np.asarray(res), sk)
+
+
+def test_confusion_matrix_multiclass_logits():
+    from tests.classification.inputs import _input_multiclass_logits
+
+    preds, target = _input_multiclass_logits.preds[0], _input_multiclass_logits.target[0]
+    res = confusion_matrix(jnp.asarray(preds), jnp.asarray(target), num_classes=NUM_CLASSES)
+    sk = sk_confmat(target, np.argmax(preds, -1), labels=range(NUM_CLASSES))
+    np.testing.assert_array_equal(np.asarray(res), sk)
+
+
+def test_cohen_kappa_binary():
+    from tests.classification.inputs import _input_binary
+
+    preds, target = _input_binary.preds[0], _input_binary.target[0]
+    res = cohen_kappa(jnp.asarray(preds), jnp.asarray(target), num_classes=2)
+    np.testing.assert_allclose(np.asarray(res), sk_kappa(target, preds), atol=1e-6)
+
+
+def test_jaccard_multilabel():
+    from tests.classification.inputs import _input_multilabel_prob
+
+    preds, target = _input_multilabel_prob.preds[0], _input_multilabel_prob.target[0]
+    res = jaccard_index(jnp.asarray(preds), jnp.asarray(target), num_classes=2, threshold=THRESHOLD)
+    hard = (preds >= THRESHOLD).astype(int).reshape(-1)
+    sk = sk_jaccard(target.reshape(-1), hard, average="macro")  # macro over {neg, pos} of the flattened lift
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-6)
+
+
+def test_matthews_binary_logits():
+    from tests.classification.inputs import _input_binary_logits
+
+    preds, target = _input_binary_logits.preds[0], _input_binary_logits.target[0]
+    res = matthews_corrcoef(jnp.asarray(preds), jnp.asarray(target), num_classes=2, threshold=THRESHOLD)
+    # the reference thresholds binary decision values at the RAW threshold in
+    # this path (no sigmoid) — verified against the reference implementation
+    hard = (preds >= THRESHOLD).astype(int)
+    np.testing.assert_allclose(np.asarray(res), sk_mcc(target, hard), atol=1e-6)
+
+
+def test_hamming_multidim():
+    from tests.classification.inputs import _input_multilabel_multidim
+
+    preds, target = _input_multilabel_multidim.preds[0], _input_multilabel_multidim.target[0]
+    res = hamming_distance(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(np.asarray(res), (preds != target).mean(), atol=1e-6)
