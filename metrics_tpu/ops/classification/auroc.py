@@ -46,6 +46,7 @@ def _auroc_compute(
     """Reference: auroc.py:52-194 (incl. unobserved-class exclusion and the
     McClish-corrected partial AUC)."""
     _raise_if_traced(preds, target)  # exact-curve math: eager-only by design
+    average = AverageMethod.NONE if average is None else average  # None = per-class (reference :161)
     if mode == DataType.BINARY:
         num_classes = 1
 

@@ -144,3 +144,43 @@ def test_binned_pr_is_jittable():
     state = f(state, preds, target)
     p, r, t = m.compute_state(state)
     assert len(p) == NUM_CLASSES
+
+
+def test_auroc_multilabel_macro_vs_sklearn():
+    import numpy as np
+    from sklearn.metrics import roc_auc_score
+
+    rng = np.random.default_rng(5)
+    preds = rng.uniform(size=(64, 4)).astype(np.float32)
+    target = rng.integers(0, 2, (64, 4))
+    res = auroc(jnp.asarray(preds), jnp.asarray(target), num_classes=4, average="macro")
+    np.testing.assert_allclose(np.asarray(res), roc_auc_score(target, preds, average="macro"), atol=1e-6)
+
+
+@pytest.mark.parametrize("average", [None, "none"])
+def test_auroc_multiclass_per_class_vs_sklearn(average):
+    """average=None is the reference's per-class alias (reference auroc.py:161)."""
+    import numpy as np
+    from sklearn.metrics import roc_auc_score
+
+    rng = np.random.default_rng(5)
+    preds = rng.uniform(size=(64, 4))
+    preds = (preds / preds.sum(1, keepdims=True)).astype(np.float32)
+    target = rng.integers(0, 4, 64)
+    res = auroc(jnp.asarray(preds), jnp.asarray(target), num_classes=4, average=average)
+    sk = roc_auc_score(target, preds, average=None, multi_class="ovr", labels=range(4))
+    np.testing.assert_allclose(np.asarray(res), sk, atol=1e-6)
+
+
+def test_average_precision_multiclass_per_class_vs_sklearn():
+    import numpy as np
+    from sklearn.metrics import average_precision_score
+
+    rng = np.random.default_rng(5)
+    preds = rng.uniform(size=(64, 4))
+    preds = (preds / preds.sum(1, keepdims=True)).astype(np.float32)
+    target = rng.integers(0, 4, 64)
+    res = average_precision(jnp.asarray(preds), jnp.asarray(target), num_classes=4, average=None)
+    onehot = np.eye(4)[target]
+    sk = [average_precision_score(onehot[:, c], preds[:, c]) for c in range(4)]
+    np.testing.assert_allclose([float(x) for x in res], sk, atol=1e-6)
