@@ -184,3 +184,21 @@ def test_average_precision_multiclass_per_class_vs_sklearn():
     onehot = np.eye(4)[target]
     sk = [average_precision_score(onehot[:, c], preds[:, c]) for c in range(4)]
     np.testing.assert_allclose([float(x) for x in res], sk, atol=1e-6)
+
+
+def test_roc_multiclass_per_class_vs_sklearn():
+    """Per-class curves keep every threshold (the reference does not drop
+    collinear points, unlike sklearn's default drop_intermediate=True)."""
+    import numpy as np
+
+    from metrics_tpu.ops.classification import roc as roc_fn
+
+    rng = np.random.default_rng(6)
+    preds = rng.uniform(size=(64, 3))
+    preds = (preds / preds.sum(1, keepdims=True)).astype(np.float32)
+    target = rng.integers(0, 3, 64)
+    fprs, tprs, _ = roc_fn(jnp.asarray(preds), jnp.asarray(target), num_classes=3)
+    for c in range(3):
+        sk_fpr, sk_tpr, _ = sk_roc((target == c).astype(int), preds[:, c], drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fprs[c]), sk_fpr, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tprs[c]), sk_tpr, atol=1e-6)
