@@ -27,14 +27,23 @@ from metrics_tpu.utils.data import METRIC_EPS, to_onehot
 def _recall_at_precision(
     precision: Array, recall: Array, thresholds: Array, min_precision: float
 ) -> Tuple[Array, Array]:
-    """Max recall subject to precision >= min_precision (mask-based)."""
-    qualify = precision[: thresholds.shape[0]] >= min_precision  # ignore appended point
+    """Max recall subject to precision >= min_precision (mask-based).
+
+    The reference maximizes the TUPLE (recall, precision, threshold) (:31-33),
+    so ties cascade lexicographically; an epsilon-weighted argmax cannot
+    express that in f32 (eps(1.0) ~ 1.2e-7 swallows any tie-break term), so
+    each stage is selected exactly.
+    """
+    precision_t = precision[: thresholds.shape[0]]  # ignore appended curve point
     recall_t = recall[: thresholds.shape[0]]
-    masked_recall = jnp.where(qualify, recall_t, -jnp.inf)
-    # break recall ties by larger precision, like the reference's max over (r, p, t)
-    best = jnp.argmax(masked_recall + precision[: thresholds.shape[0]] * 1e-9)
-    max_recall = jnp.where(jnp.any(qualify), recall_t[best], 0.0)
-    best_threshold = jnp.where(max_recall == 0.0, 1e6, thresholds[best])
+    qualify = precision_t >= min_precision
+    max_recall = jnp.max(jnp.where(qualify, recall_t, -jnp.inf))
+    recall_tied = qualify & (recall_t == max_recall)
+    max_precision = jnp.max(jnp.where(recall_tied, precision_t, -jnp.inf))
+    best_tied = recall_tied & (precision_t == max_precision)
+    best_threshold = jnp.max(jnp.where(best_tied, thresholds, -jnp.inf))
+    max_recall = jnp.where(jnp.any(qualify), max_recall, 0.0)
+    best_threshold = jnp.where(max_recall == 0.0, 1e6, best_threshold)
     return max_recall, best_threshold
 
 

@@ -1,0 +1,181 @@
+"""Differential sweep over the REFERENCE's own docstring examples.
+
+Every deterministic example block in the reference's docstrings is executed
+twice — once in torch against the reference implementation, once against
+metrics_tpu with a jnp-backed ``torch`` shim — and each displayed value must
+match numerically. This turns the reference's entire worked-example corpus
+(the values its authors vouched for) into an automated parity oracle, without
+copying any expected number into this repo.
+
+Gated: skipped wholesale when the reference checkout or torch is unavailable.
+"""
+import doctest
+import pathlib
+import re
+import sys
+import types
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+REFERENCE = pathlib.Path("/root/reference/torchmetrics")
+if not REFERENCE.exists():  # pragma: no cover - environment-specific
+    pytest.skip("reference checkout unavailable", allow_module_level=True)
+
+if "pkg_resources" not in sys.modules:  # stripped from modern setuptools
+    shim = types.ModuleType("pkg_resources")
+    shim.DistributionNotFound = type("DistributionNotFound", (Exception,), {})
+
+    def _get_distribution(name):
+        raise shim.DistributionNotFound(name)
+
+    shim.get_distribution = _get_distribution
+    sys.modules["pkg_resources"] = shim
+sys.path.append("/root/reference")  # APPEND: the reference has its own tests/ package that must not shadow ours
+
+import jax.numpy as jnp  # noqa: E402
+
+# sources that cannot run or compare here: RNG-based (framework RNGs differ),
+# model-downloading, optional-dependency, or printing non-numeric objects
+_SKIP_TOKENS = (
+    "randn", "manual_seed", "rand(", "randint",  # framework RNGs differ
+    "pesq", "torchvision", "plot", "bert", "Bert",  # absent optional deps
+    "MulticlassMode", "_gaussian", "_rouge_score_update",  # private helpers
+    "nltk", "rouge",  # needs the punkt download
+    "check_forward_no_full_state",  # timing probe, not a value
+)
+
+# a jnp-backed stand-in for the torch symbols reference examples actually use
+_FAKE_TORCH = types.SimpleNamespace(
+    tensor=jnp.asarray,
+    Tensor=jnp.asarray,  # the constructor form torch.Tensor([...])
+    reshape=jnp.reshape,
+    arange=jnp.arange,
+    ones=jnp.ones,
+    zeros=jnp.zeros,
+    linspace=jnp.linspace,
+    float32=jnp.float32,
+    float64=jnp.float64,
+    float=jnp.float32,
+    int32=jnp.int32,
+    int64=jnp.int32,
+    long=jnp.int32,
+    bool=bool,
+)
+
+
+def _collect_cases():
+    parser = doctest.DocTestParser()
+    cases = []
+    for path in sorted(REFERENCE.rglob("*.py")):
+        rel = str(path.relative_to(REFERENCE))
+        if rel.startswith(("utilities", "setup_tools")):
+            continue
+        for block in re.findall(r'"""(.*?)"""', path.read_text(), re.S):
+            if ">>>" not in block:
+                continue
+            try:
+                examples = parser.get_examples(block)
+            except Exception:
+                continue
+            if not examples:
+                continue
+            source = "".join(e.source for e in examples)
+            if any(tok in source for tok in _SKIP_TOKENS):
+                continue
+            if re.search(r"\b_[a-z]\w*\s*\(", source):
+                # demonstrates reference-private helpers; the public surface is
+                # the parity contract, the internal decomposition is not
+                continue
+            cases.append(pytest.param(rel, examples, id=f"{rel}:{len(cases)}"))
+    return cases
+
+
+def _ref_module(rel: str):
+    import importlib
+
+    name = "torchmetrics." + rel[: -len(".py")].replace("/", ".")
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return importlib.import_module(name)
+
+
+def _exec_examples(examples, glb):
+    """Run example statements, returning the values each displaying statement
+    (one with expected output in the docstring) produced."""
+    shown = []
+    for example in examples:
+        buf = []
+        old_hook = sys.displayhook
+        sys.displayhook = buf.append
+        try:
+            exec(compile(example.source, "<example>", "single"), glb)
+        finally:
+            sys.displayhook = old_hook
+        if example.want.strip():
+            shown.append(buf[-1] if buf else None)
+    return shown
+
+
+def _to_np(value):
+    if isinstance(value, torch.Tensor):
+        return value.detach().cpu().numpy()
+    if isinstance(value, (list, tuple)):
+        return [_to_np(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _to_np(v) for k, v in value.items()}
+    return np.asarray(value)
+
+
+def _assert_close(want, got):
+    if want is None and got is None:  # a print-based statement; nothing displayed
+        return
+    want, got = _to_np(want), _to_np(got)
+    if isinstance(want, list):
+        assert isinstance(got, (list, np.ndarray)) and len(want) == len(got)
+        for w, g in zip(want, got):
+            _assert_close(w, g)
+    elif isinstance(want, dict):
+        assert set(want) == set(got), (sorted(want), sorted(got))
+        for key in want:
+            _assert_close(want[key], got[key])
+    else:
+        np.testing.assert_allclose(
+            np.asarray(want, dtype=np.float64), np.asarray(got, dtype=np.float64), atol=1e-4, rtol=1e-3
+        )
+
+
+@pytest.mark.parametrize("rel,examples", _collect_cases())
+def test_reference_example_parity(rel, examples):
+    import metrics_tpu
+    import metrics_tpu.ops
+
+    try:
+        ref_glb = dict(vars(_ref_module(rel)))
+    except Exception as err:  # optional-dep module
+        pytest.skip(f"reference module unimportable: {err}")
+    ref_glb.update(torch=torch, tensor=torch.tensor)
+    try:
+        want = _exec_examples(examples, ref_glb)
+    except Exception as err:
+        pytest.skip(f"reference-side example not runnable here: {err}")
+
+    def _translate(src: str) -> str:
+        src = src.replace("torchmetrics.functional", "metrics_tpu.ops").replace("torchmetrics", "metrics_tpu")
+        # the jnp-backed ``torch`` shim is pre-seeded in the globals; real
+        # torch imports inside an example must not rebind it
+        src = re.sub(r"^(\s*)import torch\s*$", r"\1pass", src, flags=re.M)
+        src = re.sub(r"^(\s*)from torch import tensor\s*$", r"\1pass", src, flags=re.M)
+        src = src.replace(".long()", ".astype('int32')")
+        return src
+
+    source_ours = [types.SimpleNamespace(source=_translate(e.source), want=e.want) for e in examples]
+    ours_glb = {**vars(metrics_tpu.ops), **vars(metrics_tpu)}
+    ours_glb.update(torch=_FAKE_TORCH, tensor=jnp.asarray, jnp=jnp)
+    got = _exec_examples(source_ours, ours_glb)
+
+    assert len(want) == len(got), f"displayed {len(got)} values, reference displayed {len(want)}"
+    for w, g in zip(want, got):
+        _assert_close(w, g)

@@ -75,7 +75,7 @@ def test_default_model_path_idf_and_layers(tiny_bert_dir):
 
 def _reference_torchmetrics():
     if "/root/reference" not in sys.path:
-        sys.path.insert(0, "/root/reference")
+        sys.path.append("/root/reference")  # APPEND: the reference has its own tests/ package that must not shadow ours
     if "pkg_resources" not in sys.modules:  # removed from modern setuptools
         import types
 
