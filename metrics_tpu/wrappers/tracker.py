@@ -29,9 +29,9 @@ class MetricTracker:
         ...     tracker.update(jnp.asarray(epoch_preds), jnp.asarray([0, 1, 2]))
         >>> [round(float(v), 4) for v in tracker.compute_all()]
         [0.6667, 1.0]
-        >>> step, best = tracker.best_metric(return_step=True)
-        >>> step, round(float(best), 2)
-        (1, 1.0)
+        >>> best, step = tracker.best_metric(return_step=True)
+        >>> round(float(best), 2), step
+        (1.0, 1)
     """
 
     def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
@@ -95,7 +95,10 @@ class MetricTracker:
     def best_metric(
         self, return_step: bool = False
     ) -> Union[float, Tuple[int, float], Dict[str, float], Tuple[Dict[str, int], Dict[str, float]]]:
-        """Best value (and optionally its step) over time."""
+        """Best value over time; with ``return_step`` the pair ``(value, step)``
+        — the reference's order (its tracker.py:174-176 unpacks
+        ``torch.max(t, 0)`` as values-then-indices and returns them as-is,
+        as its docstring example shows)."""
         res = self.compute_all()
         if isinstance(res, dict):
             maximize = self.maximize if isinstance(self.maximize, list) else [self.maximize] * len(res)
@@ -111,7 +114,7 @@ class MetricTracker:
                     continue
                 value[k] = float(v[best_i])
                 idx[k] = best_i
-            return (idx, value) if return_step else value
+            return (value, idx) if return_step else value
         v = np.asarray(res)
         fn = np.nanargmax if self.maximize else np.nanargmin
         try:
@@ -119,7 +122,7 @@ class MetricTracker:
         except ValueError:
             rank_zero_warn("Encountered all-nan values; returning None")
             return (None, None) if return_step else None
-        return (best_i, float(v[best_i])) if return_step else float(v[best_i])
+        return (float(v[best_i]), best_i) if return_step else float(v[best_i])
 
     def _check_for_increment(self, method: str) -> None:
         if not self._increment_called:

@@ -37,15 +37,74 @@ sys.path.append("/root/reference")  # APPEND: the reference has its own tests/ p
 
 import jax.numpy as jnp  # noqa: E402
 
-# sources that cannot run or compare here: RNG-based (framework RNGs differ),
-# model-downloading, optional-dependency, or printing non-numeric objects
+# sources that cannot run or compare here: model-downloading,
+# optional-dependency, or printing non-numeric objects (RNG-based examples DO
+# run: both sides draw from one shared seeded numpy generator, see _RNG)
 _SKIP_TOKENS = (
-    "randn", "manual_seed", "rand(", "randint",  # framework RNGs differ
     "pesq", "torchvision", "plot", "bert", "Bert",  # absent optional deps
     "MulticlassMode", "_gaussian", "_rouge_score_update",  # private helpers
     "nltk", "rouge",  # needs the punkt download
     "check_forward_no_full_state",  # timing probe, not a value
+    "generator=",  # explicit torch.Generator plumbing can't be shimmed
+    ".softmax(",  # torch tensor-method call; jax arrays have no method form
+    "BootStrapper",  # resampling draws inside update differ by design
 )
+
+
+class _SharedRNG:
+    """One numpy generator behind both frameworks' sampling calls, so an
+    RNG-using reference example draws IDENTICAL values on both sides."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._rng = np.random.default_rng(20260730)
+
+    @staticmethod
+    def _shape(shape):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            return tuple(shape[0])
+        return shape or ()
+
+    def randn(self, *shape):
+        return self._rng.normal(size=self._shape(shape)).astype(np.float32)
+
+    def rand(self, *shape):
+        return self._rng.uniform(size=self._shape(shape)).astype(np.float32)
+
+    def randint(self, *args, **kwargs):
+        size = kwargs.get("size")
+        if size is None and args and isinstance(args[-1], (tuple, list)):
+            *args, size = args
+        low, high = (0, args[0]) if len(args) == 1 else args[:2]
+        return self._rng.integers(low, high, size=size or ())
+
+
+_RNG = _SharedRNG()
+
+
+class _TorchProxy:
+    """Real torch, with sampling routed through the shared numpy generator."""
+
+    def __getattr__(self, name):
+        return getattr(torch, name)
+
+    @staticmethod
+    def manual_seed(seed):
+        _RNG.reset()
+
+    @staticmethod
+    def randn(*shape, **kw):
+        return torch.as_tensor(_RNG.randn(*shape))
+
+    @staticmethod
+    def rand(*shape, **kw):
+        return torch.as_tensor(_RNG.rand(*shape))
+
+    @staticmethod
+    def randint(*args, **kw):
+        return torch.as_tensor(np.asarray(_RNG.randint(*args, **kw)))
 
 # a jnp-backed stand-in for the torch symbols reference examples actually use
 _FAKE_TORCH = types.SimpleNamespace(
@@ -64,6 +123,10 @@ _FAKE_TORCH = types.SimpleNamespace(
     long=jnp.int32,
     bool=bool,
 )
+_FAKE_TORCH.manual_seed = lambda seed: _RNG.reset()
+_FAKE_TORCH.randn = lambda *shape, **kw: jnp.asarray(_RNG.randn(*shape))
+_FAKE_TORCH.rand = lambda *shape, **kw: jnp.asarray(_RNG.rand(*shape))
+_FAKE_TORCH.randint = lambda *args, **kw: jnp.asarray(np.asarray(_RNG.randint(*args, **kw)))
 
 
 def _collect_cases():
@@ -89,6 +152,8 @@ def _collect_cases():
                 # demonstrates reference-private helpers; the public surface is
                 # the parity contract, the internal decomposition is not
                 continue
+            if re.search(r"\[[^\]]*\]\s*=[^=]", source):
+                continue  # in-place subscript mutation: jax arrays are immutable
             cases.append(pytest.param(rel, examples, id=f"{rel}:{len(cases)}"))
     return cases
 
@@ -156,7 +221,16 @@ def test_reference_example_parity(rel, examples):
         ref_glb = dict(vars(_ref_module(rel)))
     except Exception as err:  # optional-dep module
         pytest.skip(f"reference module unimportable: {err}")
-    ref_glb.update(torch=torch, tensor=torch.tensor)
+    ref_glb.update(torch=_TorchProxy(), tensor=torch.tensor)
+    # neutralize in-example torch imports on this side too: they would rebind
+    # the RNG-sharing proxy back to the real module
+    examples = [
+        types.SimpleNamespace(
+            source=re.sub(r"^(\s*)import torch\s*$", r"\1pass", e.source, flags=re.M), want=e.want
+        )
+        for e in examples
+    ]
+    _RNG.reset()
     try:
         want = _exec_examples(examples, ref_glb)
     except Exception as err:
@@ -174,6 +248,7 @@ def test_reference_example_parity(rel, examples):
     source_ours = [types.SimpleNamespace(source=_translate(e.source), want=e.want) for e in examples]
     ours_glb = {**vars(metrics_tpu.ops), **vars(metrics_tpu)}
     ours_glb.update(torch=_FAKE_TORCH, tensor=jnp.asarray, jnp=jnp)
+    _RNG.reset()
     got = _exec_examples(source_ours, ours_glb)
 
     assert len(want) == len(got), f"displayed {len(got)} values, reference displayed {len(want)}"

@@ -99,7 +99,7 @@ def test_tracker():
         tracker.update(t + shift, t)
     all_vals = np.asarray(tracker.compute_all())
     assert all_vals.shape == (3,)
-    best_step, best_val = tracker.best_metric(return_step=True)
+    best_val, best_step = tracker.best_metric(return_step=True)  # (value, step): reference order
     assert best_step == 1
     assert best_val == pytest.approx(0.01, abs=1e-5)
 
