@@ -1,0 +1,89 @@
+"""GSPMD (pjit-style) coverage: auto-partitioned jit over NamedSharding.
+
+shard_map is the explicit-collective path the suite exercises everywhere; the
+OTHER documented usage (README quickstart, docs/distributed.md) is plain
+``jit`` over sharded inputs, where XLA inserts the cross-device reductions
+itself. State stays replicated; the batch axis is sharded; the compiled
+update must produce the same accumulation as a single-device run.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metrics_tpu import Accuracy, F1Score, MeanSquaredError, MetricCollection
+
+NUM_CLASSES = 7
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs 8 devices")
+    return Mesh(np.asarray(devices[:8]), ("data",))
+
+
+def test_pjit_sharded_batch_accuracy(mesh):
+    metric = Accuracy(num_classes=NUM_CLASSES)
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(64, NUM_CLASSES)).astype(np.float32)
+    target = rng.integers(0, NUM_CLASSES, size=(64,)).astype(np.int32)
+
+    batch_sharding = NamedSharding(mesh, P("data"))
+    replicated = NamedSharding(mesh, P())
+
+    logits_sharded = jax.device_put(jnp.asarray(logits), batch_sharding)
+    target_sharded = jax.device_put(jnp.asarray(target), batch_sharding)
+
+    step = jax.jit(metric.update_state, out_shardings=replicated)
+    state = jax.device_put(metric.init_state(), replicated)
+    for _ in range(3):
+        state = step(state, logits_sharded, target_sharded)
+
+    expected = float((np.argmax(logits, -1) == target).mean())
+    assert float(metric.compute_state(state)) == pytest.approx(expected, abs=1e-6)
+    # the accumulated state itself must be replicated across all 8 devices
+    assert all(len(leaf.sharding.device_set) == 8 for leaf in jax.tree.leaves(state))
+
+
+def test_pjit_sharded_collection(mesh):
+    coll = MetricCollection(
+        {"acc": Accuracy(num_classes=NUM_CLASSES), "f1": F1Score(num_classes=NUM_CLASSES, average="macro")}
+    )
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(32, NUM_CLASSES)).astype(np.float32)
+    target = rng.integers(0, NUM_CLASSES, size=(32,)).astype(np.int32)
+
+    batch_sharding = NamedSharding(mesh, P("data"))
+    step = jax.jit(lambda s, x, y: coll.update_state(s, x, y))
+    state = step(
+        coll.init_state(),
+        jax.device_put(jnp.asarray(logits), batch_sharding),
+        jax.device_put(jnp.asarray(target), batch_sharding),
+    )
+    values = coll.compute_state(state)
+
+    single = MetricCollection(
+        {"acc": Accuracy(num_classes=NUM_CLASSES), "f1": F1Score(num_classes=NUM_CLASSES, average="macro")}
+    )
+    expected = single.compute_state(single.update_state(single.init_state(), jnp.asarray(logits), jnp.asarray(target)))
+    for key in expected:
+        assert float(values[key]) == pytest.approx(float(expected[key]), abs=1e-6), key
+
+
+def test_pjit_regression_sharded(mesh):
+    metric = MeanSquaredError()
+    rng = np.random.default_rng(2)
+    preds = rng.normal(size=(64,)).astype(np.float32)
+    target = rng.normal(size=(64,)).astype(np.float32)
+    batch_sharding = NamedSharding(mesh, P("data"))
+    step = jax.jit(metric.update_state)
+    state = step(
+        metric.init_state(),
+        jax.device_put(jnp.asarray(preds), batch_sharding),
+        jax.device_put(jnp.asarray(target), batch_sharding),
+    )
+    assert float(metric.compute_state(state)) == pytest.approx(float(((preds - target) ** 2).mean()), abs=1e-6)
