@@ -134,7 +134,9 @@ def _collect_cases():
     cases = []
     for path in sorted(REFERENCE.rglob("*.py")):
         rel = str(path.relative_to(REFERENCE))
-        if rel.startswith(("utilities", "setup_tools")):
+        # utilities/data.py carries the to_onehot/select_topk/... examples our
+        # utils.data mirrors by name; other utilities modules are torch-internal
+        if rel.startswith(("utilities", "setup_tools")) and rel != "utilities/data.py":
             continue
         for block in re.findall(r'"""(.*?)"""', path.read_text(), re.S):
             if ">>>" not in block:
@@ -165,6 +167,14 @@ def _ref_module(rel: str):
     if name.endswith(".__init__"):
         name = name[: -len(".__init__")]
     return importlib.import_module(name)
+
+
+def _ours_extra_namespace(rel: str) -> dict:
+    if rel == "utilities/data.py":
+        import metrics_tpu.utils.data as our_data
+
+        return {**vars(our_data), "Tensor": jnp.ndarray}  # bare Tensor used as a dtype filter
+    return {}
 
 
 def _exec_examples(examples, glb):
@@ -246,7 +256,7 @@ def test_reference_example_parity(rel, examples):
         return src
 
     source_ours = [types.SimpleNamespace(source=_translate(e.source), want=e.want) for e in examples]
-    ours_glb = {**vars(metrics_tpu.ops), **vars(metrics_tpu)}
+    ours_glb = {**vars(metrics_tpu.ops), **vars(metrics_tpu), **_ours_extra_namespace(rel)}
     ours_glb.update(torch=_FAKE_TORCH, tensor=jnp.asarray, jnp=jnp)
     _RNG.reset()
     got = _exec_examples(source_ours, ours_glb)
