@@ -152,3 +152,60 @@ def test_group_compute_under_distribution():
     finally:
         _s.distributed_available = orig
     assert float(res["a"]) == 2.0 and float(res["b"]) == 20.0
+
+
+@pytest.mark.parametrize("compute_groups", [True, False])
+def test_compute_groups_value_equivalence(compute_groups):
+    """Fused and unfused collections must produce identical values across a
+    mixed stat-scores family (reference overview.rst:313 claims fusion only
+    changes cost, never results)."""
+    from metrics_tpu import Accuracy, F1Score, Precision, Recall, Specificity
+
+    def make():
+        return MetricCollection(
+            {
+                "acc": Accuracy(num_classes=5),
+                "f1": F1Score(num_classes=5, average="macro"),
+                "precision": Precision(num_classes=5, average="macro"),
+                "recall": Recall(num_classes=5, average="macro"),
+                "specificity": Specificity(num_classes=5, average="macro"),
+            },
+            compute_groups=compute_groups,
+        )
+
+    rng = np.random.default_rng(3)
+    col = make()
+    state = col.init_state()
+    batches = [
+        (
+            jnp.asarray(rng.normal(size=(16, 5)).astype(np.float32)),
+            jnp.asarray(rng.integers(0, 5, size=(16,)).astype(np.int32)),
+        )
+        for _ in range(3)
+    ]
+    for logits, target in batches:
+        state = col.update_state(state, logits, target)
+    values = col.compute_state(state)
+
+    reference_col = make() if compute_groups else MetricCollection(
+        {
+            "acc": Accuracy(num_classes=5),
+            "f1": F1Score(num_classes=5, average="macro"),
+            "precision": Precision(num_classes=5, average="macro"),
+            "recall": Recall(num_classes=5, average="macro"),
+            "specificity": Specificity(num_classes=5, average="macro"),
+        }
+    )
+    ref_state = reference_col.init_state()
+    for logits, target in batches:
+        ref_state = reference_col.update_state(ref_state, logits, target)
+    expected = reference_col.compute_state(ref_state)
+
+    assert set(values) == set(expected)
+    for key in expected:
+        np.testing.assert_allclose(np.asarray(values[key]), np.asarray(expected[key]), atol=1e-7, err_msg=key)
+
+    # the macro family must actually share one group when fusion is on
+    if compute_groups:
+        group_sizes = sorted(len(members) for members in col.compute_groups.values())
+        assert group_sizes[-1] >= 3
