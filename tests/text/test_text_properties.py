@@ -1,0 +1,56 @@
+"""Property-based invariants for the text error-rate family (hypothesis).
+
+The device-side batched Levenshtein kernel must honor the metric axioms the
+eager reference math has by construction: identity, bounds, and symmetry of
+the underlying distance — searched over random corpora instead of fixtures.
+"""
+import pytest
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+from metrics_tpu.ops import char_error_rate, match_error_rate, word_error_rate, word_information_preserved
+
+SETTINGS = settings(
+    max_examples=30,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+    derandomize=True,
+)
+
+words = st.text(alphabet="abcde", min_size=1, max_size=5)
+sentences = st.lists(words, min_size=1, max_size=6).map(" ".join)
+corpora = st.lists(sentences, min_size=1, max_size=4)
+
+
+@SETTINGS
+@given(corpus=corpora)
+def test_error_rates_identity(corpus):
+    assert float(word_error_rate(corpus, corpus)) == 0.0
+    assert float(char_error_rate(corpus, corpus)) == 0.0
+    assert float(match_error_rate(corpus, corpus)) == 0.0
+    assert float(word_information_preserved(corpus, corpus)) == pytest.approx(1.0, abs=1e-6)
+
+
+@SETTINGS
+@given(preds=corpora, target=corpora)
+def test_error_rates_bounds(preds, target):
+    n = min(len(preds), len(target))
+    preds, target = preds[:n], target[:n]
+    assert float(char_error_rate(preds, target)) >= 0.0
+    # MER is normalized by max(ref, hyp) words so it cannot exceed 1
+    assert 0.0 <= float(match_error_rate(preds, target)) <= 1.0
+    assert 0.0 <= float(word_information_preserved(preds, target)) <= 1.0 + 1e-6
+
+
+@SETTINGS
+@given(preds=corpora, target=corpora)
+def test_wer_cer_swap_scales_by_length_ratio(preds, target):
+    """Levenshtein distance is symmetric, so swapping hypothesis and reference
+    rescales the rate by the corpus length ratio: wer(a,b)*len_b = wer(b,a)*len_a."""
+    n = min(len(preds), len(target))
+    preds, target = preds[:n], target[:n]
+    ref_words = sum(len(s.split()) for s in target)
+    hyp_words = sum(len(s.split()) for s in preds)
+    lhs = float(word_error_rate(preds, target)) * ref_words
+    rhs = float(word_error_rate(target, preds)) * hyp_words
+    assert lhs == pytest.approx(rhs, rel=1e-5)
