@@ -1,0 +1,78 @@
+"""Property tests of the DEFINING invariances in the audio/image metrics.
+
+Scale invariance is what the SI- prefix means; permutation invariance is the
+entire point of PIT; SSIM/UQI of an image with itself is 1. These hold by
+definition in the reference math and must survive the jax re-design —
+hypothesis searches for violations.
+"""
+import numpy as np
+import pytest
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+import jax.numpy as jnp
+
+from metrics_tpu.ops import (
+    permutation_invariant_training,
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    structural_similarity_index_measure,
+    universal_image_quality_index,
+)
+
+SETTINGS = settings(
+    max_examples=20,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+    derandomize=True,
+)
+
+
+def _signals(seed, shape=(64,)):
+    rng = np.random.default_rng(seed)
+    target = rng.normal(size=shape).astype(np.float32)
+    preds = target + 0.3 * rng.normal(size=shape).astype(np.float32)
+    return jnp.asarray(preds), jnp.asarray(target)
+
+
+@SETTINGS
+@given(seed=st.integers(min_value=0, max_value=10_000), scale=st.floats(min_value=0.05, max_value=20.0))
+def test_si_snr_scale_invariance(seed, scale):
+    preds, target = _signals(seed)
+    base = float(scale_invariant_signal_noise_ratio(preds, target))
+    scaled = float(scale_invariant_signal_noise_ratio(preds * scale, target))
+    assert scaled == pytest.approx(base, abs=1e-2)
+
+
+@SETTINGS
+@given(seed=st.integers(min_value=0, max_value=10_000), scale=st.floats(min_value=0.05, max_value=20.0))
+def test_si_sdr_scale_invariance(seed, scale):
+    preds, target = _signals(seed)
+    base = float(scale_invariant_signal_distortion_ratio(preds, target))
+    scaled = float(scale_invariant_signal_distortion_ratio(preds * scale, target))
+    assert scaled == pytest.approx(base, abs=1e-2)
+
+
+@SETTINGS
+@given(seed=st.integers(min_value=0, max_value=10_000))
+def test_pit_speaker_permutation_invariance(seed):
+    rng = np.random.default_rng(seed)
+    target = rng.normal(size=(2, 3, 32)).astype(np.float32)
+    preds = target + 0.5 * rng.normal(size=(2, 3, 32)).astype(np.float32)
+    best, _ = permutation_invariant_training(
+        jnp.asarray(preds), jnp.asarray(target), scale_invariant_signal_noise_ratio
+    )
+    shuffled = preds[:, [2, 0, 1], :]  # reorder the speaker axis
+    best_shuffled, _ = permutation_invariant_training(
+        jnp.asarray(shuffled), jnp.asarray(target), scale_invariant_signal_noise_ratio
+    )
+    np.testing.assert_allclose(np.asarray(best_shuffled), np.asarray(best), atol=1e-4)
+
+
+@SETTINGS
+@given(seed=st.integers(min_value=0, max_value=10_000))
+def test_ssim_uqi_identity(seed):
+    rng = np.random.default_rng(seed)
+    img = jnp.asarray(rng.uniform(size=(1, 1, 24, 24)).astype(np.float32))
+    assert float(structural_similarity_index_measure(img, img, data_range=1.0)) == pytest.approx(1.0, abs=1e-5)
+    assert float(universal_image_quality_index(img, img)) == pytest.approx(1.0, abs=1e-5)
