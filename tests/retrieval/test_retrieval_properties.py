@@ -1,0 +1,90 @@
+"""Property tests for the single-query retrieval scorers (hypothesis).
+
+Bounds and monotonicity that hold by definition: recall@k and hit-rate@k are
+nondecreasing in k, every rate lives in [0, 1], perfect rankings score 1, and
+the now-traceable scorers agree between eager and vmapped execution on
+hypothesis-generated queries (not just the fixture corpus).
+"""
+import numpy as np
+import pytest
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+from hypothesis.extra.numpy import arrays
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.ops import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_precision,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+
+SETTINGS = settings(
+    max_examples=25,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+    derandomize=True,
+)
+
+N_DOCS = 8
+preds_strategy = arrays(
+    np.float32, (N_DOCS,), elements=st.floats(min_value=0, max_value=1, allow_nan=False, width=32), unique=True
+)
+target_strategy = arrays(np.bool_, (N_DOCS,), elements=st.booleans())
+
+
+@SETTINGS
+@given(preds=preds_strategy, target=target_strategy)
+def test_recall_and_hit_rate_monotone_in_k(preds, target):
+    p, t = jnp.asarray(preds), jnp.asarray(target)
+    recalls = [float(retrieval_recall(p, t, k=k)) for k in range(1, N_DOCS + 1)]
+    hits = [float(retrieval_hit_rate(p, t, k=k)) for k in range(1, N_DOCS + 1)]
+    assert all(b >= a - 1e-7 for a, b in zip(recalls, recalls[1:]))
+    assert all(b >= a - 1e-7 for a, b in zip(hits, hits[1:]))
+    if target.any():
+        assert recalls[-1] == pytest.approx(1.0)  # full depth recovers everything
+
+
+@SETTINGS
+@given(preds=preds_strategy, target=target_strategy)
+def test_all_scorers_bounded(preds, target):
+    p, t = jnp.asarray(preds), jnp.asarray(target)
+    for fn, kwargs in [
+        (retrieval_average_precision, {}),
+        (retrieval_reciprocal_rank, {}),
+        (retrieval_precision, {"k": 3}),
+        (retrieval_recall, {"k": 3}),
+        (retrieval_hit_rate, {"k": 3}),
+        (retrieval_fall_out, {"k": 3}),
+        (retrieval_r_precision, {}),
+    ]:
+        value = float(fn(p, t, **kwargs))
+        assert 0.0 <= value <= 1.0 + 1e-6, fn.__name__
+
+
+@SETTINGS
+@given(target=target_strategy)
+def test_perfect_ranking_scores_one(target):
+    if not target.any():
+        return
+    # scores equal to relevance (plus rank-breaking noise below the gap)
+    preds = jnp.asarray(target.astype(np.float32) + np.linspace(0, 0.4, N_DOCS, dtype=np.float32))
+    t = jnp.asarray(target)
+    assert float(retrieval_average_precision(preds, t)) == pytest.approx(1.0)
+    assert float(retrieval_reciprocal_rank(preds, t)) == pytest.approx(1.0)
+    assert float(retrieval_r_precision(preds, t)) == pytest.approx(1.0)
+
+
+@SETTINGS
+@given(preds=preds_strategy, target=target_strategy)
+def test_vmapped_equals_eager_on_random_queries(preds, target):
+    p = jnp.stack([jnp.asarray(preds), jnp.asarray(preds)[::-1]])
+    t = jnp.stack([jnp.asarray(target), jnp.asarray(target)[::-1]])
+    batched = jax.vmap(retrieval_average_precision)(p, t)
+    eager = [float(retrieval_average_precision(p[i], t[i])) for i in range(2)]
+    np.testing.assert_allclose(np.asarray(batched), eager, atol=1e-6)
