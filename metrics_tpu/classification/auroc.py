@@ -9,6 +9,7 @@ from metrics_tpu.core.metric import Metric
 from metrics_tpu.ops.classification.auroc import _auroc_compute, _auroc_update
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import DataType
+from metrics_tpu.utils.checks import _check_arg_choice
 
 
 class AUROC(Metric):
@@ -43,9 +44,7 @@ class AUROC(Metric):
         self.average = average
         self.max_fpr = max_fpr
 
-        allowed_average = (None, "macro", "weighted", "micro")
-        if self.average not in allowed_average:
-            raise ValueError(f"Argument `average` expected to be one of the following: {allowed_average} but got {average}")
+        _check_arg_choice(self.average, "average", (None, "macro", "weighted", "micro"))
         if self.max_fpr is not None:
             if not isinstance(max_fpr, float) or not 0 < max_fpr <= 1:
                 raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")

@@ -8,6 +8,7 @@ from jax import Array
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.ops.classification.average_precision import _average_precision_compute, _average_precision_update
 from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.checks import _check_arg_choice
 
 
 class AveragePrecision(Metric):
@@ -38,9 +39,7 @@ class AveragePrecision(Metric):
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.pos_label = pos_label
-        allowed_average = ("micro", "macro", "weighted", "none", None)
-        if average not in allowed_average:
-            raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
+        _check_arg_choice(average, "average", ("micro", "macro", "weighted", "none", None))
         self.average = average
 
         self.add_state("preds", default=[], dist_reduce_fx="cat")

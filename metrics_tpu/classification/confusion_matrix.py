@@ -8,6 +8,7 @@ from jax import Array
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.ops.classification.confusion_matrix import _confusion_matrix_compute, _confusion_matrix_update
+from metrics_tpu.utils.checks import _check_arg_choice
 
 
 class ConfusionMatrix(Metric):
@@ -42,9 +43,7 @@ class ConfusionMatrix(Metric):
         self.threshold = threshold
         self.multilabel = multilabel
 
-        allowed_normalize = ("true", "pred", "all", "none", None)
-        if normalize not in allowed_normalize:
-            raise ValueError(f"Argument average needs to one of the following: {allowed_normalize}")
+        _check_arg_choice(normalize, "normalize", ("true", "pred", "all", "none", None))
 
         default = jnp.zeros((num_classes, 2, 2), dtype=jnp.int32) if multilabel else jnp.zeros((num_classes, num_classes), dtype=jnp.int32)
         self.add_state("confmat", default=default, dist_reduce_fx="sum")

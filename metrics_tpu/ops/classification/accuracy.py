@@ -18,7 +18,8 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.ops.classification.stat_scores import _reduce_stat_scores, _stat_scores_update
-from metrics_tpu.utils.checks import _check_classification_inputs, _input_format_classification, _input_squeeze
+from metrics_tpu.ops.classification.precision_recall import _check_avg_args
+from metrics_tpu.utils.checks import _check_positive_int, _check_classification_inputs, _input_format_classification, _input_squeeze
 from metrics_tpu.utils.enums import AverageMethod, DataType, MDMCAverageMethod
 
 
@@ -157,22 +158,9 @@ def accuracy(
         >>> round(float(accuracy(jnp.asarray([0, 2, 1, 3]), jnp.asarray([0, 1, 2, 3]))), 4)
         0.5
     """
-    allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
-    if average not in allowed_average:
-        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
-
-    if average in ("macro", "weighted", "none", None) and (not num_classes or num_classes < 1):
-        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
-
-    allowed_mdmc_average = (None, "samplewise", "global")
-    if mdmc_average not in allowed_mdmc_average:
-        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
-
-    if num_classes and ignore_index is not None and (not ignore_index < num_classes or num_classes == 1):
-        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
-
-    if top_k is not None and (not isinstance(top_k, int) or top_k <= 0):
-        raise ValueError(f"The `top_k` should be an integer larger than 0, got {top_k}")
+    _check_avg_args(average, mdmc_average, num_classes, ignore_index)
+    if top_k is not None:
+        _check_positive_int(top_k, "top_k")
 
     preds, target = _input_squeeze(preds, target)
     mode = _mode(preds, target, threshold, top_k, num_classes, multiclass, ignore_index)

@@ -14,7 +14,7 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.utils.checks import _input_format_classification, _is_traced
+from metrics_tpu.utils.checks import _check_arg_choice, _input_format_classification, _is_traced
 from metrics_tpu.utils.enums import DataType
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -46,9 +46,7 @@ def _confusion_matrix_update(
 
 def _confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
     """Optionally normalize over true/pred/all. Reference: :57-115."""
-    allowed_normalize = ("true", "pred", "all", "none", None)
-    if normalize not in allowed_normalize:
-        raise ValueError(f"Argument average needs to one of the following: {allowed_normalize}")
+    _check_arg_choice(normalize, "normalize", ("true", "pred", "all", "none", None))
     if normalize is not None and normalize != "none":
         confmat = confmat.astype(jnp.float32)
         if normalize == "true":

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.ops.classification.confusion_matrix import _confusion_matrix_update
+from metrics_tpu.utils.checks import _check_arg_choice
 
 
 def _jaccard_from_confmat(
@@ -25,9 +26,7 @@ def _jaccard_from_confmat(
     ignore_index: Optional[int] = None,
     absent_score: float = 0.0,
 ) -> Array:
-    allowed_average = ["micro", "macro", "weighted", "none", None]
-    if average not in allowed_average:
-        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    _check_arg_choice(average, "average", ("micro", "macro", "weighted", "none", None))
 
     if ignore_index is not None and 0 <= ignore_index < num_classes:
         confmat = confmat.at[ignore_index].set(0.0)

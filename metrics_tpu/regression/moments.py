@@ -23,6 +23,7 @@ from metrics_tpu.ops.regression.moments import (
     _spearman_corrcoef_update,
 )
 from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.checks import _check_arg_choice
 
 
 def _final_aggregation(
@@ -158,9 +159,7 @@ class R2Score(Metric):
         if adjusted < 0 or not isinstance(adjusted, int):
             raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
         self.adjusted = adjusted
-        allowed_multioutput = ("raw_values", "uniform_average", "variance_weighted")
-        if multioutput not in allowed_multioutput:
-            raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {allowed_multioutput}")
+        _check_arg_choice(multioutput, "multioutput", ("raw_values", "uniform_average", "variance_weighted"))
         self.multioutput = multioutput
 
         shape = (num_outputs,) if num_outputs > 1 else ()
@@ -202,9 +201,7 @@ class ExplainedVariance(Metric):
 
     def __init__(self, multioutput: str = "uniform_average", **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        allowed_multioutput = ("raw_values", "uniform_average", "variance_weighted")
-        if multioutput not in allowed_multioutput:
-            raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {allowed_multioutput}")
+        _check_arg_choice(multioutput, "multioutput", ("raw_values", "uniform_average", "variance_weighted"))
         self.multioutput = multioutput
         self.add_state("sum_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
         self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")

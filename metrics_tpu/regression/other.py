@@ -18,6 +18,7 @@ from metrics_tpu.ops.regression.other import (
     _tweedie_deviance_score_update,
 )
 from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.checks import _check_arg_choice
 
 
 class CosineSimilarity(Metric):
@@ -40,9 +41,7 @@ class CosineSimilarity(Metric):
 
     def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        allowed_reduction = ("sum", "mean", "none", None)
-        if reduction not in allowed_reduction:
-            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
+        _check_arg_choice(reduction, "reduction", ("sum", "mean", "none", None))
         self.reduction = reduction
         self.add_state("preds", [], dist_reduce_fx="cat")
         self.add_state("target", [], dist_reduce_fx="cat")

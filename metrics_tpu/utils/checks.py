@@ -86,6 +86,18 @@ def _check_for_empty_tensors(preds: Array, target: Array) -> bool:
     return preds.size == 0 and target.size == 0
 
 
+def _check_arg_choice(value, name: str, allowed) -> None:
+    """Raise if ``value`` is not one of ``allowed`` (shared arg validator)."""
+    if value not in allowed:
+        raise ValueError(f"`{name}` must be one of {tuple(allowed)}; got {value!r}.")
+
+
+def _check_positive_int(value, name: str) -> None:
+    """Raise if ``value`` is not a positive python int."""
+    if not (isinstance(value, int) and not isinstance(value, bool) and value > 0):
+        raise ValueError(f"`{name}` must be a positive integer; got {value!r}.")
+
+
 def _check_same_shape(preds: Array, target: Array) -> None:
     """Raise if shapes differ. Reference: checks.py:30-33."""
     if preds.shape != target.shape:

@@ -37,6 +37,7 @@ from jax import Array
 from metrics_tpu.core.buffers import CatBuffer
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.utils.data import apply_to_collection
+from metrics_tpu.utils.checks import _check_arg_choice
 
 
 def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optional[np.random.Generator] = None) -> Array:
@@ -89,11 +90,7 @@ class BootStrapper(Metric):
         self.raw = raw
         self._rng = np.random.default_rng(seed)
 
-        allowed_sampling = ("poisson", "multinomial")
-        if sampling_strategy not in allowed_sampling:
-            raise ValueError(
-                f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling} but received {sampling_strategy}"
-            )
+        _check_arg_choice(sampling_strategy, "sampling_strategy", ("poisson", "multinomial"))
         self.sampling_strategy = sampling_strategy
 
         self.base = deepcopy(base_metric)
