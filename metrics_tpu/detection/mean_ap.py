@@ -31,6 +31,7 @@ from jax import Array
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.ops.detection.boxes import box_area, box_convert, box_iou, mask_area, mask_iou
 from metrics_tpu.ops.detection.matching import match_image
+from metrics_tpu.ops.detection.rle import is_rle, masks_from_rle_list
 from metrics_tpu.parallel import sync as _sync
 
 _BBOX_AREA_RANGES = {
@@ -176,9 +177,14 @@ class MeanAveragePrecision(Metric):
         if self.iou_type == "bbox":
             boxes = _fix_empty_tensors(jnp.asarray(item["boxes"], dtype=jnp.float32))
             return box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
-        # segm: dense binary masks [N, H, W] (device-native; RLE is a CPU
-        # string format — see ops/detection/boxes.py:mask_iou)
-        masks = jnp.asarray(item["masks"], dtype=bool)
+        # segm: dense binary masks [N, H, W] on device. pycocotools-style RLE
+        # input (reference mean_ap.py:127-142) is a CPU byte-string format —
+        # decoded on host (ops/detection/rle.py), evaluated on device.
+        raw = item["masks"]
+        if isinstance(raw, (list, tuple)) and raw and is_rle(raw[0]):
+            masks = jnp.asarray(masks_from_rle_list(raw))
+        else:
+            masks = jnp.asarray(raw, dtype=bool)
         if masks.size == 0 and masks.ndim != 3:
             return masks.reshape(0, 0, 0)
         return masks

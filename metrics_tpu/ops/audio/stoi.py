@@ -47,7 +47,9 @@ def _third_octave_matrix(fs: int = FS, nfft: int = NFFT, num_bands: int = NUMBAN
         fl_ii = np.argmin((f - freq_low[i]) ** 2)
         fh_ii = np.argmin((f - freq_high[i]) ** 2)
         obm[i, fl_ii:fh_ii] = 1
-    return jnp.asarray(obm)
+    # cache the numpy constant, NOT a jnp array: a device array materialized
+    # inside the first caller's trace would be memoized as a leaked tracer
+    return obm
 
 
 def _frame(x: Array, frame_len: int = N_FRAME, hop: int = N_FRAME // 2) -> Array:

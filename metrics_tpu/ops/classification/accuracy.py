@@ -18,8 +18,13 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.ops.classification.stat_scores import _reduce_stat_scores, _stat_scores_update
-from metrics_tpu.ops.classification.precision_recall import _check_avg_args
-from metrics_tpu.utils.checks import _check_positive_int, _check_classification_inputs, _input_format_classification, _input_squeeze
+from metrics_tpu.utils.checks import (
+    _check_avg_args,
+    _check_classification_inputs,
+    _check_positive_int,
+    _input_format_classification,
+    _input_squeeze,
+)
 from metrics_tpu.utils.enums import AverageMethod, DataType, MDMCAverageMethod
 
 

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.ops.classification._ratio import mask_absent_and_reduce
-from metrics_tpu.ops.classification.precision_recall import _check_avg_args
+from metrics_tpu.utils.checks import _check_avg_args
 from metrics_tpu.ops.classification.stat_scores import _stat_scores_update
 
 

@@ -12,19 +12,7 @@ from jax import Array
 
 from metrics_tpu.ops.classification._ratio import mask_absent_and_reduce
 from metrics_tpu.ops.classification.stat_scores import _stat_scores_update
-from metrics_tpu.utils.checks import _check_arg_choice
-
-
-def _check_avg_args(average, mdmc_average, num_classes, ignore_index):
-    _check_arg_choice(average, "average", ("micro", "macro", "weighted", "samples", "none", None))
-    _check_arg_choice(mdmc_average, "mdmc_average", (None, "samplewise", "global"))
-    if average in ("macro", "weighted", "none", None) and (not num_classes or num_classes < 1):
-        raise ValueError(f"average={average!r} requires `num_classes` to be set to a positive integer.")
-    if num_classes and ignore_index is not None and (not ignore_index < num_classes or num_classes == 1):
-        raise ValueError(
-            f"`ignore_index` {ignore_index} is out of range for {num_classes} classes "
-            "(needs ignore_index < num_classes and num_classes > 1)."
-        )
+from metrics_tpu.utils.checks import _check_avg_args
 
 
 def _precision_compute(tp: Array, fp: Array, fn: Array, average: Optional[str], mdmc_average: Optional[str]) -> Array:

@@ -10,7 +10,7 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.ops.classification.precision_recall import _check_avg_args
+from metrics_tpu.utils.checks import _check_avg_args
 from metrics_tpu.ops.classification.stat_scores import _reduce_stat_scores, _stat_scores_update
 from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
 

@@ -98,6 +98,27 @@ def _check_positive_int(value, name: str) -> None:
         raise ValueError(f"`{name}` must be a positive integer; got {value!r}.")
 
 
+def _check_avg_args(average, mdmc_average, num_classes, ignore_index) -> None:
+    """Shared average/mdmc_average/num_classes/ignore_index validation used by
+    the stat-scores-derived functionals (accuracy/precision/recall/dice/
+    f_beta/specificity).
+
+    NEGATIVE ``ignore_index`` is deliberately allowed: it selects the
+    drop-rows-with-this-label path (reference
+    ``_drop_negative_ignored_indices``; see ops/classification/stat_scores.py
+    module docstring and tests/classification/test_confmat_family.py's
+    negative-index regression test), so only the upper bound is enforced."""
+    _check_arg_choice(average, "average", ("micro", "macro", "weighted", "samples", "none", None))
+    _check_arg_choice(mdmc_average, "mdmc_average", (None, "samplewise", "global"))
+    if average in ("macro", "weighted", "none", None) and (not num_classes or num_classes < 1):
+        raise ValueError(f"average={average!r} requires `num_classes` to be set to a positive integer.")
+    if num_classes and ignore_index is not None and (not ignore_index < num_classes or num_classes == 1):
+        raise ValueError(
+            f"`ignore_index` {ignore_index} is out of range for {num_classes} classes "
+            "(needs ignore_index < num_classes and num_classes > 1)."
+        )
+
+
 def _check_same_shape(preds: Array, target: Array) -> None:
     """Raise if shapes differ. Reference: checks.py:30-33."""
     if preds.shape != target.shape:

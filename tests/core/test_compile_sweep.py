@@ -1,0 +1,170 @@
+"""Full-inventory class-metric compile sweep.
+
+Reference analog: tests/helpers/testers.py:163-176 — the reference
+torch-scripts every class metric inside every test. The tpu equivalent is
+this sweep: every exported class metric is instantiated from
+tests/helpers/inventory.py and its pinned ``compile_level`` is ENFORCED:
+
+- ``full``: one traced shard_map program runs update -> sync -> compute over
+  the 8-device mesh and matches the eager sequential oracle on all shards.
+- ``update_sync``: update+sync trace under shard_map; compute runs eagerly on
+  the synced state and matches the oracle.
+- ``buffered``: the default construction is eager-only (unbounded lists) AND
+  the ``buffer_capacity`` variant achieves ``buffered_level``.
+- ``eager_only``: ``supports_compiled_update`` is False by design (host rng,
+  python-structured compute) and the eager path works.
+- ``host``: update consumes python objects; eager end-to-end only.
+
+A completeness guard asserts the inventory covers every exported Metric
+subclass, so a newly added metric cannot silently skip the sweep.
+"""
+from __future__ import annotations
+
+import importlib.util
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8 moved shard_map to the root namespace
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from tests.helpers.inventory import INVENTORY, exported_metric_classes
+
+WORLD = 8
+
+
+def _mesh():
+    devices = jax.devices()
+    if len(devices) < WORLD:
+        pytest.skip(f"needs {WORLD} devices")
+    return Mesh(np.asarray(devices[:WORLD]), ("data",))
+
+
+def _as_calls(batch):
+    """Normalize Entry.batch output to a list of (args, kwargs) update calls."""
+    out = batch()
+    return out if isinstance(out, list) else [out]
+
+
+def _shard_call(args, d, world):
+    return tuple(a[d * (a.shape[0] // world):(d + 1) * (a.shape[0] // world)] for a in args)
+
+
+def _tree_close(a, b, atol=1e-4):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        xa = np.asarray(x, dtype=np.float64)
+        assert np.isfinite(xa).all(), "non-finite sweep output (NaN==NaN must not mask a failure)"
+        np.testing.assert_allclose(xa, np.asarray(y, dtype=np.float64), atol=atol, rtol=1e-3)
+
+
+def _eager_oracle(make, calls):
+    """Sequential eager update over every shard of every call, fresh instance."""
+    m = make()
+    for args, kwargs in calls:
+        for d in range(WORLD):
+            m.update(*_shard_call(args, d, WORLD), **kwargs)
+    return m.compute()
+
+
+def _run_level(make, batch, level):
+    calls = _as_calls(batch)
+    metric = make()
+    assert metric.supports_compiled_update, (
+        f"{type(metric).__name__} pinned as compiled but supports_compiled_update is False"
+    )
+    mesh = _mesh()
+    flat_args = [a for args, _ in calls for a in args]
+    assert all(a.shape[0] % WORLD == 0 for a in flat_args)
+    static_kwargs = [kwargs for _, kwargs in calls]
+
+    def update_and_sync(*all_shard_args):
+        st = metric.get_state()
+        i = 0
+        for (args, _), kwargs in zip(calls, static_kwargs):
+            n = len(args)
+            st = metric.update_state(st, *all_shard_args[i:i + n], **kwargs)
+            i += n
+        return metric.sync_states(st, "data")
+
+    in_specs = tuple(P("data") for _ in flat_args)
+
+    if level == "full":
+        def program(*all_shard_args):
+            out = metric.compute_state(update_and_sync(*all_shard_args))
+            return jax.tree_util.tree_map(lambda x: jnp.expand_dims(jnp.asarray(x), 0), out)
+
+        fn = shard_map(program, mesh=mesh, in_specs=in_specs, out_specs=P("data"), check_vma=False)
+        out = jax.jit(fn)(*flat_args)
+        oracle = _eager_oracle(make, calls)
+        for d in range(WORLD):  # every device row must equal the oracle
+            _tree_close(jax.tree_util.tree_map(lambda x: x[d], out), oracle)
+    elif level == "update_sync":
+        def program(*all_shard_args):
+            st = update_and_sync(*all_shard_args)
+            return jax.tree_util.tree_map(lambda x: jnp.expand_dims(jnp.asarray(x), 0), dict(st))
+
+        fn = shard_map(program, mesh=mesh, in_specs=in_specs, out_specs=P("data"), check_vma=False)
+        synced = jax.jit(fn)(*flat_args)
+        # CatBuffer states are pytrees, so tree_map rebuilds them intact.
+        # compute on the SAME instance that traced the updates: mode-switching
+        # metrics (e.g. AUROC) pin their input mode as python config during
+        # update, outside the state pytree.
+        st0 = jax.tree_util.tree_map(lambda x: x[0], synced)
+        out = metric.compute_state(st0)
+        _tree_close(out, _eager_oracle(make, calls))
+    else:  # pragma: no cover
+        raise AssertionError(level)
+
+
+@pytest.mark.parametrize("name", sorted(INVENTORY), ids=str)
+def test_compile_sweep(name):
+    entry = INVENTORY[name]
+    if entry.skip and importlib.util.find_spec(entry.skip) is None:
+        pytest.skip(f"optional dependency {entry.skip} absent")
+
+    if entry.compile_level in ("full", "update_sync"):
+        _run_level(entry.make, entry.batch, entry.compile_level)
+    elif entry.compile_level == "buffered":
+        plain = entry.make()
+        assert not plain.supports_compiled_update, (
+            f"{name} pinned 'buffered' but the default construction already compiles — "
+            "promote its compile_level"
+        )
+        for args, kwargs in _as_calls(entry.batch):
+            plain.update(*args, **kwargs)
+        plain.compute()  # eager default path must work
+        assert entry.buffered is not None, f"{name}: buffered factory missing"
+        _run_level(entry.buffered, entry.batch, entry.buffered_level)
+    elif entry.compile_level == "eager_only":
+        m = entry.make()
+        for args, kwargs in _as_calls(entry.batch):
+            m.update(*args, **kwargs)
+        m.compute()
+        # the explicit assertion: this class does NOT claim the compiled path
+        assert not getattr(m, "supports_compiled_update", False) or name in (
+            "ClasswiseWrapper", "MinMaxMetric", "MultioutputWrapper", "CompositionalMetric",
+        ), f"{name} pinned eager_only but reports supports_compiled_update"
+    elif entry.compile_level == "host":
+        m = entry.make()
+        for args, kwargs in _as_calls(entry.batch):
+            m.update(*args, **kwargs)
+        m.compute()
+    else:  # pragma: no cover
+        raise AssertionError(entry.compile_level)
+
+
+def test_inventory_is_complete():
+    exported = set(exported_metric_classes())
+    covered = set(INVENTORY)
+    missing = exported - covered
+    assert not missing, f"exported class metrics missing from the sweep inventory: {sorted(missing)}"
+    stale = covered - exported
+    assert not stale, f"inventory names not exported (renamed/removed?): {sorted(stale)}"

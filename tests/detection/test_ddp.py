@@ -1,0 +1,118 @@
+"""DDP grid for detection — the list-state gather path.
+
+Reference parity: the reference runs MeanAveragePrecision with
+ddp=[False, True] (tests/detection/test_map.py via testers.py:398-439). mAP
+keeps per-image variable-length box/label/score lists, which is exactly the
+state shape the gather path must preserve: merge must concatenate the ranks'
+image lists without reordering boxes within an image or pairing detections
+with the wrong ground truths. The merged compute must EXACTLY equal a single
+process that saw every image.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import MeanAveragePrecision
+from tests.helpers.testers import merge_world
+
+WORLD = 4
+N_IMAGES = 8
+
+_rng = np.random.default_rng(99)
+
+
+def _random_image(n_det: int, n_gt: int, n_classes: int = 3, size: float = 100.0):
+    def boxes(n):
+        xy = _rng.random((n, 2)) * (size / 2)
+        wh = 5.0 + _rng.random((n, 2)) * (size / 3)
+        return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+    pred = dict(
+        boxes=jnp.asarray(boxes(n_det)),
+        scores=jnp.asarray(_rng.random(n_det).astype(np.float32)),
+        labels=jnp.asarray(_rng.integers(0, n_classes, n_det)),
+    )
+    target = dict(
+        boxes=jnp.asarray(boxes(n_gt)),
+        labels=jnp.asarray(_rng.integers(0, n_classes, n_gt)),
+    )
+    return pred, target
+
+
+def _random_mask_image(n_det: int, n_gt: int, n_classes: int = 3, hw: int = 24):
+    def masks(n):
+        out = np.zeros((n, hw, hw), dtype=bool)
+        for i in range(n):
+            x0, y0 = _rng.integers(0, hw - 8, 2)
+            w, h = _rng.integers(4, 8, 2)
+            out[i, y0:y0 + h, x0:x0 + w] = True
+        return out
+
+    pred = dict(
+        masks=jnp.asarray(masks(n_det)),
+        scores=jnp.asarray(_rng.random(n_det).astype(np.float32)),
+        labels=jnp.asarray(_rng.integers(0, n_classes, n_det)),
+    )
+    target = dict(
+        masks=jnp.asarray(masks(n_gt)),
+        labels=jnp.asarray(_rng.integers(0, n_classes, n_gt)),
+    )
+    return pred, target
+
+
+_BBOX_IMAGES = [_random_image(_rng.integers(1, 6), _rng.integers(1, 5)) for _ in range(N_IMAGES)]
+_SEGM_IMAGES = [_random_mask_image(_rng.integers(1, 4), _rng.integers(1, 4)) for _ in range(N_IMAGES)]
+
+
+def _assert_map_equal(got: dict, want: dict):
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_allclose(
+            np.asarray(got[k], dtype=np.float64), np.asarray(want[k], dtype=np.float64),
+            atol=1e-6, err_msg=k,
+        )
+
+
+@pytest.mark.parametrize(
+    "iou_type,images,kwargs",
+    [
+        ("bbox", _BBOX_IMAGES, {}),
+        ("bbox", _BBOX_IMAGES, {"class_metrics": True}),
+        ("segm", _SEGM_IMAGES, {}),
+    ],
+    ids=["bbox", "bbox-classwise", "segm"],
+)
+def test_map_ddp_merge_equals_single_process(iou_type, images, kwargs):
+    preds = [p for p, _ in images]
+    targets = [t for _, t in images]
+
+    single = MeanAveragePrecision(iou_type=iou_type, **kwargs)
+    single.update(preds, targets)
+    want = single.compute()
+
+    ranks = [MeanAveragePrecision(iou_type=iou_type, **kwargs) for _ in range(WORLD)]
+    for rank in range(WORLD):
+        ranks[rank].update(preds[rank::WORLD], targets[rank::WORLD])
+    got = merge_world(ranks).compute()
+
+    _assert_map_equal(got, want)
+
+
+def test_map_ddp_uneven_ranks():
+    """Ranks with different image counts (the real-world tail batch)."""
+    preds = [p for p, _ in _BBOX_IMAGES]
+    targets = [t for _, t in _BBOX_IMAGES]
+
+    single = MeanAveragePrecision()
+    single.update(preds, targets)
+    want = single.compute()
+
+    splits = [0, 1, 4, 8]  # rank sizes 1, 3, 4 — rank 0 empty is exercised too
+    ranks = [MeanAveragePrecision() for _ in range(len(splits) - 1 + 1)]
+    ranks[0].update([], [])  # a rank that saw no data must not poison the merge
+    for i in range(len(splits) - 1):
+        ranks[i + 1].update(preds[splits[i]:splits[i + 1]], targets[splits[i]:splits[i + 1]])
+    got = merge_world(ranks).compute()
+
+    _assert_map_equal(got, want)

@@ -1,0 +1,129 @@
+"""RLE mask codec and RLE ingestion for segm mAP.
+
+Reference parity: torchmetrics/detection/mean_ap.py:127-142 evaluates masks
+through pycocotools RLE. Here RLE is an ingestion format: decode host-side
+(ops/detection/rle.py), evaluate densely on device. Differential against
+pycocotools when installed; hand-built fixtures otherwise.
+"""
+import importlib.util
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import MeanAveragePrecision
+from metrics_tpu.ops.detection.rle import (
+    is_rle,
+    masks_from_rle_list,
+    rle_decode,
+    rle_encode,
+)
+
+_HAS_PYCOCO = importlib.util.find_spec("pycocotools") is not None
+
+_rng = np.random.default_rng(5)
+
+
+def _random_mask(h=17, w=23, p=0.3):
+    return _rng.random((h, w)) < p
+
+
+# --------------------------------------------------------------------------- #
+# codec
+# --------------------------------------------------------------------------- #
+def test_uncompressed_roundtrip_hand_fixture():
+    # 2x3 mask, column-major runs: col0 = [1,0], col1 = [0,1], col2 = [1,1]
+    mask = np.asarray([[1, 0, 1], [0, 1, 1]], dtype=bool)
+    rle = rle_encode(mask, compress=False)
+    assert rle["size"] == [2, 3]
+    # flat(F) = 1,0,0,1,1,1 -> starts with fg => leading 0 run
+    assert rle["counts"] == [0, 1, 2, 3]
+    np.testing.assert_array_equal(rle_decode(rle), mask)
+
+
+@pytest.mark.parametrize("compress", [False, True], ids=["plain", "compressed"])
+@pytest.mark.parametrize("shape", [(1, 1), (7, 3), (17, 23), (64, 64)], ids=str)
+def test_roundtrip_random(compress, shape):
+    mask = _rng.random(shape) < 0.4
+    np.testing.assert_array_equal(rle_decode(rle_encode(mask, compress=compress)), mask)
+
+
+def test_roundtrip_extremes():
+    for mask in (np.zeros((5, 4), bool), np.ones((5, 4), bool)):
+        for compress in (False, True):
+            np.testing.assert_array_equal(rle_decode(rle_encode(mask, compress=compress)), mask)
+
+
+def test_decode_validates():
+    with pytest.raises(ValueError, match="size"):
+        rle_decode({"counts": [4]})
+    with pytest.raises(ValueError, match="pixels"):
+        rle_decode({"size": [2, 2], "counts": [3]})
+    with pytest.raises(ValueError, match="share a size"):
+        masks_from_rle_list([rle_encode(np.zeros((2, 2), bool)), rle_encode(np.zeros((3, 3), bool))])
+
+
+def test_is_rle():
+    assert is_rle({"size": [2, 2], "counts": [4]})
+    assert not is_rle({"masks": 1})
+    assert not is_rle([1, 2])
+
+
+@pytest.mark.skipif(not _HAS_PYCOCO, reason="pycocotools absent")
+def test_codec_differential_pycocotools():
+    from pycocotools import mask as mask_utils
+
+    for _ in range(20):
+        m = _random_mask(h=int(_rng.integers(1, 40)), w=int(_rng.integers(1, 40)))
+        theirs = mask_utils.encode(np.asfortranarray(m.astype(np.uint8)))
+        ours = rle_encode(m, compress=True)
+        assert ours["counts"] == theirs["counts"], "compressed byte strings differ"
+        np.testing.assert_array_equal(rle_decode(theirs), m)
+
+
+# --------------------------------------------------------------------------- #
+# mAP ingestion: RLE input == dense input
+# --------------------------------------------------------------------------- #
+def _mask_image(n, hw=24):
+    out = np.zeros((n, hw, hw), dtype=bool)
+    for i in range(n):
+        x0, y0 = _rng.integers(0, hw - 8, 2)
+        w, h = _rng.integers(4, 8, 2)
+        out[i, y0:y0 + h, x0:x0 + w] = True
+    return out
+
+
+@pytest.mark.parametrize("compress", [False, True], ids=["plain", "compressed"])
+def test_segm_map_from_rle_equals_dense(compress):
+    n_imgs = 4
+    preds_dense, targets_dense, preds_rle, targets_rle = [], [], [], []
+    for _ in range(n_imgs):
+        nd, ng = int(_rng.integers(1, 4)), int(_rng.integers(1, 4))
+        dm, gm = _mask_image(nd), _mask_image(ng)
+        scores = _rng.random(nd).astype(np.float32)
+        dl = _rng.integers(0, 2, nd)
+        gl = _rng.integers(0, 2, ng)
+        preds_dense.append(dict(masks=jnp.asarray(dm), scores=jnp.asarray(scores), labels=jnp.asarray(dl)))
+        targets_dense.append(dict(masks=jnp.asarray(gm), labels=jnp.asarray(gl)))
+        preds_rle.append(dict(
+            masks=[rle_encode(m, compress=compress) for m in dm],
+            scores=jnp.asarray(scores), labels=jnp.asarray(dl),
+        ))
+        targets_rle.append(dict(
+            masks=[rle_encode(m, compress=compress) for m in gm], labels=jnp.asarray(gl),
+        ))
+
+    m_dense = MeanAveragePrecision(iou_type="segm")
+    m_dense.update(preds_dense, targets_dense)
+    want = m_dense.compute()
+
+    m_rle = MeanAveragePrecision(iou_type="segm")
+    m_rle.update(preds_rle, targets_rle)
+    got = m_rle.compute()
+
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_allclose(
+            np.asarray(got[k], np.float64), np.asarray(want[k], np.float64), atol=1e-6, err_msg=k,
+        )

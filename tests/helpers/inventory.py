@@ -1,0 +1,438 @@
+"""Factory inventory covering EVERY exported class metric.
+
+Reference analog: the reference test suite torch-scripts every class metric
+inside every test run (tests/helpers/testers.py:163-176) — its guarantee that
+no class silently falls off the compiled path. Here the analogous guarantee is
+explicit: each entry pins a ``compile_level`` stating exactly how far that
+metric participates in jit/shard_map compilation, and
+tests/core/test_compile_sweep.py enforces it against the live class.
+
+compile_level semantics:
+
+- ``"full"``: ``update_state`` -> ``sync_states`` -> ``compute_state`` runs as
+  ONE traced program under shard_map over the 8-device CPU mesh, and the
+  result matches the eager sequential oracle.
+- ``"update_sync"``: update+sync trace (fixed-shape states), but ``compute``
+  needs host-side work (dynamic output shapes, python grouping) and runs
+  eagerly on the synced state.
+- ``"buffered"``: default construction has unbounded list states (eager-only);
+  the ``buffered`` factory (buffer_capacity=N) is the compiled variant and is
+  tested at the level given by ``buffered_level``.
+- ``"eager_only"``: states stay unbounded lists by design (e.g. per-image
+  variable-count detection lists); compiled update is asserted to be
+  unsupported via ``supports_compiled_update == False``.
+- ``"host"``: update consumes python objects (strings, dicts, token lists) —
+  tracing does not apply; the class is asserted functional end-to-end eagerly.
+
+Inputs are deterministic (module-level seeded rng) so the shard-vs-sequential
+oracle comparison is exact.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+import metrics_tpu as M
+
+_rng = np.random.default_rng(1234)
+
+# ---------------------------------------------------------------- fixtures --
+N = 24  # divisible by 8 for even shard splits
+C = 4
+
+_PROBS = jnp.asarray(_rng.dirichlet(np.ones(C), size=N).astype(np.float32))  # (N, C)
+_LABELS = jnp.asarray(_rng.integers(0, C, N))
+_BIN_PROBS = jnp.asarray(_rng.random(N).astype(np.float32))
+_BIN_LABELS = jnp.asarray(_rng.integers(0, 2, N))
+_ML_PROBS = jnp.asarray(_rng.random((N, C)).astype(np.float32))
+_ML_LABELS = jnp.asarray(_rng.integers(0, 2, (N, C)))
+_LOGITS = jnp.asarray(_rng.normal(size=(N, C)).astype(np.float32))
+_REG_P = jnp.asarray(_rng.random(N).astype(np.float32) + 0.1)
+_REG_T = jnp.asarray(_rng.random(N).astype(np.float32) + 0.1)
+_REG_P2 = jnp.asarray(_rng.random((N, 2)).astype(np.float32) + 0.1)
+_REG_T2 = jnp.asarray(_rng.random((N, 2)).astype(np.float32) + 0.1)
+_IMG_P = jnp.asarray(_rng.random((8, 3, 16, 16)).astype(np.float32))
+_IMG_T = jnp.asarray(_rng.random((8, 3, 16, 16)).astype(np.float32))
+_BIG_P = jnp.asarray(_rng.random((8, 1, 192, 192)).astype(np.float32))
+_BIG_T = 0.8 * _BIG_P + 0.2 * jnp.asarray(_rng.random((8, 1, 192, 192)).astype(np.float32))
+_AUD_T = jnp.asarray(_rng.normal(size=(8, 2000)).astype(np.float32))
+_AUD_P = _AUD_T + 0.3 * jnp.asarray(_rng.normal(size=(8, 2000)).astype(np.float32))
+_MIX_T = jnp.asarray(_rng.normal(size=(8, 2, 1200)).astype(np.float32))
+_MIX_P = _MIX_T[:, ::-1] + 0.2 * jnp.asarray(_rng.normal(size=(8, 2, 1200)).astype(np.float32))
+# long enough that every shard clears STOI's 30-frame segment window even
+# after silent-frame removal shortens the overlap-add reconstruction
+_STOI_T = jnp.asarray(_rng.normal(size=(8, 8000)).astype(np.float32))
+_STOI_P = _STOI_T + 0.2 * jnp.asarray(_rng.normal(size=(8, 8000)).astype(np.float32))
+_RET_P = jnp.asarray(_rng.random(N).astype(np.float32))
+_RET_T = jnp.asarray(_rng.integers(0, 2, N))
+_RET_IDX = jnp.asarray(np.sort(_rng.integers(0, 4, N)))
+
+_FEAT_D = 6
+
+
+class _StubFeatures:
+    """Deterministic ``imgs -> (N, d)`` projection standing in for InceptionV3."""
+
+    num_features = _FEAT_D
+
+    def __init__(self, in_dim: int = 3 * 16 * 16) -> None:
+        self.w = jnp.asarray(_rng.normal(size=(in_dim, _FEAT_D)).astype(np.float32) / np.sqrt(in_dim))
+
+    def __call__(self, imgs):
+        return imgs.reshape(imgs.shape[0], -1) @ self.w
+
+
+class _StubLPIPSNet:
+    """Callable ``(img1, img2) -> (N,)`` distance standing in for LPIPS trunks."""
+
+    def __call__(self, a, b):
+        return jnp.mean((a - b) ** 2, axis=(1, 2, 3))
+
+
+# single shared instances: the sweep compares a sharded run against a fresh
+# eager oracle instance, so the projection weights must be identical
+_STUB_FEATURES = _StubFeatures()
+_STUB_LPIPS = _StubLPIPSNet()
+
+
+class Entry(NamedTuple):
+    make: Callable[[], Any]
+    # returns ONE (update_args, static_kwargs) pair, or a LIST of such pairs
+    # (multi-call updates, e.g. FID/KID real+fake); arrays traced, kwargs static
+    batch: Callable[[], Any]
+    compile_level: str  # full | update_sync | buffered | eager_only | host
+    buffered: Optional[Callable[[], Any]] = None
+    buffered_level: str = "full"
+    skip: Optional[str] = None  # gated optional dependency
+
+
+def _b(*args, **kwargs):
+    return lambda: (args, kwargs)
+
+
+TEXT_PREDS = ["the cat sat on the mat", "a quick brown fox"]
+TEXT_TARGETS = [["there is a cat on the mat"], ["a fast brown fox jumps"]]
+TEXT_TARGETS_FLAT = ["there is a cat on the mat", "a fast brown fox jumps"]
+
+_DET_PREDS = [
+    dict(
+        boxes=jnp.asarray([[10.0, 10.0, 50.0, 50.0], [20.0, 20.0, 60.0, 60.0]]),
+        scores=jnp.asarray([0.9, 0.4]),
+        labels=jnp.asarray([0, 1]),
+    )
+]
+_DET_TARGET = [
+    dict(
+        boxes=jnp.asarray([[12.0, 12.0, 52.0, 52.0]]),
+        labels=jnp.asarray([0]),
+    )
+]
+
+
+INVENTORY = {
+    # ------------------------------------------------------- aggregation ----
+    "MeanMetric": Entry(lambda: M.MeanMetric(), _b(_REG_P), "full"),
+    "SumMetric": Entry(lambda: M.SumMetric(), _b(_REG_P), "full"),
+    "MaxMetric": Entry(lambda: M.MaxMetric(), _b(_REG_P), "full"),
+    "MinMetric": Entry(lambda: M.MinMetric(), _b(_REG_P), "full"),
+    "CatMetric": Entry(
+        lambda: M.CatMetric(), _b(_REG_P), "buffered",
+        buffered=lambda: M.CatMetric(buffer_capacity=256), buffered_level="update_sync",
+    ),
+    # ---------------------------------------------------- classification ----
+    "Accuracy": Entry(lambda: M.Accuracy(num_classes=C), _b(_PROBS, _LABELS), "full"),
+    "AUC": Entry(
+        lambda: M.AUC(reorder=True), _b(_REG_P, _REG_T), "buffered",
+        # AUC compute sorts a dynamic concat -> static with CatBuffer capacity
+        buffered=lambda: M.AUC(reorder=True, buffer_capacity=256), buffered_level="update_sync",
+    ),
+    "AUROC": Entry(
+        lambda: M.AUROC(num_classes=C), _b(_PROBS, _LABELS), "buffered",
+        buffered=lambda: M.AUROC(num_classes=C, buffer_capacity=256), buffered_level="update_sync",
+    ),
+    "AveragePrecision": Entry(
+        lambda: M.AveragePrecision(num_classes=C), _b(_PROBS, _LABELS), "buffered",
+        buffered=lambda: M.AveragePrecision(num_classes=C, buffer_capacity=256),
+        buffered_level="update_sync",  # AP curve has data-dependent thresholds
+    ),
+    "BinnedAveragePrecision": Entry(
+        lambda: M.BinnedAveragePrecision(num_classes=C, thresholds=21), _b(_PROBS, _LABELS), "full",
+    ),
+    "BinnedPrecisionRecallCurve": Entry(
+        lambda: M.BinnedPrecisionRecallCurve(num_classes=C, thresholds=21), _b(_PROBS, _LABELS), "full",
+    ),
+    "BinnedRecallAtFixedPrecision": Entry(
+        lambda: M.BinnedRecallAtFixedPrecision(num_classes=C, min_precision=0.5, thresholds=21),
+        _b(_PROBS, _LABELS), "full",
+    ),
+    "CalibrationError": Entry(
+        lambda: M.CalibrationError(n_bins=10), _b(_BIN_PROBS, _BIN_LABELS), "buffered",
+        buffered=lambda: M.CalibrationError(n_bins=10, buffer_capacity=256), buffered_level="update_sync",
+    ),
+    "CohenKappa": Entry(lambda: M.CohenKappa(num_classes=C), _b(_PROBS, _LABELS), "full"),
+    "ConfusionMatrix": Entry(lambda: M.ConfusionMatrix(num_classes=C), _b(_PROBS, _LABELS), "full"),
+    "Dice": Entry(lambda: M.Dice(num_classes=C, multiclass=True), _b(_PROBS, _LABELS), "full"),
+    "F1Score": Entry(lambda: M.F1Score(num_classes=C), _b(_PROBS, _LABELS), "full"),
+    "FBetaScore": Entry(lambda: M.FBetaScore(num_classes=C, beta=2.0), _b(_PROBS, _LABELS), "full"),
+    "HammingDistance": Entry(lambda: M.HammingDistance(), _b(_ML_PROBS, _ML_LABELS), "full"),
+    "HingeLoss": Entry(lambda: M.HingeLoss(multiclass_mode="crammer-singer"), _b(_LOGITS, _LABELS), "full"),
+    "JaccardIndex": Entry(lambda: M.JaccardIndex(num_classes=C), _b(_PROBS, _LABELS), "full"),
+    "KLDivergence": Entry(lambda: M.KLDivergence(), _b(_PROBS, _PROBS[::-1]), "full"),
+    "MatthewsCorrCoef": Entry(lambda: M.MatthewsCorrCoef(num_classes=C), _b(_PROBS, _LABELS), "full"),
+    "Precision": Entry(lambda: M.Precision(num_classes=C), _b(_PROBS, _LABELS), "full"),
+    "PrecisionRecallCurve": Entry(
+        lambda: M.PrecisionRecallCurve(num_classes=C), _b(_PROBS, _LABELS), "buffered",
+        buffered=lambda: M.PrecisionRecallCurve(num_classes=C, buffer_capacity=256),
+        buffered_level="update_sync",  # curve output length is data-dependent
+    ),
+    "ROC": Entry(
+        lambda: M.ROC(num_classes=C), _b(_PROBS, _LABELS), "buffered",
+        buffered=lambda: M.ROC(num_classes=C, buffer_capacity=256),
+        buffered_level="update_sync",
+    ),
+    "Recall": Entry(lambda: M.Recall(num_classes=C), _b(_PROBS, _LABELS), "full"),
+    "Specificity": Entry(lambda: M.Specificity(num_classes=C), _b(_PROBS, _LABELS), "full"),
+    "StatScores": Entry(lambda: M.StatScores(num_classes=C), _b(_PROBS, _LABELS), "full"),
+    "CoverageError": Entry(lambda: M.CoverageError(), _b(_ML_PROBS, _ML_LABELS), "full"),
+    "LabelRankingAveragePrecision": Entry(
+        lambda: M.LabelRankingAveragePrecision(), _b(_ML_PROBS, _ML_LABELS), "full",
+    ),
+    "LabelRankingLoss": Entry(lambda: M.LabelRankingLoss(), _b(_ML_PROBS, _ML_LABELS), "full"),
+    # -------------------------------------------------------- regression ----
+    "CosineSimilarity": Entry(
+        lambda: M.CosineSimilarity(), _b(_REG_P2, _REG_T2), "buffered",
+        buffered=lambda: M.CosineSimilarity(buffer_capacity=256), buffered_level="update_sync",
+    ),
+    "ExplainedVariance": Entry(lambda: M.ExplainedVariance(), _b(_REG_P, _REG_T), "full"),
+    "MeanAbsoluteError": Entry(lambda: M.MeanAbsoluteError(), _b(_REG_P, _REG_T), "full"),
+    "MeanAbsolutePercentageError": Entry(
+        lambda: M.MeanAbsolutePercentageError(), _b(_REG_P, _REG_T), "full",
+    ),
+    "MeanSquaredError": Entry(lambda: M.MeanSquaredError(), _b(_REG_P, _REG_T), "full"),
+    "MeanSquaredLogError": Entry(lambda: M.MeanSquaredLogError(), _b(_REG_P, _REG_T), "full"),
+    "PearsonCorrCoef": Entry(lambda: M.PearsonCorrCoef(), _b(_REG_P, _REG_T), "full"),
+    "R2Score": Entry(lambda: M.R2Score(), _b(_REG_P, _REG_T), "full"),
+    "SpearmanCorrCoef": Entry(
+        lambda: M.SpearmanCorrCoef(), _b(_REG_P, _REG_T), "buffered",
+        buffered=lambda: M.SpearmanCorrCoef(buffer_capacity=256),
+        buffered_level="update_sync",  # rank transform reads the full buffer
+    ),
+    "SymmetricMeanAbsolutePercentageError": Entry(
+        lambda: M.SymmetricMeanAbsolutePercentageError(), _b(_REG_P, _REG_T), "full",
+    ),
+    "TweedieDevianceScore": Entry(lambda: M.TweedieDevianceScore(power=1.5), _b(_REG_P, _REG_T), "full"),
+    "WeightedMeanAbsolutePercentageError": Entry(
+        lambda: M.WeightedMeanAbsolutePercentageError(), _b(_REG_P, _REG_T), "full",
+    ),
+    # ------------------------------------------------------------- image ----
+    "ErrorRelativeGlobalDimensionlessSynthesis": Entry(
+        lambda: M.ErrorRelativeGlobalDimensionlessSynthesis(), _b(_IMG_P, _IMG_T), "buffered",
+        buffered=lambda: M.ErrorRelativeGlobalDimensionlessSynthesis(buffer_capacity=64),
+        buffered_level="update_sync",
+    ),
+    "MultiScaleStructuralSimilarityIndexMeasure": Entry(
+        lambda: M.MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0), _b(_BIG_P, _BIG_T), "buffered",
+        buffered=lambda: M.MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0, buffer_capacity=16),
+        buffered_level="update_sync",
+    ),
+    "PeakSignalNoiseRatio": Entry(
+        lambda: M.PeakSignalNoiseRatio(data_range=1.0), _b(_IMG_P, _IMG_T), "full",
+    ),
+    "SpectralAngleMapper": Entry(
+        lambda: M.SpectralAngleMapper(), _b(_IMG_P, _IMG_T), "buffered",
+        buffered=lambda: M.SpectralAngleMapper(buffer_capacity=64), buffered_level="update_sync",
+    ),
+    "SpectralDistortionIndex": Entry(
+        lambda: M.SpectralDistortionIndex(), _b(_IMG_P, _IMG_T), "buffered",
+        buffered=lambda: M.SpectralDistortionIndex(buffer_capacity=64), buffered_level="update_sync",
+    ),
+    "StructuralSimilarityIndexMeasure": Entry(
+        lambda: M.StructuralSimilarityIndexMeasure(data_range=1.0), _b(_IMG_P, _IMG_T), "buffered",
+        buffered=lambda: M.StructuralSimilarityIndexMeasure(data_range=1.0, buffer_capacity=16),
+        buffered_level="update_sync",
+    ),
+    "UniversalImageQualityIndex": Entry(
+        lambda: M.UniversalImageQualityIndex(), _b(_IMG_P, _IMG_T), "buffered",
+        buffered=lambda: M.UniversalImageQualityIndex(buffer_capacity=16),
+        buffered_level="update_sync",
+    ),
+    "FrechetInceptionDistance": Entry(
+        lambda: M.FrechetInceptionDistance(feature=_STUB_FEATURES, feature_size=_FEAT_D),
+        lambda: [((_IMG_P,), dict(real=True)), ((_IMG_T,), dict(real=False))], "full",
+    ),
+    "InceptionScore": Entry(
+        lambda: M.InceptionScore(feature=_STUB_FEATURES), _b(_IMG_P), "buffered",
+        buffered=lambda: M.InceptionScore(feature=_STUB_FEATURES, buffer_capacity=64),
+        buffered_level="update_sync",  # compute reads the dynamic-count buffer
+    ),
+    "KernelInceptionDistance": Entry(
+        lambda: M.KernelInceptionDistance(feature=_STUB_FEATURES, subset_size=8, subsets=2),
+        lambda: [((_IMG_P,), dict(real=True)), ((_IMG_T,), dict(real=False))], "buffered",
+        buffered=lambda: M.KernelInceptionDistance(
+            feature=_STUB_FEATURES, subset_size=8, subsets=2, buffer_capacity=64,
+        ),
+        buffered_level="update_sync",  # compute draws host-side rng subsets
+    ),
+    "LearnedPerceptualImagePatchSimilarity": Entry(
+        lambda: M.LearnedPerceptualImagePatchSimilarity(net=_STUB_LPIPS),
+        _b(_IMG_P, _IMG_T), "full",
+    ),
+    # ------------------------------------------------------------- audio ----
+    "SignalNoiseRatio": Entry(lambda: M.SignalNoiseRatio(), _b(_AUD_P, _AUD_T), "full"),
+    "ScaleInvariantSignalNoiseRatio": Entry(
+        lambda: M.ScaleInvariantSignalNoiseRatio(), _b(_AUD_P, _AUD_T), "full",
+    ),
+    "ScaleInvariantSignalDistortionRatio": Entry(
+        lambda: M.ScaleInvariantSignalDistortionRatio(), _b(_AUD_P, _AUD_T), "full",
+    ),
+    "SignalDistortionRatio": Entry(
+        lambda: M.SignalDistortionRatio(filter_length=64), _b(_AUD_P, _AUD_T), "full",
+    ),
+    "PermutationInvariantTraining": Entry(
+        lambda: M.PermutationInvariantTraining(
+            M.ops.scale_invariant_signal_noise_ratio, eval_func="max",
+        ),
+        _b(_MIX_P, _MIX_T), "full",
+    ),
+    "ShortTimeObjectiveIntelligibility": Entry(
+        lambda: M.ShortTimeObjectiveIntelligibility(fs=10000), _b(_STOI_P, _STOI_T), "full",
+    ),
+    "PerceptualEvaluationSpeechQuality": Entry(
+        lambda: M.PerceptualEvaluationSpeechQuality(fs=8000, mode="nb"),
+        _b(_STOI_P, _STOI_T), "host", skip="pesq",
+    ),
+    # --------------------------------------------------------- retrieval ----
+    **{
+        name: Entry(
+            (lambda cls: lambda: cls())(getattr(M, name)),
+            _b(_RET_P, _RET_T, _RET_IDX),
+            "buffered",
+            buffered=(lambda cls: lambda: cls(buffer_capacity=256))(getattr(M, name)),
+            buffered_level="update_sync",  # compute groups per-query host-side
+        )
+        for name in [
+            "RetrievalMAP", "RetrievalMRR", "RetrievalPrecision", "RetrievalRecall",
+            "RetrievalFallOut", "RetrievalHitRate", "RetrievalNormalizedDCG",
+            "RetrievalRPrecision",
+        ]
+    },
+    "RetrievalPrecisionRecallCurve": Entry(
+        lambda: M.RetrievalPrecisionRecallCurve(max_k=4),
+        _b(_RET_P, _RET_T, _RET_IDX), "buffered",
+        buffered=lambda: M.RetrievalPrecisionRecallCurve(max_k=4, buffer_capacity=256),
+        buffered_level="update_sync",
+    ),
+    "RetrievalRecallAtFixedPrecision": Entry(
+        lambda: M.RetrievalRecallAtFixedPrecision(min_precision=0.3, max_k=4),
+        _b(_RET_P, _RET_T, _RET_IDX), "buffered",
+        buffered=lambda: M.RetrievalRecallAtFixedPrecision(
+            min_precision=0.3, max_k=4, buffer_capacity=256,
+        ),
+        buffered_level="update_sync",
+    ),
+    # -------------------------------------------------------------- text ----
+    "BLEUScore": Entry(lambda: M.BLEUScore(), lambda: ((TEXT_PREDS, TEXT_TARGETS), {}), "host"),
+    "SacreBLEUScore": Entry(
+        lambda: M.SacreBLEUScore(), lambda: ((TEXT_PREDS, TEXT_TARGETS), {}), "host",
+    ),
+    "CHRFScore": Entry(lambda: M.CHRFScore(), lambda: ((TEXT_PREDS, TEXT_TARGETS), {}), "host"),
+    "TranslationEditRate": Entry(
+        lambda: M.TranslationEditRate(), lambda: ((TEXT_PREDS, TEXT_TARGETS), {}), "host",
+    ),
+    "ExtendedEditDistance": Entry(
+        lambda: M.ExtendedEditDistance(), lambda: ((TEXT_PREDS, TEXT_TARGETS_FLAT), {}), "host",
+    ),
+    "CharErrorRate": Entry(
+        lambda: M.CharErrorRate(), lambda: ((TEXT_PREDS, TEXT_TARGETS_FLAT), {}), "host",
+    ),
+    "WordErrorRate": Entry(
+        lambda: M.WordErrorRate(), lambda: ((TEXT_PREDS, TEXT_TARGETS_FLAT), {}), "host",
+    ),
+    "MatchErrorRate": Entry(
+        lambda: M.MatchErrorRate(), lambda: ((TEXT_PREDS, TEXT_TARGETS_FLAT), {}), "host",
+    ),
+    "WordInfoLost": Entry(
+        lambda: M.WordInfoLost(), lambda: ((TEXT_PREDS, TEXT_TARGETS_FLAT), {}), "host",
+    ),
+    "WordInfoPreserved": Entry(
+        lambda: M.WordInfoPreserved(), lambda: ((TEXT_PREDS, TEXT_TARGETS_FLAT), {}), "host",
+    ),
+    "ROUGEScore": Entry(
+        lambda: M.ROUGEScore(), lambda: ((TEXT_PREDS, TEXT_TARGETS_FLAT), {}), "host",
+    ),
+    "SQuAD": Entry(
+        lambda: M.SQuAD(),
+        lambda: ((
+            [dict(prediction_text="the cat", id="1")],
+            [dict(answers=dict(text=["the cat"], answer_start=[0]), id="1")],
+        ), {}),
+        "host",
+    ),
+    "BERTScore": Entry(
+        lambda: M.BERTScore(
+            model=object(),  # opaque handle passed through to the forward fn
+            user_forward_fn=lambda model, batch: jnp.stack(
+                [jnp.sin(jnp.arange(8, dtype=jnp.float32) * (1.0 + i))
+                 for i in np.asarray(batch["input_ids"]).reshape(-1)]
+            ).reshape(*batch["input_ids"].shape, 8),
+            user_tokenizer=_WhitespaceTokenizer(),
+        ),
+        lambda: ((TEXT_PREDS, TEXT_TARGETS_FLAT), {}),
+        "host",
+    ),
+    # --------------------------------------------------------- detection ----
+    "MeanAveragePrecision": Entry(
+        lambda: M.MeanAveragePrecision(),
+        lambda: ((_DET_PREDS, _DET_TARGET), {}),
+        "eager_only",  # per-image variable-count box lists by design
+    ),
+    # ---------------------------------------------------------- wrappers ----
+    "BootStrapper": Entry(
+        lambda: M.BootStrapper(M.MeanSquaredError(), num_bootstraps=4), _b(_REG_P, _REG_T),
+        "eager_only",  # resample indices are drawn on host each step (documented)
+    ),
+    "ClasswiseWrapper": Entry(
+        lambda: M.ClasswiseWrapper(M.Accuracy(num_classes=C, average="none")),
+        _b(_PROBS, _LABELS), "eager_only",  # compute returns a python dict keyed by class
+    ),
+    "MinMaxMetric": Entry(
+        lambda: M.MinMaxMetric(M.MeanSquaredError()), _b(_REG_P, _REG_T), "eager_only",
+    ),
+    "MultioutputWrapper": Entry(
+        lambda: M.MultioutputWrapper(M.MeanSquaredError(), num_outputs=2),
+        _b(_REG_P2, _REG_T2), "eager_only",  # delegates through child metric instances
+    ),
+    "CompositionalMetric": Entry(
+        lambda: M.MeanSquaredError() + M.MeanAbsoluteError(), _b(_REG_P, _REG_T), "eager_only",
+    ),
+}
+
+
+class _WhitespaceTokenizer:
+    """Minimal tokenizer contract for the BERTScore user hook."""
+
+    def __call__(self, sentences, max_length=64, **kwargs):
+        vocab = {}
+        ids = np.zeros((len(sentences), 8), dtype=np.int32)
+        mask = np.zeros((len(sentences), 8), dtype=np.int32)
+        for i, s in enumerate(sentences):
+            for j, tok in enumerate(s.split()[:8]):
+                ids[i, j] = vocab.setdefault(tok, len(vocab) + 1)
+                mask[i, j] = 1
+        return {"input_ids": jnp.asarray(ids), "attention_mask": jnp.asarray(mask)}
+
+
+def exported_metric_classes():
+    """Every Metric subclass exported at the package root."""
+    import inspect
+
+    from metrics_tpu.core.metric import Metric
+
+    out = {}
+    for n in dir(M):
+        obj = getattr(M, n)
+        if inspect.isclass(obj) and issubclass(obj, Metric) and obj is not Metric:
+            out[n] = obj
+    return out

@@ -154,6 +154,33 @@ def _class_test_ddp(
     _assert_allclose(result, sk_result, atol=atol)
 
 
+def merge_world(ranks: Sequence[Metric]) -> Metric:
+    """Host-gather ddp analog for metrics whose updates are python objects.
+
+    Text/detection metrics (and wrappers over them) consume strings or
+    per-image dict lists, so the shard_map path of `_class_test_ddp` cannot
+    apply; the reference covers them through torch.distributed host gathers
+    (tests/helpers/testers.py:398-439). Here the same guarantee comes from the
+    framework's documented equivalence sync == merge (SURVEY.md §7 decision
+    2): every rank's state — including child-metric states, deep — is folded
+    into rank 0 via ``merge_states`` with true update counts. Returns rank 0,
+    whose ``compute()`` must then equal the single-process all-data oracle.
+    """
+    nodes_per_rank = [[m for (m, _, _) in r._deep_snapshot()] for r in ranks]
+    assert all(len(n) == len(nodes_per_rank[0]) for n in nodes_per_rank), "rank metric trees differ"
+    for nodes in zip(*nodes_per_rank):
+        m0 = nodes[0]
+        merged = m0.get_state()
+        count = m0._update_count
+        for m in nodes[1:]:
+            merged = m0.merge_states(merged, m.get_state(), update_counts=(count, m._update_count))
+            count += m._update_count
+        m0.set_state(merged)
+        m0._update_count = count
+        m0._computed = None
+    return ranks[0]
+
+
 def _functional_test(
     preds: np.ndarray,
     target: np.ndarray,

@@ -1,0 +1,140 @@
+"""DDP grid for the text domain.
+
+Reference parity: every reference text class test runs with ddp=[False, True]
+via torch.distributed host gathers (tests/helpers/testers.py:398-439; e.g.
+tests/text/test_wer.py). Text updates consume python strings, so the
+distributed path here is the host-gather analog: per-rank instances, deep
+``merge_states`` fold (tests/helpers/testers.py ``merge_world``), and the
+merged compute must EXACTLY equal a single process that saw all data.
+"""
+import numpy as np
+import pytest
+
+import metrics_tpu as M
+from tests.helpers.testers import merge_world
+
+WORLD = 4
+
+_CORPUS = [
+    "the cat sat on the mat",
+    "a quick brown fox jumps over the lazy dog",
+    "hello world this is a test",
+    "jax compiles to the tpu",
+    "metrics are computed in parallel",
+    "the rain in spain stays mainly in the plain",
+    "to be or not to be that is the question",
+    "all happy families are alike",
+]
+_REFS = [
+    "the cat sits on the mat",
+    "a fast brown fox jumped over a lazy dog",
+    "hello world this was a test",
+    "jax compiled for the tpu",
+    "metrics were computed in parallel",
+    "the rain in spain falls mainly on the plain",
+    "to be or not to be that was a question",
+    "every happy family is alike",
+]
+
+# (class, preds-shape) — flat targets vs list-of-references targets
+_FLAT = [
+    M.WordErrorRate, M.CharErrorRate, M.MatchErrorRate, M.WordInfoLost,
+    M.WordInfoPreserved, M.ExtendedEditDistance,
+]
+_NESTED = [M.BLEUScore, M.SacreBLEUScore, M.CHRFScore, M.TranslationEditRate]
+
+
+def _shards(seq, world=WORLD):
+    return [seq[r::world] for r in range(world)]
+
+
+@pytest.mark.parametrize("metric_cls", _FLAT + _NESTED, ids=lambda c: c.__name__)
+def test_text_ddp_merge_equals_single_process(metric_cls):
+    nested = metric_cls in _NESTED
+    targets = [[r] for r in _REFS] if nested else _REFS
+
+    single = metric_cls()
+    single.update(_CORPUS, targets)
+    want = single.compute()
+
+    ranks = [metric_cls() for _ in range(WORLD)]
+    for rank, (p_shard, t_shard) in enumerate(zip(_shards(_CORPUS), _shards(targets))):
+        ranks[rank].update(p_shard, t_shard)
+    got = merge_world(ranks).compute()
+
+    np.testing.assert_allclose(
+        np.asarray(got, dtype=np.float64), np.asarray(want, dtype=np.float64), rtol=1e-6,
+    )
+
+
+def test_rouge_ddp_merge_equals_single_process():
+    single = M.ROUGEScore()
+    single.update(_CORPUS, _REFS)
+    want = single.compute()
+
+    ranks = [M.ROUGEScore() for _ in range(WORLD)]
+    for rank, (p, t) in enumerate(zip(_shards(_CORPUS), _shards(_REFS))):
+        ranks[rank].update(p, t)
+    got = merge_world(ranks).compute()
+
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_allclose(float(got[k]), float(want[k]), rtol=1e-6)
+
+
+def test_squad_ddp_merge_equals_single_process():
+    preds = [dict(prediction_text=p, id=str(i)) for i, p in enumerate(_CORPUS)]
+    targets = [
+        dict(answers=dict(text=[r], answer_start=[0]), id=str(i)) for i, r in enumerate(_REFS)
+    ]
+    single = M.SQuAD()
+    single.update(preds, targets)
+    want = single.compute()
+
+    ranks = [M.SQuAD() for _ in range(WORLD)]
+    for rank in range(WORLD):
+        ranks[rank].update(preds[rank::WORLD], targets[rank::WORLD])
+    got = merge_world(ranks).compute()
+
+    for k in want:
+        np.testing.assert_allclose(float(got[k]), float(want[k]), rtol=1e-6)
+
+
+def test_bertscore_ddp_merge_equals_single_process():
+    import jax.numpy as jnp
+
+    def fwd(model, batch):
+        ids = batch["input_ids"]
+        # deterministic embedding of the token id (any fixed fn works)
+        base = jnp.arange(8, dtype=jnp.float32)[None, None, :]
+        return jnp.sin(base * (1.0 + jnp.asarray(ids, jnp.float32)[..., None]))
+
+    class Tok:
+        def __call__(self, sentences, **kwargs):
+            ids = np.zeros((len(sentences), 8), dtype=np.int32)
+            mask = np.zeros((len(sentences), 8), dtype=np.int32)
+            for i, s in enumerate(sentences):
+                for j, tok in enumerate(s.split()[:8]):
+                    ids[i, j] = (hash(tok) % 97) + 1
+                    mask[i, j] = 1
+            return {"input_ids": jnp.asarray(ids), "attention_mask": jnp.asarray(mask)}
+
+    def make():
+        return M.BERTScore(model=object(), user_forward_fn=fwd, user_tokenizer=Tok())
+
+    single = make()
+    single.update(_CORPUS, _REFS)
+    want = single.compute()
+
+    ranks = [make() for _ in range(WORLD)]
+    for rank in range(WORLD):
+        ranks[rank].update(_CORPUS[rank::WORLD], _REFS[rank::WORLD])
+    got = merge_world(ranks).compute()
+
+    # scores are per-sentence; ddp striding reorders them — compare as sets
+    for k in ("precision", "recall", "f1"):
+        np.testing.assert_allclose(
+            sorted(np.asarray(got[k], dtype=np.float64)),
+            sorted(np.asarray(want[k], dtype=np.float64)),
+            atol=1e-5,
+        )

@@ -1,0 +1,132 @@
+"""DDP grid for wrappers — child-metric states through the gather path.
+
+Reference parity: reference wrapper tests run under ddp via testers.py:398-439
+(tests/wrappers/test_minmax.py, test_multioutput.py). Wrapper state lives
+partly in the wrapper (MinMax min/max extremes) and partly in child metrics
+(Multioutput per-output clones, ClasswiseWrapper's base, MinMax's base), so
+the merge fold must recurse into children — ``merge_world`` does, via
+``_deep_snapshot`` order.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu as M
+from tests.helpers.testers import merge_world
+
+WORLD = 4
+N = 32
+
+_rng = np.random.default_rng(31)
+_P = _rng.random((N,)).astype(np.float32)
+_T = _rng.random((N,)).astype(np.float32)
+_P2 = _rng.random((N, 3)).astype(np.float32)
+_T2 = _rng.random((N, 3)).astype(np.float32)
+_PROBS = _rng.dirichlet(np.ones(4), size=N).astype(np.float32)
+_LABELS = _rng.integers(0, 4, N)
+
+
+def _shard(a, r):
+    return jnp.asarray(a[r::WORLD])
+
+
+def test_multioutput_ddp_merge_equals_single_process():
+    single = M.MultioutputWrapper(M.MeanSquaredError(), num_outputs=3)
+    single.update(jnp.asarray(_P2), jnp.asarray(_T2))
+    want = single.compute()
+
+    ranks = [M.MultioutputWrapper(M.MeanSquaredError(), num_outputs=3) for _ in range(WORLD)]
+    for r in range(WORLD):
+        ranks[r].update(_shard(_P2, r), _shard(_T2, r))
+    got = merge_world(ranks).compute()
+
+    np.testing.assert_allclose(np.asarray(got, np.float64), np.asarray(want, np.float64), atol=1e-6)
+    # and against the direct per-output oracle
+    oracle = ((_P2 - _T2) ** 2).mean(axis=0)
+    np.testing.assert_allclose(np.asarray(got, np.float64), oracle, atol=1e-5)
+
+
+def test_classwise_ddp_merge_equals_single_process():
+    def make():
+        return M.ClasswiseWrapper(M.Accuracy(num_classes=4, average="none"))
+
+    single = make()
+    single.update(jnp.asarray(_PROBS), jnp.asarray(_LABELS))
+    want = single.compute()
+
+    ranks = [make() for _ in range(WORLD)]
+    for r in range(WORLD):
+        ranks[r].update(_shard(_PROBS, r), _shard(_LABELS, r))
+    got = merge_world(ranks).compute()
+
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_allclose(float(got[k]), float(want[k]), atol=1e-6)
+
+
+def test_minmax_ddp_merge():
+    """Per-rank extremes fold with min/max tags; the child metric's state folds
+    with sum tags — both against hand-computed expectations (a single process
+    observes different intermediate compute() values, so THE invariant is the
+    fold, not sequence equality)."""
+    ranks = [M.MinMaxMetric(M.MeanSquaredError()) for _ in range(WORLD)]
+    rank_extremes = []
+    for r in range(WORLD):
+        p, t = _shard(_P, r), _shard(_T, r)
+        half = p.shape[0] // 2
+        ranks[r](p[:half], t[:half])   # forward: updates base AND min/max
+        ranks[r](p[half:], t[half:])
+        rank_extremes.append((float(ranks[r].min_val), float(ranks[r].max_val)))
+
+    merged = merge_world(ranks)
+    got = merged.compute()
+
+    want_min = min(lo for lo, _ in rank_extremes)
+    want_max = max(hi for _, hi in rank_extremes)
+    np.testing.assert_allclose(float(got["min"]), want_min, atol=1e-6)
+    np.testing.assert_allclose(float(got["max"]), want_max, atol=1e-6)
+    # merged child == all-data MSE
+    np.testing.assert_allclose(float(got["raw"]), ((_P - _T) ** 2).mean(), atol=1e-5)
+
+
+def test_bootstrap_ddp_merge():
+    """Replica states are sum-tagged, so the world fold must equal combining
+    each rank's resampled streams; the expectation is computed directly from
+    the per-rank replica states."""
+    B = 4
+
+    def make():
+        return M.BootStrapper(M.MeanSquaredError(), num_bootstraps=B, seed=7)
+
+    ranks = [make() for _ in range(WORLD)]
+    for r in range(WORLD):
+        ranks[r].update(_shard(_P, r), _shard(_T, r))
+
+    # expected per-replica moments: sum over ranks of each replica's state
+    sums = np.zeros(B)
+    totals = np.zeros(B)
+    for r in ranks:
+        sums += np.asarray(r.sum_squared_error, dtype=np.float64)
+        totals += np.asarray(r.total, dtype=np.float64)
+    expected_means = sums / totals
+
+    got = merge_world(ranks).compute()
+    np.testing.assert_allclose(float(got["mean"]), expected_means.mean(), atol=1e-6)
+    np.testing.assert_allclose(
+        float(got["std"]), expected_means.std(ddof=1), atol=1e-6,
+    )
+
+
+def test_bootstrap_ddp_raw_replicas():
+    """raw=True exposes the per-replica values after the fold."""
+    B = 4
+    ranks = [
+        M.BootStrapper(M.MeanSquaredError(), num_bootstraps=B, seed=11, raw=True)
+        for _ in range(WORLD)
+    ]
+    for r in range(WORLD):
+        ranks[r].update(_shard(_P, r), _shard(_T, r))
+    got = merge_world(ranks).compute()
+    assert np.asarray(got["raw"]).shape == (B,)
+    assert np.isfinite(np.asarray(got["raw"])).all()
