@@ -11,14 +11,17 @@ from typing import Any
 from jax import Array
 
 from metrics_tpu.audio.base import _MeanAudioMetric
+from metrics_tpu.utils.checks import _check_arg_choice
 from metrics_tpu.ops.audio.pesq import _PESQ_AVAILABLE, perceptual_evaluation_speech_quality
 
 
 class PerceptualEvaluationSpeechQuality(_MeanAudioMetric):
     """PESQ. Reference: audio/pesq.py:25.
 
-    Requires the ``pesq`` C-extension package; construction raises an
-    actionable error when it is absent (same gate as the reference).
+    Default backend is the ``pesq`` C-extension package (reference parity);
+    construction raises an actionable error when it is absent — or pass
+    ``implementation='native'`` for the on-device jax perceptual model
+    (jittable; see ops/audio/pesq_native.py for the fidelity contract).
 
     Example:
         >>> import jax
@@ -32,19 +35,28 @@ class PerceptualEvaluationSpeechQuality(_MeanAudioMetric):
     is_differentiable = False
     higher_is_better = True
 
-    def __init__(self, fs: int, mode: str, **kwargs: Any) -> None:
+    def __init__(self, fs: int, mode: str, implementation: str = "pesq", **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        if not _PESQ_AVAILABLE:
+        _check_arg_choice(implementation, "implementation", ("pesq", "native"))
+        self.implementation = implementation
+        if implementation == "pesq" and not _PESQ_AVAILABLE:
             raise ModuleNotFoundError(
                 "PerceptualEvaluationSpeechQuality metric requires that `pesq` is installed."
-                " Either install as `pip install metrics-tpu[audio]` or `pip install pesq`."
+                " Either install as `pip install metrics-tpu[audio]` or `pip install pesq`,"
+                " or construct with implementation='native' for the on-device jax model."
             )
         if fs not in (8000, 16000):
             raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
         self.fs = fs
         if mode not in ("wb", "nb"):
             raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+        if fs == 8000 and mode == "wb":
+            raise ValueError("Expected argument `mode` to be 'nb' for a 8000Hz signal")
         self.mode = mode
 
     def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
-        self._accumulate(perceptual_evaluation_speech_quality(preds, target, self.fs, self.mode))
+        self._accumulate(
+            perceptual_evaluation_speech_quality(
+                preds, target, self.fs, self.mode, implementation=self.implementation
+            )
+        )

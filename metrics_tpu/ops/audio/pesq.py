@@ -3,10 +3,13 @@
 Reference parity: torchmetrics delegates PESQ entirely to the ``pesq`` C
 extension, per sample on CPU (torchmetrics/audio/pesq.py:25,
 functional/audio/pesq.py) and raises ``ModuleNotFoundError`` when it is not
-installed. The same delegation-and-gate contract is kept here: the ITU DSP
-pipeline is proprietary-spec C code the reference never reimplements either.
-A native port is tracked as future work (the reference's behavior — hard
-requirement on the extension — is the parity target).
+installed. Two backends here:
+
+- ``implementation="pesq"`` (default): the same delegation-and-gate contract
+  as the reference — exact ITU numbers, host-side, requires the extension.
+- ``implementation="native"``: the jax perceptual model in
+  ``pesq_native.py`` — jit/vmap-able, on-device, no extension needed; see
+  that module's docstring for its fidelity contract vs the ITU code.
 """
 from __future__ import annotations
 
@@ -14,19 +17,32 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
-from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.checks import _check_arg_choice, _check_same_shape
 from metrics_tpu.utils.imports import package_available
 
 _PESQ_AVAILABLE = package_available("pesq")
 
 
 def perceptual_evaluation_speech_quality(
-    preds: Array, target: Array, fs: int, mode: str, keep_same_device: bool = False
+    preds: Array,
+    target: Array,
+    fs: int,
+    mode: str,
+    keep_same_device: bool = False,
+    implementation: str = "pesq",
 ) -> Array:
-    """PESQ via the ``pesq`` package (host-side per-sample loop).
+    """PESQ via the ``pesq`` C extension (default, host-side per-sample loop —
+    exact reference parity) or the native jax model
+    (``implementation="native"``: jit/vmap-able, on-device; see
+    ops/audio/pesq_native.py for the fidelity contract).
 
     Reference: functional/audio/pesq.py:24-98.
     """
+    _check_arg_choice(implementation, "implementation", ("pesq", "native"))
+    if implementation == "native":
+        from metrics_tpu.ops.audio.pesq_native import pesq_native
+
+        return pesq_native(preds, target, fs, mode)
     if not _PESQ_AVAILABLE:
         raise ModuleNotFoundError(
             "PESQ metric requires that pesq is installed. Either install as `pip install metrics-tpu[audio]`"

@@ -1,0 +1,264 @@
+"""Native jax PESQ (ITU-T P.862 perceptual model) — the tpu path.
+
+Reference parity target: torchmetrics delegates PESQ to the ``pesq`` C
+extension per sample on host (torchmetrics/audio/pesq.py:25,
+functional/audio/pesq.py:24-98) and never reimplements the DSP. This module
+IS the reimplementation: the full P.862 pipeline — level alignment, IRS-style
+receive filtering, envelope time alignment, bark-band power spectrum, Zwicker
+loudness transform, asymmetric disturbance aggregation, MOS mapping
+(P.862.2 logistic for wideband) — expressed as one static-shape XLA program:
+jit/vmap-able, batched over utterances, no host round trips.
+
+Scope and fidelity: the algorithm structure follows the published P.862
+specification; the frequency-warping and threshold tables are derived from
+the standard Zwicker/Terhardt formulas the spec builds on rather than copied
+from the ITU reference tables. Scores track the C extension closely on
+speech-shaped material (differential test, gated on ``pesq`` being
+installed, asserts rank correlation and absolute tolerance) but this is a
+native model, not a bit-exact port — the C extension remains the default
+backend of ``perceptual_evaluation_speech_quality`` and the test oracle.
+
+Design choices for TPU:
+
+- all frame/band shapes static; per-utterance work is one fused program
+- envelope-domain delay search as a single cross-correlation argmax
+  (global alignment; P.862's per-utterance re-segmentation is a host-side
+  refinement the typical parity corpus does not need)
+- Lp norms, masking, and asymmetry run vectorized over (frames, bands)
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_arg_choice, _check_same_shape
+
+# frame layout: 32 ms window, 50% overlap (P.862 §10.2.4)
+_FRAME = {8000: 256, 16000: 512}
+_NBARK = {8000: 42, 16000: 49}
+_TARGET_POWER = 1e7  # P.862 calibrated listening level
+_SLL_DB = 79.0  # dBov-ish anchor used for loudness scaling
+
+
+def _bark_of_hz(f: np.ndarray) -> np.ndarray:
+    """Zwicker & Terhardt critical-band rate."""
+    return 13.0 * np.arctan(0.00076 * f) + 3.5 * np.arctan((f / 7500.0) ** 2)
+
+
+@lru_cache(maxsize=None)
+def _band_matrix(fs: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(bark-binning matrix (B, F), band widths in bark (B,), band centers Hz).
+
+    Bands are uniform in bark over [100 Hz, fs/2], matching P.862's ~0.49-bark
+    spacing (42 bands at 8 kHz, 49 at 16 kHz). numpy constants (host-derived),
+    folded into the XLA program.
+    """
+    n_fft = _FRAME[fs]
+    freqs = np.fft.rfftfreq(n_fft, 1.0 / fs)
+    nb = _NBARK[fs]
+    z = _bark_of_hz(freqs)
+    z_lo, z_hi = _bark_of_hz(np.asarray([100.0]))[0], _bark_of_hz(np.asarray([fs / 2.0]))[0]
+    edges = np.linspace(z_lo, z_hi, nb + 1)
+    mat = np.zeros((nb, len(freqs)), dtype=np.float32)
+    for b in range(nb):
+        sel = (z >= edges[b]) & (z < edges[b + 1])
+        if not sel.any():  # narrow low bands: take the nearest bin
+            sel = np.zeros_like(sel)
+            sel[np.argmin(np.abs(z - 0.5 * (edges[b] + edges[b + 1])))] = True
+        mat[b] = sel / max(sel.sum(), 1)
+    centers_hz = np.asarray(
+        [freqs[mat[b] > 0].mean() for b in range(nb)], dtype=np.float32
+    )
+    widths = np.diff(edges).astype(np.float32)
+    return mat, widths, centers_hz
+
+
+@lru_cache(maxsize=None)
+def _abs_threshold(fs: int) -> np.ndarray:
+    """Absolute hearing threshold power per band (Terhardt approximation)."""
+    _, _, centers = _band_matrix(fs)
+    f_khz = np.maximum(centers, 20.0) / 1000.0
+    thr_db = (
+        3.64 * f_khz ** -0.8
+        - 6.5 * np.exp(-0.6 * (f_khz - 3.3) ** 2)
+        + 1e-3 * f_khz ** 4
+    )
+    return (10.0 ** (thr_db / 10.0)).astype(np.float32)
+
+
+@lru_cache(maxsize=None)
+def _receive_filter(fs: int, mode: str) -> np.ndarray:
+    """Per-rfft-bin magnitude response of the receive characteristic.
+
+    nb: IRS-like telephone band emphasis (300-3100 Hz, rising 20 dB/dec to
+    1 kHz then flat); wb: P.862.2 IRF flat 50-7000 Hz with soft edges.
+    """
+    n_fft = _FRAME[fs]
+    f = np.fft.rfftfreq(n_fft, 1.0 / fs)
+    if mode == "nb":
+        lo, hi = 300.0, 3100.0
+        gain = np.clip((f / 1000.0) ** 1.0, 0.0, 1.0)  # gentle low-band tilt
+    else:
+        lo, hi = 50.0, 7000.0
+        gain = np.ones_like(f)
+    soft = 1.0 / (1.0 + np.exp(-(f - lo) / 25.0)) * (1.0 / (1.0 + np.exp((f - hi) / 150.0)))
+    return (gain * soft).astype(np.float32)
+
+
+def _frames(x: Array, n: int) -> Array:
+    hop = n // 2
+    m = max((x.shape[-1] - n) // hop + 1, 1)
+    idx = jnp.arange(m)[:, None] * hop + jnp.arange(n)[None, :]
+    idx = jnp.minimum(idx, x.shape[-1] - 1)
+    return x[..., idx]
+
+
+def _level_align(x: Array, fs: int, mode: str) -> Array:
+    """Scale to the calibrated power over the receive band (P.862 §10.1.2)."""
+    n = _FRAME[fs]
+    frames = _frames(x, n) * jnp.hanning(n)
+    spec = jnp.abs(jnp.fft.rfft(frames, axis=-1)) ** 2
+    band = jnp.asarray(_receive_filter(fs, mode)) ** 2
+    frame_pow = jnp.sum(spec * band, axis=-1)  # (M,)
+    # active frames: above 1e-4 of the loudest (speech-activity gate)
+    active = frame_pow > 1e-4 * jnp.max(frame_pow)
+    mean_pow = jnp.sum(jnp.where(active, frame_pow, 0.0)) / jnp.maximum(jnp.sum(active), 1)
+    return x * jnp.sqrt(_TARGET_POWER / jnp.maximum(mean_pow, 1e-20))
+
+
+def _envelope(x: Array, fs: int) -> Array:
+    """Per-frame log energy (the alignment domain)."""
+    n = _FRAME[fs]
+    frames = _frames(x, n)
+    return jnp.log(jnp.sum(frames * frames, axis=-1) + 1.0)
+
+
+def _align_delay_frames(ref: Array, deg: Array, fs: int, max_shift: int = 30) -> Array:
+    """Integer frame delay of ``deg`` vs ``ref`` by envelope cross-correlation."""
+    er = _envelope(ref, fs)
+    ed = _envelope(deg, fs)
+    er = er - er.mean()
+    ed = ed - ed.mean()
+    shifts = jnp.arange(-max_shift, max_shift + 1)
+
+    def score(s):
+        rolled = jnp.roll(ed, -s)
+        return jnp.sum(er * rolled)
+
+    scores = jax.vmap(score)(shifts)
+    return shifts[jnp.argmax(scores)]
+
+
+def _bark_power(x: Array, fs: int, mode: str) -> Array:
+    """(M, B) bark-band power spectrogram through the receive filter."""
+    n = _FRAME[fs]
+    frames = _frames(x, n) * jnp.hanning(n)
+    spec = jnp.abs(jnp.fft.rfft(frames, axis=-1)) ** 2
+    spec = spec * jnp.asarray(_receive_filter(fs, mode)) ** 2
+    mat, _, _ = _band_matrix(fs)
+    return spec @ jnp.asarray(mat).T  # (M, B)
+
+
+def _loudness(p: Array, fs: int) -> Array:
+    """Zwicker intensity->loudness per band (P.862 §10.2.8), gamma=0.23."""
+    thr = jnp.asarray(_abs_threshold(fs)) * 1e4  # threshold at calibrated level
+    gamma = 0.23
+    sl = (thr / 0.5) ** gamma
+    ratio = p / jnp.maximum(thr, 1e-20)
+    loud = sl * ((0.5 + 0.5 * ratio) ** gamma - 1.0)
+    return jnp.maximum(loud, 0.0)
+
+
+def _pesq_single(ref: Array, deg: Array, fs: int, mode: str) -> Array:
+    """Raw PESQ MOS for one (ref, deg) pair of equal static length."""
+    ref = ref.astype(jnp.float32)
+    deg = deg.astype(jnp.float32)
+    ref = _level_align(ref, fs, mode)
+    deg = _level_align(deg, fs, mode)
+
+    # global time alignment in the envelope domain (frame resolution), then
+    # the degraded signal is shifted sample-wise
+    hop = _FRAME[fs] // 2
+    delay = _align_delay_frames(ref, deg, fs) * hop
+    deg = jnp.roll(deg, -delay)
+
+    pr = _bark_power(ref, fs, mode)  # (M, B)
+    pd = _bark_power(deg, fs, mode)
+
+    # per-frame partial gain compensation (linear frequency response of the
+    # system under test must not count as distortion, §10.2.6): one scalar
+    # gain per frame bounded to [3e-4, 5]
+    num = jnp.sum(pr * pd, axis=-1)
+    den = jnp.sum(pd * pd, axis=-1)
+    g = jnp.clip(num / jnp.maximum(den, 1e-20), 3e-4, 5.0)
+    pd = pd * g[:, None]
+
+    lr = _loudness(pr, fs)
+    ld = _loudness(pd, fs)
+
+    # disturbance with the dead zone: |d| reduced by 0.25*min(lr, ld)
+    raw = ld - lr
+    dead = 0.25 * jnp.minimum(lr, ld)
+    disturb = jnp.sign(raw) * jnp.maximum(jnp.abs(raw) - dead, 0.0)
+
+    # asymmetry factor: additive (coding) noise hurts more than attenuation
+    asym = ((pd + 50.0) / (pr + 50.0)) ** 1.2
+    asym = jnp.where(asym < 3.0, 0.0, jnp.minimum(asym, 12.0))
+
+    _, widths, _ = _band_matrix(fs)
+    w = jnp.asarray(widths)
+    m = pr.shape[0]
+
+    # frame disturbances: weighted L2 over bands (sym), L1 (asym)
+    d_frame = jnp.sqrt(jnp.sum(w * disturb ** 2, axis=-1) / jnp.sum(w))
+    da_frame = jnp.sum(w * jnp.abs(disturb) * asym, axis=-1) / jnp.sum(w)
+
+    # weight frames by (audible energy)^0.04 and soft-gate silent frames
+    frame_e = jnp.sum(pr, axis=-1)
+    weight = (frame_e / (frame_e.mean() + 1e-20) + 1e-2) ** 0.04
+    d_frame = d_frame * weight
+    da_frame = da_frame * weight
+
+    # split-second aggregation (§10.2.11): L6 inside 20-frame windows, L2 over
+    # windows. pad to a multiple of 20 with edge frames (static shapes).
+    win = 20
+    n_win = -(-m // win)
+    pad = n_win * win - m
+
+    def _chunked(d, p_in, p_out):
+        dp = jnp.pad(d, (0, pad), mode="edge").reshape(n_win, win)
+        inner = (jnp.mean(jnp.abs(dp) ** p_in, axis=-1)) ** (1.0 / p_in)
+        return (jnp.mean(inner ** p_out)) ** (1.0 / p_out)
+
+    d_sym = _chunked(d_frame, 6.0, 2.0)
+    d_asym = _chunked(da_frame, 6.0, 2.0)
+
+    raw_mos = 4.5 - 0.1 * d_sym - 0.0309 * d_asym
+    if mode == "wb":  # P.862.2 output mapping
+        raw_mos = 0.999 + 4.0 / (1.0 + jnp.exp(-1.3669 * raw_mos + 3.8224))
+    return jnp.clip(raw_mos, 1.0, 4.64)
+
+
+def pesq_native(preds: Array, target: Array, fs: int, mode: str) -> Array:
+    """Batched native PESQ: ``[..., time]`` -> ``[...]`` MOS scores.
+
+    jit/vmap-able; the C-extension backend in ``pesq.py`` remains the default
+    and the differential oracle (see module docstring for fidelity scope).
+    """
+    _check_arg_choice(fs, "fs", (8000, 16000))
+    _check_arg_choice(mode, "mode", ("wb", "nb"))
+    if fs == 8000 and mode == "wb":
+        raise ValueError("Expected argument `mode` to be 'nb' for a 8000Hz signal")
+    _check_same_shape(preds, target)
+    single = lambda p, t: _pesq_single(t, p, fs, mode)  # noqa: E731
+    if preds.ndim == 1:
+        return single(preds, target)
+    flat_p = preds.reshape(-1, preds.shape[-1])
+    flat_t = target.reshape(-1, target.shape[-1])
+    out = jax.vmap(single)(flat_p, flat_t)
+    return out.reshape(preds.shape[:-1])

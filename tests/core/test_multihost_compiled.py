@@ -1,0 +1,114 @@
+"""COMPILED cross-process sync: 2 processes x 4 devices, one global mesh.
+
+Complements test_multihost.py (eager ragged gather): this is the DCN story
+SURVEY.md §5.8 promises — the jitted update -> sync_states(psum) ->
+compute_state chain running under shard_map over a GLOBAL mesh that spans
+jax.distributed processes, so the collective crosses process boundaries
+instead of staying inside one PJRT client. Each rank feeds only its local
+shards (jax.make_array_from_process_local_data) and every device must end up
+with the value a single process computes from ALL the data.
+"""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CHILD = textwrap.dedent(
+    """
+    import os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    proc_id, port = int(sys.argv[1]), sys.argv[2]
+    jax.distributed.initialize(f"localhost:{port}", num_processes=2, process_id=proc_id)
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from metrics_tpu import Accuracy, MeanSquaredError
+
+    WORLD = 8  # 2 processes x 4 local devices
+    assert len(jax.devices()) == WORLD, jax.devices()
+    assert len(jax.local_devices()) == 4
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+
+    # deterministic global batch; every rank derives the same full arrays and
+    # contributes only its local quarter through process-local data
+    rng = np.random.default_rng(0)
+    B = 16
+    preds_all = rng.dirichlet(np.ones(4), size=(WORLD, B)).astype(np.float32)
+    labels_all = rng.integers(0, 4, size=(WORLD, B)).astype(np.int32)
+
+    sharding = NamedSharding(mesh, P("data"))
+    lo, hi = proc_id * 4, (proc_id + 1) * 4
+    preds = jax.make_array_from_process_local_data(sharding, preds_all[lo:hi], preds_all.shape)
+    labels = jax.make_array_from_process_local_data(sharding, labels_all[lo:hi], labels_all.shape)
+
+    acc = Accuracy(num_classes=4)
+    mse = MeanSquaredError()
+
+    def program(p, t):
+        st = acc.update_state(acc.get_state(), p.reshape(-1, 4), t.reshape(-1))
+        st = acc.sync_states(st, "data")  # psum over BOTH processes
+        st2 = mse.update_state(mse.get_state(), p[..., 0].reshape(-1), t.reshape(-1).astype(jnp.float32) / 4)
+        st2 = mse.sync_states(st2, "data")
+        out = jnp.stack([acc.compute_state(st), mse.compute_state(st2)])
+        return jnp.expand_dims(out, 0)
+
+    fn = jax.jit(jax.shard_map(
+        program, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P("data"), check_vma=False,
+    ))
+    out = fn(preds, labels)
+
+    # oracle from ALL data, computed locally on this process
+    want_acc = (preds_all.reshape(-1, 4).argmax(-1) == labels_all.reshape(-1)).mean()
+    want_mse = ((preds_all[..., 0].reshape(-1) - labels_all.reshape(-1) / 4.0) ** 2).mean()
+
+    # each process checks its LOCAL rows of the global output
+    local_rows = np.stack([np.asarray(s.data).reshape(2) for s in out.addressable_shards])
+    np.testing.assert_allclose(local_rows[:, 0], want_acc, atol=1e-6)
+    np.testing.assert_allclose(local_rows[:, 1], want_mse, atol=1e-5)
+    print("COMPILED_SYNC_OK", proc_id)
+    """
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_compiled_sync_spans_processes(tmp_path):
+    child = tmp_path / "compiled_sync_child.py"
+    child.write_text(_CHILD)
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = ""  # the child sets its own 4-device flag
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(child), str(rank), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=REPO,
+        )
+        for rank in range(2)
+    ]
+    try:
+        outs = [p.communicate(timeout=300)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+        assert f"COMPILED_SYNC_OK {rank}" in out
