@@ -102,7 +102,7 @@ class BinnedPrecisionRecallCurve(Metric):
 
         # hot op: on TPU a pallas kernel streams (N, C) tiles once and sweeps
         # thresholds in VMEM (ops/classification/binned_pallas.py); elsewhere
-        # the XLA broadcast compare over (N, C, T)
+        # the bucketize+histogram XLA path (O(N*C + C*T))
         tp, fp, fn = binned_stat_counts(preds, target, self.thresholds)
         self.TPs = self.TPs + tp
         self.FPs = self.FPs + fp

@@ -42,7 +42,6 @@ from metrics_tpu.utils.checks import _check_arg_choice, _check_same_shape
 _FRAME = {8000: 256, 16000: 512}
 _NBARK = {8000: 42, 16000: 49}
 _TARGET_POWER = 1e7  # P.862 calibrated listening level
-_SLL_DB = 79.0  # dBov-ish anchor used for loudness scaling
 
 
 def _bark_of_hz(f: np.ndarray) -> np.ndarray:
@@ -118,48 +117,56 @@ def _frames(x: Array, n: int) -> Array:
     return x[..., idx]
 
 
-def _level_align(x: Array, fs: int, mode: str) -> Array:
-    """Scale to the calibrated power over the receive band (P.862 §10.1.2)."""
+def _filtered_spec(x: Array, fs: int, mode: str) -> Array:
+    """(M, F) windowed power spectrogram through the receive filter.
+
+    Computed ONCE per signal and reused by level alignment (scalar gain on
+    the power), time alignment (per-frame energies), and the bark binning —
+    the per-utterance pipeline runs a single FFT pass.
+    """
     n = _FRAME[fs]
     frames = _frames(x, n) * jnp.hanning(n)
     spec = jnp.abs(jnp.fft.rfft(frames, axis=-1)) ** 2
-    band = jnp.asarray(_receive_filter(fs, mode)) ** 2
-    frame_pow = jnp.sum(spec * band, axis=-1)  # (M,)
-    # active frames: above 1e-4 of the loudest (speech-activity gate)
+    return spec * jnp.asarray(_receive_filter(fs, mode)) ** 2
+
+
+def _level_gain_pow(spec: Array) -> Array:
+    """Scalar POWER gain to the calibrated level (P.862 §10.1.2) from the
+    filtered spectrogram; active frames = above 1e-4 of the loudest."""
+    frame_pow = jnp.sum(spec, axis=-1)  # (M,)
     active = frame_pow > 1e-4 * jnp.max(frame_pow)
     mean_pow = jnp.sum(jnp.where(active, frame_pow, 0.0)) / jnp.maximum(jnp.sum(active), 1)
-    return x * jnp.sqrt(_TARGET_POWER / jnp.maximum(mean_pow, 1e-20))
+    return _TARGET_POWER / jnp.maximum(mean_pow, 1e-20)
 
 
-def _envelope(x: Array, fs: int) -> Array:
-    """Per-frame log energy (the alignment domain)."""
-    n = _FRAME[fs]
-    frames = _frames(x, n)
-    return jnp.log(jnp.sum(frames * frames, axis=-1) + 1.0)
+def _align_delay_frames(spec_r: Array, spec_d: Array, max_shift: int = 30) -> Array:
+    """Integer FRAME delay of deg vs ref by log-energy cross-correlation.
 
-
-def _align_delay_frames(ref: Array, deg: Array, fs: int, max_shift: int = 30) -> Array:
-    """Integer frame delay of ``deg`` vs ``ref`` by envelope cross-correlation."""
-    er = _envelope(ref, fs)
-    ed = _envelope(deg, fs)
+    Level gains are per-signal scalars, so they shift the log envelope by a
+    constant — the mean-subtracted correlation is invariant to them.
+    """
+    er = jnp.log(jnp.sum(spec_r, axis=-1) + 1.0)
+    ed = jnp.log(jnp.sum(spec_d, axis=-1) + 1.0)
     er = er - er.mean()
     ed = ed - ed.mean()
     shifts = jnp.arange(-max_shift, max_shift + 1)
 
     def score(s):
-        rolled = jnp.roll(ed, -s)
-        return jnp.sum(er * rolled)
+        return jnp.sum(er * jnp.roll(ed, -s))
 
     scores = jax.vmap(score)(shifts)
-    return shifts[jnp.argmax(scores)]
+    # normalized peak coefficient: under heavy noise the envelope correlation
+    # is weak everywhere and its argmax is arbitrary — a genuine delay shows
+    # a prominent peak. Gate weak peaks to zero delay.
+    coef = jnp.max(scores) / jnp.maximum(
+        jnp.linalg.norm(er) * jnp.linalg.norm(ed), 1e-20
+    )
+    best = shifts[jnp.argmax(scores)]
+    return jnp.where(coef > 0.5, best, 0)
 
 
-def _bark_power(x: Array, fs: int, mode: str) -> Array:
-    """(M, B) bark-band power spectrogram through the receive filter."""
-    n = _FRAME[fs]
-    frames = _frames(x, n) * jnp.hanning(n)
-    spec = jnp.abs(jnp.fft.rfft(frames, axis=-1)) ** 2
-    spec = spec * jnp.asarray(_receive_filter(fs, mode)) ** 2
+def _bark_power(spec: Array, fs: int) -> Array:
+    """(M, B) bark-band power from the filtered spectrogram."""
     mat, _, _ = _band_matrix(fs)
     return spec @ jnp.asarray(mat).T  # (M, B)
 
@@ -178,17 +185,17 @@ def _pesq_single(ref: Array, deg: Array, fs: int, mode: str) -> Array:
     """Raw PESQ MOS for one (ref, deg) pair of equal static length."""
     ref = ref.astype(jnp.float32)
     deg = deg.astype(jnp.float32)
-    ref = _level_align(ref, fs, mode)
-    deg = _level_align(deg, fs, mode)
+    # one FFT pass per signal; level alignment is a scalar power factor and
+    # frame-resolution time alignment is a roll of the frame axis
+    spec_r = _filtered_spec(ref, fs, mode)  # (M, F)
+    spec_d = _filtered_spec(deg, fs, mode)
+    spec_r = spec_r * _level_gain_pow(spec_r)
+    spec_d = spec_d * _level_gain_pow(spec_d)
+    delay = _align_delay_frames(spec_r, spec_d)
+    spec_d = jnp.roll(spec_d, -delay, axis=0)
 
-    # global time alignment in the envelope domain (frame resolution), then
-    # the degraded signal is shifted sample-wise
-    hop = _FRAME[fs] // 2
-    delay = _align_delay_frames(ref, deg, fs) * hop
-    deg = jnp.roll(deg, -delay)
-
-    pr = _bark_power(ref, fs, mode)  # (M, B)
-    pd = _bark_power(deg, fs, mode)
+    pr = _bark_power(spec_r, fs)  # (M, B)
+    pd = _bark_power(spec_d, fs)
 
     # per-frame partial gain compensation (linear frequency response of the
     # system under test must not count as distortion, §10.2.6): one scalar

@@ -1,0 +1,55 @@
+"""bf16 precision grid for the audio family.
+
+Reference analog: the fp16 test grid in tests/helpers/testers.py:478-534 run
+by every reference audio test. On TPU the half precision that matters is
+bfloat16; each functional must stay finite and track its f32 value within a
+band that reflects bf16's 8-bit mantissa across batch layouts.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import ops
+from metrics_tpu.ops.audio.pesq_native import pesq_native
+
+_rng = np.random.default_rng(77)
+
+_T1 = _rng.normal(size=(2000,)).astype(np.float32)
+_P1 = _T1 + 0.3 * _rng.normal(size=(2000,)).astype(np.float32)
+_T2 = _rng.normal(size=(4, 2000)).astype(np.float32)
+_P2 = _T2 + 0.3 * _rng.normal(size=(4, 2000)).astype(np.float32)
+_MIX_T = _rng.normal(size=(2, 3, 1500)).astype(np.float32)
+_MIX_P = _MIX_T[:, ::-1] + 0.2 * _rng.normal(size=(2, 3, 1500)).astype(np.float32)
+_LONG_T = _rng.normal(size=(8000,)).astype(np.float32)
+_LONG_P = _LONG_T + 0.2 * _rng.normal(size=(8000,)).astype(np.float32)
+
+_CASES = [
+    ("snr-1d", lambda p, t: ops.signal_noise_ratio(p, t), _P1, _T1, 0.5),
+    ("snr-2d", lambda p, t: ops.signal_noise_ratio(p, t), _P2, _T2, 0.5),
+    ("si_snr", lambda p, t: ops.scale_invariant_signal_noise_ratio(p, t), _P2, _T2, 0.5),
+    ("si_sdr", lambda p, t: ops.scale_invariant_signal_distortion_ratio(p, t), _P2, _T2, 0.5),
+    ("si_sdr-zero_mean", lambda p, t: ops.scale_invariant_signal_distortion_ratio(p, t, zero_mean=True), _P2, _T2, 0.5),
+    ("sdr", lambda p, t: ops.signal_distortion_ratio(p, t), _P2, _T2, 1.5),
+    ("pit", lambda p, t: ops.permutation_invariant_training(p, t, ops.scale_invariant_signal_noise_ratio)[0], _MIX_P, _MIX_T, 0.5),
+    ("stoi", lambda p, t: ops.short_time_objective_intelligibility(p, t, 10000), _LONG_P, _LONG_T, 0.05),
+    ("pesq-native", lambda p, t: pesq_native(p, t, 8000, "nb"), _LONG_P, _LONG_T, 0.15),
+]
+
+
+@pytest.mark.parametrize("name,fn,p,t,tol", _CASES, ids=[c[0] for c in _CASES])
+def test_bf16_tracks_f32(name, fn, p, t, tol):
+    f32 = np.asarray(fn(jnp.asarray(p), jnp.asarray(t)), dtype=np.float64)
+    bf16 = np.asarray(
+        jnp.asarray(fn(jnp.asarray(p, jnp.bfloat16), jnp.asarray(t, jnp.bfloat16)), jnp.float32),
+        dtype=np.float64,
+    )
+    assert np.isfinite(bf16).all(), f"{name}: non-finite under bf16"
+    np.testing.assert_allclose(bf16, f32, atol=tol, rtol=0.05, err_msg=name)
+
+
+@pytest.mark.parametrize("name,fn,p,t,tol", _CASES[:6], ids=[c[0] for c in _CASES[:6]])
+def test_bf16_preds_f32_target_mixed(name, fn, p, t, tol):
+    """Mixed precision (bf16 model output vs f32 reference) must also work."""
+    out = fn(jnp.asarray(p, jnp.bfloat16), jnp.asarray(t))
+    assert bool(jnp.isfinite(jnp.asarray(out, jnp.float32)).all()), name

@@ -10,11 +10,49 @@ import pytest
 
 from metrics_tpu.ops.classification.binned_pallas import (
     _BLOCK_N,
+    _binned_counts_broadcast,
     _binned_counts_xla,
     binned_stat_counts,
 )
 
 _rng = np.random.default_rng(41)
+
+
+@pytest.mark.parametrize(
+    "n,c,t",
+    [(64, 3, 11), (300, 1, 100), (513, 5, 50), (7, 2, 1)],
+)
+def test_bucketized_matches_broadcast(n, c, t):
+    """The O(N*C + C*T) bucketize path == the naive broadcast, exactly."""
+    preds = jnp.asarray(_rng.uniform(size=(n, c)).astype(np.float32))
+    target = jnp.asarray(_rng.integers(0, 2, size=(n, c)).astype(bool))
+    thresholds = jnp.linspace(0.0, 1.0, t)
+    got = _binned_counts_xla(preds, target, thresholds)
+    want = _binned_counts_broadcast(preds, target, thresholds)
+    for g, w, name in zip(got, want, ("TP", "FP", "FN")):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=name)
+
+
+def test_bucketized_nan_preds_match_broadcast():
+    """NaN scores are predicted-negative at every threshold on all paths."""
+    preds = jnp.asarray([[jnp.nan], [0.7], [0.2]], dtype=jnp.float32)
+    target = jnp.asarray([[1], [1], [0]]).astype(bool)
+    thresholds = jnp.linspace(0.0, 1.0, 5)
+    got = _binned_counts_xla(preds, target, thresholds)
+    want = _binned_counts_broadcast(preds, target, thresholds)
+    for g, w, name in zip(got, want, ("TP", "FP", "FN")):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=name)
+
+
+def test_bucketized_unsorted_and_tied_thresholds():
+    """User threshold grids need not be sorted; scores may sit ON thresholds."""
+    preds = jnp.asarray([[0.0], [0.5], [0.5], [1.0], [0.25]], dtype=jnp.float32)
+    target = jnp.asarray([[1], [1], [0], [1], [0]]).astype(bool)
+    thresholds = jnp.asarray([0.5, 0.0, 1.0, 0.5, 0.25])  # unsorted + duplicate 0.5
+    got = _binned_counts_xla(preds, target, thresholds)
+    want = _binned_counts_broadcast(preds, target, thresholds)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
 
 
 @pytest.mark.parametrize(

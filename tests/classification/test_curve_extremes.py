@@ -1,0 +1,178 @@
+"""Curve metrics at class-count extremes: 1, 2, and 1000 classes.
+
+Reference analog: the reference's curve tests sweep NUM_CLASSES=5 fixtures
+(tests/classification/test_precision_recall_curve.py etc.); the extremes are
+where shape handling breaks — a single class (degenerate one-hot), binary as
+2-class-multiclass, and a 1000-class spread with few samples per class (most
+classes unseen). Differential against sklearn throughout.
+"""
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score, roc_curve as sk_roc
+
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    AUROC,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    PrecisionRecallCurve,
+    ROC,
+)
+
+_rng = np.random.default_rng(8)
+
+
+def _ref_pr_curve(target, probs):
+    """Numpy oracle with the REFERENCE's curve semantics
+    (functional/classification/precision_recall_curve.py:123-155): distinct
+    descending thresholds, truncation at the FIRST index attaining full
+    recall, then reversal and a final (precision=1, recall=0) point. sklearn
+    >= 1.3 changed its boundary handling, so it cannot oracle the curve shape
+    directly (it still oracles scalar AP/AUROC values).
+    """
+    order = np.argsort(-probs, kind="stable")
+    probs_s, target_s = probs[order], target[order]
+    distinct = np.nonzero(np.diff(probs_s))[0]
+    idxs = np.r_[distinct, target_s.size - 1]
+    tps = np.cumsum(target_s)[idxs].astype(np.float64)
+    fps = 1 + idxs - tps
+    precision = tps / (tps + fps)
+    recall = tps / tps[-1]
+    last = int(np.flatnonzero(tps == tps[-1])[0])
+    sl = slice(0, last + 1)
+    return (
+        np.r_[precision[sl][::-1], 1.0],
+        np.r_[recall[sl][::-1], 0.0],
+        probs_s[idxs][sl][::-1],
+    )
+
+
+# --------------------------------------------------------------------------- #
+# num_classes = 1: degenerate single-class problem
+# --------------------------------------------------------------------------- #
+def test_curves_single_class():
+    probs = _rng.random((32, 1)).astype(np.float32)
+    target = _rng.integers(0, 2, 32)  # hit/miss of THE class
+
+    prc = PrecisionRecallCurve(num_classes=1)
+    prc.update(jnp.asarray(probs), jnp.asarray(target))
+    precision, recall, thresholds = prc.compute()
+    p, r = np.asarray(precision, np.float64), np.asarray(recall, np.float64)
+    want_p, want_r, want_th = _ref_pr_curve(target, probs[:, 0])
+    np.testing.assert_allclose(p, want_p, atol=1e-6)
+    np.testing.assert_allclose(r, want_r, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(thresholds, np.float64), want_th, atol=1e-6)
+
+    roc = ROC(num_classes=1)
+    roc.update(jnp.asarray(probs), jnp.asarray(target))
+    fpr, tpr, _ = roc.compute()
+    # num_classes=1 returns per-class lists of length 1. The one-vs-rest
+    # loop scores class 0 as the positive class (pos_label=cls, the
+    # reference's convention in _roc_compute), so sklearn's positives are
+    # target==0; drop_intermediate would collapse collinear points.
+    sk_fpr, sk_tpr, _ = sk_roc(target == 0, probs[:, 0], drop_intermediate=False)
+    np.testing.assert_allclose(np.asarray(fpr[0], np.float64), sk_fpr, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(tpr[0], np.float64), sk_tpr, atol=1e-6)
+
+    ap = AveragePrecision(num_classes=1)
+    ap.update(jnp.asarray(probs), jnp.asarray(target))
+    np.testing.assert_allclose(float(ap.compute()), average_precision_score(target, probs[:, 0]), atol=1e-6)
+
+
+# --------------------------------------------------------------------------- #
+# num_classes = 2: binary-as-multiclass consistency
+# --------------------------------------------------------------------------- #
+def test_curves_two_class_consistency():
+    logits = _rng.normal(size=(64, 2)).astype(np.float32)
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    target = _rng.integers(0, 2, 64)
+
+    prc = PrecisionRecallCurve(num_classes=2)
+    prc.update(jnp.asarray(probs), jnp.asarray(target))
+    precision, recall, _ = prc.compute()
+    assert len(precision) == 2
+    # class-1 curve == the reference-semantics oracle on p(class 1)
+    want_p, want_r, _ = _ref_pr_curve((target == 1).astype(int), probs[:, 1])
+    np.testing.assert_allclose(np.asarray(precision[1], np.float64), want_p, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(recall[1], np.float64), want_r, atol=1e-6)
+
+    auroc = AUROC(num_classes=2)
+    auroc.update(jnp.asarray(probs), jnp.asarray(target))
+    from sklearn.metrics import roc_auc_score
+    want = (roc_auc_score(target == 0, probs[:, 0]) + roc_auc_score(target == 1, probs[:, 1])) / 2
+    np.testing.assert_allclose(float(auroc.compute()), want, atol=1e-6)
+
+
+# --------------------------------------------------------------------------- #
+# num_classes = 1000: most classes unseen
+# --------------------------------------------------------------------------- #
+def test_curves_thousand_classes_sparse():
+    C, N = 1000, 128  # most classes have no positives
+    probs = _rng.dirichlet(np.ones(C) * 0.05, size=N).astype(np.float32)
+    target = _rng.integers(0, C, N)
+
+    prc = PrecisionRecallCurve(num_classes=C)
+    prc.update(jnp.asarray(probs), jnp.asarray(target))
+    precision, recall, thresholds = prc.compute()
+    assert len(precision) == C == len(recall) == len(thresholds)
+    seen = set(np.unique(target).tolist())
+    for c in list(seen)[:5]:
+        p = np.asarray(precision[c], np.float64)
+        r = np.asarray(recall[c], np.float64)
+        want_p, want_r, _ = _ref_pr_curve((target == c).astype(int), probs[:, c])
+        np.testing.assert_allclose(p, want_p, atol=1e-6, err_msg=f"class {c}")
+        np.testing.assert_allclose(r, want_r, atol=1e-6, err_msg=f"class {c}")
+    for c in [c for c in range(C) if c not in seen][:5]:
+        # classes with no positives: curve must exist, stay in [0, 1], and
+        # end at the appended (precision=1, recall=0) anchor
+        p = np.asarray(precision[c], np.float64)
+        assert np.isfinite(p).all() and (0 <= p).all() and (p <= 1).all()
+        assert p[-1] == 1.0
+
+    ap = AveragePrecision(num_classes=C, average="macro")
+    ap.update(jnp.asarray(probs), jnp.asarray(target))
+    got = float(ap.compute())
+    assert 0.0 <= got <= 1.0 and np.isfinite(got)
+
+
+def test_binned_curves_thousand_classes():
+    C, N, TH = 1000, 128, 21
+    probs = _rng.dirichlet(np.ones(C) * 0.05, size=N).astype(np.float32)
+    target = _rng.integers(0, C, N)
+
+    b = BinnedPrecisionRecallCurve(num_classes=C, thresholds=TH)
+    b.update(jnp.asarray(probs), jnp.asarray(target))
+    precision, recall, thresholds = b.compute()
+    assert np.asarray(precision).shape == (C, TH + 1)
+    assert np.asarray(recall).shape == (C, TH + 1)
+    assert np.isfinite(np.asarray(precision)).all()
+    # recall monotone non-increasing along thresholds for every class
+    r = np.asarray(recall, np.float64)
+    assert (np.diff(r[:, :-1], axis=1) <= 1e-7).all()
+
+    bap = BinnedAveragePrecision(num_classes=C, thresholds=TH)
+    bap.update(jnp.asarray(probs), jnp.asarray(target))
+    vals = np.asarray(bap.compute(), np.float64)
+    assert vals.shape == (C,)
+    assert ((0.0 <= vals) & (vals <= 1.0)).all()
+
+
+def test_binned_single_class_matches_exact_ap_ordering():
+    """Binned AP at fine thresholds approaches the exact AP (1 class)."""
+    # 1-d inputs: the single-class binned contract treats preds as the
+    # positive-class probability ((N, 1) preds would one-hot the binary
+    # target against a single class, losing the positives — same as the
+    # reference's to_onehot path)
+    probs = _rng.random(256).astype(np.float32)
+    target = (probs + 0.3 * _rng.normal(size=256) > 0.5).astype(int)
+
+    exact = AveragePrecision()
+    exact.update(jnp.asarray(probs), jnp.asarray(target))
+    want = float(exact.compute())
+
+    binned = BinnedAveragePrecision(num_classes=1, thresholds=501)
+    binned.update(jnp.asarray(probs), jnp.asarray(target))
+    got = float(jnp.ravel(jnp.asarray(binned.compute()))[0])
+    assert abs(got - want) < 0.02, (got, want)

@@ -1,0 +1,28 @@
+"""Shared FID-numerics fixture: inception-like features + the f64 oracle.
+
+Single source of truth for both tests/image/test_fid_numerics.py and
+bench.py's ``fid_numerics_2048`` entry, which claim to measure the SAME
+differential (f32 on-device FID vs scipy f64) — duplicated constants would
+let the two drift apart silently.
+"""
+from __future__ import annotations
+
+import numpy as np
+import scipy.linalg
+
+
+def inception_like(rng: np.random.Generator, n: int, d: int, shift: float = 0.0, rank: int = 64) -> np.ndarray:
+    """Correlated nonneg activations with means dominating spread (post-ReLU
+    statistics) — with n < d the covariance is singular by construction, the
+    worst realistic FID conditioning."""
+    base = rng.normal(size=(n, rank)) @ rng.normal(size=(rank, d)) * 0.05
+    return np.maximum(base + rng.normal(size=(n, d)) * 0.02 + 0.5 + shift, 0.0).astype(np.float64)
+
+
+def oracle_fid(fr: np.ndarray, ff: np.ndarray) -> float:
+    """Reference pipeline: f64 moments + scipy sqrtm (reference fid.py:98-117)."""
+    mu1, mu2 = fr.mean(0), ff.mean(0)
+    s1 = np.cov(fr, rowvar=False)
+    s2 = np.cov(ff, rowvar=False)
+    covmean = scipy.linalg.sqrtm(s1 @ s2)
+    return float((mu1 - mu2) @ (mu1 - mu2) + np.trace(s1) + np.trace(s2) - 2 * np.trace(covmean.real))

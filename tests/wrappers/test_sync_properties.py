@@ -1,0 +1,92 @@
+"""Wrapper behavior under the sync/unsync state machine — property tests.
+
+Reference analog: tests/bases/test_ddp.py:135-241 (synced-save /
+unsync-restore). The wrappers are the risky case because their state spans
+the wrapper AND child metrics; sync must capture both, unsync must restore
+both, and compute-under-sync must see the merged world.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import metrics_tpu as M
+from metrics_tpu.utils.exceptions import MetricsUserError
+from tests.helpers.testers import merge_world
+
+_rng = np.random.default_rng(13)
+_P = jnp.asarray(_rng.random(24).astype(np.float32))
+_T = jnp.asarray(_rng.random(24).astype(np.float32))
+_P2 = jnp.asarray(_rng.random((24, 2)).astype(np.float32))
+_T2 = jnp.asarray(_rng.random((24, 2)).astype(np.float32))
+
+
+@pytest.mark.parametrize(
+    "make,args",
+    [
+        (lambda: M.MinMaxMetric(M.MeanSquaredError()), (_P, _T)),
+        (lambda: M.MultioutputWrapper(M.MeanSquaredError(), num_outputs=2), (_P2, _T2)),
+        (lambda: M.ClasswiseWrapper(M.Accuracy(num_classes=3, average="none")),
+         (jnp.asarray(_rng.dirichlet(np.ones(3), 24).astype(np.float32)), jnp.asarray(_rng.integers(0, 3, 24)))),
+        (lambda: M.BootStrapper(M.MeanSquaredError(), num_bootstraps=3, seed=3), (_P, _T)),
+    ],
+    ids=["minmax", "multioutput", "classwise", "bootstrap"],
+)
+class TestWrapperSyncStateMachine:
+    def test_unsync_restores_deep_state(self, make, args):
+        """sync (via a world merge) then unsync returns EVERY node — wrapper
+        and children — to its pre-sync state."""
+        m = make()
+        if isinstance(m, M.MinMaxMetric):
+            m(*args)  # forward also advances min/max
+        else:
+            m.update(*args)
+        before = [(type(n).__name__, jnp.asarray(jnp.concatenate([jnp.ravel(jnp.asarray(v)) for v in st.values()]))
+                   if st else None)
+                  for (n, st, _) in m._deep_snapshot()]
+
+        other = make()
+        other.update(*args)
+
+        # emulate the gather by merging the other rank in, then rolling back
+        snap = m._deep_snapshot()
+        merge_world([m, other])
+        M.Metric._deep_restore(snap)
+
+        after = [(type(n).__name__, jnp.asarray(jnp.concatenate([jnp.ravel(jnp.asarray(v)) for v in st.values()]))
+                  if st else None)
+                 for (n, st, _) in m._deep_snapshot()]
+        for (name_b, flat_b), (name_a, flat_a) in zip(before, after):
+            assert name_b == name_a
+            if flat_b is not None:
+                np.testing.assert_allclose(np.asarray(flat_a), np.asarray(flat_b), atol=1e-7)
+
+    def test_double_unsync_guard(self, make, args):
+        m = make()
+        m.update(*args)
+        with pytest.raises(MetricsUserError):
+            m.unsync()
+
+    def test_merge_is_idempotent_with_empty_rank(self, make, args):
+        """Folding in a rank that saw no data must not change the value."""
+        m1 = make()
+        if isinstance(m1, M.MinMaxMetric):
+            m1(*args)
+        else:
+            m1.update(*args)
+        want = m1.compute()
+
+        m2 = make()
+        if isinstance(m2, M.MinMaxMetric):
+            m2(*args)
+        else:
+            m2.update(*args)
+        empty = make()
+        got = merge_world([m2, empty]).compute()
+
+        flat_w = np.concatenate([np.ravel(np.asarray(v, np.float64)) for v in jax.tree_util.tree_leaves(want)]) \
+            if not isinstance(want, dict) else np.concatenate([np.ravel(np.asarray(want[k], np.float64)) for k in sorted(want)])
+        flat_g = np.concatenate([np.ravel(np.asarray(v, np.float64)) for v in jax.tree_util.tree_leaves(got)]) \
+            if not isinstance(got, dict) else np.concatenate([np.ravel(np.asarray(got[k], np.float64)) for k in sorted(got)])
+        np.testing.assert_allclose(flat_g, flat_w, atol=1e-6)
