@@ -29,7 +29,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.core.metric import Metric
-from metrics_tpu.ops.detection.boxes import box_area, box_convert, box_iou, mask_area, mask_iou
+from metrics_tpu.ops.detection.boxes import box_iou, mask_area, mask_iou
 from metrics_tpu.ops.detection.matching import match_image
 from metrics_tpu.ops.detection.rle import is_rle, masks_from_rle_list
 from metrics_tpu.parallel import sync as _sync
@@ -40,15 +40,6 @@ _BBOX_AREA_RANGES = {
     "medium": (32.0 ** 2, 96.0 ** 2),
     "large": (96.0 ** 2, 1e10),
 }
-
-
-def _fix_empty_tensors(boxes: Array) -> Array:
-    """Empty tensors get a (0, 4) shape so downstream ops don't crash
-    (reference mean_ap.py:191-196)."""
-    boxes = jnp.asarray(boxes)
-    if boxes.size == 0 and boxes.ndim == 1:
-        return boxes.reshape(0, 4)
-    return boxes
 
 
 def _input_validator(preds: Sequence[Dict], targets: Sequence[Dict], iou_type: str = "bbox") -> None:
@@ -92,12 +83,34 @@ def _bbox_eval_kernel(pd: int, pg: int):
     return kernel
 
 
+@functools.lru_cache(maxsize=None)
+def _bbox_eval_kernel_batched(pd: int, pg: int):
+    """vmap of the bucket kernel over a batch of images: ALL images sharing a
+    (det, gt) bucket are evaluated in ONE device dispatch instead of one per
+    image — the epoch-end loop becomes O(#buckets) dispatches."""
+    single = _bbox_eval_kernel(pd, pg).__wrapped__  # unjitted body
+
+    return jax.jit(jax.vmap(single, in_axes=(0, 0, 0, 0, 0, 0, 0, None)))
+
+
 def _next_bucket(n: int, minimum: int = 8) -> int:
     """Pad sizes to power-of-2 buckets to bound jit recompilation."""
     size = minimum
     while size < n:
         size *= 2
     return size
+
+
+class _PendingKernel:
+    """Placeholder for a deferred bbox-matcher call: per-image host prep is
+    done, the device work joins a per-bucket vmapped batch."""
+
+    __slots__ = ("pd", "pg", "inputs")
+
+    def __init__(self, pd: int, pg: int, inputs: tuple) -> None:
+        self.pd = pd
+        self.pg = pg
+        self.inputs = inputs
 
 
 class MeanAveragePrecision(Metric):
@@ -175,8 +188,21 @@ class MeanAveragePrecision(Metric):
     # ------------------------------------------------------------------ #
     def _get_safe_item_values(self, item: Dict) -> Array:
         if self.iou_type == "bbox":
-            boxes = _fix_empty_tensors(jnp.asarray(item["boxes"], dtype=jnp.float32))
-            return box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+            # HOST numpy, not device arrays: this metric is eager-only (list
+            # states) and the epoch-end prep is host-side slicing/sorting —
+            # per-image device round-trips were the compute() hot spot. Only
+            # the padded per-bucket batches ever reach the device. (numpy twin
+            # of ops/detection/boxes.py box_convert, which stays device-side.)
+            boxes = np.asarray(item["boxes"], dtype=np.float32).reshape(-1, 4)
+            if self.box_format == "xywh":
+                x, y, w, h = np.split(boxes, 4, axis=-1)
+                boxes = np.concatenate([x, y, x + w, y + h], axis=-1)
+            elif self.box_format == "cxcywh":
+                cx, cy, w, h = np.split(boxes, 4, axis=-1)
+                boxes = np.concatenate(
+                    [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1
+                )
+            return boxes
         # segm: dense binary masks [N, H, W] on device. pycocotools-style RLE
         # input (reference mean_ap.py:127-142) is a CPU byte-string format —
         # decoded on host (ops/detection/rle.py), evaluated on device.
@@ -193,11 +219,11 @@ class MeanAveragePrecision(Metric):
         _input_validator(preds, target, iou_type=self.iou_type)
         for item in preds:
             self.detections.append(self._get_safe_item_values(item))
-            self.detection_labels.append(jnp.asarray(item["labels"], dtype=jnp.int32).reshape(-1))
-            self.detection_scores.append(jnp.asarray(item["scores"], dtype=jnp.float32).reshape(-1))
+            self.detection_labels.append(np.asarray(item["labels"], dtype=np.int32).reshape(-1))
+            self.detection_scores.append(np.asarray(item["scores"], dtype=np.float32).reshape(-1))
         for item in target:
             self.groundtruths.append(self._get_safe_item_values(item))
-            self.groundtruth_labels.append(jnp.asarray(item["labels"], dtype=jnp.int32).reshape(-1))
+            self.groundtruth_labels.append(np.asarray(item["labels"], dtype=np.int32).reshape(-1))
 
     def _get_classes(self) -> List[int]:
         if len(self.detection_labels) > 0 or len(self.groundtruth_labels) > 0:
@@ -231,8 +257,10 @@ class MeanAveragePrecision(Metric):
         det_labels_sorted = det_labels[order]
 
         if self.iou_type == "bbox":
-            det_areas = np.asarray(box_area(det)) if n_det else np.zeros(0)
-            gt_areas = np.asarray(box_area(gt)) if n_gt else np.zeros(0)
+            det = np.asarray(det).reshape(-1, 4)
+            gt = np.asarray(gt).reshape(-1, 4)
+            det_areas = (det[:, 2] - det[:, 0]) * (det[:, 3] - det[:, 1])
+            gt_areas = (gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1])
         else:
             det_areas = np.asarray(mask_area(det)) if n_det else np.zeros(0)
             gt_areas = np.asarray(mask_area(gt)) if n_gt else np.zeros(0)
@@ -255,24 +283,20 @@ class MeanAveragePrecision(Metric):
         if n_det > 0 and n_gt > 0:
             pd, pg = _next_bucket(n_det), _next_bucket(n_gt)
             if self.iou_type == "bbox":
-                # boxes are tiny: pad on host (numpy memcpy) and run ONE jitted
-                # program per (pd, pg) bucket — padding/IoU/matching fused,
-                # instead of ~8 eager dispatches per image
+                # boxes are tiny: pad on host (numpy memcpy); the kernel call
+                # itself is deferred — _evaluate_images batches every image of
+                # the same (pd, pg) bucket into one vmapped dispatch
                 det_pad = np.zeros((pd, 4), np.float32)
-                det_pad[:n_det] = np.asarray(det)[order]
+                det_pad[:n_det] = det[order]
                 gt_pad = np.zeros((pg, 4), np.float32)
-                gt_pad[:n_gt] = np.asarray(gt)
+                gt_pad[:n_gt] = gt
                 dcv = np.zeros((len(classes), pd), bool)
                 dcv[:, :n_det] = det_class_valid
                 gcv = np.zeros((len(classes), pg), bool)
                 gcv[:, :n_gt] = gt_class_valid
                 gia = np.zeros((len(area_ranges), pg), bool)
                 gia[:, :n_gt] = gt_area_ignore
-                kernel = _bbox_eval_kernel(pd, pg)
-                det_matches, _ = kernel(
-                    det_pad, gt_pad, np.int32(n_det), np.int32(n_gt), dcv, gcv, gia,
-                    np.asarray(self.iou_thresholds, np.float32),
-                )
+                det_matches = _PendingKernel(pd, pg, (det_pad, gt_pad, np.int32(n_det), np.int32(n_gt), dcv, gcv, gia))
             else:
                 # masks are H*W-sized: reorder/pad on device, no host round-trip
                 det_sorted = jnp.asarray(det)[jnp.asarray(order)]
@@ -282,7 +306,8 @@ class MeanAveragePrecision(Metric):
                 gcv = jnp.zeros((len(classes), pg), dtype=bool).at[:, :n_gt].set(gt_class_valid)
                 gia = jnp.zeros((len(area_ranges), pg), dtype=bool).at[:, :n_gt].set(gt_area_ignore)
                 det_matches, _ = match_image(ious_p, dcv, gcv, gia, jnp.asarray(self.iou_thresholds))
-            det_matches = np.asarray(det_matches)[..., :n_det]  # (K, A, T, D)
+            if not isinstance(det_matches, _PendingKernel):
+                det_matches = np.asarray(det_matches)[..., :n_det]  # (K, A, T, D)
         else:
             det_matches = np.zeros((len(classes), len(area_ranges), len(self.iou_thresholds), n_det), dtype=bool)
 
@@ -294,6 +319,43 @@ class MeanAveragePrecision(Metric):
             "gt_class_valid": gt_class_valid,  # (K, G)
             "gt_area_ignore": gt_area_ignore,  # (A, G)
         }
+
+    def _evaluate_images(self, class_ids: List[int]) -> List[Optional[Dict[str, np.ndarray]]]:
+        """Per-image host prep, then ONE vmapped matcher dispatch per
+        (det, gt) bucket — the epoch-end device cost is O(#buckets), not
+        O(#images). The segm path stays per-image (mask shapes vary)."""
+        evals = [self._evaluate_image_device(i, class_ids) for i in range(len(self.groundtruths))]
+
+        by_bucket: Dict[Tuple[int, int], List[int]] = {}
+        for i, ev in enumerate(evals):
+            if ev is not None and isinstance(ev["det_matches"], _PendingKernel):
+                req = ev["det_matches"]
+                by_bucket.setdefault((req.pd, req.pg), []).append(i)
+
+        thresholds = np.asarray(self.iou_thresholds, np.float32)
+        # chunk each bucket's batch: (a) bounds the (B, K, A, T, pd) match
+        # output to a fixed device footprint on COCO-scale datasets, and
+        # (b) padding B to a power-of-2 keeps the vmapped program's compile
+        # count bounded (sizes 8..256 per (pd, pg)), like the pd/pg buckets
+        chunk_cap = 256
+        for (pd, pg), idxs in by_bucket.items():
+            for start in range(0, len(idxs), chunk_cap):
+                chunk = idxs[start:start + chunk_cap]
+                reqs = [evals[i]["det_matches"] for i in chunk]
+                b_pad = _next_bucket(len(chunk))
+                stacked = []
+                for j in range(len(reqs[0].inputs)):
+                    arr = np.stack([r.inputs[j] for r in reqs])
+                    if b_pad != len(chunk):  # dummy zero images: n_det=n_gt=0
+                        pad_shape = (b_pad - len(chunk),) + arr.shape[1:]
+                        arr = np.concatenate([arr, np.zeros(pad_shape, arr.dtype)])
+                    stacked.append(arr)
+                matches, _ = _bbox_eval_kernel_batched(pd, pg)(*stacked, thresholds)
+                matches = np.asarray(matches)  # (b_pad, K, A, T, pd)
+                for b, i in enumerate(chunk):
+                    n_det = int(evals[i]["scores_sorted"].shape[0])
+                    evals[i]["det_matches"] = matches[b][..., :n_det]
+        return evals
 
     # ------------------------------------------------------------------ #
     # host-side curve aggregation (reference mean_ap.py:803-871)
@@ -309,7 +371,7 @@ class MeanAveragePrecision(Metric):
         recall = -np.ones((nb_iou_thrs, nb_classes, nb_areas, nb_mdt))
         rec_thrs = np.asarray(self.rec_thresholds)
 
-        evals = [self._evaluate_image_device(i, class_ids) for i in range(len(self.groundtruths))]
+        evals = self._evaluate_images(class_ids)
 
         for idx_cls in range(nb_classes):
             for idx_area in range(nb_areas):

@@ -155,14 +155,18 @@ def _align_delay_frames(spec_r: Array, spec_d: Array, max_shift: int = 30) -> Ar
         return jnp.sum(er * jnp.roll(ed, -s))
 
     scores = jax.vmap(score)(shifts)
-    # normalized peak coefficient: under heavy noise the envelope correlation
-    # is weak everywhere and its argmax is arbitrary — a genuine delay shows
-    # a prominent peak. Gate weak peaks to zero delay.
-    coef = jnp.max(scores) / jnp.maximum(
-        jnp.linalg.norm(er) * jnp.linalg.norm(ed), 1e-20
-    )
-    best = shifts[jnp.argmax(scores)]
-    return jnp.where(coef > 0.5, best, 0)
+    # under heavy noise the correlation field is flat and its argmax is
+    # arbitrary; a genuine delay shows a PROMINENT peak. Gate on prominence
+    # (peak vs best score outside a +-2 neighborhood) plus a low absolute
+    # floor — a hard absolute threshold alone would also reject genuine
+    # delays under moderate degradation.
+    best_idx = jnp.argmax(scores)
+    peak = scores[best_idx]
+    outside = jnp.abs(shifts - shifts[best_idx]) > 2
+    runner_up = jnp.max(jnp.where(outside, scores, -jnp.inf))
+    coef = peak / jnp.maximum(jnp.linalg.norm(er) * jnp.linalg.norm(ed), 1e-20)
+    prominent = (peak > 1.4 * jnp.maximum(runner_up, 1e-20)) & (coef > 0.15)
+    return jnp.where(prominent, shifts[best_idx], 0)
 
 
 def _bark_power(spec: Array, fs: int) -> Array:
