@@ -67,13 +67,11 @@ def _input_validator(preds: Sequence[Dict], targets: Sequence[Dict], iou_type: s
             raise ValueError(f"Input {item_val_name} and labels of sample must have a length equal to each other")
 
 
-@functools.lru_cache(maxsize=None)
-def _bbox_eval_kernel(pd: int, pg: int):
-    """One fused jitted program per (det, gt) bucket: masked box IoU over the
-    padded boxes + the greedy matcher. Counts are dynamic scalars, so every
-    image sharing a bucket shares the compiled program."""
+def _bbox_eval_body(pd: int, pg: int):
+    """Fused matcher body for one image of a (det, gt) pad bucket: masked box
+    IoU over the padded boxes + the greedy matcher. Counts are dynamic
+    scalars, so every image sharing a bucket shares one compiled program."""
 
-    @jax.jit
     def kernel(det_pad, gt_pad, n_det, n_gt, dcv, gcv, gia, thresholds):
         ious = box_iou(det_pad, gt_pad)  # (pd, pg), garbage in padded rows/cols
         valid = (jnp.arange(pd) < n_det)[:, None] & (jnp.arange(pg) < n_gt)[None, :]
@@ -85,12 +83,10 @@ def _bbox_eval_kernel(pd: int, pg: int):
 
 @functools.lru_cache(maxsize=None)
 def _bbox_eval_kernel_batched(pd: int, pg: int):
-    """vmap of the bucket kernel over a batch of images: ALL images sharing a
+    """vmap of the bucket body over a batch of images: ALL images sharing a
     (det, gt) bucket are evaluated in ONE device dispatch instead of one per
     image — the epoch-end loop becomes O(#buckets) dispatches."""
-    single = _bbox_eval_kernel(pd, pg).__wrapped__  # unjitted body
-
-    return jax.jit(jax.vmap(single, in_axes=(0, 0, 0, 0, 0, 0, 0, None)))
+    return jax.jit(jax.vmap(_bbox_eval_body(pd, pg), in_axes=(0, 0, 0, 0, 0, 0, 0, None)))
 
 
 def _next_bucket(n: int, minimum: int = 8) -> int:
@@ -338,6 +334,11 @@ class MeanAveragePrecision(Metric):
         # (b) padding B to a power-of-2 keeps the vmapped program's compile
         # count bounded (sizes 8..256 per (pd, pg)), like the pd/pg buckets
         chunk_cap = 256
+        # two phases: dispatch every chunk first (jax dispatch is async, so
+        # host-side stacking of the next chunk overlaps device compute), then
+        # fetch — one blocking transfer per chunk instead of a serialized
+        # dispatch->wait per chunk
+        pending = []
         for (pd, pg), idxs in by_bucket.items():
             for start in range(0, len(idxs), chunk_cap):
                 chunk = idxs[start:start + chunk_cap]
@@ -351,10 +352,12 @@ class MeanAveragePrecision(Metric):
                         arr = np.concatenate([arr, np.zeros(pad_shape, arr.dtype)])
                     stacked.append(arr)
                 matches, _ = _bbox_eval_kernel_batched(pd, pg)(*stacked, thresholds)
-                matches = np.asarray(matches)  # (b_pad, K, A, T, pd)
-                for b, i in enumerate(chunk):
-                    n_det = int(evals[i]["scores_sorted"].shape[0])
-                    evals[i]["det_matches"] = matches[b][..., :n_det]
+                pending.append((chunk, matches))
+        for chunk, matches in pending:
+            matches = np.asarray(matches)  # (b_pad, K, A, T, pd)
+            for b, i in enumerate(chunk):
+                n_det = int(evals[i]["scores_sorted"].shape[0])
+                evals[i]["det_matches"] = matches[b][..., :n_det]
         return evals
 
     # ------------------------------------------------------------------ #

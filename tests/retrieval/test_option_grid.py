@@ -1,0 +1,216 @@
+"""Retrieval class-metric value grid: every metric x every option combination.
+
+Reference analog: each reference retrieval test file sweeps
+empty_target_action x ignore_index x k through RetrievalMetricTester
+(tests/retrieval/helpers.py:150-420). Here one parametrized grid covers all
+ten classes against an independent numpy per-query oracle that reimplements
+the option semantics from the documented contract: group by query index, drop
+``ignore_index`` documents, then handle all-negative queries per
+``empty_target_action`` (skip / score 0 / score 1 / raise).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu as M
+
+_rng = np.random.default_rng(17)
+
+N_DOCS = 96
+N_QUERIES = 7
+
+
+def _fixture(with_ignore: bool, with_empty: bool):
+    """(indexes, preds, target) with controllable pathologies."""
+    indexes = np.sort(_rng.integers(0, N_QUERIES, N_DOCS))
+    preds = _rng.random(N_DOCS).astype(np.float32)
+    target = _rng.integers(0, 2, N_DOCS)
+    if with_empty:  # make queries 0 and 3 all-negative
+        target[np.isin(indexes, [0, 3])] = 0
+    else:  # every query has at least one positive
+        for q in range(N_QUERIES):
+            sel = np.flatnonzero(indexes == q)
+            if sel.size and target[sel].sum() == 0:
+                target[sel[0]] = 1
+    if with_ignore:  # sprinkle ignored docs
+        target[_rng.choice(N_DOCS, 10, replace=False)] = -1
+    return indexes, preds, target
+
+
+# ---------------------------------------------------------------- oracles --
+def _ap(p, t):
+    order = np.argsort(-p, kind="stable")
+    t = t[order]
+    hits = np.cumsum(t)
+    prec = hits / np.arange(1, len(t) + 1)
+    return float((prec * t).sum() / max(t.sum(), 1))
+
+
+def _mrr(p, t):
+    order = np.argsort(-p, kind="stable")
+    pos = np.flatnonzero(t[order])
+    return float(1.0 / (pos[0] + 1)) if pos.size else 0.0
+
+
+def _precision_at(k):
+    def fn(p, t):
+        order = np.argsort(-p, kind="stable")[:k]
+        return float(t[order].sum() / min(k, len(t)))
+    return fn
+
+
+def _recall_at(k):
+    def fn(p, t):
+        order = np.argsort(-p, kind="stable")[:k]
+        return float(t[order].sum() / max(t.sum(), 1))
+    return fn
+
+
+def _hit_rate_at(k):
+    def fn(p, t):
+        order = np.argsort(-p, kind="stable")[:k]
+        return float(t[order].any())
+    return fn
+
+
+def _fall_out_at(k):
+    def fn(p, t):
+        order = np.argsort(-p, kind="stable")[:k]
+        neg = (1 - t)
+        return float(neg[order].sum() / max(neg.sum(), 1))
+    return fn
+
+
+def _r_precision(p, t):
+    r = int(t.sum())
+    order = np.argsort(-p, kind="stable")[:r]
+    return float(t[order].sum() / max(r, 1))
+
+
+def _ndcg_at(k):
+    def fn(p, t):
+        kk = min(k or len(t), len(t))
+        order = np.argsort(-p, kind="stable")[:kk]
+        gains = (2.0 ** t[order] - 1) / np.log2(np.arange(2, kk + 2))
+        ideal_t = np.sort(t)[::-1][:kk]
+        ideal = (2.0 ** ideal_t - 1) / np.log2(np.arange(2, kk + 2))
+        return float(gains.sum() / max(ideal.sum(), 1e-12))
+    return fn
+
+
+def _oracle(metric_name, per_query_fn, indexes, preds, target, empty_action, ignore_index):
+    # fall-out's degenerate queries are all-POSITIVE ones (no negatives to
+    # rank; reference fall_out.py:24) — every other metric degenerates on
+    # all-negative queries
+    def degenerate(t):
+        if metric_name == "RetrievalFallOut":
+            return (1 - np.clip(t, 0, 1)).sum() == 0
+        return t.sum() == 0
+
+    vals = []
+    for q in np.unique(indexes):
+        sel = indexes == q
+        p, t = preds[sel], target[sel]
+        if ignore_index is not None:
+            keep = t != ignore_index
+            p, t = p[keep], t[keep]
+        if t.size == 0:
+            continue
+        if degenerate(t):
+            if empty_action == "skip":
+                continue
+            if empty_action == "neg":
+                vals.append(0.0)
+                continue
+            if empty_action == "pos":
+                vals.append(1.0)
+                continue
+        vals.append(per_query_fn(p, np.clip(t, 0, 1)))
+    return float(np.mean(vals)) if vals else 0.0
+
+
+_K = 3
+_GRID = [
+    ("RetrievalMAP", {}, _ap),
+    ("RetrievalMRR", {}, _mrr),
+    ("RetrievalPrecision", {"k": _K}, _precision_at(_K)),
+    ("RetrievalRecall", {"k": _K}, _recall_at(_K)),
+    ("RetrievalHitRate", {"k": _K}, _hit_rate_at(_K)),
+    ("RetrievalFallOut", {"k": _K}, _fall_out_at(_K)),
+    ("RetrievalRPrecision", {}, _r_precision),
+    ("RetrievalNormalizedDCG", {"k": _K}, _ndcg_at(_K)),
+]
+
+
+@pytest.mark.parametrize("empty_action", ["skip", "neg", "pos"])
+@pytest.mark.parametrize("with_ignore", [False, True], ids=["plain", "ignore-index"])
+@pytest.mark.parametrize("name,kwargs,per_query", _GRID, ids=[g[0] for g in _GRID])
+def test_option_grid_vs_numpy_oracle(name, kwargs, per_query, empty_action, with_ignore):
+    indexes, preds, target = _fixture(with_ignore, with_empty=True)
+    if name == "RetrievalFallOut":
+        # give fall-out its own degenerate case: make query 5 all-POSITIVE
+        target = target.copy()
+        target[indexes == 5] = 1
+
+    m = getattr(M, name)(
+        empty_target_action=empty_action,
+        ignore_index=-1 if with_ignore else None,
+        **kwargs,
+    )
+    m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+    got = float(m.compute())
+
+    want = _oracle(name, per_query, indexes, preds, target, empty_action,
+                   -1 if with_ignore else None)
+    np.testing.assert_allclose(got, want, atol=1e-5, err_msg=f"{name} {empty_action}")
+
+
+@pytest.mark.parametrize("name,kwargs,per_query", _GRID, ids=[g[0] for g in _GRID])
+def test_option_grid_error_action_raises(name, kwargs, per_query):
+    if name == "RetrievalFallOut":
+        pytest.skip("fall-out raises on all-positive queries instead")
+    indexes, preds, target = _fixture(False, with_empty=True)
+    m = getattr(M, name)(empty_target_action="error", **kwargs)
+    m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+    with pytest.raises(Exception):
+        m.compute()
+
+
+@pytest.mark.parametrize("empty_action", ["skip", "neg", "pos"])
+@pytest.mark.parametrize("with_ignore", [False, True], ids=["plain", "ignore-index"])
+@pytest.mark.parametrize("name,kwargs,per_query", _GRID, ids=[g[0] for g in _GRID])
+def test_option_grid_compiled_path(name, kwargs, per_query, empty_action, with_ignore):
+    """The static-shape compiled evaluation obeys the same option grid."""
+    indexes, preds, target = _fixture(with_ignore, with_empty=True)
+    if name == "RetrievalFallOut":
+        target = target.copy()
+        target[indexes == 5] = 1
+
+    m = getattr(M, name)(
+        empty_target_action=empty_action,
+        ignore_index=-1 if with_ignore else None,
+        max_queries=N_QUERIES + 1,
+        max_docs_per_query=N_DOCS,
+        **kwargs,
+    )
+    m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+    got = float(m.compute())
+    want = _oracle(name, per_query, indexes, preds, target, empty_action,
+                   -1 if with_ignore else None)
+    np.testing.assert_allclose(got, want, atol=1e-5, err_msg=f"{name} {empty_action} compiled")
+
+
+@pytest.mark.parametrize("k", [1, 2, 5, None], ids=lambda k: f"k={k}")
+def test_k_sweep_vs_oracle(k):
+    indexes, preds, target = _fixture(False, with_empty=False)
+    kwargs = {} if k is None else {"k": k}
+    for name, per_query in [
+        ("RetrievalPrecision", _precision_at(k or N_DOCS)),
+        ("RetrievalRecall", _recall_at(k or N_DOCS)),
+        ("RetrievalNormalizedDCG", _ndcg_at(k)),
+    ]:
+        m = getattr(M, name)(**kwargs)
+        m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+        want = _oracle(name, per_query, indexes, preds, target, "neg", None)
+        np.testing.assert_allclose(float(m.compute()), want, atol=1e-5, err_msg=f"{name} k={k}")

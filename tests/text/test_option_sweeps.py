@@ -1,0 +1,148 @@
+"""Dense option sweeps for the text family vs package oracles.
+
+Reference analog: each reference text test file sweeps its metric's full
+option surface against the upstream package (tests/text/test_bleu.py
+n_gram/smooth, test_chrf.py char/word orders + whitespace, test_ter.py the
+four normalization flags, test_rouge.py keys/stemmer/accumulate). Here the
+sweeps run on a corpus with multi-reference targets, unicode, punctuation,
+casing, and degenerate strings — the inputs where option handling actually
+changes the answer.
+"""
+import numpy as np
+import pytest
+from sacrebleu.metrics import BLEU as SacreBLEU, CHRF as SacreCHRF, TER as SacreTER
+
+import metrics_tpu as M
+
+_PREDS = [
+    "the quick brown Fox jumps over the lazy dog!",
+    "hello, world — this is a TEST.",
+    "El rápido zorro marrón salta.",
+    "a shorter test sentence here",
+    "punctuation, everywhere; truly: everywhere!",
+]
+_TARGETS = [
+    ["the quick brown fox jumped over a lazy dog.", "a quick brown fox jumps over the lazy dog"],
+    ["hello world, this was a test!", "hello world this is a test"],
+    ["El zorro marrón rápido salta.", "Un zorro rápido salta."],
+    ["a short test sentence here", "a shorter sentence"],
+    ["punctuation everywhere, truly everywhere", "punctuation, everywhere; truly everywhere!"],
+]
+
+
+@pytest.mark.parametrize("n_gram", [1, 2, 3, 4])
+@pytest.mark.parametrize("smooth", [False, True], ids=["plain", "smooth"])
+def test_bleu_option_sweep(n_gram, smooth):
+    got = float(M.BLEUScore(n_gram=n_gram, smooth=smooth)(_PREDS, _TARGETS))
+    # nltk corpus_bleu with uniform weights and method1 smoothing replicates
+    # the reference's torch implementation on whitespace tokens
+    from nltk.translate.bleu_score import SmoothingFunction, corpus_bleu
+
+    weights = tuple(1.0 / n_gram for _ in range(n_gram))
+    refs = [[r.split() for r in t] for t in _TARGETS]
+    hyps = [p.split() for p in _PREDS]
+    # smooth=True implements add-1 counts for n>1 == nltk method2 (the
+    # reference's convention, see tests/text/test_bleu_chrf_ter.py)
+    sm = SmoothingFunction().method2 if smooth else SmoothingFunction().method0
+    want = corpus_bleu(refs, hyps, weights=weights, smoothing_function=sm)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+@pytest.mark.parametrize("lowercase", [False, True], ids=["cased", "lowercase"])
+@pytest.mark.parametrize("tokenize", ["13a", "none", "char"])
+def test_sacrebleu_option_sweep(tokenize, lowercase):
+    got = float(M.SacreBLEUScore(tokenize=tokenize, lowercase=lowercase)(_PREDS, _TARGETS))
+    want = (
+        SacreBLEU(tokenize=tokenize, lowercase=lowercase)
+        .corpus_score(_PREDS, [[t[i] for t in _TARGETS] for i in range(2)])
+        .score
+        / 100.0
+    )
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+@pytest.mark.parametrize("whitespace", [False, True], ids=["nospace", "space"])
+@pytest.mark.parametrize("n_char_order,n_word_order", [(6, 2), (6, 0), (4, 1), (2, 2)])
+def test_chrf_option_sweep(n_char_order, n_word_order, whitespace):
+    got = float(
+        M.CHRFScore(
+            n_char_order=n_char_order, n_word_order=n_word_order, whitespace=whitespace
+        )(_PREDS, _TARGETS)
+    )
+    want = (
+        SacreCHRF(char_order=n_char_order, word_order=n_word_order, whitespace=whitespace, eps_smoothing=True)
+        .corpus_score(_PREDS, [[t[i] for t in _TARGETS] for i in range(2)])
+        .score
+        / 100.0
+    )
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+@pytest.mark.parametrize(
+    "flags",
+    [
+        {},
+        {"normalize": True},
+        {"no_punctuation": True},
+        {"lowercase": False},
+        {"normalize": True, "no_punctuation": True, "lowercase": True},
+    ],
+    ids=["default", "normalize", "nopunct", "cased", "all"],
+)
+def test_ter_option_sweep(flags):
+    got = float(M.TranslationEditRate(**flags)(_PREDS, _TARGETS))
+    want = (
+        SacreTER(
+            normalized=flags.get("normalize", False),
+            no_punct=flags.get("no_punctuation", False),
+            case_sensitive=not flags.get("lowercase", True),
+        )
+        .corpus_score(_PREDS, [[t[i] for t in _TARGETS] for i in range(2)])
+        .score
+        / 100.0
+    )
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+@pytest.mark.parametrize("use_stemmer", [False, True], ids=["plain", "stemmer"])
+@pytest.mark.parametrize("accumulate", ["best", "avg"])
+def test_rouge_option_sweep(use_stemmer, accumulate):
+    metric = M.ROUGEScore(use_stemmer=use_stemmer, accumulate=accumulate)
+    got = metric(_PREDS, _TARGETS)
+
+    from rouge_score.rouge_scorer import RougeScorer
+
+    scorer = RougeScorer(["rouge1", "rouge2", "rougeL"], use_stemmer=use_stemmer)
+    agg = {k: [] for k in ("rouge1", "rouge2", "rougeL")}
+    for pred, refs in zip(_PREDS, _TARGETS):
+        per_ref = [scorer.score(r, pred) for r in refs]
+        if accumulate == "best":
+            # reference semantics: ONE reference wins per sentence — the one
+            # maximizing the FIRST key's fmeasure — and its scores are used
+            # for every key (reference functional/text/rouge.py accumulate)
+            best_idx = int(np.argmax([s["rouge1"].fmeasure for s in per_ref]))
+            for key in agg:
+                agg[key].append(per_ref[best_idx][key].fmeasure)
+        else:
+            for key in agg:
+                agg[key].append(float(np.mean([s[key].fmeasure for s in per_ref])))
+    for key in agg:
+        np.testing.assert_allclose(
+            float(got[f"{key}_fmeasure"]), float(np.mean(agg[key])), atol=1e-4, err_msg=key
+        )
+
+
+def test_degenerate_inputs_stay_finite():
+    """Empty hypothesis / identical strings across every text metric."""
+    preds = ["", "identical sentence"]
+    flat_targets = ["some reference", "identical sentence"]
+    nested_targets = [["some reference"], ["identical sentence"]]
+    for cls, targets in [
+        (M.WordErrorRate, flat_targets), (M.CharErrorRate, flat_targets),
+        (M.MatchErrorRate, flat_targets), (M.WordInfoLost, flat_targets),
+        (M.WordInfoPreserved, flat_targets), (M.BLEUScore, nested_targets),
+        (M.SacreBLEUScore, nested_targets), (M.CHRFScore, nested_targets),
+        (M.TranslationEditRate, nested_targets),
+    ]:
+        val = cls()(preds, targets)
+        assert np.isfinite(float(val)), cls.__name__
