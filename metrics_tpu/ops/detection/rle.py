@@ -79,7 +79,13 @@ def _counts_to_string(counts: Sequence[int]) -> bytes:
 
 
 def rle_decode(rle: Dict[str, Any]) -> np.ndarray:
-    """RLE dict (compressed or uncompressed) -> dense bool mask (H, W)."""
+    """RLE dict (compressed or uncompressed) -> dense bool mask (H, W).
+
+    Example:
+        >>> from metrics_tpu.ops.detection.rle import rle_decode
+        >>> rle_decode({"size": [2, 3], "counts": [0, 1, 2, 3]}).astype(int).tolist()
+        [[1, 0, 1], [0, 1, 1]]
+    """
     if not is_rle(rle):
         raise ValueError(
             "Expected an RLE dict with 'size' and 'counts' keys; "
@@ -101,7 +107,17 @@ def rle_decode(rle: Dict[str, Any]) -> np.ndarray:
 
 
 def rle_encode(mask: np.ndarray, compress: bool = True) -> Dict[str, Any]:
-    """Dense (H, W) mask -> RLE dict (compressed counts by default)."""
+    """Dense (H, W) mask -> RLE dict (compressed counts by default).
+
+    Example:
+        >>> import numpy as np
+        >>> from metrics_tpu.ops.detection.rle import rle_decode, rle_encode
+        >>> mask = np.asarray([[1, 0, 1], [0, 1, 1]], dtype=bool)
+        >>> rle_encode(mask, compress=False)["counts"]
+        [0, 1, 2, 3]
+        >>> bool((rle_decode(rle_encode(mask)) == mask).all())
+        True
+    """
     mask = np.asarray(mask, dtype=bool)
     if mask.ndim != 2:
         raise ValueError(f"Expected a 2-d mask; got shape {mask.shape}.")

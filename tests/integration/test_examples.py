@@ -19,6 +19,7 @@ EXAMPLES = [
     "bert_score_own_model.py",
     "rouge_score_own_normalizer_and_tokenizer.py",
     "distributed_eval.py",
+    "speech_quality_on_device.py",
 ]
 
 
