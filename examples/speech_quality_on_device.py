@@ -30,12 +30,11 @@ FS_STOI = 10000  # STOI's native rate — no resampling inside jit
 FS_PESQ = 8000   # narrowband PESQ rate
 BATCH, SECONDS = 8, 2
 
-rng = np.random.default_rng(0)
-
-
 def make_batch(fs):
     """Synthesize the SAME utterances at a given rate (each metric gets audio
-    at its native rate — never truncate one rate into another)."""
+    at its native rate — never truncate one rate into another). A fresh
+    seeded rng per call keeps the noise process identical across rates."""
+    rng = np.random.default_rng(0)
     t = np.arange(SECONDS * fs) / fs
     clean = np.stack([
         np.sin(2 * np.pi * (110 + 15 * i) * t) * (0.3 + 0.7 * (np.sin(2 * np.pi * 3 * t + i) > 0))
