@@ -1,0 +1,66 @@
+"""SSIM/PSNR parameter sweeps vs the hand-rolled numpy oracles.
+
+Reference analog: tests/image/test_ssim.py parametrizes sigma and data_range
+against skimage (absent offline — the oracle here is the independent
+scipy.signal implementation from test_image.py). The sweep covers the knobs
+that change the Gaussian window and the stabilization constants, where a
+broadcasting or constant-handling bug would hide at the defaults.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import ops
+from tests.image.test_image import _np_psnr, _np_ssim
+
+_rng = np.random.default_rng(23)
+_P = _rng.random((3, 2, 32, 32)).astype(np.float32)
+_T = np.clip(_P + 0.1 * _rng.normal(size=_P.shape), 0, 1).astype(np.float32)
+
+
+@pytest.mark.parametrize("sigma", [0.8, 1.5, 2.5])
+def test_ssim_sigma_sweep(sigma):
+    # with gaussian_kernel=True the op derives the window size from sigma
+    # (same int(3.5*sigma+0.5)*2+1 formula as the oracle) — kernel_size is
+    # intentionally NOT passed, it would be ignored
+    got = float(ops.structural_similarity_index_measure(
+        jnp.asarray(_P), jnp.asarray(_T), sigma=sigma, data_range=1.0,
+    ))
+    want = _np_ssim(_P, _T, sigma=sigma, data_range=1.0)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+@pytest.mark.parametrize("k1,k2", [(0.01, 0.03), (0.05, 0.1), (0.001, 0.001)])
+def test_ssim_stability_constants(k1, k2):
+    got = float(ops.structural_similarity_index_measure(
+        jnp.asarray(_P), jnp.asarray(_T), data_range=1.0, k1=k1, k2=k2,
+    ))
+    want = _np_ssim(_P, _T, data_range=1.0, k1=k1, k2=k2)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+@pytest.mark.parametrize("data_range", [0.5, 1.0, 255.0])
+def test_ssim_data_range_sweep(data_range):
+    scale = data_range
+    got = float(ops.structural_similarity_index_measure(
+        jnp.asarray(_P * scale), jnp.asarray(_T * scale), data_range=data_range,
+    ))
+    want = _np_ssim(_P * scale, _T * scale, data_range=data_range)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+    # SSIM is invariant under joint rescaling when data_range scales along
+    base = float(ops.structural_similarity_index_measure(
+        jnp.asarray(_P), jnp.asarray(_T), data_range=1.0,
+    ))
+    np.testing.assert_allclose(got, base, atol=1e-4)
+
+
+@pytest.mark.parametrize("base", [2.0, 10.0])
+@pytest.mark.parametrize("data_range", [1.0, 255.0])
+def test_psnr_base_and_range_sweep(base, data_range):
+    got = float(ops.peak_signal_noise_ratio(
+        jnp.asarray(_P * data_range), jnp.asarray(_T * data_range),
+        data_range=data_range, base=base,
+    ))
+    want = _np_psnr(_P * data_range, _T * data_range, data_range=data_range, base=base)
+    np.testing.assert_allclose(got, want, atol=1e-4)
