@@ -1,0 +1,97 @@
+"""``dist_sync_on_step`` semantics under a collective context.
+
+Reference analog: _class_test runs every metric with
+dist_sync_on_step=[False, True] (tests/helpers/testers.py:131-171): with True,
+``forward`` must return the batch value computed from ALL ranks' batch;
+with False, the local rank's batch value. Here the "ranks" are mesh devices
+inside shard_map with the sync_axes context declared.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import Accuracy, MeanSquaredError
+from metrics_tpu.parallel.sync import sync_axes
+from tests.helpers.testers import DummyMetricSum
+
+WORLD = 8
+
+
+@pytest.fixture()
+def mesh():
+    devices = jax.devices()
+    if len(devices) < WORLD:
+        pytest.skip("needs 8 devices")
+    return Mesh(np.asarray(devices[:WORLD]), ("data",))
+
+
+@pytest.mark.parametrize("sync_step", [False, True], ids=["local", "dist_sync_on_step"])
+def test_forward_batch_value_scope(mesh, sync_step):
+    """forward() returns the cross-device batch value iff dist_sync_on_step."""
+    m = DummyMetricSum(dist_sync_on_step=sync_step)
+
+    def body(x):
+        with sync_axes("data"):
+            val = m(x[0, 0])  # forward: batch value + accumulation
+        return jnp.expand_dims(jnp.asarray(val), 0)
+
+    xs = jnp.arange(1.0, WORLD + 1).reshape(WORLD, 1)
+    out = np.asarray(
+        jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False))(xs)
+    )
+    if sync_step:
+        np.testing.assert_allclose(out, np.full(WORLD, xs.sum()))  # global batch sum everywhere
+    else:
+        np.testing.assert_allclose(out, np.arange(1.0, WORLD + 1))  # each device its own
+
+
+@pytest.mark.parametrize("sync_step", [False, True], ids=["local", "dist_sync_on_step"])
+def test_forward_value_metric_accuracy(mesh, sync_step):
+    """Same contract through a real metric with derived (ratio) compute."""
+    rng = np.random.default_rng(3)
+    preds = jnp.asarray(rng.dirichlet(np.ones(4), (WORLD, 16)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 4, (WORLD, 16)))
+    m = Accuracy(num_classes=4, dist_sync_on_step=sync_step)
+
+    def body(p, t):
+        with sync_axes("data"):
+            val = m(p.reshape(-1, 4), t.reshape(-1))
+        return jnp.expand_dims(jnp.asarray(val), 0)
+
+    out = np.asarray(jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P("data"), check_vma=False,
+    ))(preds, target))
+
+    per_device = (np.asarray(preds).argmax(-1) == np.asarray(target)).mean(axis=1)
+    if sync_step:
+        np.testing.assert_allclose(out, per_device.mean(), atol=1e-6)
+    else:
+        np.testing.assert_allclose(out, per_device, atol=1e-6)
+
+
+def test_forward_accumulation_unaffected_by_step_sync(mesh):
+    """dist_sync_on_step changes the RETURNED batch value only — the
+    accumulated epoch state must be identical either way."""
+    results = {}
+    for sync_step in (False, True):
+        m = MeanSquaredError(dist_sync_on_step=sync_step)
+
+        def body(p, t):
+            with sync_axes("data"):
+                _ = m(p[0], t[0])
+                state = m.get_state()
+                state = m.sync_states(state, "data")
+                out = m.compute_state(state)
+            return jnp.expand_dims(out, 0)
+
+        rng = np.random.default_rng(7)
+        p = jnp.asarray(rng.random((WORLD, 16)).astype(np.float32))
+        t = jnp.asarray(rng.random((WORLD, 16)).astype(np.float32))
+        out = np.asarray(jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P("data"), check_vma=False,
+        ))(p, t))
+        results[sync_step] = out
+        m.reset()
+    np.testing.assert_allclose(results[False], results[True], atol=1e-7)

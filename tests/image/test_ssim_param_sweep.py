@@ -28,7 +28,7 @@ def test_ssim_sigma_sweep(sigma):
         jnp.asarray(_P), jnp.asarray(_T), sigma=sigma, data_range=1.0,
     ))
     want = _np_ssim(_P, _T, sigma=sigma, data_range=1.0)
-    np.testing.assert_allclose(got, want, atol=1e-5)
+    np.testing.assert_allclose(got, want, atol=1e-4)
 
 
 @pytest.mark.parametrize("k1,k2", [(0.01, 0.03), (0.05, 0.1), (0.001, 0.001)])
@@ -37,7 +37,7 @@ def test_ssim_stability_constants(k1, k2):
         jnp.asarray(_P), jnp.asarray(_T), data_range=1.0, k1=k1, k2=k2,
     ))
     want = _np_ssim(_P, _T, data_range=1.0, k1=k1, k2=k2)
-    np.testing.assert_allclose(got, want, atol=1e-5)
+    np.testing.assert_allclose(got, want, atol=1e-4)
 
 
 @pytest.mark.parametrize("data_range", [0.5, 1.0, 255.0])
@@ -48,11 +48,13 @@ def test_ssim_data_range_sweep(data_range):
     ))
     want = _np_ssim(_P * scale, _T * scale, data_range=data_range)
     np.testing.assert_allclose(got, want, atol=1e-4)
-    # SSIM is invariant under joint rescaling when data_range scales along
-    base = float(ops.structural_similarity_index_measure(
-        jnp.asarray(_P), jnp.asarray(_T), data_range=1.0,
-    ))
-    np.testing.assert_allclose(got, base, atol=1e-4)
+    if data_range != 1.0:
+        # SSIM is invariant under joint rescaling when data_range scales along
+        # (at 1.0 this would compare the call to itself — vacuous)
+        base = float(ops.structural_similarity_index_measure(
+            jnp.asarray(_P), jnp.asarray(_T), data_range=1.0,
+        ))
+        np.testing.assert_allclose(got, base, atol=1e-4)
 
 
 @pytest.mark.parametrize("base", [2.0, 10.0])
