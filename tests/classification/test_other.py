@@ -6,6 +6,7 @@ test_kl_divergence.py, test_ranking.py.
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from scipy.special import rel_entr
 from scipy.stats import entropy as scipy_entropy
 from sklearn.metrics import coverage_error as sk_coverage
 from sklearn.metrics import hinge_loss as sk_hinge
@@ -153,3 +154,33 @@ def test_calibration_eager_jit_agree_on_logits():
     eager = calibration_error(logits, target)
     jitted = jax.jit(calibration_error)(logits, target)
     np.testing.assert_allclose(np.asarray(eager), np.asarray(jitted), atol=1e-6)
+
+
+# --------------------------------------------------------------------------- #
+# KLDivergence option surface: log_prob x reduction (reference
+# kl_divergence.py:81-123) vs a scipy rel_entr oracle
+# --------------------------------------------------------------------------- #
+@pytest.mark.parametrize("log_prob", [False, True], ids=["probs", "log-probs"])
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_kl_option_surface(log_prob, reduction):
+    rng = np.random.default_rng(41)
+    p = rng.dirichlet(np.ones(5), size=12).astype(np.float32)
+    q = rng.dirichlet(np.ones(5), size=12).astype(np.float32)
+    per_sample = rel_entr(p, q).sum(axis=-1)
+    want = {"mean": per_sample.mean(), "sum": per_sample.sum(), "none": per_sample}[reduction]
+
+    args = (np.log(p), np.log(q)) if log_prob else (p, q)
+    got = kl_divergence(jnp.asarray(args[0]), jnp.asarray(args[1]),
+                        log_prob=log_prob, reduction=reduction)
+    np.testing.assert_allclose(np.asarray(got, np.float64), want, atol=1e-5)
+
+
+def test_kl_class_log_prob_accumulates():
+    rng = np.random.default_rng(42)
+    p = rng.dirichlet(np.ones(4), size=16).astype(np.float32)
+    q = rng.dirichlet(np.ones(4), size=16).astype(np.float32)
+    m = KLDivergence(log_prob=True)
+    m.update(jnp.asarray(np.log(p[:8])), jnp.asarray(np.log(q[:8])))
+    m.update(jnp.asarray(np.log(p[8:])), jnp.asarray(np.log(q[8:])))
+    want = rel_entr(p, q).sum(axis=-1).mean()
+    np.testing.assert_allclose(float(m.compute()), want, atol=1e-5)

@@ -93,5 +93,4 @@ def test_forward_accumulation_unaffected_by_step_sync(mesh):
             body, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P("data"), check_vma=False,
         ))(p, t))
         results[sync_step] = out
-        m.reset()
     np.testing.assert_allclose(results[False], results[True], atol=1e-7)
