@@ -227,3 +227,26 @@ def test_bootstrapper_inherits_base_state():
     assert bs._vmapped
     out = bs.compute()
     np.testing.assert_allclose(float(out["mean"]), 1.0)  # all replicas carry mse=1
+
+
+def test_tracker_mixed_maximize_directions():
+    """A collection tracked with per-metric directions: best step differs per
+    metric when one is maximized and the other minimized."""
+    from metrics_tpu import MeanAbsoluteError
+
+    # the maximize list maps to the collection's SORTED key order
+    # (collections.py:103, reference parity) — here ["mae", "mse"]
+    tracker = MetricTracker(
+        MetricCollection({"mse": MeanSquaredError(), "mae": MeanAbsoluteError()}),
+        maximize=[True, False],  # maximize mae (artificially), minimize mse
+    )
+    t = jnp.asarray(_rng.random(32), dtype=jnp.float32)
+    shifts = [0.5, 0.1, 0.3]
+    for shift in shifts:
+        tracker.increment()
+        tracker.update(t + shift, t)
+    best, steps = tracker.best_metric(return_step=True)
+    # mse minimized -> the 0.1 epoch (step 1); mae maximized -> 0.5 (step 0)
+    assert steps["mse"] == 1 and steps["mae"] == 0, steps
+    assert best["mse"] == pytest.approx(0.01, abs=1e-5)
+    assert best["mae"] == pytest.approx(0.5, abs=1e-5)
