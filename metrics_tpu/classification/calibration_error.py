@@ -36,7 +36,7 @@ class CalibrationError(Metric):
         if norm not in self.DISTANCES:
             raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
         if not isinstance(n_bins, int) or n_bins <= 0:
-            raise ValueError(f"Expected argument `n_bins` to be a int larger than 0 but got {n_bins}")
+            raise ValueError(f"Expected argument `n_bins` to be a positive integer but got {n_bins}")
         self.n_bins = n_bins
         self.norm = norm
         self.bin_boundaries = jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32)

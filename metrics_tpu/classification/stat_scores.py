@@ -59,7 +59,7 @@ class StatScores(Metric):
         if mdmc_reduce not in [None, "samplewise", "global"]:
             raise ValueError(f"The `mdmc_reduce` {mdmc_reduce} is not valid.")
         if reduce == "macro" and (not num_classes or num_classes < 1):
-            raise ValueError("When you set `reduce` as 'macro', you have to provide the number of classes.")
+            raise ValueError("reduce='macro' requires `num_classes` to be set.")
         if num_classes and ignore_index is not None and (not ignore_index < num_classes or num_classes == 1):
             raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
 

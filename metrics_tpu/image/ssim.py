@@ -122,7 +122,7 @@ class MultiScaleStructuralSimilarityIndexMeasure(_ImagePairMetric):
         if not all(isinstance(beta, float) for beta in betas):
             raise ValueError("Argument `betas` is expected to be a tuple of floats.")
         if normalize is not None and normalize not in ("relu", "simple"):
-            raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
+            raise ValueError("Argument `normalize` must be None, 'relu' or 'simple'")
         self.gaussian_kernel = gaussian_kernel
         self.kernel_size = kernel_size
         self.sigma = sigma

@@ -61,7 +61,7 @@ def _accuracy_update(
     mode: DataType,
 ) -> Tuple[Array, Array, Array, Array]:
     if mode == DataType.MULTILABEL and top_k:
-        raise ValueError("You can not use the `top_k` parameter to calculate accuracy for multi-label inputs.")
+        raise ValueError("The `top_k` parameter is not supported for multi-label accuracy.")
     preds, target = _input_squeeze(preds, target)
     return _stat_scores_update(
         preds, target, reduce=reduce, mdmc_reduce=mdmc_reduce, threshold=threshold,
@@ -122,7 +122,7 @@ def _subset_accuracy_update(
         preds, target, threshold=threshold, top_k=top_k, ignore_index=ignore_index, num_classes=num_classes
     )
     if mode == DataType.MULTILABEL and top_k:
-        raise ValueError("You can not use the `top_k` parameter to calculate accuracy for multi-label inputs.")
+        raise ValueError("The `top_k` parameter is not supported for multi-label accuracy.")
 
     if mode == DataType.MULTILABEL:
         correct = jnp.sum(jnp.all(preds == target, axis=1))

@@ -37,7 +37,7 @@ def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
         if bool(jnp.all(dx <= 0)):
             direction = -1.0
         else:
-            raise ValueError("The `x` tensor is neither increasing or decreasing. Try setting the reorder argument to `True`.")
+            raise ValueError("`x` must be monotonic (sorted ascending or descending); pass reorder=True to sort it first.")
     else:
         direction = 1.0
     return _auc_compute_without_check(x, y, direction)

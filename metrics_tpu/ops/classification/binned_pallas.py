@@ -137,9 +137,12 @@ def _binned_counts_xla(preds: Array, target_bool: Array, thresholds: Array):
     # bucket 0. Keep the paths bit-identical.
     bucket = jnp.where(jnp.isnan(preds), 0, bucket)
     seg = (jnp.arange(c)[None, :] * (n_t + 1) + bucket).reshape(-1)
-    tgt = target_bool.astype(jnp.float32).reshape(-1)
+    # integer accumulation: float32 segment_sum/cumsum is exact only to 2^24
+    # per class per call; int32 keeps counts exact to 2^31, cast to float32 (the
+    # other paths' output dtype) only at the end.
+    tgt = target_bool.astype(jnp.int32).reshape(-1)
     pos = jax.ops.segment_sum(tgt, seg, num_segments=c * (n_t + 1)).reshape(c, n_t + 1)
-    neg = jax.ops.segment_sum(1.0 - tgt, seg, num_segments=c * (n_t + 1)).reshape(c, n_t + 1)
+    neg = jax.ops.segment_sum(1 - tgt, seg, num_segments=c * (n_t + 1)).reshape(c, n_t + 1)
 
     cum_pos = jnp.cumsum(pos, axis=1)[:, :n_t]
     cum_neg = jnp.cumsum(neg, axis=1)[:, :n_t]
@@ -148,7 +151,11 @@ def _binned_counts_xla(preds: Array, target_bool: Array, thresholds: Array):
     fn = cum_pos
 
     inv = jnp.argsort(order)  # scatter back to the user's threshold order
-    return tp[:, inv], fp[:, inv], fn[:, inv]
+    return (
+        tp[:, inv].astype(jnp.float32),
+        fp[:, inv].astype(jnp.float32),
+        fn[:, inv].astype(jnp.float32),
+    )
 
 
 def binned_stat_counts(preds: Array, target_bool: Array, thresholds: Array, use_pallas: str = "auto"):

@@ -102,7 +102,7 @@ def calibration_error(preds: Array, target: Array, n_bins: int = 15, norm: str =
     if norm not in ("l1", "l2", "max"):
         raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
     if not isinstance(n_bins, int) or n_bins <= 0:
-        raise ValueError(f"Expected argument `n_bins` to be a int larger than 0 but got {n_bins}")
+        raise ValueError(f"Expected argument `n_bins` to be a positive integer but got {n_bins}")
     confidences, accuracies = _ce_update(preds, target)
     bin_boundaries = jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32)
     return _ce_compute(confidences, accuracies, bin_boundaries, norm=norm)

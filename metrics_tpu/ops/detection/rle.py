@@ -13,8 +13,9 @@ Two wire formats, matching pycocotools ``maskUtils``:
   background/foreground and starting with background.
 - **compressed**: ``counts`` is an ASCII byte string; each run length is a
   variable-length base-32 integer (5 value bits per byte, offset 48, bit 0x20
-  continues, sign-extended via bit 0x10 of the last byte), and from the third
-  run on the stored value is a delta against the run two places back.
+  continues, sign-extended via bit 0x10 of the last byte), and from the
+  fourth run on (index >= 3) the stored value is a delta against the run two
+  places back.
 
 The codec is a clean-room implementation of that public format (documented in
 the COCO API); both directions round-trip and the decoder is differentially

@@ -243,7 +243,7 @@ def multiscale_structural_similarity_index_measure(
     if not all(isinstance(beta, float) for beta in betas):
         raise ValueError("Argument `betas` is expected to be a tuple of floats.")
     if normalize is not None and normalize not in ("relu", "simple"):
-        raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
+        raise ValueError("Argument `normalize` must be None, 'relu' or 'simple'")
     preds, target = _ssim_check_inputs(preds, target)
     return _multiscale_ssim_compute(
         preds, target, gaussian_kernel, sigma, kernel_size, reduction, data_range, k1, k2, betas, normalize

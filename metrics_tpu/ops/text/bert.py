@@ -212,7 +212,7 @@ def bert_score(
     supply your own, as in the reference's own-model example.
     """
     if isinstance(preds, (list, tuple)) and isinstance(target, (list, tuple)) and len(preds) != len(target):
-        raise ValueError("Number of predicted and reference sententes must be the same!")
+        raise ValueError("`preds` and `target` must contain the same number of sentences.")
 
     if model is None:
         if not _TRANSFORMERS_AVAILABLE:

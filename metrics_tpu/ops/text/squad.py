@@ -81,7 +81,7 @@ def _squad_input_check(preds: PREDS_TYPE, targets: TARGETS_TYPE) -> Tuple[Dict[s
             )
         if "text" not in target["answers"]:
             raise KeyError(
-                "Expected keys in a 'answers' are 'text'."
+                "Expected the 'answers' dict to contain a 'text' key. "
                 "Please make sure that 'answer' maps to a `SQuAD` format dictionary.\n"
                 f"SQuAD Format: {SQuAD_FORMAT}"
             )
