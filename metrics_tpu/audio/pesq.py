@@ -50,10 +50,11 @@ class PerceptualEvaluationSpeechQuality(_MeanAudioMetric):
         self.fs = fs
         if mode not in ("wb", "nb"):
             raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
-        # Reference parity: the pesq C extension rejects fs=8000/mode='wb'
-        # itself at update time (torchmetrics/audio/pesq.py defers to it), so
-        # only the native model — which has no backend to defer to — enforces
-        # the pairing at construction.
+        # Reference parity: torchmetrics surfaces the fs=8000/mode='wb'
+        # rejection at update time (its pesq backend raises then), and our
+        # functional layer (ops/audio/pesq.py) does the same. Only the native
+        # model also enforces the pairing at construction, to fail fast where
+        # no update-time backend check exists.
         if implementation == "native" and fs == 8000 and mode == "wb":
             raise ValueError("Expected argument `mode` to be 'nb' for a 8000Hz signal")
         self.mode = mode

@@ -137,3 +137,42 @@ def test_differential_vs_c_extension():
     # identical quality ordering, and bounded deviation on speech material
     assert (np.argsort(ours) == np.argsort(theirs)).all(), (ours, theirs)
     assert np.max(np.abs(ours - theirs)) < 0.35, (ours, theirs)
+
+
+# ---------------------------------------------------------------------------
+# Pinned goldens: ungated numeric regression net for the native model.
+#
+# The C-extension differential above is the *truth* test but only runs where
+# `pesq` is installed; these constants freeze the native model's current MOS
+# output on deterministic fixtures so a numeric change to any pipeline stage
+# (level/time alignment, bark bands, loudness mapping, disturbance
+# aggregation) fails CI everywhere. Regenerate deliberately (and re-run the
+# gated differential) if the model is intentionally improved.
+# ---------------------------------------------------------------------------
+
+_GOLDEN_SNRS = (30, 20, 15, 10, 5, 0)
+_GOLDEN_MOS = {
+    # (fs, mode) -> [identity, snr30, snr20, snr15, snr10, snr5, snr0]
+    (8000, "nb"): [4.500000, 4.494661, 4.449308, 4.305016, 3.921289, 3.275458, 2.588635],
+    (16000, "wb"): [4.640000, 4.640000, 4.640000, 4.640000, 4.625756, 4.538018, 4.195332],
+}
+
+
+def _golden_degradations(fs):
+    ref = _speech_like(4 * fs, fs)
+    degs = [ref]
+    for i, snr_db in enumerate(_GOLDEN_SNRS):
+        rng = np.random.default_rng(1000 + i)  # per-fixture seed: order-independent
+        noise = rng.normal(size=ref.shape).astype(np.float32)
+        noise *= np.linalg.norm(ref) / np.linalg.norm(noise) * 10 ** (-snr_db / 20)
+        degs.append(ref + noise)
+    return ref, degs
+
+
+@pytest.mark.parametrize("fs,mode", [(8000, "nb"), (16000, "wb")])
+def test_pinned_goldens(fs, mode):
+    ref, degs = _golden_degradations(fs)
+    got = [float(pesq_native(jnp.asarray(d), jnp.asarray(ref), fs, mode)) for d in degs]
+    # 0.02 MOS absorbs cross-platform float32 FFT reassociation while still
+    # catching any real pipeline regression (those move scores by >> 0.1)
+    np.testing.assert_allclose(got, _GOLDEN_MOS[(fs, mode)], atol=2e-2)
