@@ -145,7 +145,13 @@ _TRUNKS = {"alex": AlexTrunk, "vgg": VGG16Trunk, "squeeze": SqueezeTrunk}
 
 
 class LPIPS(nn.Module):
-    """Full LPIPS distance module: two NHWC images in [-1,1] -> [N] distance."""
+    """Full LPIPS distance module: two NHWC images in [-1,1] -> [N] distance.
+
+    Both images run through the trunk as ONE concatenated 2N batch: a single
+    conv program instead of two, which halves kernel-launch count and doubles
+    the per-conv batch the MXU tiles over (identical numerics — the trunk is
+    batch-independent; measured bit-equal to the two-pass form on CPU).
+    """
 
     net_type: str = "alex"
 
@@ -159,12 +165,14 @@ class LPIPS(nn.Module):
             norm = jnp.sqrt(jnp.sum(feat ** 2, axis=-1, keepdims=True))
             return feat / (norm + 1e-10)
 
-        taps1 = trunk((img1 - shift) / scale)
-        taps2 = trunk((img2 - shift) / scale)
+        n = img1.shape[0]
+        both = jnp.concatenate([img1, img2], axis=0)
+        taps = trunk((both - shift) / scale)
 
         total = 0.0
-        for i, (f1, f2) in enumerate(zip(taps1, taps2)):
-            diff = (normalize(f1) - normalize(f2)) ** 2
+        for i, f in enumerate(taps):
+            f = normalize(f)
+            diff = (f[:n] - f[n:]) ** 2
             w = self.param(f"lin{i}", nn.initializers.ones, (diff.shape[-1],))
             # lin heads are constrained non-negative in lpips; enforce on use
             weighted = diff * jnp.maximum(w, 0.0)
@@ -178,7 +186,12 @@ class LPIPSNet:
     Reference analog: ``NoTrainLpips`` (torchmetrics/image/lpip.py:21-25).
     """
 
-    def __init__(self, net_type: str = "alex", variables: Dict | None = None) -> None:
+    def __init__(
+        self,
+        net_type: str = "alex",
+        variables: Dict | None = None,
+        compute_dtype: Any = None,
+    ) -> None:
         if net_type not in _TRUNKS:
             raise ValueError(f"Argument `net_type` must be one of {tuple(_TRUNKS)}, but got {net_type}.")
         self.net_type = net_type
@@ -186,12 +199,24 @@ class LPIPSNet:
         if variables is None:
             dummy = jnp.zeros((1, 64, 64, 3))
             variables = self.module.init(jax.random.PRNGKey(0), dummy, dummy)
+        # compute_dtype=jnp.bfloat16 runs the trunk at the MXU's native rate
+        # on TPU (2x the f32 path); distances shift by O(1e-3) so it is
+        # opt-in — the default matches the reference's f32 numerics. The cast
+        # happens ONCE here (not per forward), and the dtype is fixed for the
+        # life of the scorer — it is baked into the jitted program.
+        self.compute_dtype = compute_dtype
+        if compute_dtype is not None:
+            variables = jax.tree.map(lambda x: x.astype(compute_dtype), variables)
         self.variables = variables
-        self._forward = jax.jit(
-            lambda variables, a, b: self.module.apply(
-                variables, jnp.transpose(a, (0, 2, 3, 1)), jnp.transpose(b, (0, 2, 3, 1))
-            )
-        )
+
+        def forward(variables, a, b):
+            a = jnp.transpose(a, (0, 2, 3, 1))
+            b = jnp.transpose(b, (0, 2, 3, 1))
+            if compute_dtype is not None:
+                a, b = a.astype(compute_dtype), b.astype(compute_dtype)
+            return self.module.apply(variables, a, b).astype(jnp.float32)
+
+        self._forward = jax.jit(forward)
 
     def __call__(self, img1: Array, img2: Array) -> Array:
         return self._forward(self.variables, img1.astype(jnp.float32), img2.astype(jnp.float32))

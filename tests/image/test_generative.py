@@ -303,6 +303,18 @@ def test_lpips_net_architecture(net_type):
     np.testing.assert_allclose(np.asarray(net(a, b)), np.asarray(net(a, b)))  # deterministic
 
 
+def test_lpips_bf16_compute_dtype():
+    """Opt-in bf16 trunk: f32 output dtype, distances within bf16 tolerance
+    of the f32 path (the TPU-rate deployment mode)."""
+    f32 = LPIPSNet("alex")
+    bf16 = LPIPSNet("alex", variables=f32.variables, compute_dtype=jnp.bfloat16)
+    a = jnp.asarray(_rng.uniform(-1, 1, size=(4, 3, 64, 64)).astype(np.float32))
+    b = jnp.asarray(_rng.uniform(-1, 1, size=(4, 3, 64, 64)).astype(np.float32))
+    d32, d16 = f32(a, b), bf16(a, b)
+    assert d16.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(d16), np.asarray(d32), rtol=2e-2)
+
+
 # --------------------------------------------------------------------------- #
 # Inception architecture (no pretrained weights available offline)
 # --------------------------------------------------------------------------- #
