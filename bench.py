@@ -305,30 +305,37 @@ def bench_collection_scan() -> dict:
             "recall": Recall(num_classes=NUM_CLASSES, average="macro"),
         }
     )
+    # Loop-VARYING inputs via a ring of pre-generated batches, indexed per
+    # step: with a single closed-over (or even argument) batch, XLA hoists
+    # the whole top-k/one-hot input stage out of the scan as loop-invariant
+    # code (and constant-folds it for closures, ~40s extra compile), so the
+    # timed loop would exclude most of the per-step work.
     rng = np.random.default_rng(0)
-    logits = jnp.asarray(rng.normal(size=(BATCH, NUM_CLASSES)), dtype=jnp.float32)
-    target = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=jnp.int32)
+    ring = 8
+    logits_ring = jnp.asarray(rng.normal(size=(ring, BATCH, NUM_CLASSES)), dtype=jnp.float32)
+    target_ring = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(ring, BATCH)), dtype=jnp.int32)
     n_steps = 256
 
-    def sweep(states):
-        def one_step(states, _):
+    def sweep(states, logits_ring, target_ring):
+        def one_step(states, i):
+            logits = jax.lax.dynamic_index_in_dim(logits_ring, i % ring, keepdims=False)
+            target = jax.lax.dynamic_index_in_dim(target_ring, i % ring, keepdims=False)
             return coll.update_state(states, logits, target), ()
 
-        states, _ = jax.lax.scan(one_step, states, None, length=n_steps)
+        states, _ = jax.lax.scan(one_step, states, jnp.arange(n_steps))
         return states
 
     # AOT lower/compile once: the same executable is timed AND provides the
     # cost analysis, so no second (hang-prone on TPU) compile sits between a
     # successful measurement and its report
     states0 = coll.init_state()
-    compiled = jax.jit(sweep).lower(states0).compile()
+    compiled = jax.jit(sweep).lower(states0, logits_ring, target_ring).compile()
     flops = _flops_of_compiled(compiled)
-    jax.block_until_ready(compiled(states0))  # warm
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        jax.block_until_ready(compiled(states0))
-        best = min(best, time.perf_counter() - t0)
+    jax.block_until_ready(compiled(states0, logits_ring, target_ring))  # warm
+    best = min(
+        _timed(lambda: jax.block_until_ready(compiled(states0, logits_ring, target_ring)))
+        for _ in range(3)
+    )
     return {
         "us_per_step": best / n_steps * 1e6,
         **_mfu_fields(flops / n_steps if flops else None, best / n_steps),

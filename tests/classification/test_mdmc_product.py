@@ -1,0 +1,203 @@
+"""Full mdmc_average × average × top_k × ignore_index product, stat-scores family.
+
+The reference sweeps every stat-scores-derived metric over the complete
+option cross-product (tests/classification/test_precision_recall.py:163-230
+with the mdmc fixtures from tests/classification/inputs.py:25-80). This
+module closes the same grid here against an independent numpy oracle that
+re-derives the k-hot stat-scores semantics from scratch (one-hot/k-hot
+matrices, column deletion for ignore_index, per-sample reduction for
+``mdmc_average='samplewise'``) — no shared code with the jax implementation.
+"""
+import numpy as np
+import pytest
+
+from metrics_tpu.classification import F1Score, Precision, Recall, Specificity
+from metrics_tpu.ops.classification import f1_score, precision, recall, specificity
+from tests.classification.inputs import (
+    _input_multidim_multiclass,
+    _input_multidim_multiclass_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+_t = MetricTester()
+
+
+# --------------------------------------------------------------------------- #
+# independent numpy oracle
+# --------------------------------------------------------------------------- #
+def _khot_rows(preds, top_k):
+    """(M,) labels or (M, C) probs -> (M, C) 0/1 k-hot matrix."""
+    if preds.ndim == 1:  # hard labels
+        out = np.zeros((preds.shape[0], NUM_CLASSES), dtype=np.int64)
+        out[np.arange(preds.shape[0]), preds] = 1
+        return out
+    k = top_k or 1
+    top = np.argsort(-preds, axis=-1, kind="stable")[:, :k]
+    out = np.zeros_like(preds, dtype=np.int64)
+    np.put_along_axis(out, top, 1, axis=-1)
+    return out
+
+
+def _onehot_rows(target):
+    out = np.zeros((target.shape[0], NUM_CLASSES), dtype=np.int64)
+    out[np.arange(target.shape[0]), target] = 1
+    return out
+
+
+def _counts(preds_rows, target_rows, top_k, ignore_index, micro):
+    """Per-class (or micro-collapsed) tp/fp/tn/fn over a flat sample block."""
+    kh = _khot_rows(preds_rows, top_k)
+    oh = _onehot_rows(target_rows)
+    if ignore_index is not None and micro:
+        kh = np.delete(kh, ignore_index, axis=1)
+        oh = np.delete(oh, ignore_index, axis=1)
+    tp = (kh & oh).sum(0)
+    fp = (kh & (1 - oh)).sum(0)
+    fn = ((1 - kh) & oh).sum(0)
+    tn = ((1 - kh) & (1 - oh)).sum(0)
+    if micro:
+        tp, fp, fn, tn = tp.sum(), fp.sum(), fn.sum(), tn.sum()
+    return tp, fp, tn, fn
+
+
+_NUM_DEN = {
+    "precision": lambda tp, fp, tn, fn: (tp, tp + fp),
+    "recall": lambda tp, fp, tn, fn: (tp, tp + fn),
+    "f1": lambda tp, fp, tn, fn: (2 * tp, 2 * tp + fp + fn),
+    "specificity": lambda tp, fp, tn, fn: (tn, tn + fp),
+}
+_WEIGHTS = {
+    "precision": lambda tp, fp, tn, fn: tp + fn,
+    "recall": lambda tp, fp, tn, fn: tp + fn,
+    "f1": lambda tp, fp, tn, fn: tp + fn,
+    "specificity": lambda tp, fp, tn, fn: tn + fp,
+}
+
+
+def _oracle_block(metric, preds_rows, target_rows, average, top_k, ignore_index):
+    """Score one flat block of samples (post-mdmc-flattening)."""
+    micro = average == "micro"
+    tp, fp, tn, fn = _counts(preds_rows, target_rows, top_k, ignore_index, micro)
+    num, den = _NUM_DEN[metric](tp, fp, tn, fn)
+    num, den = np.asarray(num, np.float64), np.asarray(den, np.float64)
+    score = np.divide(num, den, out=np.zeros_like(num), where=den != 0)
+    if micro:
+        return float(score)
+    keep = np.ones(NUM_CLASSES, dtype=bool)
+    if ignore_index is not None:
+        keep[ignore_index] = False
+    if average == "macro":
+        return float(score[keep].mean())
+    if average == "weighted":
+        w = np.asarray(_WEIGHTS[metric](tp, fp, tn, fn), np.float64)[keep]
+        return float(np.nan_to_num((score[keep] * w).sum() / w.sum()))
+    # none: per-class vector, nan at the ignored class
+    out = score.astype(np.float64)
+    if ignore_index is not None:
+        out[ignore_index] = np.nan
+    return out
+
+
+def _oracle(metric, preds, target, average, mdmc_average, top_k, ignore_index):
+    """preds: (N, C, X) probs or (N, X) labels; target: (N, X)."""
+    if preds.ndim == 3:  # probs: (N, C, X) -> per-sample (X, C)
+        rows = lambda n: np.moveaxis(preds[n], 0, -1).reshape(-1, NUM_CLASSES)
+    else:
+        rows = lambda n: preds[n].reshape(-1)
+    n_samples = preds.shape[0]
+    if mdmc_average == "global":
+        p = np.concatenate([rows(n) for n in range(n_samples)])
+        t = target.reshape(-1)
+        return _oracle_block(metric, p, t, average, top_k, ignore_index)
+    per_sample = [
+        _oracle_block(metric, rows(n), target[n].reshape(-1), average, top_k, ignore_index)
+        for n in range(n_samples)
+    ]
+    return np.mean(np.asarray(per_sample), axis=0)
+
+
+_FUNCTIONAL = {"precision": precision, "recall": recall, "f1": f1_score, "specificity": specificity}
+_CLASSES = {"precision": Precision, "recall": Recall, "f1": F1Score, "specificity": Specificity}
+
+_MDMC = _input_multidim_multiclass
+_MDMC_PROB = _input_multidim_multiclass_prob
+
+# the full grid: every (input_kind, top_k) that is type-valid
+_INPUT_TOPK = [("labels", None), ("probs", None), ("probs", 2)]
+
+
+def _fixture(input_kind):
+    return _MDMC if input_kind == "labels" else _MDMC_PROB
+
+
+@pytest.mark.parametrize("ignore_index", [None, 0])
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+@pytest.mark.parametrize("mdmc_average", ["global", "samplewise"])
+@pytest.mark.parametrize("input_kind,top_k", _INPUT_TOPK)
+@pytest.mark.parametrize("metric", list(_FUNCTIONAL))
+def test_mdmc_product_functional(metric, input_kind, top_k, mdmc_average, average, ignore_index):
+    import jax.numpy as jnp
+
+    fix = _fixture(input_kind)
+    fn = _FUNCTIONAL[metric]
+    # per batch, like the reference functional tester
+    for i in range(fix.preds.shape[0]):
+        got = fn(
+            jnp.asarray(fix.preds[i]),
+            jnp.asarray(fix.target[i]),
+            average=average,
+            mdmc_average=mdmc_average,
+            top_k=top_k,
+            ignore_index=ignore_index,
+            num_classes=NUM_CLASSES,
+        )
+        want = _oracle(
+            metric, fix.preds[i], fix.target[i], average, mdmc_average, top_k, ignore_index
+        )
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5, err_msg=f"{metric} {input_kind}")
+
+
+@pytest.mark.parametrize("ignore_index", [None, 0])
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+@pytest.mark.parametrize("mdmc_average", ["global", "samplewise"])
+@pytest.mark.parametrize("input_kind,top_k", _INPUT_TOPK)
+@pytest.mark.parametrize("ddp", [False, True])
+def test_mdmc_product_f1_class(ddp, input_kind, top_k, mdmc_average, average, ignore_index):
+    """F1 (the most general num/den shape) over the FULL product incl. ddp."""
+    fix = _fixture(input_kind)
+    _t.run_class_metric_test(
+        ddp=ddp,
+        preds=fix.preds,
+        target=fix.target,
+        metric_class=F1Score,
+        sk_metric=lambda p, t: _oracle("f1", p, t, average, mdmc_average, top_k, ignore_index),
+        metric_args={
+            "average": average,
+            "mdmc_average": mdmc_average,
+            "top_k": top_k,
+            "ignore_index": ignore_index,
+            "num_classes": NUM_CLASSES,
+        },
+    )
+
+
+@pytest.mark.parametrize("metric", ["precision", "recall", "specificity"])
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+@pytest.mark.parametrize("mdmc_average", ["global", "samplewise"])
+def test_mdmc_product_class_ddp(metric, mdmc_average, average):
+    """Remaining family members: mdmc × average cross under ddp with the
+    stressing option corner (top_k=2, ignore_index=0) pinned on."""
+    _t.run_class_metric_test(
+        ddp=True,
+        preds=_MDMC_PROB.preds,
+        target=_MDMC_PROB.target,
+        metric_class=_CLASSES[metric],
+        sk_metric=lambda p, t: _oracle(metric, p, t, average, mdmc_average, 2, 0),
+        metric_args={
+            "average": average,
+            "mdmc_average": mdmc_average,
+            "top_k": 2,
+            "ignore_index": 0,
+            "num_classes": NUM_CLASSES,
+        },
+    )

@@ -1,0 +1,60 @@
+"""Helpers for the real-weight parity harness tests."""
+from __future__ import annotations
+
+import os
+
+
+def make_synthetic_weights_dir(path: str) -> None:
+    """Populate ``path`` with randomized checkpoints saved in the exact file
+    formats / key layouts of the community weights the gated harness expects
+    (pt_inception .pth, torchvision trunk .pth, lpips lin .pth, HF dir).
+
+    Values are random — the point is that every loader, converter, and
+    differential in ``test_real_weight_parity.py`` executes end to end, so the
+    harness is proven runnable before real weights ever arrive.
+    """
+    import torch
+
+    from metrics_tpu.nets.lpips import NET_CHANNELS
+    from tests.helpers.torch_nets import (
+        TorchFIDInception,
+        make_lpips_backbone_state_dict,
+        make_lpips_lin_state_dict,
+        randomize_inception_,
+    )
+
+    os.makedirs(path, exist_ok=True)
+    net = TorchFIDInception()
+    randomize_inception_(net, seed=11)
+    torch.save(net.state_dict(), os.path.join(path, "pt_inception-2015-12-05-synthetic.pth"))
+    torch.save(make_lpips_backbone_state_dict("alex", seed=12), os.path.join(path, "alexnet-synthetic.pth"))
+    torch.save(
+        make_lpips_lin_state_dict(NET_CHANNELS["alex"], seed=13),
+        os.path.join(path, "lpips_alex_synthetic.pth"),
+    )
+    torch.save(make_lpips_backbone_state_dict("vgg", seed=14), os.path.join(path, "vgg16-synthetic.pth"))
+    torch.save(
+        make_lpips_lin_state_dict(NET_CHANNELS["vgg"], seed=15),
+        os.path.join(path, "lpips_vgg_synthetic.pth"),
+    )
+
+    try:
+        from transformers import BertConfig, BertModel, BertTokenizer
+    except ImportError:
+        return
+    cfg = BertConfig(
+        vocab_size=64,
+        hidden_size=32,
+        num_hidden_layers=3,
+        num_attention_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=64,
+    )
+    bert_dir = os.path.join(path, "bert")
+    BertModel(cfg).save_pretrained(bert_dir)
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    vocab += ["the", "cat", "sat", "on", "mat", "a", "quick", "brown", "fox", "slow", "jumped"]
+    vocab += [f"tok{i}" for i in range(64 - len(vocab))]
+    with open(os.path.join(bert_dir, "vocab.txt"), "w") as fh:
+        fh.write("\n".join(vocab))
+    BertTokenizer(os.path.join(bert_dir, "vocab.txt")).save_pretrained(bert_dir)

@@ -1,0 +1,241 @@
+"""Real pretrained-checkpoint parity harness (checkpoint-dir gated).
+
+The structural converter differentials in ``tests/image/test_generative.py``
+prove key-mapping + architecture on *randomized* torch nets. This module is
+the missing value-parity leg: point ``METRICS_TPU_WEIGHTS_DIR`` at a local
+directory holding the community checkpoints and every test below runs the
+real weights through converter -> tap-for-tap torch differential -> full
+metric value parity on fixed fixtures. Without the env var the module skips
+cleanly, so it is runnable today and green the day weights are available
+(this environment has no network, so the weights cannot be fetched here).
+
+Expected directory layout (any subset; each file gates only its own tests):
+
+    $METRICS_TPU_WEIGHTS_DIR/
+      pt_inception-2015-12-05*.pth     torch-fidelity FID InceptionV3
+                                       (reference download site:
+                                       torchmetrics/image/fid.py:27-46)
+      alexnet*.pth                     torchvision AlexNet (LPIPS trunk)
+      vgg16*.pth                       torchvision VGG16 (LPIPS trunk)
+      lpips_alex*.pth / alex.pth       lpips lin heads, alex
+                                       (torchmetrics/image/lpip.py:34-45)
+      lpips_vgg*.pth / vgg.pth         lpips lin heads, vgg
+      bert/ (or any dir w/ config.json) HF encoder checkpoint for BERTScore
+                                       (torchmetrics/functional/text/bert.py:249-326)
+
+Run:  METRICS_TPU_WEIGHTS_DIR=/path/to/ckpts python -m pytest tests/weights -v
+"""
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+WEIGHTS_DIR = os.environ.get("METRICS_TPU_WEIGHTS_DIR", "")
+
+pytestmark = pytest.mark.skipif(
+    not WEIGHTS_DIR or not os.path.isdir(WEIGHTS_DIR),
+    reason="METRICS_TPU_WEIGHTS_DIR not set to an existing checkpoint directory",
+)
+
+_rng = np.random.default_rng(20260731)
+
+
+def _find(*patterns: str) -> str | None:
+    for pat in patterns:
+        hits = sorted(glob.glob(os.path.join(WEIGHTS_DIR, pat)))
+        if hits:
+            return hits[0]
+    return None
+
+
+def _torch_load(path: str):
+    torch = pytest.importorskip("torch")
+    sd = torch.load(path, map_location="cpu")
+    if isinstance(sd, dict) and "state_dict" in sd:
+        sd = sd["state_dict"]
+    return {k: v for k, v in sd.items()}
+
+
+def _require(path: str | None, what: str) -> str:
+    if path is None:
+        pytest.skip(f"{what} checkpoint not present in METRICS_TPU_WEIGHTS_DIR")
+    return path
+
+
+# --------------------------------------------------------------------------- #
+# FID InceptionV3 (pt_inception-2015-12-05)
+# --------------------------------------------------------------------------- #
+def _real_inception():
+    torch = pytest.importorskip("torch")
+    path = _require(_find("pt_inception*.pth", "*inception*2015*.pth"), "FID Inception")
+    sd = _torch_load(path)
+    from tests.helpers.torch_nets import TorchFIDInception
+
+    net = TorchFIDInception()
+    # the community checkpoint stores fc as 1x1 conv weights in some exports;
+    # let strict loading report any mismatch precisely rather than masking it
+    net.load_state_dict({k: torch.as_tensor(np.asarray(v)) for k, v in sd.items()})
+    net.eval()
+
+    from metrics_tpu.nets.inception import load_inception_torch_state_dict
+
+    taps = ("64", "192", "768", "2048", "logits_unbiased")
+    variables = load_inception_torch_state_dict(
+        {k: np.asarray(v) for k, v in sd.items()}, features_list=taps
+    )
+    return net, variables, taps
+
+
+def test_inception_real_weight_tap_parity():
+    """Real FID weights: flax taps must match the torch oracle tap-for-tap."""
+    torch = pytest.importorskip("torch")
+    net, variables, taps = _real_inception()
+    imgs = _rng.integers(0, 255, size=(2, 3, 299, 299)).astype(np.uint8)
+    with torch.no_grad():
+        want = net(torch.as_tensor(imgs))
+
+    from metrics_tpu.nets.inception import InceptionV3, _resize_bilinear_tf1
+
+    module = InceptionV3(features_list=taps)
+    x = jnp.transpose(jnp.asarray(imgs, jnp.float32), (0, 2, 3, 1))
+    x = _resize_bilinear_tf1(x, 299, 299)
+    x = (x - 128.0) / 128.0
+    got = module.apply(variables, x)
+    for tap in taps:
+        w = want[tap].numpy()
+        scale = max(1e-6, float(np.abs(w).max()))
+        np.testing.assert_allclose(
+            np.asarray(got[tap]), w, rtol=2e-3, atol=2e-3 * scale, err_msg=f"tap {tap}"
+        )
+
+
+def test_fid_real_weight_value_parity():
+    """Published-weight FID: same images through both pipelines -> same value."""
+    torch = pytest.importorskip("torch")
+    net, variables, _ = _real_inception()
+    real = _rng.integers(0, 255, size=(24, 3, 96, 96)).astype(np.uint8)
+    fake = np.clip(real + _rng.integers(-40, 40, size=real.shape), 0, 255).astype(np.uint8)
+
+    from metrics_tpu.image import FrechetInceptionDistance
+    from metrics_tpu.nets.inception import InceptionV3FeatureExtractor
+
+    ext = InceptionV3FeatureExtractor("2048", variables=variables)
+    fid = FrechetInceptionDistance(feature=ext)
+    for i in range(0, 24, 12):
+        fid.update(jnp.asarray(real[i : i + 12]), real=True)
+        fid.update(jnp.asarray(fake[i : i + 12]), real=False)
+    got = float(fid.compute())
+
+    with torch.no_grad():
+        rf = net(torch.as_tensor(real))["2048"].numpy().astype(np.float64)
+        ff = net(torch.as_tensor(fake))["2048"].numpy().astype(np.float64)
+    from tests.image.test_generative import _np_fid
+
+    want = _np_fid(rf.mean(0), np.cov(rf, rowvar=False), ff.mean(0), np.cov(ff, rowvar=False))
+    assert abs(got - want) / max(1.0, abs(want)) < 2e-2, (got, want)
+
+
+# --------------------------------------------------------------------------- #
+# LPIPS (torchvision trunk + lpips lin heads)
+# --------------------------------------------------------------------------- #
+def _lpips_state_dicts(net_type: str):
+    trunk_path = _require(
+        _find(f"{'alexnet' if net_type == 'alex' else 'vgg16'}*.pth"),
+        f"torchvision {net_type} trunk",
+    )
+    lin_path = _require(
+        _find(f"lpips_{net_type}*.pth", f"{net_type}.pth"), f"lpips {net_type} lin"
+    )
+    trunk = {k: np.asarray(v) for k, v in _torch_load(trunk_path).items() if k.startswith("features.")}
+    lin = {k: np.asarray(v) for k, v in _torch_load(lin_path).items() if ".model." in k or k.startswith("lin")}
+    return trunk, lin
+
+
+@pytest.mark.parametrize("net_type", ["alex", "vgg"])
+def test_lpips_real_weight_value_parity(net_type):
+    """Real trunk+lin weights: flax LPIPS == torch oracle forward, and the
+    LPIPS metric on fixed image pairs matches the torch pipeline value."""
+    torch = pytest.importorskip("torch")
+    trunk, lin = _lpips_state_dicts(net_type)
+
+    from metrics_tpu.nets.lpips import LPIPSNet, load_lpips_torch_state_dict
+    from tests.helpers.torch_nets import torch_lpips_forward
+
+    variables = load_lpips_torch_state_dict(trunk, lin, net_type)
+    a = _rng.uniform(-1, 1, size=(4, 3, 96, 96)).astype(np.float32)
+    b = _rng.uniform(-1, 1, size=(4, 3, 96, 96)).astype(np.float32)
+    want = torch_lpips_forward(
+        {k: torch.as_tensor(v) for k, v in trunk.items()},
+        {k: torch.as_tensor(v) for k, v in lin.items()},
+        net_type,
+        torch.as_tensor(a),
+        torch.as_tensor(b),
+    ).numpy()
+    scorer = LPIPSNet(net_type=net_type, variables=variables)
+    got = np.asarray(scorer(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(got.reshape(-1), want.reshape(-1), rtol=2e-3, atol=2e-4)
+
+    from metrics_tpu.image import LearnedPerceptualImagePatchSimilarity
+
+    metric = LearnedPerceptualImagePatchSimilarity(net=scorer)
+    metric.update(jnp.asarray(a), jnp.asarray(b))
+    assert abs(float(metric.compute()) - float(want.mean())) < 5e-4
+
+
+# --------------------------------------------------------------------------- #
+# BERTScore (HF checkpoint dir)
+# --------------------------------------------------------------------------- #
+def _bert_dir() -> str:
+    for cand in sorted(glob.glob(os.path.join(WEIGHTS_DIR, "*"))):
+        if os.path.isdir(cand) and os.path.exists(os.path.join(cand, "config.json")):
+            return cand
+    pytest.skip("no HF checkpoint dir (config.json) in METRICS_TPU_WEIGHTS_DIR")
+
+
+def test_bert_score_real_checkpoint_flax_vs_torch():
+    """Same HF checkpoint through FlaxAutoModel (our default path) and torch
+    AutoModel (via user_forward_fn) must yield the same BERTScore."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    path = _bert_dir()
+
+    from metrics_tpu.functional import bert_score
+
+    preds = ["the cat sat on the mat", "a quick brown fox"]
+    target = ["a cat sat on a mat", "the slow brown fox jumped"]
+
+    flax_out = bert_score(
+        preds, target, model_name_or_path=path, num_layers=2, batch_size=2, max_length=32
+    )
+
+    tok = transformers.AutoTokenizer.from_pretrained(path)
+    tmodel = transformers.AutoModel.from_pretrained(path, output_hidden_states=True)
+    tmodel.eval()
+
+    def torch_forward(_model, batch):
+        with torch.no_grad():
+            out = tmodel(
+                input_ids=torch.as_tensor(np.asarray(batch["input_ids"])),
+                attention_mask=torch.as_tensor(np.asarray(batch["attention_mask"])),
+            )
+        return np.asarray(out.hidden_states[2])
+
+    torch_out = bert_score(
+        preds,
+        target,
+        model=object(),
+        user_tokenizer=tok,
+        user_forward_fn=torch_forward,
+        batch_size=2,
+        max_length=32,
+    )
+    for key in ("precision", "recall", "f1"):
+        np.testing.assert_allclose(
+            np.asarray(flax_out[key]), np.asarray(torch_out[key]), rtol=1e-3, atol=1e-3,
+            err_msg=key,
+        )
