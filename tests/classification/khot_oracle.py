@@ -1,0 +1,26 @@
+"""Shared numpy k-hot/one-hot canonicalization for independent test oracles.
+
+Used by the option-product suites (`test_mdmc_product.py`,
+`test_stat_scores_product.py`) so the from-scratch counting semantics live in
+exactly one place — still with no code shared with the jax implementation.
+"""
+import numpy as np
+
+
+def khot_rows(preds: np.ndarray, top_k, num_classes: int) -> np.ndarray:
+    """(M,) hard labels or (M, C) probabilities -> (M, C) 0/1 k-hot matrix."""
+    if preds.ndim == 1:
+        out = np.zeros((preds.shape[0], num_classes), dtype=np.int64)
+        out[np.arange(preds.shape[0]), preds] = 1
+        return out
+    k = top_k or 1
+    top = np.argsort(-preds, axis=-1, kind="stable")[:, :k]
+    out = np.zeros_like(preds, dtype=np.int64)
+    np.put_along_axis(out, top, 1, axis=-1)
+    return out
+
+
+def onehot_rows(target: np.ndarray, num_classes: int) -> np.ndarray:
+    out = np.zeros((target.shape[0], num_classes), dtype=np.int64)
+    out[np.arange(target.shape[0]), target] = 1
+    return out

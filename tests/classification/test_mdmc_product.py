@@ -17,6 +17,7 @@ from tests.classification.inputs import (
     _input_multidim_multiclass,
     _input_multidim_multiclass_prob,
 )
+from tests.classification.khot_oracle import khot_rows, onehot_rows
 from tests.helpers.testers import NUM_CLASSES, MetricTester
 
 _t = MetricTester()
@@ -25,29 +26,10 @@ _t = MetricTester()
 # --------------------------------------------------------------------------- #
 # independent numpy oracle
 # --------------------------------------------------------------------------- #
-def _khot_rows(preds, top_k):
-    """(M,) labels or (M, C) probs -> (M, C) 0/1 k-hot matrix."""
-    if preds.ndim == 1:  # hard labels
-        out = np.zeros((preds.shape[0], NUM_CLASSES), dtype=np.int64)
-        out[np.arange(preds.shape[0]), preds] = 1
-        return out
-    k = top_k or 1
-    top = np.argsort(-preds, axis=-1, kind="stable")[:, :k]
-    out = np.zeros_like(preds, dtype=np.int64)
-    np.put_along_axis(out, top, 1, axis=-1)
-    return out
-
-
-def _onehot_rows(target):
-    out = np.zeros((target.shape[0], NUM_CLASSES), dtype=np.int64)
-    out[np.arange(target.shape[0]), target] = 1
-    return out
-
-
 def _counts(preds_rows, target_rows, top_k, ignore_index, micro):
     """Per-class (or micro-collapsed) tp/fp/tn/fn over a flat sample block."""
-    kh = _khot_rows(preds_rows, top_k)
-    oh = _onehot_rows(target_rows)
+    kh = khot_rows(preds_rows, top_k, NUM_CLASSES)
+    oh = onehot_rows(target_rows, NUM_CLASSES)
     if ignore_index is not None and micro:
         kh = np.delete(kh, ignore_index, axis=1)
         oh = np.delete(oh, ignore_index, axis=1)

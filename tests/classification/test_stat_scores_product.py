@@ -1,0 +1,197 @@
+"""StatScores root functional: reduce × mdmc_reduce × top_k × ignore_index grid.
+
+The whole stat-scores-derived family (precision/recall/F-beta/specificity/
+accuracy) consumes the counts this functional produces, so the reference
+pins the raw [tp, fp, tn, fn, support] tensors themselves across its full
+option grid (tests/classification/test_stat_scores.py:112-230 with the
+mdmc fixtures). Same here, against a from-scratch numpy k-hot counter, plus
+the Accuracy-specific ``subset_accuracy`` × mdmc cells.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.classification import Accuracy, StatScores
+from metrics_tpu.ops.classification import accuracy, stat_scores
+from tests.classification.inputs import (
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multidim_multiclass,
+    _input_multidim_multiclass_prob,
+    _input_multilabel_prob,
+)
+from tests.classification.khot_oracle import khot_rows, onehot_rows
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+_t = MetricTester()
+
+
+# --------------------------------------------------------------------------- #
+# numpy oracle: k-hot counts with reference column-drop / sentinel semantics
+# --------------------------------------------------------------------------- #
+def _rows(preds, target, top_k):
+    """Canonicalize one flat block to (M, C) k-hot / one-hot matrices."""
+    return khot_rows(preds, top_k, NUM_CLASSES), onehot_rows(target, NUM_CLASSES)
+
+
+def _np_counts(kh, oh, reduce, ignore_index):
+    if ignore_index is not None and reduce != "macro":
+        kh = np.delete(kh, ignore_index, axis=1)
+        oh = np.delete(oh, ignore_index, axis=1)
+    axis = 1 if reduce == "samples" else 0
+    tp = (kh & oh).sum(axis)
+    fp = (kh & (1 - oh)).sum(axis)
+    fn = ((1 - kh) & oh).sum(axis)
+    tn = ((1 - kh) & (1 - oh)).sum(axis)
+    if reduce == "micro":
+        tp, fp, tn, fn = tp.sum(), fp.sum(), tn.sum(), fn.sum()
+    stacked = np.stack([tp, fp, tn, fn, tp + fn], axis=-1).astype(np.int64)
+    if ignore_index is not None and reduce == "macro":
+        stacked[..., ignore_index, :] = -1
+    return stacked
+
+
+def _np_stat_scores(preds, target, reduce, mdmc_reduce, top_k, ignore_index):
+    if preds.ndim >= 2 and not (preds.ndim == 2 and np.issubdtype(preds.dtype, np.floating)):
+        # multidim multiclass: (N, C, X) probs or (N, X) labels
+        if np.issubdtype(preds.dtype, np.floating):
+            per = [np.moveaxis(preds[n], 0, -1).reshape(-1, NUM_CLASSES) for n in range(preds.shape[0])]
+        else:
+            per = [preds[n].reshape(-1) for n in range(preds.shape[0])]
+        tgt = [target[n].reshape(-1) for n in range(target.shape[0])]
+        if mdmc_reduce == "global":
+            p = np.concatenate(per) if per[0].ndim == 1 else np.vstack(per)
+            kh, oh = _rows(p, np.concatenate(tgt), top_k)
+            return _np_counts(kh, oh, reduce, ignore_index)
+        blocks = []
+        for p, t in zip(per, tgt):
+            kh, oh = _rows(p, t, top_k)
+            blocks.append(_np_counts(kh, oh, reduce, ignore_index))
+        return np.stack(blocks)
+    kh, oh = _rows(preds, target, top_k)
+    return _np_counts(kh, oh, reduce, ignore_index)
+
+
+_FLAT_CASES = [
+    ("mc", _input_multiclass),
+    ("mc_prob", _input_multiclass_prob),
+]
+_MDMC_CASES = [
+    ("mdmc", _input_multidim_multiclass),
+    ("mdmc_prob", _input_multidim_multiclass_prob),
+]
+
+
+@pytest.mark.parametrize("ignore_index", [None, 1])
+@pytest.mark.parametrize("top_k", [None, 2])
+@pytest.mark.parametrize("reduce", ["micro", "macro", "samples"])
+@pytest.mark.parametrize("case,fix", _FLAT_CASES)
+def test_stat_scores_flat_grid(case, fix, reduce, top_k, ignore_index):
+    if top_k is not None and case == "mc":
+        pytest.skip("top_k needs probability inputs")
+    for i in range(fix.preds.shape[0]):
+        got = stat_scores(
+            jnp.asarray(fix.preds[i]), jnp.asarray(fix.target[i]),
+            reduce=reduce, top_k=top_k, ignore_index=ignore_index, num_classes=NUM_CLASSES,
+        )
+        want = _np_stat_scores(fix.preds[i], fix.target[i], reduce, None, top_k, ignore_index)
+        np.testing.assert_array_equal(np.asarray(got), want, err_msg=f"{case} {reduce}")
+
+
+@pytest.mark.parametrize("ignore_index", [None, 1])
+@pytest.mark.parametrize("top_k", [None, 2])
+@pytest.mark.parametrize("mdmc_reduce", ["global", "samplewise"])
+@pytest.mark.parametrize("reduce", ["micro", "macro", "samples"])
+@pytest.mark.parametrize("case,fix", _MDMC_CASES)
+def test_stat_scores_mdmc_grid(case, fix, reduce, mdmc_reduce, top_k, ignore_index):
+    if top_k is not None and case == "mdmc":
+        pytest.skip("top_k needs probability inputs")
+    for i in range(fix.preds.shape[0]):
+        got = stat_scores(
+            jnp.asarray(fix.preds[i]), jnp.asarray(fix.target[i]),
+            reduce=reduce, mdmc_reduce=mdmc_reduce, top_k=top_k,
+            ignore_index=ignore_index, num_classes=NUM_CLASSES,
+        )
+        want = _np_stat_scores(fix.preds[i], fix.target[i], reduce, mdmc_reduce, top_k, ignore_index)
+        np.testing.assert_array_equal(np.asarray(got), want, err_msg=f"{case} {reduce} {mdmc_reduce}")
+
+
+@pytest.mark.parametrize("ddp", [False, True])
+@pytest.mark.parametrize("reduce", ["micro", "macro"])
+def test_stat_scores_class_ddp(ddp, reduce):
+    """Class StatScores: summed counts across batches and ranks."""
+    fix = _input_multiclass_prob
+    _t.run_class_metric_test(
+        ddp=ddp,
+        preds=fix.preds,
+        target=fix.target,
+        metric_class=StatScores,
+        sk_metric=lambda p, t: _np_stat_scores(p, t, reduce, None, 2, 1),
+        metric_args={"reduce": reduce, "top_k": 2, "ignore_index": 1, "num_classes": NUM_CLASSES},
+    )
+
+
+# --------------------------------------------------------------------------- #
+# Accuracy: subset_accuracy × mdmc × top_k cells (reference test_accuracy.py)
+# --------------------------------------------------------------------------- #
+def _np_accuracy_topk(preds_prob, target, k):
+    """Sample counts as correct when the target class is in the top-k."""
+    top = np.argsort(-preds_prob, axis=-1, kind="stable")[..., :k]
+    return float(np.mean((top == target[..., None]).any(-1)))
+
+
+@pytest.mark.parametrize("top_k", [1, 2, 3])
+def test_accuracy_topk_vs_oracle(top_k):
+    fix = _input_multiclass_prob
+    for i in range(fix.preds.shape[0]):
+        got = accuracy(jnp.asarray(fix.preds[i]), jnp.asarray(fix.target[i]), top_k=top_k)
+        want = _np_accuracy_topk(fix.preds[i], fix.target[i], top_k)
+        np.testing.assert_allclose(float(got), want, atol=1e-6)
+
+
+@pytest.mark.parametrize("mdmc_average", ["global", "samplewise"])
+@pytest.mark.parametrize("subset", [False, True])
+def test_accuracy_mdmc_subset_cells(mdmc_average, subset):
+    """subset_accuracy on mdmc inputs: a sample (= one outer row with
+    ``samplewise``; one inner element with ``global``) is correct iff ALL its
+    element predictions match."""
+    fix = _input_multidim_multiclass
+    for i in range(fix.preds.shape[0]):
+        p, t = fix.preds[i], fix.target[i]
+        got = float(
+            accuracy(
+                jnp.asarray(p), jnp.asarray(t),
+                mdmc_average=mdmc_average, subset_accuracy=subset, num_classes=NUM_CLASSES,
+            )
+        )
+        if subset:
+            # reference semantics: subset accuracy over mdmc treats the extra
+            # dim jointly — every element of the sample must match
+            want = float(np.mean((p == t).all(axis=-1)))
+        elif mdmc_average == "global":
+            want = float(np.mean(p == t))
+        else:
+            want = float(np.mean((p == t).mean(axis=-1)))
+        np.testing.assert_allclose(got, want, atol=1e-6, err_msg=f"{mdmc_average} subset={subset}")
+
+
+@pytest.mark.parametrize("ddp", [False, True])
+@pytest.mark.parametrize("subset", [False, True])
+def test_accuracy_multilabel_subset_class_ddp(ddp, subset):
+    """Multilabel (threshold) accuracy, exact-match vs per-label, under ddp."""
+    fix = _input_multilabel_prob
+
+    def oracle(p, t):
+        hard = (p >= THRESHOLD).astype(np.int64)
+        if subset:
+            return float(np.mean((hard == t).all(axis=-1)))
+        return float(np.mean(hard == t))
+
+    _t.run_class_metric_test(
+        ddp=ddp,
+        preds=fix.preds,
+        target=fix.target,
+        metric_class=Accuracy,
+        sk_metric=oracle,
+        metric_args={"subset_accuracy": subset, "threshold": THRESHOLD},
+    )

@@ -27,6 +27,7 @@ Run:  METRICS_TPU_WEIGHTS_DIR=/path/to/ckpts python -m pytest tests/weights -v
 """
 from __future__ import annotations
 
+import functools
 import glob
 import os
 
@@ -70,6 +71,7 @@ def _require(path: str | None, what: str) -> str:
 # --------------------------------------------------------------------------- #
 # FID InceptionV3 (pt_inception-2015-12-05)
 # --------------------------------------------------------------------------- #
+@functools.lru_cache(maxsize=1)  # both FID tests share one checkpoint load
 def _real_inception():
     torch = pytest.importorskip("torch")
     path = _require(_find("pt_inception*.pth", "*inception*2015*.pth"), "FID Inception")
