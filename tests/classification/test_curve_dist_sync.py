@@ -1,0 +1,206 @@
+"""Curve-family metrics under ``dist_sync_on_step`` / step-sync in a collective context.
+
+Reference analog: tests/helpers/testers.py:131-171 runs every metric —
+including cat-state curve metrics — with dist_sync_on_step=[False, True].
+This framework splits the curve family deliberately:
+
+- Binned* curves (sum states) are fixed-shape and run fully inside compiled
+  programs — dist_sync_on_step is a psum of the TP/FP/FN grids and forward
+  returns the cross-device batch value.
+- Exact curves (cat states) have data-dependent output shapes, so compute —
+  and therefore forward — is eager-only by design (utils/checks.py guard).
+  Their step-sync story inside a compiled program is a buffered
+  ``update_state`` + ``sync_states`` all_gather (parallel/sync.py:120-125),
+  with compute outside the jit boundary. Both halves are tested here.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+from sklearn.metrics import average_precision_score as sk_ap
+from sklearn.metrics import roc_auc_score as sk_roc_auc
+
+from metrics_tpu import AUROC, AveragePrecision, BinnedAveragePrecision, BinnedPrecisionRecallCurve
+from metrics_tpu.parallel.sync import sync_axes
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+WORLD = 8
+N = 24  # samples per device
+
+
+@pytest.fixture()
+def mesh():
+    devices = jax.devices()
+    if len(devices) < WORLD:
+        pytest.skip("needs 8 devices")
+    return Mesh(np.asarray(devices[:WORLD]), ("data",))
+
+
+def _binary_inputs(seed=11):
+    rng = np.random.default_rng(seed)
+    preds = rng.random((WORLD, N)).astype(np.float32)
+    # force both classes on every device so per-device sklearn oracles exist
+    target = rng.integers(0, 2, (WORLD, N))
+    target[:, 0], target[:, 1] = 0, 1
+    return jnp.asarray(preds), jnp.asarray(target)
+
+
+def _run_forward(mesh, metric, preds, target):
+    """One forward() per device inside shard_map; returns (WORLD,) of batch values."""
+
+    def body(p, t):
+        with sync_axes("data"):
+            val = metric(p.reshape(-1, *p.shape[2:]), t.reshape(-1))
+        return jnp.expand_dims(jnp.asarray(val), 0)
+
+    return np.asarray(
+        jax.jit(
+            jax.shard_map(
+                body, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P("data"), check_vma=False
+            )
+        )(preds, target)
+    )
+
+
+@pytest.mark.parametrize("sync_step", [False, True], ids=["local", "dist_sync_on_step"])
+def test_binned_ap_forward_scope(mesh, sync_step):
+    """Binned (sum-state) curve under step sync: oracle = a fresh single-device
+    metric fed the global (resp. local) batch — exact, since the threshold grid
+    and psum of counts commute."""
+    preds, target = _binary_inputs(seed=23)
+    out = _run_forward(
+        mesh,
+        BinnedAveragePrecision(num_classes=1, thresholds=25, dist_sync_on_step=sync_step),
+        preds,
+        target,
+    )
+
+    def oracle(p, t):
+        m = BinnedAveragePrecision(num_classes=1, thresholds=25)
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        return float(m.compute())
+
+    p_np, t_np = np.asarray(preds), np.asarray(target)
+    if sync_step:
+        expected = np.full(WORLD, oracle(p_np.reshape(-1), t_np.reshape(-1)))
+    else:
+        expected = np.asarray([oracle(p_np[i], t_np[i]) for i in range(WORLD)])
+    np.testing.assert_allclose(out, expected, atol=1e-6)
+
+
+@pytest.mark.parametrize("sync_step", [False, True], ids=["local", "dist_sync_on_step"])
+def test_binned_pr_curve_forward_scope(mesh, sync_step):
+    """Full curve output (tuple state) through forward under step sync."""
+    preds, target = _binary_inputs(seed=31)
+
+    metric = BinnedPrecisionRecallCurve(num_classes=1, thresholds=11, dist_sync_on_step=sync_step)
+
+    def body(p, t):
+        with sync_axes("data"):
+            prec, rec, thr = metric(p.reshape(-1), t.reshape(-1))
+        return prec[None], rec[None], thr[None]
+
+    prec, rec, _ = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P("data"), check_vma=False
+        )
+    )(preds, target)
+    prec, rec = np.asarray(prec), np.asarray(rec)
+
+    def oracle(p, t):
+        m = BinnedPrecisionRecallCurve(num_classes=1, thresholds=11)
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        pr, rc, _ = m.compute()
+        return np.asarray(pr), np.asarray(rc)
+
+    p_np, t_np = np.asarray(preds), np.asarray(target)
+    if sync_step:
+        e_prec, e_rec = oracle(p_np.reshape(-1), t_np.reshape(-1))
+        for i in range(WORLD):
+            np.testing.assert_allclose(prec[i], e_prec, atol=1e-6)
+            np.testing.assert_allclose(rec[i], e_rec, atol=1e-6)
+    else:
+        for i in range(WORLD):
+            e_prec, e_rec = oracle(p_np[i], t_np[i])
+            np.testing.assert_allclose(prec[i], e_prec, atol=1e-6)
+            np.testing.assert_allclose(rec[i], e_rec, atol=1e-6)
+
+
+def test_binned_ap_epoch_state_unaffected_by_step_sync(mesh):
+    """dist_sync_on_step must not change the accumulated epoch value."""
+    preds, target = _binary_inputs(seed=29)
+    results = {}
+    for sync_step in (False, True):
+        m = BinnedAveragePrecision(num_classes=1, thresholds=25, dist_sync_on_step=sync_step)
+
+        def body(p, t):
+            with sync_axes("data"):
+                _ = m(p.reshape(-1), t.reshape(-1))
+                state = m.sync_states(m.get_state(), "data")
+                out = m.compute_state(state)
+            return jnp.expand_dims(jnp.asarray(out), 0)
+
+        out = np.asarray(
+            jax.jit(
+                jax.shard_map(
+                    body, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P("data"), check_vma=False
+                )
+            )(preds, target)
+        )
+        results[sync_step] = out
+    np.testing.assert_allclose(results[False], results[True], atol=1e-7)
+
+
+@pytest.mark.parametrize(
+    "metric_cls, sk_fn",
+    [(AUROC, sk_roc_auc), (AveragePrecision, sk_ap)],
+    ids=["auroc", "average_precision"],
+)
+def test_exact_curve_buffered_gather_sync(mesh, metric_cls, sk_fn):
+    """Exact-curve step sync inside a compiled program: buffered update +
+    all_gather of the sample buffers, compute eagerly outside. The gathered
+    (global) value must match sklearn on the concatenated batch; the
+    unsynced per-device values must match per-device sklearn."""
+    preds, target = _binary_inputs(seed=37)
+    metric = metric_cls(pos_label=1, buffer_capacity=WORLD * N)
+
+    def body(p, t, sync):
+        with sync_axes("data"):
+            state = metric.update_state(metric.init_state(), p.reshape(-1), t.reshape(-1))
+            if sync:
+                state = metric.sync_states(state, "data")
+        return state
+
+    p_np, t_np = np.asarray(preds), np.asarray(target)
+
+    # unsynced: per-device states out, computed eagerly per device
+    states = jax.jit(
+        jax.shard_map(
+            lambda p, t: jax.tree.map(lambda x: x[None] if hasattr(x, "ndim") else x,
+                                      body(p, t, False)),
+            mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P("data"), check_vma=False,
+        )
+    )(preds, target)
+    for i in range(WORLD):
+        local = jax.tree.map(lambda x: x[i] if hasattr(x, "ndim") else x, states)
+        got = float(metric.compute_state(local))
+        np.testing.assert_allclose(got, sk_fn(t_np[i], p_np[i]), atol=1e-6)
+
+    # synced: gathered buffers are identical on every device; take device 0's
+    synced = jax.jit(
+        jax.shard_map(
+            lambda p, t: body(p, t, True),
+            mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False,
+        )
+    )(preds, target)
+    got = float(metric.compute_state(synced))
+    np.testing.assert_allclose(got, sk_fn(t_np.reshape(-1), p_np.reshape(-1)), atol=1e-6)
+
+
+def test_exact_curve_forward_in_jit_raises_actionable(mesh):
+    """The design guard: exact-curve forward under jit must fail with the
+    actionable message pointing at Binned* variants, not an opaque tracer error."""
+    preds, target = _binary_inputs()
+    with pytest.raises(MetricsUserError, match="Binned"):
+        _run_forward(mesh, AUROC(pos_label=1, dist_sync_on_step=True), preds, target)

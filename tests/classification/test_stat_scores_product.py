@@ -152,9 +152,10 @@ def test_accuracy_topk_vs_oracle(top_k):
 @pytest.mark.parametrize("mdmc_average", ["global", "samplewise"])
 @pytest.mark.parametrize("subset", [False, True])
 def test_accuracy_mdmc_subset_cells(mdmc_average, subset):
-    """subset_accuracy on mdmc inputs: a sample (= one outer row with
-    ``samplewise``; one inner element with ``global``) is correct iff ALL its
-    element predictions match."""
+    """subset_accuracy on mdmc inputs: a sample is one OUTER row under both
+    mdmc_average values (subset_accuracy treats the extra dim jointly, so the
+    reference yields the same all-elements-match row score either way), and it
+    is correct iff ALL its element predictions match."""
     fix = _input_multidim_multiclass
     for i in range(fix.preds.shape[0]):
         p, t = fix.preds[i], fix.target[i]

@@ -4,18 +4,17 @@ Closes the remaining grid cells the reference covers through its ddp
 parametrization of wrapper tests (tests/wrappers/* with testers.py:398-439):
 a *buffered* cat-state child (``buffer_capacity`` turns the unbounded list
 state into a fixed-capacity jittable CatBuffer) flowing through every wrapper
-under the world merge, and curve-family (cat-state) metrics computing their
-forward batch value across ranks when ``dist_sync_on_step=True``.
+under the world merge, plus the cat-state sync==merge equivalence that stands
+in for ``dist_sync_on_step`` on eager-compute curve metrics. Curve forward
+under ``dist_sync_on_step`` is owned by
+tests/classification/test_curve_dist_sync.py.
 """
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh, PartitionSpec as P
 from sklearn.metrics import average_precision_score, roc_auc_score
 
 import metrics_tpu as M
-from metrics_tpu.parallel.sync import sync_axes
 from tests.helpers.testers import merge_world
 
 WORLD = 4
@@ -53,6 +52,7 @@ def test_minmax_buffered_child_ddp(cap):
     np.testing.assert_allclose(float(got["raw"]), _SK_AUROC_ALL, atol=1e-6)
     # one lifetime value -> min == max == raw
     np.testing.assert_allclose(float(got["min"]), float(got["max"]), atol=1e-6)
+    np.testing.assert_allclose(float(got["min"]), float(got["raw"]), atol=1e-6)
 
 
 @pytest.mark.parametrize("cap", CAPS, ids=["list", "cap8", "cap64"])
@@ -111,54 +111,9 @@ def test_bootstrap_buffered_child_ddp(cap):
     np.testing.assert_allclose(float(got["std"]), raw.std(ddof=1), atol=1e-6)
 
 
-# --------------------------------------------------------------------------- #
-# cat-state metrics under dist_sync_on_step: the forward batch value must be
-# computed from the ALL-ranks batch (gathered fixed-capacity buffers inside
-# the compiled program)
-# --------------------------------------------------------------------------- #
-@pytest.fixture()
-def mesh():
-    devices = jax.devices()
-    if len(devices) < WORLD:
-        pytest.skip(f"needs {WORLD} devices")
-    return Mesh(np.asarray(devices[:WORLD]), ("data",))
-
-
-@pytest.mark.parametrize("sync_step", [False, True], ids=["local", "dist_sync_on_step"])
-def test_binned_curve_dist_sync_on_step(mesh, sync_step):
-    """Binned (compiled-path) curve metric inside shard_map: with
-    dist_sync_on_step the forward batch value must come from ALL ranks'
-    threshold counts; without, each device scores its own shard. The oracle
-    is the same metric run single-device on the corresponding data."""
-    per_dev = N // WORLD
-    T = 25
-    m = M.BinnedAveragePrecision(num_classes=1, thresholds=T, dist_sync_on_step=sync_step)
-
-    def body(p, t):
-        with sync_axes("data"):
-            val = m(p[0], t[0])  # forward: batch value (+ local accumulation)
-        return jnp.expand_dims(jnp.asarray(val), 0)
-
-    preds = jnp.asarray(_SCORES.reshape(WORLD, per_dev))
-    target = jnp.asarray(_LABELS.reshape(WORLD, per_dev))
-    out = np.asarray(
-        jax.jit(
-            jax.shard_map(body, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P("data"), check_vma=False)
-        )(preds, target)
-    )
-
-    def single(p, t):
-        ref = M.BinnedAveragePrecision(num_classes=1, thresholds=T)
-        ref.update(jnp.asarray(p), jnp.asarray(t))
-        return float(ref.compute())
-
-    if sync_step:
-        want = np.full(WORLD, single(_SCORES, _LABELS))
-    else:
-        want = np.asarray([single(np.asarray(preds[d]), np.asarray(target[d])) for d in range(WORLD)])
-    np.testing.assert_allclose(out, want, atol=1e-6)
-
-
+# binned-curve forward under dist_sync_on_step lives in
+# tests/classification/test_curve_dist_sync.py (single owner of that cell);
+# this file keeps only the buffer_capacity-specific cross below.
 @pytest.mark.parametrize("cap", [None, 16], ids=["list", "cap16"])
 @pytest.mark.parametrize(
     "metric_cls,sk_fn",
