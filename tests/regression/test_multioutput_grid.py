@@ -1,0 +1,126 @@
+"""Multioutput option grid for moment-based regression metrics.
+
+Reference analog: tests/regression/test_explained_variance.py:30-76 and
+tests/regression/test_r2.py:36-92 sweep multioutput ∈ {raw_values,
+uniform_average, variance_weighted} (× adjusted for R2) × ddp against the
+sklearn oracles on (N, d) outputs; tests/regression/test_mean_error.py
+parametrizes the error family over input views. Same cells here on the
+8-device CPU mesh world merge.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import explained_variance_score, mean_squared_error as sk_mse, r2_score as sk_r2
+
+from metrics_tpu import ExplainedVariance, MeanSquaredError, R2Score, TweedieDevianceScore
+from metrics_tpu.functional import explained_variance, r2_score
+from tests.helpers.testers import MetricTester
+
+NB, BS, D = 8, 32, 3
+_rng = np.random.default_rng(321)
+_preds = _rng.standard_normal((NB, BS, D)).astype(np.float32)
+# correlate target with preds so variance_weighted/raw_values differ meaningfully
+_target = (0.7 * _preds + 0.3 * _rng.standard_normal((NB, BS, D))).astype(np.float32)
+
+MULTIOUTPUT = ["raw_values", "uniform_average", "variance_weighted"]
+
+
+@pytest.mark.parametrize("ddp", [False, True])
+@pytest.mark.parametrize("multioutput", MULTIOUTPUT)
+def test_explained_variance_multioutput(ddp, multioutput):
+    MetricTester().run_class_metric_test(
+        ddp=ddp,
+        preds=_preds,
+        target=_target,
+        metric_class=ExplainedVariance,
+        sk_metric=lambda p, t: explained_variance_score(t, p, multioutput=multioutput),
+        metric_args={"multioutput": multioutput},
+        check_batch=False,
+    )
+
+
+@pytest.mark.parametrize("ddp", [False, True])
+@pytest.mark.parametrize("adjusted", [0, 5])
+@pytest.mark.parametrize("multioutput", MULTIOUTPUT)
+def test_r2_multioutput_adjusted(ddp, multioutput, adjusted):
+    if adjusted and multioutput == "raw_values":
+        pytest.skip("adjusted R2 is a scalar correction; raw_values keeps per-output values")
+
+    def sk(p, t):
+        r2 = sk_r2(t, p, multioutput=multioutput)
+        if adjusted:
+            n = t.shape[0]
+            r2 = 1 - (1 - r2) * (n - 1) / (n - adjusted - 1)
+        return r2
+
+    MetricTester().run_class_metric_test(
+        ddp=ddp,
+        preds=_preds,
+        target=_target,
+        metric_class=R2Score,
+        sk_metric=sk,
+        metric_args={"num_outputs": D, "adjusted": adjusted, "multioutput": multioutput},
+        check_batch=False,
+    )
+
+
+@pytest.mark.parametrize("multioutput", MULTIOUTPUT)
+def test_functional_multioutput_parity(multioutput):
+    p, t = _preds.reshape(-1, D), _target.reshape(-1, D)
+    np.testing.assert_allclose(
+        np.asarray(explained_variance(jnp.asarray(p), jnp.asarray(t), multioutput=multioutput)),
+        explained_variance_score(t, p, multioutput=multioutput),
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(r2_score(jnp.asarray(p), jnp.asarray(t), multioutput=multioutput)),
+        sk_r2(t, p, multioutput=multioutput),
+        atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("ddp", [False, True])
+@pytest.mark.parametrize("squared", [True, False])
+def test_mse_num_outputs(ddp, squared):
+    """Per-output MSE/RMSE state ((d,) sums) through the world merge."""
+
+    def sk(p, t):
+        val = sk_mse(t.reshape(-1, D), p.reshape(-1, D), multioutput="raw_values")
+        return val if squared else np.sqrt(val)
+
+    MetricTester().run_class_metric_test(
+        ddp=ddp,
+        preds=_preds,
+        target=_target,
+        metric_class=MeanSquaredError,
+        sk_metric=sk,
+        metric_args={"squared": squared, "num_outputs": D},
+        check_batch=False,
+    )
+
+
+def test_r2_raw_values_matches_per_output_scalars():
+    """raw_values == stacking d independent single-output R2 scores."""
+    p, t = _preds.reshape(-1, D), _target.reshape(-1, D)
+    raw = np.asarray(r2_score(jnp.asarray(p), jnp.asarray(t), multioutput="raw_values"))
+    per = [float(r2_score(jnp.asarray(p[:, j]), jnp.asarray(t[:, j]))) for j in range(D)]
+    np.testing.assert_allclose(raw, per, atol=1e-5)
+
+
+@pytest.mark.parametrize("power", [0.25, 0.5, 0.75])
+def test_tweedie_invalid_power_raises(power):
+    """Deviance is undefined for 0 < power < 1 (reference raises there; negative
+    powers are legal extreme-stable cases)."""
+    with pytest.raises(ValueError):
+        m = TweedieDevianceScore(power=power)
+        m.update(jnp.ones(4), jnp.ones(4))
+
+
+def test_tweedie_negative_power_parity():
+    from sklearn.metrics import mean_tweedie_deviance
+
+    p = _rng.random(64).astype(np.float64) + 0.1  # strictly positive preds
+    t = _rng.standard_normal(64).astype(np.float64)
+    m = TweedieDevianceScore(power=-1.0)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(float(m.compute()), mean_tweedie_deviance(t, p, power=-1.0), rtol=1e-4)

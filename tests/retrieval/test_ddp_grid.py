@@ -1,0 +1,65 @@
+"""Retrieval metric × ddp cross: the reference's missing axis here.
+
+Reference analog: every reference retrieval test file runs its class metric
+with ddp=[True, False] through RetrievalMetricTester
+(tests/retrieval/helpers.py:150-250). The hard property the world merge must
+preserve is that a query's documents may be scattered across ranks — the
+per-query grouping only becomes complete after the cat-state gather. Docs are
+dealt round-robin so every query spans all ranks.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu as M
+from tests.helpers.testers import merge_world
+from tests.retrieval.test_option_grid import _GRID, _fixture, _oracle
+
+WORLD = 4
+
+
+@pytest.mark.parametrize("empty_action", ["skip", "neg", "pos"])
+@pytest.mark.parametrize("with_ignore", [False, True], ids=["plain", "ignore-index"])
+@pytest.mark.parametrize("name,kwargs,per_query", _GRID, ids=[g[0] for g in _GRID])
+def test_ddp_grid_vs_numpy_oracle(name, kwargs, per_query, empty_action, with_ignore):
+    indexes, preds, target = _fixture(with_ignore, with_empty=True)
+    if name == "RetrievalFallOut":
+        target = target.copy()
+        target[indexes == 5] = 1  # fall-out degenerates on all-positive queries
+
+    ignore_index = -1 if with_ignore else None
+    ranks = []
+    for r in range(WORLD):
+        m = getattr(M, name)(empty_target_action=empty_action, ignore_index=ignore_index, **kwargs)
+        sel = slice(r, None, WORLD)  # round-robin: queries span every rank
+        m.update(jnp.asarray(preds[sel]), jnp.asarray(target[sel]), indexes=jnp.asarray(indexes[sel]))
+        ranks.append(m)
+    got = float(merge_world(ranks).compute())
+
+    want = _oracle(name, per_query, indexes, preds, target, empty_action, ignore_index)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+@pytest.mark.parametrize("name,kwargs,per_query", _GRID, ids=[g[0] for g in _GRID])
+def test_ddp_two_step_updates_match_single(name, kwargs, per_query):
+    """Two updates per rank == one update per rank == single-process, for the
+    same multiset of (index, pred, target) rows."""
+    indexes, preds, target = _fixture(with_ignore=False, with_empty=False)
+
+    def value(n_ranks, n_chunks):
+        ranks = []
+        for r in range(n_ranks):
+            m = getattr(M, name)(**kwargs)
+            rows = np.flatnonzero(np.arange(len(indexes)) % n_ranks == r)
+            for chunk in np.array_split(rows, n_chunks):
+                if chunk.size:
+                    m.update(
+                        jnp.asarray(preds[chunk]), jnp.asarray(target[chunk]), indexes=jnp.asarray(indexes[chunk])
+                    )
+            ranks.append(m)
+        return float(merge_world(ranks).compute())
+
+    single = value(1, 1)
+    np.testing.assert_allclose(value(WORLD, 1), single, atol=1e-6)
+    np.testing.assert_allclose(value(WORLD, 3), single, atol=1e-6)

@@ -15,14 +15,15 @@ import jax.numpy as jnp
 
 import metrics_tpu as M
 
-_rng = np.random.default_rng(17)
-
 N_DOCS = 96
 N_QUERIES = 7
 
 
 def _fixture(with_ignore: bool, with_empty: bool):
-    """(indexes, preds, target) with controllable pathologies."""
+    """(indexes, preds, target) with controllable pathologies. A fresh seeded
+    rng per call keeps every parametrized cell deterministic in isolation
+    (running one cell alone draws the same data as the full suite)."""
+    _rng = np.random.default_rng(17)
     indexes = np.sort(_rng.integers(0, N_QUERIES, N_DOCS))
     preds = _rng.random(N_DOCS).astype(np.float32)
     target = _rng.integers(0, 2, N_DOCS)
