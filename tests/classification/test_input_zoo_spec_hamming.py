@@ -1,0 +1,88 @@
+"""Specificity + HammingDistance parity over the FULL input-type zoo.
+
+Extends the zoo treatment (tests/classification/test_input_zoo_prf.py) to the
+two remaining stat-scores consumers the reference sweeps through its full
+input grid: Specificity (tests/classification/test_specificity.py) and
+HammingDistance (tests/classification/test_hamming_distance.py), both built
+on tests/classification/inputs.py:25-80. Oracles come from the canonical
+(N, C) indicator lift, same strategy as the PRF zoo.
+"""
+import numpy as np
+import pytest
+
+from metrics_tpu.classification import HammingDistance, Specificity
+from tests.classification.inputs import _input_binary_prob, _input_multilabel_prob
+from tests.classification.test_input_zoo_prf import ZOO, _canonical
+from tests.helpers.testers import THRESHOLD, MetricTester
+
+
+def _sk_specificity_micro(preds, target):
+    """TN / (TN + FP) over the canonical indicator totals."""
+    c_preds, c_target = _canonical(preds, target)
+    tn = float(((c_preds == 0) & (c_target == 0)).sum())
+    fp = float(((c_preds == 1) & (c_target == 0)).sum())
+    return tn / max(tn + fp, 1.0)
+
+
+def _sk_hamming(preds, target):
+    """Fraction of disagreeing indicator cells (reference hamming.py:23)."""
+    c_preds, c_target = _canonical(preds, target)
+    return float((c_preds != c_target).mean())
+
+
+@pytest.mark.parametrize("case,inputs,num_classes", ZOO, ids=[z[0] for z in ZOO])
+class TestSpecificityHammingZoo(MetricTester):
+    def test_specificity_micro(self, case, inputs, num_classes):
+        self.run_class_metric_test(
+            ddp=False,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=Specificity,
+            sk_metric=_sk_specificity_micro,
+            metric_args={
+                "average": "micro",
+                "mdmc_average": "global",
+                "threshold": THRESHOLD,
+                "num_classes": num_classes,
+            },
+        )
+
+    def test_hamming_distance(self, case, inputs, num_classes):
+        self.run_class_metric_test(
+            ddp=False,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=HammingDistance,
+            sk_metric=_sk_hamming,
+            metric_args={"threshold": THRESHOLD},
+        )
+
+
+@pytest.mark.parametrize(
+    "metric_class,sk_fn,args",
+    [
+        (Specificity, _sk_specificity_micro, {"average": "micro", "mdmc_average": "global", "threshold": THRESHOLD}),
+        (HammingDistance, _sk_hamming, {"threshold": THRESHOLD}),
+    ],
+    ids=["specificity", "hamming"],
+)
+@pytest.mark.parametrize(
+    "inputs,num_classes",
+    [(_input_binary_prob, 1), (_input_multilabel_prob, 5)],
+    ids=["binary_prob", "multilabel_prob"],
+)
+def test_zoo_ddp(metric_class, sk_fn, args, inputs, num_classes):
+    """Sum-state metrics through the real collective path. Prob inputs only:
+    HammingDistance has no num_classes (reference parity), so label inputs
+    cannot be canonicalized under jit tracing — the class count must come
+    from the trailing input dim."""
+    if metric_class is Specificity:
+        args = {**args, "num_classes": num_classes}
+    MetricTester().run_class_metric_test(
+        ddp=True,
+        preds=inputs.preds,
+        target=inputs.target,
+        metric_class=metric_class,
+        sk_metric=sk_fn,
+        metric_args=args,
+    )
