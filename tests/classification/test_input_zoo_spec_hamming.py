@@ -12,16 +12,14 @@ import pytest
 
 from metrics_tpu.classification import HammingDistance, Specificity
 from tests.classification.inputs import _input_binary_prob, _input_multilabel_prob
-from tests.classification.test_input_zoo_prf import ZOO, _canonical
+from tests.classification.test_input_zoo_prf import ZOO, _canonical, _sk_stat_scores_micro
 from tests.helpers.testers import THRESHOLD, MetricTester
 
 
 def _sk_specificity_micro(preds, target):
-    """TN / (TN + FP) over the canonical indicator totals."""
-    c_preds, c_target = _canonical(preds, target)
-    tn = float(((c_preds == 0) & (c_target == 0)).sum())
-    fp = float(((c_preds == 1) & (c_target == 0)).sum())
-    return tn / max(tn + fp, 1.0)
+    """TN / (TN + FP), derived from the PRF zoo's shared indicator counts."""
+    tp, fp, tn, fn, _ = _sk_stat_scores_micro(preds, target)
+    return float(tn) / max(float(tn + fp), 1.0)
 
 
 def _sk_hamming(preds, target):

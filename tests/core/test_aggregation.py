@@ -83,3 +83,65 @@ def test_nan_in_weight_error():
     m = MeanMetric(nan_strategy="error")
     with pytest.raises(RuntimeError, match="nan"):
         m.update(jnp.asarray([1.0]), weight=jnp.asarray([float("nan")]))
+
+
+# ---- nan_strategy x aggregator product (reference test_aggregation.py:33-94) --
+_NAN_VEC = [1.0, float("nan"), 3.0]
+
+
+# impute value 10.0 is outside [1, 3] so every aggregator's impute result
+# differs from its ignore result — a drop-instead-of-impute regression fails
+@pytest.mark.parametrize(
+    "cls,ignore_expected,impute_expected",
+    [
+        (SumMetric, 4.0, 14.0),
+        (MeanMetric, 2.0, 14.0 / 3),
+        (MaxMetric, 3.0, 10.0),
+        (MinMetric, 1.0, 1.0),          # min insensitive to a high impute; covered by max
+        (CatMetric, [1.0, 3.0], [1.0, 10.0, 3.0]),
+    ],
+    ids=["sum", "mean", "max", "min", "cat"],
+)
+@pytest.mark.parametrize("strategy", ["error", "warn", "ignore", 10.0], ids=str)
+def test_nan_strategy_product(cls, ignore_expected, impute_expected, strategy):
+    """Every aggregator x every nan policy on a nan-bearing vector: error
+    raises; warn warns AND removes (reference aggregation.py:75-77 — warn is
+    ignore plus the warning); ignore silently drops; float imputes."""
+    m = cls(nan_strategy=strategy)
+    if strategy == "error":
+        with pytest.raises(RuntimeError, match="nan"):
+            m.update(jnp.asarray(_NAN_VEC))
+        return
+    if strategy == "warn":
+        with pytest.warns(UserWarning, match="[Nn]a[Nn]"):
+            m.update(jnp.asarray(_NAN_VEC))
+        np.testing.assert_allclose(np.asarray(m.compute()), ignore_expected, atol=1e-6)
+        return
+    m.update(jnp.asarray(_NAN_VEC))
+    want = ignore_expected if strategy == "ignore" else impute_expected
+    np.testing.assert_allclose(np.asarray(m.compute()), want, atol=1e-6)
+
+
+@pytest.mark.parametrize("cls", [SumMetric, MeanMetric, MaxMetric, MinMetric], ids=["sum", "mean", "max", "min"])
+def test_scalar_nan_update_ignored(cls):
+    """A pure-nan scalar update under 'ignore' must leave the state unchanged."""
+    m = cls(nan_strategy="ignore")
+    m.update(jnp.asarray(2.0))
+    m.update(jnp.asarray(float("nan")))
+    m.update(jnp.asarray(4.0))
+    want = {SumMetric: 6.0, MeanMetric: 3.0, MaxMetric: 4.0, MinMetric: 2.0}[cls]
+    assert float(m.compute()) == pytest.approx(want)
+
+
+def test_aggregator_ddp_world_merge():
+    """Aggregator states across ranks fold with their own reductions."""
+    from tests.helpers.testers import merge_world
+
+    vals = np.arange(1.0, 9.0)
+    for cls, want in [(SumMetric, vals.sum()), (MeanMetric, vals.mean()), (MaxMetric, 8.0), (MinMetric, 1.0)]:
+        ranks = []
+        for r in range(4):
+            m = cls()
+            m.update(jnp.asarray(vals[r::4]))
+            ranks.append(m)
+        assert float(merge_world(ranks).compute()) == pytest.approx(float(want)), cls.__name__
