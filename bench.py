@@ -47,6 +47,30 @@ import traceback
 
 import numpy as np
 
+
+def _xla_cache_dir() -> str:
+    """Persistent-compile-cache dir keyed by host CPU identity.
+
+    XLA's CPU AOT cache entries record the compile machine's feature set; on
+    a different host they load with 'could lead to execution errors such as
+    SIGILL' errors (observed when the cache dir survived a round boundary
+    onto new hardware). Keying the dir by a hash of the CPU feature flags
+    keeps reuse on the same host and isolation across hosts."""
+    import hashlib
+    import platform
+
+    key = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    key += line
+                    break
+    except OSError:
+        key += platform.processor() or ""
+    tag = hashlib.sha1(key.encode()).hexdigest()[:12]
+    return os.path.expanduser(f"~/.cache/metrics_tpu_xla_{tag}")
+
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 NUM_CLASSES = 1000
@@ -995,7 +1019,7 @@ def main() -> None:
         if os.environ.get("BENCH_FORCE_CPU"):
             jax.config.update("jax_platforms", "cpu")
         try:  # share the parent's persistent compile cache
-            jax.config.update("jax_compilation_cache_dir", os.path.expanduser("~/.cache/metrics_tpu_xla"))
+            jax.config.update("jax_compilation_cache_dir", _xla_cache_dir())
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         except Exception:
             pass
@@ -1083,7 +1107,7 @@ def main() -> None:
     try:
         # persistent compile cache: repeated bench runs (and the driver's)
         # skip recompilation of the big programs (inception, matcher, sweeps)
-        jax.config.update("jax_compilation_cache_dir", os.path.expanduser("~/.cache/metrics_tpu_xla"))
+        jax.config.update("jax_compilation_cache_dir", _xla_cache_dir())
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass

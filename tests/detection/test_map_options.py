@@ -1,0 +1,95 @@
+"""MeanAveragePrecision threshold-option grid.
+
+Reference analog: detection/mean_ap.py:199 constructor options
+(iou_thresholds, rec_thresholds, max_detection_thresholds, box_format).
+The reference test suite exercises these through tests/detection/test_map.py's
+pycocotools comparisons; here custom threshold lists are pinned by internal
+consistency against the default-grid results (single-threshold runs must
+reproduce map_50/map_75 exactly; mAP is monotone non-increasing in the IoU
+threshold; rec_thresholds given explicitly at the COCO grid must be a no-op).
+"""
+import numpy as np
+import pytest
+
+from metrics_tpu.detection import MeanAveragePrecision
+from tests.detection.test_map import _random_dataset
+
+
+def _value(preds, targets, key="map", **kwargs):
+    m = MeanAveragePrecision(**kwargs)
+    m.update(preds, targets)
+    return float(m.compute()[key])
+
+
+@pytest.fixture(scope="module")
+def data():
+    return _random_dataset(rng=np.random.default_rng(42))
+
+
+@pytest.mark.parametrize("thr,key", [(0.5, "map_50"), (0.75, "map_75")])
+def test_single_iou_threshold_reproduces_default_column(data, thr, key):
+    preds, targets = data
+    single = _value(preds, targets, iou_thresholds=[thr])
+    default_col = _value(preds, targets, key=key)
+    np.testing.assert_allclose(single, default_col, atol=1e-6)
+
+
+def test_map_monotone_in_iou_threshold(data):
+    preds, targets = data
+    vals = [_value(preds, targets, iou_thresholds=[t]) for t in (0.3, 0.5, 0.7, 0.9)]
+    assert all(a >= b - 1e-9 for a, b in zip(vals, vals[1:])), vals
+
+
+def test_explicit_coco_rec_thresholds_noop(data):
+    preds, targets = data
+    default = _value(preds, targets)
+    explicit = _value(preds, targets, rec_thresholds=list(np.linspace(0.0, 1.0, 101)))
+    np.testing.assert_allclose(explicit, default, atol=1e-6)
+
+
+def test_coarse_rec_thresholds_still_bounded(data):
+    preds, targets = data
+    coarse = _value(preds, targets, rec_thresholds=[0.0, 0.5, 1.0])
+    assert 0.0 <= coarse <= 1.0
+
+
+def test_max_detection_thresholds_monotone(data):
+    """mar_k is non-decreasing in k (more detections can only help recall)."""
+    preds, targets = data
+    m = MeanAveragePrecision(max_detection_thresholds=[1, 10, 100])
+    m.update(preds, targets)
+    res = {k: float(v) for k, v in m.compute().items()}
+    assert res["mar_1"] <= res["mar_10"] + 1e-9 <= res["mar_100"] + 2e-9
+
+
+def test_custom_iou_grid_matches_mean_of_singles(data):
+    """A two-threshold grid averages the per-threshold AP columns."""
+    preds, targets = data
+    pair = _value(preds, targets, iou_thresholds=[0.5, 0.75])
+    singles = [_value(preds, targets, iou_thresholds=[t]) for t in (0.5, 0.75)]
+    np.testing.assert_allclose(pair, np.mean(singles), atol=1e-6)
+
+
+@pytest.mark.parametrize("fmt", ["xywh", "cxcywh"])
+def test_box_format_equivalence_full_dataset(data, fmt):
+    """Format conversion on the whole random dataset, not just one box."""
+    preds, targets = data
+
+    def convert(boxes):
+        b = np.asarray(boxes, dtype=np.float32).reshape(-1, 4)
+        x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+        w, h = x2 - x1, y2 - y1
+        if fmt == "xywh":
+            return np.stack([x1, y1, w, h], axis=1)
+        return np.stack([x1 + w / 2, y1 + h / 2, w, h], axis=1)
+
+    conv_preds = [{**p, "boxes": convert(p["boxes"])} for p in preds]
+    conv_targets = [{**t, "boxes": convert(t["boxes"])} for t in targets]
+    np.testing.assert_allclose(
+        _value(conv_preds, conv_targets, box_format=fmt), _value(preds, targets), atol=1e-6
+    )
+
+
+def test_invalid_iou_type_raises():
+    with pytest.raises(ValueError):
+        MeanAveragePrecision(iou_type="polygon")
