@@ -185,6 +185,10 @@ class MetricCollection:
 
     def __setitem__(self, key: str, metric: Metric) -> None:
         self._metrics[key] = metric
+        # update/compute iterate the fused groups, so membership must be
+        # rebuilt here too — add_metrics' trailing rebuild only covers its own
+        # batched path (redundant rebuilds are cheap: one pass over members)
+        self._rebuild_groups()
 
     def __contains__(self, key: str) -> bool:
         return key in self._metrics

@@ -209,3 +209,82 @@ def test_compute_groups_value_equivalence(compute_groups):
     if compute_groups:
         group_sizes = sorted(len(members) for members in col.compute_groups.values())
         assert group_sizes[-1] >= 3
+
+
+# ---- container-protocol surface (reference test_collections.py:205-263) ----
+def test_add_metrics_after_construction_rebuilds_groups():
+    col = MetricCollection({"a": _GroupedA()})
+    col.add_metrics({"b": _GroupedB()})
+    col.add_metrics(DummyMetricDiff())
+    assert set(col.keys(keep_base=True)) == {"a", "b", "DummyMetricDiff"}
+    # the grouped pair share an update signature -> fused after the rebuild
+    groups = {frozenset(v) for v in col.compute_groups.values()}
+    assert frozenset({"a", "b"}) in groups
+    col.update(jnp.asarray([2.0]))
+    res = col.compute()
+    assert float(res["a"]) == 2.0 and float(res["b"]) == 20.0
+
+
+def test_add_metrics_sequence_class_name_collision_raises():
+    col = MetricCollection([DummyMetricSum()])
+    with pytest.raises(ValueError, match="DummyMetricSum"):
+        col.add_metrics(DummyMetricSum())
+
+
+def test_add_metrics_dict_overwrites_like_reference():
+    """Dict adds overwrite an existing key silently (reference
+    collections.py:304-317 routes through plain __setitem__)."""
+    col = MetricCollection({"s": DummyMetricSum()})
+    col.update(jnp.asarray(5.0))
+    col.add_metrics({"s": DummyMetricSum()})
+    assert float(col.compute()["s"]) == 0.0  # fresh metric replaced the old
+
+
+def test_setitem_contains_len_iter_order():
+    col = MetricCollection({"b": DummyMetricSum(), "a": DummyMetricSum()})
+    col["c"] = DummyMetricDiff()
+    assert "c" in col and "missing" not in col
+    assert len(col) == 3
+    # insertion order preserved; iteration yields keys (reference ModuleDict)
+    assert list(col.keys(keep_base=True))[-1] == "c"
+    assert list(iter(col))[-1] == "c"
+    # a __setitem__-added metric participates in update/compute (groups rebuilt)
+    col.update(jnp.asarray(2.0))
+    res = col.compute()
+    assert set(res) == {"a", "b", "c"} and float(res["c"]) == -2.0
+
+
+def test_values_and_items_track_same_objects():
+    col = MetricCollection({"x": DummyMetricSum()})
+    (k, v), = list(col.items(keep_base=True))
+    assert k == "x" and v is list(col.values())[0]
+    v.update(jnp.asarray(3.0))
+    assert float(col.compute()["x"]) == 3.0
+
+
+def test_repr_lists_members():
+    col = MetricCollection([DummyMetricSum()], prefix="p_")
+    r = repr(col)
+    assert "MetricCollection" in r and "DummyMetricSum" in r
+
+
+def test_invalid_prefix_type_raises():
+    with pytest.raises(ValueError, match="prefix"):
+        MetricCollection([DummyMetricSum()], prefix=5)  # type: ignore[arg-type]
+
+
+def test_clone_is_independent():
+    col = MetricCollection({"s": DummyMetricSum()})
+    col.update(jnp.asarray(4.0))
+    twin = col.clone(prefix="t_")
+    twin.update(jnp.asarray(10.0))
+    assert float(col.compute()["s"]) == 4.0          # original untouched
+    assert float(twin.compute()["t_s"]) == 14.0       # clone carried state then diverged
+
+
+def test_persistent_flag_propagates():
+    col = MetricCollection({"s": DummyMetricSum()})
+    col.persistent(False)
+    assert all(not any(m._persistent.values()) for m in col.values())
+    col.persistent(True)
+    assert all(all(m._persistent.values()) for m in col.values())
