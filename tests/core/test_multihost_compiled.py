@@ -83,13 +83,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_compiled_sync_spans_processes(tmp_path):
-    child = tmp_path / "compiled_sync_child.py"
-    child.write_text(_CHILD)
+def _run_two_ranks(tmp_path, child_src: str, marker: str) -> None:
+    """Launch the child program as 2 jax.distributed processes and assert
+    each prints its success marker."""
+    child = tmp_path / "child.py"
+    child.write_text(child_src)
     port = _free_port()
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["XLA_FLAGS"] = ""  # the child sets its own 4-device flag
+    env["XLA_FLAGS"] = ""  # the child sets its own device-count flag
     env.pop("JAX_PLATFORMS", None)
     procs = [
         subprocess.Popen(
@@ -111,4 +113,64 @@ def test_compiled_sync_spans_processes(tmp_path):
                 p.wait()
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
-        assert f"COMPILED_SYNC_OK {rank}" in out
+        assert f"{marker} {rank}" in out
+
+
+def test_compiled_sync_spans_processes(tmp_path):
+    _run_two_ranks(tmp_path, _CHILD, "COMPILED_SYNC_OK")
+
+
+_CHILD_GATHER = textwrap.dedent(
+    """
+    import os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    proc_id, port = int(sys.argv[1]), sys.argv[2]
+    jax.distributed.initialize(f"localhost:{port}", num_processes=2, process_id=proc_id)
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from sklearn.metrics import roc_auc_score
+
+    from metrics_tpu import AUROC
+
+    WORLD, B = 8, 16
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    rng = np.random.default_rng(3)
+    scores_all = rng.random((WORLD, B)).astype(np.float32)
+    labels_all = rng.integers(0, 2, (WORLD, B)).astype(np.int32)
+    labels_all[:, 0], labels_all[:, 1] = 0, 1
+
+    sharding = NamedSharding(mesh, P("data"))
+    lo, hi = proc_id * 4, (proc_id + 1) * 4
+    scores = jax.make_array_from_process_local_data(sharding, scores_all[lo:hi], scores_all.shape)
+    labels = jax.make_array_from_process_local_data(sharding, labels_all[lo:hi], labels_all.shape)
+
+    # buffered cat states: the gather is a lax.all_gather crossing BOTH
+    # processes; the synced buffer is replicated, compute happens eagerly
+    # on each process afterwards (exact curves are eager-only by design)
+    m = AUROC(pos_label=1, buffer_capacity=WORLD * B)
+
+    def program(s, t):
+        # pure path: sync_states takes the axis explicitly, no ambient context
+        st = m.update_state(m.init_state(), s.reshape(-1), t.reshape(-1))
+        return m.sync_states(st, "data")
+
+    fn = jax.jit(jax.shard_map(
+        program, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False,
+    ))
+    synced = fn(scores, labels)
+    got = float(m.compute_state(jax.device_get(synced)))
+    want = roc_auc_score(labels_all.reshape(-1), scores_all.reshape(-1))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    print("GATHER_SYNC_OK", proc_id)
+    """
+)
+
+
+def test_compiled_cat_gather_spans_processes(tmp_path):
+    """Buffered cat-state all_gather across process boundaries: the synced
+    CatBuffer must hold every process's samples and compute the global AUROC."""
+    _run_two_ranks(tmp_path, _CHILD_GATHER, "GATHER_SYNC_OK")

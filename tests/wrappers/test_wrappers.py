@@ -250,3 +250,60 @@ def test_tracker_mixed_maximize_directions():
     assert steps["mse"] == 1 and steps["mae"] == 0, steps
     assert best["mse"] == pytest.approx(0.01, abs=1e-5)
     assert best["mae"] == pytest.approx(0.5, abs=1e-5)
+
+
+# ---- MultioutputWrapper option surface (reference wrappers/multioutput.py:83-115) --
+def test_multioutput_remove_nans_per_output():
+    """A NaN row is dropped only for the output where it appears."""
+    from sklearn.metrics import mean_squared_error as sk_mse
+
+    preds = np.asarray([[1.0, 10.0], [2.0, np.nan], [3.0, 30.0], [4.0, 40.0]], np.float32)
+    target = np.asarray([[1.5, 11.0], [2.5, 21.0], [np.nan, 29.0], [4.0, 40.0]], np.float32)
+    m = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    got = np.asarray(m.compute())
+    keep0 = ~np.isnan(preds[:, 0]) & ~np.isnan(target[:, 0])  # drops row 2
+    keep1 = ~np.isnan(preds[:, 1]) & ~np.isnan(target[:, 1])  # drops row 1
+    np.testing.assert_allclose(got[0], sk_mse(target[keep0, 0], preds[keep0, 0]), atol=1e-6)
+    np.testing.assert_allclose(got[1], sk_mse(target[keep1, 1], preds[keep1, 1]), atol=1e-6)
+
+
+def test_multioutput_remove_nans_disabled_propagates():
+    preds = np.asarray([[1.0, 10.0], [2.0, np.nan]], np.float32)
+    target = np.asarray([[1.0, 10.0], [2.0, 20.0]], np.float32)
+    m = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    got = np.asarray(m.compute())
+    assert got[0] == 0.0 and np.isnan(got[1])
+
+
+def test_multioutput_output_dim():
+    """Outputs along dim 0 instead of the trailing dim."""
+    preds = np.asarray([[1.0, 2.0, 3.0], [10.0, 20.0, 30.0]], np.float32)   # (2 outputs, 3 samples)
+    target = np.asarray([[1.0, 2.0, 4.0], [10.0, 22.0, 30.0]], np.float32)
+    m = MultioutputWrapper(MeanSquaredError(), num_outputs=2, output_dim=0)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    got = np.asarray(m.compute())
+    np.testing.assert_allclose(got[0], ((preds[0] - target[0]) ** 2).mean(), atol=1e-6)
+    np.testing.assert_allclose(got[1], ((preds[1] - target[1]) ** 2).mean(), atol=1e-6)
+
+
+def test_multioutput_squeeze_outputs_disabled_keeps_dim():
+    """With squeeze_outputs=False each clone sees (N, 1) slices — metrics
+    that accept 2D regression inputs must agree with the squeezed path."""
+    rng = np.random.default_rng(5)
+    preds = rng.random((8, 2)).astype(np.float32)
+    target = rng.random((8, 2)).astype(np.float32)
+    a = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    b = MultioutputWrapper(MeanSquaredError(), num_outputs=2, squeeze_outputs=False)
+    a.update(jnp.asarray(preds), jnp.asarray(target))
+    b.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(np.asarray(a.compute()), np.asarray(b.compute()), atol=1e-6)
+
+
+def test_multioutput_forward_returns_stacked_batch_values():
+    preds = np.asarray([[1.0, 10.0], [2.0, 20.0]], np.float32)
+    target = np.asarray([[1.0, 11.0], [2.0, 21.0]], np.float32)
+    m = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    out = np.asarray(m(jnp.asarray(preds), jnp.asarray(target)))
+    np.testing.assert_allclose(out, [0.0, 1.0], atol=1e-6)
