@@ -13,3 +13,35 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 NUM_DEVICES = len(jax.devices())
+
+
+def import_reference_torchmetrics():
+    """Import the reference checkout's torchmetrics (skip if unavailable).
+
+    One shared copy of the pkg_resources shim + sys.path dance used by the
+    reference-differential tests.
+    """
+    import pathlib
+    import sys
+    import types
+
+    import pytest
+
+    if not pathlib.Path("/root/reference/torchmetrics").exists():
+        pytest.skip("reference checkout unavailable")
+    pytest.importorskip("torch")
+    if "pkg_resources" not in sys.modules:  # removed from modern setuptools
+        shim = types.ModuleType("pkg_resources")
+        shim.DistributionNotFound = type("DistributionNotFound", (Exception,), {})
+
+        def get_distribution(name):
+            raise shim.DistributionNotFound(name)
+
+        shim.get_distribution = get_distribution
+        sys.modules["pkg_resources"] = shim
+    if "/root/reference" not in sys.path:
+        # APPEND: the reference has its own tests/ package that must not shadow ours
+        sys.path.append("/root/reference")
+    import torchmetrics
+
+    return torchmetrics

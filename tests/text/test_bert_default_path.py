@@ -74,22 +74,9 @@ def test_default_model_path_idf_and_layers(tiny_bert_dir):
 
 
 def _reference_torchmetrics():
-    if "/root/reference" not in sys.path:
-        sys.path.append("/root/reference")  # APPEND: the reference has its own tests/ package that must not shadow ours
-    if "pkg_resources" not in sys.modules:  # removed from modern setuptools
-        import types
+    from tests.conftest import import_reference_torchmetrics
 
-        shim = types.ModuleType("pkg_resources")
-        shim.DistributionNotFound = type("DistributionNotFound", (Exception,), {})
-
-        def get_distribution(name):
-            raise shim.DistributionNotFound(name)
-
-        shim.get_distribution = get_distribution
-        sys.modules["pkg_resources"] = shim
-    import torchmetrics
-
-    return torchmetrics
+    return import_reference_torchmetrics()
 
 
 def test_default_model_path_matches_reference(tiny_bert_dir):

@@ -146,3 +146,65 @@ def test_degenerate_inputs_stay_finite():
     ]:
         val = cls()(preds, targets)
         assert np.isfinite(float(val)), cls.__name__
+
+
+# ---- EED cost-parameter sweep (reference text/eed.py:24 kwargs) ------------
+# Cost monotonicity does NOT hold for EED (the optimal alignment path
+# switches as costs change), so the sweep is pinned differentially against
+# the reference implementation instead of against synthetic properties.
+_EED_PREDS = ["this is the prediction", "here is an other sample", "fox"]
+_EED_TARGET = ["this is the reference", "here is another one", "the quick brown fox jumps"]
+
+
+def _reference_eed_fn():
+    from tests.conftest import import_reference_torchmetrics
+
+    import_reference_torchmetrics()
+    from torchmetrics.functional.text.eed import extended_edit_distance as ref_eed
+
+    return ref_eed
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        {},
+        {"alpha": 3.0},
+        {"alpha": 0.5},
+        {"rho": 0.0},
+        {"rho": 0.9},
+        {"deletion": 1.0},
+        {"insertion": 0.2},
+        {"alpha": 4.0, "rho": 0.1, "deletion": 0.6, "insertion": 1.5},
+    ],
+    ids=lambda k: "-".join(f"{a}{v}" for a, v in k.items()) or "defaults",
+)
+def test_eed_param_grid_vs_reference(kwargs):
+    """Every cost-parameter combination must match the reference EED exactly."""
+    kwargs = {k: float(v) for k, v in kwargs.items()}
+    ours = float(M.functional.extended_edit_distance(_EED_PREDS, _EED_TARGET, **kwargs))
+    want = float(_reference_eed_fn()(_EED_PREDS, _EED_TARGET, **kwargs))
+    np.testing.assert_allclose(ours, want, atol=1e-6)
+
+
+def test_eed_sentence_level_scores_vs_reference():
+    _, ours = M.functional.extended_edit_distance(_EED_PREDS, _EED_TARGET, return_sentence_level_score=True)
+    _, want = _reference_eed_fn()(_EED_PREDS, _EED_TARGET, return_sentence_level_score=True)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray([float(w) for w in want]), atol=1e-6)
+
+
+def test_eed_class_matches_functional_with_params():
+    kwargs = dict(alpha=3.0, rho=0.2, deletion=0.5, insertion=0.8)
+    m = M.ExtendedEditDistance(**kwargs)
+    m.update(_EED_PREDS, _EED_TARGET)
+    np.testing.assert_allclose(
+        float(m.compute()),
+        float(M.functional.extended_edit_distance(_EED_PREDS, _EED_TARGET, **kwargs)),
+        atol=1e-7,
+    )
+
+
+@pytest.mark.parametrize("bad", [{"alpha": -1.0}, {"rho": -0.1}, {"deletion": -2.0}, {"insertion": -0.5}])
+def test_eed_negative_params_raise(bad):
+    with pytest.raises(ValueError):
+        M.ExtendedEditDistance(**bad)
