@@ -1,0 +1,105 @@
+"""Option surfaces pinned directly against the reference implementation.
+
+For CalibrationError (norm × n_bins) and HingeLoss (squared ×
+multiclass_mode) the repo's other tests use self-written numpy oracles;
+this module removes the self-oracle risk by asserting exact agreement with
+the reference running live on the same inputs (reference
+functional/classification/calibration_error.py, hinge.py). Uses the shared
+conftest import helper; skips when the checkout or torch is unavailable.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.ops.classification import calibration_error, hinge_loss
+from metrics_tpu.functional import accuracy as mt_accuracy, f1_score as mt_f1_score
+from tests.classification.inputs import _input_binary_prob, _input_multiclass_prob
+from tests.conftest import import_reference_torchmetrics
+
+
+def _ref():
+    import_reference_torchmetrics()
+    import torch
+    import torchmetrics.functional as F
+
+    return torch, F
+
+
+@pytest.mark.parametrize("n_bins", [5, 15, 30])
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+def test_calibration_binary_vs_reference(norm, n_bins):
+    torch, F = _ref()
+    preds, target = _input_binary_prob.preds[0], _input_binary_prob.target[0]
+    ours = float(calibration_error(jnp.asarray(preds), jnp.asarray(target), norm=norm, n_bins=n_bins))
+    want = float(
+        F.calibration_error(torch.tensor(preds), torch.tensor(target), norm=norm, n_bins=n_bins)
+    )
+    np.testing.assert_allclose(ours, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+def test_calibration_multiclass_vs_reference(norm):
+    torch, F = _ref()
+    preds, target = _input_multiclass_prob.preds[0], _input_multiclass_prob.target[0]
+    ours = float(calibration_error(jnp.asarray(preds), jnp.asarray(target), norm=norm))
+    want = float(F.calibration_error(torch.tensor(preds), torch.tensor(target), norm=norm))
+    np.testing.assert_allclose(ours, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("squared", [False, True], ids=["hinge", "squared"])
+def test_hinge_binary_vs_reference(squared):
+    torch, F = _ref()
+    rng = np.random.default_rng(8)
+    preds = rng.standard_normal(64).astype(np.float32)
+    target = rng.integers(0, 2, 64)
+    ours = float(hinge_loss(jnp.asarray(preds), jnp.asarray(target), squared=squared))
+    want = float(F.hinge_loss(torch.tensor(preds), torch.tensor(target), squared=squared))
+    np.testing.assert_allclose(ours, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["crammer-singer", "one-vs-all"])
+@pytest.mark.parametrize("squared", [False, True], ids=["hinge", "squared"])
+def test_hinge_multiclass_vs_reference(squared, mode):
+    torch, F = _ref()
+    rng = np.random.default_rng(9)
+    preds = rng.standard_normal((64, 4)).astype(np.float32)
+    target = rng.integers(0, 4, 64)
+    ours = hinge_loss(jnp.asarray(preds), jnp.asarray(target), squared=squared, multiclass_mode=mode)
+    want = F.hinge_loss(torch.tensor(preds), torch.tensor(target), squared=squared, multiclass_mode=mode)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(want), atol=1e-6)
+
+
+@pytest.mark.parametrize("ignore_index", [None, 1])
+@pytest.mark.parametrize("top_k", [None, 2])
+@pytest.mark.parametrize("mdmc_average", ["global", "samplewise"])
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+def test_f1_mdmc_cells_vs_reference(average, mdmc_average, top_k, ignore_index):
+    """Cross-validates the repo's numpy k-hot oracle: the same option cells
+    the mdmc product asserts against numpy must also match the reference."""
+    torch, F = _ref()
+    rng = np.random.default_rng(12)
+    preds = rng.dirichlet(np.ones(4), (32, 6)).astype(np.float32).transpose(0, 2, 1)  # (N, C, X)
+    target = rng.integers(0, 4, (32, 6))
+    kwargs = dict(
+        average=average, mdmc_average=mdmc_average, num_classes=4, top_k=top_k, ignore_index=ignore_index
+    )
+    ours = float(
+        mt_f1_score(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+    )
+    want = float(F.f1_score(torch.tensor(preds), torch.tensor(target), **kwargs))
+    np.testing.assert_allclose(ours, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("subset_accuracy", [False, True], ids=["plain", "subset"])
+@pytest.mark.parametrize("mdmc_average", ["global", "samplewise"])
+def test_accuracy_mdmc_cells_vs_reference(mdmc_average, subset_accuracy):
+    torch, F = _ref()
+    rng = np.random.default_rng(13)
+    preds = rng.dirichlet(np.ones(4), (32, 6)).astype(np.float32).transpose(0, 2, 1)
+    target = rng.integers(0, 4, (32, 6))
+    kwargs = dict(mdmc_average=mdmc_average, num_classes=4, subset_accuracy=subset_accuracy)
+    ours = float(
+        mt_accuracy(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+    )
+    want = float(F.accuracy(torch.tensor(preds), torch.tensor(target), **kwargs))
+    np.testing.assert_allclose(ours, want, atol=1e-6)

@@ -15,11 +15,12 @@ jax.config.update("jax_platforms", "cpu")
 NUM_DEVICES = len(jax.devices())
 
 
-def import_reference_torchmetrics():
+def import_reference_torchmetrics(allow_module_level: bool = False):
     """Import the reference checkout's torchmetrics (skip if unavailable).
 
     One shared copy of the pkg_resources shim + sys.path dance used by the
-    reference-differential tests.
+    reference-differential tests. Pass ``allow_module_level=True`` when
+    calling at import time (module-gated suites).
     """
     import pathlib
     import sys
@@ -28,7 +29,7 @@ def import_reference_torchmetrics():
     import pytest
 
     if not pathlib.Path("/root/reference/torchmetrics").exists():
-        pytest.skip("reference checkout unavailable")
+        pytest.skip("reference checkout unavailable", allow_module_level=allow_module_level)
     pytest.importorskip("torch")
     if "pkg_resources" not in sys.modules:  # removed from modern setuptools
         shim = types.ModuleType("pkg_resources")

@@ -20,20 +20,10 @@ import pytest
 
 torch = pytest.importorskip("torch")
 
+from tests.conftest import import_reference_torchmetrics  # noqa: E402
+
 REFERENCE = pathlib.Path("/root/reference/torchmetrics")
-if not REFERENCE.exists():  # pragma: no cover - environment-specific
-    pytest.skip("reference checkout unavailable", allow_module_level=True)
-
-if "pkg_resources" not in sys.modules:  # stripped from modern setuptools
-    shim = types.ModuleType("pkg_resources")
-    shim.DistributionNotFound = type("DistributionNotFound", (Exception,), {})
-
-    def _get_distribution(name):
-        raise shim.DistributionNotFound(name)
-
-    shim.get_distribution = _get_distribution
-    sys.modules["pkg_resources"] = shim
-sys.path.append("/root/reference")  # APPEND: the reference has its own tests/ package that must not shadow ours
+import_reference_torchmetrics(allow_module_level=True)  # shim + sys.path, or skip
 
 import jax.numpy as jnp  # noqa: E402
 

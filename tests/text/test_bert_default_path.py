@@ -11,10 +11,11 @@ reference implementation on them, so both frameworks score identical inputs
 with identical weights.
 """
 import os
-import sys
 
 import numpy as np
 import pytest
+
+from tests.conftest import import_reference_torchmetrics
 
 transformers = pytest.importorskip("transformers")
 
@@ -73,19 +74,13 @@ def test_default_model_path_idf_and_layers(tiny_bert_dir):
     assert out["f1"][0] == pytest.approx(1.0, abs=1e-4)
 
 
-def _reference_torchmetrics():
-    from tests.conftest import import_reference_torchmetrics
-
-    return import_reference_torchmetrics()
-
-
 def test_default_model_path_matches_reference(tiny_bert_dir):
     """Same tiny weights through both full pipelines (flax here, torch there)."""
     pytest.importorskip("torch")
     if not any(name.startswith(("pytorch_model", "model.safetensors")) for name in os.listdir(tiny_bert_dir)):
         pytest.skip("no torch-format weights saved alongside the flax ones")
     try:
-        tm = _reference_torchmetrics()
+        tm = import_reference_torchmetrics()
     except Exception as err:  # pragma: no cover - environment-specific
         pytest.skip(f"reference torchmetrics unavailable: {err}")
 
