@@ -14,15 +14,12 @@ import pytest
 from metrics_tpu.ops.classification import calibration_error, hinge_loss
 from metrics_tpu.functional import accuracy as mt_accuracy, f1_score as mt_f1_score
 from tests.classification.inputs import _input_binary_prob, _input_multiclass_prob
-from tests.conftest import import_reference_torchmetrics
 
 
 def _ref():
-    import_reference_torchmetrics()
-    import torch
-    import torchmetrics.functional as F
+    from tests.conftest import reference_functional
 
-    return torch, F
+    return reference_functional()
 
 
 @pytest.mark.parametrize("n_bins", [5, 15, 30])

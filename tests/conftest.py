@@ -46,3 +46,13 @@ def import_reference_torchmetrics(allow_module_level: bool = False):
     import torchmetrics
 
     return torchmetrics
+
+
+def reference_functional():
+    """(torch, torchmetrics.functional) from the reference checkout — the
+    shared entry point for the per-domain reference-differential suites."""
+    import_reference_torchmetrics()
+    import torch
+    import torchmetrics.functional as F
+
+    return torch, F

@@ -1,0 +1,124 @@
+"""Image option surfaces pinned directly against the reference implementation.
+
+The SSIM family's gaussian kernels, padding, and multiscale downsampling are
+the numerically fiddliest part of the image domain; the repo's other tests
+use self-written numpy oracles. This module asserts exact agreement with the
+reference functionals running live on identical inputs (reference
+functional/image/ssim.py, psnr.py, uqi.py, sam.py, ergas.py, d_lambda.py).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.functional as mtf
+
+_rng = np.random.default_rng(21)
+PREDS = _rng.random((4, 3, 32, 32)).astype(np.float32)
+TARGET = _rng.random((4, 3, 32, 32)).astype(np.float32)
+
+
+def _ref():
+    from tests.conftest import reference_functional
+
+    return reference_functional()
+
+
+@pytest.mark.parametrize("sigma", [0.8, 1.5, 2.5])
+@pytest.mark.parametrize("data_range", [1.0, 2.0])
+def test_ssim_sigma_vs_reference(sigma, data_range):
+    torch, F = _ref()
+    ours = float(
+        mtf.structural_similarity_index_measure(
+            jnp.asarray(PREDS), jnp.asarray(TARGET), sigma=sigma, data_range=data_range
+        )
+    )
+    want = float(
+        F.structural_similarity_index_measure(
+            torch.tensor(PREDS), torch.tensor(TARGET), sigma=sigma, data_range=data_range
+        )
+    )
+    np.testing.assert_allclose(ours, want, atol=1e-5)
+
+
+@pytest.mark.parametrize("kernel_size", [7, 11])
+@pytest.mark.parametrize("k1,k2", [(0.01, 0.03), (0.05, 0.1)])
+def test_ssim_kernel_k_vs_reference(kernel_size, k1, k2):
+    torch, F = _ref()
+    ours = float(
+        mtf.structural_similarity_index_measure(
+            jnp.asarray(PREDS), jnp.asarray(TARGET), kernel_size=kernel_size, k1=k1, k2=k2, data_range=1.0
+        )
+    )
+    want = float(
+        F.structural_similarity_index_measure(
+            torch.tensor(PREDS), torch.tensor(TARGET), kernel_size=kernel_size, k1=k1, k2=k2, data_range=1.0
+        )
+    )
+    np.testing.assert_allclose(ours, want, atol=1e-5)
+
+
+@pytest.mark.parametrize("normalize", [None, "relu", "simple"])
+def test_ms_ssim_vs_reference(normalize):
+    torch, F = _ref()
+    # 5 scales halve 4x; the effective gaussian kernel (11) must fit at the
+    # smallest scale, so 256 -> 16 per side is the minimum that passes the guard
+    p = _rng.random((2, 3, 256, 256)).astype(np.float32)
+    t = np.clip(p + 0.1 * _rng.standard_normal(p.shape).astype(np.float32), 0, 1)
+    ours = float(
+        mtf.multiscale_structural_similarity_index_measure(
+            jnp.asarray(p), jnp.asarray(t), data_range=1.0, normalize=normalize
+        )
+    )
+    want = float(
+        F.multiscale_structural_similarity_index_measure(
+            torch.tensor(p), torch.tensor(t), data_range=1.0, normalize=normalize
+        )
+    )
+    np.testing.assert_allclose(ours, want, atol=1e-5)
+
+
+@pytest.mark.parametrize("base", [2.0, 10.0])
+@pytest.mark.parametrize("reduction", ["elementwise_mean", "sum", "none"])
+def test_psnr_options_vs_reference(base, reduction):
+    torch, F = _ref()
+    ours = mtf.peak_signal_noise_ratio(
+        jnp.asarray(PREDS), jnp.asarray(TARGET), data_range=1.0, base=base, reduction=reduction, dim=(1, 2, 3)
+    )
+    want = F.peak_signal_noise_ratio(
+        torch.tensor(PREDS), torch.tensor(TARGET), data_range=1.0, base=base, reduction=reduction, dim=(1, 2, 3)
+    )
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(want), atol=1e-4)
+
+
+def test_uqi_vs_reference():
+    torch, F = _ref()
+    ours = float(mtf.universal_image_quality_index(jnp.asarray(PREDS), jnp.asarray(TARGET)))
+    want = float(F.universal_image_quality_index(torch.tensor(PREDS), torch.tensor(TARGET)))
+    np.testing.assert_allclose(ours, want, atol=1e-5)
+
+
+@pytest.mark.parametrize("reduction", ["elementwise_mean", "sum"])
+def test_sam_vs_reference(reduction):
+    torch, F = _ref()
+    ours = mtf.spectral_angle_mapper(jnp.asarray(PREDS), jnp.asarray(TARGET), reduction=reduction)
+    want = F.spectral_angle_mapper(torch.tensor(PREDS), torch.tensor(TARGET), reduction=reduction)
+    # rtol: 'sum' accumulates ~1k angles in f32, so agreement is relative
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(want), rtol=1e-5)
+
+
+@pytest.mark.parametrize("ratio", [2, 4])
+def test_ergas_vs_reference(ratio):
+    torch, F = _ref()
+    ours = float(mtf.error_relative_global_dimensionless_synthesis(jnp.asarray(PREDS), jnp.asarray(TARGET), ratio=ratio))
+    want = float(
+        F.error_relative_global_dimensionless_synthesis(torch.tensor(PREDS), torch.tensor(TARGET), ratio=ratio)
+    )
+    np.testing.assert_allclose(ours, want, rtol=1e-4)
+
+
+@pytest.mark.parametrize("p", [1, 3])
+def test_d_lambda_vs_reference(p):
+    torch, F = _ref()
+    ours = float(mtf.spectral_distortion_index(jnp.asarray(PREDS), jnp.asarray(TARGET), p=p))
+    want = float(F.spectral_distortion_index(torch.tensor(PREDS), torch.tensor(TARGET), p=p))
+    np.testing.assert_allclose(ours, want, atol=1e-5)

@@ -1,0 +1,51 @@
+"""Retrieval option grid pinned directly against the reference classes.
+
+The repo's option grid asserts against a self-written numpy per-query
+oracle; this module removes the self-oracle risk by running the reference
+RetrievalMetric classes live on the same (indexes, preds, target) streams
+across empty_target_action × ignore_index (reference retrieval/base.py:27,
+fall_out.py:24). Uses the shared conftest import helper.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as M
+from tests.conftest import import_reference_torchmetrics
+from tests.retrieval.test_option_grid import _K, _fixture
+
+_PAIRS = [
+    ("RetrievalMAP", "RetrievalMAP", {}),
+    ("RetrievalMRR", "RetrievalMRR", {}),
+    ("RetrievalPrecision", "RetrievalPrecision", {"k": _K}),
+    ("RetrievalRecall", "RetrievalRecall", {"k": _K}),
+    ("RetrievalHitRate", "RetrievalHitRate", {"k": _K}),
+    ("RetrievalFallOut", "RetrievalFallOut", {"k": _K}),
+    ("RetrievalRPrecision", "RetrievalRPrecision", {}),
+    ("RetrievalNormalizedDCG", "RetrievalNormalizedDCG", {"k": _K}),
+]
+
+
+@pytest.mark.parametrize("empty_action", ["skip", "neg", "pos"])
+@pytest.mark.parametrize("with_ignore", [False, True], ids=["plain", "ignore-index"])
+@pytest.mark.parametrize("ours_name,ref_name,kwargs", _PAIRS, ids=[p[0] for p in _PAIRS])
+def test_option_grid_vs_reference(ours_name, ref_name, kwargs, empty_action, with_ignore):
+    import_reference_torchmetrics()
+    import torch
+    import torchmetrics
+
+    indexes, preds, target = _fixture(with_ignore, with_empty=True)
+    if ours_name == "RetrievalFallOut":
+        target = target.copy()
+        target[indexes == 5] = 1  # fall-out degenerates on all-positive queries
+
+    ignore_index = -1 if with_ignore else None
+    ours = getattr(M, ours_name)(empty_target_action=empty_action, ignore_index=ignore_index, **kwargs)
+    ours.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+
+    ref = getattr(torchmetrics, ref_name)(
+        empty_target_action=empty_action, ignore_index=ignore_index, **kwargs
+    )
+    ref.update(torch.tensor(preds), torch.tensor(target), indexes=torch.tensor(indexes))
+
+    np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-5)
