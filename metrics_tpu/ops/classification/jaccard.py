@@ -29,7 +29,9 @@ def _jaccard_from_confmat(
     _check_arg_choice(average, "average", ("micro", "macro", "weighted", "none", None))
 
     if ignore_index is not None and 0 <= ignore_index < num_classes:
-        confmat = confmat.at[ignore_index].set(0.0)
+        # zero in the confmat's own dtype: a float scatter into an int matrix
+        # is a FutureWarning today and an error in future jax releases
+        confmat = confmat.at[ignore_index].set(jnp.zeros((), dtype=confmat.dtype))
 
     if average == "none" or average is None:
         intersection = jnp.diag(confmat)

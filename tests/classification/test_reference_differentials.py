@@ -12,7 +12,12 @@ import numpy as np
 import pytest
 
 from metrics_tpu.ops.classification import calibration_error, hinge_loss
-from metrics_tpu.functional import accuracy as mt_accuracy, f1_score as mt_f1_score
+from metrics_tpu.functional import (
+    accuracy as mt_accuracy,
+    cohen_kappa as mt_cohen_kappa,
+    f1_score as mt_f1_score,
+    jaccard_index as mt_jaccard_index,
+)
 from tests.classification.inputs import _input_binary_prob, _input_multiclass_prob
 
 
@@ -99,4 +104,38 @@ def test_accuracy_mdmc_cells_vs_reference(mdmc_average, subset_accuracy):
         mt_accuracy(jnp.asarray(preds), jnp.asarray(target), **kwargs)
     )
     want = float(F.accuracy(torch.tensor(preds), torch.tensor(target), **kwargs))
+    np.testing.assert_allclose(ours, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("absent_score", [0.0, 1.0, -1.0])
+@pytest.mark.parametrize("ignore_index", [None, 0])
+@pytest.mark.parametrize("average", ["macro", "none"])
+def test_jaccard_options_vs_reference(average, ignore_index, absent_score):
+    """ignore_index/absent_score/average surface of JaccardIndex — the
+    repo's other jaccard tests only sweep average vs sklearn."""
+    torch, F = _ref()
+    rng = np.random.default_rng(14)
+    # class 3 absent from BOTH arrays -> union == 0 -> absent_score applies
+    preds = rng.integers(0, 3, 64)
+    target = rng.integers(0, 3, 64)
+    kwargs = dict(num_classes=4, average=average, ignore_index=ignore_index, absent_score=absent_score)
+    ours = np.asarray(
+        mt_jaccard_index(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+    )
+    want = np.asarray(F.jaccard_index(torch.tensor(preds), torch.tensor(target), **kwargs))
+    np.testing.assert_allclose(ours, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+def test_cohen_kappa_weights_vs_reference(weights):
+    torch, F = _ref()
+    rng = np.random.default_rng(15)
+    preds = rng.integers(0, 5, 128)
+    target = rng.integers(0, 5, 128)
+    ours = float(
+        mt_cohen_kappa(
+            jnp.asarray(preds), jnp.asarray(target), num_classes=5, weights=weights
+        )
+    )
+    want = float(F.cohen_kappa(torch.tensor(preds), torch.tensor(target), num_classes=5, weights=weights))
     np.testing.assert_allclose(ours, want, atol=1e-6)
