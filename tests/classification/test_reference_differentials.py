@@ -1,10 +1,11 @@
-"""Option surfaces pinned directly against the reference implementation.
+"""Classification option surfaces pinned directly against the reference.
 
-For CalibrationError (norm × n_bins) and HingeLoss (squared ×
-multiclass_mode) the repo's other tests use self-written numpy oracles;
-this module removes the self-oracle risk by asserting exact agreement with
-the reference running live on the same inputs (reference
-functional/classification/calibration_error.py, hinge.py). Uses the shared
+Where the repo's other tests use self-written numpy oracles, this module
+removes the self-oracle risk by asserting exact agreement with the
+reference running live on the same inputs: CalibrationError norm × n_bins,
+HingeLoss squared × multiclass_mode, F1/Accuracy mdmc cells, JaccardIndex
+ignore_index/absent_score, CohenKappa weights, Dice average × top_k ×
+ignore_index (reference functional/classification/*.py). Uses the shared
 conftest import helper; skips when the checkout or torch is unavailable.
 """
 import jax.numpy as jnp
@@ -15,6 +16,7 @@ from metrics_tpu.ops.classification import calibration_error, hinge_loss
 from metrics_tpu.functional import (
     accuracy as mt_accuracy,
     cohen_kappa as mt_cohen_kappa,
+    dice as mt_dice,
     f1_score as mt_f1_score,
     jaccard_index as mt_jaccard_index,
 )
@@ -138,4 +140,18 @@ def test_cohen_kappa_weights_vs_reference(weights):
         )
     )
     want = float(F.cohen_kappa(torch.tensor(preds), torch.tensor(target), num_classes=5, weights=weights))
+    np.testing.assert_allclose(ours, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("ignore_index", [None, 1])
+@pytest.mark.parametrize("top_k", [None, 2])
+@pytest.mark.parametrize("average", ["micro", "macro", "samples"])
+def test_dice_options_vs_reference(average, top_k, ignore_index):
+    torch, F = _ref()
+    rng = np.random.default_rng(16)
+    preds = rng.dirichlet(np.ones(4), 48).astype(np.float32)
+    target = rng.integers(0, 4, 48)
+    kwargs = dict(average=average, num_classes=4, top_k=top_k, ignore_index=ignore_index)
+    ours = float(mt_dice(jnp.asarray(preds), jnp.asarray(target), **kwargs))
+    want = float(F.dice(torch.tensor(preds), torch.tensor(target), **kwargs))
     np.testing.assert_allclose(ours, want, atol=1e-6)
