@@ -1,0 +1,84 @@
+"""Text option surfaces pinned directly against the reference implementation.
+
+BLEU's smoothing/brevity-penalty and SQuAD's normalization pipeline are
+reference-defined (nltk/sacrebleu approximate but don't define them); these
+cells assert exact agreement with the reference running live on identical
+corpora (reference functional/text/bleu.py, squad.py, chrf.py, ter.py,
+cer.py/wer.py/mer.py/wil.py/wip.py).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.functional as mtf
+
+
+def _ref():
+    from tests.conftest import reference_functional
+
+    return reference_functional()
+
+
+_PREDS = ["the cat is on the mat", "a quick brown fox jumps"]
+_TARGETS = [
+    ["there is a cat on the mat", "the cat sits on the mat"],
+    ["the quick brown fox jumps over the dog", "a fast brown fox leaps"],
+]
+
+
+@pytest.mark.parametrize("smooth", [False, True], ids=["plain", "smooth"])
+@pytest.mark.parametrize("n_gram", [1, 2, 3, 4])
+def test_bleu_vs_reference(n_gram, smooth):
+    torch, F = _ref()
+    ours = float(mtf.bleu_score(_PREDS, _TARGETS, n_gram=n_gram, smooth=smooth))
+    want = float(F.bleu_score(_PREDS, _TARGETS, n_gram=n_gram, smooth=smooth))
+    np.testing.assert_allclose(ours, want, atol=1e-6)
+
+
+def test_squad_vs_reference():
+    torch, F = _ref()
+    preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"},
+             {"prediction_text": "the Panthers", "id": "q2"}]
+    target = [
+        {"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"},
+        {"answers": {"answer_start": [1], "text": ["Carolina Panthers", "Panthers"]}, "id": "q2"},
+    ]
+    ours = mtf.squad(preds, target)
+    want = F.squad(preds, target)
+    for key in ("exact_match", "f1"):
+        np.testing.assert_allclose(float(ours[key]), float(want[key]), atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["char_error_rate", "word_error_rate", "match_error_rate", "word_information_lost", "word_information_preserved"],
+)
+def test_error_rates_vs_reference(name):
+    torch, F = _ref()
+    preds = ["this is the prediction", "there is an other sample", ""]
+    target = ["this is the reference", "there is another one", "non empty"]
+    ours = float(getattr(mtf, name)(preds, target))
+    want = float(getattr(F, name)(preds, target))
+    np.testing.assert_allclose(ours, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("return_sentence_level", [False, True], ids=["corpus", "sentence"])
+def test_chrf_vs_reference(return_sentence_level):
+    torch, F = _ref()
+    if return_sentence_level:
+        ours_c, ours_s = mtf.chrf_score(_PREDS, _TARGETS, return_sentence_level_score=True)
+        want_c, want_s = F.chrf_score(_PREDS, _TARGETS, return_sentence_level_score=True)
+        np.testing.assert_allclose(np.asarray(ours_s), np.asarray(want_s), atol=1e-6)
+    else:
+        ours_c = mtf.chrf_score(_PREDS, _TARGETS)
+        want_c = F.chrf_score(_PREDS, _TARGETS)
+    np.testing.assert_allclose(float(ours_c), float(want_c), atol=1e-6)
+
+
+@pytest.mark.parametrize("asian_support", [False, True], ids=["latin", "asian"])
+@pytest.mark.parametrize("normalize", [False, True], ids=["raw", "normalize"])
+def test_ter_vs_reference(normalize, asian_support):
+    torch, F = _ref()
+    ours = float(mtf.translation_edit_rate(_PREDS, _TARGETS, normalize=normalize, asian_support=asian_support))
+    want = float(F.translation_edit_rate(_PREDS, _TARGETS, normalize=normalize, asian_support=asian_support))
+    np.testing.assert_allclose(ours, want, atol=1e-6)
