@@ -1,0 +1,77 @@
+"""Regression option surfaces pinned directly against the reference.
+
+sklearn/scipy are the primary oracles elsewhere; these cells close the loop
+with the reference's own implementations where it makes choices sklearn
+doesn't expose: spearman tie handling, cosine reduction modes, tweedie
+powers, multioutput folding (reference functional/regression/*.py).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.functional as mtf
+
+_rng = np.random.default_rng(44)
+N, D = 96, 3
+PREDS = _rng.standard_normal((N, D)).astype(np.float32)
+TARGET = (0.6 * PREDS + 0.4 * _rng.standard_normal((N, D))).astype(np.float32)
+
+
+def _ref():
+    from tests.conftest import reference_functional
+
+    return reference_functional()
+
+
+def test_spearman_ties_vs_reference():
+    torch, F = _ref()
+    rng = np.random.default_rng(45)  # own rng: cell reproducible in isolation
+    preds = np.round(rng.random(64) * 5).astype(np.float32)  # heavy ties
+    target = np.round(rng.random(64) * 5).astype(np.float32)
+    ours = float(mtf.spearman_corrcoef(jnp.asarray(preds), jnp.asarray(target)))
+    want = float(F.spearman_corrcoef(torch.tensor(preds), torch.tensor(target)))
+    np.testing.assert_allclose(ours, want, atol=1e-5)
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_cosine_reduction_vs_reference(reduction):
+    torch, F = _ref()
+    ours = mtf.cosine_similarity(jnp.asarray(PREDS), jnp.asarray(TARGET), reduction=reduction)
+    want = F.cosine_similarity(torch.tensor(PREDS), torch.tensor(TARGET), reduction=reduction)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(want), rtol=1e-5)
+
+
+@pytest.mark.parametrize("power", [0.0, 1.0, 1.5, 2.0, 3.0, -1.0])
+def test_tweedie_powers_vs_reference(power):
+    torch, F = _ref()
+    rng = np.random.default_rng(46)  # own rng: cell reproducible in isolation
+    preds = (rng.random(64) + 0.1).astype(np.float32)
+    target = (rng.random(64) + 0.1).astype(np.float32)
+    ours = float(mtf.tweedie_deviance_score(jnp.asarray(preds), jnp.asarray(target), power=power))
+    want = float(F.tweedie_deviance_score(torch.tensor(preds), torch.tensor(target), power=power))
+    np.testing.assert_allclose(ours, want, rtol=1e-4)
+
+
+@pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+def test_explained_variance_vs_reference(multioutput):
+    torch, F = _ref()
+    ours = mtf.explained_variance(jnp.asarray(PREDS), jnp.asarray(TARGET), multioutput=multioutput)
+    want = F.explained_variance(torch.tensor(PREDS), torch.tensor(TARGET), multioutput=multioutput)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(want), rtol=1e-4)
+
+
+@pytest.mark.parametrize("adjusted", [0, 5])
+@pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+def test_r2_vs_reference(multioutput, adjusted):
+    torch, F = _ref()
+    ours = mtf.r2_score(jnp.asarray(PREDS), jnp.asarray(TARGET), multioutput=multioutput, adjusted=adjusted)
+    want = F.r2_score(torch.tensor(PREDS), torch.tensor(TARGET), multioutput=multioutput, adjusted=adjusted)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(want), rtol=1e-4)
+
+
+@pytest.mark.parametrize("squared", [True, False], ids=["mse", "rmse"])
+def test_mse_squared_vs_reference(squared):
+    torch, F = _ref()
+    ours = float(mtf.mean_squared_error(jnp.asarray(PREDS), jnp.asarray(TARGET), squared=squared))
+    want = float(F.mean_squared_error(torch.tensor(PREDS), torch.tensor(TARGET), squared=squared))
+    np.testing.assert_allclose(ours, want, rtol=1e-5)

@@ -6,7 +6,6 @@ cells assert exact agreement with the reference running live on identical
 corpora (reference functional/text/bleu.py, squad.py, chrf.py, ter.py,
 cer.py/wer.py/mer.py/wil.py/wip.py).
 """
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -75,10 +74,20 @@ def test_chrf_vs_reference(return_sentence_level):
     np.testing.assert_allclose(float(ours_c), float(want_c), atol=1e-6)
 
 
+# punctuation + CJK text so the normalize/asian_support tokenizer branches
+# actually fire (all-lowercase-Latin inputs make the grid vacuous)
+_TER_PREDS = ["hello, world! this is a test...", "\u6771\u4eac\u30bf\u30ef\u30fc\u306f\u9ad8\u3044 (tall)"]
+_TER_TARGETS = [["hello world, this is the test."], ["\u6771\u4eac\u30bf\u30ef\u30fc\u306f\u3068\u3066\u3082\u9ad8\u3044 (very tall)"]]
+
+
 @pytest.mark.parametrize("asian_support", [False, True], ids=["latin", "asian"])
 @pytest.mark.parametrize("normalize", [False, True], ids=["raw", "normalize"])
 def test_ter_vs_reference(normalize, asian_support):
     torch, F = _ref()
-    ours = float(mtf.translation_edit_rate(_PREDS, _TARGETS, normalize=normalize, asian_support=asian_support))
-    want = float(F.translation_edit_rate(_PREDS, _TARGETS, normalize=normalize, asian_support=asian_support))
+    ours = float(
+        mtf.translation_edit_rate(_TER_PREDS, _TER_TARGETS, normalize=normalize, asian_support=asian_support)
+    )
+    want = float(
+        F.translation_edit_rate(_TER_PREDS, _TER_TARGETS, normalize=normalize, asian_support=asian_support)
+    )
     np.testing.assert_allclose(ours, want, atol=1e-6)
