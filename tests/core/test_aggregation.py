@@ -145,3 +145,40 @@ def test_aggregator_ddp_world_merge():
             m.update(jnp.asarray(vals[r::4]))
             ranks.append(m)
         assert float(merge_world(ranks).compute()) == pytest.approx(float(want)), cls.__name__
+
+
+# ---- reference differential (aggregation.py classes run live) --------------
+@pytest.mark.parametrize(
+    "name", ["SumMetric", "MeanMetric", "MaxMetric", "MinMetric", "CatMetric"],
+    ids=["sum", "mean", "max", "min", "cat"],
+)
+@pytest.mark.parametrize("nan_strategy", ["ignore", 7.0], ids=["ignore", "impute"])
+def test_aggregators_vs_reference(name, nan_strategy):
+    import metrics_tpu as M
+    from tests.conftest import import_reference_torchmetrics
+
+    tm = import_reference_torchmetrics()
+    import torch
+
+    updates = [[1.0, float("nan"), 3.0], [5.0], [2.0, 4.0]]
+    ours = getattr(M, name)(nan_strategy=nan_strategy)
+    ref = getattr(tm, name)(nan_strategy=nan_strategy)
+    for u in updates:
+        ours.update(jnp.asarray(u))
+        ref.update(torch.tensor(u))
+    np.testing.assert_allclose(np.asarray(ours.compute()), np.asarray(ref.compute()), atol=1e-6)
+
+
+def test_weighted_mean_vs_reference():
+    import metrics_tpu as M
+    from tests.conftest import import_reference_torchmetrics
+
+    tm = import_reference_torchmetrics()
+    import torch
+
+    ours, ref = M.MeanMetric(), tm.MeanMetric()
+    ours.update(jnp.asarray([1.0, 2.0, 3.0]), weight=jnp.asarray([0.5, 1.5, 2.0]))
+    ours.update(jnp.asarray(4.0), weight=jnp.asarray(3.0))
+    ref.update(torch.tensor([1.0, 2.0, 3.0]), weight=torch.tensor([0.5, 1.5, 2.0]))
+    ref.update(torch.tensor(4.0), weight=torch.tensor(3.0))
+    np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-6)

@@ -77,7 +77,7 @@ def test_chrf_vs_reference(return_sentence_level):
 # punctuation + CJK text so the normalize/asian_support tokenizer branches
 # actually fire (all-lowercase-Latin inputs make the grid vacuous)
 _TER_PREDS = ["hello, world! this is a test...", "\u6771\u4eac\u30bf\u30ef\u30fc\u306f\u9ad8\u3044 (tall)"]
-_TER_TARGETS = [["hello world, this is the test."], ["\u6771\u4eac\u30bf\u30ef\u30fc\u306f\u3068\u3066\u3082\u9ad8\u3044 (very tall)"]]
+_TER_TARGETS = [["hello world, this is the test.", "hello, world: it is a test!"], ["\u6771\u4eac\u30bf\u30ef\u30fc\u306f\u3068\u3066\u3082\u9ad8\u3044 (very tall)"]]
 
 
 @pytest.mark.parametrize("asian_support", [False, True], ids=["latin", "asian"])
