@@ -148,6 +148,15 @@ def test_aggregator_ddp_world_merge():
 
 
 # ---- reference differential (aggregation.py classes run live) --------------
+def _ref():
+    from tests.conftest import import_reference_torchmetrics
+
+    tm = import_reference_torchmetrics()
+    import torch
+
+    return torch, tm
+
+
 @pytest.mark.parametrize(
     "name", ["SumMetric", "MeanMetric", "MaxMetric", "MinMetric", "CatMetric"],
     ids=["sum", "mean", "max", "min", "cat"],
@@ -155,11 +164,8 @@ def test_aggregator_ddp_world_merge():
 @pytest.mark.parametrize("nan_strategy", ["ignore", 7.0], ids=["ignore", "impute"])
 def test_aggregators_vs_reference(name, nan_strategy):
     import metrics_tpu as M
-    from tests.conftest import import_reference_torchmetrics
 
-    tm = import_reference_torchmetrics()
-    import torch
-
+    torch, tm = _ref()
     updates = [[1.0, float("nan"), 3.0], [5.0], [2.0, 4.0]]
     ours = getattr(M, name)(nan_strategy=nan_strategy)
     ref = getattr(tm, name)(nan_strategy=nan_strategy)
@@ -170,13 +176,8 @@ def test_aggregators_vs_reference(name, nan_strategy):
 
 
 def test_weighted_mean_vs_reference():
-    import metrics_tpu as M
-    from tests.conftest import import_reference_torchmetrics
-
-    tm = import_reference_torchmetrics()
-    import torch
-
-    ours, ref = M.MeanMetric(), tm.MeanMetric()
+    torch, tm = _ref()
+    ours, ref = MeanMetric(), tm.MeanMetric()
     ours.update(jnp.asarray([1.0, 2.0, 3.0]), weight=jnp.asarray([0.5, 1.5, 2.0]))
     ours.update(jnp.asarray(4.0), weight=jnp.asarray(3.0))
     ref.update(torch.tensor([1.0, 2.0, 3.0]), weight=torch.tensor([0.5, 1.5, 2.0]))

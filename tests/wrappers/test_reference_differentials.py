@@ -1,0 +1,70 @@
+"""Deterministic wrapper behavior pinned against the live reference.
+
+MinMaxMetric's min/max tracking across compute() calls, MetricTracker's
+best_metric bookkeeping, and MultioutputWrapper's per-output slicing are
+deterministic (BootStrapper is excluded: its resampling draws differ by
+design). Reference: wrappers/minmax.py:23, tracker.py:26, multioutput.py:24.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as M
+from tests.conftest import import_reference_torchmetrics
+
+
+def _ref():
+    tm = import_reference_torchmetrics()
+    import torch
+
+    return torch, tm
+
+
+def test_minmax_tracking_vs_reference():
+    torch, tm = _ref()
+    ours = M.MinMaxMetric(M.MeanSquaredError())
+    ref = tm.MinMaxMetric(tm.MeanSquaredError())
+    rng = np.random.default_rng(51)
+    for _ in range(4):  # min/max only move at compute() boundaries
+        p = rng.random(16).astype(np.float32)
+        t = rng.random(16).astype(np.float32)
+        ours.update(jnp.asarray(p), jnp.asarray(t))
+        ref.update(torch.tensor(p), torch.tensor(t))
+        got, want = ours.compute(), ref.compute()
+        for key in ("raw", "min", "max"):
+            np.testing.assert_allclose(float(got[key]), float(want[key]), atol=1e-6, err_msg=key)
+
+
+def test_tracker_best_metric_vs_reference():
+    torch, tm = _ref()
+    ours = M.MetricTracker(M.MeanSquaredError(), maximize=False)
+    ref = tm.MetricTracker(tm.MeanSquaredError(), maximize=False)
+    rng = np.random.default_rng(52)
+    t = rng.random(32).astype(np.float32)
+    for noise in (0.5, 0.1, 0.3):  # epoch 2 (index 1) is best
+        ours.increment()
+        ref.increment()
+        p = (t + noise * rng.standard_normal(32)).astype(np.float32)
+        ours.update(jnp.asarray(p), jnp.asarray(t))
+        ref.update(torch.tensor(p), torch.tensor(t))
+    np.testing.assert_allclose(
+        np.asarray(ours.compute_all()), np.asarray(ref.compute_all()), atol=1e-6
+    )
+    ours_best, ours_idx = ours.best_metric(return_step=True)
+    ref_best, ref_idx = ref.best_metric(return_step=True)
+    np.testing.assert_allclose(float(ours_best), float(ref_best), atol=1e-6)
+    assert int(ours_idx) == int(ref_idx)
+
+
+@pytest.mark.parametrize("remove_nans", [True, False], ids=["remove_nans", "keep"])
+def test_multioutput_vs_reference(remove_nans):
+    torch, tm = _ref()
+    preds = np.asarray([[1.0, 10.0], [2.0, np.nan], [3.0, 30.0]], np.float32)
+    target = np.asarray([[1.5, 11.0], [2.5, 21.0], [3.5, 29.0]], np.float32)
+    ours = M.MultioutputWrapper(M.MeanSquaredError(), num_outputs=2, remove_nans=remove_nans)
+    ref = tm.MultioutputWrapper(tm.MeanSquaredError(), num_outputs=2, remove_nans=remove_nans)
+    ours.update(jnp.asarray(preds), jnp.asarray(target))
+    ref.update(torch.tensor(preds), torch.tensor(target))
+    got = np.asarray(ours.compute())
+    want = np.asarray([float(v) for v in ref.compute()])
+    np.testing.assert_allclose(got, want, atol=1e-6)
