@@ -155,3 +155,35 @@ def test_dice_options_vs_reference(average, top_k, ignore_index):
     ours = float(mt_dice(jnp.asarray(preds), jnp.asarray(target), **kwargs))
     want = float(F.dice(torch.tensor(preds), torch.tensor(target), **kwargs))
     np.testing.assert_allclose(ours, want, atol=1e-6)
+
+
+def test_roc_prc_output_format_vs_reference():
+    """Curve OUTPUT CONTRACT: the reference prepends a max+1 threshold to ROC
+    and returns per-class lists for multiclass — both pinned exactly."""
+    torch, F = _ref()
+    p = np.asarray([0.1, 0.4, 0.35, 0.8], np.float32)
+    t = np.asarray([0, 0, 1, 1])
+    from metrics_tpu.functional import precision_recall_curve as mt_prc, roc as mt_roc
+
+    ours_roc = mt_roc(jnp.asarray(p), jnp.asarray(t), pos_label=1)
+    want_roc = F.roc(torch.tensor(p), torch.tensor(t), pos_label=1)
+    assert len(ours_roc) == len(want_roc) == 3  # (fpr, tpr, thresholds)
+    for ours, want in zip(ours_roc, want_roc):
+        np.testing.assert_allclose(np.asarray(ours), np.asarray(want), atol=1e-6)
+    ours_prc = mt_prc(jnp.asarray(p), jnp.asarray(t), pos_label=1)
+    want_prc = F.precision_recall_curve(torch.tensor(p), torch.tensor(t), pos_label=1)
+    assert len(ours_prc) == len(want_prc) == 3  # (precision, recall, thresholds)
+    for ours, want in zip(ours_prc, want_prc):
+        np.testing.assert_allclose(np.asarray(ours), np.asarray(want), atol=1e-6)
+
+    # multiclass: list-of-arrays per class on both sides
+    rng = np.random.default_rng(17)
+    pm = rng.dirichlet(np.ones(3), 32).astype(np.float32)
+    tm_ = rng.integers(0, 3, 32)
+    ours_l = mt_roc(jnp.asarray(pm), jnp.asarray(tm_), num_classes=3)
+    want_l = F.roc(torch.tensor(pm), torch.tensor(tm_), num_classes=3)
+    assert len(ours_l) == len(want_l) == 3
+    for ours_part, want_part in zip(ours_l, want_l):
+        assert len(ours_part) == len(want_part) == 3
+        for o, w in zip(ours_part, want_part):
+            np.testing.assert_allclose(np.asarray(o), np.asarray(w), atol=1e-6)

@@ -56,3 +56,12 @@ def reference_functional():
     import torchmetrics.functional as F
 
     return torch, F
+
+
+def reference_modular():
+    """(torch, torchmetrics) module pair from the reference checkout — the
+    class-level counterpart of reference_functional()."""
+    tm = import_reference_torchmetrics()
+    import torch
+
+    return torch, tm

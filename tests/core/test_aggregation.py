@@ -149,12 +149,9 @@ def test_aggregator_ddp_world_merge():
 
 # ---- reference differential (aggregation.py classes run live) --------------
 def _ref():
-    from tests.conftest import import_reference_torchmetrics
+    from tests.conftest import reference_modular
 
-    tm = import_reference_torchmetrics()
-    import torch
-
-    return torch, tm
+    return reference_modular()
 
 
 @pytest.mark.parametrize(

@@ -10,14 +10,12 @@ import numpy as np
 import pytest
 
 import metrics_tpu as M
-from tests.conftest import import_reference_torchmetrics
 
 
 def _ref():
-    tm = import_reference_torchmetrics()
-    import torch
+    from tests.conftest import reference_modular
 
-    return torch, tm
+    return reference_modular()
 
 
 def test_minmax_tracking_vs_reference():
