@@ -19,6 +19,8 @@ from metrics_tpu.functional import (
     dice as mt_dice,
     f1_score as mt_f1_score,
     jaccard_index as mt_jaccard_index,
+    precision_recall_curve as mt_prc,
+    roc as mt_roc,
 )
 from tests.classification.inputs import _input_binary_prob, _input_multiclass_prob
 
@@ -163,8 +165,6 @@ def test_roc_prc_output_format_vs_reference():
     torch, F = _ref()
     p = np.asarray([0.1, 0.4, 0.35, 0.8], np.float32)
     t = np.asarray([0, 0, 1, 1])
-    from metrics_tpu.functional import precision_recall_curve as mt_prc, roc as mt_roc
-
     ours_roc = mt_roc(jnp.asarray(p), jnp.asarray(t), pos_label=1)
     want_roc = F.roc(torch.tensor(p), torch.tensor(t), pos_label=1)
     assert len(ours_roc) == len(want_roc) == 3  # (fpr, tpr, thresholds)

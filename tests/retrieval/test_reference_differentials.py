@@ -49,3 +49,37 @@ def test_option_grid_vs_reference(ours_name, ref_name, kwargs, empty_action, wit
     ref.update(torch.tensor(preds), torch.tensor(target), indexes=torch.tensor(indexes))
 
     np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-5)
+
+
+@pytest.mark.parametrize("adaptive_k", [False, True], ids=["fixed-k", "adaptive-k"])
+@pytest.mark.parametrize("max_k", [None, 4])
+def test_precision_recall_curve_vs_reference(max_k, adaptive_k):
+    from tests.conftest import reference_modular
+
+    torch, torchmetrics = reference_modular()
+    indexes, preds, target = _fixture(with_ignore=False, with_empty=False)
+    ours = M.RetrievalPrecisionRecallCurve(max_k=max_k, adaptive_k=adaptive_k)
+    ours.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+    ref = torchmetrics.RetrievalPrecisionRecallCurve(max_k=max_k, adaptive_k=adaptive_k)
+    ref.update(torch.tensor(preds), torch.tensor(target), indexes=torch.tensor(indexes))
+    o_prec, o_rec, o_k = ours.compute()
+    r_prec, r_rec, r_k = ref.compute()
+    np.testing.assert_allclose(np.asarray(o_k), np.asarray(r_k))
+    np.testing.assert_allclose(np.asarray(o_prec), np.asarray(r_prec), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(o_rec), np.asarray(r_rec), atol=1e-6)
+
+
+@pytest.mark.parametrize("min_precision", [0.0, 0.4, 0.8])
+def test_recall_at_fixed_precision_vs_reference(min_precision):
+    from tests.conftest import reference_modular
+
+    torch, torchmetrics = reference_modular()
+    indexes, preds, target = _fixture(with_ignore=False, with_empty=False)
+    ours = M.RetrievalRecallAtFixedPrecision(min_precision=min_precision, max_k=6)
+    ours.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+    ref = torchmetrics.RetrievalRecallAtFixedPrecision(min_precision=min_precision, max_k=6)
+    ref.update(torch.tensor(preds), torch.tensor(target), indexes=torch.tensor(indexes))
+    o_rec, o_k = ours.compute()
+    r_rec, r_k = ref.compute()
+    np.testing.assert_allclose(float(o_rec), float(r_rec), atol=1e-6)
+    assert int(o_k) == int(r_k)
