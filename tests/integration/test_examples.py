@@ -15,6 +15,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 EXAMPLES = [
+    "compiled_scan_loop.py",
     "detection_map.py",
     "bert_score_own_model.py",
     "rouge_score_own_normalizer_and_tokenizer.py",
