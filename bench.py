@@ -382,9 +382,11 @@ def _sync_overhead_child() -> None:
     from metrics_tpu import Accuracy, F1Score, MetricCollection
 
     devices = jax.devices()
-    if len(devices) < 8:
-        raise RuntimeError(f"expected 8 forced host devices, got {len(devices)}")
-    world = 8
+    # BENCH_SYNC_WORLD lets a scaling sweep vary the mesh width (default: the
+    # BASELINE.md 8-device config); the parent sets the matching device count
+    world = int(os.environ.get("BENCH_SYNC_WORLD", "8"))
+    if len(devices) < world:
+        raise RuntimeError(f"expected {world} forced host devices, got {len(devices)}")
     mesh = Mesh(np.asarray(devices[:world]), ("data",))
     coll = MetricCollection(
         {
@@ -393,7 +395,11 @@ def _sync_overhead_child() -> None:
         }
     )
     per_dev_batch = 1024
+    if 65_536 % (per_dev_batch * world) != 0:
+        raise RuntimeError(f"world={world} does not divide the 64k-sample sweep evenly")
     steps = 65_536 // (per_dev_batch * world)  # 64k-sample sweep (BASELINE.md)
+    if steps < 1:
+        raise RuntimeError(f"world={world} leaves zero sweep steps")
 
     def sweep(sync_every_step: bool):
         def body(seed):
@@ -466,10 +472,11 @@ def _sync_overhead_child() -> None:
     )
 
 
-def bench_sync_overhead(timeout: float = 1200.0) -> dict:
+def bench_sync_overhead(timeout: float = 1200.0, world: int = 8) -> dict:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    env["BENCH_SYNC_WORLD"] = str(world)
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={world}"
     out = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--child", "sync_overhead"],
         capture_output=True,
@@ -1003,6 +1010,11 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--child", choices=["sync_overhead", *_CHILD_BENCHES])
     parser.add_argument(
+        "--sync-scaling",
+        action="store_true",
+        help="run the sync-overhead config across mesh widths 2/4/8/16 and print one JSON dict",
+    )
+    parser.add_argument(
         "--quick-tpu",
         action="store_true",
         help="<=5-minute subset (config1/2 + sync overhead + binned A/B + one "
@@ -1010,6 +1022,12 @@ def main() -> None:
         "full platform:tpu record",
     )
     args = parser.parse_args()
+    if args.sync_scaling:
+        out = {}
+        for w in (2, 4, 8, 16):
+            out[f"world_{w}"] = _safe(bench_sync_overhead, 1500.0, w)
+        print(json.dumps(_round(out)))
+        return
     if args.child == "sync_overhead":
         _sync_overhead_child()
         return
