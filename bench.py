@@ -284,6 +284,38 @@ def bench_collection_ours() -> float:
     return (t1 - t0) / STEPS * 1e6
 
 
+def bench_collection_facade() -> float:
+    """Config-2 collection driven through plain ``coll.update()`` — the
+    stateful facade the reference exposes. The compiled-update engine serves
+    these calls from one cached fused (and donated) executable, so this is
+    the apples-to-apples number against the reference's eager per-call time."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, Recall
+
+    coll = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES, average="micro"),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "precision": Precision(num_classes=NUM_CLASSES, average="macro"),
+            "recall": Recall(num_classes=NUM_CLASSES, average="macro"),
+        }
+    )
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(BATCH, NUM_CLASSES)), dtype=jnp.float32)
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=jnp.int32)
+
+    for _ in range(WARMUP):
+        coll.update(logits, target)
+    coll.reset()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        coll.update(logits, target)
+    jax.block_until_ready(coll["acc"].tp)
+    return (time.perf_counter() - t0) / STEPS * 1e6
+
+
 def bench_collection_ref() -> float:
     import torch
 
@@ -421,9 +453,17 @@ def _sync_overhead_child() -> None:
             out = coll.compute_state(state)
             return jax.tree.map(lambda x: jnp.expand_dims(x, 0), (out, vals))
 
-        fn = jax.jit(
-            jax.shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False)
-        )
+        if hasattr(jax, "shard_map"):
+            smapped = jax.shard_map(
+                body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False
+            )
+        else:  # jax < 0.6: experimental namespace, check_rep spelling
+            from jax.experimental.shard_map import shard_map
+
+            smapped = shard_map(
+                body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_rep=False
+            )
+        fn = jax.jit(smapped)
         seeds = jnp.arange(world)[:, None]
         jax.block_until_ready(fn(seeds))  # compile
         return fn, seeds
@@ -941,6 +981,22 @@ def bench_catbuffer_auroc() -> dict:
     jax.block_until_ready(state)
     jit_us = (time.perf_counter() - t0) / 32 * 1e6
 
+    # the stateful facade: plain .update() calls, served by the compiled-update
+    # engine's cached donated executables after warmup
+    stateful = AUROC(buffer_capacity=256 * 40)
+    for _ in range(5):
+        # warm both buffer signatures AND both executables: the donating
+        # variant compiles lazily on the first donated call (call 4 here)
+        stateful.update(preds, target)
+    stateful.reset()
+    stateful.update(preds, target)  # re-materialize the buffer treedef
+    t0 = time.perf_counter()
+    for _ in range(32):
+        stateful.update(preds, target)
+    jax.block_until_ready(stateful.preds.data)
+    stateful_us = (time.perf_counter() - t0) / 32 * 1e6
+
+    # list-state eager baseline (no buffer: dynamic shapes, engine-ineligible)
     eager = AUROC()
     eager.update(preds, target)  # warm
     eager.reset()
@@ -948,8 +1004,12 @@ def bench_catbuffer_auroc() -> dict:
     for _ in range(32):
         eager.update(preds, target)
     jax.block_until_ready(eager.preds)
-    eager_us = (time.perf_counter() - t0) / 32 * 1e6
-    return {"jit_update_us_per_step": jit_us, "eager_update_us_per_step": eager_us}
+    list_eager_us = (time.perf_counter() - t0) / 32 * 1e6
+    return {
+        "jit_update_us_per_step": jit_us,
+        "eager_update_us_per_step": stateful_us,
+        "list_eager_update_us_per_step": list_eager_us,
+    }
 
 
 # --------------------------------------------------------------------------- #
@@ -1205,6 +1265,7 @@ def main() -> None:
             "collection_scan_us_per_step": scan_us if scan_us is not None else scan_raw,
             "collection_scan_mfu": scan_mfu,
             "percall_us_per_step": ours_us,
+            "facade_update_us_per_step": _num(_safe(bench_collection_facade)),
             "reference_torch_us_per_step": ref_us,
             "vs_baseline_percall": round(ref_us / ours_us, 3) if ref_us else None,
         },

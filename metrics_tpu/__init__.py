@@ -47,7 +47,14 @@ from metrics_tpu.classification import (  # noqa: F401
     Specificity,
     StatScores,
 )
-from metrics_tpu.core import CatBuffer, CompositionalMetric, Metric, MetricCollection  # noqa: F401
+from metrics_tpu.core import (  # noqa: F401
+    CatBuffer,
+    CompositionalMetric,
+    Metric,
+    MetricCollection,
+    compiled_update_enabled,
+    set_compiled_update,
+)
 from metrics_tpu.detection import MeanAveragePrecision  # noqa: F401
 from metrics_tpu.image import (  # noqa: F401
     ErrorRelativeGlobalDimensionlessSynthesis,
@@ -116,6 +123,7 @@ __all__ = [
     "functional",
     # core
     "Metric", "MetricCollection", "CompositionalMetric", "CatBuffer",
+    "set_compiled_update", "compiled_update_enabled",
     # aggregation
     "CatMetric", "MaxMetric", "MeanMetric", "MinMetric", "SumMetric",
     # audio

@@ -24,7 +24,7 @@ class PermutationInvariantTraining(_MeanAudioMetric):
         >>> pit = PermutationInvariantTraining(scale_invariant_signal_noise_ratio)
         >>> pit.update(preds, target)
         >>> round(float(pit.compute()), 4)
-        -16.8378
+        -21.9724
     """
 
     is_differentiable = True

@@ -23,7 +23,7 @@ class SignalDistortionRatio(_MeanAudioMetric):
         >>> sdr = SignalDistortionRatio()
         >>> sdr.update(preds, target)
         >>> round(float(sdr.compute()), 4)
-        20.0742
+        20.3381
     """
 
     is_differentiable = True

@@ -24,7 +24,7 @@ class ShortTimeObjectiveIntelligibility(_MeanAudioMetric):
         >>> stoi = ShortTimeObjectiveIntelligibility(8000)
         >>> stoi.update(preds, target)
         >>> round(float(stoi.compute()), 4)
-        0.9888
+        0.9893
     """
 
     is_differentiable = False

@@ -72,6 +72,11 @@ class StatScores(Metric):
         for s in ("tp", "fp", "tn", "fn"):
             self.add_state(s, default=default(), dist_reduce_fx=reduce_fn)
 
+        # Sum-reduced counts are additive in masked rows, so the compiled-update
+        # engine may pad ragged batches and thread a validity mask; the cat
+        # layouts (samples / samplewise) would append the padded rows.
+        self._accepts_sample_mask = reduce != "samples" and mdmc_reduce != "samplewise"
+
     def _update_signature(self):
         """Stat-scores family compute-group key: equal args => identical state."""
         return (
@@ -79,11 +84,11 @@ class StatScores(Metric):
             self.threshold, self.multiclass, self.ignore_index, self.top_k,
         )
 
-    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+    def update(self, preds: Array, target: Array, sample_mask: Optional[Array] = None) -> None:  # type: ignore[override]
         tp, fp, tn, fn = _stat_scores_update(
             preds, target, reduce=self.reduce, mdmc_reduce=self.mdmc_reduce,
             threshold=self.threshold, num_classes=self.num_classes, top_k=self.top_k,
-            multiclass=self.multiclass, ignore_index=self.ignore_index,
+            multiclass=self.multiclass, ignore_index=self.ignore_index, sample_mask=sample_mask,
         )
         if self.reduce != "samples" and self.mdmc_reduce != "samplewise":
             self.tp = self.tp + tp

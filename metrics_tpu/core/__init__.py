@@ -1,4 +1,11 @@
 """Core metric runtime (reference parity: torchmetrics/metric.py + collections.py)."""
 from metrics_tpu.core.collections import MetricCollection  # noqa: F401
 from metrics_tpu.core.buffers import CatBuffer  # noqa: F401
+from metrics_tpu.core.engine import (  # noqa: F401
+    CollectionUpdateEngine,
+    CompiledUpdateEngine,
+    EngineStats,
+    compiled_update_enabled,
+    set_compiled_update,
+)
 from metrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: F401

@@ -27,6 +27,8 @@ from __future__ import annotations
 from copy import deepcopy
 from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
 
+import jax
+
 from metrics_tpu.core.metric import Metric, StateDict
 from metrics_tpu.utils.exceptions import MetricsUserError
 
@@ -39,6 +41,11 @@ class MetricCollection:
         additional_metrics: more metrics when ``metrics`` is a single one.
         prefix / postfix: added to every output key.
         compute_groups: enable static compute-group fusion (default True).
+        compiled_update: dispatch ``update()`` through one fused jitted
+            executable per input signature (all groups in a single XLA call;
+            see :mod:`metrics_tpu.core.engine`). ``None`` follows the global
+            switch; ``False`` keeps the eager per-group loop (member metrics'
+            own engines still apply).
 
     Example:
         >>> import jax.numpy as jnp
@@ -63,12 +70,15 @@ class MetricCollection:
         prefix: Optional[str] = None,
         postfix: Optional[str] = None,
         compute_groups: bool = True,
+        compiled_update: Optional[bool] = None,
     ) -> None:
         self._metrics: Dict[str, Metric] = {}
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
         self._enable_compute_groups = compute_groups
         self._groups: List[List[str]] = []
+        self._compiled_update = compiled_update
+        self._update_engine: Any = None  # lazily-built CollectionUpdateEngine
         self.add_metrics(metrics, *additional_metrics)
 
     @staticmethod
@@ -136,6 +146,9 @@ class MetricCollection:
 
     def _rebuild_groups(self) -> None:
         """Static grouping by update signature (no runtime probing)."""
+        # group membership is baked into the fused executable's closure, so any
+        # cached compiled update is stale the moment groups change
+        self._update_engine = None
         self._groups = []
         if not self._enable_compute_groups:
             self._groups = [[k] for k in self.keys(keep_base=True)]
@@ -210,19 +223,42 @@ class MetricCollection:
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         return self.forward(*args, **kwargs)
 
+    def _maybe_engine(self) -> Optional[Any]:
+        """The fused compiled-update engine, or None when disabled."""
+        from metrics_tpu.core import engine as _engine
+
+        enabled = self._compiled_update
+        if enabled is None:
+            enabled = _engine.compiled_update_enabled()
+        if not enabled:
+            return None
+        if self._update_engine is None:
+            self._update_engine = _engine.CollectionUpdateEngine(self)
+        return self._update_engine
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Fused update: one update per compute group; members share the
-        leader's (immutable) state by reference. Reference: :160-179."""
+        leader's (immutable) state by reference. Reference: :160-179.
+
+        With the compiled-update engine enabled, the whole loop below runs as
+        one cached jitted executable from the second call per input signature."""
+        engine = self._maybe_engine()
+        if engine is not None and engine.eligible(args, kwargs) and engine.dispatch(args, kwargs):
+            return
         for group in self._groups:
             leader = self._metrics.__getitem__(group[0])
             leader.update(*args, **leader._filter_kwargs(**kwargs))
             if len(group) > 1:
                 state = leader.get_state()
+                # shared leaves must never be donated by any member's engine
+                shared = frozenset(id(leaf) for leaf in jax.tree_util.tree_leaves(state))
+                leader._shared_state_ids = shared
                 for name in group[1:]:
                     m = self._metrics.__getitem__(name)
                     m.set_state(state)
                     m._update_count = leader._update_count
                     m._computed = None
+                    m._shared_state_ids = shared
 
     def compute(self) -> Dict[str, Any]:
         """One sync per group, value per member. Reference: :241-253."""
@@ -317,6 +353,15 @@ class MetricCollection:
             leader = self._metrics.__getitem__(group[0])
             out[group[0]] = leader.sync_states(states[group[0]], axis_name)
         return out
+
+    def __getstate__(self) -> Dict[str, Any]:
+        """Drop the fused engine (jitted executables close over ``self``);
+        clones/unpickled copies rebuild it lazily."""
+        return {k: v for k, v in self.__dict__.items() if k != "_update_engine"}
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        self._update_engine = None
 
     def __repr__(self) -> str:
         repr_str = self.__class__.__name__ + "(\n"

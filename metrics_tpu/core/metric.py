@@ -85,6 +85,18 @@ class Metric:
             compiled appends require it to cover the full run (overflow is
             detected and raised at ``compute``). TPU-first replacement for the
             reference's unbounded list states (metric.py:350-352).
+        compiled_update: whether ``update()`` dispatches through the compiled-
+            update engine (cached jitted ``update_state`` per input signature;
+            see :mod:`metrics_tpu.core.engine`). ``None`` (default) follows the
+            global switch (:func:`metrics_tpu.set_compiled_update` /
+            ``METRICS_TPU_COMPILED_UPDATE``); ``False`` forces eager updates.
+        donate_state: allow the engine's steady-state executable to donate the
+            state pytree (in-place buffer reuse on TPU/GPU). Aliased state
+            (defaults, collection-shared) is detected and never donated.
+        batch_buckets: opt-in shape bucketing — ragged batch sizes are padded
+            to power-of-two buckets (with a validity mask when the metric's
+            update accepts ``sample_mask``) or split into power-of-two chunks,
+            bounding recompiles at ``log2(max_batch)`` signatures.
 
     Example (implementing a custom metric):
         >>> import jax.numpy as jnp
@@ -118,10 +130,19 @@ class Metric:
         dist_sync_fn: Optional[Callable] = None,
         sync_on_compute: bool = True,
         buffer_capacity: Optional[int] = None,
+        compiled_update: Optional[bool] = None,
+        donate_state: bool = True,
+        batch_buckets: bool = False,
         **kwargs: Any,
     ) -> None:
         if kwargs:
             raise ValueError(f"Unexpected keyword arguments: {list(kwargs)}")
+        if compiled_update is not None and not isinstance(compiled_update, bool):
+            raise ValueError(f"Expected keyword argument `compiled_update` to be a `bool` or None but got {compiled_update}")
+        if not isinstance(donate_state, bool):
+            raise ValueError(f"Expected keyword argument `donate_state` to be a `bool` but got {donate_state}")
+        if not isinstance(batch_buckets, bool):
+            raise ValueError(f"Expected keyword argument `batch_buckets` to be a `bool` but got {batch_buckets}")
         if buffer_capacity is not None and (not isinstance(buffer_capacity, int) or buffer_capacity <= 0):
             raise ValueError(f"Expected keyword argument `buffer_capacity` to be a positive int but got {buffer_capacity}")
         if not isinstance(compute_on_cpu, bool):
@@ -136,6 +157,11 @@ class Metric:
         self.dist_sync_fn = dist_sync_fn
         self.sync_on_compute = sync_on_compute
         self.buffer_capacity = buffer_capacity
+        self._compiled_update = compiled_update
+        self._donate_state = donate_state
+        self._batch_buckets = batch_buckets
+        self._update_engine: Any = None  # lazily-built CompiledUpdateEngine
+        self._shared_state_ids: frozenset = frozenset()  # leaves shared across a collection group
 
         self._defaults: Dict[str, StateValue] = {}
         self._persistent: Dict[str, bool] = {}
@@ -430,12 +456,28 @@ class Metric:
         self.compute_on_cpu = _temp_compute_on_cpu
         return batch_val
 
+    def _maybe_engine(self) -> Optional[Any]:
+        """The compiled-update engine for this instance, or None when disabled
+        (per-instance flag first, then the global switch)."""
+        from metrics_tpu.core import engine as _engine
+
+        enabled = self._compiled_update
+        if enabled is None:
+            enabled = _engine.compiled_update_enabled()
+        if not enabled:
+            return None
+        if self._update_engine is None:
+            self._update_engine = _engine.CompiledUpdateEngine(self)
+        return self._update_engine
+
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
             self._computed = None
             self._update_count += 1
-            update(*args, **kwargs)
+            engine = self._maybe_engine()
+            if engine is None or not engine.dispatch(args, kwargs):
+                update(*args, **kwargs)
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
 
@@ -598,11 +640,18 @@ class Metric:
         object.__setattr__(self, name, value)
 
     def __getstate__(self) -> Dict[str, Any]:
-        """Drop the wrapped bound methods for pickling (reference: metric.py:573-577)."""
-        return {k: v for k, v in self.__dict__.items() if k not in ("update", "compute", "_update", "_compute")}
+        """Drop the wrapped bound methods for pickling (reference: metric.py:573-577).
+        The compiled-update engine is dropped too (jitted executables close over
+        ``self``); clones/unpickled copies rebuild it lazily."""
+        return {
+            k: v
+            for k, v in self.__dict__.items()
+            if k not in ("update", "compute", "_update", "_compute", "_update_engine")
+        }
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)
+        self._update_engine = None
         self.update = self._wrap_update(type(self).update.__get__(self))  # type: ignore[method-assign]
         self.compute = self._wrap_compute(type(self).compute.__get__(self))  # type: ignore[method-assign]
 

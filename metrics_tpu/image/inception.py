@@ -36,7 +36,7 @@ class InceptionScore(Metric):
         >>> metric.update(imgs)
         >>> mean, std = metric.compute()
         >>> round(float(mean), 4), round(float(std), 4)
-        (1.5532, 0.1367)
+        (1.6102, 0.2894)
     """
 
     higher_is_better = True

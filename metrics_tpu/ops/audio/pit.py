@@ -75,9 +75,9 @@ def permutation_invariant_training(
         >>> target = jax.random.normal(jax.random.PRNGKey(4), (2, 2, 16))
         >>> best, perm = permutation_invariant_training(preds, target, scale_invariant_signal_noise_ratio)
         >>> [round(float(x), 4) for x in best]
-        [-15.6326, -18.043]
+        [-31.022, -12.9228]
         >>> perm.tolist()
-        [[1, 0], [0, 1]]
+        [[0, 1], [1, 0]]
     """
     if preds.shape[0:2] != target.shape[0:2]:
         raise RuntimeError(

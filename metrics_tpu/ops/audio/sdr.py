@@ -89,7 +89,7 @@ def signal_distortion_ratio(
         >>> target = jax.random.normal(jax.random.PRNGKey(1), (8000,))
         >>> preds = target + 0.1 * jax.random.normal(jax.random.PRNGKey(2), (8000,))
         >>> round(float(signal_distortion_ratio(preds, target)), 4)
-        20.0742
+        20.3381
     """
     _check_same_shape(preds, target)
     orig_dtype = preds.dtype

@@ -175,7 +175,7 @@ def short_time_objective_intelligibility(
         >>> target = jax.random.normal(jax.random.PRNGKey(1), (8000,))
         >>> preds = target + 0.1 * jax.random.normal(jax.random.PRNGKey(2), (8000,))
         >>> round(float(short_time_objective_intelligibility(preds, target, 8000)), 4)
-        0.9888
+        0.9893
     """
     _check_same_shape(preds, target)
     if fs != FS:

@@ -59,6 +59,7 @@ def _accuracy_update(
     multiclass: Optional[bool],
     ignore_index: Optional[int],
     mode: DataType,
+    sample_mask: Optional[Array] = None,
 ) -> Tuple[Array, Array, Array, Array]:
     if mode == DataType.MULTILABEL and top_k:
         raise ValueError("The `top_k` parameter is not supported for multi-label accuracy.")
@@ -66,7 +67,7 @@ def _accuracy_update(
     return _stat_scores_update(
         preds, target, reduce=reduce, mdmc_reduce=mdmc_reduce, threshold=threshold,
         num_classes=num_classes, top_k=top_k, multiclass=multiclass,
-        ignore_index=ignore_index, mode=mode,
+        ignore_index=ignore_index, mode=mode, sample_mask=sample_mask,
     )
 
 
@@ -111,11 +112,13 @@ def _subset_accuracy_update(
     top_k: Optional[int],
     ignore_index: Optional[int] = None,
     num_classes: Optional[int] = None,
+    sample_mask: Optional[Array] = None,
 ) -> Tuple[Array, Array]:
     """Exact-match (subset) accuracy counts. Reference: :206-244.
 
     ``num_classes`` is a TPU-first extension: label inputs under jit tracing
     cannot infer the one-hot width from data, so the module passes it through.
+    ``sample_mask`` (optional ``(N,)``) removes padded rows from both counts.
     """
     preds, target = _input_squeeze(preds, target)
     preds, target, mode = _input_format_classification(
@@ -124,16 +127,19 @@ def _subset_accuracy_update(
     if mode == DataType.MULTILABEL and top_k:
         raise ValueError("The `top_k` parameter is not supported for multi-label accuracy.")
 
+    w = None if sample_mask is None else sample_mask.reshape(-1).astype(jnp.int32)
     if mode == DataType.MULTILABEL:
-        correct = jnp.sum(jnp.all(preds == target, axis=1))
-        total = jnp.asarray(target.shape[0])
+        row_correct = jnp.all(preds == target, axis=1).astype(jnp.int32)
+        correct = jnp.sum(row_correct if w is None else row_correct * w)
+        total = jnp.asarray(target.shape[0]) if w is None else jnp.sum(w)
     elif mode == DataType.MULTICLASS:
-        correct = jnp.sum(preds * target)
-        total = jnp.sum(target)
+        hits = preds * target
+        correct = jnp.sum(hits if w is None else hits * w[:, None])
+        total = jnp.sum(target if w is None else target * w[:, None])
     elif mode == DataType.MULTIDIM_MULTICLASS:
-        sample_correct = jnp.sum(preds * target, axis=(1, 2))
-        correct = jnp.sum(sample_correct == target.shape[2])
-        total = jnp.asarray(target.shape[0])
+        sample_correct = (jnp.sum(preds * target, axis=(1, 2)) == target.shape[2]).astype(jnp.int32)
+        correct = jnp.sum(sample_correct if w is None else sample_correct * w)
+        total = jnp.asarray(target.shape[0]) if w is None else jnp.sum(w)
     else:
         correct, total = jnp.asarray(0), jnp.asarray(0)
     return correct, total

@@ -73,7 +73,7 @@ def spectral_distortion_index(
         >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (2, 3, 16, 16))
         >>> target = jax.random.uniform(jax.random.PRNGKey(43), (2, 3, 16, 16))
         >>> round(float(spectral_distortion_index(preds, target)), 4)
-        0.0507
+        0.1299
     """
     if not isinstance(p, int) or p <= 0:
         raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")

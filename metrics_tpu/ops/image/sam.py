@@ -35,7 +35,7 @@ def spectral_angle_mapper(preds: Array, target: Array, reduction: Optional[str] 
         >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (2, 3, 16, 16))
         >>> target = jax.random.uniform(jax.random.PRNGKey(43), (2, 3, 16, 16))
         >>> round(float(spectral_angle_mapper(preds, target)), 4)
-        0.575
+        0.5708
     """
     preds, target = _sam_check_inputs(preds, target)
     return _sam_compute(preds, target, reduction)

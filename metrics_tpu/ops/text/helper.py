@@ -72,7 +72,7 @@ def _compiled_edit_kernel(pred_width: int, ref_width: int):
             cost = jnp.where(p_tok == ref_ids, 0, 1)  # (R,)
             cand = jnp.minimum(prev_row[1:] + 1, prev_row[:-1] + cost)
             c = jnp.concatenate([i[None].astype(jnp.int32), cand])  # c_0 = i boundary
-            row = jnp.minimum.accumulate(c - js) + js  # min-plus prefix scan
+            row = jax.lax.cummin(c - js) + js  # min-plus prefix scan
             return row, row
 
         _, rows = jax.lax.scan(step, init_row, (jnp.arange(1, pred_width + 1), pred_ids))

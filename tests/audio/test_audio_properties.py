@@ -7,6 +7,8 @@ hypothesis searches for violations.
 """
 import numpy as np
 import pytest
+
+pytest.importorskip("hypothesis", reason="property-based tests need the `test` extra (pip install metrics-tpu[test])")
 from hypothesis import HealthCheck, given, settings
 from hypothesis import strategies as st
 

@@ -1,0 +1,132 @@
+"""Numeric parity for the scatter-add multiclass stat-scores fast path.
+
+``_stat_scores_update`` routes multiclass top-1 inputs through O(batch)
+bincount scatters (``_stat_scores_multiclass_counts``) instead of one-hot
+``(N, C)`` broadcasts. These tests pin exact count parity against the
+broadcast formulation (forced by disabling the eligibility predicate) across
+reduces, input kinds, masks, ignore_index, and under jit.
+"""
+import importlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+# the `stat_scores` function re-exported by the package shadows the submodule
+# attribute, so resolve the module itself for monkeypatching
+ss_mod = importlib.import_module("metrics_tpu.ops.classification.stat_scores")
+_multiclass_fast_path_eligible = ss_mod._multiclass_fast_path_eligible
+_stat_scores_update = ss_mod._stat_scores_update
+
+
+@pytest.fixture()
+def force_broadcast(monkeypatch):
+    """Route every call through the one-hot broadcast formulation."""
+    monkeypatch.setattr(ss_mod, "_multiclass_fast_path_eligible", lambda *a, **k: False)
+
+
+def _logits(n, c, seed):
+    rng = np.random.default_rng(seed)
+    preds = jnp.asarray(rng.standard_normal((n, c)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, c, n))
+    return preds, target
+
+
+def _labels(n, c, seed):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, c, n)), jnp.asarray(rng.integers(0, c, n))
+
+
+def _assert_counts_equal(fast, slow):
+    for f, s, name in zip(fast, slow, ("tp", "fp", "tn", "fn")):
+        np.testing.assert_array_equal(np.asarray(f), np.asarray(s), err_msg=name)
+        assert f.shape == s.shape, name
+
+
+REDUCES = ["micro", "macro", "samples"]
+SHAPES = [(1, 2), (7, 3), (64, 5), (128, 100)]
+
+
+@pytest.mark.parametrize("reduce", REDUCES)
+@pytest.mark.parametrize("n,c", SHAPES)
+def test_logit_inputs_parity(force_broadcast, reduce, n, c):
+    preds, target = _logits(n, c, seed=n * 31 + c)
+    assert _multiclass_fast_path_eligible(preds, target, reduce, None, None, None)
+    fast = ss_mod._stat_scores_multiclass_counts(
+        jnp.argmax(preds, axis=1), target, reduce, c
+    )
+    slow = _stat_scores_update(preds, target, reduce=reduce, num_classes=c)
+    _assert_counts_equal(fast, slow)
+
+
+@pytest.mark.parametrize("reduce", REDUCES)
+@pytest.mark.parametrize("n,c", SHAPES)
+def test_label_inputs_parity(reduce, n, c, monkeypatch):
+    preds, target = _labels(n, c, seed=n * 17 + c)
+    fast = _stat_scores_update(preds, target, reduce=reduce, num_classes=c)
+    monkeypatch.setattr(ss_mod, "_multiclass_fast_path_eligible", lambda *a, **k: False)
+    slow = _stat_scores_update(preds, target, reduce=reduce, num_classes=c)
+    _assert_counts_equal(fast, slow)
+
+
+@pytest.mark.parametrize("reduce", REDUCES)
+def test_argmax_tie_breaking_parity(reduce, monkeypatch):
+    # repeated maxima: the scatter path must pick the FIRST argmax like
+    # select_topk on the broadcast path
+    preds = jnp.asarray(
+        [[1.0, 1.0, 0.0], [0.5, 0.7, 0.7], [2.0, 2.0, 2.0], [0.0, 1.0, 1.0]]
+    )
+    target = jnp.asarray([1, 2, 0, 2])
+    fast = _stat_scores_update(preds, target, reduce=reduce, num_classes=3)
+    monkeypatch.setattr(ss_mod, "_multiclass_fast_path_eligible", lambda *a, **k: False)
+    slow = _stat_scores_update(preds, target, reduce=reduce, num_classes=3)
+    _assert_counts_equal(fast, slow)
+
+
+def test_macro_ignore_index_parity(monkeypatch):
+    preds, target = _logits(50, 4, seed=9)
+    fast = _stat_scores_update(preds, target, reduce="macro", num_classes=4, ignore_index=2)
+    monkeypatch.setattr(ss_mod, "_multiclass_fast_path_eligible", lambda *a, **k: False)
+    slow = _stat_scores_update(preds, target, reduce="macro", num_classes=4, ignore_index=2)
+    _assert_counts_equal(fast, slow)
+
+
+@pytest.mark.parametrize("reduce", REDUCES)
+def test_sample_mask_matches_dropped_rows(reduce):
+    # masking the tail must equal running on the unpadded prefix
+    preds, target = _logits(40, 6, seed=5)
+    mask = jnp.arange(40) < 29
+    masked = _stat_scores_update(
+        preds, target, reduce=reduce, num_classes=6, sample_mask=mask
+    )
+    trimmed = _stat_scores_update(preds[:29], target[:29], reduce=reduce, num_classes=6)
+    if reduce == "samples":
+        # masked rows report all-zero counts; compare the valid prefix
+        masked = tuple(m[:29] for m in masked)
+    _assert_counts_equal(trimmed, masked)
+
+
+@pytest.mark.parametrize("reduce", REDUCES)
+def test_jit_parity(reduce):
+    preds, target = _logits(32, 5, seed=3)
+    eager = _stat_scores_update(preds, target, reduce=reduce, num_classes=5)
+    jitted = jax.jit(
+        lambda p, t: _stat_scores_update(p, t, reduce=reduce, num_classes=5)
+    )(preds, target)
+    _assert_counts_equal(eager, jitted)
+
+
+def test_fast_path_eligibility_boundaries():
+    preds, target = _logits(8, 3, seed=0)
+    assert _multiclass_fast_path_eligible(preds, target, "macro", None, None, None)
+    assert _multiclass_fast_path_eligible(preds, target, "macro", 1, None, None)
+    # broadcast-only configurations must be rejected
+    assert not _multiclass_fast_path_eligible(preds, target, "macro", 2, None, None)
+    assert not _multiclass_fast_path_eligible(preds, target, "macro", None, False, None)
+    assert not _multiclass_fast_path_eligible(preds, target, "micro", None, None, 0)
+    probs = jnp.asarray(np.random.default_rng(0).random(8).astype(np.float32))
+    binary = jnp.asarray(np.random.default_rng(1).integers(0, 2, 8))
+    assert not _multiclass_fast_path_eligible(probs, binary, "micro", None, None, None)
+    ml_target = jnp.asarray(np.random.default_rng(2).integers(0, 2, (8, 3)))
+    assert not _multiclass_fast_path_eligible(preds, ml_target, "micro", None, None, None)

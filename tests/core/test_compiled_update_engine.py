@@ -1,0 +1,407 @@
+"""Compiled-update engine: cache dispatch, donation safety, bucketing, rebuilds.
+
+The engine (``metrics_tpu/core/engine.py``) makes plain ``metric.update()``
+hit a cached jitted ``update_state`` from the second call per input signature.
+These tests pin the dispatch contract: warmup-then-compile counting, the
+donation aliasing guard (a caller-held state reference must never be
+invalidated), bucketed-batch numeric parity against unpadded eager updates,
+and MetricCollection group rebuilds dropping stale fused executables.
+"""
+import pickle
+import time
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu
+from metrics_tpu import (
+    AUROC,
+    Accuracy,
+    CatMetric,
+    MeanMetric,
+    Metric,
+    MetricCollection,
+    Precision,
+    Recall,
+    StatScores,
+)
+from metrics_tpu.core import engine as engine_mod
+
+
+@pytest.fixture(autouse=True)
+def _engine_on():
+    metrics_tpu.set_compiled_update(True)
+    yield
+    metrics_tpu.set_compiled_update(None)
+
+
+def _data(n=64, c=5, seed=0):
+    rng = np.random.default_rng(seed)
+    preds = jnp.asarray(rng.standard_normal((n, c)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, c, n))
+    return preds, target
+
+
+# --------------------------------------------------------------------- cache --
+class TestCacheCounting:
+    def test_warmup_then_hit(self):
+        preds, target = _data()
+        m = StatScores(reduce="macro", num_classes=5)
+        for _ in range(4):
+            m.update(preds, target)
+        stats = m._update_engine.stats
+        assert stats.eager_calls == 1  # first call per signature runs eagerly
+        assert stats.cache_misses == 1  # second call compiles
+        assert stats.cache_hits == 2
+
+    def test_new_signature_recompiles(self):
+        preds, target = _data()
+        m = StatScores(reduce="macro", num_classes=5)
+        for _ in range(2):
+            m.update(preds, target)
+        m.update(preds[:16], target[:16])  # new aval -> new warmup
+        m.update(preds[:16], target[:16])
+        stats = m._update_engine.stats
+        assert stats.eager_calls == 2
+        assert stats.cache_misses == 2
+
+    def test_parity_with_eager(self):
+        preds, target = _data()
+        m = StatScores(reduce="macro", num_classes=5)
+        ref = StatScores(reduce="macro", num_classes=5, compiled_update=False)
+        for _ in range(5):
+            m.update(preds, target)
+            ref.update(preds, target)
+        assert ref._update_engine is None
+        np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+
+    def test_global_switch(self):
+        preds, target = _data()
+        metrics_tpu.set_compiled_update(False)
+        m = StatScores(reduce="macro", num_classes=5)
+        m.update(preds, target)
+        assert m._update_engine is None
+        # per-instance True overrides the global False
+        m2 = StatScores(reduce="macro", num_classes=5, compiled_update=True)
+        m2.update(preds, target)
+        m2.update(preds, target)
+        assert m2._update_engine.stats.compiled_calls == 1
+
+    def test_untraceable_update_falls_back_permanently(self):
+        class HostUpdate(Metric):
+            full_state_update = False
+
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+            def update(self, x):
+                if float(jnp.sum(x)) > -1e30:  # host readback: untraceable
+                    self.total = self.total + jnp.sum(x)
+
+            def compute(self):
+                return self.total
+
+        m = HostUpdate()
+        x = jnp.asarray([1.0, 2.0])
+        m.update(x)
+        with pytest.warns(UserWarning, match="compiled-update engine disabled"):
+            m.update(x)  # first compiled attempt fails the trace
+        assert m._update_engine.broken is not None
+        m.update(x)
+        assert float(m.compute()) == 9.0  # all three updates applied eagerly
+        assert m._update_engine.stats.compiled_calls == 0
+
+    def test_list_state_metric_stays_eager(self):
+        m = AUROC()  # unbounded list states -> not compilable
+        rng = np.random.default_rng(0)
+        p = jnp.asarray(rng.random(32).astype(np.float32))
+        t = jnp.asarray(rng.integers(0, 2, 32))
+        for _ in range(3):
+            m.update(p, t)
+        assert m._update_engine.stats.compiled_calls == 0
+
+
+# ------------------------------------------------------------------ donation --
+@pytest.mark.skipif(
+    not engine_mod.backend_supports_donation(), reason="backend has no buffer donation"
+)
+class TestDonationSafety:
+    def test_steady_state_donates(self):
+        preds, target = _data()
+        m = StatScores(reduce="macro", num_classes=5)
+        for _ in range(5):
+            m.update(preds, target)
+        # call 1 eager, call 2 compiles (plain probe), calls 3+ donate
+        assert m._update_engine.stats.donated_calls >= 2
+
+    def test_held_state_reference_survives(self):
+        preds, target = _data()
+        m = StatScores(reduce="macro", num_classes=5)
+        for _ in range(4):
+            m.update(preds, target)
+        held = m.tp  # caller keeps a reference into the state
+        donated_before = m._update_engine.stats.donated_calls
+        m.update(preds, target)
+        assert m._update_engine.stats.donated_calls == donated_before
+        assert not held.is_deleted()
+        _ = np.asarray(held)  # still readable
+        del held
+        m.update(preds, target)
+        m.update(preds, target)
+        assert m._update_engine.stats.donated_calls > donated_before  # resumes
+
+    def test_held_snapshot_survives(self):
+        preds, target = _data()
+        m = StatScores(reduce="macro", num_classes=5)
+        for _ in range(4):
+            m.update(preds, target)
+        snap = m.get_state()
+        donated_before = m._update_engine.stats.donated_calls
+        m.update(preds, target)
+        assert m._update_engine.stats.donated_calls == donated_before
+        assert all(not v.is_deleted() for v in snap.values())
+
+    def test_defaults_never_donated(self):
+        preds, target = _data()
+        m = StatScores(reduce="macro", num_classes=5)
+        for _ in range(4):
+            m.update(preds, target)
+        m.reset()  # state now aliases the registered defaults
+        donated_before = m._update_engine.stats.donated_calls
+        m.update(preds, target)
+        assert m._update_engine.stats.donated_calls == donated_before
+        assert all(not jnp.asarray(v).is_deleted() for v in m._defaults.values())
+
+    def test_donate_state_false_never_donates(self):
+        preds, target = _data()
+        m = StatScores(reduce="macro", num_classes=5, donate_state=False)
+        for _ in range(6):
+            m.update(preds, target)
+        assert m._update_engine.stats.compiled_calls >= 4
+        assert m._update_engine.stats.donated_calls == 0
+
+    def test_donated_catbuffer_updates_in_place(self):
+        rng = np.random.default_rng(0)
+        p = jnp.asarray(rng.random(128).astype(np.float32))
+        t = jnp.asarray(rng.integers(0, 2, 128))
+        m = AUROC(buffer_capacity=4096)
+        ref = AUROC(compiled_update=False)
+        for _ in range(6):
+            m.update(p, t)
+            ref.update(p, t)
+        assert m._update_engine.stats.donated_calls >= 2
+        np.testing.assert_allclose(
+            np.asarray(m.compute()), np.asarray(ref.compute()), rtol=1e-6
+        )
+
+
+# ----------------------------------------------------------------- bucketing --
+class TestBatchBuckets:
+    RAGGED = [100, 37, 64, 13, 100, 99, 5, 1]
+
+    def test_mask_path_parity(self):
+        rng = np.random.default_rng(1)
+        m = StatScores(reduce="macro", num_classes=5, batch_buckets=True)
+        ref = StatScores(reduce="macro", num_classes=5, compiled_update=False)
+        for n in self.RAGGED:
+            p = jnp.asarray(rng.standard_normal((n, 5)).astype(np.float32))
+            t = jnp.asarray(rng.integers(0, 5, n))
+            m.update(p, t)
+            ref.update(p, t)
+        np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+        stats = m._update_engine.stats
+        assert stats.bucketed_calls == len(self.RAGGED)
+        # ragged sizes collapse onto power-of-two buckets
+        assert len(m._update_engine._seen) <= 5
+
+    def test_chunk_path_parity(self):
+        rng = np.random.default_rng(2)
+        m = MeanMetric(batch_buckets=True)  # no sample_mask support -> chunks
+        ref = MeanMetric(compiled_update=False)
+        for n in self.RAGGED:
+            v = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+            m.update(v)
+            ref.update(v)
+        np.testing.assert_allclose(
+            np.asarray(m.compute()), np.asarray(ref.compute()), rtol=1e-5
+        )
+        assert m._update_engine.stats.bucketed_calls == len(self.RAGGED)
+
+    def test_chunk_path_cat_order(self):
+        rng = np.random.default_rng(3)
+        m = CatMetric(buffer_capacity=1024, batch_buckets=True)
+        ref = CatMetric(compiled_update=False)
+        for n in [10, 33, 7]:
+            v = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+            m.update(v)
+            ref.update(v)
+        np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(ref.compute()))
+
+
+# --------------------------------------------------------------- collections --
+class TestCollectionEngine:
+    def _coll(self, **kw):
+        return MetricCollection(
+            {
+                "precision": Precision(num_classes=5, average="macro"),
+                "recall": Recall(num_classes=5, average="macro"),
+                "acc": Accuracy(),
+            },
+            **kw,
+        )
+
+    def test_fused_parity(self):
+        preds, target = _data()
+        coll = self._coll()
+        ref = self._coll(compiled_update=False)
+        for _ in range(4):
+            coll.update(preds, target)
+            ref.update(preds, target)
+        r1, r2 = coll.compute(), ref.compute()
+        for k in r1:
+            np.testing.assert_allclose(np.asarray(r1[k]), np.asarray(r2[k]))
+        stats = coll._update_engine.stats
+        assert stats.eager_calls == 1 and stats.cache_misses == 1 and stats.cache_hits == 2
+
+    def test_group_rebuild_invalidates_engine(self):
+        preds, target = _data()
+        coll = self._coll()
+        for _ in range(3):
+            coll.update(preds, target)
+        stale = coll._update_engine
+        assert stale is not None
+        coll["f1"] = metrics_tpu.F1Score(num_classes=5, average="macro")
+        assert coll._update_engine is None  # rebuild dropped the stale executable
+        ref = MetricCollection(
+            {
+                "precision": Precision(num_classes=5, average="macro"),
+                "recall": Recall(num_classes=5, average="macro"),
+                "acc": Accuracy(),
+                "f1": metrics_tpu.F1Score(num_classes=5, average="macro"),
+            },
+            compiled_update=False,
+        )
+        for _ in range(3):
+            ref.update(preds, target)
+        coll.update(preds, target)  # pre-rebuild updates for old members kept
+        assert coll._update_engine is not stale
+        # the new member's counts cover only post-rebuild updates
+        f1_solo = metrics_tpu.F1Score(num_classes=5, average="macro", compiled_update=False)
+        f1_solo.update(preds, target)
+        np.testing.assert_allclose(
+            np.asarray(coll.compute()["f1"]), np.asarray(f1_solo.compute())
+        )
+
+    def test_collection_flag_false_leaves_member_engines(self):
+        preds, target = _data()
+        coll = self._coll(compiled_update=False)
+        for _ in range(3):
+            coll.update(preds, target)
+        assert coll._update_engine is None
+        # group leaders still compile through their own per-metric engines
+        leader = coll["precision"]
+        assert leader._update_engine is not None
+        assert leader._update_engine.stats.compiled_calls >= 1
+
+    def test_member_shared_state_protected_from_member_engine(self):
+        preds, target = _data(seed=4)
+        coll = MetricCollection(
+            {
+                "precision": Precision(num_classes=5, average="macro"),
+                "recall": Recall(num_classes=5, average="macro"),
+            },
+            compiled_update=False,  # eager loop shares leader state with members
+        )
+        for _ in range(4):
+            coll.update(preds, target)
+        recall = coll["recall"]
+        assert recall._shared_state_ids  # sharing recorded
+        # direct member updates must not donate the group-shared leaves
+        donated = recall._update_engine.stats.donated_calls if recall._update_engine else 0
+        recall.update(preds, target)
+        recall.update(preds, target)
+        precision = coll["precision"]
+        assert all(
+            not jnp.asarray(v).is_deleted() for v in precision.metric_state.values()
+        )
+
+
+# ------------------------------------------------------------- lifecycle ----
+class TestLifecycle:
+    def test_clone_and_pickle_drop_engine(self):
+        preds, target = _data()
+        m = StatScores(reduce="macro", num_classes=5)
+        for _ in range(3):
+            m.update(preds, target)
+        assert m._update_engine is not None
+        c = m.clone()
+        assert c._update_engine is None
+        c.update(preds, target)  # engine rebuilds lazily
+        p = pickle.loads(pickle.dumps(m))
+        assert p._update_engine is None
+        np.testing.assert_array_equal(np.asarray(p.compute()), np.asarray(m.compute()))
+
+    def test_reset_keeps_compiled_cache(self):
+        preds, target = _data()
+        m = StatScores(reduce="macro", num_classes=5)
+        for _ in range(3):
+            m.update(preds, target)
+        misses = m._update_engine.stats.cache_misses
+        m.reset()
+        m.update(preds, target)  # same signature: straight to the cached executable
+        assert m._update_engine.stats.cache_misses == misses
+        ref = StatScores(reduce="macro", num_classes=5, compiled_update=False)
+        ref.update(preds, target)
+        np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+
+
+# ------------------------------------------------ dispatch-overhead guard ----
+def test_jit_cached_dispatch_overhead_guard():
+    """Tier-1 perf guard: the stateful jit-cached ``update()`` must stay within
+    ~2x of driving the raw jitted ``update_state`` by hand (plus a fixed
+    per-call bookkeeping floor for signature hashing / stats)."""
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.random(256).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 2, 256).astype(np.int32))
+
+    raw = AUROC(buffer_capacity=256 * 64, compiled_update=False)
+    step = jax.jit(raw.update_state)
+    state = raw.init_state()
+    state = step(state, preds, target)
+    state = step(state, preds, target)
+    jax.block_until_ready(state)
+
+    def time_raw():
+        s = step(raw.init_state(), preds, target)
+        t0 = time.perf_counter()
+        for _ in range(64):
+            s = step(s, preds, target)
+        jax.block_until_ready(s)
+        return (time.perf_counter() - t0) / 64
+
+    stateful = AUROC(buffer_capacity=256 * 64)
+    for _ in range(3):
+        stateful.update(preds, target)  # warm both buffer signatures
+
+    def time_stateful():
+        stateful.reset()
+        stateful.update(preds, target)
+        t0 = time.perf_counter()
+        for _ in range(64):
+            stateful.update(preds, target)
+        jax.block_until_ready(stateful.preds.data)
+        return (time.perf_counter() - t0) / 64
+
+    raw_s = min(time_raw() for _ in range(3))
+    stateful_s = min(time_stateful() for _ in range(3))
+    assert stateful.supports_compiled_update
+    assert stateful._update_engine.stats.compiled_calls > 64
+    # 2x relative + 150us absolute floor absorbs timer noise on tiny steps
+    assert stateful_s <= 2.0 * raw_s + 150e-6, (
+        f"stateful jit-cached update too slow: {stateful_s * 1e6:.1f}us/step vs "
+        f"raw jitted {raw_s * 1e6:.1f}us/step"
+    )

@@ -9,6 +9,8 @@ the input space instead of relying on a handful of fixtures.
 """
 import numpy as np
 import pytest
+
+pytest.importorskip("hypothesis", reason="property-based tests need the `test` extra (pip install metrics-tpu[test])")
 from hypothesis import HealthCheck, assume, given, settings
 from hypothesis import strategies as st
 from hypothesis.extra.numpy import arrays

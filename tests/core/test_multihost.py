@@ -52,7 +52,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_gather_all_arrays_two_process(tmp_path):
+def test_gather_all_arrays_two_process(tmp_path, multiprocess_backend):
     child = tmp_path / "gather_child.py"
     child.write_text(_CHILD)
     port = _free_port()

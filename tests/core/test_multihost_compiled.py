@@ -116,7 +116,7 @@ def _run_two_ranks(tmp_path, child_src: str, marker: str) -> None:
         assert f"{marker} {rank}" in out
 
 
-def test_compiled_sync_spans_processes(tmp_path):
+def test_compiled_sync_spans_processes(tmp_path, multiprocess_backend):
     _run_two_ranks(tmp_path, _CHILD, "COMPILED_SYNC_OK")
 
 
@@ -170,7 +170,7 @@ _CHILD_GATHER = textwrap.dedent(
 )
 
 
-def test_compiled_cat_gather_spans_processes(tmp_path):
+def test_compiled_cat_gather_spans_processes(tmp_path, multiprocess_backend):
     """Buffered cat-state all_gather across process boundaries: the synced
     CatBuffer must hold every process's samples and compute the global AUROC."""
     _run_two_ranks(tmp_path, _CHILD_GATHER, "GATHER_SYNC_OK")

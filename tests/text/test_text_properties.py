@@ -5,6 +5,8 @@ eager reference math has by construction: identity, bounds, and symmetry of
 the underlying distance — searched over random corpora instead of fixtures.
 """
 import pytest
+
+pytest.importorskip("hypothesis", reason="property-based tests need the `test` extra (pip install metrics-tpu[test])")
 from hypothesis import HealthCheck, given, settings
 from hypothesis import strategies as st
 
