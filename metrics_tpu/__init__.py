@@ -52,7 +52,9 @@ from metrics_tpu.core import (  # noqa: F401
     CompositionalMetric,
     Metric,
     MetricCollection,
+    compiled_compute_enabled,
     compiled_update_enabled,
+    set_compiled_compute,
     set_compiled_update,
 )
 from metrics_tpu.detection import MeanAveragePrecision  # noqa: F401
@@ -124,6 +126,7 @@ __all__ = [
     # core
     "Metric", "MetricCollection", "CompositionalMetric", "CatBuffer",
     "set_compiled_update", "compiled_update_enabled",
+    "set_compiled_compute", "compiled_compute_enabled",
     # aggregation
     "CatMetric", "MaxMetric", "MeanMetric", "MinMetric", "SumMetric",
     # audio

@@ -8,8 +8,11 @@ curves; here they are also the *compiled-path* curve metrics: fixed
 ``(C, T)`` state, fully jittable update (the reference iterates thresholds in
 a python loop "to conserve memory"). The threshold counting dispatches per
 backend: a pallas kernel on TPU that streams ``(N, C)`` tiles through VMEM
-once (ops/classification/binned_pallas.py), the fused XLA ``(N, C, T)``
-broadcast compare elsewhere and under outer jit transforms.
+once (ops/classification/binned_pallas.py), the bucketize + histogram +
+cumsum scatter path elsewhere and under outer jit transforms — O(N*C + C*T)
+work instead of the naive ``(N, C, T)`` broadcast compare, which survives
+only as a parity-testing reference behind ``xla_impl="broadcast"`` /
+``METRICS_TPU_BINNED_XLA=broadcast``.
 """
 from __future__ import annotations
 

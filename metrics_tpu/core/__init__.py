@@ -2,10 +2,14 @@
 from metrics_tpu.core.collections import MetricCollection  # noqa: F401
 from metrics_tpu.core.buffers import CatBuffer  # noqa: F401
 from metrics_tpu.core.engine import (  # noqa: F401
+    CollectionComputeEngine,
     CollectionUpdateEngine,
+    CompiledComputeEngine,
     CompiledUpdateEngine,
     EngineStats,
+    compiled_compute_enabled,
     compiled_update_enabled,
+    set_compiled_compute,
     set_compiled_update,
 )
 from metrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: F401

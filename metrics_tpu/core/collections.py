@@ -30,6 +30,7 @@ from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tupl
 import jax
 
 from metrics_tpu.core.metric import Metric, StateDict
+from metrics_tpu.utils.data import _squeeze_if_scalar
 from metrics_tpu.utils.exceptions import MetricsUserError
 
 
@@ -46,6 +47,12 @@ class MetricCollection:
             see :mod:`metrics_tpu.core.engine`). ``None`` follows the global
             switch; ``False`` keeps the eager per-group loop (member metrics'
             own engines still apply).
+        compiled_compute: dispatch ``compute()`` through one fused jitted
+            executable over the group leaders' states (every member's finalize
+            in a single XLA call). ``None`` follows the global switch
+            (:func:`metrics_tpu.set_compiled_compute`); ``False`` keeps the
+            eager per-group loop (member metrics' own compute engines still
+            apply).
 
     Example:
         >>> import jax.numpy as jnp
@@ -71,6 +78,7 @@ class MetricCollection:
         postfix: Optional[str] = None,
         compute_groups: bool = True,
         compiled_update: Optional[bool] = None,
+        compiled_compute: Optional[bool] = None,
     ) -> None:
         self._metrics: Dict[str, Metric] = {}
         self.prefix = self._check_arg(prefix, "prefix")
@@ -78,7 +86,9 @@ class MetricCollection:
         self._enable_compute_groups = compute_groups
         self._groups: List[List[str]] = []
         self._compiled_update = compiled_update
+        self._compiled_compute = compiled_compute
         self._update_engine: Any = None  # lazily-built CollectionUpdateEngine
+        self._compute_engine: Any = None  # lazily-built CollectionComputeEngine
         self.add_metrics(metrics, *additional_metrics)
 
     @staticmethod
@@ -146,9 +156,10 @@ class MetricCollection:
 
     def _rebuild_groups(self) -> None:
         """Static grouping by update signature (no runtime probing)."""
-        # group membership is baked into the fused executable's closure, so any
-        # cached compiled update is stale the moment groups change
+        # group membership is baked into the fused executables' closures, so
+        # any cached compiled update/compute is stale the moment groups change
         self._update_engine = None
+        self._compute_engine = None
         self._groups = []
         if not self._enable_compute_groups:
             self._groups = [[k] for k in self.keys(keep_base=True)]
@@ -236,6 +247,19 @@ class MetricCollection:
             self._update_engine = _engine.CollectionUpdateEngine(self)
         return self._update_engine
 
+    def _maybe_compute_engine(self) -> Optional[Any]:
+        """The fused compiled-compute engine, or None when disabled."""
+        from metrics_tpu.core import engine as _engine
+
+        enabled = self._compiled_compute
+        if enabled is None:
+            enabled = _engine.compiled_compute_enabled()
+        if not enabled:
+            return None
+        if self._compute_engine is None:
+            self._compute_engine = _engine.CollectionComputeEngine(self)
+        return self._compute_engine
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Fused update: one update per compute group; members share the
         leader's (immutable) state by reference. Reference: :160-179.
@@ -261,7 +285,23 @@ class MetricCollection:
                     m._shared_state_ids = shared
 
     def compute(self) -> Dict[str, Any]:
-        """One sync per group, value per member. Reference: :241-253."""
+        """One sync per group, value per member. Reference: :241-253.
+
+        With the compiled-compute engine enabled (and no real distributed sync
+        or other escape hatch in play), the whole per-member loop below runs as
+        one cached jitted executable from the second call per state signature;
+        each member's ``_computed`` cache is populated from the fused result."""
+        engine = self._maybe_compute_engine()
+        if engine is not None and engine.eligible():
+            handled, values = engine.dispatch()
+            if handled:
+                res = {}
+                for group in self._groups:
+                    for name in group:
+                        m = self._metrics.__getitem__(name)
+                        m._computed = _squeeze_if_scalar(values[name])
+                        res[self._set_name(name)] = m._computed
+                return _flatten_results(res)
         res: Dict[str, Any] = {}
         for group in self._groups:
             leader = self._metrics.__getitem__(group[0])
@@ -354,14 +394,26 @@ class MetricCollection:
             out[group[0]] = leader.sync_states(states[group[0]], axis_name)
         return out
 
+    def sync_compute_state(
+        self, states: Dict[str, StateDict], axis_name: Optional[Union[str, Tuple[str, ...]]] = None
+    ) -> Dict[str, Any]:
+        """Pure fused sync+compute: one collective bundle per group feeding
+        every member's finalize, all in a single traceable function (call it
+        inside your ``shard_map`` eval step for one fused XLA program).
+        ``axis_name=None`` skips the sync stage (no-axis fast path)."""
+        if axis_name is not None:
+            states = self.sync_states(states, axis_name)
+        return self.compute_state(states)
+
     def __getstate__(self) -> Dict[str, Any]:
-        """Drop the fused engine (jitted executables close over ``self``);
-        clones/unpickled copies rebuild it lazily."""
-        return {k: v for k, v in self.__dict__.items() if k != "_update_engine"}
+        """Drop the fused engines (jitted executables close over ``self``);
+        clones/unpickled copies rebuild them lazily."""
+        return {k: v for k, v in self.__dict__.items() if k not in ("_update_engine", "_compute_engine")}
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)
         self._update_engine = None
+        self._compute_engine = None
 
     def __repr__(self) -> str:
         repr_str = self.__class__.__name__ + "(\n"

@@ -1,4 +1,4 @@
-"""The compiled-update engine: cached jit dispatch for the stateful facade.
+"""The compiled update+compute engines: cached jit dispatch for the stateful facade.
 
 ``Metric.update()`` historically ran the update computation eagerly, op by op —
 ``BENCH_r05.json`` measured the stateful ``catbuffer_auroc`` update at 353 us
@@ -7,6 +7,15 @@ that gap by default: the facade dispatches through a per-metric cache of jitted
 ``update_state`` executables keyed on (state pytree structure, input avals),
 so plain ``metric.update(preds, target)`` hits compiled code from its second
 call per input signature.
+
+``Metric.compute()`` gets the symmetric treatment (:class:`CompiledComputeEngine`):
+the facade dispatches through a per-instance cache of jitted
+``sync_states ∘ compute_state`` executables keyed on the state avals plus the
+resolved sync-axis context, so the whole finalize — and, inside a collective
+program, the sync collectives feeding it — is one XLA program instead of an
+eager op walk. ``MetricCollection.compute()`` fuses every compute group's
+finalize into a single jitted program over the group leaders' states
+(:class:`CollectionComputeEngine`), mirroring the fused group update.
 
 Design points:
 
@@ -31,8 +40,10 @@ Design points:
   ``log2(max_batch)`` signatures ever compile.
 
 Global switches: ``set_compiled_update(False)`` (or the environment variable
-``METRICS_TPU_COMPILED_UPDATE=0``) disables the engine process-wide;
-``Metric(..., compiled_update=False)`` disables it per instance.
+``METRICS_TPU_COMPILED_UPDATE=0``) disables the update engine process-wide and
+``set_compiled_compute(False)`` / ``METRICS_TPU_COMPILED_COMPUTE=0`` the
+compute engine; ``Metric(..., compiled_update=False)`` /
+``Metric(..., compiled_compute=False)`` disable them per instance.
 """
 from __future__ import annotations
 
@@ -45,6 +56,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.parallel import sync as _sync
 from metrics_tpu.utils.checks import _tracing_active
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -52,15 +64,17 @@ from metrics_tpu.utils.prints import rank_zero_warn
 _WARMUP_CALLS = 1
 
 _ENV_FLAG = "METRICS_TPU_COMPILED_UPDATE"
+_ENV_FLAG_COMPUTE = "METRICS_TPU_COMPILED_COMPUTE"
 
 _SCALAR_TYPES = (int, float, bool, complex, np.number, np.bool_)
 
 
-def _env_default() -> bool:
-    return os.environ.get(_ENV_FLAG, "1").lower() not in ("0", "false", "off")
+def _env_default(flag: str = _ENV_FLAG) -> bool:
+    return os.environ.get(flag, "1").lower() not in ("0", "false", "off")
 
 
 _global_enabled: Optional[bool] = None  # None = follow the environment
+_global_compute_enabled: Optional[bool] = None  # None = follow the environment
 
 
 def compiled_update_enabled() -> bool:
@@ -77,6 +91,22 @@ def set_compiled_update(enabled: Optional[bool]) -> None:
     """
     global _global_enabled
     _global_enabled = enabled
+
+
+def compiled_compute_enabled() -> bool:
+    """Whether the compiled-compute engine is globally enabled."""
+    return _env_default(_ENV_FLAG_COMPUTE) if _global_compute_enabled is None else _global_compute_enabled
+
+
+def set_compiled_compute(enabled: Optional[bool]) -> None:
+    """Globally enable/disable the compiled-compute engine.
+
+    ``None`` restores the environment default (``METRICS_TPU_COMPILED_COMPUTE``,
+    on unless set to ``0``). Per-instance ``compiled_compute=`` flags take
+    precedence over this switch in both directions.
+    """
+    global _global_compute_enabled
+    _global_compute_enabled = enabled
 
 
 def backend_supports_donation() -> bool:
@@ -167,6 +197,11 @@ _DONATION_MAX_REFS = 5
 class _EngineBase:
     """Shared dispatch machinery; subclasses provide the pure fn + bookkeeping."""
 
+    # which facade path this engine accelerates; drives the fallback warning
+    _kind = "update"
+    _target = "update_state"
+    _opt_out = "compiled_update=False"
+
     def __init__(self, donate: bool) -> None:
         self.stats = EngineStats()
         self._seen: Dict[Any, int] = {}
@@ -183,10 +218,10 @@ class _EngineBase:
         """Why the engine permanently fell back to eager mode (None = healthy)."""
         return self._broken
 
-    def _dispatch(self, pure_fn: Callable, plain_fn: Callable, donate_fn: Callable,
+    def _dispatch(self, plain_fn: Callable, donate_fn: Callable,
                   state: Any, args: Tuple, kwargs: Dict, protected: set) -> Tuple[bool, Any]:
-        """Core cache dance. Returns (handled, new_state)."""
-        key = (_aval_signature((args, kwargs)), _aval_signature(state)[0])
+        """Core cache dance. Returns (handled, result)."""
+        key = (_aval_signature((args, kwargs)), _aval_signature(state))
         count = self._seen.get(key, 0)
         self._seen[key] = count + 1
         if count < _WARMUP_CALLS:
@@ -204,12 +239,12 @@ class _EngineBase:
         fn = donate_fn if donate_ok else plain_fn
         try:
             new_state = fn(state, *args, **kwargs)
-        except Exception as err:  # untraceable update: revert to eager for good
+        except Exception as err:  # untraceable target: revert to eager for good
             self._broken = f"{type(err).__name__}: {err}"
             rank_zero_warn(
-                f"compiled-update engine disabled for {type(self).__name__} target: "
-                f"update_state raised under jit tracing ({self._broken.splitlines()[0][:200]}). "
-                "Reverting to eager updates; pass compiled_update=False to silence.",
+                f"compiled-{self._kind} engine disabled for {type(self).__name__} target: "
+                f"{self._target} raised under jit tracing ({self._broken.splitlines()[0][:200]}). "
+                f"Reverting to eager {self._kind}s; pass {self._opt_out} to silence.",
                 UserWarning,
             )
             return False, None
@@ -270,7 +305,7 @@ class CompiledUpdateEngine(_EngineBase):
         state = m.get_state()
         shared = m._shared_state_ids
         handled, new_state = self._dispatch(
-            m.update_state, self._jit_plain, self._jit_donate, state, args, kwargs,
+            self._jit_plain, self._jit_donate, state, args, kwargs,
             self._default_ids | shared if shared else self._default_ids,
         )
         if handled:
@@ -387,7 +422,7 @@ class CollectionUpdateEngine(_EngineBase):
                 for key in member._defaults:
                     setattr(member, key, None)
         handled, new_states = self._dispatch(
-            coll.update_state, self._jit_plain, self._jit_donate, states, args, kwargs,
+            self._jit_plain, self._jit_donate, states, args, kwargs,
             self._default_ids,
         )
         if not handled:
@@ -407,3 +442,111 @@ class CollectionUpdateEngine(_EngineBase):
                 member._computed = None
                 member._shared_state_ids = shared
         return True
+
+
+class CompiledComputeEngine(_EngineBase):
+    """Per-metric cache of jitted ``sync_states ∘ compute_state`` executables.
+
+    Created lazily by ``Metric.compute()`` on first eligible call. The jitted
+    unit is :meth:`Metric.sync_compute_state`, so the sync stage is part of the
+    traced program: at facade-dispatch time the resolved axis context is always
+    ``None`` (inside a real collective program ``_tracing_active()`` keeps the
+    engine out of the way) and the no-axis fast path folds sync to identity —
+    one compile, one dispatch, no eager op walk over the finalize math.
+
+    The warmup/trace-probe lifecycle is shared with the update engine: the
+    first compute per state signature runs eagerly, the second compiles, and a
+    ``compute_state`` that cannot trace (host readbacks, value-dependent output
+    shapes such as ``CatBuffer.to_array``, string/dict outputs) permanently
+    reverts this instance to eager compute with a one-time warning.
+    """
+
+    _kind = "compute"
+    _target = "compute_state"
+    _opt_out = "compiled_compute=False"
+
+    def __init__(self, metric: Any) -> None:
+        super().__init__(donate=False)  # `_computed` memoizes; state stays live
+        self.metric = metric
+        self._has_children = bool(metric._child_metrics())
+        self._jit = jax.jit(metric.sync_compute_state, static_argnames=("axis_name",))
+
+    def dispatch(self) -> Tuple[bool, Any]:
+        """Try to produce the metric value through the jit cache.
+
+        Returns ``(handled, value)``; ``handled=False`` tells the facade to run
+        its eager sync+compute path itself.
+        """
+        m = self.metric
+        if self._broken is not None or self._has_children:
+            return False, None
+        if not m.supports_compiled_compute:
+            return False, None
+        # escape hatches stay eager: host offload, custom sync fn, and state
+        # that is (or is about to be) replaced by a real distributed sync
+        if m.compute_on_cpu or m.dist_sync_fn is not None or m._is_synced:
+            return False, None
+        if m._to_sync and _sync.distributed_available():
+            return False, None
+        if _tracing_active():
+            return False, None
+        state = m.get_state()
+        if not _leaves_compilable(state):
+            return False, None
+        return self._dispatch(self._jit, self._jit, state, (), {}, frozenset())
+
+
+class CollectionComputeEngine(_EngineBase):
+    """Fused jitted compute over a MetricCollection's compute groups.
+
+    Jits one function mapping ``{leader: state}`` to per-member raw values
+    (base names, unflattened), so a whole collection finalize — every group's
+    reduction math — runs as a single XLA program and each member's
+    ``_computed`` cache can still be populated from the result. Invalidated
+    whenever group membership changes (``MetricCollection._rebuild_groups``).
+    """
+
+    _kind = "compute"
+    _target = "compute_state"
+    _opt_out = "compiled_compute=False"
+
+    def __init__(self, collection: Any) -> None:
+        super().__init__(donate=False)
+        self.collection = collection
+        self._jit = jax.jit(self._member_values)
+
+    def _member_values(self, states: Dict[str, Any]) -> Dict[str, Any]:
+        coll = self.collection
+        return {
+            name: coll._metrics[name].compute_state(states[group[0]])
+            for group in coll._groups
+            for name in group
+        }
+
+    def eligible(self) -> bool:
+        coll = self.collection
+        if self._broken is not None or _tracing_active():
+            return False
+        for group in coll._groups:
+            leader = coll._metrics[group[0]]
+            if leader._to_sync and _sync.distributed_available():
+                return False  # real sync due: the eager per-group loop owns it
+            for name in group:
+                m = coll._metrics[name]
+                if getattr(m, "_compiled_compute", None) is False:
+                    return False
+                if m._child_metrics() or not m.supports_compiled_compute:
+                    return False
+                if m.compute_on_cpu or m.dist_sync_fn is not None or m._is_synced:
+                    return False
+                if m._update_count == 0:
+                    return False  # keep the eager loop's never-updated warning
+        return True
+
+    def dispatch(self) -> Tuple[bool, Any]:
+        """Returns ``(handled, {member_base_name: raw_value})``."""
+        coll = self.collection
+        states = {g[0]: coll._metrics[g[0]].get_state() for g in coll._groups}
+        if not _leaves_compilable(states):
+            return False, None
+        return self._dispatch(self._jit, self._jit, states, (), {}, frozenset())

@@ -90,6 +90,12 @@ class Metric:
             see :mod:`metrics_tpu.core.engine`). ``None`` (default) follows the
             global switch (:func:`metrics_tpu.set_compiled_update` /
             ``METRICS_TPU_COMPILED_UPDATE``); ``False`` forces eager updates.
+        compiled_compute: whether ``compute()`` dispatches through the
+            compiled-compute engine (cached jitted ``sync_states ∘
+            compute_state`` per state signature; see
+            :mod:`metrics_tpu.core.engine`). ``None`` (default) follows the
+            global switch (:func:`metrics_tpu.set_compiled_compute` /
+            ``METRICS_TPU_COMPILED_COMPUTE``); ``False`` forces eager computes.
         donate_state: allow the engine's steady-state executable to donate the
             state pytree (in-place buffer reuse on TPU/GPU). Aliased state
             (defaults, collection-shared) is detected and never donated.
@@ -131,6 +137,7 @@ class Metric:
         sync_on_compute: bool = True,
         buffer_capacity: Optional[int] = None,
         compiled_update: Optional[bool] = None,
+        compiled_compute: Optional[bool] = None,
         donate_state: bool = True,
         batch_buckets: bool = False,
         **kwargs: Any,
@@ -139,6 +146,8 @@ class Metric:
             raise ValueError(f"Unexpected keyword arguments: {list(kwargs)}")
         if compiled_update is not None and not isinstance(compiled_update, bool):
             raise ValueError(f"Expected keyword argument `compiled_update` to be a `bool` or None but got {compiled_update}")
+        if compiled_compute is not None and not isinstance(compiled_compute, bool):
+            raise ValueError(f"Expected keyword argument `compiled_compute` to be a `bool` or None but got {compiled_compute}")
         if not isinstance(donate_state, bool):
             raise ValueError(f"Expected keyword argument `donate_state` to be a `bool` but got {donate_state}")
         if not isinstance(batch_buckets, bool):
@@ -158,9 +167,11 @@ class Metric:
         self.sync_on_compute = sync_on_compute
         self.buffer_capacity = buffer_capacity
         self._compiled_update = compiled_update
+        self._compiled_compute = compiled_compute
         self._donate_state = donate_state
         self._batch_buckets = batch_buckets
         self._update_engine: Any = None  # lazily-built CompiledUpdateEngine
+        self._compute_engine: Any = None  # lazily-built CompiledComputeEngine
         self._shared_state_ids: frozenset = frozenset()  # leaves shared across a collection group
 
         self._defaults: Dict[str, StateValue] = {}
@@ -382,6 +393,27 @@ class Metric:
         called inside a ``shard_map``/``pmap`` program over that axis."""
         return _sync.sync_state(state, self._reductions, axis_name)
 
+    def sync_compute_state(self, state: StateDict, axis_name: Optional[Union[str, Tuple[str, ...]]] = None) -> Any:
+        """Pure fused sync+compute: the cross-device collectives (when
+        ``axis_name`` is given) and the downstream reduction in one traceable
+        function, so XLA fuses them into a single program. This is the unit
+        the compiled-compute engine jits, and the function to call inside your
+        own ``shard_map``/``pmap`` eval step for a fully fused epoch finalize.
+        ``axis_name=None`` skips the sync stage entirely (the no-axis fast
+        path), making the function jittable outside any collective program."""
+        if axis_name is not None:
+            state = self.sync_states(state, axis_name)
+        return self.compute_state(state)
+
+    @property
+    def supports_compiled_compute(self) -> bool:
+        """True when no state is an unbounded python list, i.e. ``compute_state``
+        *may* run under jit. This is the static gate only: computes that turn
+        out untraceable at runtime (host readbacks, ``CatBuffer.to_array``'s
+        value-dependent shape) are discovered by the engine's trace probe and
+        revert to eager permanently."""
+        return not any(isinstance(v, list) for v in self._defaults.values())
+
     # ------------------------------------------------------------------ #
     # stateful facade: forward / update / compute
     # ------------------------------------------------------------------ #
@@ -586,6 +618,20 @@ class Metric:
         yield
         self.unsync(should_unsync=self._is_synced and should_unsync)
 
+    def _maybe_compute_engine(self) -> Optional[Any]:
+        """The compiled-compute engine for this instance, or None when disabled
+        (per-instance flag first, then the global switch)."""
+        from metrics_tpu.core import engine as _engine
+
+        enabled = self._compiled_compute
+        if enabled is None:
+            enabled = _engine.compiled_compute_enabled()
+        if not enabled:
+            return None
+        if self._compute_engine is None:
+            self._compute_engine = _engine.CompiledComputeEngine(self)
+        return self._compute_engine
+
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:
@@ -598,6 +644,15 @@ class Metric:
                 )
             if self._computed is not None:
                 return self._computed
+            if not args and not kwargs:
+                # compiled path: one cached jitted sync∘compute executable per
+                # state signature (warmup/escape-hatch rules in the engine)
+                engine = self._maybe_compute_engine()
+                if engine is not None:
+                    handled, value = engine.dispatch()
+                    if handled:
+                        self._computed = _squeeze_if_scalar(value)
+                        return self._computed
             with self.sync_context(
                 dist_sync_fn=self.dist_sync_fn, should_sync=self._to_sync, should_unsync=self._should_unsync
             ):
@@ -641,17 +696,18 @@ class Metric:
 
     def __getstate__(self) -> Dict[str, Any]:
         """Drop the wrapped bound methods for pickling (reference: metric.py:573-577).
-        The compiled-update engine is dropped too (jitted executables close over
-        ``self``); clones/unpickled copies rebuild it lazily."""
+        The compiled update/compute engines are dropped too (jitted executables
+        close over ``self``); clones/unpickled copies rebuild them lazily."""
         return {
             k: v
             for k, v in self.__dict__.items()
-            if k not in ("update", "compute", "_update", "_compute", "_update_engine")
+            if k not in ("update", "compute", "_update", "_compute", "_update_engine", "_compute_engine")
         }
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)
         self._update_engine = None
+        self._compute_engine = None
         self.update = self._wrap_update(type(self).update.__get__(self))  # type: ignore[method-assign]
         self.compute = self._wrap_compute(type(self).compute.__get__(self))  # type: ignore[method-assign]
 

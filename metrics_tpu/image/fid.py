@@ -108,6 +108,10 @@ class FrechetInceptionDistance(Metric):
 
     def sync_states(self, state: Dict, axis_name) -> Dict:
         """All-gather the triples over the mesh axis and fold with Chan's combine."""
+        if axis_name is None:
+            # no-axis fast path (same contract as parallel.sync.sync_state):
+            # keeps sync_compute_state jittable outside collective programs
+            return dict(state)
         stacks = {k: lax.all_gather(v, axis_name, axis=0) for k, v in state.items()}
         world = stacks["real_n"].shape[0]
         out: Dict[str, Array] = {}

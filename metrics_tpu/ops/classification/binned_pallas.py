@@ -16,9 +16,12 @@ the same output block across grid steps is the standard accumulation pattern
 
 ``binned_stat_counts`` dispatches: Pallas on TPU backends (or when
 ``METRICS_TPU_PALLAS=1`` forces the interpreter elsewhere), the bucketized
-XLA path otherwise. Differential tests in
-tests/classification/test_binned_pallas.py pin kernel, bucketized, and
-broadcast paths to each other.
+XLA path otherwise — it is the default XLA formulation (BENCH_r06: 56 ms vs
+217 ms for the broadcast on the 4096x128x101 shape). The broadcast variant
+stays reachable behind ``xla_impl="broadcast"`` (or
+``METRICS_TPU_BINNED_XLA=broadcast``) purely for parity testing/debugging.
+Differential tests in tests/classification/test_binned_pallas.py pin kernel,
+bucketized, and broadcast paths to each other.
 """
 from __future__ import annotations
 
@@ -158,15 +161,29 @@ def _binned_counts_xla(preds: Array, target_bool: Array, thresholds: Array):
     )
 
 
-def binned_stat_counts(preds: Array, target_bool: Array, thresholds: Array, use_pallas: str = "auto"):
+def binned_stat_counts(
+    preds: Array, target_bool: Array, thresholds: Array, use_pallas: str = "auto", xla_impl: str = "scatter"
+):
     """``(TP, FP, FN)`` of shape ``(C, T)`` from ``(N, C)`` scores/targets.
 
     ``use_pallas``: ``"auto"`` (TPU backends only), ``"force"`` (interpret
     mode off-TPU — for tests), ``"never"``.
+
+    ``xla_impl`` picks the non-pallas formulation: ``"scatter"`` (default, the
+    O(N*C + C*T) bucketize + histogram + cumsum path) or ``"broadcast"`` (the
+    naive O(N*C*T) compare — kept only as a differential reference for parity
+    testing; ~4x slower on the bench shape). ``METRICS_TPU_BINNED_XLA=broadcast``
+    forces the broadcast path process-wide.
     """
     env = os.environ.get("METRICS_TPU_PALLAS")
     if use_pallas == "auto" and env is not None:
         use_pallas = "never" if env in ("0", "never") else "force"
+    env_xla = os.environ.get("METRICS_TPU_BINNED_XLA")
+    if env_xla is not None:
+        xla_impl = env_xla
+    if xla_impl not in ("scatter", "broadcast"):
+        raise ValueError(f"xla_impl must be 'scatter' or 'broadcast', got {xla_impl!r}")
+    xla_counts = _binned_counts_broadcast if xla_impl == "broadcast" else _binned_counts_xla
     if preds.shape[0] == 0:
         # zero grid steps would skip the kernel's init; the counts are zeros
         shape = (preds.shape[1], thresholds.shape[0])
@@ -179,7 +196,7 @@ def binned_stat_counts(preds: Array, target_bool: Array, thresholds: Array, use_
     # tracing for tests and for users who have validated their shapes.
     tracing = _is_traced(preds)
     if use_pallas == "never" or (use_pallas == "auto" and (not on_tpu or tracing)) or pl is None:
-        return _binned_counts_xla(preds, target_bool, thresholds)
+        return xla_counts(preds, target_bool, thresholds)
     interpret = not on_tpu
     try:
         return _binned_counts_pallas(preds, target_bool.astype(jnp.float32), thresholds, interpret=interpret)
@@ -187,4 +204,4 @@ def binned_stat_counts(preds: Array, target_bool: Array, thresholds: Array, use_
         from metrics_tpu.utils.prints import rank_zero_warn
 
         rank_zero_warn("pallas binned-count kernel failed to compile; falling back to the XLA path.")
-        return _binned_counts_xla(preds, target_bool, thresholds)
+        return xla_counts(preds, target_bool, thresholds)

@@ -101,14 +101,22 @@ def distributed_available() -> bool:
 # --------------------------------------------------------------------------- #
 # collective sync of a single state leaf
 # --------------------------------------------------------------------------- #
-def sync_array(x: Array, reduction: Optional[Union[str, Callable]], axis_name: AxisNames) -> Array:
+def sync_array(x: Array, reduction: Optional[Union[str, Callable]], axis_name: Optional[AxisNames]) -> Array:
     """Synchronize one state array across ``axis_name`` devices.
 
     sum/mean/max/min lower to a single fused collective (cheaper than the
     reference's gather-then-reduce, metric.py:361-372); ``cat``/None/callable
     all-gather along dim 0 (reference keeps gathered list and either concats or
     applies a custom callable on the stacked tensor).
+
+    ``axis_name=None`` is the no-axis fast path: outside any collective
+    context there is nothing to reduce over, so sync is the identity. This is
+    what lets ``sync_states ∘ compute_state`` be jitted unconditionally (the
+    compiled-compute engine) — under plain ``jit`` the sync stage folds away,
+    inside ``shard_map``/``pmap`` it emits the fused collectives.
     """
+    if axis_name is None:
+        return x
     if reduction == "sum":
         return lax.psum(x, axis_name)
     if reduction == "mean":
@@ -132,14 +140,17 @@ def sync_array(x: Array, reduction: Optional[Union[str, Callable]], axis_name: A
 def sync_state(
     state: Dict[str, Any],
     reductions: Dict[str, Optional[Union[str, Callable]]],
-    axis_name: AxisNames,
+    axis_name: Optional[AxisNames],
 ) -> Dict[str, Any]:
     """Synchronize a whole state pytree by per-state reduction tag.
 
     List states (unbounded ``cat`` buffers) are concatenated locally first so
     each state costs exactly one collective — same optimization the reference
-    applies at metric.py:350-352.
+    applies at metric.py:350-352. ``axis_name=None`` is the no-axis identity
+    fast path (see :func:`sync_array`): the state is returned unchanged.
     """
+    if axis_name is None:
+        return dict(state)
     from metrics_tpu.core.buffers import CatBuffer
 
     out = {}

@@ -103,6 +103,28 @@ def test_binned_curve_metric_uses_kernel(monkeypatch):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
 
 
+def test_xla_impl_flag_selects_formulation(monkeypatch):
+    """`xla_impl` / METRICS_TPU_BINNED_XLA pick the XLA formulation: scatter
+    (default) and broadcast must agree exactly; bad values must raise."""
+    preds = jnp.asarray(_rng.uniform(size=(90, 3)).astype(np.float32))
+    target = jnp.asarray(_rng.integers(0, 2, size=(90, 3)).astype(bool))
+    thresholds = jnp.linspace(0.0, 1.0, 13)
+    default = binned_stat_counts(preds, target, thresholds, use_pallas="never")
+    scatter = binned_stat_counts(preds, target, thresholds, use_pallas="never", xla_impl="scatter")
+    broadcast = binned_stat_counts(preds, target, thresholds, use_pallas="never", xla_impl="broadcast")
+    for d, s, b in zip(default, scatter, broadcast):
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(s))
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(b))
+    # the env var overrides the argument process-wide
+    monkeypatch.setenv("METRICS_TPU_BINNED_XLA", "broadcast")
+    env_forced = binned_stat_counts(preds, target, thresholds, use_pallas="never")
+    for d, e in zip(default, env_forced):
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(e))
+    monkeypatch.setenv("METRICS_TPU_BINNED_XLA", "bogus")
+    with pytest.raises(ValueError, match="xla_impl"):
+        binned_stat_counts(preds, target, thresholds, use_pallas="never")
+
+
 def test_empty_batch_returns_zeros():
     got = binned_stat_counts(
         jnp.zeros((0, 3)), jnp.zeros((0, 3), bool), jnp.linspace(0, 1, 5), use_pallas="force"

@@ -1,0 +1,449 @@
+"""Compiled-compute engine: cached sync∘compute dispatch, fallback, fusion.
+
+The engine (``metrics_tpu/core/engine.py``) makes plain ``metric.compute()``
+hit a cached jitted ``sync_states ∘ compute_state`` from the second call per
+state signature, and fuses ``MetricCollection.compute()`` into one program
+over the group leaders' states. These tests pin the dispatch contract:
+warmup-then-compile counting, ``_computed`` memoization skipping the engine,
+eager parity across one metric per domain package, the permanent eager
+fallback for untraceable ``compute_state``, bitwise sync parity of the fused
+``sync_compute_state`` against the eager sync+compute on the 8-device CPU
+mesh, and the dispatch-overhead guard against a hand-jitted compute_state.
+"""
+import pickle
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu
+from metrics_tpu import (
+    AUROC,
+    Accuracy,
+    F1Score,
+    MeanMetric,
+    MeanSquaredError,
+    Metric,
+    MetricCollection,
+    PeakSignalNoiseRatio,
+    Precision,
+    Recall,
+    RetrievalRecall,
+    SignalNoiseRatio,
+    StatScores,
+    WordErrorRate,
+)
+from metrics_tpu.parallel.sync import sync_state
+
+
+@pytest.fixture(autouse=True)
+def _engine_on():
+    metrics_tpu.set_compiled_compute(True)
+    yield
+    metrics_tpu.set_compiled_compute(None)
+
+
+def _data(n=64, c=5, seed=0):
+    rng = np.random.default_rng(seed)
+    preds = jnp.asarray(rng.standard_normal((n, c)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, c, n))
+    return preds, target
+
+
+# --------------------------------------------------------------------- cache --
+class TestCacheCounting:
+    def test_warmup_then_hit(self):
+        preds, target = _data()
+        m = StatScores(reduce="macro", num_classes=5)
+        for _ in range(3):
+            m.update(preds, target)  # update resets _computed -> real dispatches
+            m.compute()
+        stats = m._compute_engine.stats
+        assert stats.eager_calls == 1  # first compute per state signature is eager
+        assert stats.cache_misses == 1  # second compiles
+        assert stats.cache_hits == 1
+
+    def test_memoized_compute_skips_engine(self):
+        preds, target = _data()
+        m = Accuracy()
+        m.update(preds, target)
+        v1 = m.compute()
+        stats_before = m._compute_engine.stats.eager_calls
+        v2 = m.compute()  # `_computed` memo: no second dispatch
+        assert v1 is v2
+        assert m._compute_engine.stats.eager_calls == stats_before
+
+    def test_global_switch(self):
+        preds, target = _data()
+        metrics_tpu.set_compiled_compute(False)
+        m = Accuracy()
+        m.update(preds, target)
+        m.compute()
+        assert m._compute_engine is None
+        # per-instance True overrides the global False
+        m2 = Accuracy(compiled_compute=True)
+        for _ in range(2):
+            m2.update(preds, target)
+            m2.compute()
+        assert m2._compute_engine.stats.compiled_calls == 1
+
+    def test_list_state_metric_stays_eager(self):
+        m = AUROC()  # unbounded list states -> compute not compilable
+        rng = np.random.default_rng(0)
+        p = jnp.asarray(rng.random(32).astype(np.float32))
+        t = jnp.asarray(rng.integers(0, 2, 32))
+        for _ in range(3):
+            m.update(p, t)
+            m.compute()
+            m._computed = None
+        assert not m.supports_compiled_compute
+        assert m._compute_engine.stats.compiled_calls == 0
+
+    def test_untraceable_compute_falls_back_permanently(self):
+        class HostCompute(Metric):
+            full_state_update = False
+
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+            def update(self, x):
+                self.total = self.total + jnp.sum(x)
+
+            def compute(self):
+                if float(self.total) > -1e30:  # host readback: untraceable
+                    return self.total + 0.0
+                return self.total
+
+        m = HostCompute()
+        x = jnp.asarray([1.0, 2.0])
+        m.update(x)
+        assert float(m.compute()) == 3.0  # warmup: eager
+        m.update(x)
+        with pytest.warns(UserWarning, match="compiled-compute engine disabled"):
+            m.compute()  # first compiled attempt fails the trace
+        assert m._compute_engine.broken is not None
+        m.update(x)
+        assert float(m.compute()) == 9.0  # all computes applied eagerly
+        assert m._compute_engine.stats.compiled_calls == 0
+
+
+# ------------------------------------------------------------- domain sweep --
+def _cls_data(seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.standard_normal((64, 5)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, 5, 64)),
+    )
+
+
+def _pair_data(seed=1):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.random(64).astype(np.float32)),
+        jnp.asarray(rng.random(64).astype(np.float32)),
+    )
+
+
+def _retrieval_data(seed=3):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.random(24).astype(np.float32)),
+        jnp.asarray(rng.integers(0, 2, 24)),
+        jnp.asarray(np.repeat(np.arange(4), 6)),
+    )
+
+
+DOMAIN_CASES = [
+    pytest.param(lambda **kw: Accuracy(**kw), _cls_data, id="classification-accuracy"),
+    pytest.param(lambda **kw: MeanSquaredError(**kw), _pair_data, id="regression-mse"),
+    pytest.param(
+        lambda **kw: MeanMetric(**kw),
+        lambda: (jnp.asarray(np.random.default_rng(2).random(64).astype(np.float32)),),
+        id="aggregation-mean",
+    ),
+    pytest.param(
+        lambda **kw: PeakSignalNoiseRatio(data_range=1.0, **kw),
+        lambda: tuple(x.reshape(4, 4, 4) for x in _pair_data(4)),
+        id="image-psnr",
+    ),
+    pytest.param(
+        lambda **kw: WordErrorRate(**kw),
+        lambda: (["hello world foo", "bar baz"], ["hello word foo", "bar baz qux"]),
+        id="text-wer",
+    ),
+    pytest.param(
+        lambda **kw: SignalNoiseRatio(**kw),
+        lambda: tuple(x.reshape(8, 8) for x in _pair_data(5)),
+        id="audio-snr",
+    ),
+    pytest.param(
+        lambda **kw: RetrievalRecall(
+            max_queries=8, max_docs_per_query=32, buffer_capacity=128, **kw
+        ),
+        _retrieval_data,
+        id="retrieval-recall",
+    ),
+]
+
+
+@pytest.mark.parametrize("build, data", DOMAIN_CASES)
+def test_compiled_vs_eager_compute_parity(build, data):
+    """One metric per domain package: 3 update/compute rounds, compiled path
+    must match the eager facade exactly and actually hit the jit cache."""
+    m = build()
+    ref = build(compiled_compute=False)
+    args = data()
+    for _ in range(3):
+        m.update(*args)
+        ref.update(*args)
+        got, want = m.compute(), ref.compute()
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6
+        )
+    assert ref._compute_engine is None
+    assert m.supports_compiled_compute
+    assert m._compute_engine is not None
+    assert m._compute_engine.broken is None
+    assert m._compute_engine.stats.compiled_calls >= 1
+
+
+# ------------------------------------------------------------------ syncing --
+WORLD = 8
+
+
+@pytest.fixture()
+def mesh():
+    devices = jax.devices()
+    if len(devices) < WORLD:
+        pytest.skip("needs 8 devices")
+    return Mesh(np.asarray(devices[:WORLD]), ("data",))
+
+
+def test_sync_state_no_axis_is_identity():
+    m = StatScores(reduce="macro", num_classes=5, compiled_compute=False)
+    preds, target = _data()
+    m.update(preds, target)
+    state = m.get_state()
+    out = sync_state(state, m._reductions, None)
+    assert set(out) == set(state)
+    for k in state:
+        assert out[k] is state[k]  # fast path: no collective, no copy
+
+
+def test_plain_jit_sync_compute_folds_sync(mesh):
+    """Outside any collective program, jit(sync_compute_state) == compute."""
+    m = StatScores(reduce="macro", num_classes=5, compiled_compute=False)
+    preds, target = _data()
+    m.update(preds, target)
+    state = m.get_state()
+    fused = jax.jit(m.sync_compute_state)(state)
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(m.compute()))
+
+
+def test_fused_sync_compute_bitwise_parity(mesh):
+    """The engine's jitted unit (sync_states ∘ compute_state) must be
+    bitwise-identical to the eager two-step sync inside a shard_map."""
+    m = StatScores(reduce="macro", num_classes=5, compiled_compute=False)
+
+    def fused(x):
+        state = m.update_state(m.init_state(), x[0], x[1])
+        return jnp.expand_dims(m.sync_compute_state(state, axis_name="data"), 0)
+
+    def eager(x):
+        state = m.update_state(m.init_state(), x[0], x[1])
+        state = m.sync_states(state, "data")
+        return jnp.expand_dims(m.compute_state(state), 0)
+
+    rng = np.random.default_rng(7)
+    preds = jnp.asarray(rng.standard_normal((WORLD, 16, 5)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 5, (WORLD, 16)))
+
+    def run(body):
+        return np.asarray(
+            jax.jit(
+                shard_map(
+                    lambda p, t: body((p[0], t[0])),
+                    mesh=mesh,
+                    in_specs=P("data"),
+                    out_specs=P("data"),
+                    check_rep=False,
+                )
+            )(preds, target)
+        )
+
+    np.testing.assert_array_equal(run(fused), run(eager))  # bitwise
+
+
+def test_mean_reduction_fused_sync_parity(mesh):
+    m = MeanSquaredError(compiled_compute=False)
+
+    def fused(p, t):
+        state = m.update_state(m.init_state(), p[0], t[0])
+        return jnp.expand_dims(m.sync_compute_state(state, axis_name="data"), 0)
+
+    rng = np.random.default_rng(8)
+    preds = jnp.asarray(rng.random((WORLD, 32)).astype(np.float32))
+    target = jnp.asarray(rng.random((WORLD, 32)).astype(np.float32))
+    out = np.asarray(
+        jax.jit(
+            shard_map(fused, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_rep=False)
+        )(preds[:, None], target[:, None])
+    )
+    ref = MeanSquaredError(compiled_compute=False)
+    ref.update(preds.reshape(-1), target.reshape(-1))
+    np.testing.assert_allclose(out, float(ref.compute()), rtol=1e-6)
+    assert np.all(out == out[0])  # identical on every device
+
+
+# --------------------------------------------------------------- collections --
+class TestCollectionComputeEngine:
+    def _coll(self, **kw):
+        return MetricCollection(
+            {
+                "precision": Precision(num_classes=5, average="macro"),
+                "recall": Recall(num_classes=5, average="macro"),
+                "acc": Accuracy(),
+            },
+            **kw,
+        )
+
+    def test_fused_parity(self):
+        preds, target = _data()
+        coll = self._coll()
+        ref = self._coll(compiled_compute=False)
+        for member in ref.values():
+            member._compiled_compute = False
+        for _ in range(3):
+            coll.update(preds, target)
+            ref.update(preds, target)
+            r1, r2 = coll.compute(), ref.compute()
+            assert set(r1) == set(r2)
+            for k in r1:
+                np.testing.assert_allclose(np.asarray(r1[k]), np.asarray(r2[k]))
+        stats = coll._compute_engine.stats
+        assert stats.eager_calls == 1 and stats.cache_misses == 1 and stats.cache_hits == 1
+
+    def test_fused_compute_populates_member_memo(self):
+        preds, target = _data()
+        coll = self._coll()
+        for _ in range(2):
+            coll.update(preds, target)
+            res = coll.compute()
+        for name in ("precision", "recall", "acc"):
+            member = coll[name]
+            assert member._computed is not None
+            np.testing.assert_allclose(
+                np.asarray(member._computed), np.asarray(res[name])
+            )
+
+    def test_group_rebuild_invalidates_engine(self):
+        preds, target = _data()
+        coll = self._coll()
+        for _ in range(2):
+            coll.update(preds, target)
+            coll.compute()
+        stale = coll._compute_engine
+        assert stale is not None
+        coll["f1"] = F1Score(num_classes=5, average="macro")
+        assert coll._compute_engine is None  # rebuild dropped the stale executable
+        coll.update(preds, target)
+        f1_solo = F1Score(num_classes=5, average="macro", compiled_compute=False)
+        f1_solo.update(preds, target)
+        np.testing.assert_allclose(
+            np.asarray(coll.compute()["f1"]), np.asarray(f1_solo.compute())
+        )
+
+    def test_member_opt_out_disables_fusion(self):
+        preds, target = _data()
+        coll = self._coll()
+        coll["acc"]._compiled_compute = False
+        coll.update(preds, target)
+        coll.update(preds, target)
+        coll.compute()
+        engine = coll._compute_engine
+        assert engine is None or engine.stats.compiled_calls == 0
+
+
+# ------------------------------------------------------------- lifecycle ----
+class TestLifecycle:
+    def test_clone_and_pickle_drop_engine(self):
+        preds, target = _data()
+        m = StatScores(reduce="macro", num_classes=5)
+        for _ in range(3):
+            m.update(preds, target)
+            m.compute()
+        assert m._compute_engine is not None
+        c = m.clone()
+        assert c._compute_engine is None
+        c.update(preds, target)
+        c.compute()  # engine rebuilds lazily
+        p = pickle.loads(pickle.dumps(m))
+        assert p._compute_engine is None
+        np.testing.assert_array_equal(np.asarray(p.compute()), np.asarray(m.compute()))
+
+    def test_reset_keeps_compiled_cache(self):
+        preds, target = _data()
+        m = StatScores(reduce="macro", num_classes=5)
+        for _ in range(3):
+            m.update(preds, target)
+            m.compute()
+        misses = m._compute_engine.stats.cache_misses
+        m.reset()
+        m.update(preds, target)
+        m.compute()  # same state signature: straight to the cached executable
+        assert m._compute_engine.stats.cache_misses == misses
+        ref = StatScores(reduce="macro", num_classes=5, compiled_compute=False)
+        ref.update(preds, target)
+        np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+
+
+# ------------------------------------------------ dispatch-overhead guard ----
+def test_compute_dispatch_overhead_guard():
+    """Tier-1 perf guard: the stateful jit-cached ``compute()`` must stay
+    within ~2x of driving the raw jitted ``compute_state`` by hand (plus a
+    fixed per-call bookkeeping floor for signature hashing / stats)."""
+    preds, target = _data(n=256)
+    raw = StatScores(reduce="macro", num_classes=5, compiled_compute=False)
+    raw.update(preds, target)
+    state = raw.get_state()
+    fn = jax.jit(raw.compute_state)
+    jax.block_until_ready(fn(state))
+
+    def time_raw():
+        jax.block_until_ready(fn(state))
+        t0 = time.perf_counter()
+        for _ in range(64):
+            out = fn(state)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / 64
+
+    stateful = StatScores(reduce="macro", num_classes=5)
+    stateful.update(preds, target)
+    for _ in range(3):  # warmup sighting + compile + first cached hit
+        stateful._computed = None
+        stateful.compute()
+
+    def time_stateful():
+        stateful._computed = None
+        jax.block_until_ready(stateful.compute())
+        t0 = time.perf_counter()
+        for _ in range(64):
+            stateful._computed = None
+            out = stateful.compute()
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / 64
+
+    raw_s = min(time_raw() for _ in range(3))
+    stateful_s = min(time_stateful() for _ in range(3))
+    assert stateful.supports_compiled_compute
+    assert stateful._compute_engine.stats.compiled_calls > 64
+    # 2x relative + 150us absolute floor absorbs timer noise on tiny steps
+    assert stateful_s <= 2.0 * raw_s + 150e-6, (
+        f"stateful jit-cached compute too slow: {stateful_s * 1e6:.1f}us/call vs "
+        f"raw jitted {raw_s * 1e6:.1f}us/call"
+    )
