@@ -357,6 +357,88 @@ def bench_collection_facade() -> float:
     return (time.perf_counter() - t0) / STEPS * 1e6
 
 
+def bench_collection_fused_update() -> dict:
+    """ISSUE-3 acceptance numbers: the fused collection update (ONE donated
+    jitted program per step, compute-group dedup) against the per-member
+    dispatch path (``fused_update=False, compute_groups=False``: every member
+    runs its own jitted executable per step — the pre-fusion facade cost),
+    plus a member-count sweep showing how the fused program scales."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, Recall
+
+    def build(**kw):
+        return MetricCollection(
+            {
+                "acc": Accuracy(num_classes=NUM_CLASSES, average="micro"),
+                "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+                "precision": Precision(num_classes=NUM_CLASSES, average="macro"),
+                "recall": Recall(num_classes=NUM_CLASSES, average="macro"),
+            },
+            **kw,
+        )
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(BATCH, NUM_CLASSES)), dtype=jnp.float32)
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=jnp.int32)
+
+    def timed_updates(coll, steps=STEPS, reps=3):
+        for _ in range(WARMUP):  # warmup sighting + compile probe + donate
+            coll.update(logits, target)
+
+        def one_rep():
+            coll.reset()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                coll.update(logits, target)
+            jax.block_until_ready(next(iter(coll.values())).get_state())
+            return (time.perf_counter() - t0) / steps * 1e6
+
+        return min(one_rep() for _ in range(reps))
+
+    fused_us = timed_updates(build())
+    permember_us = timed_updates(build(fused_update=False, compute_groups=False))
+
+    # member-count sweep: fused path only, small shapes (a 64-member
+    # per-member comparison would compile 64 separate executables). Cycling
+    # ignore_index yields distinct update signatures (cheap masking, unlike
+    # top_k's sort); equal-signature stat-scores members still dedup into
+    # shared compute groups — `compute_groups` records how far.
+    sweep = {}
+    classes, batch, steps = 64, 256, 8
+    s_logits = jnp.asarray(rng.normal(size=(batch, classes)), dtype=jnp.float32)
+    s_target = jnp.asarray(rng.integers(0, classes, size=(batch,)), dtype=jnp.int32)
+    makers = (Precision, Recall, F1Score)
+    for n_members in (4, 16, 64):
+        coll = MetricCollection(
+            {
+                f"m{i}": makers[i % len(makers)](
+                    num_classes=classes, average="macro", ignore_index=i // len(makers)
+                )
+                for i in range(n_members)
+            }
+        )
+        for _ in range(WARMUP):
+            coll.update(s_logits, s_target)
+        coll.reset()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            coll.update(s_logits, s_target)
+        jax.block_until_ready(next(iter(coll.values())).get_state())
+        sweep[f"members_{n_members}"] = {
+            "us_per_step": (time.perf_counter() - t0) / steps * 1e6,
+            "compute_groups": len(coll._groups),
+        }
+
+    return {
+        "fused_update_us_per_step": fused_us,
+        "permember_update_us_per_step": permember_us,
+        "fused_vs_permember": permember_us / fused_us if fused_us else None,
+        "member_sweep": sweep,
+    }
+
+
 def bench_collection_compute() -> dict:
     """Config-2 ``MetricCollection.compute()``: the fused compiled-compute
     facade (one cached jitted program for every member's finalize) vs the
@@ -606,6 +688,22 @@ def _sync_overhead_child() -> None:
     med = overheads[reps // 2]
     t_nosync = float(np.median([p[0] for p in pairs]))
     t_sync = float(np.median([p[1] for p in pairs]))
+
+    # trace-time collective counts: bucketed (default) vs per-leaf sync of
+    # this collection's leader states — the coalescing win, counted exactly
+    from metrics_tpu.parallel import count_collectives, set_bucketed_sync
+
+    def count_sync_collectives(bucketed: bool) -> int:
+        set_bucketed_sync(bucketed)
+        try:
+            with count_collectives() as box:
+                jax.make_jaxpr(
+                    lambda st: coll.sync_states(st, "data"), axis_env=[("data", world)]
+                )(coll.init_state())
+            return box["count"]
+        finally:
+            set_bucketed_sync(None)
+
     print(
         json.dumps(
             {
@@ -618,6 +716,8 @@ def _sync_overhead_child() -> None:
                 "reps": reps,
                 "world": world,
                 "samples": per_dev_batch * world * steps,
+                "sync_collectives_bucketed": count_sync_collectives(True),
+                "sync_collectives_per_leaf": count_sync_collectives(False),
             }
         )
     )
@@ -1401,6 +1501,7 @@ def main() -> None:
             "collection_scan_mfu": scan_mfu,
             "percall_us_per_step": ours_us,
             "facade_update_us_per_step": _num(_safe(bench_collection_facade)),
+            "fused_update": _safe(bench_collection_fused_update),
             "compute_us_per_step": _safe(bench_collection_compute),
             "reference_torch_us_per_step": ref_us,
             "vs_baseline_percall": round(ref_us / ours_us, 3) if ref_us else None,

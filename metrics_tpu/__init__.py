@@ -54,8 +54,10 @@ from metrics_tpu.core import (  # noqa: F401
     MetricCollection,
     compiled_compute_enabled,
     compiled_update_enabled,
+    fused_update_enabled,
     set_compiled_compute,
     set_compiled_update,
+    set_fused_update,
 )
 from metrics_tpu.detection import MeanAveragePrecision  # noqa: F401
 from metrics_tpu.image import (  # noqa: F401
@@ -71,6 +73,7 @@ from metrics_tpu.image import (  # noqa: F401
     StructuralSimilarityIndexMeasure,
     UniversalImageQualityIndex,
 )
+from metrics_tpu.parallel import bucketed_sync_enabled, set_bucketed_sync  # noqa: F401
 from metrics_tpu.retrieval import (  # noqa: F401
     RetrievalFallOut,
     RetrievalHitRate,
@@ -127,6 +130,8 @@ __all__ = [
     "Metric", "MetricCollection", "CompositionalMetric", "CatBuffer",
     "set_compiled_update", "compiled_update_enabled",
     "set_compiled_compute", "compiled_compute_enabled",
+    "set_fused_update", "fused_update_enabled",
+    "set_bucketed_sync", "bucketed_sync_enabled",
     # aggregation
     "CatMetric", "MaxMetric", "MeanMetric", "MinMetric", "SumMetric",
     # audio

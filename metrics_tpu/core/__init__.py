@@ -9,7 +9,9 @@ from metrics_tpu.core.engine import (  # noqa: F401
     EngineStats,
     compiled_compute_enabled,
     compiled_update_enabled,
+    fused_update_enabled,
     set_compiled_compute,
     set_compiled_update,
+    set_fused_update,
 )
 from metrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: F401

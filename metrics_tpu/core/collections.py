@@ -47,6 +47,12 @@ class MetricCollection:
             see :mod:`metrics_tpu.core.engine`). ``None`` follows the global
             switch; ``False`` keeps the eager per-group loop (member metrics'
             own engines still apply).
+        fused_update: the dedicated switch for the same fused engine, layered
+            on top of ``compiled_update``: the engine runs only when both
+            allow it. ``None`` follows the global switch
+            (:func:`metrics_tpu.set_fused_update` /
+            ``METRICS_TPU_FUSED_UPDATE``); ``False`` keeps the eager
+            per-group loop; ``True`` overrides a global ``set_fused_update(False)``.
         compiled_compute: dispatch ``compute()`` through one fused jitted
             executable over the group leaders' states (every member's finalize
             in a single XLA call). ``None`` follows the global switch
@@ -79,6 +85,7 @@ class MetricCollection:
         compute_groups: bool = True,
         compiled_update: Optional[bool] = None,
         compiled_compute: Optional[bool] = None,
+        fused_update: Optional[bool] = None,
     ) -> None:
         self._metrics: Dict[str, Metric] = {}
         self.prefix = self._check_arg(prefix, "prefix")
@@ -87,8 +94,12 @@ class MetricCollection:
         self._groups: List[List[str]] = []
         self._compiled_update = compiled_update
         self._compiled_compute = compiled_compute
+        self._fused_update = fused_update
         self._update_engine: Any = None  # lazily-built CollectionUpdateEngine
         self._compute_engine: Any = None  # lazily-built CollectionComputeEngine
+        # True while fused dispatches advance only the group leaders; members
+        # are detached (state attrs None) and realiased lazily at finalize
+        self._members_stale = False
         self.add_metrics(metrics, *additional_metrics)
 
     @staticmethod
@@ -154,8 +165,32 @@ class MetricCollection:
             raise ValueError("Unknown input to MetricCollection.")
         self._rebuild_groups()
 
+    def _realias_members(self) -> None:
+        """Rebind every group member to its leader's state (lazy finalize of
+        the fused engine's member-skip: leaders advance per step, members
+        alias here — once per observation instead of once per update)."""
+        if not self._members_stale:
+            return
+        self._members_stale = False
+        for group in self._groups:
+            if len(group) == 1:
+                continue
+            leader = self._metrics.__getitem__(group[0])
+            state = leader.get_state()
+            shared = frozenset(id(leaf) for leaf in jax.tree_util.tree_leaves(state))
+            leader._shared_state_ids = shared
+            for name in group[1:]:
+                m = self._metrics.__getitem__(name)
+                m.set_state(state)
+                m._update_count = leader._update_count
+                m._computed = None
+                m._shared_state_ids = shared
+
     def _rebuild_groups(self) -> None:
         """Static grouping by update signature (no runtime probing)."""
+        # members must be whole before membership changes: a member that moves
+        # to another group would otherwise keep its detached (None) state
+        self._realias_members()
         # group membership is baked into the fused executables' closures, so
         # any cached compiled update/compute is stale the moment groups change
         self._update_engine = None
@@ -191,14 +226,17 @@ class MetricCollection:
         return [self._set_name(k) for k in self._metrics.keys()]
 
     def items(self, keep_base: bool = False):  # type: ignore[override]
+        self._realias_members()
         if keep_base:
             return list(self._metrics.items())
         return [(self._set_name(k), v) for k, v in self._metrics.items()]
 
     def values(self):
+        self._realias_members()
         return list(self._metrics.values())
 
     def __getitem__(self, key: str) -> Metric:
+        self._realias_members()
         if key in self._metrics:
             return self._metrics[key]
         # allow lookup by prefixed name
@@ -235,9 +273,16 @@ class MetricCollection:
         return self.forward(*args, **kwargs)
 
     def _maybe_engine(self) -> Optional[Any]:
-        """The fused compiled-update engine, or None when disabled."""
+        """The fused compiled-update engine, or None when disabled (the
+        dedicated ``fused_update`` surface first, then the ``compiled_update``
+        umbrella; per-collection flags beat the globals in both directions)."""
         from metrics_tpu.core import engine as _engine
 
+        fused = self._fused_update
+        if fused is None:
+            fused = _engine.fused_update_enabled()
+        if not fused:
+            return None
         enabled = self._compiled_update
         if enabled is None:
             enabled = _engine.compiled_update_enabled()
@@ -283,6 +328,8 @@ class MetricCollection:
                     m._update_count = leader._update_count
                     m._computed = None
                     m._shared_state_ids = shared
+        # the loop above rebroadcast every multi-member group
+        self._members_stale = False
 
     def compute(self) -> Dict[str, Any]:
         """One sync per group, value per member. Reference: :241-253.
@@ -291,6 +338,9 @@ class MetricCollection:
         or other escape hatch in play), the whole per-member loop below runs as
         one cached jitted executable from the second call per state signature;
         each member's ``_computed`` cache is populated from the fused result."""
+        # fused updates advance only the leaders; members must be whole before
+        # the compute engine probes them (and before the eager loop below)
+        self._realias_members()
         engine = self._maybe_compute_engine()
         if engine is not None and engine.eligible():
             handled, values = engine.dispatch()
@@ -408,6 +458,8 @@ class MetricCollection:
     def __getstate__(self) -> Dict[str, Any]:
         """Drop the fused engines (jitted executables close over ``self``);
         clones/unpickled copies rebuild them lazily."""
+        # never capture detached (None) member states in a clone/pickle
+        self._realias_members()
         return {k: v for k, v in self.__dict__.items() if k not in ("_update_engine", "_compute_engine")}
 
     def __setstate__(self, state: Dict[str, Any]) -> None:

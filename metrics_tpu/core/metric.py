@@ -390,7 +390,14 @@ class Metric:
 
     def sync_states(self, state: StateDict, axis_name: Union[str, Tuple[str, ...]]) -> StateDict:
         """Pure: emit collectives over ``axis_name`` per reduction tag. Must be
-        called inside a ``shard_map``/``pmap`` program over that axis."""
+        called inside a ``shard_map``/``pmap`` program over that axis.
+
+        By default state leaves are coalesced by ``(reduction, dtype)`` into
+        one flat buffer per bucket, so a metric with many scalar counters
+        emits one ``psum`` instead of one collective per leaf (bitwise
+        identical to the per-leaf path; opt out with
+        :func:`metrics_tpu.parallel.set_bucketed_sync` or
+        ``METRICS_TPU_BUCKETED_SYNC=0``)."""
         return _sync.sync_state(state, self._reductions, axis_name)
 
     def sync_compute_state(self, state: StateDict, axis_name: Optional[Union[str, Tuple[str, ...]]] = None) -> Any:
@@ -400,7 +407,9 @@ class Metric:
         the compiled-compute engine jits, and the function to call inside your
         own ``shard_map``/``pmap`` eval step for a fully fused epoch finalize.
         ``axis_name=None`` skips the sync stage entirely (the no-axis fast
-        path), making the function jittable outside any collective program."""
+        path), making the function jittable outside any collective program.
+        The sync stage inherits the bucketed (coalesced) collectives of
+        :meth:`sync_states`."""
         if axis_name is not None:
             state = self.sync_states(state, axis_name)
         return self.compute_state(state)

@@ -1,11 +1,14 @@
 """Distributed/parallel layer (reference parity: torchmetrics/utilities/distributed.py)."""
 from metrics_tpu.parallel.mesh import batch_sharded, data_parallel_mesh, make_mesh, replicated  # noqa: F401
 from metrics_tpu.parallel.sync import (  # noqa: F401
+    bucketed_sync_enabled,
     class_reduce,
+    count_collectives,
     current_sync_axes,
     distributed_available,
     gather_all_arrays,
     reduce,
+    set_bucketed_sync,
     sync_array,
     sync_axes,
     sync_state,

@@ -23,6 +23,7 @@ the data axis only — exactly the reference's ``process_group`` kwarg
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -34,6 +35,60 @@ AxisNames = Union[str, Tuple[str, ...]]
 
 # Reduction vocabulary (reference: metric.py:196-207 resolves these at add_state).
 _REDUCTIONS = ("sum", "mean", "max", "min", "cat", None)
+
+# Reductions the coalesced (bucketed) sync can merge into one collective per
+# (reduction, dtype) bucket. Callables and unknown tags stay per-leaf.
+_BUCKETABLE = ("sum", "mean", "max", "min", "cat", None)
+
+_ENV_BUCKETED = "METRICS_TPU_BUCKETED_SYNC"
+_bucketed_enabled: Optional[bool] = None  # None = follow the environment
+
+
+def bucketed_sync_enabled() -> bool:
+    """Whether coalesced (bucketed) state sync is globally enabled."""
+    if _bucketed_enabled is not None:
+        return _bucketed_enabled
+    return os.environ.get(_ENV_BUCKETED, "1").lower() not in ("0", "false", "off")
+
+
+def set_bucketed_sync(enabled: Optional[bool]) -> None:
+    """Globally enable/disable coalesced (bucketed) state sync.
+
+    ``None`` restores the environment default (``METRICS_TPU_BUCKETED_SYNC``,
+    on unless set to ``0``). The explicit ``bucketed=`` argument of
+    :func:`sync_state` takes precedence over this switch.
+    """
+    global _bucketed_enabled
+    _bucketed_enabled = enabled
+
+
+# --------------------------------------------------------------------------- #
+# collective counting (trace-time instrumentation for benches/tests)
+# --------------------------------------------------------------------------- #
+_counter = threading.local()
+
+
+@contextlib.contextmanager
+def count_collectives():
+    """Count collectives emitted by this module while the block traces.
+
+    Yields a dict whose ``"count"`` entry holds the number of collective ops
+    (``psum``/``pmean``/``pmax``/``pmin``/``all_gather``) this module emitted —
+    incremented at trace time, so wrap a ``jax.make_jaxpr(...)``/``jit`` trace
+    of the sync, not a cached compiled call."""
+    prev = getattr(_counter, "box", None)
+    box = {"count": 0}
+    _counter.box = box
+    try:
+        yield box
+    finally:
+        _counter.box = prev
+
+
+def _tick_collective() -> None:
+    box = getattr(_counter, "box", None)
+    if box is not None:
+        box["count"] += 1
 
 
 def reduce(x: Array, reduction: str) -> Array:
@@ -117,6 +172,7 @@ def sync_array(x: Array, reduction: Optional[Union[str, Callable]], axis_name: O
     """
     if axis_name is None:
         return x
+    _tick_collective()
     if reduction == "sum":
         return lax.psum(x, axis_name)
     if reduction == "mean":
@@ -137,10 +193,58 @@ def sync_array(x: Array, reduction: Optional[Union[str, Callable]], axis_name: O
     raise ValueError(f"Unknown dist_reduce_fx {reduction!r}; expected one of {_REDUCTIONS} or a callable.")
 
 
+def _sync_bucketed(entries: List[Tuple[str, Array, Optional[str]]], axis_name: AxisNames) -> Dict[str, Any]:
+    """One collective per (reduction, dtype) bucket — gradient-bucketing for
+    metric state (ISSUE-3 tentpole; arXiv:2305.06942 fused-collective shape).
+
+    Bucket layout: every leaf of a bucket is raveled and concatenated into one
+    flat buffer, a single ``psum``/``pmean``/``pmax``/``pmin``/``all_gather``
+    runs over it, and the unflatten step slices each leaf's segment back out
+    and reshapes it. Elementwise reductions make this bitwise-identical to the
+    per-leaf path (pinned by tests on the 8-device CPU mesh); singleton buckets
+    skip the flatten dance entirely and go straight through :func:`sync_array`.
+    """
+    out: Dict[str, Any] = {}
+    buckets: Dict[Tuple[Any, Any], List[Tuple[str, Array]]] = {}
+    for name, arr, red in entries:
+        arr = jnp.asarray(arr)
+        buckets.setdefault((red, arr.dtype), []).append((name, arr))
+    for (red, _dtype), items in buckets.items():
+        if len(items) == 1:
+            name, arr = items[0]
+            out[name] = sync_array(arr, red, axis_name)
+            continue
+        if red in ("sum", "mean", "max", "min"):
+            flat = jnp.concatenate([jnp.ravel(a) for _, a in items])
+            synced = sync_array(flat, red, axis_name)
+            offset = 0
+            for name, arr in items:
+                out[name] = synced[offset : offset + arr.size].reshape(arr.shape)
+                offset += arr.size
+        else:  # "cat" / None: one stacking all_gather, per-leaf unflatten
+            shaped = [(name, jnp.atleast_1d(a) if red == "cat" else a) for name, a in items]
+            flat = jnp.concatenate([jnp.ravel(a) for _, a in shaped])
+            _tick_collective()
+            gathered = lax.all_gather(flat, axis_name, axis=0)  # (world, sum of sizes)
+            world = gathered.shape[0]
+            offset = 0
+            for name, arr in shaped:
+                seg = gathered[:, offset : offset + arr.size]
+                if red == "cat":
+                    # tiled semantics: device-major concat along dim 0
+                    out[name] = seg.reshape((world * arr.shape[0],) + arr.shape[1:])
+                else:
+                    # stacking semantics: keep the leading per-device dim
+                    out[name] = seg.reshape((world,) + arr.shape)
+                offset += arr.size
+    return out
+
+
 def sync_state(
     state: Dict[str, Any],
     reductions: Dict[str, Optional[Union[str, Callable]]],
     axis_name: Optional[AxisNames],
+    bucketed: Optional[bool] = None,
 ) -> Dict[str, Any]:
     """Synchronize a whole state pytree by per-state reduction tag.
 
@@ -148,12 +252,23 @@ def sync_state(
     each state costs exactly one collective — same optimization the reference
     applies at metric.py:350-352. ``axis_name=None`` is the no-axis identity
     fast path (see :func:`sync_array`): the state is returned unchanged.
+
+    ``bucketed`` (default: the :func:`set_bucketed_sync` /
+    ``METRICS_TPU_BUCKETED_SYNC`` switch, on) coalesces all array leaves by
+    ``(reduction, dtype)`` into one flat buffer per bucket and emits a single
+    collective per bucket instead of one per leaf (see :func:`_sync_bucketed`),
+    bitwise-identical to the per-leaf path. Callable reductions and
+    ``CatBuffer`` states always sync per-leaf.
     """
     if axis_name is None:
         return dict(state)
+    if bucketed is None:
+        bucketed = bucketed_sync_enabled()
     from metrics_tpu.core.buffers import CatBuffer
 
-    out = {}
+    out: Dict[str, Any] = {}
+    entries: List[Tuple[str, Array, Optional[str]]] = []
+    rewrap: Dict[str, type] = {}
     for name, val in state.items():
         red = reductions.get(name)
         if isinstance(val, CatBuffer):
@@ -167,12 +282,23 @@ def sync_state(
             if len(val) == 0:
                 out[name] = val
                 continue
-            val = jnp.concatenate([jnp.atleast_1d(v) for v in val], axis=0)
-            synced = sync_array(val, "cat" if red is None or red == "cat" else red, axis_name)
-            out[name] = [synced]
+            # the synced concat comes back wrapped in the INPUT container type
+            # (a tuple state must stay a tuple: container drift changes the
+            # pytree structure across a sync and forces recompiles)
+            rewrap[name] = type(val)
+            arr = jnp.concatenate([jnp.atleast_1d(v) for v in val], axis=0)
+            red = "cat" if red is None or red == "cat" else red
         else:
-            out[name] = sync_array(val, red, axis_name)
-    return out
+            arr = val
+        if bucketed and red in _BUCKETABLE:
+            entries.append((name, arr, red))
+        else:
+            out[name] = sync_array(arr, red, axis_name)
+    if entries:
+        out.update(_sync_bucketed(entries, axis_name))
+    for name, container in rewrap.items():
+        out[name] = container((out[name],))
+    return {name: out[name] for name in state}
 
 
 # --------------------------------------------------------------------------- #

@@ -1,0 +1,264 @@
+"""Coalesced (bucketed) state sync: one collective per (reduction, dtype).
+
+``sync_state`` (``metrics_tpu/parallel/sync.py``) buckets state leaves by
+``(reduction, dtype)`` into one flat buffer and emits a single
+``psum``/``pmean``/``pmax``/``pmin``/``all_gather`` per bucket — the gradient
+bucketing trick applied to metric state. These tests pin the contract: bitwise
+parity against the per-leaf path on the 8-device CPU mesh (metrics, mixed
+pytrees, and whole collections), trace-time collective counts actually
+shrinking, the ``set_bucketed_sync`` switch surface, callables staying
+per-leaf, and the container-type regression (a tuple state must come back a
+tuple, not a list — drift changes the pytree structure across a sync and
+forces recompiles).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu
+from metrics_tpu import MetricCollection, Precision, Recall, StatScores
+from metrics_tpu.parallel import sync as sync_mod
+from metrics_tpu.parallel.sync import count_collectives, sync_state
+
+WORLD = 8
+
+
+@pytest.fixture(autouse=True)
+def _bucketed_default():
+    metrics_tpu.set_bucketed_sync(None)
+    yield
+    metrics_tpu.set_bucketed_sync(None)
+
+
+@pytest.fixture()
+def mesh():
+    devices = jax.devices()
+    if len(devices) < WORLD:
+        pytest.skip("needs 8 devices")
+    return Mesh(np.asarray(devices[:WORLD]), ("data",))
+
+
+# mixed reductions, dtypes, ranks — exercises every bucket shape at once
+_STATE = {
+    "tp": jnp.arange(5, dtype=jnp.float32),
+    "fp": jnp.full((5,), 2.0, jnp.float32),
+    "n": jnp.asarray(3.0, jnp.float32),
+    "running_mean": jnp.asarray(0.25, jnp.float32),
+    "mx": jnp.asarray(7.0, jnp.float32),
+    "hits": jnp.arange(4, dtype=jnp.int32),
+    "misses": jnp.asarray([9, 1], jnp.int32),
+    "chunks": jnp.arange(6, dtype=jnp.float32).reshape(3, 2),
+    "per_device": jnp.asarray([1.0, 2.0]),
+    "extra": jnp.asarray([0.5, 1.5, 2.5]),
+}
+_REDS = {
+    "tp": "sum",
+    "fp": "sum",
+    "n": "mean",
+    "running_mean": "mean",
+    "mx": "max",
+    "hits": "sum",
+    "misses": "sum",
+    "chunks": "cat",
+    "per_device": None,
+    "extra": None,
+}
+
+
+def _per_device_states(state):
+    """(WORLD, ...) inputs whose per-device slice is one device's local state."""
+    return jax.tree_util.tree_map(
+        lambda a: jnp.stack([a * (i + 1) for i in range(WORLD)]), state
+    )
+
+
+def _run_sync(mesh, state, reds, bucketed):
+    def body(s):
+        local = jax.tree_util.tree_map(lambda x: x[0], s)
+        out = sync_state(local, reds, "data", bucketed=bucketed)
+        return jax.tree_util.tree_map(lambda x: jnp.expand_dims(x, 0), out)
+
+    f = shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_rep=False)
+    return jax.jit(f)(_per_device_states(state))
+
+
+def _trace_count(reds, state, bucketed):
+    with count_collectives() as box:
+        jax.make_jaxpr(
+            lambda st: sync_state(st, reds, "data", bucketed=bucketed),
+            axis_env=[("data", WORLD)],
+        )(state)
+    return box["count"]
+
+
+# ----------------------------------------------------------------- parity ----
+def test_bitwise_parity_vs_per_leaf(mesh):
+    out_b = _run_sync(mesh, _STATE, _REDS, bucketed=True)
+    out_p = _run_sync(mesh, _STATE, _REDS, bucketed=False)
+    flat_b, td_b = jax.tree_util.tree_flatten(out_b)
+    flat_p, td_p = jax.tree_util.tree_flatten(out_p)
+    assert td_b == td_p  # identical pytree structure
+    for a, b in zip(flat_b, flat_p):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # bitwise
+
+
+def test_metric_sync_states_bitwise_parity(mesh):
+    """A real metric's sync_states: bucketed vs per-leaf inside shard_map."""
+    m = StatScores(reduce="macro", num_classes=5, compiled_compute=False)
+    rng = np.random.default_rng(3)
+    preds = jnp.asarray(rng.standard_normal((WORLD, 16, 5)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 5, (WORLD, 16)))
+
+    def run(bucketed):
+        def body(p, t):
+            state = m.update_state(m.init_state(), p[0], t[0])
+            synced = sync_state(state, m._reductions, "data", bucketed=bucketed)
+            return jnp.expand_dims(m.compute_state(synced), 0)
+
+        return np.asarray(
+            jax.jit(
+                shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_rep=False)
+            )(preds, target)
+        )
+
+    np.testing.assert_array_equal(run(True), run(False))
+
+
+def test_collection_sync_states_bitwise_parity(mesh):
+    """Whole-collection sync: the group-leader state set syncs bucketed."""
+    coll = MetricCollection(
+        {
+            "precision": Precision(num_classes=5, average="macro"),
+            "recall": Recall(num_classes=5, average="macro"),
+        },
+        compiled_compute=False,
+    )
+    rng = np.random.default_rng(4)
+    preds = jnp.asarray(rng.standard_normal((WORLD, 16, 5)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 5, (WORLD, 16)))
+
+    def run(bucketed):
+        metrics_tpu.set_bucketed_sync(bucketed)
+        try:
+            def body(p, t):
+                states = coll.update_state(coll.init_state(p[0], t[0]), p[0], t[0])
+                vals = coll.sync_compute_state(states, axis_name="data")
+                return {k: jnp.expand_dims(v, 0) for k, v in vals.items()}
+
+            return jax.jit(
+                shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_rep=False)
+            )(preds, target)
+        finally:
+            metrics_tpu.set_bucketed_sync(None)
+
+    out_b, out_p = run(True), run(False)
+    assert set(out_b) == set(out_p)
+    for k in out_b:
+        np.testing.assert_array_equal(np.asarray(out_b[k]), np.asarray(out_p[k]))
+
+
+# ------------------------------------------------------- container types -----
+def test_tuple_state_stays_tuple(mesh):
+    """Regression: tuple states used to come back as [synced] lists, changing
+    the pytree structure across a sync and forcing recompiles."""
+    state = {"buf": (jnp.arange(3, dtype=jnp.float32),), "n": jnp.asarray(1.0)}
+    reds = {"buf": "cat", "n": "sum"}
+    for bucketed in (True, False):
+        out = _run_sync(mesh, state, reds, bucketed=bucketed)
+        assert isinstance(out["buf"], tuple), f"bucketed={bucketed}"
+        assert len(out["buf"]) == 1
+    # list states keep coming back as lists
+    lstate = {"buf": [jnp.arange(3, dtype=jnp.float32)], "n": jnp.asarray(1.0)}
+    out = _run_sync(mesh, lstate, reds, bucketed=True)
+    assert isinstance(out["buf"], list)
+
+
+def test_sync_preserves_key_order():
+    """Bucketing reorders work internally; the output dict must not notice
+    (checked inside the trace — jit boundaries re-sort dict pytrees anyway)."""
+    state = {"z": jnp.asarray(1.0), "a": jnp.asarray(2.0), "m": jnp.asarray(3.0)}
+    reds = {"z": "sum", "a": "sum", "m": "mean"}
+    captured = {}
+
+    def run(st):
+        out = sync_state(st, reds, "data", bucketed=True)
+        captured["in"], captured["out"] = list(st), list(out)
+        return out
+
+    jax.make_jaxpr(run, axis_env=[("data", WORLD)])(state)
+    assert captured["out"] == captured["in"]
+    assert list(sync_state(state, reds, None)) == list(state)  # no-axis path too
+
+
+# ------------------------------------------------------ collective counts ----
+def test_collective_count_shrinks():
+    per_leaf = _trace_count(_REDS, _STATE, bucketed=False)
+    bucketed = _trace_count(_REDS, _STATE, bucketed=True)
+    assert per_leaf == len(_STATE)
+    # buckets: f32-sum(3), f32-mean(2), f32-max(1), i32-sum(2), cat(1), None(2)
+    assert bucketed == 6
+    assert bucketed < per_leaf
+
+
+def test_singleton_buckets_match_per_leaf_count():
+    state = {"a": jnp.asarray(1.0), "b": jnp.arange(3, dtype=jnp.int32)}
+    reds = {"a": "sum", "b": "sum"}  # different dtypes: two singleton buckets
+    assert _trace_count(reds, state, bucketed=True) == 2
+
+
+def test_stat_scores_collection_counts(mesh):
+    """The config2-shaped sync: a stat-scores state (5 same-dtype sum leaves)
+    collapses to ONE psum."""
+    m = StatScores(reduce="macro", num_classes=5)
+    state = m.init_state()
+    assert _trace_count(m._reductions, state, bucketed=False) == len(state)
+    assert _trace_count(m._reductions, state, bucketed=True) == 1
+
+
+# --------------------------------------------------------------- switches ----
+def test_global_switch_controls_default():
+    m = StatScores(reduce="macro", num_classes=5)
+    state = m.init_state()
+    metrics_tpu.set_bucketed_sync(False)
+    assert not sync_mod.bucketed_sync_enabled()
+    assert _trace_count(m._reductions, state, bucketed=None) == len(state)
+    metrics_tpu.set_bucketed_sync(True)
+    assert _trace_count(m._reductions, state, bucketed=None) == 1
+
+
+def test_explicit_arg_beats_global():
+    m = StatScores(reduce="macro", num_classes=5)
+    state = m.init_state()
+    metrics_tpu.set_bucketed_sync(False)
+    assert _trace_count(m._reductions, state, bucketed=True) == 1
+    metrics_tpu.set_bucketed_sync(True)
+    assert _trace_count(m._reductions, state, bucketed=False) == len(state)
+
+
+def test_env_flag(monkeypatch):
+    metrics_tpu.set_bucketed_sync(None)
+    monkeypatch.setenv("METRICS_TPU_BUCKETED_SYNC", "0")
+    assert not sync_mod.bucketed_sync_enabled()
+    monkeypatch.setenv("METRICS_TPU_BUCKETED_SYNC", "1")
+    assert sync_mod.bucketed_sync_enabled()
+
+
+# ------------------------------------------------------------- callables -----
+def test_callable_reduction_stays_per_leaf(mesh):
+    """Custom dist_reduce_fx callables see the stacked (world, ...) gather —
+    bucketing must leave them alone."""
+    merge = lambda stacked: jnp.sum(stacked, axis=0) * 10.0
+    state = {"a": jnp.asarray([1.0, 2.0]), "b": jnp.asarray([3.0, 4.0])}
+    reds = {"a": merge, "b": "sum"}
+    out = _run_sync(mesh, state, reds, bucketed=True)
+    # merge over stacked per-device (i+1)-scaled values: sum_i (i+1)*x * 10
+    scale = sum(range(1, WORLD + 1))
+    np.testing.assert_allclose(
+        np.asarray(out["a"])[0], np.asarray([1.0, 2.0]) * scale * 10.0, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["b"])[0], np.asarray([3.0, 4.0]) * scale, rtol=1e-6
+    )

@@ -400,8 +400,71 @@ def test_jit_cached_dispatch_overhead_guard():
     stateful_s = min(time_stateful() for _ in range(3))
     assert stateful.supports_compiled_update
     assert stateful._update_engine.stats.compiled_calls > 64
+    # steady-state dispatch must ride the id-keyed signature memo, not re-hash
+    # (args repeat by object identity; state is re-seeded after every dispatch)
+    assert stateful._update_engine.stats.key_fast_hits > 64
     # 2x relative + 150us absolute floor absorbs timer noise on tiny steps
     assert stateful_s <= 2.0 * raw_s + 150e-6, (
         f"stateful jit-cached update too slow: {stateful_s * 1e6:.1f}us/step vs "
         f"raw jitted {raw_s * 1e6:.1f}us/step"
     )
+
+
+# ------------------------------------------------- signature fast path ------
+class TestSignatureFastPath:
+    def test_repeated_objects_hit_the_memo(self):
+        preds, target = _data()
+        m = StatScores(reduce="macro", num_classes=5)
+        for _ in range(6):
+            m.update(preds, target)  # same array objects every call
+        stats = m._update_engine.stats
+        # both key halves fast-path in steady state: the args memo from the
+        # second sighting on, the state memo from the first dispatch's seed
+        assert stats.key_fast_hits >= 2 * (stats.cache_hits - 1)
+
+    def test_fresh_arrays_still_dispatch_correctly(self):
+        m = StatScores(reduce="macro", num_classes=5)
+        ref = StatScores(reduce="macro", num_classes=5, compiled_update=False)
+        for s in range(5):
+            preds, target = _data(seed=s)  # new objects, same avals
+            m.update(preds, target)
+            ref.update(preds, target)
+        stats = m._update_engine.stats
+        # fresh args miss the id memo but land on the same compiled signature
+        assert stats.cache_misses == 1 and stats.cache_hits == 3
+        np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+
+    def test_collection_engine_fast_path(self):
+        preds, target = _data()
+        coll = MetricCollection(
+            {
+                "precision": Precision(num_classes=5, average="macro"),
+                "recall": Recall(num_classes=5, average="macro"),
+                "acc": Accuracy(),
+            }
+        )
+        for _ in range(5):
+            coll.update(preds, target)
+        assert coll._update_engine.stats.key_fast_hits >= 4
+
+    def test_memo_never_lies_across_mutation(self):
+        """Interleaving signatures must re-derive keys, never serve a stale
+        memo entry: parity against eager across alternating batch sizes."""
+        m = StatScores(reduce="macro", num_classes=5)
+        ref = StatScores(reduce="macro", num_classes=5, compiled_update=False)
+        big, small = _data(n=64), _data(n=16, seed=1)
+        for _ in range(3):
+            for args in (big, small):
+                m.update(*args)
+                ref.update(*args)
+        assert len(m._update_engine._seen) == 2  # one entry per signature
+        np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+
+    def test_scalar_leaves_disable_memo_but_stay_correct(self):
+        m = MeanMetric()
+        ref = MeanMetric(compiled_update=False)
+        for _ in range(5):
+            m.update(2.5)  # python scalar: not weakrefable, memo stays off
+            ref.update(2.5)
+        assert m._update_engine.stats.compiled_calls >= 1
+        np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(ref.compute()))

@@ -1,0 +1,359 @@
+"""Fused collection update engine: one donated jitted program per signature.
+
+``MetricCollection.update()`` dispatches through :class:`CollectionUpdateEngine`
+(``metrics_tpu/core/engine.py``): ONE jitted ``update_state`` over the joint
+``{leader: state}`` pytree per (input-aval, state-aval) signature, donated in
+steady state, with compute-group members skipped entirely during updates and
+realiased lazily at observation points. These tests pin that contract:
+domain-sweep parity (classification/regression/retrieval mixed in one
+collection), donation safety when members share a state leaf, group-rebuild
+invalidation of the fused cache, the permanent eager fallback when one member
+is untraceable, and the ``fused_update`` switch surface.
+"""
+import pickle
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu
+from metrics_tpu import (
+    Accuracy,
+    F1Score,
+    MeanAbsoluteError,
+    MeanSquaredError,
+    Metric,
+    MetricCollection,
+    Precision,
+    Recall,
+    RetrievalMRR,
+    StatScores,
+)
+from metrics_tpu.core import engine as engine_mod
+
+
+@pytest.fixture(autouse=True)
+def _engines_on():
+    metrics_tpu.set_compiled_update(True)
+    metrics_tpu.set_fused_update(True)
+    yield
+    metrics_tpu.set_compiled_update(None)
+    metrics_tpu.set_fused_update(None)
+
+
+def _data(n=64, c=5, seed=0):
+    rng = np.random.default_rng(seed)
+    preds = jnp.asarray(rng.standard_normal((n, c)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, c, n))
+    return preds, target
+
+
+def _binary_data(n=64, q=8, seed=0):
+    rng = np.random.default_rng(seed)
+    preds = jnp.asarray(rng.random(n).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 2, n))
+    indexes = jnp.asarray(rng.integers(0, q, n))
+    return preds, target, indexes
+
+
+def _grouped_coll(**kw):
+    return MetricCollection(
+        {
+            "precision": Precision(num_classes=5, average="macro"),
+            "recall": Recall(num_classes=5, average="macro"),
+            "f1": F1Score(num_classes=5, average="macro"),
+            "acc": Accuracy(),
+        },
+        **kw,
+    )
+
+
+# ---------------------------------------------------------------- parity -----
+class TestDomainSweepParity:
+    def _mixed_coll(self, **kw):
+        """Classification + regression + retrieval behind one call signature."""
+        return MetricCollection(
+            {
+                "acc": Accuracy(),
+                "prec": Precision(num_classes=None),
+                "rec": Recall(num_classes=None),
+                "mse": MeanSquaredError(),
+                "mae": MeanAbsoluteError(),
+                "mrr": RetrievalMRR(buffer_capacity=512),
+            },
+            **kw,
+        )
+
+    def test_mixed_domain_parity_and_fused_dispatch(self):
+        fused = self._mixed_coll()
+        eager = self._mixed_coll(fused_update=False)
+        for s in range(5):
+            p, t, i = _binary_data(seed=s)
+            fused.update(p, t, indexes=i)
+            eager.update(p, t, indexes=i)
+        rf, re = fused.compute(), eager.compute()
+        assert set(rf) == set(re)
+        for k in rf:
+            np.testing.assert_allclose(np.asarray(rf[k]), np.asarray(re[k]), rtol=1e-6)
+        eng = fused._update_engine
+        assert eng is not None and eng.broken is None
+        assert eng.stats.compiled_calls >= 2  # fused program actually ran
+        assert eager._update_engine is None
+
+    def test_grouped_classification_parity(self):
+        fused = _grouped_coll()
+        eager = _grouped_coll(fused_update=False)
+        for s in range(4):
+            p, t = _data(seed=s)
+            fused.update(p, t)
+            eager.update(p, t)
+        rf, re = fused.compute(), eager.compute()
+        for k in rf:
+            np.testing.assert_array_equal(np.asarray(rf[k]), np.asarray(re[k]))
+        # stat-scores family fuses into one group: one state threads 3 members
+        assert any(len(g) == 3 for g in fused._groups)
+
+    def test_interleaved_observe_update_parity(self):
+        """compute() mid-stream (members realias) must not perturb later
+        fused updates."""
+        fused = _grouped_coll()
+        eager = _grouped_coll(fused_update=False)
+        for s in range(6):
+            p, t = _data(seed=s)
+            fused.update(p, t)
+            eager.update(p, t)
+            if s % 2:
+                rf, re = fused.compute(), eager.compute()
+                for k in rf:
+                    np.testing.assert_array_equal(np.asarray(rf[k]), np.asarray(re[k]))
+
+
+# ----------------------------------------------------------- member skip -----
+class TestMemberSkip:
+    def test_members_detached_between_observations(self):
+        coll = _grouped_coll()
+        p, t = _data()
+        for _ in range(3):
+            coll.update(p, t)
+        # steady state: leaders advanced, members detached until observed
+        assert coll._members_stale
+        member = coll["recall"]  # __getitem__ realiases
+        assert not coll._members_stale
+        assert member._update_count == 3
+        leader = coll["precision"]
+        assert member.tp is leader.tp  # realias is reference assignment
+
+    def test_update_counts_consistent_after_fused_runs(self):
+        coll = _grouped_coll()
+        p, t = _data()
+        for _ in range(4):
+            coll.update(p, t)
+        counts = {k: m._update_count for k, m in coll.items(keep_base=True)}
+        assert set(counts.values()) == {4}
+
+    def test_reset_after_fused_updates(self):
+        coll = _grouped_coll()
+        p, t = _data()
+        for _ in range(3):
+            coll.update(p, t)
+        coll.reset()
+        for k, m in coll.items(keep_base=True):
+            assert m._update_count == 0
+        coll.update(p, t)
+        ref = _grouped_coll(fused_update=False)
+        ref.update(p, t)
+        r1, r2 = coll.compute(), ref.compute()
+        for k in r1:
+            np.testing.assert_array_equal(np.asarray(r1[k]), np.asarray(r2[k]))
+
+    def test_clone_and_pickle_see_whole_members(self):
+        coll = _grouped_coll()
+        p, t = _data()
+        for _ in range(3):
+            coll.update(p, t)
+        assert coll._members_stale
+        c = coll.clone()
+        for k, m in c.items(keep_base=True):
+            assert m._update_count == 3
+            assert all(v is not None for v in m.metric_state.values())
+        roundtrip = pickle.loads(pickle.dumps(coll))
+        r1, r2 = roundtrip.compute(), coll.compute()
+        for k in r1:
+            np.testing.assert_array_equal(np.asarray(r1[k]), np.asarray(r2[k]))
+
+
+# ------------------------------------------------------------- donation ------
+@pytest.mark.skipif(
+    not engine_mod.backend_supports_donation(), reason="backend has no buffer donation"
+)
+class TestDonationSafety:
+    def test_steady_state_donates(self):
+        coll = _grouped_coll()
+        p, t = _data()
+        for _ in range(5):
+            coll.update(p, t)
+        # call 1 eager, call 2 compiles (plain probe), calls 3+ donate
+        assert coll._update_engine.stats.donated_calls >= 2
+
+    def test_shared_state_leaf_survives_donation(self):
+        """A caller-held reference into a group member's (leader-shared) state
+        must never be invalidated by the fused program's donation."""
+        coll = _grouped_coll()
+        p, t = _data()
+        for _ in range(4):
+            coll.update(p, t)
+        held = coll["recall"].tp  # realias: now aliases the leader's tp leaf
+        donated_before = coll._update_engine.stats.donated_calls
+        coll.update(p, t)  # refcount guard sees the extra reference: no donate
+        assert coll._update_engine.stats.donated_calls == donated_before
+        assert not held.is_deleted()
+        _ = np.asarray(held)  # still readable
+        del held
+        coll.update(p, t)
+        coll.update(p, t)
+        assert coll._update_engine.stats.donated_calls > donated_before  # resumes
+
+    def test_held_leader_snapshot_survives(self):
+        coll = _grouped_coll()
+        p, t = _data()
+        for _ in range(4):
+            coll.update(p, t)
+        snap = coll["precision"].get_state()
+        coll.update(p, t)
+        assert all(not v.is_deleted() for v in snap.values())
+        _ = [np.asarray(v) for v in snap.values()]
+
+
+# ------------------------------------------------------------- rebuilds ------
+class TestGroupRebuild:
+    def test_rebuild_invalidates_fused_cache_and_realiases(self):
+        coll = _grouped_coll()
+        p, t = _data()
+        for _ in range(3):
+            coll.update(p, t)
+        assert coll._members_stale
+        stale = coll._update_engine
+        coll["stat"] = StatScores(reduce="macro", num_classes=5)
+        # the rebuild realiased members BEFORE regrouping and dropped the engine
+        assert not coll._members_stale
+        assert coll._update_engine is None
+        for name in ("recall", "f1"):
+            m = coll[name]
+            assert m._update_count == 3
+            assert all(v is not None for v in m.metric_state.values())
+        coll.update(p, t)
+        assert coll._update_engine is not stale
+        ref = Recall(num_classes=5, average="macro", compiled_update=False)
+        for _ in range(4):
+            ref.update(p, t)
+        np.testing.assert_array_equal(
+            np.asarray(coll.compute()["recall"]), np.asarray(ref.compute())
+        )
+
+
+# ------------------------------------------------------------- fallback ------
+class _HostReadbackMetric(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds, target):
+        if float(jnp.sum(preds)) > -1e30:  # host readback: untraceable
+            self.total = self.total + jnp.sum(preds)
+
+    def compute(self):
+        return self.total
+
+
+class TestEagerFallback:
+    def test_one_untraceable_member_reverts_collection(self):
+        coll = MetricCollection(
+            {"acc": Accuracy(), "host": _HostReadbackMetric()}
+        )
+        p = jnp.asarray(np.random.default_rng(0).random(32).astype(np.float32))
+        t = jnp.asarray(np.random.default_rng(1).integers(0, 2, 32))
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            for _ in range(4):
+                coll.update(p, t)
+        assert any("engine disabled" in str(w.message) for w in caught)
+        assert coll._update_engine.broken is not None
+        assert coll._update_engine.stats.compiled_calls == 0
+        # every eager update landed: nothing was lost to the failed probe
+        np.testing.assert_allclose(
+            float(coll.compute()["host"]), 4 * float(jnp.sum(p)), rtol=1e-6
+        )
+        assert coll["acc"]._update_count == 4
+
+    def test_fallback_is_permanent_and_warns_once(self):
+        coll = MetricCollection({"host": _HostReadbackMetric()})
+        x = jnp.asarray([1.0, 2.0])
+        t = jnp.asarray([1.0, 2.0])
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            for _ in range(6):
+                coll.update(x, t)
+        fused_warnings = [
+            w for w in caught
+            if "CollectionUpdateEngine" in str(w.message)
+        ]
+        assert len(fused_warnings) == 1
+
+
+# --------------------------------------------------------------- switches ----
+class TestSwitchSurface:
+    def test_global_off_reverts_to_eager_loop(self):
+        metrics_tpu.set_fused_update(False)
+        coll = _grouped_coll()
+        p, t = _data()
+        for _ in range(3):
+            coll.update(p, t)
+        assert coll._update_engine is None
+        # member engines are governed separately and still compile
+        leader_name = next(g[0] for g in coll._groups if len(g) > 1)
+        leader = coll[leader_name]
+        assert leader._update_engine is not None
+        assert leader._update_engine.stats.compiled_calls >= 1
+
+    def test_per_collection_true_overrides_global_false(self):
+        metrics_tpu.set_fused_update(False)
+        coll = _grouped_coll(fused_update=True)
+        p, t = _data()
+        for _ in range(3):
+            coll.update(p, t)
+        assert coll._update_engine is not None
+        assert coll._update_engine.stats.compiled_calls >= 1
+
+    def test_per_collection_false_overrides_global_true(self):
+        coll = _grouped_coll(fused_update=False)
+        p, t = _data()
+        for _ in range(3):
+            coll.update(p, t)
+        assert coll._update_engine is None
+
+    def test_env_flag_off(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_FUSED_UPDATE", "0")
+        metrics_tpu.set_fused_update(None)  # follow the environment
+        assert not engine_mod.fused_update_enabled()
+        coll = _grouped_coll()
+        p, t = _data()
+        coll.update(p, t)
+        coll.update(p, t)
+        assert coll._update_engine is None
+
+    def test_none_restores_env_default(self):
+        metrics_tpu.set_fused_update(False)
+        assert not engine_mod.fused_update_enabled()
+        metrics_tpu.set_fused_update(None)
+        assert engine_mod.fused_update_enabled()  # env default: on
+
+    def test_compiled_update_false_also_gates_fused(self):
+        # the fused engine layers on compiled_update: both must allow it
+        coll = _grouped_coll(compiled_update=False)
+        p, t = _data()
+        for _ in range(3):
+            coll.update(p, t)
+        assert coll._update_engine is None
