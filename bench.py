@@ -1292,8 +1292,48 @@ def _split_throughput(d, key="samples_per_sec"):
     return d[key], {k: v for k, v in d.items() if k != key}
 
 
+def bench_analysis() -> None:
+    """``--analysis``: run the static analyzer (``metrics_tpu.analysis``) over
+    the registered metric universe and record wall time + per-rule hit counts
+    into ``BENCH_r09.json`` (one JSON line on stdout, same shape)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # host-only: axis_env mock mesh
+    from metrics_tpu.analysis import run_analysis
+    from metrics_tpu.analysis.rules import INFO, WARNING
+
+    t0 = time.perf_counter()
+    report = run_analysis()
+    wall_s = time.perf_counter() - t0
+    record = {
+        "metric": "analysis_wall_s",
+        "value": round(wall_s, 3),
+        "unit": "s",
+        "extra": {
+            "classes": report.classes,
+            "linted_classes": report.linted_classes,
+            "errors": report.errors,
+            "warnings": report.count(WARNING),
+            "info": report.count(INFO),
+            "suppressed": sum(1 for f in report.findings if f.suppressed),
+            "by_rule": report.by_rule(),
+            "eval_skipped": len(report.skipped),
+        },
+    }
+    with open(os.path.join(REPO, "BENCH_r09.json"), "w") as fh:
+        json.dump(record, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(record), flush=True)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--analysis",
+        action="store_true",
+        help="run the metrics_tpu.analysis static analyzer and record wall "
+        "time + per-rule hit counts into BENCH_r09.json",
+    )
     parser.add_argument("--child", choices=["sync_overhead", *_CHILD_BENCHES])
     parser.add_argument(
         "--sync-scaling",
@@ -1309,6 +1349,9 @@ def main() -> None:
     )
     global _BENCH_START
     args = parser.parse_args()
+    if args.analysis:
+        bench_analysis()
+        return
     if args.sync_scaling:
         out = {}
         for w in (2, 4, 8, 16):

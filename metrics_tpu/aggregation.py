@@ -182,7 +182,7 @@ class CatMetric(BaseAggregator):
 
         if isinstance(self.value, CatBuffer):
             return self.value.to_array() if self.value else jnp.zeros((0,))
-        if isinstance(self.value, list) and self.value:
+        if isinstance(self.value, list) and self.value:  # metrics-tpu: allow[A002] — eager-only list branch; the CatBuffer branch is the compiled path
             return dim_zero_cat(self.value)
         return self.value
 
@@ -213,3 +213,16 @@ class MeanMetric(BaseAggregator):
 
     def compute(self) -> Array:
         return self.value / self.weight
+
+
+# --------------------------------------------------------------------------- #
+# analyzer registry (metrics_tpu.analysis): how each export is constructed and
+# fed for the abstract-eval sweep; see docs/static_analysis.md
+# --------------------------------------------------------------------------- #
+ANALYSIS_SPECS = {
+    "CatMetric": {"init": {"buffer_capacity": 32}, "inputs": [("float32", (8,))]},
+    "MaxMetric": {"inputs": [("float32", (8,))]},
+    "MinMetric": {"inputs": [("float32", (8,))]},
+    "SumMetric": {"inputs": [("float32", (8,))]},
+    "MeanMetric": {"inputs": [("float32", (8,)), ("float32", (8,))]},
+}

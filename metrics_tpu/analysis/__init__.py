@@ -1,0 +1,153 @@
+"""metrics_tpu.analysis — trace-safety & pytree-discipline analyzer.
+
+Gates the compiled engine *before* runtime: stage 1 is an AST lint over every
+registered metric's jit-facing methods (host round-trips, data-dependent
+control flow, hidden state writes, bare-scalar state, mutable-global
+closures), stage 2 an abstract-eval sweep (``jax.eval_shape`` /
+``jax.make_jaxpr`` under a mock 8-device mesh) asserting treedef, aval and
+donation stability plus a trace-time collective budget. Run it as::
+
+    python -m metrics_tpu.analysis [--json] [--strict]
+
+See ``docs/static_analysis.md`` for the rule catalog and suppression syntax.
+"""
+from __future__ import annotations
+
+import time
+import warnings
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from metrics_tpu.analysis.rules import ERROR, INFO, RULES, WARNING, Finding, Rule
+from metrics_tpu.analysis import ast_stage, eval_stage, registry
+
+__all__ = [
+    "RULES",
+    "Rule",
+    "Finding",
+    "Report",
+    "run_analysis",
+    "audit_paths",
+]
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)
+    classes: int = 0
+    linted_classes: int = 0
+    skipped: Dict[str, str] = field(default_factory=dict)
+    notes: Dict[str, List[str]] = field(default_factory=dict)
+    elapsed_s: float = 0.0
+
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    def count(self, severity: str) -> int:
+        return sum(1 for f in self.active() if f.severity == severity)
+
+    @property
+    def errors(self) -> int:
+        return self.count(ERROR)
+
+    def by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.active():
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return dict(sorted(out.items()))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "findings": [f.to_dict() for f in sorted(self.findings, key=Finding.sort_key)],
+            "summary": {
+                "classes": self.classes,
+                "linted_classes": self.linted_classes,
+                "errors": self.errors,
+                "warnings": self.count(WARNING),
+                "info": self.count(INFO),
+                "suppressed": sum(1 for f in self.findings if f.suppressed),
+                "by_rule": self.by_rule(),
+                "skipped": self.skipped,
+            },
+            "elapsed_s": round(self.elapsed_s, 4),
+        }
+
+
+def run_analysis(
+    stages: Sequence[str] = ("ast", "eval"),
+    budget_cap: Optional[int] = None,
+) -> Report:
+    """Run the analyzer over the registered metric universe."""
+    t0 = time.perf_counter()
+    report = Report()
+    entries = registry.build_registry()
+    report.classes = len(entries)
+
+    # instantiate probes up front: stage 2 needs them, stage 1 uses their
+    # registered-state names / __init__ attrs for precise taint & A003.
+    init_findings: Dict[str, Finding] = {}
+    for entry in entries:
+        f = eval_stage.instantiate(entry)
+        if f is not None:
+            init_findings[entry.name] = f
+    universe = registry.state_name_universe(entries)
+
+    if "ast" in stages:
+        for cls in registry.lintable_classes(entries):
+            entry = registry.spec_for_class(entries, cls)
+            state_names = known_attrs = None
+            host_inputs, class_allow = False, ()
+            if entry is not None:
+                if entry.instance is not None:
+                    state_names = set(entry.instance._defaults.keys())
+                    known_attrs = set(vars(entry.instance).keys())
+                if entry.cls is cls or entry.host_inputs:
+                    host_inputs = entry.host_inputs
+                if entry.cls is cls:
+                    class_allow = entry.allow
+            report.findings.extend(
+                ast_stage.lint_class(
+                    cls,
+                    state_names=state_names,
+                    known_attrs=known_attrs,
+                    global_state_names=universe,
+                    host_inputs=host_inputs,
+                    class_allow=class_allow,
+                )
+            )
+            report.linted_classes += 1
+
+    if "eval" in stages:
+        for entry in entries:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")  # probe traces re-trigger runtime warns
+                report.findings.extend(eval_stage.evaluate_entry(entry, budget_cap=budget_cap))
+            if entry.skip_eval:
+                report.skipped[entry.name] = entry.skip_eval
+            if entry.notes:
+                report.notes[entry.name] = list(entry.notes)
+    else:
+        # still surface constructor failures discovered while probing
+        report.findings.extend(init_findings.values())
+
+    report.findings.sort(key=Finding.sort_key)
+    report.elapsed_s = time.perf_counter() - t0
+    return report
+
+
+def audit_paths(paths: Sequence[str]) -> Report:
+    """``--paths`` mode: scan arbitrary files for direct metric-state reads
+    (A006) — the fused-streak staleness caveat, statically."""
+    t0 = time.perf_counter()
+    report = Report()
+    entries = registry.build_registry()
+    for entry in entries:
+        eval_stage.instantiate(entry)
+    universe = registry.state_name_universe(entries)
+    for path in paths:
+        with open(path, "r") as fh:
+            source = fh.read()
+        report.findings.extend(ast_stage.lint_source(path, source, universe))
+    report.findings.sort(key=Finding.sort_key)
+    report.elapsed_s = time.perf_counter() - t0
+    return report

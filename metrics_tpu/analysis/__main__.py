@@ -1,0 +1,98 @@
+"""``python -m metrics_tpu.analysis`` — CLI for the trace-safety analyzer.
+
+Exit codes: 0 = clean (or only warnings/info), 1 = unsuppressed errors under
+``--strict``, 2 = the analyzer itself failed. Runs entirely on the host: the
+mock 8-device mesh is an ``axis_env`` trace, so no accelerator (or XLA device
+flag) is needed.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from metrics_tpu.analysis import RULES, Report, audit_paths, run_analysis
+from metrics_tpu.analysis.rules import ERROR, INFO, WARNING
+
+_SEV_TAG = {ERROR: "error", WARNING: "warn ", INFO: "info "}
+
+
+def _print_human(report: Report, show_suppressed: bool) -> None:
+    shown = report.findings if show_suppressed else report.active()
+    for f in shown:
+        tag = _SEV_TAG[f.severity]
+        sup = " [suppressed]" if f.suppressed else ""
+        loc = f.location()
+        print(f"{tag} {f.rule} {f.obj}{sup}")
+        print(f"      {loc}")
+        print(f"      {f.message}")
+    if report.skipped:
+        print(f"-- eval skipped for {len(report.skipped)} metric(s):")
+        for name, why in sorted(report.skipped.items()):
+            print(f"      {name}: {why}")
+    print(
+        f"== {report.classes} metric(s), {report.linted_classes} class(es) linted: "
+        f"{report.errors} error(s), {report.count(WARNING)} warning(s), "
+        f"{report.count(INFO)} info, "
+        f"{sum(1 for f in report.findings if f.suppressed)} suppressed "
+        f"[{report.elapsed_s:.2f}s]"
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m metrics_tpu.analysis",
+        description="Trace-safety & pytree-discipline analyzer for metrics_tpu metrics.",
+    )
+    parser.add_argument("--json", action="store_true", help="machine-readable report on stdout")
+    parser.add_argument(
+        "--strict", action="store_true", help="exit 1 on any unsuppressed error finding"
+    )
+    parser.add_argument(
+        "--stage", choices=("ast", "eval", "all"), default="all", help="run one stage only"
+    )
+    parser.add_argument(
+        "--paths",
+        nargs="+",
+        metavar="FILE",
+        help="audit arbitrary Python files for direct metric-state reads (A006) "
+        "instead of analyzing the registry",
+    )
+    parser.add_argument(
+        "--budget",
+        type=int,
+        default=None,
+        help="absolute per-metric trace-time collective cap (tightens the canonical budget)",
+    )
+    parser.add_argument(
+        "--show-suppressed", action="store_true", help="include suppressed findings in output"
+    )
+    parser.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in RULES.values():
+            print(f"{rule.id} [{rule.severity}] {rule.name}\n      {rule.summary}")
+        return 0
+
+    try:
+        if args.paths:
+            report = audit_paths(args.paths)
+        else:
+            stages = ("ast", "eval") if args.stage == "all" else (args.stage,)
+            report = run_analysis(stages=stages, budget_cap=args.budget)
+    except Exception as e:  # noqa: BLE001 — analyzer crash is exit 2, not a finding
+        print(f"analysis failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        _print_human(report, args.show_suppressed)
+    if args.strict and report.errors:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

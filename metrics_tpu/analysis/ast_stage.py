@@ -1,0 +1,576 @@
+"""Stage 1 — AST lint over metric source.
+
+Lints the jit-facing methods (``update``/``compute`` and any overrides of the
+pure protocol) of every class in the registry (shared bases once, findings
+attached to the defining class). The lint is a *linter*, not a verifier: taint
+tracking is deliberately shallow — inputs and registered-state reads are
+tainted, taint flows through jnp/jax/lax calls, arithmetic, subscripts and
+method calls, and stops at calls to local helper functions. Real
+untraceability that hides behind helpers is caught by stage 2
+(``jax.eval_shape``, :mod:`metrics_tpu.analysis.eval_stage`), which is the
+ground truth; stage 1 exists to point at the *line*.
+
+Code under an ``_is_concrete(...)`` / ``_tracing_active()`` / ``_is_traced(...)``
+guard (metrics_tpu.utils.checks) is host-side by design and exempt from
+A001/A002 within the guarded body.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple, Type
+
+from metrics_tpu.analysis.rules import Finding, parse_suppressions
+
+# methods that run under jit in the compiled engines (or feed them)
+LINT_METHODS = ("update", "compute", "update_state", "compute_state", "sync_states", "sync_compute_state")
+
+# concreteness guards from metrics_tpu.utils.checks: bodies they protect are
+# host-side by design
+GUARD_NAMES = {"_is_concrete", "_tracing_active", "_is_traced"}
+
+# static accessors: reading these off a traced value stays trace-safe
+STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "weak_type", "itemsize", "nbytes", "T", "aval"}
+
+HOST_CASTS = {"float", "int", "bool", "complex"}
+
+# builtins whose result is static metadata, never a traced value
+SAFE_BUILTINS = {
+    "len", "isinstance", "issubclass", "type", "getattr", "hasattr", "callable",
+    "range", "enumerate", "zip", "str", "repr", "format", "print",
+    "tuple", "list", "dict", "set", "frozenset", "sorted",
+}
+
+MUTATOR_METHODS = {"append", "extend", "insert", "update", "setdefault", "pop", "popitem", "clear", "add", "remove", "discard"}
+
+
+# --------------------------------------------------------------------------- #
+# per-module context (parsed once, shared by every class in the module)
+# --------------------------------------------------------------------------- #
+class ModuleContext:
+    def __init__(self, filename: str, source: str):
+        self.filename = filename
+        self.source = source
+        self.tree = ast.parse(source)
+        self.suppressions = parse_suppressions(source)
+        self.np_aliases: Set[str] = set()
+        self.jax_aliases: Set[str] = set()
+        self.module_mutables: Set[str] = set()
+        self._scan_toplevel()
+
+    def _scan_toplevel(self) -> None:
+        for node in self.tree.body:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    if alias.name.split(".")[0] == "numpy":
+                        self.np_aliases.add(bound)
+                    elif alias.name.split(".")[0] == "jax":
+                        self.jax_aliases.add(bound)
+            elif isinstance(node, ast.ImportFrom):
+                root = (node.module or "").split(".")[0]
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    if root == "numpy":
+                        self.np_aliases.add(bound)
+                    elif root == "jax" and alias.name in ("numpy", "lax"):
+                        self.jax_aliases.add(bound)
+            elif isinstance(node, ast.Assign):
+                if isinstance(node.value, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.module_mutables.add(tgt.id)
+
+    def class_def(self, name: str) -> Optional[ast.ClassDef]:
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef) and node.name == name:
+                return node
+        return None
+
+
+_MODULE_CACHE: Dict[str, Optional[ModuleContext]] = {}
+
+
+def module_context_for(cls: Type) -> Optional[ModuleContext]:
+    try:
+        filename = inspect.getsourcefile(cls)
+        if filename is None:
+            return None
+    except (OSError, TypeError):
+        return None
+    if filename not in _MODULE_CACHE:
+        try:
+            with open(filename, "r") as fh:
+                _MODULE_CACHE[filename] = ModuleContext(filename, fh.read())
+        except (OSError, SyntaxError):
+            _MODULE_CACHE[filename] = None
+    return _MODULE_CACHE[filename]
+
+
+# --------------------------------------------------------------------------- #
+# the per-method taint walker
+# --------------------------------------------------------------------------- #
+class _MethodLinter:
+    def __init__(
+        self,
+        ctx: ModuleContext,
+        cls_name: str,
+        fn: ast.FunctionDef,
+        state_names: Set[str],
+        known_attrs: Set[str],
+        global_state_names: Set[str],
+        host_inputs: bool,
+    ):
+        self.ctx = ctx
+        self.cls_name = cls_name
+        self.fn = fn
+        self.state_names = state_names
+        self.known_attrs = known_attrs
+        self.global_state_names = global_state_names
+        self.findings: List[Finding] = []
+        self.guard_depth = 0
+        self.tainted: Set[str] = set()
+        if not host_inputs:
+            args = fn.args
+            for a in (*args.posonlyargs, *args.args, *args.kwonlyargs):
+                # axis_name is static mesh config by protocol; a plain `bool`
+                # annotation marks a static flag (FID/KID `real`), not data
+                if a.arg in ("self", "state", "axis_name"):
+                    continue
+                if isinstance(a.annotation, ast.Name) and a.annotation.id == "bool":
+                    continue
+                self.tainted.add(a.arg)
+            if args.vararg:
+                self.tainted.add(args.vararg.arg)
+            if args.kwarg:
+                self.tainted.add(args.kwarg.arg)
+        # the pure-protocol `state` argument carries registered state values
+        for a in (*fn.args.posonlyargs, *fn.args.args):
+            if a.arg == "state":
+                self.tainted.add(a.arg)
+
+    # ---------------------------------------------------------------- emit --
+    def emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", self.fn.lineno)
+        self.findings.append(
+            Finding(
+                rule=rule,
+                obj=f"{self.cls_name}.{self.fn.name}",
+                message=message,
+                file=self.ctx.filename,
+                line=line,
+            )
+        )
+
+    # --------------------------------------------------------------- taint --
+    def is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                return node.attr in self.state_names
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value)
+        if isinstance(node, (ast.BinOp,)):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # identity checks (`x is None`) are static Python-level decisions
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return self.is_tainted(node.left) or any(self.is_tainted(c) for c in node.comparators)
+        if isinstance(node, ast.IfExp):
+            return self.is_tainted(node.body) or self.is_tainted(node.test) or self.is_tainted(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            return self._call_taint(node)
+        return False
+
+    def _call_args_tainted(self, node: ast.Call) -> bool:
+        return any(self.is_tainted(a) for a in node.args) or any(
+            self.is_tainted(kw.value) for kw in node.keywords
+        )
+
+    def _root_name(self, node: ast.AST) -> Optional[str]:
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    def _call_taint(self, node: ast.Call) -> bool:
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id in SAFE_BUILTINS or func.id in HOST_CASTS or func.id in GUARD_NAMES:
+                return False
+            # calls to local helpers do not propagate taint (shallow-by-design;
+            # stage 2 is the ground truth for what hides behind them)
+            return False
+        if isinstance(func, ast.Attribute):
+            root = self._root_name(func)
+            if root in self.ctx.jax_aliases:
+                return self._call_args_tainted(node)
+            if root in self.ctx.np_aliases:
+                return False  # flagged as A001 separately; result is host-side
+            if func.attr in ("item", "tolist"):
+                return False  # the readback itself is the finding
+            # method call on a traced value (x.sum(), x.astype(...), ...)
+            return self.is_tainted(func.value) or self._call_args_tainted(node)
+        return False
+
+    # ---------------------------------------------------------- statements --
+    def lint(self) -> List[Finding]:
+        for stmt in self.fn.body:
+            self.visit_stmt(stmt)
+        return self.findings
+
+    def visit_stmt(self, node: ast.stmt) -> None:
+        if isinstance(node, ast.Global):
+            self.emit("A005", node, f"`global {', '.join(node.names)}` inside {self.fn.name}()")
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self._visit_assign(node)
+            return
+        if isinstance(node, ast.If):
+            self._visit_if(node)
+            return
+        if isinstance(node, ast.While):
+            if self.guard_depth == 0 and self.is_tainted(node.test):
+                self.emit("A002", node, "while-loop condition depends on traced input/state values")
+            for s in (*node.body, *node.orelse):
+                self.visit_stmt(s)
+            return
+        if isinstance(node, ast.Assert):
+            if self.guard_depth == 0 and self.is_tainted(node.test):
+                self.emit("A002", node, "assert on traced input/state values (use utils.checks guards)")
+            return
+        if isinstance(node, ast.For):
+            if isinstance(node.target, ast.Name) and self.is_tainted(node.iter):
+                # iterating a traced array unrolls over its *static* length —
+                # allowed; the element is still traced
+                self.tainted.add(node.target.id)
+            for s in (*node.body, *node.orelse):
+                self.visit_stmt(s)
+            return
+        if isinstance(node, (ast.With,)):
+            for s in node.body:
+                self.visit_stmt(s)
+            self._scan_expr_tree(node)
+            return
+        if isinstance(node, ast.Try):
+            for s in (*node.body, *node.orelse, *node.finalbody):
+                self.visit_stmt(s)
+            for handler in node.handlers:
+                for s in handler.body:
+                    self.visit_stmt(s)
+            return
+        if isinstance(node, (ast.Return, ast.Expr, ast.Raise, ast.Delete)):
+            self._scan_expr_tree(node)
+            return
+        # nested defs/classes and anything else: still scan for violations
+        self._scan_expr_tree(node)
+
+    def _visit_if(self, node: ast.If) -> None:
+        guard = any(
+            isinstance(n, ast.Name) and n.id in GUARD_NAMES for n in ast.walk(node.test)
+        )
+        if not guard and self.guard_depth == 0 and self.is_tainted(node.test):
+            self.emit(
+                "A002",
+                node,
+                "branch on traced input/state values (shapes/dtypes/config are fine; "
+                "use jnp.where/lax.cond or an _is_concrete guard)",
+            )
+        self._scan_expr(node.test)
+        if guard:
+            self.guard_depth += 1
+        for s in node.body:
+            self.visit_stmt(s)
+        if guard:
+            self.guard_depth -= 1
+        for s in node.orelse:
+            self.visit_stmt(s)
+
+    def _visit_assign(self, node: ast.stmt) -> None:
+        value = getattr(node, "value", None)
+        if value is not None:
+            self._scan_expr(value)
+        targets: Sequence[ast.AST]
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        else:
+            targets = [node.target]
+        value_tainted = value is not None and self.is_tainted(value)
+        for tgt in targets:
+            self._bind_target(tgt, value_tainted, node, aug=isinstance(node, ast.AugAssign))
+
+    def _bind_target(self, tgt: ast.AST, value_tainted: bool, node: ast.stmt, aug: bool) -> None:
+        if isinstance(tgt, ast.Name):
+            if value_tainted:
+                self.tainted.add(tgt.id)
+            else:
+                self.tainted.discard(tgt.id)
+            return
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._bind_target(elt, value_tainted, node, aug)
+            return
+        if isinstance(tgt, ast.Subscript):
+            base = tgt.value
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+                and base.attr in self.state_names
+            ):
+                self.emit(
+                    "A003",
+                    node,
+                    f"in-place subscript write to registered state `self.{base.attr}[...]` "
+                    "(jnp arrays are immutable; rebind with .at[...].set())",
+                )
+            elif isinstance(base, ast.Name) and base.id in self.ctx.module_mutables:
+                self.emit("A005", node, f"mutates module-level `{base.id}` from {self.fn.name}()")
+            return
+        if isinstance(tgt, ast.Attribute):
+            if isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+                name = tgt.attr
+                if name in self.state_names or name in self.known_attrs or name.startswith("_"):
+                    return  # functional rebind of state / config rebind
+                self.emit(
+                    "A003",
+                    node,
+                    f"writes `self.{name}` which is neither registered via add_state nor "
+                    "initialised in __init__ — invisible to get_state/set_state and lost "
+                    "by the compiled engine's functional update",
+                )
+
+    # ----------------------------------------------------- expression scan --
+    def _scan_expr_tree(self, node: ast.AST) -> None:
+        for child in ast.walk(node):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child, recurse=False)
+
+    def _scan_expr(self, node: ast.expr, recurse: bool = True) -> None:
+        nodes = ast.walk(node) if recurse else (node,)
+        for n in nodes:
+            if isinstance(n, ast.Call):
+                self._check_call(n)
+            elif isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load):
+                self._check_foreign_read(n)
+
+    def _check_call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id in HOST_CASTS and self.guard_depth == 0 and self._call_args_tainted(node):
+                self.emit(
+                    "A001",
+                    node,
+                    f"{func.id}() on a traced input/state value forces a device→host sync",
+                )
+            return
+        if not isinstance(func, ast.Attribute):
+            return
+        if func.attr in ("item", "tolist") and self.guard_depth == 0 and self.is_tainted(func.value):
+            self.emit("A001", node, f".{func.attr}() on a traced input/state value forces a device→host sync")
+            return
+        root = self._root_name(func)
+        if root in self.ctx.np_aliases and self.guard_depth == 0 and self._call_args_tainted(node):
+            self.emit(
+                "A001",
+                node,
+                f"numpy call `{root}.{func.attr}(...)` on a traced input/state value "
+                "materialises it on host",
+            )
+            return
+        if (
+            func.attr in MUTATOR_METHODS
+            and isinstance(func.value, ast.Name)
+            and func.value.id in self.ctx.module_mutables
+        ):
+            self.emit("A005", node, f"mutates module-level `{func.value.id}` from {self.fn.name}()")
+
+    def _check_foreign_read(self, node: ast.Attribute) -> None:
+        if node.attr not in self.global_state_names:
+            return
+        base = node.value
+        if isinstance(base, ast.Name) and base.id in ("self", "state", "cls"):
+            return
+        if isinstance(base, (ast.Name, ast.Attribute)):
+            self.emit(
+                "A006",
+                node,
+                f"reads state attribute `.{node.attr}` on a non-self object — stale "
+                "during fused collection streaks; read via compute()/get_state() at "
+                "an observation point instead",
+            )
+
+
+# --------------------------------------------------------------------------- #
+# per-class lint
+# --------------------------------------------------------------------------- #
+def _init_attr_names(classdef: ast.ClassDef) -> Set[str]:
+    """Attributes assigned in this class's __init__ (AST fallback when the
+    registry could not instantiate a probe)."""
+    out: Set[str] = set()
+    for node in classdef.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "__init__":
+            for n in ast.walk(node):
+                if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    tgts = n.targets if isinstance(n, ast.Assign) else [n.target]
+                    for tgt in tgts:
+                        if (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                        ):
+                            out.add(tgt.attr)
+    return out
+
+
+def _addstate_names(classdef: ast.ClassDef) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(classdef):
+        if (
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "add_state"
+            and n.args
+            and isinstance(n.args[0], ast.Constant)
+            and isinstance(n.args[0].value, str)
+        ):
+            out.add(n.args[0].value)
+    return out
+
+
+def _lint_addstate_defaults(ctx: ModuleContext, classdef: ast.ClassDef) -> List[Finding]:
+    findings: List[Finding] = []
+    for n in ast.walk(classdef):
+        if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) and n.func.attr == "add_state"):
+            continue
+        default: Optional[ast.expr] = None
+        if len(n.args) >= 2:
+            default = n.args[1]
+        for kw in n.keywords:
+            if kw.arg == "default":
+                default = kw.value
+        if default is None:
+            continue
+        node = default
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, (ast.USub, ast.UAdd)):
+            node = node.operand
+        if isinstance(node, ast.Constant) and isinstance(node.value, (int, float, bool, complex)):
+            name = (
+                n.args[0].value
+                if n.args and isinstance(n.args[0], ast.Constant)
+                else "<state>"
+            )
+            findings.append(
+                Finding(
+                    rule="A004",
+                    obj=f"{classdef.name}.add_state",
+                    message=f"state `{name}` defaults to bare Python scalar {node.value!r}; "
+                    "wrap it in jnp.asarray(...) so the leaf is an array",
+                    file=ctx.filename,
+                    line=n.lineno,
+                )
+            )
+    return findings
+
+
+def _apply_suppressions(
+    findings: List[Finding],
+    ctx: ModuleContext,
+    fn_lines: Dict[str, int],
+    class_allow: Tuple[str, ...],
+) -> None:
+    for f in findings:
+        allowed: Set[str] = set(class_allow)
+        if f.line is not None:
+            allowed.update(ctx.suppressions.get(f.line, ()))
+        method = f.obj.split(".")[-1]
+        if method in fn_lines:
+            allowed.update(ctx.suppressions.get(fn_lines[method], ()))
+        if f.rule in allowed:
+            f.suppressed = True
+
+
+def lint_class(
+    cls: Type,
+    state_names: Optional[Set[str]] = None,
+    known_attrs: Optional[Set[str]] = None,
+    global_state_names: Optional[Set[str]] = None,
+    host_inputs: bool = False,
+    class_allow: Tuple[str, ...] = (),
+) -> List[Finding]:
+    """All stage-1 findings for methods *defined directly on* ``cls``."""
+    ctx = module_context_for(cls)
+    if ctx is None:
+        return []
+    classdef = ctx.class_def(cls.__name__)
+    if classdef is None:
+        return []
+    # union probe-derived names with source-derived ones: conditionally
+    # registered states (subset_accuracy, return_sentence_level_score, ...)
+    # are absent from the default-config probe but still legitimate
+    state = set(state_names) if state_names is not None else set()
+    state |= _addstate_names(classdef)
+    known = set(known_attrs) if known_attrs is not None else set()
+    known |= _init_attr_names(classdef)
+    universe = set(global_state_names) if global_state_names is not None else set(state)
+
+    findings = _lint_addstate_defaults(ctx, classdef)
+    fn_lines: Dict[str, int] = {}
+    for node in classdef.body:
+        if isinstance(node, ast.FunctionDef) and node.name in LINT_METHODS:
+            fn_lines[node.name] = node.lineno
+            linter = _MethodLinter(
+                ctx, cls.__name__, node, state, known, universe, host_inputs
+            )
+            findings.extend(linter.lint())
+    fn_lines["add_state"] = classdef.lineno
+    _apply_suppressions(findings, ctx, fn_lines, class_allow)
+    return findings
+
+
+def lint_source(filename: str, source: str, global_state_names: Set[str]) -> List[Finding]:
+    """Audit mode (``--paths``): scan arbitrary code for foreign-state reads
+    (A006) — the ROADMAP's stale-member-state caveat, detected statically."""
+    try:
+        ctx = ModuleContext(filename, textwrap.dedent(source))
+    except SyntaxError as err:
+        return [Finding(rule="A006", obj=filename, message=f"unparseable: {err}", file=filename, suppressed=True)]
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load)):
+            continue
+        if node.attr not in global_state_names:
+            continue
+        base = node.value
+        if isinstance(base, ast.Name) and base.id in ("self", "state", "cls"):
+            continue
+        if not isinstance(base, (ast.Name, ast.Attribute)):
+            continue
+        findings.append(
+            Finding(
+                rule="A006",
+                obj=filename,
+                message=f"reads metric state attribute `.{node.attr}` directly — stale during "
+                "fused collection update streaks (members realias only at observation "
+                "points: compute/items/indexing/clone/pickle)",
+                file=filename,
+                line=node.lineno,
+            )
+        )
+    for f in findings:
+        if f.line is not None and f.rule in ctx.suppressions.get(f.line, ()):
+            f.suppressed = True
+    return findings

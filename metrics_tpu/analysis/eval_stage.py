@@ -1,0 +1,277 @@
+"""Stage 2 — abstract-eval sweep over the registered metric universe.
+
+For each registry entry with a spec, this stage instantiates the metric and
+traces its pure protocol *without running a single FLOP*:
+
+* ``jax.eval_shape`` over ``update_state`` with canonical abstract inputs,
+  twice in a row (a simulated multi-step streak) — treedef stability,
+  dtype/weak-type stability, donation-aliasing compatibility;
+* ``jax.make_jaxpr(..., axis_env=[("data", 8)])`` over ``sync_states`` and
+  ``sync_compute_state`` — a mock 8-device mesh needing no real devices —
+  asserting sync treedef stability and a trace-time collective budget via
+  :func:`metrics_tpu.parallel.sync.count_collectives`. The budget is what the
+  canonical bucketed ``sync_state`` emits for the same state pytree: a custom
+  sync override that spends more network phases than the default is an error.
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.analysis.registry import Entry
+from metrics_tpu.analysis.rules import Finding
+from metrics_tpu.parallel import sync as _sync
+
+AXIS = "data"
+WORLD = 8
+
+
+def _materialize(spec_inputs: Any) -> List[Any]:
+    """``[("float32", (8, 4)), ...]`` -> concrete zero arrays (values never
+    matter: everything downstream is eval_shape/make_jaxpr)."""
+    out = []
+    for item in spec_inputs or []:
+        dtype, shape = item
+        out.append(jnp.zeros(shape, dtype=dtype))
+    return out
+
+
+def _materialize_kwargs(spec_kwargs: Any) -> Dict[str, Any]:
+    return {k: jnp.zeros(shape, dtype=dtype) for k, (dtype, shape) in (spec_kwargs or {}).items()}
+
+
+def _aval(x: Any) -> Tuple:
+    return (tuple(getattr(x, "shape", ())), str(getattr(x, "dtype", "?")), bool(getattr(x, "weak_type", False)))
+
+
+def _leaf_paths(tree: Any) -> List[Tuple[str, Any]]:
+    try:
+        flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+        return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+    except Exception:
+        return [(f"[{i}]", leaf) for i, leaf in enumerate(jax.tree_util.tree_leaves(tree))]
+
+
+def _err(e: BaseException) -> str:
+    return f"{type(e).__name__}: {e}".splitlines()[0][:300]
+
+
+def instantiate(entry: Entry) -> Optional[Finding]:
+    """Build ``entry.instance`` from the spec; an E003 finding on failure.
+
+    Specs may set ``"no_probe"`` (with a reason string) for metrics whose
+    constructor is too heavy to probe — pretrained-LM downloads and the like;
+    the AST stage then falls back to source-derived state names."""
+    if entry.spec is None or entry.spec.get("no_probe") or entry.instance is not None:
+        return None
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            init_fn = entry.spec.get("init_fn")
+            if init_fn is not None:
+                entry.instance = init_fn()
+            else:
+                entry.instance = entry.cls(**entry.spec.get("init", {}))
+    except Exception as e:  # noqa: BLE001 — any constructor failure is the finding
+        entry.init_error = _err(e)
+        return Finding(
+            rule="E003",
+            obj=entry.name,
+            message=f"constructing from ANALYSIS_SPECS failed: {entry.init_error}",
+        )
+    return None
+
+
+def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    if entry.spec is None:
+        findings.append(
+            Finding(
+                rule="E002",
+                obj=entry.name,
+                message=f"exported metric has no ANALYSIS_SPECS entry in its domain package "
+                f"({entry.cls.__module__})",
+            )
+        )
+        return findings
+    if entry.skip_eval:
+        entry.notes.append(f"eval skipped: {entry.skip_eval}")
+        return findings
+
+    e003 = instantiate(entry)
+    if e003 is not None:
+        findings.append(e003)
+        return findings
+    inst = entry.instance
+
+    if not (inst.supports_compiled_update and inst.supports_compiled_compute):
+        findings.append(
+            Finding(
+                rule="E001",
+                obj=entry.name,
+                message="unbounded Python-list state: the compiled engines skip this metric "
+                "(construct with buffer_capacity=N to opt in); eval sweep skipped",
+            )
+        )
+        return findings
+
+    args = _materialize(entry.spec.get("inputs"))
+    kwargs = _materialize_kwargs(entry.spec.get("kwargs"))
+    # static flags (FID's `real=True`, ...) are closed over, not traced
+    static_kwargs = dict(entry.spec.get("static_kwargs", {}))
+
+    def _step(s, *a, **kw):
+        return inst.update_state(s, *a, **kw, **static_kwargs)
+
+    # ---------------------------------------------------------- update leg --
+    try:
+        state0 = inst.init_state(*args, **kwargs) if not static_kwargs else inst.get_state()
+        out1 = jax.eval_shape(_step, state0, *args, **kwargs)
+        out2 = jax.eval_shape(_step, out1, *args, **kwargs)
+    except Exception as e:  # noqa: BLE001
+        findings.append(
+            Finding(
+                rule="E101",
+                obj=entry.name,
+                message=f"eval_shape over update_state failed: {_err(e)}",
+            )
+        )
+        return findings
+
+    t1, t2 = jax.tree_util.tree_structure(out1), jax.tree_util.tree_structure(out2)
+    if t1 != t2:
+        findings.append(
+            Finding(
+                rule="E102",
+                obj=entry.name,
+                message=f"update_state treedef drifts across a streak: step1 {t1} vs step2 {t2}",
+            )
+        )
+    if isinstance(out1, dict):
+        for key, v0 in state0.items():
+            v1 = out1.get(key)
+            if isinstance(v0, (tuple, list, dict)) and type(v1) is not type(v0):
+                findings.append(
+                    Finding(
+                        rule="E102",
+                        obj=entry.name,
+                        message=f"state `{key}` container drifts {type(v0).__name__} -> "
+                        f"{type(v1).__name__} across update_state",
+                    )
+                )
+    if t1 == t2:
+        for (path, a), (_, b) in zip(_leaf_paths(out1), _leaf_paths(out2)):
+            (sh_a, dt_a, wk_a), (sh_b, dt_b, wk_b) = _aval(a), _aval(b)
+            if (sh_a, dt_a) != (sh_b, dt_b):
+                findings.append(
+                    Finding(
+                        rule="E104",
+                        obj=entry.name,
+                        message=f"state leaf {path} aval drifts {sh_a}/{dt_a} -> {sh_b}/{dt_b} "
+                        "across a streak: the donated input buffer cannot alias the output",
+                    )
+                )
+            elif wk_a != wk_b:
+                findings.append(
+                    Finding(
+                        rule="E103",
+                        obj=entry.name,
+                        message=f"state leaf {path} weak-type flips {wk_a} -> {wk_b} across a "
+                        "streak: one silent recompile per flip",
+                    )
+                )
+
+    # ------------------------------------------------------------ sync leg --
+    # steady-state concrete state for the mesh traces
+    state = jax.tree_util.tree_map(
+        lambda l: jnp.zeros(l.shape, l.dtype) if hasattr(l, "shape") else l, out1
+    )
+
+    with _sync.count_collectives() as budget_box:
+        try:
+            jax.make_jaxpr(
+                lambda s: _sync.sync_state(s, dict(inst._reductions), AXIS),
+                axis_env=[(AXIS, WORLD)],
+            )(dict(state) if isinstance(state, dict) else state)
+        except Exception as e:  # noqa: BLE001 — canonical sync must trace; treat as untraceable
+            entry.notes.append(f"canonical sync_state trace failed: {_err(e)}")
+    allowed = entry.spec.get("collective_budget", budget_box["count"])
+    if budget_cap is not None:
+        allowed = min(allowed, budget_cap)
+
+    with _sync.count_collectives() as box:
+        try:
+            _, sync_shape = jax.make_jaxpr(
+                lambda s: inst.sync_states(s, AXIS),
+                axis_env=[(AXIS, WORLD)],
+                return_shape=True,
+            )(state)
+        except Exception as e:  # noqa: BLE001
+            findings.append(
+                Finding(
+                    rule="E107",
+                    obj=entry.name,
+                    message=f"sync_states failed to trace under the mock {WORLD}-device mesh: {_err(e)}",
+                )
+            )
+            sync_shape = None
+    actual = box["count"]
+    entry.notes.append(f"collectives: {actual} (budget {allowed}, by_kind {box['by_kind']})")
+
+    if sync_shape is not None:
+        ts_in, ts_out = jax.tree_util.tree_structure(state), jax.tree_util.tree_structure(sync_shape)
+        if ts_in != ts_out:
+            findings.append(
+                Finding(
+                    rule="E105",
+                    obj=entry.name,
+                    message=f"sync_states changes the state treedef: {ts_in} -> {ts_out} "
+                    "(set_state after sync would corrupt state)",
+                )
+            )
+        elif isinstance(sync_shape, dict):
+            for key, v0 in state.items():
+                v1 = sync_shape.get(key)
+                if isinstance(v0, (tuple, list, dict)) and type(v1) is not type(v0):
+                    findings.append(
+                        Finding(
+                            rule="E105",
+                            obj=entry.name,
+                            message=f"state `{key}` container drifts {type(v0).__name__} -> "
+                            f"{type(v1).__name__} across sync_states (the PR-3 tuple→list class)",
+                        )
+                    )
+        if actual > allowed:
+            findings.append(
+                Finding(
+                    rule="E106",
+                    obj=entry.name,
+                    message=f"sync_states emits {actual} collectives on the mock {WORLD}-device "
+                    f"mesh; budget is {allowed} (canonical bucketed sync_state for the same "
+                    f"state pytree); by_kind={box['by_kind']}",
+                    extra={"collectives": actual, "budget": allowed, "by_kind": dict(box["by_kind"])},
+                )
+            )
+
+    # ----------------------------------------------------- fused compute leg --
+    try:
+        jax.make_jaxpr(
+            lambda s: inst.sync_compute_state(s, AXIS), axis_env=[(AXIS, WORLD)]
+        )(state)
+    except Exception as e:  # noqa: BLE001
+        findings.append(
+            Finding(
+                rule="E107",
+                obj=entry.name,
+                message=f"sync_compute_state failed to trace under the mock {WORLD}-device mesh: "
+                f"{_err(e)} — the compiled compute engine will run this metric eagerly",
+            )
+        )
+
+    for f in findings:
+        if f.rule in entry.allow:
+            f.suppressed = True
+    return findings

@@ -1,0 +1,140 @@
+"""The analyzer's metric universe.
+
+The registry is the cross product of two sources:
+
+* the public export surface — every :class:`~metrics_tpu.Metric` subclass
+  reachable from ``metrics_tpu.__all__`` (what users can construct), and
+* the declarative ``ANALYSIS_SPECS`` dicts each domain package publishes next
+  to its exports (how the analyzer constructs and feeds each class).
+
+A spec entry looks like::
+
+    ANALYSIS_SPECS = {
+        "ConfusionMatrix": {
+            "init": {"num_classes": 4},                    # constructor kwargs
+            "inputs": [("float32", (8, 4)), ("int32", (8,))],  # update args
+        },
+        "WordErrorRate": {
+            "skip_eval": "string inputs are host-side by design",
+            "host_inputs": True,   # relax input-taint AST rules (A001/A002)
+        },
+        "MinMaxMetric": {
+            "init_fn": lambda: MinMaxMetric(MeanSquaredError()),  # or a factory
+            "inputs": [("float32", (8,)), ("float32", (8,))],
+        },
+    }
+
+Optional keys: ``"kwargs"`` (update kwargs, same ``(dtype, shape)`` form),
+``"allow"`` (rule ids suppressed class-wide), ``"collective_budget"`` (absolute
+per-metric cap overriding the canonical-sync budget). An exported metric class
+with no spec is itself a finding (``E002``) — that is the merge gate: new
+metrics must declare how they are analyzed.
+"""
+from __future__ import annotations
+
+import importlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple, Type
+
+# domain packages that publish ANALYSIS_SPECS next to their exports
+SPEC_MODULES = (
+    "metrics_tpu.aggregation",
+    "metrics_tpu.audio",
+    "metrics_tpu.classification",
+    "metrics_tpu.detection",
+    "metrics_tpu.image",
+    "metrics_tpu.regression",
+    "metrics_tpu.retrieval",
+    "metrics_tpu.text",
+    "metrics_tpu.wrappers",
+)
+
+
+@dataclass
+class Entry:
+    cls: Type
+    spec: Optional[Dict[str, Any]]       # None => E002
+    instance: Any = None                 # populated by the eval stage
+    init_error: Optional[str] = None
+    notes: List[str] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.cls.__name__
+
+    @property
+    def allow(self) -> Tuple[str, ...]:
+        return tuple((self.spec or {}).get("allow", ()))
+
+    @property
+    def host_inputs(self) -> bool:
+        return bool((self.spec or {}).get("host_inputs", False))
+
+    @property
+    def skip_eval(self) -> Optional[str]:
+        return (self.spec or {}).get("skip_eval")
+
+
+def collect_specs() -> Dict[str, Dict[str, Any]]:
+    specs: Dict[str, Dict[str, Any]] = {}
+    for modname in SPEC_MODULES:
+        mod = importlib.import_module(modname)
+        for name, spec in getattr(mod, "ANALYSIS_SPECS", {}).items():
+            specs[name] = spec
+    return specs
+
+
+def metric_classes() -> List[Type]:
+    """Every public Metric subclass, in export order."""
+    import metrics_tpu
+    from metrics_tpu.core.metric import Metric
+
+    out: List[Type] = []
+    for name in metrics_tpu.__all__:
+        obj = getattr(metrics_tpu, name, None)
+        if isinstance(obj, type) and issubclass(obj, Metric) and obj is not Metric:
+            out.append(obj)
+    return out
+
+
+def build_registry() -> List[Entry]:
+    specs = collect_specs()
+    return [Entry(cls=cls, spec=specs.get(cls.__name__)) for cls in metric_classes()]
+
+
+def lintable_classes(entries: List[Entry]) -> List[Type]:
+    """Registry classes plus their Metric-subclass ancestors, deduplicated —
+    shared bases (StatScores, the retrieval base, ...) are linted once and
+    findings attach to the defining class."""
+    from metrics_tpu.core.metric import Metric
+
+    seen: Dict[Tuple[str, str], Type] = {}
+    for entry in entries:
+        for klass in entry.cls.__mro__:
+            if klass is Metric or not issubclass(klass, Metric):
+                continue
+            seen.setdefault((klass.__module__, klass.__qualname__), klass)
+    return list(seen.values())
+
+
+def spec_for_class(entries: List[Entry], cls: Type) -> Optional[Entry]:
+    """The registry entry whose class defines or inherits ``cls``; prefers an
+    exact match, else the first subclass (so base-class lint findings inherit
+    the most specific spec's allow/host_inputs flags only on exact match)."""
+    for entry in entries:
+        if entry.cls is cls:
+            return entry
+    for entry in entries:
+        if issubclass(entry.cls, cls):
+            return entry
+    return None
+
+
+def state_name_universe(entries: List[Entry]) -> set:
+    """Union of registered state names across all instantiated entries — the
+    A006 foreign-state-read vocabulary."""
+    names: set = set()
+    for entry in entries:
+        if entry.instance is not None:
+            names.update(entry.instance._defaults.keys())
+    return names

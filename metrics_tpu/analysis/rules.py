@@ -1,0 +1,199 @@
+"""Rule catalog and finding model for :mod:`metrics_tpu.analysis`.
+
+Two rule families mirror the analyzer's two stages:
+
+* ``A###`` — AST lint rules over metric source (stage 1). Purely static: no
+  metric is instantiated, no tracing happens.
+* ``E###`` — abstract-eval rules over the registered metric universe
+  (stage 2): ``jax.eval_shape`` / ``jax.make_jaxpr`` sweeps of the pure
+  protocol (``update_state``, ``sync_states ∘ compute_state``) under a mock
+  8-device mesh.
+
+Severity decides the exit code, not the report: ``--strict`` fails on any
+unsuppressed *error*; warnings and infos always pass. Suppression is per-rule
+via an inline ``# metrics-tpu: allow[A001]`` comment on the offending line (or
+the enclosing ``def`` line), or an ``"allow": ("A001",)`` tuple in the metric's
+``ANALYSIS_SPECS`` entry.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+_SEVERITY_ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    severity: str
+    summary: str
+
+
+# --------------------------------------------------------------------------- #
+# stage 1 — AST lint
+# --------------------------------------------------------------------------- #
+_AST_RULES = (
+    Rule(
+        "A001", "host-roundtrip", ERROR,
+        "update/compute calls .item()/.tolist(), float()/int()/bool(), or a "
+        "numpy function on a value derived from inputs or registered state — "
+        "a device→host sync that breaks under jit tracing (unless under an "
+        "_is_concrete/_tracing_active guard).",
+    ),
+    Rule(
+        "A002", "data-dependent-control-flow", ERROR,
+        "Python if/while/assert whose test depends on input or state *values* "
+        "(shapes/dtypes/config are fine) — a ConcretizationTypeError under "
+        "jit; use jnp.where/lax.cond or guard with _is_concrete.",
+    ),
+    Rule(
+        "A003", "hidden-state-write", ERROR,
+        "update/compute writes a self attribute that is neither registered "
+        "via add_state nor initialised in __init__, or mutates registered "
+        "state in place — invisible to get_state/set_state and lost by the "
+        "compiled engine's functional update.",
+    ),
+    Rule(
+        "A004", "scalar-state-leaf", ERROR,
+        "add_state default is a bare Python scalar — a non-array pytree leaf "
+        "that defeats donation and (before interning) the _SigCache id-keyed "
+        "dispatch memo; wrap it in jnp.asarray(...).",
+    ),
+    Rule(
+        "A005", "mutable-global-closure", WARNING,
+        "update/compute declares `global` or mutates a module-level "
+        "list/dict/set — hidden cross-instance state the tracer bakes in at "
+        "trace time and never sees change.",
+    ),
+    Rule(
+        "A006", "foreign-state-read", WARNING,
+        "reads a registered-state attribute (tp/fp/total/...) on an object "
+        "other than self — during fused collection streaks member state is "
+        "stale between observation points, so such reads see outdated values.",
+    ),
+)
+
+# --------------------------------------------------------------------------- #
+# stage 2 — abstract-eval sweep
+# --------------------------------------------------------------------------- #
+_EVAL_RULES = (
+    Rule(
+        "E001", "engine-ineligible", INFO,
+        "metric carries unbounded Python-list state, so the compiled "
+        "update/compute engines skip it (construct with buffer_capacity=N to "
+        "opt in); abstract-eval checks are skipped.",
+    ),
+    Rule(
+        "E002", "missing-spec", ERROR,
+        "metric class exported from metrics_tpu has no ANALYSIS_SPECS entry "
+        "in its domain package — the analyzer cannot vouch for it, so it "
+        "cannot merge.",
+    ),
+    Rule(
+        "E003", "uninstantiable", ERROR,
+        "constructing the metric from its ANALYSIS_SPECS init spec raised.",
+    ),
+    Rule(
+        "E101", "untraceable-update", ERROR,
+        "jax.eval_shape over update_state raised with canonical abstract "
+        "inputs — the compiled update engine would trace-fail and demote the "
+        "metric (and any collection containing it) to the eager loop.",
+    ),
+    Rule(
+        "E102", "update-treedef-drift", ERROR,
+        "update_state changes the state pytree structure between steps "
+        "(container types or treedef) — recompiles every step and breaks "
+        "lax.scan carries and donation.",
+    ),
+    Rule(
+        "E103", "aval-instability", WARNING,
+        "a state leaf's dtype/weak-type drifts across a simulated multi-step "
+        "streak — each drift is a silent recompile of the cached executable.",
+    ),
+    Rule(
+        "E104", "donation-alias-mismatch", WARNING,
+        "a state leaf's shape/dtype differs between update input and output "
+        "at the same tree position — XLA cannot alias the donated input "
+        "buffer, so donate_argnums silently copies instead.",
+    ),
+    Rule(
+        "E105", "sync-treedef-drift", ERROR,
+        "sync_states returns a state pytree with different structure or "
+        "container types than its input (the PR-3 tuple→list class) — "
+        "set_state after sync then corrupts the state.",
+    ),
+    Rule(
+        "E106", "collective-budget-overrun", ERROR,
+        "tracing sync_states under a mock 8-device mesh emits more "
+        "collectives than the canonical bucketed sync_state budget for the "
+        "same state (or the --budget cap) — a custom sync override is "
+        "spending extra network phases per finalize.",
+    ),
+    Rule(
+        "E107", "untraceable-compute", WARNING,
+        "sync_compute_state failed to trace under the mock mesh "
+        "(value-dependent shapes such as CatBuffer.to_array, or host "
+        "readbacks) — the compiled compute engine will fall back to eager "
+        "for this metric.",
+    ),
+)
+
+RULES: Dict[str, Rule] = {r.id: r for r in (*_AST_RULES, *_EVAL_RULES)}
+
+# inline suppression:  some_code()  # metrics-tpu: allow[A001] or allow[A001,E106]
+SUPPRESS_RE = re.compile(r"#\s*metrics-tpu:\s*allow\[([A-Za-z0-9_,\s]+)\]")
+
+
+def parse_suppressions(source: str) -> Dict[int, Tuple[str, ...]]:
+    """Map 1-based line number -> rule ids allowed on that line."""
+    out: Dict[int, Tuple[str, ...]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = SUPPRESS_RE.search(line)
+        if m:
+            out[i] = tuple(tok.strip() for tok in m.group(1).split(",") if tok.strip())
+    return out
+
+
+@dataclass
+class Finding:
+    rule: str
+    obj: str                      # "ClassName.method" or "ClassName"
+    message: str
+    file: Optional[str] = None
+    line: Optional[int] = None
+    suppressed: bool = False
+    extra: Dict[str, object] = field(default_factory=dict)
+
+    @property
+    def severity(self) -> str:
+        return RULES[self.rule].severity
+
+    def location(self) -> str:
+        if self.file is None:
+            return self.obj
+        return f"{self.file}:{self.line}" if self.line else self.file
+
+    def to_dict(self) -> Dict[str, object]:
+        d = {
+            "rule": self.rule,
+            "name": RULES[self.rule].name,
+            "severity": self.severity,
+            "obj": self.obj,
+            "message": self.message,
+            "file": self.file,
+            "line": self.line,
+            "suppressed": self.suppressed,
+        }
+        if self.extra:
+            d["extra"] = self.extra
+        return d
+
+    def sort_key(self) -> Tuple:
+        return (_SEVERITY_ORDER[self.severity], self.rule, self.file or "", self.line or 0)

@@ -14,3 +14,32 @@ __all__ = [
     "SignalDistortionRatio",
     "SignalNoiseRatio",
 ]
+
+
+# --------------------------------------------------------------------------- #
+# analyzer registry (metrics_tpu.analysis); see docs/static_analysis.md
+# --------------------------------------------------------------------------- #
+_WAVE = [("float32", (3, 8000)), ("float32", (3, 8000))]
+
+ANALYSIS_SPECS = {
+    "SignalNoiseRatio": {"inputs": _WAVE},
+    "ScaleInvariantSignalNoiseRatio": {"inputs": _WAVE},
+    "SignalDistortionRatio": {"inputs": _WAVE},
+    "ScaleInvariantSignalDistortionRatio": {"inputs": _WAVE},
+    "PerceptualEvaluationSpeechQuality": {
+        "init": {"fs": 16000, "mode": "wb"},
+        "skip_eval": "reference PESQ DSP runs on host by design",
+        "host_inputs": True,
+    },
+    "ShortTimeObjectiveIntelligibility": {
+        "init": {"fs": 16000},
+        "skip_eval": "reference STOI DSP runs on host by design",
+        "host_inputs": True,
+    },
+    "PermutationInvariantTraining": {
+        "init_fn": lambda: PermutationInvariantTraining(
+            __import__("metrics_tpu.ops.audio.snr", fromlist=["x"]).scale_invariant_signal_noise_ratio
+        ),
+        "inputs": [("float32", (2, 2, 1000)), ("float32", (2, 2, 1000))],
+    },
+}

@@ -28,3 +28,53 @@ from metrics_tpu.classification.ranking import (  # noqa: F401
 from metrics_tpu.classification.roc import ROC  # noqa: F401
 from metrics_tpu.classification.specificity import Specificity  # noqa: F401
 from metrics_tpu.classification.stat_scores import StatScores  # noqa: F401
+
+
+# --------------------------------------------------------------------------- #
+# analyzer registry (metrics_tpu.analysis): constructor + canonical abstract
+# input specs per export; see docs/static_analysis.md
+# --------------------------------------------------------------------------- #
+_BINARY = [("float32", (16,)), ("int32", (16,))]
+_LABELS4 = [("int32", (16,)), ("int32", (16,))]
+_MULTILABEL5 = [("float32", (8, 5)), ("int32", (8, 5))]
+
+ANALYSIS_SPECS = {
+    "Accuracy": {"inputs": _BINARY},
+    "Dice": {"inputs": _BINARY},
+    "F1Score": {"inputs": _BINARY},
+    "FBetaScore": {"inputs": _BINARY},
+    "HammingDistance": {"inputs": _BINARY},
+    "HingeLoss": {"inputs": _BINARY},
+    "Precision": {"inputs": _BINARY},
+    "Recall": {"inputs": _BINARY},
+    "Specificity": {"inputs": _BINARY},
+    "StatScores": {"inputs": _BINARY},
+    # curve family: buffer_capacity turns the unbounded cat states into
+    # CatBuffers so the compiled path (and the eval sweep) covers them
+    "AUC": {"init": {"buffer_capacity": 64}, "inputs": [("float32", (16,)), ("float32", (16,))]},
+    "AUROC": {"init": {"buffer_capacity": 64}, "inputs": _BINARY},
+    "AveragePrecision": {"init": {"buffer_capacity": 64}, "inputs": _BINARY},
+    "CalibrationError": {"init": {"buffer_capacity": 64}, "inputs": _BINARY},
+    "PrecisionRecallCurve": {"init": {"buffer_capacity": 64}, "inputs": _BINARY},
+    "ROC": {"init": {"buffer_capacity": 64}, "inputs": _BINARY},
+    "CohenKappa": {"init": {"num_classes": 4}, "inputs": _LABELS4},
+    "ConfusionMatrix": {"init": {"num_classes": 4}, "inputs": _LABELS4},
+    "JaccardIndex": {"init": {"num_classes": 4}, "inputs": _LABELS4},
+    "MatthewsCorrCoef": {"init": {"num_classes": 4}, "inputs": _LABELS4},
+    "KLDivergence": {"inputs": [("float32", (8, 5)), ("float32", (8, 5))]},
+    "CoverageError": {"inputs": _MULTILABEL5},
+    "LabelRankingAveragePrecision": {"inputs": _MULTILABEL5},
+    "LabelRankingLoss": {"inputs": _MULTILABEL5},
+    "BinnedAveragePrecision": {
+        "init": {"num_classes": 3, "thresholds": 50},
+        "inputs": [("float32", (16, 3)), ("int32", (16, 3))],
+    },
+    "BinnedPrecisionRecallCurve": {
+        "init": {"num_classes": 3, "thresholds": 50},
+        "inputs": [("float32", (16, 3)), ("int32", (16, 3))],
+    },
+    "BinnedRecallAtFixedPrecision": {
+        "init": {"num_classes": 3, "min_precision": 0.5, "thresholds": 50},
+        "inputs": [("float32", (16, 3)), ("int32", (16, 3))],
+    },
+}

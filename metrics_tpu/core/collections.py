@@ -305,6 +305,36 @@ class MetricCollection:
             self._compute_engine = _engine.CollectionComputeEngine(self)
         return self._compute_engine
 
+    def engine_stats(self) -> Dict[str, Any]:
+        """Dispatch counters and fallback reasons across the collection.
+
+        ``update``/``compute`` are the collection-level engines'
+        :class:`EngineStats` (``None`` until built), ``members`` maps each
+        member name to its own :meth:`Metric.engine_stats`, and
+        ``fallback_reasons`` merges every recorded eager-fallback reason keyed
+        ``"<kind>:<OwnerClass>"`` — so a collection silently demoted to the
+        eager loop is one dict lookup away from its cause.
+        """
+        stats: Dict[str, Any] = {
+            "update": self._update_engine.stats if self._update_engine is not None else None,
+            "compute": self._compute_engine.stats if self._compute_engine is not None else None,
+        }
+        reasons: Dict[str, str] = {}
+        for kind in ("update", "compute"):
+            s = stats[kind]
+            if s is not None:
+                for owner, why in s.fallback_reasons.items():
+                    reasons[f"{kind}:{owner}"] = why
+        members: Dict[str, Any] = {}
+        for name in self._metrics:
+            member = self._metrics.__getitem__(name)
+            member_stats = member.engine_stats()
+            members[name] = member_stats
+            reasons.update(member_stats["fallback_reasons"])
+        stats["members"] = members
+        stats["fallback_reasons"] = reasons
+        return stats
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Fused update: one update per compute group; members share the
         leader's (immutable) state by reference. Reference: :160-179.

@@ -149,6 +149,10 @@ class EngineStats:
     donated_calls: int = 0  # compiled calls that donated the state pytree
     bucketed_calls: int = 0  # updates routed through the shape-bucketing layer
     key_fast_hits: int = 0  # dispatch keys served from the id-keyed aval memo
+    # metric/collection class name -> why the engine permanently reverted it to
+    # the eager path; feeds ``engine_stats()`` so runtime fallbacks can be
+    # diffed against the static analyzer's findings (metrics_tpu.analysis)
+    fallback_reasons: Dict[str, str] = field(default_factory=dict)
 
     @property
     def compiled_calls(self) -> int:
@@ -187,34 +191,56 @@ def _aval_signature(tree: Any) -> Tuple:
     return _aval_signature_flat(leaves, treedef)
 
 
+# hashable immutable python leaves that can be memo-keyed by VALUE instead of
+# identity: equal values are interchangeable for dispatch (the aval key only
+# records their type), so a fresh-but-equal scalar object still hits the memo
+_INTERNABLE_TYPES = _SCALAR_TYPES + (str, bytes)
+
+
 class _SigCache:
-    """Single-entry id-keyed memo for :func:`_aval_signature`.
+    """Single-entry identity-keyed memo for :func:`_aval_signature`.
 
     Steady-state facade dispatch re-derives the aval key of an unchanged tree
     every call — a python loop over every leaf plus shape/dtype tuple hashing
     (config1 measured 72.6 us facade vs 4.95 us raw jit). When the incoming
     tree is built from the very same leaf objects as last time (repeated
     ``compute()`` on untouched state; the seeded output of the previous
-    update dispatch), the signature cannot have changed, so an id-tuple
-    comparison replaces the per-leaf walk. Weak references pin correctness:
-    the memo only answers while every original leaf is still alive, so a
-    recycled ``id()`` can never alias a dead leaf. Trees holding any
-    non-weakrefable leaf (python scalars) simply never memoize.
+    update dispatch), the signature cannot have changed, so a key-tuple
+    comparison replaces the per-leaf walk.
+
+    Leaf keys come in two flavors. Array leaves are keyed by ``id()`` with a
+    weak reference pinning correctness: the memo only answers while every
+    original leaf is still alive, so a recycled ``id()`` can never alias a
+    dead leaf. Non-weakrefable python scalars (and str/bytes kwargs) are
+    *interned by value* — keyed ``(type, value)`` — so scalar-kwarg metrics
+    keep the fast path instead of disabling the memo: a fresh ``2.5`` every
+    call compares equal, and value keys cannot go stale (no liveness to
+    track). A leaf that is neither weakrefable nor hashable leaves the memo
+    un-stored (correct, just slower).
     """
 
-    __slots__ = ("_ids", "_treedef", "_refs", "_sig")
+    __slots__ = ("_keys", "_treedef", "_refs", "_sig")
 
     def __init__(self) -> None:
-        self._ids: Optional[Tuple[int, ...]] = None
+        self._keys: Optional[Tuple] = None
         self._treedef = None
         self._refs: Tuple = ()
         self._sig: Optional[Tuple] = None
 
+    @staticmethod
+    def _leaf_keys(leaves: list) -> Tuple:
+        # ints (ids) and (type, value) tuples never compare equal, so the two
+        # key flavors cannot alias each other inside one key tuple
+        return tuple(
+            (type(leaf), leaf) if isinstance(leaf, _INTERNABLE_TYPES) else id(leaf)
+            for leaf in leaves
+        )
+
     def signature(self, tree: Any, stats: Optional["EngineStats"] = None) -> Tuple:
         leaves, treedef = jax.tree_util.tree_flatten(tree)
-        ids = tuple(map(id, leaves))
+        keys = self._leaf_keys(leaves)
         if (
-            ids == self._ids
+            keys == self._keys
             and treedef == self._treedef
             and all(ref() is not None for ref in self._refs)
         ):
@@ -222,7 +248,7 @@ class _SigCache:
                 stats.key_fast_hits += 1
             return self._sig
         sig = _aval_signature_flat(leaves, treedef)
-        self._store(leaves, treedef, ids, sig)
+        self._store(leaves, treedef, keys, sig)
         return sig
 
     def seed(self, tree: Any, sig: Optional[Tuple] = None) -> None:
@@ -234,15 +260,21 @@ class _SigCache:
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         if sig is None:
             sig = _aval_signature_flat(leaves, treedef)
-        self._store(leaves, treedef, tuple(map(id, leaves)), sig)
+        self._store(leaves, treedef, self._leaf_keys(leaves), sig)
 
-    def _store(self, leaves: list, treedef: Any, ids: Tuple[int, ...], sig: Tuple) -> None:
+    def _store(self, leaves: list, treedef: Any, keys: Tuple, sig: Tuple) -> None:
         try:
-            self._refs = tuple(weakref.ref(leaf) for leaf in leaves)
-        except TypeError:  # non-weakrefable leaf: stay un-memoized (correct, just slower)
-            self._ids = None
+            # only identity-keyed leaves need liveness pins; value-keyed
+            # (interned) leaves are immortal by construction
+            self._refs = tuple(
+                weakref.ref(leaf)
+                for leaf, key in zip(leaves, keys)
+                if isinstance(key, int)
+            )
+        except TypeError:  # non-weakrefable, non-internable leaf: stay un-memoized
+            self._keys = None
             return
-        self._ids, self._treedef, self._sig = ids, treedef, sig
+        self._keys, self._treedef, self._sig = keys, treedef, sig
 
 
 def _leaves_compilable(tree: Any) -> bool:
@@ -315,6 +347,11 @@ class _EngineBase:
         """Why the engine permanently fell back to eager mode (None = healthy)."""
         return self._broken
 
+    def _owner_name(self) -> str:
+        """Class name of the metric/collection this engine accelerates."""
+        owner = getattr(self, "metric", None) or getattr(self, "collection", None)
+        return type(owner).__name__ if owner is not None else type(self).__name__
+
     def _dispatch(self, plain_fn: Callable, donate_fn: Callable,
                   state: Any, args: Tuple, kwargs: Dict, protected: set) -> Tuple[bool, Any]:
         """Core cache dance. Returns (handled, result)."""
@@ -341,8 +378,10 @@ class _EngineBase:
             new_state = fn(state, *args, **kwargs)
         except Exception as err:  # untraceable target: revert to eager for good
             self._broken = f"{type(err).__name__}: {err}"
+            self.stats.fallback_reasons[self._owner_name()] = self._broken
             rank_zero_warn(
-                f"compiled-{self._kind} engine disabled for {type(self).__name__} target: "
+                f"compiled-{self._kind} engine disabled for {self._owner_name()} "
+                f"({type(self).__name__}) target: "
                 f"{self._target} raised under jit tracing ({self._broken.splitlines()[0][:200]}). "
                 f"Reverting to eager {self._kind}s; pass {self._opt_out} to silence.",
                 UserWarning,

@@ -641,6 +641,28 @@ class Metric:
             self._compute_engine = _engine.CompiledComputeEngine(self)
         return self._compute_engine
 
+    def engine_stats(self) -> Dict[str, Any]:
+        """Dispatch counters and fallback reasons for this metric's compiled
+        engines.
+
+        ``update``/``compute`` are the engines' :class:`EngineStats` (``None``
+        until the corresponding engine is first built), and
+        ``fallback_reasons`` merges both engines' recorded eager-fallback
+        reasons keyed ``"<kind>:<MetricClass>"`` — the runtime counterpart of
+        the static findings from ``python -m metrics_tpu.analysis``.
+        """
+        stats: Dict[str, Any] = {
+            "update": self._update_engine.stats if self._update_engine is not None else None,
+            "compute": self._compute_engine.stats if self._compute_engine is not None else None,
+        }
+        reasons: Dict[str, str] = {}
+        for kind, s in stats.items():
+            if s is not None:
+                for owner, why in s.fallback_reasons.items():
+                    reasons[f"{kind}:{owner}"] = why
+        stats["fallback_reasons"] = reasons
+        return stats
+
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:

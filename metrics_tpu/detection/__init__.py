@@ -2,3 +2,12 @@
 from metrics_tpu.detection.mean_ap import MeanAveragePrecision
 
 __all__ = ["MeanAveragePrecision"]
+
+
+# analyzer registry (metrics_tpu.analysis); see docs/static_analysis.md
+ANALYSIS_SPECS = {
+    "MeanAveragePrecision": {
+        "skip_eval": "dict-of-boxes inputs and COCO matching are host-side by design",
+        "host_inputs": True,
+    },
+}

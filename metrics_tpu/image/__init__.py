@@ -28,3 +28,36 @@ __all__ = [
     "StructuralSimilarityIndexMeasure",
     "UniversalImageQualityIndex",
 ]
+
+
+# --------------------------------------------------------------------------- #
+# analyzer registry (metrics_tpu.analysis); see docs/static_analysis.md
+# --------------------------------------------------------------------------- #
+_IMG = [("float32", (2, 3, 32, 32)), ("float32", (2, 3, 32, 32))]
+
+ANALYSIS_SPECS = {
+    "PeakSignalNoiseRatio": {"inputs": _IMG},
+    "StructuralSimilarityIndexMeasure": {"inputs": _IMG},
+    "MultiScaleStructuralSimilarityIndexMeasure": {
+        "inputs": [("float32", (2, 3, 128, 128)), ("float32", (2, 3, 128, 128))],
+    },
+    "SpectralAngleMapper": {"inputs": _IMG},
+    "SpectralDistortionIndex": {"inputs": _IMG},
+    "UniversalImageQualityIndex": {"inputs": _IMG},
+    "ErrorRelativeGlobalDimensionlessSynthesis": {"inputs": _IMG},
+    "FrechetInceptionDistance": {
+        "inputs": [("uint8", (2, 3, 299, 299))],
+        "static_kwargs": {"real": True},
+        # the Welford triple merge all-gathers each moment leaf separately by
+        # design (Chan's combine needs the per-device stacks)
+        "collective_budget": 8,
+    },
+    "KernelInceptionDistance": {
+        "inputs": [("uint8", (2, 3, 299, 299))],
+        "static_kwargs": {"real": True},
+    },
+    "InceptionScore": {"inputs": [("uint8", (2, 3, 299, 299))]},
+    "LearnedPerceptualImagePatchSimilarity": {
+        "inputs": [("float32", (2, 3, 64, 64)), ("float32", (2, 3, 64, 64))],
+    },
+}

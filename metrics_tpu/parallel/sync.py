@@ -75,9 +75,11 @@ def count_collectives():
     Yields a dict whose ``"count"`` entry holds the number of collective ops
     (``psum``/``pmean``/``pmax``/``pmin``/``all_gather``) this module emitted —
     incremented at trace time, so wrap a ``jax.make_jaxpr(...)``/``jit`` trace
-    of the sync, not a cached compiled call."""
+    of the sync, not a cached compiled call. ``"by_kind"`` breaks the same
+    total down per collective primitive (e.g. ``{"psum": 2, "all_gather": 1}``)
+    — the analyzer's collective-budget rule reports it alongside overruns."""
     prev = getattr(_counter, "box", None)
-    box = {"count": 0}
+    box: Dict[str, Any] = {"count": 0, "by_kind": {}}
     _counter.box = box
     try:
         yield box
@@ -85,10 +87,11 @@ def count_collectives():
         _counter.box = prev
 
 
-def _tick_collective() -> None:
+def _tick_collective(kind: str) -> None:
     box = getattr(_counter, "box", None)
     if box is not None:
         box["count"] += 1
+        box["by_kind"][kind] = box["by_kind"].get(kind, 0) + 1
 
 
 def reduce(x: Array, reduction: str) -> Array:
@@ -172,22 +175,28 @@ def sync_array(x: Array, reduction: Optional[Union[str, Callable]], axis_name: O
     """
     if axis_name is None:
         return x
-    _tick_collective()
     if reduction == "sum":
+        _tick_collective("psum")
         return lax.psum(x, axis_name)
     if reduction == "mean":
+        _tick_collective("pmean")
         return lax.pmean(x, axis_name)
     if reduction == "max":
+        _tick_collective("pmax")
         return lax.pmax(x, axis_name)
     if reduction == "min":
+        _tick_collective("pmin")
         return lax.pmin(x, axis_name)
     if reduction == "cat":
+        _tick_collective("all_gather")
         return lax.all_gather(jnp.atleast_1d(x), axis_name, axis=0, tiled=True)
     if reduction is None:
         # keep per-device values separate (reference stacks the gathered list,
         # metric.py:364-365) — e.g. Pearson's moment merge consumes the stack
+        _tick_collective("all_gather")
         return lax.all_gather(x, axis_name, axis=0)
     if callable(reduction):
+        _tick_collective("all_gather")
         gathered = lax.all_gather(x, axis_name, axis=0)  # (world, ...)
         return reduction(gathered)
     raise ValueError(f"Unknown dist_reduce_fx {reduction!r}; expected one of {_REDUCTIONS} or a callable.")
@@ -224,7 +233,7 @@ def _sync_bucketed(entries: List[Tuple[str, Array, Optional[str]]], axis_name: A
         else:  # "cat" / None: one stacking all_gather, per-leaf unflatten
             shaped = [(name, jnp.atleast_1d(a) if red == "cat" else a) for name, a in items]
             flat = jnp.concatenate([jnp.ravel(a) for _, a in shaped])
-            _tick_collective()
+            _tick_collective("all_gather")
             gathered = lax.all_gather(flat, axis_name, axis=0)  # (world, sum of sizes)
             world = gathered.shape[0]
             offset = 0

@@ -14,3 +14,27 @@ from metrics_tpu.regression.moments import (  # noqa: F401
     SpearmanCorrCoef,
 )
 from metrics_tpu.regression.other import CosineSimilarity, TweedieDevianceScore  # noqa: F401
+
+
+# --------------------------------------------------------------------------- #
+# analyzer registry (metrics_tpu.analysis); see docs/static_analysis.md
+# --------------------------------------------------------------------------- #
+_VEC = [("float32", (16,)), ("float32", (16,))]
+
+ANALYSIS_SPECS = {
+    "MeanAbsoluteError": {"inputs": _VEC},
+    "MeanAbsolutePercentageError": {"inputs": _VEC},
+    "MeanSquaredError": {"inputs": _VEC},
+    "MeanSquaredLogError": {"inputs": _VEC},
+    "SymmetricMeanAbsolutePercentageError": {"inputs": _VEC},
+    "WeightedMeanAbsolutePercentageError": {"inputs": _VEC},
+    "ExplainedVariance": {"inputs": _VEC},
+    "PearsonCorrCoef": {"inputs": _VEC},
+    "R2Score": {"inputs": _VEC},
+    "TweedieDevianceScore": {"inputs": _VEC},
+    "CosineSimilarity": {
+        "init": {"buffer_capacity": 32},
+        "inputs": [("float32", (4, 8)), ("float32", (4, 8))],
+    },
+    "SpearmanCorrCoef": {"init": {"buffer_capacity": 32}, "inputs": _VEC},
+}

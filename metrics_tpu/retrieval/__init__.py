@@ -14,3 +14,29 @@ from metrics_tpu.retrieval.precision_recall_curve import (  # noqa: F401
     RetrievalPrecisionRecallCurve,
     RetrievalRecallAtFixedPrecision,
 )
+
+
+# --------------------------------------------------------------------------- #
+# analyzer registry (metrics_tpu.analysis): the compiled retrieval path needs
+# static query/document bounds plus CatBuffer state; see docs/static_analysis.md
+# --------------------------------------------------------------------------- #
+_RETRIEVAL_SPEC = {
+    "init": {"max_queries": 8, "max_docs_per_query": 4, "buffer_capacity": 64},
+    "inputs": [("float32", (16,)), ("int32", (16,)), ("int32", (16,))],
+}
+
+ANALYSIS_SPECS = {
+    name: dict(_RETRIEVAL_SPEC)
+    for name in (
+        "RetrievalFallOut",
+        "RetrievalHitRate",
+        "RetrievalMAP",
+        "RetrievalMRR",
+        "RetrievalNormalizedDCG",
+        "RetrievalPrecision",
+        "RetrievalPrecisionRecallCurve",
+        "RetrievalRecall",
+        "RetrievalRecallAtFixedPrecision",
+        "RetrievalRPrecision",
+    )
+}

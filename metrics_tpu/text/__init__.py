@@ -13,3 +13,36 @@ from metrics_tpu.text.error_rates import (  # noqa: F401
 from metrics_tpu.text.rouge import ROUGEScore  # noqa: F401
 from metrics_tpu.text.squad import SQuAD  # noqa: F401
 from metrics_tpu.text.ter import TranslationEditRate  # noqa: F401
+
+
+# --------------------------------------------------------------------------- #
+# analyzer registry (metrics_tpu.analysis): text metrics take Python strings,
+# so update/compute are host-side by design — the abstract-eval sweep is
+# skipped and input-taint AST rules are relaxed; see docs/static_analysis.md
+# --------------------------------------------------------------------------- #
+_HOST_TEXT = {
+    "skip_eval": "string inputs are host-side by design",
+    "host_inputs": True,
+}
+
+ANALYSIS_SPECS = {
+    name: dict(_HOST_TEXT)
+    for name in (
+        "BLEUScore",
+        "CharErrorRate",
+        "CHRFScore",
+        "ExtendedEditDistance",
+        "MatchErrorRate",
+        "ROUGEScore",
+        "SacreBLEUScore",
+        "SQuAD",
+        "TranslationEditRate",
+        "WordErrorRate",
+        "WordInfoLost",
+        "WordInfoPreserved",
+    )
+}
+ANALYSIS_SPECS["BERTScore"] = {
+    **_HOST_TEXT,
+    "no_probe": "constructor loads a pretrained LM from the network",
+}

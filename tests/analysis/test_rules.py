@@ -1,0 +1,256 @@
+"""Fixture metrics seeded with one hazard class each, asserting the analyzer
+flags each with exactly the expected rule — AST stage (stage 1) and
+abstract-eval stage (stage 2) over the mock 8-device mesh.
+
+The fixtures live at module top level so ``inspect.getsourcefile`` resolves
+this file and the AST stage lints real source, suppression comments included.
+"""
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu.analysis import ast_stage, eval_stage
+from metrics_tpu.analysis.registry import Entry
+from metrics_tpu.analysis.rules import ERROR, RULES, parse_suppressions
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.parallel import sync as _sync
+
+
+# --------------------------------------------------------------------------- #
+# stage-1 fixtures (linted, never instantiated)
+# --------------------------------------------------------------------------- #
+class HostRoundTripMetric(Metric):
+    """A001: float() on a traced value is a device->host sync."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.total = self.total + float(values.sum())
+
+    def compute(self):
+        return self.total
+
+
+class BranchyMetric(Metric):
+    """A002: Python `if` on an input-derived value."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, values):
+        if values.sum() > 0:
+            self.total = self.total + values.sum()
+
+    def compute(self):
+        return self.total
+
+
+class HiddenWriteMetric(Metric):
+    """A003: writes an attribute that is neither add_state'd nor __init__'d."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.scratch = values.sum()
+        self.total = self.total + self.scratch
+
+    def compute(self):
+        return self.total
+
+
+class ScalarStateMetric(Metric):
+    """A004: bare Python scalar as an add_state default (the constructor
+    would reject it at runtime; the lint catches it without constructing)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("count", default=0.0, dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.count = self.count + values.sum()
+
+    def compute(self):
+        return self.count
+
+
+class SuppressedHostMetric(Metric):
+    """Same A001 hazard as HostRoundTripMetric, silenced inline."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.total = self.total + float(values.sum())  # metrics-tpu: allow[A001]
+
+    def compute(self):
+        return self.total
+
+
+class CleanMetric(Metric):
+    """Control: no hazards, no findings."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.total = self.total + jnp.sum(values)
+
+    def compute(self):
+        return self.total
+
+
+# --------------------------------------------------------------------------- #
+# stage-2 fixtures (instantiated and traced under the mock mesh)
+# --------------------------------------------------------------------------- #
+class DriftySyncMetric(CleanMetric):
+    """E105: sync_states grows the state treedef."""
+
+    def sync_states(self, state, axis_name):
+        synced = super().sync_states(state, axis_name)
+        synced = dict(synced)
+        synced["extra"] = jnp.zeros(())
+        return synced
+
+
+class ChattySyncMetric(Metric):
+    """E106: per-leaf collectives where the canonical bucketed sync coalesces
+    four same-dtype sum states into one psum."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        for name in ("a", "b", "c", "d"):
+            self.add_state(name, default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.a = self.a + jnp.sum(values)
+        self.b = self.b + jnp.sum(values)
+        self.c = self.c + jnp.sum(values)
+        self.d = self.d + jnp.sum(values)
+
+    def compute(self):
+        return self.a + self.b + self.c + self.d
+
+    def sync_states(self, state, axis_name):
+        return {k: _sync.sync_array(v, "sum", axis_name) for k, v in state.items()}
+
+
+class TreedefDriftUpdateMetric(CleanMetric):
+    """E102: the state treedef oscillates step to step (a one-time warmup
+    materialization is tolerated; this alternates forever)."""
+
+    def update_state(self, state, *args, **kwargs):
+        state = dict(state)
+        stray = state.pop("stray", None)
+        out = dict(super().update_state(state, *args, **kwargs))
+        if stray is None:
+            out["stray"] = jnp.zeros(())
+        return out
+
+
+_SPEC = {"init": {}, "inputs": [("float32", (8,))]}
+
+
+def _lint(cls):
+    return ast_stage.lint_class(cls)
+
+
+def _active_rules(findings):
+    return sorted({f.rule for f in findings if not f.suppressed})
+
+
+def _evaluate(cls, spec=_SPEC):
+    return eval_stage.evaluate_entry(Entry(cls=cls, spec=dict(spec)))
+
+
+# --------------------------------------------------------------------------- #
+# stage 1
+# --------------------------------------------------------------------------- #
+class TestASTStage:
+    @pytest.mark.parametrize(
+        "cls, expected",
+        [
+            (HostRoundTripMetric, "A001"),
+            (BranchyMetric, "A002"),
+            (HiddenWriteMetric, "A003"),
+            (ScalarStateMetric, "A004"),
+        ],
+        ids=lambda x: getattr(x, "__name__", x),
+    )
+    def test_each_hazard_flagged_by_exactly_its_rule(self, cls, expected):
+        findings = _lint(cls)
+        assert _active_rules(findings) == [expected]
+        f = next(f for f in findings if f.rule == expected)
+        assert f.obj.startswith(cls.__name__)
+        assert f.file and f.file.endswith("test_rules.py") and f.line
+
+    def test_clean_metric_has_no_findings(self):
+        assert _lint(CleanMetric) == []
+
+    def test_inline_suppression_keeps_finding_but_marks_it(self):
+        findings = _lint(SuppressedHostMetric)
+        assert [f.rule for f in findings] == ["A001"]
+        assert findings[0].suppressed
+        assert _active_rules(findings) == []
+
+    def test_parse_suppressions(self):
+        src = "x = 1\ny = foo()  # metrics-tpu: allow[A001, E106]\n"
+        assert parse_suppressions(src) == {2: ("A001", "E106")}
+
+    def test_every_finding_rule_is_in_catalog(self):
+        for cls in (HostRoundTripMetric, BranchyMetric, HiddenWriteMetric, ScalarStateMetric):
+            for f in _lint(cls):
+                assert f.rule in RULES
+
+
+# --------------------------------------------------------------------------- #
+# stage 2 — mock 8-device mesh (axis_env trace, no real devices needed)
+# --------------------------------------------------------------------------- #
+class TestEvalStage:
+    def test_clean_metric_passes(self):
+        findings = _evaluate(CleanMetric)
+        assert [f for f in findings if not f.suppressed] == []
+
+    def test_sync_treedef_drift_is_E105(self):
+        findings = _evaluate(DriftySyncMetric)
+        errors = sorted({f.rule for f in findings if f.severity == ERROR and not f.suppressed})
+        assert errors == ["E105"]
+
+    def test_collective_budget_overrun_is_E106(self):
+        findings = _evaluate(ChattySyncMetric)
+        errors = [f for f in findings if f.severity == ERROR and not f.suppressed]
+        assert [f.rule for f in errors] == ["E106"]
+        extra = errors[0].extra
+        assert extra["collectives"] == 4  # one psum per leaf
+        assert extra["budget"] < 4  # canonical bucketed sync coalesces them
+        assert extra["by_kind"] == {"psum": 4}
+
+    def test_budget_override_silences_E106(self):
+        spec = dict(_SPEC, collective_budget=4)
+        findings = _evaluate(ChattySyncMetric, spec)
+        assert "E106" not in {f.rule for f in findings if not f.suppressed}
+
+    def test_update_treedef_drift_is_E102(self):
+        findings = _evaluate(TreedefDriftUpdateMetric)
+        assert "E102" in {f.rule for f in findings if not f.suppressed}
+
+    def test_spec_level_allow_suppresses(self):
+        spec = dict(_SPEC, allow=("E105",))
+        findings = _evaluate(DriftySyncMetric, spec)
+        e105 = [f for f in findings if f.rule == "E105"]
+        assert e105 and all(f.suppressed for f in e105)
+
+    def test_missing_spec_is_E002(self):
+        findings = eval_stage.evaluate_entry(Entry(cls=CleanMetric, spec=None))
+        assert [f.rule for f in findings] == ["E002"]
+
+    def test_uninstantiable_is_E003(self):
+        findings = eval_stage.evaluate_entry(
+            Entry(cls=CleanMetric, spec={"init": {"no_such_kwarg": 1}, "inputs": _SPEC["inputs"]})
+        )
+        assert [f.rule for f in findings] == ["E003"]

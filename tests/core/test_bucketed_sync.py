@@ -262,3 +262,35 @@ def test_callable_reduction_stays_per_leaf(mesh):
     np.testing.assert_allclose(
         np.asarray(out["b"])[0], np.asarray([3.0, 4.0]) * scale, rtol=1e-6
     )
+
+
+# ------------------------------------------------------------ by-kind tally --
+def test_count_collectives_tallies_by_kind():
+    """The counter box breaks the tally down per collective kind — the
+    analyzer's E106 diagnostics depend on this split."""
+    state = {
+        "s": jnp.zeros((3,)),
+        "m": jnp.zeros((3,)),
+        "hi": jnp.zeros(()),
+        "lo": jnp.zeros(()),
+        "g": jnp.zeros((2,)),
+    }
+    reds = {"s": "sum", "m": "mean", "hi": "max", "lo": "min", "g": None}
+    with count_collectives() as box:
+        jax.make_jaxpr(
+            lambda st: sync_state(st, reds, "data", bucketed=False),
+            axis_env=[("data", WORLD)],
+        )(state)
+    assert box["by_kind"] == {"psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "all_gather": 1}
+    assert box["count"] == 5
+
+
+def test_bucketed_coalesces_by_kind():
+    state = {k: jnp.zeros((4,)) for k in ("a", "b", "c")}
+    reds = {k: "sum" for k in state}
+    with count_collectives() as box:
+        jax.make_jaxpr(
+            lambda st: sync_state(st, reds, "data", bucketed=True),
+            axis_env=[("data", WORLD)],
+        )(state)
+    assert box["by_kind"] == {"psum": 1}  # one bucket, one collective

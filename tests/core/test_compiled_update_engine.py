@@ -460,11 +460,73 @@ class TestSignatureFastPath:
         assert len(m._update_engine._seen) == 2  # one entry per signature
         np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
 
-    def test_scalar_leaves_disable_memo_but_stay_correct(self):
+    def test_scalar_leaves_intern_into_the_memo(self):
+        """Python scalars are not weakrefable, but _SigCache interns them by
+        (type, value): a fresh 2.5 every call still hits the fast path."""
         m = MeanMetric()
         ref = MeanMetric(compiled_update=False)
         for _ in range(5):
-            m.update(2.5)  # python scalar: not weakrefable, memo stays off
+            m.update(2.5)
             ref.update(2.5)
         assert m._update_engine.stats.compiled_calls >= 1
+        assert m._update_engine.stats.key_fast_hits > 0
         np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(ref.compute()))
+
+    def test_scalar_interning_distinguishes_type_and_value(self):
+        """Interned keys are (type, value): 2.5 vs 3.5 and 1 vs 1.0 must not
+        collide, and correctness holds across interleavings."""
+        m = MeanMetric()
+        ref = MeanMetric(compiled_update=False)
+        for _ in range(3):
+            for v in (2.5, 3.5, 1, True):
+                m.update(v)
+                ref.update(v)
+        np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(ref.compute()))
+
+
+# ------------------------------------------------------------- observability --
+class TestEngineStatsObservability:
+    def test_healthy_metric_reports_counters_and_no_reasons(self):
+        m = StatScores(reduce="macro", num_classes=5)
+        args = _data()
+        for _ in range(3):
+            m.update(*args)
+        stats = m.engine_stats()
+        assert stats["update"].compiled_calls >= 1
+        assert stats["fallback_reasons"] == {}
+
+    def test_fallback_reason_surfaces_in_engine_stats(self):
+        class HostUpdate(Metric):
+            full_state_update = False
+
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+            def update(self, x):
+                if float(jnp.sum(x)) > -1e30:  # host readback: untraceable
+                    self.total = self.total + jnp.sum(x)
+
+            def compute(self):
+                return self.total
+
+        m = HostUpdate()
+        x = jnp.asarray([1.0, 2.0])
+        m.update(x)
+        with pytest.warns(UserWarning, match="compiled-update engine disabled"):
+            m.update(x)
+        reasons = m.engine_stats()["fallback_reasons"]
+        assert "update:HostUpdate" in reasons
+        assert "ConcretizationTypeError" in reasons["update:HostUpdate"] or reasons[
+            "update:HostUpdate"
+        ]
+
+    def test_collection_engine_stats_include_members(self):
+        coll = MetricCollection({"p": Precision(num_classes=5), "r": Recall(num_classes=5)})
+        args = _data()
+        for _ in range(3):
+            coll.update(*args)
+        stats = coll.engine_stats()
+        assert stats["update"].compiled_calls >= 1
+        assert set(stats["members"]) == {"p", "r"}
+        assert stats["fallback_reasons"] == {}

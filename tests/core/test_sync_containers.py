@@ -1,0 +1,117 @@
+"""Regression: every state container type must come back from sync with the
+same pytree structure it went in with (the PR-3 tuple->list drift class).
+
+All traces run under the mock 8-device mesh (``make_jaxpr`` with an
+``axis_env``), so treedef stability is checked exactly where it matters — at
+trace time, where a drift would recompile every finalize and corrupt
+``set_state`` round-trips.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import CatMetric, MeanMetric
+from metrics_tpu.core.buffers import CatBuffer
+from metrics_tpu.parallel import sync as _sync
+
+AXIS = "data"
+WORLD = 8
+
+
+def _sync_shape(state, reductions, bucketed):
+    _, shape = jax.make_jaxpr(
+        lambda s: _sync.sync_state(s, reductions, AXIS, bucketed=bucketed),
+        axis_env=[(AXIS, WORLD)],
+        return_shape=True,
+    )(state)
+    return shape
+
+
+CONTAINER_STATES = {
+    "array-sum": ({"v": jnp.zeros((4,))}, {"v": "sum"}),
+    "array-mean": ({"v": jnp.zeros((4,))}, {"v": "mean"}),
+    "array-max": ({"v": jnp.zeros(())}, {"v": "max"}),
+    "array-min": ({"v": jnp.zeros(())}, {"v": "min"}),
+    "array-gather": ({"v": jnp.zeros((4,))}, {"v": None}),
+    "nonempty-list-cat": ({"v": [jnp.zeros((2,)), jnp.zeros((3,))]}, {"v": "cat"}),
+    "nonempty-tuple-cat": ({"v": (jnp.zeros((2,)), jnp.zeros((3,)))}, {"v": "cat"}),
+    "empty-list": ({"v": []}, {"v": "cat"}),
+    "empty-tuple": ({"v": ()}, {"v": "cat"}),
+    "catbuffer": ({"v": CatBuffer.from_array(jnp.arange(4.0), capacity=8)}, {"v": "cat"}),
+    "catbuffer-unmaterialized": ({"v": CatBuffer.empty(capacity=8)}, {"v": "cat"}),
+    "mixed": (
+        {
+            "total": jnp.zeros(()),
+            "count": jnp.zeros((), jnp.int32),
+            "buf": (jnp.zeros((2,)),),
+            "cat": CatBuffer.from_array(jnp.arange(3.0), capacity=8),
+        },
+        {"total": "sum", "count": "sum", "buf": "cat", "cat": "cat"},
+    ),
+}
+
+
+def _expected_structure(state):
+    """Sync's container contract: container types are preserved; non-empty
+    list/tuple states collapse to one locally-concatenated element; everything
+    else keeps its structure leaf-for-leaf."""
+    out = {}
+    for key, val in state.items():
+        if isinstance(val, (list, tuple)) and len(val) > 1:
+            out[key] = type(val)((val[0],))
+        else:
+            out[key] = val
+    return jax.tree_util.tree_structure(out)
+
+
+@pytest.mark.parametrize("bucketed", [True, False], ids=["bucketed", "per-leaf"])
+@pytest.mark.parametrize("name", sorted(CONTAINER_STATES))
+def test_sync_preserves_treedef_and_container_types(name, bucketed):
+    state, reductions = CONTAINER_STATES[name]
+    out = _sync_shape(state, reductions, bucketed)
+    assert jax.tree_util.tree_structure(out) == _expected_structure(state)
+    for key, val in state.items():
+        if isinstance(val, (list, tuple, CatBuffer)):
+            # the PR-3 drift class: a tuple state must come back a tuple
+            assert type(out[key]) is type(val)
+
+
+def test_no_axis_sync_is_identity_structure():
+    state, reductions = CONTAINER_STATES["mixed"]
+    out = _sync.sync_state(state, reductions, None)
+    assert jax.tree_util.tree_structure(out) == jax.tree_util.tree_structure(state)
+
+
+@pytest.mark.parametrize(
+    "make",
+    [
+        lambda: MeanMetric(),
+        lambda: CatMetric(buffer_capacity=8),
+    ],
+    ids=["MeanMetric", "CatMetric-buffered"],
+)
+def test_metric_sync_states_treedef_stable(make):
+    m = make()
+    m.update(jnp.arange(4.0))
+    state = m.get_state()
+    _, shape = jax.make_jaxpr(
+        lambda s: m.sync_states(s, AXIS), axis_env=[(AXIS, WORLD)], return_shape=True
+    )(state)
+    assert jax.tree_util.tree_structure(shape) == jax.tree_util.tree_structure(state)
+    # and the state survives a set_state round-trip with the synced shape
+    m.set_state(jax.tree_util.tree_map(lambda l: jnp.zeros(l.shape, l.dtype), shape))
+
+
+def test_bucketed_matches_per_leaf_bitwise():
+    state = {"a": jnp.arange(3.0), "b": jnp.arange(5.0), "n": jnp.asarray(2.0)}
+    reductions = {"a": "sum", "b": "sum", "n": "sum"}
+
+    def run(bucketed):
+        return jax.pmap(
+            lambda s: _sync.sync_state(s, reductions, AXIS, bucketed=bucketed),
+            axis_name=AXIS,
+        )(jax.tree_util.tree_map(lambda l: jnp.stack([l] * WORLD), state))
+
+    a, b = run(True), run(False)
+    for key in state:
+        assert jnp.array_equal(a[key], b[key])
