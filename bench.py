@@ -1326,6 +1326,115 @@ def bench_analysis() -> None:
     print(json.dumps(record), flush=True)
 
 
+def bench_checkpoint() -> None:
+    """``--checkpoint``: snapshot/restore wall time for the config2 collection
+    (Accuracy/F1/Precision/Recall at NUM_CLASSES) plus an 8-shard offline
+    merge, recorded into ``BENCH_r10.json`` (one JSON line on stdout, same
+    shape). Host-side I/O bench: runs on CPU regardless of accelerator."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, Recall
+    from metrics_tpu.checkpoint import merge_shards, restore_checkpoint, save_checkpoint
+
+    def build():
+        return MetricCollection(
+            {
+                "acc": Accuracy(num_classes=NUM_CLASSES, average="micro"),
+                "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+                "precision": Precision(num_classes=NUM_CLASSES, average="macro"),
+                "recall": Recall(num_classes=NUM_CLASSES, average="macro"),
+            }
+        )
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(BATCH, NUM_CLASSES)), dtype=jnp.float32)
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=jnp.int32)
+
+    coll = build()
+    for _ in range(4):
+        coll.update(logits, target)
+    jax.block_until_ready({k: m.get_state() for k, m in coll.items()})
+
+    reps = 5
+    tmp = tempfile.mkdtemp(prefix="mtpu-ckpt-bench-")
+    try:
+        # blocking save: device->host copy + shard write + commit + rename
+        save_ms = []
+        for r in range(reps):
+            t0 = time.perf_counter()
+            save_checkpoint(coll, os.path.join(tmp, f"save{r}"))
+            save_ms.append((time.perf_counter() - t0) * 1e3)
+
+        # async save: time until update() may safely continue (host copy +
+        # thread handoff), and separately until the commit landed
+        async_resume_ms, async_total_ms = [], []
+        for r in range(reps):
+            t0 = time.perf_counter()
+            handle = save_checkpoint(coll, os.path.join(tmp, f"async{r}"), blocking=False)
+            async_resume_ms.append((time.perf_counter() - t0) * 1e3)
+            handle.wait()
+            async_total_ms.append((time.perf_counter() - t0) * 1e3)
+
+        restore_ms = []
+        for r in range(reps):
+            fresh = build()
+            t0 = time.perf_counter()
+            restore_checkpoint(fresh, os.path.join(tmp, "save0"), host_index=0, host_count=1)
+            restore_ms.append((time.perf_counter() - t0) * 1e3)
+
+        # 8-shard world written per host, folded to 1 host on restore and
+        # offline via the CLI-level merge
+        world = 8
+        sharded_root = os.path.join(tmp, "world8")
+        for i in range(world):
+            m = build()
+            m.update(logits, target)
+            save_checkpoint(m, sharded_root, step=0, shard_index=i, world_size=world)
+        t0 = time.perf_counter()
+        restore_checkpoint(build(), sharded_root, host_index=0, host_count=1)
+        reshard_restore_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        merge_shards(sharded_root, os.path.join(tmp, "merged"))
+        merge_ms = (time.perf_counter() - t0) * 1e3
+
+        ckpt_bytes = sum(
+            os.path.getsize(os.path.join(root, f))
+            for root, _, files in os.walk(os.path.join(tmp, "save0"))
+            for f in files
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    med = lambda xs: round(float(np.median(xs)), 3)
+    record = {
+        "metric": "checkpoint_save_ms",
+        "value": med(save_ms),
+        "unit": "ms",
+        "extra": {
+            "config": "config2_collection",
+            "num_classes": NUM_CLASSES,
+            "reps": reps,
+            "snapshot_bytes": ckpt_bytes,
+            "save_blocking_ms": med(save_ms),
+            "save_async_resume_ms": med(async_resume_ms),
+            "save_async_total_ms": med(async_total_ms),
+            "restore_ms": med(restore_ms),
+            "reshard_restore_8to1_ms": round(reshard_restore_ms, 3),
+            "merge_8shard_ms": round(merge_ms, 3),
+        },
+    }
+    with open(os.path.join(REPO, "BENCH_r10.json"), "w") as fh:
+        json.dump(record, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(record), flush=True)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
@@ -1333,6 +1442,12 @@ def main() -> None:
         action="store_true",
         help="run the metrics_tpu.analysis static analyzer and record wall "
         "time + per-rule hit counts into BENCH_r09.json",
+    )
+    parser.add_argument(
+        "--checkpoint",
+        action="store_true",
+        help="time config2 snapshot save/restore plus an 8-shard merge and "
+        "record into BENCH_r10.json",
     )
     parser.add_argument("--child", choices=["sync_overhead", *_CHILD_BENCHES])
     parser.add_argument(
@@ -1351,6 +1466,9 @@ def main() -> None:
     args = parser.parse_args()
     if args.analysis:
         bench_analysis()
+        return
+    if args.checkpoint:
+        bench_checkpoint()
         return
     if args.sync_scaling:
         out = {}

@@ -59,6 +59,12 @@ from metrics_tpu.core import (  # noqa: F401
     set_compiled_update,
     set_fused_update,
 )
+from metrics_tpu import checkpoint  # noqa: F401
+from metrics_tpu.checkpoint import (  # noqa: F401
+    restore_checkpoint,
+    save_checkpoint,
+    verify_checkpoint,
+)
 from metrics_tpu.detection import MeanAveragePrecision  # noqa: F401
 from metrics_tpu.image import (  # noqa: F401
     ErrorRelativeGlobalDimensionlessSynthesis,
@@ -132,6 +138,8 @@ __all__ = [
     "set_compiled_compute", "compiled_compute_enabled",
     "set_fused_update", "fused_update_enabled",
     "set_bucketed_sync", "bucketed_sync_enabled",
+    # checkpoint
+    "checkpoint", "save_checkpoint", "restore_checkpoint", "verify_checkpoint",
     # aggregation
     "CatMetric", "MaxMetric", "MeanMetric", "MinMetric", "SumMetric",
     # audio

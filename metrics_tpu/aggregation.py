@@ -219,6 +219,8 @@ class MeanMetric(BaseAggregator):
 # analyzer registry (metrics_tpu.analysis): how each export is constructed and
 # fed for the abstract-eval sweep; see docs/static_analysis.md
 # --------------------------------------------------------------------------- #
+# (the checkpoint roundtrip sweep synthesizes valid inputs from these specs
+# directly: every aggregation metric accepts arbitrary floats)
 ANALYSIS_SPECS = {
     "CatMetric": {"init": {"buffer_capacity": 32}, "inputs": [("float32", (8,))]},
     "MaxMetric": {"inputs": [("float32", (8,))]},

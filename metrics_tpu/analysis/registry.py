@@ -29,6 +29,24 @@ Optional keys: ``"kwargs"`` (update kwargs, same ``(dtype, shape)`` form),
 per-metric cap overriding the canonical-sync budget). An exported metric class
 with no spec is itself a finding (``E002``) — that is the merge gate: new
 metrics must declare how they are analyzed.
+
+The ``"ckpt"`` key parameterizes the checkpoint/state-dict roundtrip sweep
+(``tests/core/test_checkpoint_sweep.py``), which — unlike the abstract-eval
+stage — runs *concrete* updates and therefore needs valid values, not just
+shapes::
+
+    "ckpt": {
+        "int_high": 4,           # exclusive bound for synthesized int inputs
+                                 # (default 2: binary labels)
+        "inputs_fn": lambda: ((arg0, arg1), {}),  # concrete update (args,
+                                 # kwargs) when synthesis can't produce valid
+                                 # inputs (strings, box dicts, sorted x, ...)
+        "init_fn": lambda: ...,  # sweep-specific constructor override
+        "skip": "reason",        # exclude from the sweep, with the why
+    }
+
+Absent ``"ckpt"``, the sweep synthesizes from ``"inputs"``: floats uniform in
+[0, 1), ints uniform in [0, int_high).
 """
 from __future__ import annotations
 
@@ -73,6 +91,10 @@ class Entry:
     @property
     def skip_eval(self) -> Optional[str]:
         return (self.spec or {}).get("skip_eval")
+
+    @property
+    def ckpt(self) -> Dict[str, Any]:
+        return (self.spec or {}).get("ckpt", {})
 
 
 def collect_specs() -> Dict[str, Dict[str, Any]]:

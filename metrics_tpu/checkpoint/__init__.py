@@ -1,0 +1,178 @@
+"""metrics_tpu.checkpoint — preemption-safe distributed snapshot/restore.
+
+Public surface::
+
+    handle = save_checkpoint(metric_or_collection, root)   # blocking by default
+    handle = save_checkpoint(obj, root, blocking=False)    # async file I/O
+    handle.wait()                                          # join + raise errors
+
+    info = restore_checkpoint(obj, root)                   # latest step
+    info = restore_checkpoint(obj, root, step=12, host_count=1)  # reshard N->1
+
+    report = verify_checkpoint(root)                       # checksum everything
+    merge_shards(root, out_root)                           # offline N->1 fold
+
+Saves are per-host shards (each host persists only its local state), writes
+are atomic two-phase (see :mod:`metrics_tpu.checkpoint.io`), and restore
+verifies the fingerprint/manifest/checksums *before* touching live state and
+supports world-size change by folding shards with their recorded reductions
+(:mod:`metrics_tpu.checkpoint.restore`).
+
+``python -m metrics_tpu.checkpoint {inspect,verify,merge,clean}`` operates on
+snapshot directories without importing any metric class.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from metrics_tpu.checkpoint.format import (
+    FORMAT_VERSION,
+    build_shard,
+    fingerprint_diff,
+    object_fingerprint,
+)
+from metrics_tpu.checkpoint.io import (
+    CheckpointCorruptError,
+    CheckpointError,
+    CheckpointMismatchError,
+    CheckpointNotFoundError,
+    available_steps,
+    clean_pending,
+    latest_step,
+    next_step,
+    pending_dir,
+    try_commit,
+    write_shard,
+)
+from metrics_tpu.checkpoint.restore import (
+    RestoreInfo,
+    VerifyReport,
+    assign_shards,
+    merge_shards,
+    restore_checkpoint,
+    verify_all,
+    verify_checkpoint,
+)
+
+__all__ = [
+    "FORMAT_VERSION",
+    "SaveHandle",
+    "RestoreInfo",
+    "VerifyReport",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "verify_checkpoint",
+    "verify_all",
+    "assign_shards",
+    "merge_shards",
+    "available_steps",
+    "latest_step",
+    "clean_pending",
+    "object_fingerprint",
+    "fingerprint_diff",
+    "CheckpointError",
+    "CheckpointNotFoundError",
+    "CheckpointCorruptError",
+    "CheckpointMismatchError",
+]
+
+
+@dataclass
+class SaveHandle:
+    """Result of :func:`save_checkpoint`.
+
+    For async saves the device->host copy has already happened by the time the
+    handle is returned — only file I/O and the commit attempt run on the
+    background thread. ``wait()`` joins and re-raises any I/O failure;
+    ``committed`` reports whether this host observed the snapshot reach its
+    committed state (on multi-host saves the *last* finishing host commits, so
+    early hosts legitimately see ``False``).
+    """
+
+    root: str
+    step: int
+    shard_index: int
+    world_size: int
+    committed: bool = False
+    _thread: Optional[threading.Thread] = None
+    _error: Optional[BaseException] = None
+
+    def wait(self) -> "SaveHandle":
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+        return self
+
+    @property
+    def done(self) -> bool:
+        return self._thread is None or not self._thread.is_alive()
+
+
+def _host_copy(payload: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    # force the device->host transfer now, so async saves never race live
+    # (possibly donation-aliased) device buffers
+    return {k: np.asarray(v) for k, v in payload.items()}
+
+
+def save_checkpoint(
+    obj: Any,
+    root: str,
+    step: Optional[int] = None,
+    *,
+    shard_index: Optional[int] = None,
+    world_size: Optional[int] = None,
+    blocking: bool = True,
+) -> SaveHandle:
+    """Snapshot this host's shard of a Metric / MetricCollection.
+
+    ``shard_index``/``world_size`` default to ``jax.process_index()`` /
+    ``jax.process_count()``. With ``blocking=False`` the state is copied to
+    host immediately (cheap, and safe against later donation) and the file
+    write + commit attempt run on a daemon thread — call ``handle.wait()``
+    before relying on the snapshot. The snapshot becomes visible to readers
+    only once every host's shard landed and one of them committed.
+    """
+    import jax
+
+    if world_size is None:
+        try:
+            world_size = jax.process_count()
+        except Exception:
+            world_size = 1
+    if shard_index is None:
+        try:
+            shard_index = jax.process_index()
+        except Exception:
+            shard_index = 0
+    if step is None:
+        step = next_step(root)
+
+    payload, shard_meta = build_shard(obj)
+    payload = _host_copy(payload)
+    handle = SaveHandle(root=root, step=int(step), shard_index=shard_index, world_size=world_size)
+
+    def _write() -> None:
+        try:
+            write_shard(pending_dir(root, handle.step), shard_index, world_size, payload, shard_meta)
+            handle.committed = try_commit(root, handle.step, world_size)
+        except BaseException as err:  # surfaced by wait()
+            handle._error = err
+
+    if blocking:
+        _write()
+        if handle._error is not None:
+            err, handle._error = handle._error, None
+            raise err
+    else:
+        handle._thread = threading.Thread(
+            target=_write, name=f"metrics-tpu-ckpt-save-{handle.step}", daemon=True
+        )
+        handle._thread.start()
+    return handle

@@ -1,0 +1,263 @@
+"""The snapshot format: versioned, pytree-native metric state on disk.
+
+A snapshot is a directory holding one payload shard per saving host plus a
+single aggregated ``MANIFEST.json`` and a ``COMMIT`` marker (see
+:mod:`metrics_tpu.checkpoint.io` for the atomic write protocol). This module
+owns the *content*: how live :class:`~metrics_tpu.Metric` /
+:class:`~metrics_tpu.MetricCollection` state becomes host-side numpy payload
+plus JSON metadata, and the config fingerprint that gates restore.
+
+Design points:
+
+- **Dense leaves** are saved verbatim (dtype/shape recorded per leaf).
+- **``CatBuffer`` states** are saved as their *compact valid prefix*
+  (``data[:count]``) plus the fill count and configured capacity — shards from
+  hosts with different fill levels stay small, and restore re-materializes the
+  buffer at the live metric's capacity (growing it when the folded prefix is
+  larger). An overflowed buffer refuses to snapshot — the tail is corrupt and
+  ``CatBuffer.to_array`` raises its actionable error instead of persisting
+  silently truncated data.
+- **Unbounded list states** are saved element-wise (``name.0``, ``name.1``, …)
+  with the length recorded, so list and buffer checkpoints interconvert.
+- **Reduction tags ride along per leaf.** They are what makes
+  *reshard-on-restore* possible: a shard set written by N hosts can be folded
+  onto M hosts by merging leaves with their recorded reductions (``sum``
+  add, ``max``/``min`` elementwise, ``cat``/``CatBuffer`` concatenate,
+  ``mean`` recomputed from the recorded update counts).
+- **The fingerprint** (class, per-state kind/reduction/shape/dtype, update
+  signature, buffer capacity, engine-relevant config) is compared against the
+  live object *before any state is touched*; a mismatch produces a refusal
+  with a line-by-line diff, never a half-restored metric.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from metrics_tpu.core.buffers import CatBuffer
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+FORMAT_VERSION = 1
+
+# the member key a bare Metric is stored under (collections use their own keys)
+SELF_KEY = "__self__"
+
+# reduction tags whose shards can be folded at restore time; a callable tag or
+# a ``none`` tag on a dense leaf keeps per-shard values and cannot merge
+MERGEABLE_TAGS = ("sum", "mean", "max", "min", "cat", "none")
+
+
+def reduction_tag(red: Any) -> str:
+    """Stable string form of a ``dist_reduce_fx`` for the manifest."""
+    if red is None:
+        return "none"
+    if isinstance(red, str):
+        return red
+    return f"callable:{getattr(red, '__qualname__', None) or getattr(red, '__name__', repr(red))}"
+
+
+def tag_mergeable(tag: str, kind: str) -> bool:
+    """Whether shards of a leaf with this (tag, kind) can be folded.
+
+    Callable reductions have unknowable merge semantics offline; a ``none``
+    tag on a dense array means "keep per-device values" — folding it would
+    change the leaf's shape (the stacking merge), so cross-world restore
+    refuses it. ``none`` on list/CatBuffer leaves concatenates fine.
+    """
+    if tag.startswith("callable:"):
+        return False
+    if tag == "none" and kind == "array":
+        return False
+    return tag in MERGEABLE_TAGS or kind in ("list", "catbuffer")
+
+
+# --------------------------------------------------------------------------- #
+# live object -> payload + metadata
+# --------------------------------------------------------------------------- #
+def describe(obj: Any) -> Tuple[str, Dict[str, Metric]]:
+    """``("metric"|"collection", ordered {member_key: Metric})`` for ``obj``.
+
+    Snapshotting a collection during a fused update streak first *realizes*
+    the detached member states (:meth:`MetricCollection._realias_members`) —
+    the checkpoint never sees (or persists) poisoned detached attrs.
+
+    Child metrics held as attributes (wrapper internals: BootStrapper copies,
+    MinMaxMetric's base, CompositionalMetric operands) become members of
+    their own under ``<parent key>#child<i>`` — their state lives outside
+    the parent's ``_defaults`` and would otherwise be lost.
+    """
+    if isinstance(obj, MetricCollection):
+        obj._realias_members()
+        return "collection", _expand_children({k: m for k, m in obj.items(keep_base=True)})
+    if isinstance(obj, Metric):
+        return "metric", _expand_children({SELF_KEY: obj})
+    raise MetricsUserError(
+        f"checkpointing supports Metric and MetricCollection, got {type(obj).__name__}"
+    )
+
+
+def _expand_children(members: Dict[str, Metric]) -> Dict[str, Metric]:
+    out: Dict[str, Metric] = {}
+
+    def add(key: str, metric: Metric) -> None:
+        out[key] = metric
+        for i, child in enumerate(metric._child_metrics()):
+            add(f"{key}#child{i}", child)
+
+    for key, metric in members.items():
+        add(key, metric)
+    return out
+
+
+def metric_leaves(metric: Metric, prefix: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Dict[str, Any]]]:
+    """``(payload, leaves_meta)`` for one metric's registered states.
+
+    ``payload`` maps npz keys to host numpy arrays (the device->host copy
+    happens here, synchronously — async saves only defer the file I/O);
+    ``leaves_meta`` maps state names to their manifest entries.
+    """
+    payload: Dict[str, np.ndarray] = {}
+    meta: Dict[str, Dict[str, Any]] = {}
+    state = metric.get_state()
+    for name in metric._defaults:
+        val = state[name]
+        tag = reduction_tag(metric._reductions[name])
+        key = prefix + name
+        if isinstance(val, CatBuffer):
+            entry: Dict[str, Any] = {
+                "kind": "catbuffer",
+                "reduction": tag,
+                "capacity": int(val.capacity),
+                "count": int(val.count) if val.materialized else 0,
+                "materialized": bool(val.materialized),
+            }
+            if val.materialized:
+                arr = np.asarray(val.to_array())  # raises loudly on overflow
+                payload[key] = arr
+                entry["dtype"] = str(arr.dtype)
+                entry["item_shape"] = [int(s) for s in arr.shape[1:]]
+            meta[name] = entry
+        elif isinstance(val, (list, tuple)):
+            arrs = [np.asarray(v) for v in val]
+            meta[name] = {
+                "kind": "list",
+                "reduction": tag,
+                "length": len(arrs),
+                "container": "tuple" if isinstance(val, tuple) else "list",
+            }
+            for i, a in enumerate(arrs):
+                payload[f"{key}.{i}"] = a
+        else:
+            arr = np.asarray(val)
+            payload[key] = arr
+            meta[name] = {
+                "kind": "array",
+                "reduction": tag,
+                "dtype": str(arr.dtype),
+                "shape": [int(s) for s in arr.shape],
+            }
+    return payload, meta
+
+
+def metric_aux(metric: Metric) -> Dict[str, Any]:
+    """Update-determined python config riding along per member.
+
+    ``Metric._ckpt_aux_attrs`` names attrs like ``Accuracy.mode`` or
+    ``ROC.num_classes`` that updates infer from the first batch — without
+    them a restored metric could not ``compute()`` before seeing data.
+    Data-dependent, so part of the shard, never of the fingerprint.
+    """
+    aux: Dict[str, Any] = {}
+    for name in type(metric)._ckpt_aux_attrs:
+        val = getattr(metric, name, None)
+        if val is not None and not isinstance(val, (str, int, float, bool)):
+            val = str(val)
+        aux[name] = val
+    return aux
+
+
+def metric_fingerprint(metric: Metric) -> Dict[str, Any]:
+    """Static identity of a metric for restore gating: class, per-state
+    kind/reduction (+ dense shape/dtype from the registered defaults), the
+    compute-group update signature, and engine-relevant config."""
+    states: Dict[str, Any] = {}
+    for name, default in metric._defaults.items():
+        tag = reduction_tag(metric._reductions[name])
+        if isinstance(default, CatBuffer):
+            states[name] = {"kind": "catbuffer", "reduction": tag}
+        elif isinstance(default, (list, tuple)):
+            states[name] = {"kind": "list", "reduction": tag}
+        else:
+            arr = np.asarray(default)
+            states[name] = {
+                "kind": "array",
+                "reduction": tag,
+                "shape": [int(s) for s in arr.shape],
+                "dtype": str(arr.dtype),
+            }
+    sig = metric._update_signature()
+    return {
+        "class": type(metric).__name__,
+        "states": states,
+        "update_signature": None if sig is None else repr(sig),
+        "buffer_capacity": metric.buffer_capacity,
+    }
+
+
+def object_fingerprint(obj: Any) -> Dict[str, Any]:
+    """Fingerprint of a Metric or MetricCollection (member-keyed)."""
+    kind, members = describe(obj)
+    fp: Dict[str, Any] = {
+        "format_version": FORMAT_VERSION,
+        "kind": kind,
+        "members": {key: metric_fingerprint(m) for key, m in members.items()},
+    }
+    return fp
+
+
+def fingerprint_diff(saved: Dict[str, Any], live: Dict[str, Any], path: str = "") -> List[str]:
+    """Line-per-mismatch diff between two fingerprints (empty = compatible)."""
+    lines: List[str] = []
+    if isinstance(saved, dict) and isinstance(live, dict):
+        for key in sorted(set(saved) | set(live)):
+            sub = f"{path}.{key}" if path else str(key)
+            if key not in saved:
+                lines.append(f"{sub}: only in live object ({live[key]!r})")
+            elif key not in live:
+                lines.append(f"{sub}: only in checkpoint ({saved[key]!r})")
+            else:
+                lines.extend(fingerprint_diff(saved[key], live[key], sub))
+        return lines
+    if saved != live:
+        lines.append(f"{path}: checkpoint={saved!r} live={live!r}")
+    return lines
+
+
+def build_shard(obj: Any) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """One host's shard: ``(payload, shard_meta)``.
+
+    ``shard_meta`` carries the per-member leaves metadata, update counts, and
+    the object fingerprint (identical across shards; the committer refuses a
+    shard set whose fingerprints diverge).
+    """
+    kind, members = describe(obj)
+    payload: Dict[str, np.ndarray] = {}
+    members_meta: Dict[str, Any] = {}
+    for key, metric in members.items():
+        prefix = "" if key == SELF_KEY else f"{key}."
+        p, leaves = metric_leaves(metric, prefix)
+        payload.update(p)
+        members_meta[key] = {
+            "update_count": int(metric._update_count),
+            "leaves": leaves,
+            "aux": metric_aux(metric),
+        }
+    shard_meta = {
+        "kind": kind,
+        "members": members_meta,
+        "fingerprint": object_fingerprint(obj),
+    }
+    return payload, shard_meta

@@ -1,0 +1,406 @@
+"""Restore, verification, and reshard-on-restore shard folding.
+
+Restore is strictly two-pass: every check (commit marker, manifest version,
+checksums, fingerprint diff) and every shard fold happens on host-side numpy
+state *before* the live object is touched. Only when the complete folded state
+exists is it applied, after which the object's dispatch memos are invalidated
+(``_computed`` caches, engine :class:`~metrics_tpu.core.engine._SigCache`
+state-signature memos, donation-aliasing bookkeeping) so the compiled engines
+can never serve a value derived from pre-restore state identity.
+
+**Reshard-on-restore**: a checkpoint written by N hosts (N shards) restores
+onto M hosts for any M by assigning shards round-robin — host ``i`` of ``M``
+folds shards ``{i, i+M, i+2M, …}`` with each leaf's recorded reduction:
+``sum`` adds, ``max``/``min`` take the elementwise extremum,
+``cat``/``CatBuffer``/list states concatenate in shard order, and ``mean``
+is recomputed from the recorded per-shard update counts. The fold is the
+metric's own :meth:`~metrics_tpu.Metric.merge_states` — the same primitive
+that backs cross-batch accumulation and cross-device sync — so a folded
+restore is bitwise-identical to having accumulated on fewer hosts from the
+start for all mergeable reductions.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.checkpoint import io as _io
+from metrics_tpu.checkpoint.format import (
+    SELF_KEY,
+    describe,
+    fingerprint_diff,
+    object_fingerprint,
+    tag_mergeable,
+)
+from metrics_tpu.core.buffers import CatBuffer
+from metrics_tpu.core.metric import Metric
+
+
+@dataclass
+class RestoreInfo:
+    """What a restore actually did (returned by ``restore_checkpoint``)."""
+
+    root: str
+    step: int
+    world_size: int            # hosts that wrote the checkpoint
+    shards_loaded: Tuple[int, ...]  # shard indices folded into this host
+    host_index: int
+    host_count: int
+
+
+@dataclass
+class VerifyReport:
+    """Result of verifying one snapshot."""
+
+    root: str
+    step: int
+    ok: bool
+    world_size: int = 0
+    shards: int = 0
+    issues: List[str] = field(default_factory=list)
+
+
+# --------------------------------------------------------------------------- #
+# shard decoding + folding (pure numpy/jnp; no live object involved)
+# --------------------------------------------------------------------------- #
+def _decode_member_state(
+    payload: Dict[str, np.ndarray], member_key: str, leaves: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Rebuild one member's state dict from a shard's payload."""
+    prefix = "" if member_key == SELF_KEY else f"{member_key}."
+    state: Dict[str, Any] = {}
+    for name, meta in leaves.items():
+        key = prefix + name
+        kind = meta["kind"]
+        if kind == "array":
+            if key not in payload:
+                raise _io.CheckpointCorruptError(f"payload key {key!r} missing from shard")
+            state[name] = jnp.asarray(payload[key])
+        elif kind == "list":
+            items = [jnp.asarray(payload[f"{key}.{i}"]) for i in range(meta["length"])]
+            state[name] = tuple(items) if meta.get("container") == "tuple" else items
+        elif kind == "catbuffer":
+            if not meta.get("materialized", False):
+                state[name] = CatBuffer.empty(meta["capacity"])
+            else:
+                arr = jnp.asarray(payload[key])
+                cap = max(int(meta["capacity"]), int(arr.shape[0]), 1)
+                state[name] = (
+                    CatBuffer.empty(cap) if arr.shape[0] == 0 else CatBuffer.from_array(arr, capacity=cap)
+                )
+        else:
+            raise _io.CheckpointCorruptError(f"unknown leaf kind {kind!r} for {key!r}")
+    return state
+
+
+def _check_foldable(leaves: Dict[str, Any], n_shards: int, member_key: str) -> None:
+    if n_shards <= 1:
+        return
+    for name, meta in leaves.items():
+        if not tag_mergeable(meta["reduction"], meta["kind"]):
+            raise _io.CheckpointMismatchError(
+                f"state {member_key}.{name} (reduction {meta['reduction']!r}, kind "
+                f"{meta['kind']!r}) cannot be folded across shards; restore with the "
+                "same host count the checkpoint was written with, or merge offline "
+                "after replacing the reduction"
+            )
+
+
+def fold_member_shards(
+    metric: Metric,
+    member_key: str,
+    shard_states: List[Dict[str, Any]],
+    shard_counts: List[int],
+    leaves: Dict[str, Any],
+) -> Tuple[Dict[str, Any], int]:
+    """Fold shard states with the metric's own merge semantics.
+
+    Returns ``(folded_state, total_update_count)``. A single shard passes
+    through untouched (the N==M fast path).
+    """
+    _check_foldable(leaves, len(shard_states), member_key)
+    state, count = shard_states[0], shard_counts[0]
+    for incoming, inc_count in zip(shard_states[1:], shard_counts[1:]):
+        state = metric.merge_states(state, incoming, (count, inc_count))
+        count += inc_count
+    return state, count
+
+
+def assign_shards(world_size: int, host_index: int, host_count: int) -> Tuple[int, ...]:
+    """Round-robin shard ownership for reshard-on-restore."""
+    if host_count <= 0:
+        raise _io.CheckpointError(f"host_count must be positive, got {host_count}")
+    if not (0 <= host_index < host_count):
+        raise _io.CheckpointError(f"host_index {host_index} out of range for host_count {host_count}")
+    return tuple(range(host_index, world_size, host_count))
+
+
+# --------------------------------------------------------------------------- #
+# the live-object restore
+# --------------------------------------------------------------------------- #
+def restore_checkpoint(
+    obj: Any,
+    root: str,
+    step: Optional[int] = None,
+    *,
+    host_index: Optional[int] = None,
+    host_count: Optional[int] = None,
+    verify_payload: bool = True,
+) -> RestoreInfo:
+    """Load a committed snapshot into a live Metric / MetricCollection.
+
+    ``host_index``/``host_count`` default to ``jax.process_index()`` /
+    ``jax.process_count()``; pass them explicitly to reshard (e.g.
+    ``host_count=1`` folds every shard into this process). All verification
+    and folding completes before any live state is replaced.
+    """
+    import jax
+
+    if host_count is None:
+        try:
+            host_count = jax.process_count()
+        except Exception:
+            host_count = 1
+    if host_index is None:
+        try:
+            host_index = jax.process_index()
+        except Exception:
+            host_index = 0
+
+    step = _io.resolve_step(root, step)
+    manifest = _io.read_manifest(root, step)
+
+    live_fp = object_fingerprint(obj)
+    diff = fingerprint_diff(manifest["fingerprint"], live_fp)
+    if diff:
+        raise _io.CheckpointMismatchError(
+            f"checkpoint step {step} under {root!r} does not match the live "
+            f"{type(obj).__name__}; refusing to restore. Diff (checkpoint vs live):\n  "
+            + "\n  ".join(diff)
+        )
+
+    world_size = int(manifest["world_size"])
+    mine = assign_shards(world_size, host_index, host_count)
+    shard_entries = {int(s["shard_index"]): s for s in manifest["shards"]}
+
+    kind, members = describe(obj)
+
+    # pass 1: load + fold on host memory; the live object is untouched
+    loaded: List[Tuple[int, Dict[str, np.ndarray], Dict[str, Any]]] = []
+    for idx in mine:
+        entry = shard_entries[idx]
+        loaded.append((idx, _io.load_shard_payload(root, step, entry, verify=verify_payload), entry))
+
+    folded: Dict[str, Tuple[Dict[str, Any], int]] = {}
+    for key, metric in members.items():
+        if not loaded:
+            # more restore hosts than shards: this host starts from defaults
+            folded[key] = ({k: v for k, v in metric.init_state().items()}, 0)
+            continue
+        states, counts = [], []
+        leaves = None
+        for _idx, payload, entry in loaded:
+            mmeta = entry["members"][key]
+            leaves = mmeta["leaves"]
+            states.append(_decode_member_state(payload, key, leaves))
+            counts.append(int(mmeta["update_count"]))
+        folded[key] = fold_member_shards(metric, key, states, counts, leaves)
+
+    # pass 2: apply + invalidate dispatch state
+    for key, metric in members.items():
+        state, count = folded[key]
+        metric.set_state(state)
+        if loaded:
+            # update-determined python config (Accuracy.mode, ...); identical
+            # across shards (the committer pinned the fingerprints equal and
+            # mixed input modes raise at update time)
+            for aux_name, aux_val in (loaded[0][2]["members"][key].get("aux") or {}).items():
+                setattr(metric, aux_name, aux_val)
+        metric._update_count = count
+        metric._is_synced = False
+        metric._cache = None
+        metric._shared_state_ids = frozenset()
+        metric._invalidate_dispatch()
+    if kind == "collection":
+        obj._members_stale = False
+        obj._invalidate_dispatch()
+    return RestoreInfo(
+        root=root,
+        step=step,
+        world_size=world_size,
+        shards_loaded=mine,
+        host_index=host_index,
+        host_count=host_count,
+    )
+
+
+# --------------------------------------------------------------------------- #
+# verification (no live object needed)
+# --------------------------------------------------------------------------- #
+def verify_checkpoint(root: str, step: Optional[int] = None) -> VerifyReport:
+    """Structural + checksum verification of one committed snapshot."""
+    try:
+        step = _io.resolve_step(root, step)
+    except _io.CheckpointError as err:
+        return VerifyReport(root=root, step=-1 if step is None else step, ok=False, issues=[str(err)])
+    report = VerifyReport(root=root, step=step, ok=True)
+    try:
+        manifest = _io.read_manifest(root, step)
+    except _io.CheckpointError as err:
+        report.ok = False
+        report.issues.append(str(err))
+        return report
+    report.world_size = int(manifest["world_size"])
+    report.shards = len(manifest["shards"])
+    if report.shards != report.world_size:
+        report.ok = False
+        report.issues.append(
+            f"manifest lists {report.shards} shards but world_size is {report.world_size}"
+        )
+    for entry in manifest["shards"]:
+        try:
+            payload = _io.load_shard_payload(root, step, entry, verify=True)
+        except _io.CheckpointError as err:
+            report.ok = False
+            report.issues.append(str(err))
+            continue
+        # every manifest leaf must be present in the payload
+        for member_key, mmeta in entry["members"].items():
+            try:
+                _decode_member_state(payload, member_key, mmeta["leaves"])
+            except _io.CheckpointError as err:
+                report.ok = False
+                report.issues.append(f"shard {entry['shard_index']}: {err}")
+    return report
+
+
+def verify_all(root: str) -> List[VerifyReport]:
+    return [verify_checkpoint(root, s) for s in _io.available_steps(root)]
+
+
+# --------------------------------------------------------------------------- #
+# offline shard merge (the CLI `merge` subcommand)
+# --------------------------------------------------------------------------- #
+def _merge_leaf_offline(
+    meta: Dict[str, Any],
+    values: List[Any],
+    counts: List[int],
+) -> Any:
+    """Numpy-only fold of one leaf across shards by its recorded reduction."""
+    tag, kind = meta["reduction"], meta["kind"]
+    if kind == "list":
+        out: List[np.ndarray] = []
+        for v in values:
+            out.extend(v)
+        return out
+    if kind == "catbuffer":
+        mats = [v for v in values if v is not None]
+        return np.concatenate(mats, axis=0) if mats else None
+    if tag == "sum":
+        out = values[0]
+        for v in values[1:]:
+            out = out + v
+        return out
+    if tag == "max":
+        out = values[0]
+        for v in values[1:]:
+            out = np.maximum(out, v)
+        return out
+    if tag == "min":
+        out = values[0]
+        for v in values[1:]:
+            out = np.minimum(out, v)
+        return out
+    if tag == "mean":
+        total = max(sum(counts), 1)
+        acc = np.zeros_like(np.asarray(values[0], dtype=np.result_type(values[0], np.float64)))
+        for v, n in zip(values, counts):
+            acc = acc + np.asarray(v) * n
+        return (acc / total).astype(np.asarray(values[0]).dtype)
+    if tag == "cat":
+        return np.concatenate([np.atleast_1d(v) for v in values], axis=0)
+    raise _io.CheckpointMismatchError(
+        f"cannot merge leaves with reduction {tag!r} offline (kind {kind!r})"
+    )
+
+
+def merge_shards(root: str, out_root: str, step: Optional[int] = None, out_step: Optional[int] = None) -> int:
+    """Fold an N-shard snapshot into a committed 1-shard snapshot at
+    ``out_root`` (offline reshard; no live metric objects needed). Returns the
+    written step."""
+    step = _io.resolve_step(root, step)
+    manifest = _io.read_manifest(root, step)
+    out_step = step if out_step is None else out_step
+    entries = sorted(manifest["shards"], key=lambda s: s["shard_index"])
+    payloads = [_io.load_shard_payload(root, step, e, verify=True) for e in entries]
+
+    merged_payload: Dict[str, np.ndarray] = {}
+    merged_members: Dict[str, Any] = {}
+    member_keys = entries[0]["members"].keys()
+    for member_key in member_keys:
+        prefix = "" if member_key == SELF_KEY else f"{member_key}."
+        leaves = entries[0]["members"][member_key]["leaves"]
+        counts = [int(e["members"][member_key]["update_count"]) for e in entries]
+        merged_leaves: Dict[str, Any] = {}
+        for name, meta in leaves.items():
+            key = prefix + name
+            kind = meta["kind"]
+            if kind == "list":
+                values = [
+                    [p[f"{key}.{i}"] for i in range(e["members"][member_key]["leaves"][name]["length"])]
+                    for e, p in zip(entries, payloads)
+                ]
+                merged = _merge_leaf_offline(meta, values, counts)
+                new_meta = dict(meta)
+                new_meta["length"] = len(merged)
+                for i, a in enumerate(merged):
+                    merged_payload[f"{key}.{i}"] = a
+                merged_leaves[name] = new_meta
+            elif kind == "catbuffer":
+                values = [
+                    p.get(key) if e["members"][member_key]["leaves"][name].get("materialized") else None
+                    for e, p in zip(entries, payloads)
+                ]
+                merged = _merge_leaf_offline(meta, values, counts)
+                new_meta = dict(meta)
+                if merged is None:
+                    new_meta["materialized"] = False
+                    new_meta["count"] = 0
+                else:
+                    new_meta["materialized"] = True
+                    new_meta["count"] = int(merged.shape[0])
+                    new_meta["capacity"] = max(
+                        int(meta["capacity"]), int(merged.shape[0]), 1
+                    )
+                    new_meta["dtype"] = str(merged.dtype)
+                    new_meta["item_shape"] = [int(s) for s in merged.shape[1:]]
+                    merged_payload[key] = merged
+                merged_leaves[name] = new_meta
+            else:
+                values = [p[key] for p in payloads]
+                merged = _merge_leaf_offline(meta, values, counts)
+                new_meta = dict(meta)
+                new_meta["shape"] = [int(s) for s in np.asarray(merged).shape]
+                merged_payload[key] = np.asarray(merged)
+                merged_leaves[name] = new_meta
+        merged_members[member_key] = {
+            "update_count": sum(counts),
+            "leaves": merged_leaves,
+            "aux": entries[0]["members"][member_key].get("aux") or {},
+        }
+
+    shard_meta = {
+        "kind": manifest["kind"],
+        "members": merged_members,
+        "fingerprint": manifest["fingerprint"],
+    }
+    import os
+
+    os.makedirs(out_root, exist_ok=True)
+    pending = _io.pending_dir(out_root, out_step)
+    _io.write_shard(pending, 0, 1, merged_payload, shard_meta)
+    _io.try_commit(out_root, out_step, 1)
+    return out_step

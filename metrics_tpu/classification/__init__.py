@@ -38,6 +38,28 @@ _BINARY = [("float32", (16,)), ("int32", (16,))]
 _LABELS4 = [("int32", (16,)), ("int32", (16,))]
 _MULTILABEL5 = [("float32", (8, 5)), ("int32", (8, 5))]
 
+# checkpoint-sweep hints: 4-class label inputs need int_high=4 (the default
+# binary synthesis would never exercise classes 2/3); AUC needs monotonic x;
+# KLDivergence needs rows that are probability distributions
+_CKPT4 = {"int_high": 4}
+
+
+def _ckpt_auc_inputs():
+    import numpy as np
+
+    x = np.linspace(0.0, 1.0, 16, dtype=np.float32)
+    return (x, np.sqrt(x)), {}
+
+
+def _ckpt_kld_inputs():
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    p = rng.uniform(0.1, 1.0, (8, 5)).astype(np.float32)
+    q = rng.uniform(0.1, 1.0, (8, 5)).astype(np.float32)
+    return (p / p.sum(-1, keepdims=True), q / q.sum(-1, keepdims=True)), {}
+
+
 ANALYSIS_SPECS = {
     "Accuracy": {"inputs": _BINARY},
     "Dice": {"inputs": _BINARY},
@@ -51,17 +73,25 @@ ANALYSIS_SPECS = {
     "StatScores": {"inputs": _BINARY},
     # curve family: buffer_capacity turns the unbounded cat states into
     # CatBuffers so the compiled path (and the eval sweep) covers them
-    "AUC": {"init": {"buffer_capacity": 64}, "inputs": [("float32", (16,)), ("float32", (16,))]},
+    "AUC": {
+        "init": {"buffer_capacity": 64},
+        "inputs": [("float32", (16,)), ("float32", (16,))],
+        # a second identical update would break global monotonicity of x
+        "ckpt": {"inputs_fn": _ckpt_auc_inputs, "updates": 1},
+    },
     "AUROC": {"init": {"buffer_capacity": 64}, "inputs": _BINARY},
     "AveragePrecision": {"init": {"buffer_capacity": 64}, "inputs": _BINARY},
     "CalibrationError": {"init": {"buffer_capacity": 64}, "inputs": _BINARY},
     "PrecisionRecallCurve": {"init": {"buffer_capacity": 64}, "inputs": _BINARY},
     "ROC": {"init": {"buffer_capacity": 64}, "inputs": _BINARY},
-    "CohenKappa": {"init": {"num_classes": 4}, "inputs": _LABELS4},
-    "ConfusionMatrix": {"init": {"num_classes": 4}, "inputs": _LABELS4},
-    "JaccardIndex": {"init": {"num_classes": 4}, "inputs": _LABELS4},
-    "MatthewsCorrCoef": {"init": {"num_classes": 4}, "inputs": _LABELS4},
-    "KLDivergence": {"inputs": [("float32", (8, 5)), ("float32", (8, 5))]},
+    "CohenKappa": {"init": {"num_classes": 4}, "inputs": _LABELS4, "ckpt": _CKPT4},
+    "ConfusionMatrix": {"init": {"num_classes": 4}, "inputs": _LABELS4, "ckpt": _CKPT4},
+    "JaccardIndex": {"init": {"num_classes": 4}, "inputs": _LABELS4, "ckpt": _CKPT4},
+    "MatthewsCorrCoef": {"init": {"num_classes": 4}, "inputs": _LABELS4, "ckpt": _CKPT4},
+    "KLDivergence": {
+        "inputs": [("float32", (8, 5)), ("float32", (8, 5))],
+        "ckpt": {"inputs_fn": _ckpt_kld_inputs},
+    },
     "CoverageError": {"inputs": _MULTILABEL5},
     "LabelRankingAveragePrecision": {"inputs": _MULTILABEL5},
     "LabelRankingLoss": {"inputs": _MULTILABEL5},

@@ -28,6 +28,7 @@ class AUROC(Metric):
 
     is_differentiable = False
     higher_is_better = True
+    _ckpt_aux_attrs = ("mode",)
     full_state_update: bool = False
 
     def __init__(

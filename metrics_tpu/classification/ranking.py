@@ -24,6 +24,7 @@ from metrics_tpu.ops.classification.ranking import (
 class _RankingBase(Metric):
     is_differentiable = False
     full_state_update: bool = False
+    _ckpt_aux_attrs = ("_has_weight",)
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)

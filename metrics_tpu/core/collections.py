@@ -189,7 +189,7 @@ class MetricCollection:
     def _rebuild_groups(self) -> None:
         """Static grouping by update signature (no runtime probing)."""
         # members must be whole before membership changes: a member that moves
-        # to another group would otherwise keep its detached (None) state
+        # to another group would otherwise keep its detached (poisoned) state
         self._realias_members()
         # group membership is baked into the fused executables' closures, so
         # any cached compiled update/compute is stale the moment groups change
@@ -433,6 +433,17 @@ class MetricCollection:
     def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
         for k, m in self.items(keep_base=True):
             m.load_state_dict(state_dict, prefix=f"{k}.", strict=strict)
+        # members invalidated their own engines; the fused collection engines
+        # hold their own id-keyed memos over the (now replaced) leader leaves
+        self._invalidate_dispatch()
+
+    def _invalidate_dispatch(self) -> None:
+        """Reset the fused engines' id-keyed signature memos after an
+        out-of-band state replacement (``load_state_dict``, checkpoint
+        restore); see :meth:`Metric._invalidate_dispatch`."""
+        for engine in (self._update_engine, self._compute_engine):
+            if engine is not None:
+                engine.reset_signature_memos()
 
     # ------------------------------------------------------------------ #
     # fused pure protocol (the compiled hot path)

@@ -347,6 +347,17 @@ class _EngineBase:
         """Why the engine permanently fell back to eager mode (None = healthy)."""
         return self._broken
 
+    def reset_signature_memos(self) -> None:
+        """Drop the id-keyed dispatch memos (the two ``_SigCache`` halves).
+
+        Called when state is replaced out-of-band (``load_state_dict``,
+        checkpoint restore): the new leaves' ids must never inherit signatures
+        memoized for the old leaves. The jitted executables stay cached —
+        their key is avals, not identity — so the next dispatch re-derives the
+        signature once and is compiled again immediately."""
+        self._args_sig = _SigCache()
+        self._state_sig = _SigCache()
+
     def _owner_name(self) -> str:
         """Class name of the metric/collection this engine accelerates."""
         owner = getattr(self, "metric", None) or getattr(self, "collection", None)
@@ -569,9 +580,7 @@ class CollectionUpdateEngine(_EngineBase):
         if not coll._members_stale:
             for group in coll._groups:
                 for name in group[1:]:
-                    member = coll._metrics[name]
-                    for key in member._defaults:
-                        setattr(member, key, None)
+                    coll._metrics[name]._detach_states()
             coll._members_stale = True
         handled, new_states = self._dispatch(
             self._jit_plain, self._jit_donate, states, args, kwargs,

@@ -128,6 +128,12 @@ class Metric:
     higher_is_better: Optional[bool] = None
     full_state_update: Optional[bool] = True
 
+    # update-determined python config (e.g. Accuracy.mode, ROC.num_classes
+    # inferred from the first batch) that a checkpoint must persist alongside
+    # the registered states for restore-then-compute to work without seeing
+    # data first. Values must be JSON-serializable scalars.
+    _ckpt_aux_attrs: Tuple[str, ...] = ()
+
     def __init__(
         self,
         compute_on_cpu: bool = False,
@@ -185,6 +191,7 @@ class Metric:
         self._should_unsync = True
         self._is_synced = False
         self._cache: Optional[StateDict] = None
+        self._states_detached = False  # fused-collection streak poison flag
 
         # wrap the subclass update/compute with bookkeeping (reference :118-119)
         self.update: Callable = self._wrap_update(self.update)  # type: ignore[method-assign]
@@ -280,6 +287,52 @@ class Metric:
     def set_state(self, state: StateDict) -> None:
         for k, v in state.items():
             setattr(self, k, _copy_state_value(v))
+        if self._states_detached and all(k in self.__dict__ for k in self._defaults):
+            self._states_detached = False
+
+    def _detach_states(self) -> None:
+        """Remove the registered state attrs for a fused-update streak.
+
+        While this metric is a detached non-leader member of a collection
+        compute group (only its leader advances; see
+        ``CollectionUpdateEngine.dispatch``), a direct ``metric.tp``-style
+        read raises loudly via ``__getattr__`` instead of returning stale
+        state — the runtime side of analysis rule A006. ``set_state`` /
+        ``reset`` re-attach.
+        """
+        for key in self._defaults:
+            self.__dict__.pop(key, None)
+        self._states_detached = True
+
+    def _invalidate_dispatch(self) -> None:
+        """Forget everything derived from the previous state's identity.
+
+        Any out-of-band state replacement (``load_state_dict``, checkpoint
+        restore) must clear the memoized compute results and the engines'
+        id-keyed signature memos: the new leaves could otherwise inherit a
+        stale ``_computed`` value or the old leaves' dispatch fast path.
+        """
+        self._computed = None
+        self._forward_cache = None
+        for engine in (self._update_engine, self._compute_engine):
+            if engine is not None:
+                engine.reset_signature_memos()
+
+    def __getattr__(self, name: str) -> Any:
+        # only reached when normal lookup fails; detached state attrs are
+        # *removed* (not None), so stale-state reads land here and fail loudly
+        d = object.__getattribute__(self, "__dict__")
+        if d.get("_states_detached") and name in d.get("_defaults", ()):
+            raise MetricsUserError(
+                f"{type(self).__name__}.{name} was read while its state is detached: this "
+                "metric is a non-leader member of a MetricCollection compute group in a fused "
+                "update streak, so its state only materializes at the next "
+                "compute()/items()/checkpoint (MetricCollection._realias_members). Read "
+                "results through the collection, or realize states first via "
+                "collection.items(). (`python -m metrics_tpu.analysis` rule A006 flags "
+                "these reads statically.)"
+            )
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
 
     def _child_metrics(self) -> List["Metric"]:
         """Metric instances held as attributes (wrappers: BootStrapper copies,
@@ -715,6 +768,7 @@ class Metric:
             setattr(self, attr, _copy_state_value(default))
         self._cache = None
         self._is_synced = False
+        self._states_detached = False
 
     def clone(self) -> "Metric":
         """Deep copy (reference: metric.py:545-547)."""
@@ -832,6 +886,11 @@ class Metric:
                     setattr(self, key, jnp.asarray(val))
             elif strict and self._persistent[key]:
                 raise KeyError(f"Missing key {name!r} in state_dict")
+        # any state load replaces leaves out-of-band: stale `_computed` memos
+        # and the engines' id-keyed signature memos must not survive it
+        self._is_synced = False
+        self._cache = None
+        self._invalidate_dispatch()
 
     # ------------------------------------------------------------------ #
     # misc parity helpers

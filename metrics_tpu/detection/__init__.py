@@ -5,9 +5,30 @@ __all__ = ["MeanAveragePrecision"]
 
 
 # analyzer registry (metrics_tpu.analysis); see docs/static_analysis.md
+def _ckpt_map_inputs():
+    # checkpoint-sweep inputs: one image, two detections against one gt box
+    import numpy as np
+
+    preds = [
+        {
+            "boxes": np.asarray([[10.0, 20.0, 50.0, 60.0], [30.0, 10.0, 70.0, 50.0]], np.float32),
+            "scores": np.asarray([0.9, 0.4], np.float32),
+            "labels": np.asarray([0, 1], np.int32),
+        }
+    ]
+    target = [
+        {
+            "boxes": np.asarray([[12.0, 22.0, 48.0, 58.0]], np.float32),
+            "labels": np.asarray([0], np.int32),
+        }
+    ]
+    return (preds, target), {}
+
+
 ANALYSIS_SPECS = {
     "MeanAveragePrecision": {
         "skip_eval": "dict-of-boxes inputs and COCO matching are host-side by design",
         "host_inputs": True,
+        "ckpt": {"inputs_fn": _ckpt_map_inputs},
     },
 }

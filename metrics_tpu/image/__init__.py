@@ -35,11 +35,23 @@ __all__ = [
 # --------------------------------------------------------------------------- #
 _IMG = [("float32", (2, 3, 32, 32)), ("float32", (2, 3, 32, 32))]
 
+def _ckpt_msssim_inputs():
+    import numpy as np
+
+    rng = np.random.default_rng(3)
+    a = rng.uniform(0.0, 1.0, (1, 3, 192, 192)).astype(np.float32)
+    b = rng.uniform(0.0, 1.0, (1, 3, 192, 192)).astype(np.float32)
+    return (a, b), {}
+
+
 ANALYSIS_SPECS = {
     "PeakSignalNoiseRatio": {"inputs": _IMG},
     "StructuralSimilarityIndexMeasure": {"inputs": _IMG},
     "MultiScaleStructuralSimilarityIndexMeasure": {
         "inputs": [("float32", (2, 3, 128, 128)), ("float32", (2, 3, 128, 128))],
+        # compute at 5 scales needs sides > 160; the 128px abstract-eval shape
+        # only ever runs update
+        "ckpt": {"inputs_fn": _ckpt_msssim_inputs},
     },
     "SpectralAngleMapper": {"inputs": _IMG},
     "SpectralDistortionIndex": {"inputs": _IMG},
@@ -48,6 +60,7 @@ ANALYSIS_SPECS = {
     "FrechetInceptionDistance": {
         "inputs": [("uint8", (2, 3, 299, 299))],
         "static_kwargs": {"real": True},
+        "ckpt": {"skip": "inception forward too heavy for the tier-1 sweep"},
         # the Welford triple merge all-gathers each moment leaf separately by
         # design (Chan's combine needs the per-device stacks)
         "collective_budget": 8,
@@ -55,9 +68,14 @@ ANALYSIS_SPECS = {
     "KernelInceptionDistance": {
         "inputs": [("uint8", (2, 3, 299, 299))],
         "static_kwargs": {"real": True},
+        "ckpt": {"skip": "inception forward too heavy for the tier-1 sweep"},
     },
-    "InceptionScore": {"inputs": [("uint8", (2, 3, 299, 299))]},
+    "InceptionScore": {
+        "inputs": [("uint8", (2, 3, 299, 299))],
+        "ckpt": {"skip": "inception forward too heavy for the tier-1 sweep"},
+    },
     "LearnedPerceptualImagePatchSimilarity": {
         "inputs": [("float32", (2, 3, 64, 64)), ("float32", (2, 3, 64, 64))],
+        "ckpt": {"skip": "VGG feature forward too heavy for the tier-1 sweep"},
     },
 }

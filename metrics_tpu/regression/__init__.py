@@ -21,6 +21,9 @@ from metrics_tpu.regression.other import CosineSimilarity, TweedieDevianceScore 
 # --------------------------------------------------------------------------- #
 _VEC = [("float32", (16,)), ("float32", (16,))]
 
+# (the checkpoint roundtrip sweep synthesizes valid inputs from these specs
+# directly: uniform [0, 1) floats are in-domain for every regression metric,
+# including MeanSquaredLogError's > -1 requirement)
 ANALYSIS_SPECS = {
     "MeanAbsoluteError": {"inputs": _VEC},
     "MeanAbsolutePercentageError": {"inputs": _VEC},

@@ -20,9 +20,22 @@ from metrics_tpu.retrieval.precision_recall_curve import (  # noqa: F401
 # analyzer registry (metrics_tpu.analysis): the compiled retrieval path needs
 # static query/document bounds plus CatBuffer state; see docs/static_analysis.md
 # --------------------------------------------------------------------------- #
+def _ckpt_retrieval_inputs():
+    # checkpoint-sweep inputs: 8 queries x 2 docs, one relevant doc per query
+    # (every retrieval metric is well-defined; synthesized random indexes
+    # would overflow max_docs_per_query and leave positive-free queries)
+    import numpy as np
+
+    preds = np.linspace(0.05, 0.95, 16, dtype=np.float32)
+    target = np.tile(np.asarray([0, 1], np.int32), 8)
+    indexes = np.repeat(np.arange(8, dtype=np.int32), 2)
+    return (preds, target, indexes), {}
+
+
 _RETRIEVAL_SPEC = {
     "init": {"max_queries": 8, "max_docs_per_query": 4, "buffer_capacity": 64},
     "inputs": [("float32", (16,)), ("int32", (16,)), ("int32", (16,))],
+    "ckpt": {"inputs_fn": _ckpt_retrieval_inputs},
 }
 
 ANALYSIS_SPECS = {

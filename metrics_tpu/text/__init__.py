@@ -25,6 +25,26 @@ _HOST_TEXT = {
     "host_inputs": True,
 }
 
+# checkpoint-sweep inputs: synthesized (dtype, shape) arrays can't stand in
+# for strings, so each text metric declares a concrete example corpus
+_CKPT_PREDS = ["hello world", "the cat sat on the mat"]
+_CKPT_REFS = ["hello there world", "the cat sat on a mat"]
+_CKPT_PAIR = {"inputs_fn": lambda: ((list(_CKPT_PREDS), list(_CKPT_REFS)), {})}
+_CKPT_CORPUS = {"inputs_fn": lambda: ((list(_CKPT_PREDS), [[r] for r in _CKPT_REFS]), {})}
+
+
+def _ckpt_squad_inputs():
+    preds = [
+        {"prediction_text": "1976", "id": "q0"},
+        {"prediction_text": "san francisco", "id": "q1"},
+    ]
+    target = [
+        {"answers": {"answer_start": [97], "text": ["1976"]}, "id": "q0"},
+        {"answers": {"answer_start": [1], "text": ["San Francisco"]}, "id": "q1"},
+    ]
+    return (preds, target), {}
+
+
 ANALYSIS_SPECS = {
     name: dict(_HOST_TEXT)
     for name in (
@@ -42,7 +62,14 @@ ANALYSIS_SPECS = {
         "WordInfoPreserved",
     )
 }
+for _name in ("BLEUScore", "SacreBLEUScore", "CHRFScore", "TranslationEditRate", "ExtendedEditDistance"):
+    ANALYSIS_SPECS[_name]["ckpt"] = _CKPT_CORPUS
+for _name in ("CharErrorRate", "MatchErrorRate", "ROUGEScore", "WordErrorRate", "WordInfoLost", "WordInfoPreserved"):
+    ANALYSIS_SPECS[_name]["ckpt"] = _CKPT_PAIR
+ANALYSIS_SPECS["SQuAD"]["ckpt"] = {"inputs_fn": _ckpt_squad_inputs}
+del _name
 ANALYSIS_SPECS["BERTScore"] = {
     **_HOST_TEXT,
     "no_probe": "constructor loads a pretrained LM from the network",
+    "ckpt": {"skip": "constructor loads a pretrained LM from the network"},
 }

@@ -27,29 +27,73 @@ def _probe_base():
 
 _CHILD_STATE = "state lives in wrapped child metrics outside the pure-state protocol"
 
+
+def _ckpt_vec_inputs():
+    # checkpoint-sweep inputs for the MSE probe base: deterministic float pairs
+    # (device arrays: BootStrapper's resampler dispatches on jax.Array)
+    import jax.numpy as jnp
+
+    x = jnp.linspace(0.0, 1.0, 8, dtype=jnp.float32)
+    return (x, x * 0.5 + 0.1), {}
+
+
+def _ckpt_multioutput_inputs():
+    import jax.numpy as jnp
+
+    x = jnp.linspace(0.0, 1.0, 16, dtype=jnp.float32).reshape(8, 2)
+    return (x, x * 0.5 + 0.1), {}
+
+
+def _ckpt_classwise():
+    # a per-class (vector-compute) base: ClasswiseWrapper enumerates the
+    # compute result, which a scalar MSE probe cannot support
+    from metrics_tpu.classification import Accuracy
+
+    return ClasswiseWrapper(Accuracy(num_classes=4, average=None))
+
+
+def _ckpt_classwise_inputs():
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    return (
+        rng.integers(0, 4, (16,)).astype(np.int32),
+        rng.integers(0, 4, (16,)).astype(np.int32),
+    ), {}
+
+
 ANALYSIS_SPECS = {
     "BootStrapper": {
         "init_fn": lambda: BootStrapper(_probe_base(), num_bootstraps=4),
         "skip_eval": _CHILD_STATE,
+        "ckpt": {"inputs_fn": _ckpt_vec_inputs},
     },
     "ClasswiseWrapper": {
         "init_fn": lambda: ClasswiseWrapper(_probe_base()),
         "skip_eval": _CHILD_STATE,
+        "ckpt": {"init_fn": _ckpt_classwise, "inputs_fn": _ckpt_classwise_inputs},
     },
     "MinMaxMetric": {
         "init_fn": lambda: MinMaxMetric(_probe_base()),
         "skip_eval": _CHILD_STATE,
+        "ckpt": {"inputs_fn": _ckpt_vec_inputs},
     },
     "MultioutputWrapper": {
         "init_fn": lambda: MultioutputWrapper(_probe_base(), num_outputs=2),
         "skip_eval": _CHILD_STATE,
+        "ckpt": {"inputs_fn": _ckpt_multioutput_inputs},
     },
     "MetricTracker": {
         "init_fn": lambda: MetricTracker(_probe_base()),
         "skip_eval": _CHILD_STATE,
+        "ckpt": {
+            "skip": "per-step child list grows via increment(); a fresh tracker "
+            "fingerprint-mismatches the snapshot by design"
+        },
     },
     "CompositionalMetric": {
         "init_fn": lambda: _probe_base() + _probe_base(),
         "skip_eval": _CHILD_STATE,
+        "ckpt": {"inputs_fn": _ckpt_vec_inputs},
     },
 }

@@ -294,3 +294,82 @@ def test_bucketed_coalesces_by_kind():
             axis_env=[("data", WORLD)],
         )(state)
     assert box["by_kind"] == {"psum": 1}  # one bucket, one collective
+
+
+# ------------------------------------------------------------ CatBuffers -----
+from metrics_tpu.core.buffers import CatBuffer  # noqa: E402
+
+_CAP = 8
+
+
+def _device_buffers(i):
+    """Per-device CatBuffers with axis-index-dependent fill counts; rows past
+    the count are sentinel garbage the compaction must drop."""
+    base = jnp.arange(_CAP, dtype=jnp.float32)
+    fbuf = CatBuffer(base + 100.0 * i.astype(jnp.float32), (i % 3) + 1)
+    ibuf = CatBuffer(jnp.arange(_CAP, dtype=jnp.int32) + 1000 * i, (i % 5) + 1)
+    return fbuf, ibuf
+
+
+def _run_buffer_sync(mesh, bucketed):
+    reds = {"fbuf": "cat", "ibuf": "cat", "n": "sum"}
+
+    def body(n):
+        i = jax.lax.axis_index("data")
+        fbuf, ibuf = _device_buffers(i)
+        out = sync_state({"fbuf": fbuf, "ibuf": ibuf, "n": n[0]}, reds, "data", bucketed=bucketed)
+        flat = (
+            out["fbuf"].data, out["fbuf"].count, out["fbuf"].overflowed,
+            out["ibuf"].data, out["ibuf"].count, out["ibuf"].overflowed,
+            out["n"],
+        )
+        return jax.tree_util.tree_map(lambda x: jnp.expand_dims(x, 0), flat)
+
+    f = shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_rep=False)
+    return jax.jit(f)(jnp.ones((WORLD,), jnp.float32))
+
+
+def test_catbuffer_bitwise_parity_vs_gather(mesh):
+    """Bucketed CatBuffer sync (one stacked meta gather + one data gather per
+    dtype) must be bitwise-identical to per-buffer ``CatBuffer.gather``."""
+    out_b = _run_buffer_sync(mesh, bucketed=True)
+    out_p = _run_buffer_sync(mesh, bucketed=False)
+    for a, b in zip(out_b, out_p):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_catbuffer_sync_content(mesh):
+    """The synced buffer holds the device-order concatenation of every
+    device's valid prefix, at capacity WORLD * cap."""
+    data, count, overflowed = (np.asarray(x)[0] for x in _run_buffer_sync(mesh, bucketed=True)[:3])
+    expected = np.concatenate(
+        [(np.arange(_CAP, dtype=np.float32) + 100.0 * i)[: (i % 3) + 1] for i in range(WORLD)]
+    )
+    assert data.shape[0] == WORLD * _CAP
+    assert count == expected.shape[0]
+    assert not overflowed
+    np.testing.assert_array_equal(data[: count], expected)
+
+
+def test_catbuffer_collective_count():
+    """Buffers join the bucketed plan: 3 buffers cost 1 meta gather + 1 data
+    gather per item dtype instead of 3 collectives each."""
+    i0 = jnp.asarray(0, jnp.int32)
+    fbuf, ibuf = _device_buffers(i0)
+    fbuf2 = CatBuffer(jnp.ones((_CAP,), jnp.float32), 2)
+    state = {"fbuf": fbuf, "fbuf2": fbuf2, "ibuf": ibuf, "n": jnp.asarray(1.0)}
+    reds = {"fbuf": "cat", "fbuf2": "cat", "ibuf": "cat", "n": "sum"}
+    assert _trace_count(reds, state, bucketed=False) == 3 * 3 + 1
+    assert _trace_count(reds, state, bucketed=True) == 1 + 2 + 1  # meta + {f32,i32} + sum
+
+
+def test_unmaterialized_catbuffer_passthrough():
+    """An empty (never-appended) buffer has no item dtype/shape to gather —
+    it passes through both paths untouched and costs no collectives."""
+    state = {"buf": CatBuffer.empty(_CAP), "n": jnp.asarray(1.0)}
+    reds = {"buf": "cat", "n": "sum"}
+    for bucketed in (True, False):
+        assert _trace_count(reds, state, bucketed=bucketed) == 1
+
+    out = sync_state({"buf": CatBuffer.empty(_CAP)}, {"buf": "cat"}, None)
+    assert not out["buf"].materialized

@@ -1,0 +1,164 @@
+"""Fault injection against the checkpoint write protocol.
+
+The protocol under test: every file lands in ``step_X.pending/`` via
+mkstemp + fsync + rename; the COMMIT marker is written strictly last and the
+pending directory is atomically renamed into place. A reader therefore only
+ever sees (a) a fully committed snapshot or (b) nothing — and every
+corruption mode below must surface as a clean, typed error, never as a
+silently wrong restore.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MeanMetric
+from metrics_tpu.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointMismatchError,
+    CheckpointNotFoundError,
+    restore_checkpoint,
+    save_checkpoint,
+    verify_checkpoint,
+)
+from metrics_tpu.checkpoint import io as ckpt_io
+from metrics_tpu.checkpoint.format import build_shard
+
+
+def _batch(seed=0, n=32):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.uniform(0, 1, (n,)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, 2, (n,)).astype(np.int32)),
+    )
+
+
+def _committed_accuracy(root, seed=1):
+    m = Accuracy()
+    m.update(*_batch(seed=seed))
+    save_checkpoint(m, str(root))
+    return m
+
+
+def _step_dir(root):
+    step = ckpt_io.latest_step(str(root))
+    return step, os.path.join(str(root), ckpt_io.step_dir_name(step))
+
+
+# ------------------------------------------------- kill mid-write ------------
+def test_kill_before_commit_leaves_old_snapshot_intact(tmp_path):
+    m = _committed_accuracy(tmp_path, seed=1)
+    ref = m.compute()
+
+    # simulate preemption after the shard file landed but before commit:
+    # the shard is in the pending dir, no COMMIT, no rename
+    m.update(*_batch(seed=2))
+    payload, shard_meta = build_shard(m)
+    step2 = ckpt_io.next_step(str(tmp_path))
+    pending = ckpt_io.pending_dir(str(tmp_path), step2)
+    ckpt_io.write_shard(pending, 0, 2, payload, shard_meta)  # 1 of 2 shards: can't commit
+    assert not ckpt_io.try_commit(str(tmp_path), step2, 2)
+
+    # readers never see the aborted attempt
+    assert ckpt_io.available_steps(str(tmp_path)) == [0]
+    fresh = Accuracy()
+    restore_checkpoint(fresh, str(tmp_path), host_index=0, host_count=1)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(fresh.compute()))
+
+    # and the janitor reaps the orphan
+    removed = ckpt_io.clean_pending(str(tmp_path))
+    assert removed and not os.path.exists(pending)
+
+
+def test_commit_requires_every_shard(tmp_path):
+    m = Accuracy()
+    m.update(*_batch(seed=3))
+    payload, shard_meta = build_shard(m)
+    pending = ckpt_io.pending_dir(str(tmp_path), 0)
+    ckpt_io.write_shard(pending, 0, 2, payload, shard_meta)
+    assert not ckpt_io.try_commit(str(tmp_path), 0, 2)
+    ckpt_io.write_shard(pending, 1, 2, payload, shard_meta)
+    assert ckpt_io.try_commit(str(tmp_path), 0, 2)
+    assert ckpt_io.available_steps(str(tmp_path)) == [0]
+
+
+def test_uncommitted_dir_is_invisible(tmp_path):
+    # a step dir without a COMMIT marker (e.g. interrupted rename cleanup)
+    os.makedirs(tmp_path / "step_0000000000")
+    assert ckpt_io.available_steps(str(tmp_path)) == []
+    with pytest.raises(CheckpointNotFoundError):
+        restore_checkpoint(Accuracy(), str(tmp_path), host_index=0, host_count=1)
+
+
+# ---------------------------------------------------- corruption -------------
+def test_truncated_shard_raises_corrupt(tmp_path):
+    m = _committed_accuracy(tmp_path, seed=4)
+    step, step_dir = _step_dir(tmp_path)
+    npz = [f for f in os.listdir(step_dir) if f.endswith(".npz")][0]
+    path = os.path.join(step_dir, npz)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(CheckpointCorruptError, match="size|sha|bytes"):
+        restore_checkpoint(Accuracy(), str(tmp_path), host_index=0, host_count=1)
+    assert not verify_checkpoint(str(tmp_path)).ok
+
+
+def test_bitflipped_shard_raises_corrupt(tmp_path):
+    _committed_accuracy(tmp_path, seed=5)
+    step, step_dir = _step_dir(tmp_path)
+    npz = [f for f in os.listdir(step_dir) if f.endswith(".npz")][0]
+    path = os.path.join(step_dir, npz)
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0xFF  # same size, different content -> sha catches
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(CheckpointCorruptError, match="checksum"):
+        restore_checkpoint(Accuracy(), str(tmp_path), host_index=0, host_count=1)
+
+
+def test_tampered_manifest_raises(tmp_path):
+    _committed_accuracy(tmp_path, seed=6)
+    step, step_dir = _step_dir(tmp_path)
+    mpath = os.path.join(step_dir, "MANIFEST.json")
+    manifest = json.load(open(mpath))
+    manifest["world_size"] = 99
+    json.dump(manifest, open(mpath, "w"))
+    with pytest.raises(CheckpointCorruptError, match="MANIFEST"):
+        restore_checkpoint(Accuracy(), str(tmp_path), host_index=0, host_count=1)
+
+
+def test_future_format_version_refused(tmp_path):
+    _committed_accuracy(tmp_path, seed=7)
+    step, step_dir = _step_dir(tmp_path)
+    cpath = os.path.join(step_dir, "COMMIT")
+    commit = json.load(open(cpath))
+    commit["format_version"] = 999
+    json.dump(commit, open(cpath, "w"))
+    with pytest.raises(CheckpointMismatchError, match="format version"):
+        restore_checkpoint(Accuracy(), str(tmp_path), host_index=0, host_count=1)
+
+
+# ------------------------------------------------------- refusals ------------
+def test_wrong_class_refused_before_state_touched(tmp_path):
+    _committed_accuracy(tmp_path, seed=8)
+    other = MeanMetric()
+    other.update(jnp.asarray(41.0))
+    with pytest.raises(CheckpointMismatchError, match="class"):
+        restore_checkpoint(other, str(tmp_path), host_index=0, host_count=1)
+    # refusal happened before any state was replaced
+    np.testing.assert_allclose(np.asarray(other.compute()), 41.0)
+
+
+def test_verify_payload_false_skips_checksums(tmp_path):
+    m = _committed_accuracy(tmp_path, seed=9)
+    step, step_dir = _step_dir(tmp_path)
+    npz = [f for f in os.listdir(step_dir) if f.endswith(".npz")][0]
+    path = os.path.join(step_dir, npz)
+    data = bytearray(open(path, "rb").read())
+    data[len(data) - 1] ^= 0x01
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(CheckpointCorruptError):
+        restore_checkpoint(Accuracy(), str(tmp_path), host_index=0, host_count=1, verify_payload=True)

@@ -1,0 +1,189 @@
+"""Reshard-on-restore: an N-host checkpoint folds onto M hosts.
+
+Host i of M claims shards {i, i+M, i+2M, ...} and folds them with each
+leaf's recorded reduction via the metric's own ``merge_states`` — so a folded
+restore is bitwise-identical to having accumulated on fewer hosts from the
+start, for every mergeable reduction. Multi-host saves are simulated by
+writing each shard from its own metric instance with explicit
+``shard_index``/``world_size``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import AUROC, Accuracy, MeanMetric
+from metrics_tpu.checkpoint import (
+    CheckpointMismatchError,
+    assign_shards,
+    merge_shards,
+    restore_checkpoint,
+    save_checkpoint,
+    verify_checkpoint,
+)
+from metrics_tpu.core.metric import Metric
+
+N = 8  # hosts that wrote the checkpoint
+
+
+def _host_batch(i, n=16):
+    rng = np.random.default_rng(1000 + i)
+    return (
+        jnp.asarray(rng.uniform(0, 1, (n,)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, 2, (n,)).astype(np.int32)),
+    )
+
+
+def _save_world(make, root, world=N, updates_for=lambda i: 1):
+    """One metric instance per simulated host; every shard into one step."""
+    metrics = []
+    for i in range(world):
+        m = make()
+        for u in range(updates_for(i)):
+            m.update(*_host_batch(i * 100 + u))
+        metrics.append(m)
+        save_checkpoint(m, root, step=0, shard_index=i, world_size=world)
+    return metrics
+
+
+def _reference(make, world=N, updates_for=lambda i: 1):
+    """The 'always ran on one host' ground truth: same batches, one metric."""
+    ref = make()
+    for i in range(world):
+        for u in range(updates_for(i)):
+            ref.update(*_host_batch(i * 100 + u))
+    return ref
+
+
+def test_assign_shards_round_robin():
+    assert assign_shards(8, 0, 4) == (0, 4)
+    assert assign_shards(8, 3, 4) == (3, 7)
+    assert assign_shards(8, 0, 1) == tuple(range(8))
+    assert assign_shards(2, 5, 8) == ()  # more hosts than shards
+    with pytest.raises(Exception):
+        assign_shards(8, 4, 4)
+
+
+@pytest.mark.parametrize("m_hosts", [1, 4])
+def test_accuracy_folds_bitwise(tmp_path, m_hosts):
+    _save_world(Accuracy, str(tmp_path))
+    ref = _reference(Accuracy)
+
+    # fold every host's restored state into one ground-truth comparison
+    total_state, total_count = None, 0
+    carrier = Accuracy()
+    for host in range(m_hosts):
+        m = Accuracy()
+        info = restore_checkpoint(m, str(tmp_path), host_index=host, host_count=m_hosts)
+        assert info.shards_loaded == assign_shards(N, host, m_hosts)
+        if total_state is None:
+            total_state, total_count = m.get_state(), m._update_count
+        else:
+            total_state = carrier.merge_states(total_state, m.get_state(), (total_count, m._update_count))
+            total_count += m._update_count
+    carrier.set_state(total_state)
+    carrier.mode = ref.mode
+    carrier._update_count = total_count
+    np.testing.assert_array_equal(np.asarray(ref.compute()), np.asarray(carrier.compute()))
+    assert total_count == ref._update_count
+
+
+def test_catbuffer_folds_bitwise(tmp_path):
+    make = lambda: AUROC(buffer_capacity=512)
+    _save_world(make, str(tmp_path))
+    ref = _reference(make)
+
+    m = make()
+    restore_checkpoint(m, str(tmp_path), host_index=0, host_count=1)
+    np.testing.assert_array_equal(np.asarray(ref.compute()), np.asarray(m.compute()))
+
+
+def test_mean_fold_recomputed_from_counts(tmp_path):
+    # uneven update counts per shard: mean must be count-weighted, not averaged
+    make = MeanMetric
+    updates = lambda i: i + 1
+    metrics = []
+    for i in range(4):
+        m = make()
+        for u in range(updates(i)):
+            m.update(jnp.asarray(float(10 * i + u)))
+        metrics.append(m)
+        save_checkpoint(m, str(tmp_path), step=0, shard_index=i, world_size=4)
+    ref = make()
+    for i in range(4):
+        for u in range(updates(i)):
+            ref.update(jnp.asarray(float(10 * i + u)))
+
+    folded = make()
+    restore_checkpoint(folded, str(tmp_path), host_index=0, host_count=1)
+    np.testing.assert_allclose(np.asarray(folded.compute()), np.asarray(ref.compute()), rtol=1e-6)
+
+
+def test_more_hosts_than_shards_get_defaults(tmp_path):
+    _save_world(Accuracy, str(tmp_path), world=2)
+    m = Accuracy()
+    info = restore_checkpoint(m, str(tmp_path), host_index=5, host_count=8)
+    assert info.shards_loaded == ()
+    assert m._update_count == 0
+    for val in m.get_state().values():
+        np.testing.assert_array_equal(np.asarray(val), 0)
+
+
+def test_preemption_cycle_save_kill_restore_continue(tmp_path):
+    """The headline flow: train on 8 hosts, snapshot, lose the job, resume on
+    1 host, keep training — identical to never having been preempted."""
+    metrics = _save_world(Accuracy, str(tmp_path))
+    ref = _reference(Accuracy)
+    del metrics  # the 'kill'
+
+    resumed = Accuracy()
+    restore_checkpoint(resumed, str(tmp_path), host_index=0, host_count=1)
+    extra = _host_batch(999)
+    resumed.update(*extra)
+    ref.update(*extra)
+    np.testing.assert_array_equal(np.asarray(ref.compute()), np.asarray(resumed.compute()))
+    assert resumed._update_count == ref._update_count
+
+
+def test_offline_merge_matches_live_fold(tmp_path):
+    _save_world(Accuracy, str(tmp_path / "in"))
+    ref = _reference(Accuracy)
+    merge_shards(str(tmp_path / "in"), str(tmp_path / "out"))
+    assert verify_checkpoint(str(tmp_path / "out")).ok
+
+    m = Accuracy()
+    restore_checkpoint(m, str(tmp_path / "out"), host_index=0, host_count=1)
+    np.testing.assert_array_equal(np.asarray(ref.compute()), np.asarray(m.compute()))
+    assert m._update_count == ref._update_count
+
+
+# ------------------------------------------------------ unmergeable ----------
+class _CallableReduce(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("acc", default=jnp.asarray(0.0), dist_reduce_fx=lambda stacked: jnp.sum(stacked, axis=0))
+
+    def update(self, x):
+        self.acc = self.acc + jnp.sum(x)
+
+    def compute(self):
+        return self.acc
+
+
+def test_callable_reduction_refuses_fold_but_allows_same_world(tmp_path):
+    for i in range(2):
+        m = _CallableReduce()
+        m.update(jnp.asarray([float(i + 1)]))
+        save_checkpoint(m, str(tmp_path), step=0, shard_index=i, world_size=2)
+
+    # N == M: each host takes its own shard untouched — fine
+    m = _CallableReduce()
+    restore_checkpoint(m, str(tmp_path), host_index=1, host_count=2)
+    np.testing.assert_allclose(np.asarray(m.compute()), 2.0)
+
+    # N != M would have to fold with unknowable semantics — refused
+    with pytest.raises(CheckpointMismatchError, match="folded|reduction"):
+        restore_checkpoint(_CallableReduce(), str(tmp_path), host_index=0, host_count=1)

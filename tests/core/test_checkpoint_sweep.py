@@ -1,0 +1,159 @@
+"""Checkpoint / state-dict roundtrip parity sweep over every exported metric.
+
+Driven by the same declarative ``ANALYSIS_SPECS`` tables the static analyzer
+uses (``metrics_tpu.analysis.registry``), extended with the ``"ckpt"`` key:
+concrete inputs where synthesized ``(dtype, shape)`` arrays would be invalid
+(strings, box dicts, monotonic x), ``int_high`` bounds for label inputs, and
+explicit skips with reasons (host DSP, network-weight models).
+
+Two assertions per metric:
+
+* ``state_dict`` -> fresh instance -> ``load_state_dict`` reproduces the
+  registered states exactly. (State only: update-determined python config
+  like ``Accuracy.mode`` is deliberately outside ``state_dict`` — that is
+  what the checkpoint's aux channel exists for.)
+* ``save_checkpoint`` -> fresh instance -> ``restore_checkpoint`` reproduces
+  states, update counts, *and* ``compute()`` output, including for wrappers
+  whose state lives in child metrics.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+import pytest
+
+from metrics_tpu.analysis.registry import Entry, build_registry
+from metrics_tpu.checkpoint import restore_checkpoint, save_checkpoint
+from metrics_tpu.core.buffers import CatBuffer
+
+
+def _sweepable(entry: Entry) -> bool:
+    if entry.spec is None or entry.ckpt.get("skip"):
+        return False
+    if entry.spec.get("no_probe") and "init_fn" not in entry.ckpt:
+        return False
+    return True
+
+
+_ENTRIES: Dict[str, Entry] = {e.name: e for e in build_registry()}
+_SWEEP = sorted(name for name, e in _ENTRIES.items() if _sweepable(e))
+
+
+def _make(entry: Entry) -> Any:
+    if "init_fn" in entry.ckpt:
+        return entry.ckpt["init_fn"]()
+    init_fn = entry.spec.get("init_fn")
+    if init_fn is not None:
+        return init_fn()
+    return entry.cls(**entry.spec.get("init", {}))
+
+
+def _synth_inputs(entry: Entry, seed: int) -> Tuple[Tuple[Any, ...], Dict[str, Any]]:
+    if "inputs_fn" in entry.ckpt:
+        return entry.ckpt["inputs_fn"]()
+    inputs = entry.spec.get("inputs")
+    if not inputs:
+        pytest.fail(
+            f"{entry.name}: no 'inputs' spec and no ckpt inputs_fn/skip — every "
+            "exported metric must declare checkpoint-sweep coverage"
+        )
+    rng = np.random.default_rng(seed)
+    int_high = int(entry.ckpt.get("int_high", 2))
+    args: List[Any] = []
+    for dtype, shape in inputs:
+        if np.issubdtype(np.dtype(dtype), np.integer):
+            args.append(rng.integers(0, int_high, shape).astype(dtype))
+        else:
+            args.append(rng.uniform(0.0, 1.0, shape).astype(dtype))
+    return tuple(args), dict(entry.spec.get("static_kwargs", {}))
+
+
+def _feed(metric: Any, entry: Entry) -> None:
+    n_updates = int(entry.ckpt.get("updates", 2))
+    for i in range(n_updates):
+        args, kwargs = _synth_inputs(entry, seed=100 + i)
+        metric.update(*args, **kwargs)
+
+
+def _assert_leaf_equal(va: Any, vb: Any, where: str) -> None:
+    if isinstance(va, CatBuffer):
+        assert isinstance(vb, CatBuffer), where
+        empty_a = not va.materialized or int(va.count) == 0
+        empty_b = not vb.materialized or int(vb.count) == 0
+        if empty_a or empty_b:
+            assert empty_a == empty_b, where
+            return
+        np.testing.assert_array_equal(
+            np.asarray(va.to_array()), np.asarray(vb.to_array()), err_msg=where
+        )
+    elif isinstance(va, (list, tuple)):
+        assert isinstance(vb, (list, tuple)) and len(va) == len(vb), where
+        for i, (xa, xb) in enumerate(zip(va, vb)):
+            np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb), err_msg=f"{where}[{i}]")
+    else:
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb), err_msg=where)
+
+
+def _assert_state_equal(ma: Any, mb: Any, ctx: str) -> None:
+    sa, sb = ma.get_state(), mb.get_state()
+    assert set(sa) == set(sb), ctx
+    for key in sa:
+        _assert_leaf_equal(sa[key], sb[key], f"{ctx}:{key}")
+
+
+def _assert_compute_equal(ra: Any, rb: Any, ctx: str) -> None:
+    import jax
+
+    la, ta = jax.tree_util.tree_flatten(ra)
+    lb, tb = jax.tree_util.tree_flatten(rb)
+    assert ta == tb, f"{ctx}: compute tree structure differs"
+    for i, (xa, xb) in enumerate(zip(la, lb)):
+        np.testing.assert_allclose(
+            np.asarray(xa), np.asarray(xb), rtol=1e-6, atol=1e-6, err_msg=f"{ctx}:leaf{i}"
+        )
+
+
+@pytest.mark.parametrize("name", _SWEEP)
+def test_state_dict_roundtrip(name: str) -> None:
+    entry = _ENTRIES[name]
+    m1 = _make(entry)
+    m1.persistent(True)  # states default to persistent=False (reference parity)
+    _feed(m1, entry)
+    m2 = _make(entry)
+    m2.persistent(True)
+    m2.load_state_dict(m1.state_dict())
+    _assert_state_equal(m1, m2, f"{name}:state_dict")
+
+
+@pytest.mark.parametrize("name", _SWEEP)
+def test_checkpoint_roundtrip(name: str, tmp_path) -> None:
+    entry = _ENTRIES[name]
+    m1 = _make(entry)
+    _feed(m1, entry)
+    handle = save_checkpoint(m1, str(tmp_path), shard_index=0, world_size=1)
+    assert handle.committed
+
+    m2 = _make(entry)
+    restore_checkpoint(m2, str(tmp_path), host_index=0, host_count=1)
+    _assert_state_equal(m1, m2, f"{name}:checkpoint")
+    assert m1._update_count == m2._update_count, name
+    _assert_compute_equal(m1.compute(), m2.compute(), name)
+
+
+def test_every_export_declares_sweep_coverage() -> None:
+    """The merge gate: a metric is either swept or carries an explicit reason."""
+    for name, entry in _ENTRIES.items():
+        if name in _SWEEP:
+            continue
+        assert (
+            entry.spec is not None
+            and (entry.ckpt.get("skip") or entry.spec.get("no_probe"))
+        ), f"{name} is neither swept nor explicitly ckpt-skipped"
+
+
+def test_skips_carry_reasons() -> None:
+    for name, entry in _ENTRIES.items():
+        skip = entry.ckpt.get("skip")
+        if skip is not None:
+            assert isinstance(skip, str) and len(skip) > 10, name
