@@ -1435,6 +1435,154 @@ def bench_checkpoint() -> None:
     print(json.dumps(record), flush=True)
 
 
+def _sharded_state_child() -> None:
+    """``--child sharded_state``: per-device state bytes + trace-time
+    collective bytes for replicated vs sharded placement at one mesh width
+    (``BENCH_SHARD_WORLD``, device count forced by the parent's XLA_FLAGS).
+
+    Two configs: the config2 collection (micro Accuracy scalar states stay
+    replicated, macro F1/Precision/Recall per-class vectors shard) and a
+    4096-class ConfusionMatrix ((4096, 4096) int32 — the state that motivates
+    sharding: 64 MiB per device replicated, 1/width sharded)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, ConfusionMatrix, F1Score, MetricCollection, Precision, Recall
+    from metrics_tpu.parallel import count_collectives, make_mesh
+    from metrics_tpu.parallel.sync import sync_state as _canonical_sync
+
+    world = int(os.environ.get("BENCH_SHARD_WORLD", "8"))
+    devices = jax.devices()
+    if len(devices) < world:
+        raise RuntimeError(f"expected {world} forced host devices, got {len(devices)}")
+    mesh = make_mesh([world], ["data"], devices[:world])
+
+    rng = np.random.default_rng(0)
+
+    def state_bytes(metrics) -> dict:
+        """Total per-device and global bytes across all registered leaves."""
+        per_dev = glob = 0
+        for m in metrics:
+            for leaf in jax.tree_util.tree_leaves(m.metric_state):
+                n = int(leaf.nbytes)
+                glob += n
+                shards = getattr(leaf, "addressable_shards", None)
+                per_dev += int(shards[0].data.nbytes) if shards else n
+        return {"per_device_bytes": per_dev, "global_bytes": glob}
+
+    def sync_bytes(metric_like, members) -> dict:
+        """Trace-time collective bytes-by-kind for the live sync routing."""
+        out: dict = {}
+        with count_collectives() as box:
+            for m in members:
+                state = {k: v for k, v in m.metric_state.items()}
+                jax.make_jaxpr(
+                    lambda s, m=m: _canonical_sync(
+                        s, dict(m._reductions), "data", shard_axes=m.active_shard_axes
+                    ),
+                    axis_env=[("data", world)],
+                )(state)
+        out["collectives_by_kind"] = dict(box["by_kind"])
+        out["bytes_by_kind"] = dict(box["bytes_by_kind"])
+        return out
+
+    def run_config(build, update_args, n_steps=4):
+        # replicated baseline
+        base = build()
+        for a in update_args[:n_steps]:
+            base.update(*a)
+        expect = jax.tree_util.tree_map(np.asarray, base.compute())
+        base_members = [base] if not isinstance(base, MetricCollection) else list(base.values())
+
+        # sharded run over the same data
+        shard = build().shard_state(mesh)
+        for a in update_args[:n_steps]:
+            shard.update(*a)
+        got = jax.tree_util.tree_map(np.asarray, shard.compute())
+        if isinstance(shard, MetricCollection):
+            shard._realias_members()
+        shard_members = [shard] if not isinstance(shard, MetricCollection) else list(shard.values())
+
+        equal = all(
+            np.array_equal(a, b)
+            for a, b in zip(jax.tree_util.tree_leaves(expect), jax.tree_util.tree_leaves(got))
+        )
+        rec = {
+            "world": world,
+            "bitwise_equal_vs_replicated": bool(equal),
+            "replicated": {**state_bytes(base_members), **sync_bytes(base, base_members)},
+            "sharded": {**state_bytes(shard_members), **sync_bytes(shard, shard_members)},
+        }
+        return rec
+
+    def build_config2():
+        return MetricCollection(
+            {
+                "acc": Accuracy(num_classes=NUM_CLASSES, average="micro"),
+                "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+                "precision": Precision(num_classes=NUM_CLASSES, average="macro"),
+                "recall": Recall(num_classes=NUM_CLASSES, average="macro"),
+            }
+        )
+
+    logits = jnp.asarray(rng.normal(size=(BATCH, NUM_CLASSES)), dtype=jnp.float32)
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=jnp.int32)
+    config2 = run_config(build_config2, [(logits, target)] * 4)
+
+    c = 4096
+    cm_preds = jnp.asarray(rng.integers(0, c, size=(8192,)), dtype=jnp.int32)
+    cm_target = jnp.asarray(rng.integers(0, c, size=(8192,)), dtype=jnp.int32)
+    confusion = run_config(lambda: ConfusionMatrix(num_classes=c), [(cm_preds, cm_target)] * 4)
+
+    print(json.dumps({"world": world, "config2": config2, "confusion_4096": confusion}), flush=True)
+
+
+def bench_sharded_state() -> None:
+    """``--sharded-state``: replicated-vs-sharded state footprint and
+    collective bytes across mesh widths 1/4/8, recorded into
+    ``BENCH_r11.json``. Host-side CPU bench (forced device counts)."""
+    widths = (1, 4, 8)
+    out = {}
+    for w in widths:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_SHARD_WORLD"] = str(w)
+        env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={w}"
+        child = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", "sharded_state"],
+            capture_output=True,
+            text=True,
+            env=env,
+            timeout=1500.0,
+            cwd=REPO,
+        )
+        if child.returncode != 0:
+            raise RuntimeError(f"sharded-state child (world={w}) failed:\n{child.stderr[-2000:]}")
+        out[f"world_{w}"] = json.loads(child.stdout.strip().splitlines()[-1])
+
+    w8 = out["world_8"]["confusion_4096"]
+    record = {
+        # headline: the per-device bytes of the 4096-class confusion matrix at
+        # width 8 — lower is better, replicated baseline in extra
+        "metric": "sharded_confmat4096_per_device_bytes",
+        "value": w8["sharded"]["per_device_bytes"],
+        "unit": "bytes",
+        "extra": {
+            "replicated_per_device_bytes": w8["replicated"]["per_device_bytes"],
+            "bitwise_equal_vs_replicated": w8["bitwise_equal_vs_replicated"],
+            "sharded_bytes_by_kind": w8["sharded"]["bytes_by_kind"],
+            "replicated_bytes_by_kind": w8["replicated"]["bytes_by_kind"],
+            "widths": out,
+        },
+    }
+    with open(os.path.join(REPO, "BENCH_r11.json"), "w") as fh:
+        json.dump(record, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(record), flush=True)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
@@ -1449,7 +1597,13 @@ def main() -> None:
         help="time config2 snapshot save/restore plus an 8-shard merge and "
         "record into BENCH_r10.json",
     )
-    parser.add_argument("--child", choices=["sync_overhead", *_CHILD_BENCHES])
+    parser.add_argument(
+        "--sharded-state",
+        action="store_true",
+        help="measure replicated-vs-sharded per-device state bytes and "
+        "collective bytes at mesh widths 1/4/8 and record into BENCH_r11.json",
+    )
+    parser.add_argument("--child", choices=["sync_overhead", "sharded_state", *_CHILD_BENCHES])
     parser.add_argument(
         "--sync-scaling",
         action="store_true",
@@ -1470,6 +1624,9 @@ def main() -> None:
     if args.checkpoint:
         bench_checkpoint()
         return
+    if args.sharded_state:
+        bench_sharded_state()
+        return
     if args.sync_scaling:
         out = {}
         for w in (2, 4, 8, 16):
@@ -1483,6 +1640,9 @@ def main() -> None:
         return
     if args.child == "sync_overhead":
         _sync_overhead_child()
+        return
+    if args.child == "sharded_state":
+        _sharded_state_child()
         return
     if args.child in _CHILD_BENCHES:
         import jax

@@ -48,7 +48,11 @@ class BaseAggregator(Metric):
                 f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy} but got {nan_strategy}."
             )
         self.nan_strategy = nan_strategy
-        self.add_state("value", default=default_value, dist_reduce_fx=fn)
+        # list-valued aggregators (CatMetric) promote to a CatBuffer under
+        # buffer_capacity, which is shardable along the sample axis; dense
+        # running aggregates (sum/mean/max/min scalars) stay replicated
+        shard_axis = 0 if isinstance(default_value, list) and self.buffer_capacity is not None else None
+        self.add_state("value", default=default_value, dist_reduce_fx=fn, shard_axis=shard_axis)
 
     def _cast_and_nan_check_input(self, x: Union[float, Array], weight: Union[float, Array, None] = None) -> Tuple[Array, Array]:
         """Cast to float and apply the NaN strategy via masking.

@@ -12,6 +12,11 @@ traces its pure protocol *without running a single FLOP*:
   :func:`metrics_tpu.parallel.sync.count_collectives`. The budget is what the
   canonical bucketed ``sync_state`` emits for the same state pytree: a custom
   sync override that spends more network phases than the default is an error.
+* a **sharded leg** (E108) for every metric declaring ``shard_axis`` states:
+  shard routing is activated abstractly (no device placement) and the
+  metric's ``sync_states`` must not route more psum/all_gather *bytes* than
+  the canonical sharded ``sync_state`` — a sync override that reduces a
+  sharded leaf's disjoint blocks as if replicated is numerically wrong.
 """
 from __future__ import annotations
 
@@ -83,6 +88,104 @@ def instantiate(entry: Entry) -> Optional[Finding]:
             message=f"constructing from ANALYSIS_SPECS failed: {entry.init_error}",
         )
     return None
+
+
+def _evaluate_sharded(entry: Entry, inst: Any, state: Any) -> List[Finding]:
+    """The E108 leg: sharded-state sync routing for ``shard_axis`` declarers.
+
+    Activates shard routing *abstractly* (``_state_sharding`` is flipped to a
+    sentinel; no device placement happens — everything stays make_jaxpr under
+    the mock mesh) and asserts the metric's own ``sync_states`` spends no more
+    psum/all_gather bytes than the canonical sharded ``sync_state``. A sync
+    override that ignores ``active_shard_axes`` psums the disjoint per-device
+    blocks of a sharded leaf — numerically wrong, not just wasteful — and
+    shows up here as replicating-collective bytes above the canonical budget.
+    """
+    findings: List[Finding] = []
+    declared = entry.sharded
+    if not declared:
+        return findings
+    live = dict(inst.shard_axes)
+    if declared != live:
+        findings.append(
+            Finding(
+                rule="E108",
+                obj=entry.name,
+                message=f"ANALYSIS_SPECS promises sharded={declared} but the instance "
+                f"declares {live} — the spec and add_state(shard_axis=...) drifted",
+            )
+        )
+        return findings
+
+    canon_error: Optional[str] = None
+    with _sync.count_collectives() as canon:
+        try:
+            jax.make_jaxpr(
+                lambda s: _sync.sync_state(s, dict(inst._reductions), AXIS, shard_axes=live),
+                axis_env=[(AXIS, WORLD)],
+            )(dict(state) if isinstance(state, dict) else state)
+        except Exception as e:  # noqa: BLE001
+            canon_error = _err(e)
+            entry.notes.append(f"canonical sharded sync_state trace failed: {canon_error}")
+
+    prior = inst._state_sharding
+    inst._state_sharding = ("__analysis__", AXIS)
+    try:
+        with _sync.count_collectives() as box:
+            jax.make_jaxpr(
+                lambda s: inst.sync_states(s, AXIS), axis_env=[(AXIS, WORLD)]
+            )(state)
+    except Exception as e:  # noqa: BLE001
+        findings.append(
+            Finding(
+                rule="E108",
+                obj=entry.name,
+                message=f"sync_states failed to trace with sharded state active under the "
+                f"mock {WORLD}-device mesh: {_err(e)}",
+            )
+        )
+        return findings
+    finally:
+        inst._state_sharding = prior
+
+    entry.notes.append(
+        f"sharded sync: by_kind {box['by_kind']}, bytes_by_kind {box['bytes_by_kind']} "
+        f"(canonical {canon['bytes_by_kind']})"
+    )
+    if canon_error is not None:
+        # no budget to compare against — every byte would read as an overrun
+        findings.append(
+            Finding(
+                rule="E108",
+                obj=entry.name,
+                message="canonical sharded sync_state failed to trace, so the metric's "
+                "sync_states collective bytes cannot be validated against a budget: "
+                f"{canon_error}",
+            )
+        )
+        return findings
+    for kind, nbytes in box["bytes_by_kind"].items():
+        if kind == "reshard":
+            continue
+        if nbytes > canon["bytes_by_kind"].get(kind, 0):
+            findings.append(
+                Finding(
+                    rule="E108",
+                    obj=entry.name,
+                    message=f"with sharded state active, sync_states routes {nbytes} bytes "
+                    f"through {kind} vs {canon['bytes_by_kind'].get(kind, 0)} in the canonical "
+                    f"sharded sync — a shard_axis leaf's disjoint blocks are being reduced "
+                    "as if replicated",
+                    extra={
+                        "kind": kind,
+                        "bytes": int(nbytes),
+                        "budget_bytes": int(canon["bytes_by_kind"].get(kind, 0)),
+                        "by_kind": dict(box["by_kind"]),
+                        "bytes_by_kind": dict(box["bytes_by_kind"]),
+                    },
+                )
+            )
+    return findings
 
 
 def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Finding]:
@@ -219,7 +322,10 @@ def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Findi
             )
             sync_shape = None
     actual = box["count"]
-    entry.notes.append(f"collectives: {actual} (budget {allowed}, by_kind {box['by_kind']})")
+    entry.notes.append(
+        f"collectives: {actual} (budget {allowed}, by_kind {box['by_kind']}, "
+        f"bytes_by_kind {box['bytes_by_kind']})"
+    )
 
     if sync_shape is not None:
         ts_in, ts_out = jax.tree_util.tree_structure(state), jax.tree_util.tree_structure(sync_shape)
@@ -251,8 +357,13 @@ def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Findi
                     obj=entry.name,
                     message=f"sync_states emits {actual} collectives on the mock {WORLD}-device "
                     f"mesh; budget is {allowed} (canonical bucketed sync_state for the same "
-                    f"state pytree); by_kind={box['by_kind']}",
-                    extra={"collectives": actual, "budget": allowed, "by_kind": dict(box["by_kind"])},
+                    f"state pytree); by_kind={box['by_kind']} bytes_by_kind={box['bytes_by_kind']}",
+                    extra={
+                        "collectives": actual,
+                        "budget": allowed,
+                        "by_kind": dict(box["by_kind"]),
+                        "bytes_by_kind": dict(box["bytes_by_kind"]),
+                    },
                 )
             )
 
@@ -270,6 +381,9 @@ def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Findi
                 f"{_err(e)} — the compiled compute engine will run this metric eagerly",
             )
         )
+
+    # ---------------------------------------------------------- sharded leg --
+    findings.extend(_evaluate_sharded(entry, inst, state))
 
     for f in findings:
         if f.rule in entry.allow:

@@ -96,6 +96,21 @@ class Entry:
     def ckpt(self) -> Dict[str, Any]:
         return (self.spec or {}).get("ckpt", {})
 
+    @property
+    def sharded(self) -> Dict[str, int]:
+        """Declared ``shard_axis`` per state name.
+
+        The spec's ``"sharded"`` key is the *expectation* (what the domain
+        package promises); absent a spec key, the live instance's
+        declarations are reported. The eval stage's E108 leg runs whenever
+        this is non-empty."""
+        declared = (self.spec or {}).get("sharded")
+        if declared is not None:
+            return dict(declared)
+        if self.instance is not None:
+            return dict(self.instance.shard_axes)
+        return {}
+
 
 def collect_specs() -> Dict[str, Dict[str, Any]]:
     specs: Dict[str, Dict[str, Any]] = {}

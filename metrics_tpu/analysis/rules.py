@@ -143,6 +143,14 @@ _EVAL_RULES = (
         "readbacks) — the compiled compute engine will fall back to eager "
         "for this metric.",
     ),
+    Rule(
+        "E108", "sharded-sync-routing", ERROR,
+        "with sharded state active, sync_states either failed to trace or "
+        "routed more psum/all_gather bytes than the canonical sharded "
+        "sync_state for the same state — a shard_axis-declared leaf is being "
+        "reduced as if replicated, which double-counts (psum) or misorders "
+        "(gather) the disjoint per-device blocks.",
+    ),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in (*_AST_RULES, *_EVAL_RULES)}

@@ -28,6 +28,11 @@ Design points:
   signature, buffer capacity, engine-relevant config) is compared against the
   live object *before any state is touched*; a mismatch produces a refusal
   with a line-by-line diff, never a half-restored metric.
+- **Mesh-sharded leaves are persisted placement-free.** ``np.asarray`` on a
+  :func:`~metrics_tpu.Metric.shard_state`-placed leaf gathers the global
+  value, so the payload is independent of the writing mesh's width; the
+  declared ``shard_axis`` rides along in the leaf metadata (and fingerprint)
+  and restore re-places leaves onto whatever mesh the live metric holds.
 """
 from __future__ import annotations
 
@@ -126,6 +131,7 @@ def metric_leaves(metric: Metric, prefix: str) -> Tuple[Dict[str, np.ndarray], D
         val = state[name]
         tag = reduction_tag(metric._reductions[name])
         key = prefix + name
+        shard_axis = metric._shard_axes.get(name)
         if isinstance(val, CatBuffer):
             entry: Dict[str, Any] = {
                 "kind": "catbuffer",
@@ -134,6 +140,8 @@ def metric_leaves(metric: Metric, prefix: str) -> Tuple[Dict[str, np.ndarray], D
                 "count": int(val.count) if val.materialized else 0,
                 "materialized": bool(val.materialized),
             }
+            if shard_axis is not None:
+                entry["shard_axis"] = int(shard_axis)
             if val.materialized:
                 arr = np.asarray(val.to_array())  # raises loudly on overflow
                 payload[key] = arr
@@ -151,6 +159,8 @@ def metric_leaves(metric: Metric, prefix: str) -> Tuple[Dict[str, np.ndarray], D
             for i, a in enumerate(arrs):
                 payload[f"{key}.{i}"] = a
         else:
+            # np.asarray on a mesh-sharded leaf gathers the global value: the
+            # on-disk layout is placement-free and restores onto any mesh width
             arr = np.asarray(val)
             payload[key] = arr
             meta[name] = {
@@ -159,6 +169,8 @@ def metric_leaves(metric: Metric, prefix: str) -> Tuple[Dict[str, np.ndarray], D
                 "dtype": str(arr.dtype),
                 "shape": [int(s) for s in arr.shape],
             }
+            if shard_axis is not None:
+                meta[name]["shard_axis"] = int(shard_axis)
     return payload, meta
 
 
@@ -198,6 +210,12 @@ def metric_fingerprint(metric: Metric) -> Dict[str, Any]:
                 "shape": [int(s) for s in arr.shape],
                 "dtype": str(arr.dtype),
             }
+        # the declared shard axis is part of the state's static identity;
+        # fingerprint_diff treats a missing key as compatible with any
+        # declaration, so checkpoints written before a class gained (or after
+        # it lost) the declaration stay restorable
+        if metric._shard_axes.get(name) is not None:
+            states[name]["shard_axis"] = int(metric._shard_axes[name])
     sig = metric._update_signature()
     return {
         "class": type(metric).__name__,
@@ -224,6 +242,12 @@ def fingerprint_diff(saved: Dict[str, Any], live: Dict[str, Any], path: str = ""
     if isinstance(saved, dict) and isinstance(live, dict):
         for key in sorted(set(saved) | set(live)):
             sub = f"{path}.{key}" if path else str(key)
+            if key == "shard_axis" and (key not in saved or key not in live):
+                # a shard_axis declaration is placement-inert — the payload is
+                # host-side and placement-free either way — so checkpoints
+                # written before/after a class gained the declaration stay
+                # restorable; only two *conflicting* declarations diff
+                continue
             if key not in saved:
                 lines.append(f"{sub}: only in live object ({live[key]!r})")
             elif key not in live:

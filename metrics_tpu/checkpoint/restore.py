@@ -213,6 +213,11 @@ def restore_checkpoint(
     for key, metric in members.items():
         state, count = folded[key]
         metric.set_state(state)
+        if metric._state_sharding is not None:
+            # folded leaves are host/global values: restore the sharded mesh
+            # placement so the round-trip keeps the 1/width device footprint
+            for name in metric._shard_axes:
+                setattr(metric, name, metric._place_sharded_value(name, getattr(metric, name)))
         if loaded:
             # update-determined python config (Accuracy.mode, ...); identical
             # across shards (the committer pinned the fingerprints equal and

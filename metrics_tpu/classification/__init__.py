@@ -85,9 +85,9 @@ ANALYSIS_SPECS = {
     "PrecisionRecallCurve": {"init": {"buffer_capacity": 64}, "inputs": _BINARY},
     "ROC": {"init": {"buffer_capacity": 64}, "inputs": _BINARY},
     "CohenKappa": {"init": {"num_classes": 4}, "inputs": _LABELS4, "ckpt": _CKPT4},
-    "ConfusionMatrix": {"init": {"num_classes": 4}, "inputs": _LABELS4, "ckpt": _CKPT4},
-    "JaccardIndex": {"init": {"num_classes": 4}, "inputs": _LABELS4, "ckpt": _CKPT4},
-    "MatthewsCorrCoef": {"init": {"num_classes": 4}, "inputs": _LABELS4, "ckpt": _CKPT4},
+    "ConfusionMatrix": {"init": {"num_classes": 4}, "inputs": _LABELS4, "ckpt": _CKPT4, "sharded": {"confmat": 0}},
+    "JaccardIndex": {"init": {"num_classes": 4}, "inputs": _LABELS4, "ckpt": _CKPT4, "sharded": {"confmat": 0}},
+    "MatthewsCorrCoef": {"init": {"num_classes": 4}, "inputs": _LABELS4, "ckpt": _CKPT4, "sharded": {"confmat": 0}},
     "KLDivergence": {
         "inputs": [("float32", (8, 5)), ("float32", (8, 5))],
         "ckpt": {"inputs_fn": _ckpt_kld_inputs},
@@ -98,13 +98,16 @@ ANALYSIS_SPECS = {
     "BinnedAveragePrecision": {
         "init": {"num_classes": 3, "thresholds": 50},
         "inputs": [("float32", (16, 3)), ("int32", (16, 3))],
+        "sharded": {"TPs": 0, "FPs": 0, "FNs": 0},
     },
     "BinnedPrecisionRecallCurve": {
         "init": {"num_classes": 3, "thresholds": 50},
         "inputs": [("float32", (16, 3)), ("int32", (16, 3))],
+        "sharded": {"TPs": 0, "FPs": 0, "FNs": 0},
     },
     "BinnedRecallAtFixedPrecision": {
         "init": {"num_classes": 3, "min_precision": 0.5, "thresholds": 50},
         "inputs": [("float32", (16, 3)), ("int32", (16, 3))],
+        "sharded": {"TPs": 0, "FPs": 0, "FNs": 0},
     },
 }

@@ -85,11 +85,14 @@ class BinnedPrecisionRecallCurve(Metric):
             self.thresholds = jnp.asarray(thresholds)
             self.num_thresholds = self.thresholds.size
 
+        # shardable along the class axis: each device holds a
+        # (num_classes/width, num_thresholds) block after shard_state()
         for name in ("TPs", "FPs", "FNs"):
             self.add_state(
                 name=name,
                 default=jnp.zeros((num_classes, self.num_thresholds), dtype=jnp.float32),
                 dist_reduce_fx="sum",
+                shard_axis=0,
             )
 
     def _update_signature(self):

@@ -46,7 +46,9 @@ class ConfusionMatrix(Metric):
         _check_arg_choice(normalize, "normalize", ("true", "pred", "all", "none", None))
 
         default = jnp.zeros((num_classes, 2, 2), dtype=jnp.int32) if multilabel else jnp.zeros((num_classes, num_classes), dtype=jnp.int32)
-        self.add_state("confmat", default=default, dist_reduce_fx="sum")
+        # shardable along the (true-)class axis: a 4096-class matrix on an
+        # 8-wide mesh stores a (512, 4096) block per device after shard_state()
+        self.add_state("confmat", default=default, dist_reduce_fx="sum", shard_axis=0)
 
     def _update_signature(self):
         return ("confmat", self.num_classes, self.threshold, self.multilabel)

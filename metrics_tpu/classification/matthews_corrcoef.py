@@ -32,7 +32,12 @@ class MatthewsCorrCoef(Metric):
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.threshold = threshold
-        self.add_state("confmat", default=jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state(
+            "confmat",
+            default=jnp.zeros((num_classes, num_classes), dtype=jnp.int32),
+            dist_reduce_fx="sum",
+            shard_axis=0,
+        )
 
     def _update_signature(self):
         return ("confmat", self.num_classes, self.threshold, False)

@@ -66,11 +66,15 @@ class StatScores(Metric):
         if mdmc_reduce != "samplewise" and reduce != "samples":
             zeros_shape = [] if reduce == "micro" else [num_classes]
             default, reduce_fn = lambda: jnp.zeros(zeros_shape, dtype=jnp.int32), "sum"
+            # per-class count vectors shard along the class axis; the micro
+            # layout is a scalar and stays replicated
+            shard_axis = None if reduce == "micro" else 0
         else:
             default, reduce_fn = lambda: [], "cat"
+            shard_axis = None
 
         for s in ("tp", "fp", "tn", "fn"):
-            self.add_state(s, default=default(), dist_reduce_fx=reduce_fn)
+            self.add_state(s, default=default(), dist_reduce_fx=reduce_fn, shard_axis=shard_axis)
 
         # Sum-reduced counts are additive in masked rows, so the compiled-update
         # engine may pad ragged batches and thread a validity mask; the cat

@@ -259,10 +259,11 @@ class CatBuffer:
         # counted like the sync module's own collectives so the analyzer's
         # collective-budget rule sees buffer gathers too (deferred import:
         # parallel.sync imports this module)
-        from metrics_tpu.parallel.sync import _tick_collective
+        from metrics_tpu.parallel.sync import _leaf_nbytes, _tick_collective
 
-        for _ in range(3):
-            _tick_collective("all_gather")
+        _tick_collective("all_gather", _leaf_nbytes(self.data))
+        _tick_collective("all_gather", _leaf_nbytes(self.count))
+        _tick_collective("all_gather", _leaf_nbytes(self.overflowed))
         data = lax.all_gather(self.data, axis_name, axis=0, tiled=True)  # (W*cap, *item)
         counts = lax.all_gather(self.count, axis_name, axis=0)  # (W,)
         overflowed = jnp.any(lax.all_gather(self.overflowed, axis_name, axis=0))

@@ -412,6 +412,61 @@ class MetricCollection:
         for m in self.values():
             m.reset()
 
+    # ------------------------------------------------------------------ #
+    # sharded state placement (per-member shard_state passthrough)
+    # ------------------------------------------------------------------ #
+    def shard_state(self, mesh: Any = None, axis_name: str = "data") -> "MetricCollection":
+        """Place every member's ``shard_axis``-declared state over ``mesh``.
+
+        Members without shardable states stay fully replicated (no warning —
+        mixed collections are the expected shape), and the fused update/compute
+        engines are rebuilt so their cached executables pick up the per-leader
+        sharding constraints. Returns ``self`` for chaining.
+        """
+        if mesh is None:
+            from metrics_tpu.parallel import mesh as _meshlib
+
+            mesh = _meshlib.data_parallel_mesh(axis_name=axis_name)
+        # members must hold real state before their placement moves
+        self._realias_members()
+        for _, m in self.items(keep_base=True):
+            if m._shard_axes:
+                m.shard_state(mesh, axis_name)
+        # sharing is re-established from the (re-placed) leader state
+        for group in self._groups:
+            if len(group) > 1:
+                leader = self._metrics.__getitem__(group[0])
+                state = leader.get_state()
+                shared = frozenset(id(leaf) for leaf in jax.tree_util.tree_leaves(state))
+                leader._shared_state_ids = shared
+                for name in group[1:]:
+                    member = self._metrics.__getitem__(name)
+                    member.set_state(state)
+                    member._shared_state_ids = shared
+        self._update_engine = None
+        self._compute_engine = None
+        self._invalidate_dispatch()
+        return self
+
+    def unshard_state(self) -> "MetricCollection":
+        """Undo :meth:`shard_state` for every member."""
+        self._realias_members()
+        for _, m in self.items(keep_base=True):
+            if m._state_sharding is not None:
+                m.unshard_state()
+        self._update_engine = None
+        self._compute_engine = None
+        self._invalidate_dispatch()
+        return self
+
+    def _constrain_states(self, states: Dict[str, StateDict]) -> Dict[str, StateDict]:
+        """Per-leader sharding constraints for the fused jitted update (see
+        :meth:`Metric._constrain_state`); identity for unsharded leaders."""
+        return {
+            group[0]: self._metrics.__getitem__(group[0])._constrain_state(states[group[0]])
+            for group in self._groups
+        }
+
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
         mc = deepcopy(self)
         if prefix:

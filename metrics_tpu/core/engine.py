@@ -149,6 +149,14 @@ class EngineStats:
     donated_calls: int = 0  # compiled calls that donated the state pytree
     bucketed_calls: int = 0  # updates routed through the shape-bucketing layer
     key_fast_hits: int = 0  # dispatch keys served from the id-keyed aval memo
+    # collectives observed while tracing compiled calls (cumulative across
+    # signatures): op counts and approximate per-device payload bytes per
+    # bucket kind (psum/pmean/.../all_gather/reshard), from the sync module's
+    # count_collectives tally. Empty for programs that emit no collectives
+    # (the usual no-axis facade dispatch) — populated when the jitted target
+    # runs under a collective context, e.g. inside shard_map.
+    collective_counts: Dict[str, int] = field(default_factory=dict)
+    collective_bytes: Dict[str, int] = field(default_factory=dict)
     # metric/collection class name -> why the engine permanently reverted it to
     # the eager path; feeds ``engine_stats()`` so runtime fallbacks can be
     # diffed against the static analyzer's findings (metrics_tpu.analysis)
@@ -386,7 +394,17 @@ class _EngineBase:
                     break
         fn = donate_fn if donate_ok else plain_fn
         try:
-            new_state = fn(state, *args, **kwargs)
+            if count == _WARMUP_CALLS:
+                # the first compiled call traces: capture the collective tally
+                # (op counts + approx payload bytes per kind) into the stats
+                with _sync.count_collectives() as box:
+                    new_state = fn(state, *args, **kwargs)
+                for kind, n in box["by_kind"].items():
+                    self.stats.collective_counts[kind] = self.stats.collective_counts.get(kind, 0) + n
+                for kind, n in box["bytes_by_kind"].items():
+                    self.stats.collective_bytes[kind] = self.stats.collective_bytes.get(kind, 0) + n
+            else:
+                new_state = fn(state, *args, **kwargs)
         except Exception as err:  # untraceable target: revert to eager for good
             self._broken = f"{type(err).__name__}: {err}"
             self.stats.fallback_reasons[self._owner_name()] = self._broken
@@ -428,8 +446,17 @@ class CompiledUpdateEngine(_EngineBase):
         super().__init__(donate=getattr(metric, "_donate_state", True))
         self.metric = metric
         self._has_children = bool(metric._child_metrics())
-        self._jit_plain = jax.jit(metric.update_state)
-        self._jit_donate = jax.jit(metric.update_state, donate_argnums=(0,))
+
+        # pin sharded state leaves to their NamedSharding placement inside the
+        # traced program: donation then sees matching in/out shardings and the
+        # accumulated state cannot silently decay to replicated. Identity for
+        # unsharded metrics (shard_state() drops engines, so this closure
+        # always matches the live placement).
+        def _update_constrained(state, *args, **kwargs):
+            return metric._constrain_state(metric.update_state(state, *args, **kwargs))
+
+        self._jit_plain = jax.jit(_update_constrained)
+        self._jit_donate = jax.jit(_update_constrained, donate_argnums=(0,))
         # pad+mask bucketing needs the update to accept a validity mask
         mask_ok = getattr(metric, "_accepts_sample_mask", False)
         if mask_ok:
@@ -541,8 +568,15 @@ class CollectionUpdateEngine(_EngineBase):
             getattr(collection._metrics[g[0]], "_donate_state", True) for g in collection._groups
         ))
         self.collection = collection
-        self._jit_plain = jax.jit(collection.update_state)
-        self._jit_donate = jax.jit(collection.update_state, donate_argnums=(0,))
+
+        # per-leader sharding constraints (see CompiledUpdateEngine): mixed
+        # collections pin only their sharded leaders' leaves, the rest pass
+        # through untouched
+        def _update_constrained(states, *args, **kwargs):
+            return collection._constrain_states(collection.update_state(states, *args, **kwargs))
+
+        self._jit_plain = jax.jit(_update_constrained)
+        self._jit_donate = jax.jit(_update_constrained, donate_argnums=(0,))
         # group membership is fixed for this engine's lifetime (rebuilds drop
         # the engine), so the leaders' default-leaf ids are computed once
         self._default_ids = frozenset(

@@ -36,6 +36,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.core.buffers import CatBuffer, _is_traced
+from metrics_tpu.parallel import mesh as _meshlib
 from metrics_tpu.parallel import sync as _sync
 from metrics_tpu.utils.data import (
     _flatten,
@@ -183,6 +184,8 @@ class Metric:
         self._defaults: Dict[str, StateValue] = {}
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, Optional[Union[str, Callable]]] = {}
+        self._shard_axes: Dict[str, int] = {}  # declared shardable state axes
+        self._state_sharding: Optional[Tuple[Any, str]] = None  # (mesh, axis_name) once shard_state() ran
 
         self._update_count = 0
         self._forward_cache: Any = None
@@ -207,6 +210,7 @@ class Metric:
         dist_reduce_fx: Optional[Union[str, Callable]] = None,
         persistent: bool = False,
         bufferable: Optional[bool] = None,
+        shard_axis: Optional[int] = None,
     ) -> None:
         """Register a state variable (reference: metric.py:149-217).
 
@@ -222,6 +226,17 @@ class Metric:
         ``bufferable`` defaults to ``dist_reduce_fx == "cat"``; metrics whose
         ``None``-reduce list states are nonetheless flat (IS/KID features,
         retrieval) pass ``bufferable=True`` explicitly.
+
+        ``shard_axis`` declares the state *shardable* along that dimension
+        (the class axis of a confusion matrix, the sample axis of a
+        ``CatBuffer``). The declaration is inert — state stays replicated,
+        every existing path is unchanged — until :meth:`shard_state` places
+        the leaves as ``NamedSharding``-sharded global arrays over a mesh;
+        from then on each device holds only its 1/width block, updates
+        accumulate into local shards inside the compiled engines, and sync at
+        ``compute()`` becomes a single reshard (no psum) for these leaves.
+        ``CatBuffer`` states may only declare ``shard_axis=0`` (the sample
+        axis).
         """
         if (
             not isinstance(default, (jnp.ndarray, np.ndarray, CatBuffer))
@@ -245,6 +260,26 @@ class Metric:
                     "be stored in a fixed-capacity CatBuffer. Remove the `buffer_capacity` argument."
                 )
             default = CatBuffer.empty(self.buffer_capacity)
+        if shard_axis is not None:
+            if not isinstance(shard_axis, int):
+                raise ValueError(f"`shard_axis` must be an int or None but got {shard_axis!r}")
+            if isinstance(default, list):
+                raise ValueError(
+                    f"state {name!r}: unbounded list states cannot declare `shard_axis` "
+                    "(construct the metric with `buffer_capacity=N` for a shardable CatBuffer)"
+                )
+            if isinstance(default, CatBuffer) and shard_axis != 0:
+                raise ValueError(
+                    f"state {name!r}: CatBuffer states shard along the sample axis only (shard_axis=0), got {shard_axis}"
+                )
+            if isinstance(default, jnp.ndarray):
+                if default.ndim == 0:
+                    raise ValueError(f"state {name!r}: scalar states cannot declare `shard_axis`")
+                if not (-default.ndim <= shard_axis < default.ndim):
+                    raise ValueError(
+                        f"state {name!r}: shard_axis {shard_axis} out of range for default of rank {default.ndim}"
+                    )
+            self._shard_axes[name] = shard_axis
 
         self._defaults[name] = _copy_state_value(default)
         self._persistent[name] = persistent
@@ -255,6 +290,137 @@ class Metric:
     def metric_state(self) -> StateDict:
         """Current state values keyed by registered name."""
         return {attr: getattr(self, attr) for attr in self._defaults}
+
+    # ------------------------------------------------------------------ #
+    # sharded state placement (SPMD scale-out; ROADMAP "shard metric state")
+    # ------------------------------------------------------------------ #
+    @property
+    def shard_axes(self) -> Dict[str, int]:
+        """Declared shardable state axes (name → axis), active or not."""
+        return dict(self._shard_axes)
+
+    @property
+    def active_shard_axes(self) -> Dict[str, int]:
+        """Shard axes in effect: non-empty only after :meth:`shard_state`.
+
+        This is what the sync path consumes — a declaration alone must not
+        change sync semantics, because per-device values of an *unsharded*
+        metric inside ``shard_map`` are partial replicas (psum is correct),
+        while after ``shard_state`` they are disjoint blocks (reshard is).
+        """
+        return dict(self._shard_axes) if self._state_sharding is not None else {}
+
+    @property
+    def state_sharding(self) -> Optional[Tuple[Any, str]]:
+        """The ``(mesh, axis_name)`` placement from :meth:`shard_state`, or None."""
+        return self._state_sharding
+
+    def _leaf_sharding(self, name: str, val: Any):
+        """NamedSharding for one sharded leaf under the active placement."""
+        mesh, axis_name = self._state_sharding  # type: ignore[misc]
+        if isinstance(val, CatBuffer):
+            return _meshlib.sample_sharded(mesh, axis_name)
+        return _meshlib.shard_spec(mesh, self._shard_axes[name], jnp.ndim(val), axis_name)
+
+    def _place_sharded_value(self, name: str, val: Any) -> Any:
+        """``device_put`` one state leaf per the active placement (host side)."""
+        if isinstance(val, CatBuffer):
+            if not val.materialized:
+                return val
+            return CatBuffer(
+                jax.device_put(val.data, self._leaf_sharding(name, val)),
+                val.count,
+                val.capacity,
+                val.overflowed,
+            )
+        return jax.device_put(val, self._leaf_sharding(name, val))
+
+    def shard_state(self, mesh: Any = None, axis_name: str = "data") -> "Metric":
+        """Place every ``shard_axis``-declared state leaf sharded over ``mesh``.
+
+        After this call the declared leaves (and their defaults, so ``reset``
+        preserves placement) live as ``NamedSharding(mesh,
+        PartitionSpec(...))``-sharded global arrays: each device stores only
+        its 1/width block along the declared axis instead of a full replica.
+        The compiled update/compute engines are dropped and lazily rebuilt so
+        their cached executables re-specialize — updates keep running through
+        the same donated jitted streaks, with XLA owning the batch→shard data
+        movement (GSPMD is semantics-preserving, so ``compute()`` stays
+        bitwise-identical to the replicated path), and the explicit
+        ``shard_map`` sync path routes these leaves through the reshard bucket
+        (one tiled ``all_gather`` at ``compute()``, zero psum bytes).
+
+        ``mesh=None`` builds a 1-D data-parallel mesh over all devices. A
+        shard dimension not divisible by the mesh width still works (GSPMD
+        pads internally) but wastes the padding — the analyzer's sharded-spec
+        rule flags it. Returns ``self`` for chaining.
+        """
+        if mesh is None:
+            mesh = _meshlib.data_parallel_mesh(axis_name=axis_name)
+        if axis_name not in mesh.axis_names:
+            raise ValueError(f"axis {axis_name!r} is not an axis of the mesh {mesh.axis_names}")
+        if not self._shard_axes:
+            rank_zero_warn(
+                f"{type(self).__name__}.shard_state: no state declares a `shard_axis`; "
+                "state stays fully replicated.",
+                UserWarning,
+            )
+        self._state_sharding = (mesh, axis_name)
+        for name in self._shard_axes:
+            setattr(self, name, self._place_sharded_value(name, getattr(self, name)))
+            self._defaults[name] = self._place_sharded_value(name, self._defaults[name])
+        # cached executables specialized on the old (replicated) placement and
+        # the id-keyed dispatch memos must not survive the move
+        self._update_engine = None
+        self._compute_engine = None
+        self._invalidate_dispatch()
+        return self
+
+    def unshard_state(self) -> "Metric":
+        """Undo :meth:`shard_state`: gather sharded leaves back to replicated."""
+        if self._state_sharding is None:
+            return self
+
+        def gather(val):
+            if isinstance(val, CatBuffer):
+                if not val.materialized:
+                    return val
+                return CatBuffer(jax.device_put(np.asarray(val.data)), val.count, val.capacity, val.overflowed)
+            return jax.device_put(np.asarray(val))
+
+        for name in self._shard_axes:
+            setattr(self, name, gather(getattr(self, name)))
+            self._defaults[name] = gather(self._defaults[name])
+        self._state_sharding = None
+        self._update_engine = None
+        self._compute_engine = None
+        self._invalidate_dispatch()
+        return self
+
+    def _constrain_state(self, state: StateDict) -> StateDict:
+        """Pin sharded leaves of a traced state pytree to their placement.
+
+        Applied by the compiled engines *inside* the jitted program (on the
+        update output), so donation sees matching in/out shardings and the
+        accumulated state never silently decays to replicated. Identity when
+        :meth:`shard_state` has not run.
+        """
+        if self._state_sharding is None or not self._shard_axes:
+            return state
+        out = dict(state)
+        for name in self._shard_axes:
+            val = out.get(name)
+            if isinstance(val, CatBuffer):
+                if val.materialized:
+                    out[name] = CatBuffer(
+                        jax.lax.with_sharding_constraint(val.data, self._leaf_sharding(name, val)),
+                        val.count,
+                        val.capacity,
+                        val.overflowed,
+                    )
+            elif isinstance(val, jnp.ndarray):
+                out[name] = jax.lax.with_sharding_constraint(val, self._leaf_sharding(name, val))
+        return out
 
     # ------------------------------------------------------------------ #
     # pure functional protocol
@@ -450,8 +616,13 @@ class Metric:
         emits one ``psum`` instead of one collective per leaf (bitwise
         identical to the per-leaf path; opt out with
         :func:`metrics_tpu.parallel.set_bucketed_sync` or
-        ``METRICS_TPU_BUCKETED_SYNC=0``)."""
-        return _sync.sync_state(state, self._reductions, axis_name)
+        ``METRICS_TPU_BUCKETED_SYNC=0``).
+
+        Once :meth:`shard_state` has run, the declared-sharded leaves skip the
+        reduction buckets: their per-device values are disjoint blocks, so
+        they re-materialize through the reshard bucket instead (one tiled
+        ``all_gather`` along the shard axis, zero psum traffic)."""
+        return _sync.sync_state(state, self._reductions, axis_name, shard_axes=self.active_shard_axes)
 
     def sync_compute_state(self, state: StateDict, axis_name: Optional[Union[str, Tuple[str, ...]]] = None) -> Any:
         """Pure fused sync+compute: the cross-device collectives (when
@@ -587,7 +758,7 @@ class Metric:
             if isinstance(val, list):
                 setattr(self, key, [move(v) for v in val])
             elif isinstance(val, CatBuffer) and val.materialized:
-                setattr(self, key, CatBuffer(move(val.data), val.count, val.capacity))
+                setattr(self, key, CatBuffer(move(val.data), val.count, val.capacity, val.overflowed))
 
     # ------------------------------------------------------------------ #
     # distributed sync (reference: metric.py:346-483)
@@ -598,7 +769,7 @@ class Metric:
         if dist_sync_fn is not None:
             synced = dist_sync_fn(state, self._reductions, axes)
         elif axes is not None:
-            synced = _sync.sync_state(state, self._reductions, axes)
+            synced = _sync.sync_state(state, self._reductions, axes, shard_axes=self.active_shard_axes)
         else:
             # eager multi-host path: gather + host-side reduce per tag
             synced = {}
@@ -820,7 +991,7 @@ class Metric:
             if isinstance(val, list):
                 return [move(v) for v in val]
             if isinstance(val, CatBuffer):
-                return val if not val.materialized else CatBuffer(move(val.data), val.count, val.capacity)
+                return val if not val.materialized else CatBuffer(move(val.data), val.count, val.capacity, val.overflowed)
             return move(val)
 
         for attr in self._defaults:
@@ -837,7 +1008,7 @@ class Metric:
             if isinstance(val, list):
                 return [cast(v) for v in val]
             if isinstance(val, CatBuffer):
-                return val if not val.materialized else CatBuffer(cast(val.data), val.count, val.capacity)
+                return val if not val.materialized else CatBuffer(cast(val.data), val.count, val.capacity, val.overflowed)
             return cast(val)
 
         for attr in self._defaults:
@@ -890,6 +1061,11 @@ class Metric:
         # and the engines' id-keyed signature memos must not survive it
         self._is_synced = False
         self._cache = None
+        if self._state_sharding is not None:
+            # loaded leaves arrive as host/global arrays: restore the sharded
+            # placement so the round-trip preserves the 1/width footprint
+            for name in self._shard_axes:
+                setattr(self, name, self._place_sharded_value(name, getattr(self, name)))
         self._invalidate_dispatch()
 
     # ------------------------------------------------------------------ #

@@ -1,5 +1,13 @@
 """Distributed/parallel layer (reference parity: torchmetrics/utilities/distributed.py)."""
-from metrics_tpu.parallel.mesh import batch_sharded, data_parallel_mesh, make_mesh, replicated  # noqa: F401
+from metrics_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharded,
+    class_sharded,
+    data_parallel_mesh,
+    make_mesh,
+    replicated,
+    sample_sharded,
+    shard_spec,
+)
 from metrics_tpu.parallel.sync import (  # noqa: F401
     bucketed_sync_enabled,
     class_reduce,

@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import Array, lax
 
 AxisNames = Union[str, Tuple[str, ...]]
@@ -73,25 +74,59 @@ def count_collectives():
     """Count collectives emitted by this module while the block traces.
 
     Yields a dict whose ``"count"`` entry holds the number of collective ops
-    (``psum``/``pmean``/``pmax``/``pmin``/``all_gather``) this module emitted —
-    incremented at trace time, so wrap a ``jax.make_jaxpr(...)``/``jit`` trace
-    of the sync, not a cached compiled call. ``"by_kind"`` breaks the same
-    total down per collective primitive (e.g. ``{"psum": 2, "all_gather": 1}``)
-    — the analyzer's collective-budget rule reports it alongside overruns."""
-    prev = getattr(_counter, "box", None)
-    box: Dict[str, Any] = {"count": 0, "by_kind": {}}
-    _counter.box = box
+    (``psum``/``pmean``/``pmax``/``pmin``/``all_gather``/``reshard``) this
+    module emitted — incremented at trace time, so wrap a
+    ``jax.make_jaxpr(...)``/``jit`` trace of the sync, not a cached compiled
+    call. ``"by_kind"`` breaks the same total down per collective primitive
+    (e.g. ``{"psum": 2, "all_gather": 1}``) — the analyzer's collective-budget
+    rule reports it alongside overruns. ``"bytes"`` / ``"bytes_by_kind"``
+    tally the approximate per-device payload bytes entering each collective
+    (static shape × itemsize at trace time), so traffic-elimination claims —
+    e.g. *zero psum bytes for sharded leaves* — are measurable, not asserted.
+
+    Boxes nest as a stack: an inner ``count_collectives`` (say, the engine's
+    own first-compile capture) does not steal ticks from an outer user-level
+    box — every active box sees every tick."""
+    stack = getattr(_counter, "stack", None)
+    if stack is None:
+        stack = _counter.stack = []
+    box: Dict[str, Any] = {"count": 0, "by_kind": {}, "bytes": 0, "bytes_by_kind": {}}
+    stack.append(box)
     try:
         yield box
     finally:
-        _counter.box = prev
+        # context managers unwind LIFO per thread; pop by position, not by
+        # equality — nested boxes with identical contents would remove the
+        # wrong one
+        popped = stack.pop()
+        assert popped is box
 
 
-def _tick_collective(kind: str) -> None:
-    box = getattr(_counter, "box", None)
-    if box is not None:
+def _leaf_nbytes(x: Any) -> int:
+    """Approximate per-device payload bytes of a collective operand.
+
+    Works on tracers: shapes are static at trace time, so ``size × itemsize``
+    of the abstract value is exact for the per-device block entering the op.
+    """
+    try:
+        size = 1
+        for d in jnp.shape(x):
+            size *= int(d)
+        dtype = x.dtype if hasattr(x, "dtype") else jnp.result_type(x)
+        return size * int(np.dtype(dtype).itemsize)
+    except Exception:
+        return 0
+
+
+def _tick_collective(kind: str, nbytes: int = 0) -> None:
+    stack = getattr(_counter, "stack", None)
+    if not stack:
+        return
+    for box in stack:
         box["count"] += 1
         box["by_kind"][kind] = box["by_kind"].get(kind, 0) + 1
+        box["bytes"] += nbytes
+        box["bytes_by_kind"][kind] = box["bytes_by_kind"].get(kind, 0) + nbytes
 
 
 def reduce(x: Array, reduction: str) -> Array:
@@ -176,27 +211,27 @@ def sync_array(x: Array, reduction: Optional[Union[str, Callable]], axis_name: O
     if axis_name is None:
         return x
     if reduction == "sum":
-        _tick_collective("psum")
+        _tick_collective("psum", _leaf_nbytes(x))
         return lax.psum(x, axis_name)
     if reduction == "mean":
-        _tick_collective("pmean")
+        _tick_collective("pmean", _leaf_nbytes(x))
         return lax.pmean(x, axis_name)
     if reduction == "max":
-        _tick_collective("pmax")
+        _tick_collective("pmax", _leaf_nbytes(x))
         return lax.pmax(x, axis_name)
     if reduction == "min":
-        _tick_collective("pmin")
+        _tick_collective("pmin", _leaf_nbytes(x))
         return lax.pmin(x, axis_name)
     if reduction == "cat":
-        _tick_collective("all_gather")
+        _tick_collective("all_gather", _leaf_nbytes(jnp.atleast_1d(x)))
         return lax.all_gather(jnp.atleast_1d(x), axis_name, axis=0, tiled=True)
     if reduction is None:
         # keep per-device values separate (reference stacks the gathered list,
         # metric.py:364-365) — e.g. Pearson's moment merge consumes the stack
-        _tick_collective("all_gather")
+        _tick_collective("all_gather", _leaf_nbytes(x))
         return lax.all_gather(x, axis_name, axis=0)
     if callable(reduction):
-        _tick_collective("all_gather")
+        _tick_collective("all_gather", _leaf_nbytes(x))
         gathered = lax.all_gather(x, axis_name, axis=0)  # (world, ...)
         return reduction(gathered)
     raise ValueError(f"Unknown dist_reduce_fx {reduction!r}; expected one of {_REDUCTIONS} or a callable.")
@@ -233,7 +268,7 @@ def _sync_bucketed(entries: List[Tuple[str, Array, Optional[str]]], axis_name: A
         else:  # "cat" / None: one stacking all_gather, per-leaf unflatten
             shaped = [(name, jnp.atleast_1d(a) if red == "cat" else a) for name, a in items]
             flat = jnp.concatenate([jnp.ravel(a) for _, a in shaped])
-            _tick_collective("all_gather")
+            _tick_collective("all_gather", _leaf_nbytes(flat))
             gathered = lax.all_gather(flat, axis_name, axis=0)  # (world, sum of sizes)
             world = gathered.shape[0]
             offset = 0
@@ -249,8 +284,51 @@ def _sync_bucketed(entries: List[Tuple[str, Array, Optional[str]]], axis_name: A
     return out
 
 
+def _sync_resharded(
+    entries: List[Tuple[str, Array, int]], axis_name: AxisNames
+) -> Dict[str, Any]:
+    """Reshard bucket: sharded state leaves re-materialize at ``compute()``.
+
+    Each entry is a per-device *disjoint block* of a leaf sharded along
+    ``shard_axis`` (class axis of a confusion matrix, threshold axis of binned
+    counts, ...). There is no cross-replica reduction — every device already
+    owns its slice exactly — so the sync is pure data movement: one tiled
+    ``all_gather`` along the shard axis rebuilds the global leaf. Leaves with
+    the same ``(dtype, shard dimension)`` coalesce into one collective by
+    concatenating their flattened trailing dims; the rest go singleton. Every
+    op ticks :func:`count_collectives` as ``"reshard"`` so the byte tally can
+    prove sharded leaves move zero psum bytes.
+    """
+    out: Dict[str, Any] = {}
+    buckets: Dict[Tuple[Any, int], List[Tuple[str, Array, int]]] = {}
+    for name, arr, axis in entries:
+        arr = jnp.asarray(arr)
+        axis = axis % max(arr.ndim, 1)
+        buckets.setdefault((arr.dtype, int(arr.shape[axis])), []).append((name, arr, axis))
+    for (_dtype, dim), items in buckets.items():
+        if len(items) == 1:
+            name, arr, axis = items[0]
+            _tick_collective("reshard", _leaf_nbytes(arr))
+            out[name] = lax.all_gather(arr, axis_name, axis=axis, tiled=True)
+            continue
+        # shard axis to the front, trailing dims raveled: (dim, -1) per leaf,
+        # concat along the raveled dim, one tiled gather, slice + restore axes
+        moved = [(name, jnp.moveaxis(arr, axis, 0), axis) for name, arr, axis in items]
+        flat = jnp.concatenate([m.reshape(dim, -1) for _, m, _ in moved], axis=1)
+        _tick_collective("reshard", _leaf_nbytes(flat))
+        gathered = lax.all_gather(flat, axis_name, axis=0, tiled=True)
+        offset = 0
+        for (name, m, axis), (_, arr, _) in zip(moved, items):
+            width = m.size // dim
+            seg = gathered[:, offset : offset + width]
+            offset += width
+            full = seg.reshape((gathered.shape[0],) + m.shape[1:])
+            out[name] = jnp.moveaxis(full, 0, axis)
+    return out
+
+
 def _sync_bucketed_catbuffers(
-    entries: List[Tuple[str, Any]], axis_name: AxisNames
+    entries: List[Tuple[str, Any]], axis_name: AxisNames, kind: str = "all_gather"
 ) -> Dict[str, Any]:
     """CatBuffer states joining the ``cat`` bucket: fill counts ride alongside.
 
@@ -271,14 +349,14 @@ def _sync_bucketed_catbuffers(
         [jnp.asarray(b.count, jnp.int32) for _, b in entries]
         + [jnp.asarray(b.overflowed, jnp.int32) for _, b in entries]
     )
-    _tick_collective("all_gather")
+    _tick_collective(kind, _leaf_nbytes(meta))
     gmeta = lax.all_gather(meta, axis_name, axis=0)  # (world, 2n)
     buckets: Dict[Any, List[Tuple[int, str, Any]]] = {}
     for i, (name, buf) in enumerate(entries):
         buckets.setdefault(buf.data.dtype, []).append((i, name, buf))
     for _dtype, items in buckets.items():
         flat = jnp.concatenate([jnp.ravel(b.data) for _, _, b in items])
-        _tick_collective("all_gather")
+        _tick_collective(kind, _leaf_nbytes(flat))
         gflat = lax.all_gather(flat, axis_name, axis=0)  # (world, sum of sizes)
         world = gflat.shape[0]
         offset = 0
@@ -301,6 +379,7 @@ def sync_state(
     reductions: Dict[str, Optional[Union[str, Callable]]],
     axis_name: Optional[AxisNames],
     bucketed: Optional[bool] = None,
+    shard_axes: Optional[Dict[str, int]] = None,
 ) -> Dict[str, Any]:
     """Synchronize a whole state pytree by per-state reduction tag.
 
@@ -318,16 +397,27 @@ def sync_state(
     the payloads (see :func:`_sync_bucketed_catbuffers`) — instead of paying
     three collectives each on the per-leaf fallback. Callable reductions
     always sync per-leaf.
+
+    ``shard_axes`` (name → axis int) marks leaves that live sharded along an
+    axis: per-device values are *disjoint blocks*, not replicas, so they skip
+    the reduction buckets entirely and re-materialize through the reshard
+    bucket (:func:`_sync_resharded`) — one tiled ``all_gather`` along the
+    shard axis, zero psum traffic. Sharded ``CatBuffer`` states (sample-axis
+    sharding) take the same gather-with-fill-counts path as replicated ones
+    but tick as ``"reshard"``: their per-device payloads are already disjoint.
     """
     if axis_name is None:
         return dict(state)
     if bucketed is None:
         bucketed = bucketed_sync_enabled()
+    shard_axes = shard_axes or {}
     from metrics_tpu.core.buffers import CatBuffer
 
     out: Dict[str, Any] = {}
     entries: List[Tuple[str, Array, Optional[str]]] = []
+    shard_entries: List[Tuple[str, Array, int]] = []
     buf_entries: List[Tuple[str, CatBuffer]] = []
+    shard_buf_entries: List[Tuple[str, CatBuffer]] = []
     rewrap: Dict[str, type] = {}
     for name, val in state.items():
         red = reductions.get(name)
@@ -338,10 +428,15 @@ def sync_state(
                 )
             if not val.materialized:
                 out[name] = val
+            elif name in shard_axes:
+                shard_buf_entries.append((name, val))
             elif bucketed:
                 buf_entries.append((name, val))
             else:
                 out[name] = val.gather(axis_name)
+            continue
+        if name in shard_axes and not isinstance(val, (list, tuple)):
+            shard_entries.append((name, val, shard_axes[name]))
             continue
         if isinstance(val, (list, tuple)):
             if len(val) == 0:
@@ -361,8 +456,12 @@ def sync_state(
             out[name] = sync_array(arr, red, axis_name)
     if entries:
         out.update(_sync_bucketed(entries, axis_name))
+    if shard_entries:
+        out.update(_sync_resharded(shard_entries, axis_name))
     if buf_entries:
         out.update(_sync_bucketed_catbuffers(buf_entries, axis_name))
+    if shard_buf_entries:
+        out.update(_sync_bucketed_catbuffers(shard_buf_entries, axis_name, kind="reshard"))
     for name, container in rewrap.items():
         out[name] = container((out[name],))
     return {name: out[name] for name in state}

@@ -153,6 +153,29 @@ class TreedefDriftUpdateMetric(CleanMetric):
         return out
 
 
+class ShardedCleanMetric(Metric):
+    """Control for E108: a class-sharded vector state with canonical sync."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("counts", default=jnp.zeros((8,)), dist_reduce_fx="sum", shard_axis=0)
+
+    def update(self, values):
+        self.counts = self.counts + values
+
+    def compute(self):
+        return self.counts.sum()
+
+
+class ShardIgnorantSyncMetric(ShardedCleanMetric):
+    """E108: the sync override psums every leaf, ignoring active_shard_axes —
+    with sharded state the per-device blocks are disjoint, so the psum
+    double-counts instead of gathering."""
+
+    def sync_states(self, state, axis_name):
+        return {k: _sync.sync_array(v, "sum", axis_name) for k, v in state.items()}
+
+
 _SPEC = {"init": {}, "inputs": [("float32", (8,))]}
 
 
@@ -244,6 +267,43 @@ class TestEvalStage:
         findings = _evaluate(DriftySyncMetric, spec)
         e105 = [f for f in findings if f.rule == "E105"]
         assert e105 and all(f.suppressed for f in e105)
+
+    def test_sharded_clean_metric_passes(self):
+        findings = _evaluate(ShardedCleanMetric)
+        assert [f for f in findings if not f.suppressed] == []
+
+    def test_sharded_routing_violation_is_E108(self):
+        findings = _evaluate(ShardIgnorantSyncMetric)
+        e108 = [f for f in findings if f.rule == "E108" and not f.suppressed]
+        assert e108, [f.rule for f in findings]
+        extra = e108[0].extra
+        assert extra["kind"] == "psum"
+        assert extra["bytes"] == 8 * 4  # the whole sharded leaf went through psum
+        assert extra["budget_bytes"] == 0  # canonical sharded sync psums nothing
+
+    def test_spec_sharded_promise_mismatch_is_E108(self):
+        # spec promises a sharded state the class never declares
+        findings = _evaluate(CleanMetric, dict(_SPEC, sharded={"total": 0}))
+        e108 = [f for f in findings if f.rule == "E108" and not f.suppressed]
+        assert e108 and "drifted" in e108[0].message
+
+    def test_sharded_canonical_trace_failure_is_reported_not_compared(self, monkeypatch):
+        """When the canonical sharded sync fails to trace there is no byte
+        budget — the failure itself must be the finding, not a spurious
+        'reduced as if replicated' comparison against an empty budget."""
+        real = _sync.sync_state
+
+        def failing(state, reductions, axis_name, **kwargs):
+            if kwargs.get("shard_axes"):
+                raise RuntimeError("canonical sharded sync exploded")
+            return real(state, reductions, axis_name, **kwargs)
+
+        monkeypatch.setattr(_sync, "sync_state", failing)
+        findings = _evaluate(ShardIgnorantSyncMetric)
+        e108 = [f for f in findings if f.rule == "E108" and not f.suppressed]
+        assert e108, [f.rule for f in findings]
+        assert all("cannot be validated" in f.message for f in e108)
+        assert not any("reduced as if replicated" in f.message for f in e108)
 
     def test_missing_spec_is_E002(self):
         findings = eval_stage.evaluate_entry(Entry(cls=CleanMetric, spec=None))

@@ -285,6 +285,22 @@ def test_count_collectives_tallies_by_kind():
     assert box["count"] == 5
 
 
+def test_count_collectives_nested_boxes_unwind_by_identity():
+    """Nested boxes with identical contents (e.g. the engine's first-compile
+    capture — usually empty — inside a user-level box) must pop their own box
+    on exit, not the first *equal* one; otherwise later ticks are credited to
+    the dead inner box and the outer exit raises ValueError."""
+    from metrics_tpu.parallel.sync import _tick_collective
+
+    with count_collectives() as outer:
+        with count_collectives() as inner:
+            pass  # both boxes are identical empty dicts at this exit
+        _tick_collective("psum", 16)
+    assert outer["by_kind"] == {"psum": 1}
+    assert outer["bytes_by_kind"] == {"psum": 16}
+    assert inner["count"] == 0
+
+
 def test_bucketed_coalesces_by_kind():
     state = {k: jnp.zeros((4,)) for k in ("a", "b", "c")}
     reds = {k: "sum" for k in state}

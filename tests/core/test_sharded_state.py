@@ -1,0 +1,466 @@
+"""Sharded metric state: SPMD placement with reshard-at-compute sync.
+
+``add_state(..., shard_axis=k)`` declares a state leaf shardable along one
+dimension; :meth:`Metric.shard_state` places declared leaves as
+``NamedSharding``-sharded global arrays over a mesh. These tests pin the
+contract on the 8-device CPU mesh:
+
+* the declaration alone is inert — replicated placement, psum sync, every
+  existing path byte-identical;
+* after ``shard_state()`` each device holds a 1/width block
+  (``addressable_shards``), updates run through the compiled donated engines,
+  and ``compute()`` is bitwise-equal to the replicated metric on the same
+  data;
+* sync routing: sharded leaves spend *zero* psum/all_gather bytes — their
+  only collective is the single reshard (tiled all-gather) at compute;
+* placement survives ``reset``, ``state_dict`` roundtrips, and checkpoint
+  save/restore; ``unshard_state`` returns to replicated;
+* fused collection streaks handle mixed sharded/replicated members.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import metrics_tpu
+from metrics_tpu import (
+    Accuracy,
+    BinnedPrecisionRecallCurve,
+    CatMetric,
+    ConfusionMatrix,
+    F1Score,
+    MetricCollection,
+    Precision,
+)
+from metrics_tpu.parallel import make_mesh
+from metrics_tpu.parallel.sync import count_collectives
+
+WORLD = 8
+
+
+@pytest.fixture(autouse=True)
+def _bucketed_default():
+    metrics_tpu.set_bucketed_sync(None)
+    yield
+    metrics_tpu.set_bucketed_sync(None)
+
+
+@pytest.fixture()
+def mesh():
+    devices = jax.devices()
+    if len(devices) < WORLD:
+        pytest.skip("needs 8 devices")
+    return make_mesh([WORLD], ["data"], devices[:WORLD])
+
+
+def _rng():
+    return np.random.default_rng(0)
+
+
+def _leaves_equal(a, b, exact=True):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    if exact:
+        return all(np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb))
+    return all(
+        np.allclose(np.asarray(x), np.asarray(y), rtol=1e-6, atol=0)
+        for x, y in zip(la, lb)
+    )
+
+
+def _per_device_nbytes(leaf):
+    shards = getattr(leaf, "addressable_shards", None)
+    return int(shards[0].data.nbytes) if shards else int(leaf.nbytes)
+
+
+# --------------------------------------------------------------------------- #
+# declaration surface
+# --------------------------------------------------------------------------- #
+class _Declared(metrics_tpu.Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("grid", default=jnp.zeros((4, 6)), dist_reduce_fx="sum", shard_axis=1)
+
+    def update(self, x):
+        self.grid = self.grid + x
+
+    def compute(self):
+        return self.grid.sum()
+
+
+def test_add_state_shard_axis_validation():
+    class Bad(metrics_tpu.Metric):
+        def __init__(self, default, shard_axis, **kw):
+            super().__init__(**kw)
+            self.add_state("s", default=default, dist_reduce_fx="sum", shard_axis=shard_axis)
+
+        def update(self):
+            pass
+
+        def compute(self):
+            return self.s
+
+    with pytest.raises(ValueError, match="must be an int"):
+        Bad(jnp.zeros((4,)), "0")
+    with pytest.raises(ValueError, match="scalar states"):
+        Bad(jnp.asarray(0.0), 0)
+    with pytest.raises(ValueError, match="out of range"):
+        Bad(jnp.zeros((4,)), 2)
+    with pytest.raises(ValueError, match="unbounded list states"):
+        Bad([], 0)
+    # negative axes within rank are accepted (numpy convention)
+    assert Bad(jnp.zeros((2, 3)), -1).shard_axes == {"s": -1}
+
+
+def test_declaration_is_inert():
+    """shard_axis alone changes nothing: no active axes, psum routing."""
+    m = _Declared()
+    assert m.shard_axes == {"grid": 1}
+    assert m.active_shard_axes == {}
+    with count_collectives() as box:
+        jax.make_jaxpr(lambda s: m.sync_states(s, "data"), axis_env=[("data", WORLD)])(
+            m.init_state()
+        )
+    assert box["by_kind"].get("reshard", 0) == 0
+    assert box["by_kind"].get("psum", 0) >= 1
+
+
+def test_shard_state_requires_known_axis(mesh):
+    with pytest.raises(Exception, match="axis"):
+        _Declared().shard_state(mesh, axis_name="model")
+
+
+def test_shard_state_without_declarations_warns(mesh):
+    with pytest.warns(UserWarning, match="shard_axis"):
+        Accuracy(num_classes=4, average="micro").shard_state(mesh)
+
+
+# --------------------------------------------------------------------------- #
+# replicated-vs-sharded parity sweep
+# --------------------------------------------------------------------------- #
+def _confmat_case():
+    rng = _rng()
+    data = [
+        (jnp.asarray(rng.integers(0, 64, size=(128,))), jnp.asarray(rng.integers(0, 64, size=(128,))))
+        for _ in range(3)
+    ]
+    return lambda: ConfusionMatrix(num_classes=64), data, True
+
+
+def _precision_case():
+    rng = _rng()
+    data = [
+        (
+            jnp.asarray(rng.random((64, 16), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, 16, size=(64,))),
+        )
+        for _ in range(3)
+    ]
+    # macro averaging reduces *over* the sharded class axis: GSPMD may reorder
+    # that float reduction, so parity is to 1 ulp, not bitwise — integer
+    # accumulation and elementwise computes (the other cases) stay exact
+    return lambda: Precision(num_classes=16, average="macro"), data, False
+
+
+def _binned_case():
+    rng = _rng()
+    data = [
+        (
+            jnp.asarray(rng.random((32, 16), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, 2, size=(32, 16))),
+        )
+        for _ in range(3)
+    ]
+    return lambda: BinnedPrecisionRecallCurve(num_classes=16, thresholds=10), data, True
+
+
+def _catbuffer_case():
+    data = [(jnp.arange(i * 8, i * 8 + 8, dtype=jnp.float32),) for i in range(4)]
+    return lambda: CatMetric(buffer_capacity=64), data, True
+
+
+@pytest.mark.parametrize(
+    "case",
+    [_confmat_case, _precision_case, _binned_case, _catbuffer_case],
+    ids=["confmat", "precision_macro", "binned_pr", "catbuffer"],
+)
+def test_sharded_parity_and_footprint(mesh, case):
+    build, data, exact = case()
+    ref = build()
+    for args in data:
+        ref.update(*args)
+    expect = ref.compute()
+
+    m = build().shard_state(mesh)
+    assert m.active_shard_axes == m.shard_axes and m.shard_axes
+    for args in data:
+        m.update(*args)
+
+    # every declared leaf holds a 1/WORLD block per device
+    state = m.metric_state
+    for name in m.shard_axes:
+        leaf = state[name]
+        if isinstance(leaf, metrics_tpu.CatBuffer):
+            leaf = leaf.data
+        assert _per_device_nbytes(leaf) * WORLD == int(leaf.nbytes)
+
+    assert _leaves_equal(expect, m.compute(), exact=exact)
+
+
+def test_sharded_update_uses_compiled_donated_engine(mesh):
+    rng = _rng()
+    m = ConfusionMatrix(num_classes=64).shard_state(mesh)
+    for _ in range(5):
+        m.update(
+            jnp.asarray(rng.integers(0, 64, size=(64,))),
+            jnp.asarray(rng.integers(0, 64, size=(64,))),
+        )
+    stats = m.engine_stats()["update"]
+    assert stats is not None
+    assert stats.compiled_calls > 0
+    assert stats.donated_calls > 0
+    assert not m.engine_stats()["fallback_reasons"]
+
+
+# --------------------------------------------------------------------------- #
+# sync routing: sharded leaves never psum
+# --------------------------------------------------------------------------- #
+def test_sharded_leaves_spend_zero_psum_bytes(mesh):
+    m = ConfusionMatrix(num_classes=64).shard_state(mesh)
+    with count_collectives() as box:
+        jax.make_jaxpr(lambda s: m.sync_states(s, "data"), axis_env=[("data", WORLD)])(
+            {"confmat": jnp.zeros((64, 64), jnp.int32)}
+        )
+    assert box["bytes_by_kind"].get("psum", 0) == 0
+    assert box["bytes_by_kind"].get("all_gather", 0) == 0
+    assert box["by_kind"] == {"reshard": 1}
+    assert box["bytes_by_kind"]["reshard"] == 64 * 64 * 4
+
+
+def test_mixed_state_splits_buckets(mesh):
+    """Micro-Accuracy scalars keep their psum bucket; macro leaves reshard."""
+    coll = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=16, average="micro"),
+            "f1": F1Score(num_classes=16, average="macro"),
+        }
+    ).shard_state(mesh)
+    member = coll["f1"]
+    with count_collectives() as box:
+        jax.make_jaxpr(
+            lambda s: member.sync_states(s, "data"), axis_env=[("data", WORLD)]
+        )(member.init_state())
+    assert box["by_kind"].get("reshard", 0) >= 1
+    acc = coll["acc"]
+    with count_collectives() as box:
+        jax.make_jaxpr(lambda s: acc.sync_states(s, "data"), axis_env=[("data", WORLD)])(
+            acc.init_state()
+        )
+    assert box["by_kind"].get("reshard", 0) == 0
+    assert box["by_kind"].get("psum", 0) >= 1
+
+
+# --------------------------------------------------------------------------- #
+# lifecycle: reset / state_dict / checkpoint / unshard
+# --------------------------------------------------------------------------- #
+def _sharded_spec(leaf):
+    return getattr(leaf.sharding, "spec", None)
+
+
+def test_reset_keeps_placement(mesh):
+    rng = _rng()
+    m = ConfusionMatrix(num_classes=64).shard_state(mesh)
+    m.update(
+        jnp.asarray(rng.integers(0, 64, size=(64,))),
+        jnp.asarray(rng.integers(0, 64, size=(64,))),
+    )
+    m.reset()
+    assert _per_device_nbytes(m.confmat) * WORLD == int(m.confmat.nbytes)
+    assert np.asarray(m.confmat).sum() == 0
+
+
+def test_state_dict_roundtrip_keeps_placement(mesh):
+    rng = _rng()
+    preds = jnp.asarray(rng.integers(0, 64, size=(128,)))
+    target = jnp.asarray(rng.integers(0, 64, size=(128,)))
+
+    def build():
+        m = ConfusionMatrix(num_classes=64)
+        m._persistent["confmat"] = True  # state_dict snapshots persistent states
+        return m.shard_state(mesh)
+
+    src = build()
+    src.update(preds, target)
+
+    dst = build()
+    dst.load_state_dict(src.state_dict())
+    assert _per_device_nbytes(dst.confmat) * WORLD == int(dst.confmat.nbytes)
+    assert _leaves_equal(src.compute(), dst.compute())
+
+
+def test_checkpoint_roundtrip_sharded(mesh, tmp_path):
+    from metrics_tpu.checkpoint import restore_checkpoint, save_checkpoint
+
+    rng = _rng()
+    preds = jnp.asarray(rng.integers(0, 64, size=(128,)))
+    target = jnp.asarray(rng.integers(0, 64, size=(128,)))
+    src = ConfusionMatrix(num_classes=64).shard_state(mesh)
+    src.update(preds, target)
+    expect = np.asarray(src.compute())
+    save_checkpoint(src, str(tmp_path), step=1)
+
+    # sharded -> sharded: placement restored
+    dst = ConfusionMatrix(num_classes=64).shard_state(mesh)
+    restore_checkpoint(dst, str(tmp_path))
+    assert _per_device_nbytes(dst.confmat) * WORLD == int(dst.confmat.nbytes)
+    assert np.array_equal(expect, np.asarray(dst.compute()))
+
+    # sharded -> replicated: the payload is placement-free
+    flat = ConfusionMatrix(num_classes=64)
+    restore_checkpoint(flat, str(tmp_path))
+    assert np.array_equal(expect, np.asarray(flat.compute()))
+
+
+def test_checkpoint_fingerprint_includes_shard_axis():
+    from metrics_tpu.checkpoint.format import metric_fingerprint
+
+    fp = metric_fingerprint(ConfusionMatrix(num_classes=8))
+    assert fp["states"]["confmat"]["shard_axis"] == 0
+    fp_micro = metric_fingerprint(Accuracy(num_classes=8, average="micro"))
+    assert "shard_axis" not in fp_micro["states"]["tp"]
+
+
+def test_checkpoint_fingerprint_shard_axis_back_compat():
+    """Checkpoints written before a class gained its shard_axis declaration
+    must stay restorable — the declaration is placement-inert and the payload
+    placement-free. Two *conflicting* declarations still refuse."""
+    import copy
+
+    from metrics_tpu.checkpoint.format import fingerprint_diff, metric_fingerprint
+
+    live = metric_fingerprint(ConfusionMatrix(num_classes=8))
+    pre_sharding = copy.deepcopy(live)
+    del pre_sharding["states"]["confmat"]["shard_axis"]
+    assert fingerprint_diff(pre_sharding, live) == []  # old checkpoint, new class
+    assert fingerprint_diff(live, pre_sharding) == []  # new checkpoint, old class
+    conflicting = copy.deepcopy(live)
+    conflicting["states"]["confmat"]["shard_axis"] = 1
+    assert fingerprint_diff(conflicting, live)
+
+
+def test_sharded_catbuffer_keeps_overflow_flag(mesh):
+    """The sticky `overflowed` flag must survive sharded placement, the
+    per-step sharding constraint inside compiled updates, and the gather back
+    to replicated — dropping it would hand corrupt tail data to to_array()."""
+    from metrics_tpu.core.buffers import CatBuffer
+
+    m = CatMetric(buffer_capacity=WORLD).shard_state(mesh)
+    over = CatBuffer(jnp.zeros((WORLD,), jnp.float32), WORLD + 2, None, True)
+
+    placed = m._place_sharded_value("value", over)
+    assert bool(placed.overflowed)
+
+    constrained = m._constrain_state({"value": placed})["value"]
+    assert bool(constrained.overflowed)
+
+    m.value = placed
+    m.unshard_state()
+    assert bool(m.value.overflowed)
+
+
+def test_unshard_state(mesh):
+    rng = _rng()
+    m = ConfusionMatrix(num_classes=64).shard_state(mesh)
+    m.update(
+        jnp.asarray(rng.integers(0, 64, size=(128,))),
+        jnp.asarray(rng.integers(0, 64, size=(128,))),
+    )
+    before = np.asarray(m.compute())
+    m.unshard_state()
+    assert m.active_shard_axes == {}
+    assert _per_device_nbytes(m.confmat) == int(m.confmat.nbytes)
+    assert np.array_equal(before, np.asarray(m.compute()))
+
+
+# --------------------------------------------------------------------------- #
+# fused collection streaks with mixed members
+# --------------------------------------------------------------------------- #
+def test_fused_collection_mixed_sharded_members(mesh):
+    rng = _rng()
+    data = [
+        (
+            jnp.asarray(rng.random((64, 16), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, 16, size=(64,))),
+        )
+        for _ in range(5)
+    ]
+
+    def build():
+        return MetricCollection(
+            {
+                "acc": Accuracy(num_classes=16, average="micro"),  # replicated
+                "prec": Precision(num_classes=16, average="macro"),  # sharded
+                "confmat": ConfusionMatrix(num_classes=16),  # sharded
+            }
+        )
+
+    ref = build()
+    for args in data:
+        ref.update(*args)
+    expect = ref.compute()
+
+    coll = build().shard_state(mesh)
+    for args in data:
+        coll.update(*args)
+    got = coll.compute()
+
+    for key in expect:
+        assert _leaves_equal(expect[key], got[key]), key
+
+    stats = coll.engine_stats()["update"]
+    assert stats is not None and stats.compiled_calls > 0 and stats.donated_calls > 0
+
+    # member leaves really are distributed inside the fused streak
+    coll._realias_members()
+    confmat = coll["confmat"].confmat
+    assert _per_device_nbytes(confmat) * WORLD == int(confmat.nbytes)
+
+
+def test_collection_unshard_state(mesh):
+    rng = _rng()
+    coll = MetricCollection(
+        {"prec": Precision(num_classes=16, average="macro")}
+    ).shard_state(mesh)
+    coll.update(
+        jnp.asarray(rng.random((32, 16), dtype=np.float32)),
+        jnp.asarray(rng.integers(0, 16, size=(32,))),
+    )
+    before = coll.compute()
+    coll.unshard_state()
+    assert coll["prec"].active_shard_axes == {}
+    assert _leaves_equal(before, coll.compute())
+
+
+# --------------------------------------------------------------------------- #
+# engine capture: collective bytes land in EngineStats
+# --------------------------------------------------------------------------- #
+def test_engine_stats_record_reshard_bytes(mesh):
+    rng = _rng()
+    m = ConfusionMatrix(num_classes=64).shard_state(mesh)
+    # two update→compute cycles: the engine lifecycle runs the first call
+    # eager, so only the second compute goes through the compiled path where
+    # the trace-time collective capture happens
+    for _ in range(2):
+        m.update(
+            jnp.asarray(rng.integers(0, 64, size=(64,))),
+            jnp.asarray(rng.integers(0, 64, size=(64,))),
+        )
+        m.compute()
+    stats = m.engine_stats()["compute"]
+    assert stats is not None and stats.cache_misses > 0
+    assert not m.engine_stats()["fallback_reasons"]
+    # single-process sync short-circuits before emitting collectives; the
+    # capture contract is: whatever kinds the trace ticked are tallied
+    assert isinstance(stats.collective_counts, dict)
+    assert isinstance(stats.collective_bytes, dict)
