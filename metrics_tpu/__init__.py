@@ -65,6 +65,7 @@ from metrics_tpu.checkpoint import (  # noqa: F401
     save_checkpoint,
     verify_checkpoint,
 )
+from metrics_tpu import observability  # noqa: F401
 from metrics_tpu.detection import MeanAveragePrecision  # noqa: F401
 from metrics_tpu.image import (  # noqa: F401
     ErrorRelativeGlobalDimensionlessSynthesis,
@@ -140,6 +141,8 @@ __all__ = [
     "set_bucketed_sync", "bucketed_sync_enabled",
     # checkpoint
     "checkpoint", "save_checkpoint", "restore_checkpoint", "verify_checkpoint",
+    # observability (event tracer, instrument registry, exporters)
+    "observability",
     # aggregation
     "CatMetric", "MaxMetric", "MeanMetric", "MinMetric", "SumMetric",
     # audio

@@ -12,7 +12,7 @@ ground truth; stage 1 exists to point at the *line*.
 
 Code under an ``_is_concrete(...)`` / ``_tracing_active()`` / ``_is_traced(...)``
 guard (metrics_tpu.utils.checks) is host-side by design and exempt from
-A001/A002 within the guarded body.
+A001/A002/A007 within the guarded body.
 """
 from __future__ import annotations
 
@@ -44,6 +44,18 @@ SAFE_BUILTINS = {
 
 MUTATOR_METHODS = {"append", "extend", "insert", "update", "setdefault", "pop", "popitem", "clear", "add", "remove", "discard"}
 
+# host clocks (A007): under jit these evaluate once at trace time, baking a
+# constant timestamp into the compiled program
+CLOCK_FUNCS = {
+    "perf_counter", "perf_counter_ns", "monotonic", "monotonic_ns",
+    "time", "time_ns", "process_time", "process_time_ns",
+    "thread_time", "thread_time_ns",
+}
+
+# observability-tracer entry points (A007): emitting from a jit-facing method
+# fires once per compile, not once per step, and drags host work into tracing
+TRACER_EMITS = {"emit_instant", "emit_complete", "span", "record", "trace", "enable"}
+
 
 # --------------------------------------------------------------------------- #
 # per-module context (parsed once, shared by every class in the module)
@@ -57,6 +69,9 @@ class ModuleContext:
         self.np_aliases: Set[str] = set()
         self.jax_aliases: Set[str] = set()
         self.module_mutables: Set[str] = set()
+        self.time_aliases: Set[str] = set()
+        self.clock_names: Set[str] = set()
+        self.tracer_aliases: Set[str] = set()
         self._scan_toplevel()
 
     def _scan_toplevel(self) -> None:
@@ -68,6 +83,11 @@ class ModuleContext:
                         self.np_aliases.add(bound)
                     elif alias.name.split(".")[0] == "jax":
                         self.jax_aliases.add(bound)
+                    elif alias.name.split(".")[0] == "time":
+                        self.time_aliases.add(bound)
+                    elif "observability" in alias.name:
+                        # import metrics_tpu.observability[.tracer] as _otrace
+                        self.tracer_aliases.add(alias.asname or alias.name.split(".")[0])
             elif isinstance(node, ast.ImportFrom):
                 root = (node.module or "").split(".")[0]
                 for alias in node.names:
@@ -76,6 +96,17 @@ class ModuleContext:
                         self.np_aliases.add(bound)
                     elif root == "jax" and alias.name in ("numpy", "lax"):
                         self.jax_aliases.add(bound)
+                    elif root == "time" and alias.name in CLOCK_FUNCS:
+                        self.clock_names.add(bound)
+                    elif "observability" in (node.module or "") or (
+                        root == "metrics_tpu" and alias.name == "observability"
+                    ):
+                        # from metrics_tpu.observability import tracer, or a
+                        # direct emit import — either way, track the binding
+                        if alias.name in TRACER_EMITS:
+                            self.clock_names.add(bound)  # bare-call check path
+                        else:
+                            self.tracer_aliases.add(bound)
             elif isinstance(node, ast.Assign):
                 if isinstance(node.value, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)):
                     for tgt in node.targets:
@@ -376,6 +407,14 @@ class _MethodLinter:
                     node,
                     f"{func.id}() on a traced input/state value forces a device→host sync",
                 )
+            elif func.id in self.ctx.clock_names and self.guard_depth == 0:
+                self.emit(
+                    "A007",
+                    node,
+                    f"`{func.id}()` (host clock / tracer emit) inside {self.fn.name}() — "
+                    "evaluated once at trace time, not per compiled step; record at "
+                    "the dispatch layer instead",
+                )
             return
         if not isinstance(func, ast.Attribute):
             return
@@ -383,6 +422,25 @@ class _MethodLinter:
             self.emit("A001", node, f".{func.attr}() on a traced input/state value forces a device→host sync")
             return
         root = self._root_name(func)
+        if root in self.ctx.time_aliases and func.attr in CLOCK_FUNCS and self.guard_depth == 0:
+            self.emit(
+                "A007",
+                node,
+                f"host-clock read `{root}.{func.attr}()` inside {self.fn.name}() — under "
+                "jit this bakes a trace-time constant into the compiled program; move "
+                "timing to the dispatch layer (metrics_tpu.observability) or guard "
+                "with _is_concrete/_tracing_active",
+            )
+            return
+        if root in self.ctx.tracer_aliases and func.attr in TRACER_EMITS and self.guard_depth == 0:
+            self.emit(
+                "A007",
+                node,
+                f"tracer call `{root}.{func.attr}(...)` inside {self.fn.name}() — fires "
+                "once per compile under jit, not per step; emit from the dispatch "
+                "layer, never from jit-facing metric methods",
+            )
+            return
         if root in self.ctx.np_aliases and self.guard_depth == 0 and self._call_args_tainted(node):
             self.emit(
                 "A001",

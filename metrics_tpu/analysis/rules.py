@@ -78,6 +78,15 @@ _AST_RULES = (
         "other than self — during fused collection streaks member state is "
         "stale between observation points, so such reads see outdated values.",
     ),
+    Rule(
+        "A007", "host-clock-in-trace", ERROR,
+        "update/compute reads a host clock (time.perf_counter/monotonic/...) "
+        "or calls the observability tracer's emit/span API — under jit the "
+        "clock value is baked into the compiled program as a trace-time "
+        "constant and tracer events fire once per compile, not per step; "
+        "record telemetry at the dispatch layer (metrics_tpu.observability) "
+        "or guard with _is_concrete/_tracing_active.",
+    ),
 )
 
 # --------------------------------------------------------------------------- #

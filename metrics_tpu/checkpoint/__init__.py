@@ -24,11 +24,14 @@ snapshot directories without importing any metric class.
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass
+import time
+from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
 import numpy as np
 
+from metrics_tpu.observability import instruments as _instruments
+from metrics_tpu.observability import tracer as _otrace
 from metrics_tpu.checkpoint.format import (
     FORMAT_VERSION,
     build_shard,
@@ -91,6 +94,14 @@ class SaveHandle:
     ``committed`` reports whether this host observed the snapshot reach its
     committed state (on multi-host saves the *last* finishing host commits, so
     early hosts legitimately see ``False``).
+
+    ``timings`` holds per-phase wall seconds — ``snapshot_s`` (live state →
+    payload pytree), ``host_copy_s`` (device→host transfer), ``write_s``
+    (npz + sidecar + fsync into the pending dir), ``commit_s`` (manifest +
+    atomic rename), ``total_s`` — recorded for every save (blocking or async;
+    the write/commit entries appear once the background thread finishes, so
+    read them after ``wait()``). This is the baseline the ROADMAP's "overlap
+    async save with the next update step" item needs to beat.
     """
 
     root: str
@@ -98,6 +109,7 @@ class SaveHandle:
     shard_index: int
     world_size: int
     committed: bool = False
+    timings: Dict[str, float] = field(default_factory=dict)
     _thread: Optional[threading.Thread] = None
     _error: Optional[BaseException] = None
 
@@ -119,6 +131,24 @@ def _host_copy(payload: Dict[str, Any]) -> Dict[str, np.ndarray]:
     # force the device->host transfer now, so async saves never race live
     # (possibly donation-aliased) device buffers
     return {k: np.asarray(v) for k, v in payload.items()}
+
+
+def _emit_phase(name: str, t0: float, t1: float, **args: Any) -> None:
+    """Tracer span for a checkpoint phase from perf_counter endpoints (same
+    clock as the tracer's microsecond timestamps)."""
+    _otrace.emit_complete(name, "checkpoint", int(t0 * 1e6), int((t1 - t0) * 1e6), **args)
+
+
+def _observe_phases(prefix: str, timings: Dict[str, float]) -> None:
+    """Fold recorded phase durations into the registry's checkpoint
+    histograms (always on: checkpoint phases are ms-scale, a histogram
+    observe is nanoseconds)."""
+    for key, seconds in timings.items():
+        _instruments.REGISTRY.histogram(
+            "checkpoint_phase_seconds",
+            help="wall seconds per checkpoint phase",
+            op=prefix, phase=key[:-2] if key.endswith("_s") else key,
+        ).observe(seconds)
 
 
 def save_checkpoint(
@@ -154,14 +184,38 @@ def save_checkpoint(
     if step is None:
         step = next_step(root)
 
+    t0 = time.perf_counter()
     payload, shard_meta = build_shard(obj)
+    t1 = time.perf_counter()
     payload = _host_copy(payload)
+    t2 = time.perf_counter()
     handle = SaveHandle(root=root, step=int(step), shard_index=shard_index, world_size=world_size)
+    handle.timings["snapshot_s"] = t1 - t0
+    handle.timings["host_copy_s"] = t2 - t1
+    payload_bytes = sum(int(v.nbytes) for v in payload.values())
+    if _otrace.active:
+        _emit_phase("checkpoint/save/snapshot", t0, t1, step=handle.step, leaves=len(payload))
+        _emit_phase("checkpoint/save/host_copy", t1, t2, step=handle.step, bytes=payload_bytes)
 
     def _write() -> None:
+        # on async saves this runs on the daemon thread: the tracer records
+        # that thread's id, so the write/commit spans land on their own
+        # Perfetto track next to the main thread's update steps
         try:
+            w0 = time.perf_counter()
             write_shard(pending_dir(root, handle.step), shard_index, world_size, payload, shard_meta)
+            w1 = time.perf_counter()
             handle.committed = try_commit(root, handle.step, world_size)
+            w2 = time.perf_counter()
+            handle.timings["write_s"] = w1 - w0
+            handle.timings["commit_s"] = w2 - w1
+            handle.timings["total_s"] = w2 - t0
+            if _otrace.active:
+                _emit_phase("checkpoint/save/write", w0, w1,
+                            step=handle.step, shard=handle.shard_index, bytes=payload_bytes)
+                _emit_phase("checkpoint/save/commit", w1, w2,
+                            step=handle.step, committed=handle.committed)
+            _observe_phases("save", handle.timings)
         except BaseException as err:  # surfaced by wait()
             handle._error = err
 

@@ -21,12 +21,14 @@ start for all mergeable reductions.
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.observability import tracer as _otrace
 from metrics_tpu.checkpoint import io as _io
 from metrics_tpu.checkpoint.format import (
     SELF_KEY,
@@ -49,6 +51,10 @@ class RestoreInfo:
     shards_loaded: Tuple[int, ...]  # shard indices folded into this host
     host_index: int
     host_count: int
+    # wall seconds per phase: verify_s (manifest/fingerprint/checksum checks +
+    # host-side shard load/fold — everything before live state is touched) and
+    # apply_s (folded state applied + dispatch invalidation)
+    timings: Dict[str, float] = field(default_factory=dict)
 
 
 @dataclass
@@ -170,6 +176,7 @@ def restore_checkpoint(
         except Exception:
             host_index = 0
 
+    t0 = time.perf_counter()
     step = _io.resolve_step(root, step)
     manifest = _io.read_manifest(root, step)
 
@@ -208,6 +215,13 @@ def restore_checkpoint(
             states.append(_decode_member_state(payload, key, leaves))
             counts.append(int(mmeta["update_count"]))
         folded[key] = fold_member_shards(metric, key, states, counts, leaves)
+    t1 = time.perf_counter()
+    if _otrace.active:
+        _otrace.emit_complete(
+            "checkpoint/restore/verify", "checkpoint",
+            int(t0 * 1e6), int((t1 - t0) * 1e6),
+            step=step, shards=len(mine), world_size=world_size,
+        )
 
     # pass 2: apply + invalidate dispatch state
     for key, metric in members.items():
@@ -232,6 +246,13 @@ def restore_checkpoint(
     if kind == "collection":
         obj._members_stale = False
         obj._invalidate_dispatch()
+    t2 = time.perf_counter()
+    if _otrace.active:
+        _otrace.emit_complete(
+            "checkpoint/restore/apply", "checkpoint",
+            int(t1 * 1e6), int((t2 - t1) * 1e6),
+            step=step, members=len(members),
+        )
     return RestoreInfo(
         root=root,
         step=step,
@@ -239,6 +260,7 @@ def restore_checkpoint(
         shards_loaded=mine,
         host_index=host_index,
         host_count=host_count,
+        timings={"verify_s": t1 - t0, "apply_s": t2 - t1, "total_s": t2 - t0},
     )
 
 

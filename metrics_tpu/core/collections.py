@@ -30,6 +30,8 @@ from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tupl
 import jax
 
 from metrics_tpu.core.metric import Metric, StateDict
+from metrics_tpu.observability import instruments as _instruments
+from metrics_tpu.observability import tracer as _otrace
 from metrics_tpu.utils.data import _squeeze_if_scalar
 from metrics_tpu.utils.exceptions import MetricsUserError
 
@@ -172,6 +174,12 @@ class MetricCollection:
         if not self._members_stale:
             return
         self._members_stale = False
+        if _otrace.active:
+            _otrace.emit_instant(
+                "streak/realias", "streak",
+                owner=type(self).__name__,
+                members=sum(len(g) - 1 for g in self._groups),
+            )
         for group in self._groups:
             if len(group) == 1:
                 continue
@@ -311,28 +319,25 @@ class MetricCollection:
         ``update``/``compute`` are the collection-level engines'
         :class:`EngineStats` (``None`` until built), ``members`` maps each
         member name to its own :meth:`Metric.engine_stats`, and
-        ``fallback_reasons`` merges every recorded eager-fallback reason keyed
-        ``"<kind>:<OwnerClass>"`` — so a collection silently demoted to the
-        eager loop is one dict lookup away from its cause.
+        ``fallback_reasons`` merges every recorded eager-fallback reason —
+        collection-level engines keyed ``"<kind>:<OwnerClass>"``, member
+        reasons keyed ``"<member_name>.<kind>:<MetricClass>"`` (the member
+        *name* prefix keeps two members of the same class, e.g.
+        ``{"a": F1(), "b": F1()}``, from colliding on one key) — so a
+        collection silently demoted to the eager loop is one dict lookup away
+        from its cause. Assembled by the observability instrument registry's
+        view helpers; the same stats appear in
+        ``metrics_tpu.observability.to_prometheus_text()`` snapshots.
         """
-        stats: Dict[str, Any] = {
-            "update": self._update_engine.stats if self._update_engine is not None else None,
-            "compute": self._compute_engine.stats if self._compute_engine is not None else None,
-        }
-        reasons: Dict[str, str] = {}
-        for kind in ("update", "compute"):
-            s = stats[kind]
-            if s is not None:
-                for owner, why in s.fallback_reasons.items():
-                    reasons[f"{kind}:{owner}"] = why
+        stats = _instruments.engine_stats_view(self._update_engine, self._compute_engine)
+        reasons: Dict[str, str] = stats["fallback_reasons"]
         members: Dict[str, Any] = {}
         for name in self._metrics:
             member = self._metrics.__getitem__(name)
             member_stats = member.engine_stats()
             members[name] = member_stats
-            reasons.update(member_stats["fallback_reasons"])
+            _instruments.merge_member_reasons(reasons, name, member_stats["fallback_reasons"])
         stats["members"] = members
-        stats["fallback_reasons"] = reasons
         return stats
 
     def update(self, *args: Any, **kwargs: Any) -> None:

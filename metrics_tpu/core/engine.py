@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 import weakref
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -57,6 +58,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.observability import instruments as _instruments
+from metrics_tpu.observability import tracer as _otrace
 from metrics_tpu.parallel import sync as _sync
 from metrics_tpu.utils.checks import _tracing_active
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -161,6 +164,13 @@ class EngineStats:
     # the eager path; feeds ``engine_stats()`` so runtime fallbacks can be
     # diffed against the static analyzer's findings (metrics_tpu.analysis)
     fallback_reasons: Dict[str, str] = field(default_factory=dict)
+    # cumulative wall time of cache-miss compiles (the first compiled call per
+    # signature, trace + XLA compile + run) — the cost that dominates
+    # first-epoch latency yet was invisible in the dispatch counters
+    compile_seconds: float = 0.0
+    # 1-based engine dispatch count at which the permanent eager fallback
+    # happened (None = never fell back); pins "which member fell back *when*"
+    last_fallback_step: Optional[int] = None
 
     @property
     def compiled_calls(self) -> int:
@@ -344,6 +354,9 @@ class _EngineBase:
         self._args_sig = _SigCache()
         self._state_sig = _SigCache()
         self._out_sigs: Dict[Any, Tuple] = {}  # dispatch key -> output state sig
+        # weakly tracked by the instrument registry: this engine's stats show
+        # up in observability snapshots as metrics_tpu_engine_*{kind,owner}
+        _instruments.register_engine(self)
 
     def __deepcopy__(self, memo: Dict) -> None:
         # clones/pickles rebuild their engine lazily (jitted executables are
@@ -371,6 +384,16 @@ class _EngineBase:
         owner = getattr(self, "metric", None) or getattr(self, "collection", None)
         return type(owner).__name__ if owner is not None else type(self).__name__
 
+    def _call_bridged(self, fn: Callable, state: Any, args: Tuple, kwargs: Dict) -> Any:
+        """Run ``fn`` under a ``jax.profiler.TraceAnnotation`` when the host
+        tracer is on, so compiled dispatches line up with the device timeline
+        when a ``jax.profiler`` trace (``utils/profiling.py``) runs alongside.
+        Only called off the plain hot path (cold compile, or tracer active)."""
+        if not _otrace.active:
+            return fn(state, *args, **kwargs)
+        with jax.profiler.TraceAnnotation(f"metrics_tpu/{self._owner_name()}.{self._kind}"):
+            return fn(state, *args, **kwargs)
+
     def _dispatch(self, plain_fn: Callable, donate_fn: Callable,
                   state: Any, args: Tuple, kwargs: Dict, protected: set) -> Tuple[bool, Any]:
         """Core cache dance. Returns (handled, result)."""
@@ -382,6 +405,11 @@ class _EngineBase:
         self._seen[key] = count + 1
         if count < _WARMUP_CALLS:
             self.stats.eager_calls += 1
+            if _otrace.active:
+                _otrace.emit_instant(
+                    "dispatch/eager", "engine",
+                    owner=self._owner_name(), kind=self._kind,
+                )
             return False, None
 
         donate_ok = self._donate and count > _WARMUP_CALLS  # first compiled call doubles as a trace probe
@@ -396,18 +424,50 @@ class _EngineBase:
         try:
             if count == _WARMUP_CALLS:
                 # the first compiled call traces: capture the collective tally
-                # (op counts + approx payload bytes per kind) into the stats
+                # (op counts + approx payload bytes per kind) into the stats.
+                # perf_counter here is cold-path only (once per signature) and
+                # records the number first-epoch latency is made of.
+                t0 = time.perf_counter()
                 with _sync.count_collectives() as box:
-                    new_state = fn(state, *args, **kwargs)
+                    new_state = self._call_bridged(fn, state, args, kwargs)
+                compile_s = time.perf_counter() - t0
+                self.stats.compile_seconds += compile_s
                 for kind, n in box["by_kind"].items():
                     self.stats.collective_counts[kind] = self.stats.collective_counts.get(kind, 0) + n
                 for kind, n in box["bytes_by_kind"].items():
                     self.stats.collective_bytes[kind] = self.stats.collective_bytes.get(kind, 0) + n
+                if _otrace.active:
+                    now_us = _otrace._now_us()
+                    _otrace.emit_complete(
+                        "dispatch/compile", "engine",
+                        now_us - int(compile_s * 1e6), int(compile_s * 1e6),
+                        owner=self._owner_name(), kind=self._kind,
+                        compile_s=compile_s,
+                        collectives=dict(box["by_kind"]),
+                        collective_bytes=dict(box["bytes_by_kind"]),
+                    )
+            elif _otrace.active:
+                t0_us = _otrace._now_us()
+                new_state = self._call_bridged(fn, state, args, kwargs)
+                _otrace.emit_complete(
+                    "dispatch/cached", "engine", t0_us, _otrace._now_us() - t0_us,
+                    owner=self._owner_name(), kind=self._kind, donated=donate_ok,
+                )
             else:
                 new_state = fn(state, *args, **kwargs)
         except Exception as err:  # untraceable target: revert to eager for good
             self._broken = f"{type(err).__name__}: {err}"
             self.stats.fallback_reasons[self._owner_name()] = self._broken
+            self.stats.last_fallback_step = (
+                self.stats.eager_calls + self.stats.compiled_calls + 1
+            )
+            if _otrace.active:
+                _otrace.emit_instant(
+                    "dispatch/fallback", "engine",
+                    owner=self._owner_name(), kind=self._kind,
+                    reason=self._broken.splitlines()[0][:200],
+                    step=self.stats.last_fallback_step,
+                )
             rank_zero_warn(
                 f"compiled-{self._kind} engine disabled for {self._owner_name()} "
                 f"({type(self).__name__}) target: "
@@ -616,6 +676,12 @@ class CollectionUpdateEngine(_EngineBase):
                 for name in group[1:]:
                     coll._metrics[name]._detach_states()
             coll._members_stale = True
+            if _otrace.active:
+                _otrace.emit_instant(
+                    "streak/detach", "streak",
+                    owner=self._owner_name(),
+                    members=sum(len(g) - 1 for g in coll._groups),
+                )
         handled, new_states = self._dispatch(
             self._jit_plain, self._jit_donate, states, args, kwargs,
             self._default_ids,

@@ -36,6 +36,8 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.core.buffers import CatBuffer, _is_traced
+from metrics_tpu.observability import instruments as _instruments
+from metrics_tpu.observability import tracer as _otrace
 from metrics_tpu.parallel import mesh as _meshlib
 from metrics_tpu.parallel import sync as _sync
 from metrics_tpu.utils.data import (
@@ -365,6 +367,7 @@ class Metric:
                 "state stays fully replicated.",
                 UserWarning,
             )
+        t0_us = _otrace._now_us() if _otrace.active else 0
         self._state_sharding = (mesh, axis_name)
         for name in self._shard_axes:
             setattr(self, name, self._place_sharded_value(name, getattr(self, name)))
@@ -374,6 +377,12 @@ class Metric:
         self._update_engine = None
         self._compute_engine = None
         self._invalidate_dispatch()
+        if _otrace.active:
+            _otrace.emit_complete(
+                "shard/place", "shard", t0_us, _otrace._now_us() - t0_us,
+                owner=type(self).__name__, leaves=len(self._shard_axes),
+                axis=axis_name,
+            )
         return self
 
     def unshard_state(self) -> "Metric":
@@ -388,6 +397,7 @@ class Metric:
                 return CatBuffer(jax.device_put(np.asarray(val.data)), val.count, val.capacity, val.overflowed)
             return jax.device_put(np.asarray(val))
 
+        t0_us = _otrace._now_us() if _otrace.active else 0
         for name in self._shard_axes:
             setattr(self, name, gather(getattr(self, name)))
             self._defaults[name] = gather(self._defaults[name])
@@ -395,6 +405,11 @@ class Metric:
         self._update_engine = None
         self._compute_engine = None
         self._invalidate_dispatch()
+        if _otrace.active:
+            _otrace.emit_complete(
+                "shard/unshard", "shard", t0_us, _otrace._now_us() - t0_us,
+                owner=type(self).__name__, leaves=len(self._shard_axes),
+            )
         return self
 
     def _constrain_state(self, state: StateDict) -> StateDict:
@@ -874,18 +889,13 @@ class Metric:
         ``fallback_reasons`` merges both engines' recorded eager-fallback
         reasons keyed ``"<kind>:<MetricClass>"`` — the runtime counterpart of
         the static findings from ``python -m metrics_tpu.analysis``.
+
+        This is a view assembled by the observability instrument registry
+        (:func:`metrics_tpu.observability.instruments.engine_stats_view`) over
+        the same live :class:`EngineStats` objects that registry exports as
+        Prometheus-style counters — one source of truth, two read paths.
         """
-        stats: Dict[str, Any] = {
-            "update": self._update_engine.stats if self._update_engine is not None else None,
-            "compute": self._compute_engine.stats if self._compute_engine is not None else None,
-        }
-        reasons: Dict[str, str] = {}
-        for kind, s in stats.items():
-            if s is not None:
-                for owner, why in s.fallback_reasons.items():
-                    reasons[f"{kind}:{owner}"] = why
-        stats["fallback_reasons"] = reasons
-        return stats
+        return _instruments.engine_stats_view(self._update_engine, self._compute_engine)
 
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)

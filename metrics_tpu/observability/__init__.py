@@ -1,0 +1,94 @@
+"""Unified runtime telemetry for the compiled engines.
+
+Three pieces (see ``docs/observability.md`` for the full architecture):
+
+* :mod:`~metrics_tpu.observability.tracer` — an off-by-default bounded
+  ring-buffer **event tracer** recording timestamped spans for every runtime
+  lifecycle event: engine dispatch (warmup / compile / cached / donated /
+  fallback), fused-streak detach/realias, sync bucket builds with per-kind
+  collective tallies, shard placement, and checkpoint save/restore phases.
+* :mod:`~metrics_tpu.observability.instruments` — an **instrument registry**
+  unifying every live engine's :class:`EngineStats` and the manual
+  counters/gauges/histograms under Prometheus-style names;
+  ``Metric.engine_stats()`` / ``MetricCollection.engine_stats()`` are views
+  over it.
+* :mod:`~metrics_tpu.observability.export` — **exporters**: Chrome
+  trace-event JSON (loads in Perfetto next to ``jax.profiler`` device
+  traces), Prometheus text / JSON snapshots, and summarize/diff analytics.
+
+``python -m metrics_tpu.observability`` dumps, summarizes, validates, and
+diffs trace files from the command line.
+
+Quick start::
+
+    from metrics_tpu import observability as obs
+
+    with obs.trace() as tracer:
+        for batch in loader:
+            coll.update(**batch)
+        values = coll.compute()
+    obs.write_chrome_trace("run.trace.json", tracer)   # open in Perfetto
+    print(obs.to_prometheus_text())                    # engine counters
+
+The disabled path costs one module-attribute boolean check per
+instrumentation site (``tracer.active``) — nothing else runs, so the compiled
+engines' dispatch overhead is unchanged (guarded by
+``tests/observability/test_overhead.py``; numbers in ``BENCH_r12.json``).
+"""
+from metrics_tpu.observability.tracer import (
+    DEFAULT_CAPACITY,
+    EVENT_CATALOG,
+    EventTracer,
+    TraceEvent,
+    disable,
+    enable,
+    enabled,
+    get_tracer,
+    trace,
+)
+from metrics_tpu.observability.instruments import (
+    Counter,
+    Gauge,
+    Histogram,
+    InstrumentRegistry,
+    REGISTRY,
+    Sample,
+    get_registry,
+)
+from metrics_tpu.observability.export import (
+    diff_traces,
+    load_trace,
+    summarize_trace,
+    to_chrome_trace,
+    to_metrics_json,
+    to_prometheus_text,
+    validate_chrome_trace,
+    write_chrome_trace,
+)
+
+__all__ = [
+    "DEFAULT_CAPACITY",
+    "EVENT_CATALOG",
+    "EventTracer",
+    "TraceEvent",
+    "enable",
+    "disable",
+    "enabled",
+    "get_tracer",
+    "trace",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "InstrumentRegistry",
+    "REGISTRY",
+    "Sample",
+    "get_registry",
+    "to_chrome_trace",
+    "write_chrome_trace",
+    "validate_chrome_trace",
+    "load_trace",
+    "summarize_trace",
+    "diff_traces",
+    "to_prometheus_text",
+    "to_metrics_json",
+]

@@ -1,0 +1,305 @@
+"""The instrument registry: one namespace over every runtime counter.
+
+Before this module the repo's runtime numbers lived in three disjoint places:
+per-engine :class:`~metrics_tpu.core.engine.EngineStats` dataclasses, the
+``count_collectives`` trace-time tallies folded into them, and ad-hoc
+``engine_stats()`` dicts assembled by ``Metric``/``MetricCollection``. The
+registry unifies them under Prometheus-style identities —
+``metrics_tpu_engine_cache_hits{kind="update",owner="MulticlassF1Score"}`` —
+without moving the source of truth: engines keep mutating their own
+``EngineStats`` fields exactly as before (zero new work on the dispatch hot
+path), and the registry holds *weak references* to the live engines, walking
+them only when a snapshot is requested. ``Metric.engine_stats()`` /
+``MetricCollection.engine_stats()`` are now thin views assembled by
+:func:`engine_stats_view` / :func:`collection_engine_stats_view` over the same
+objects, so every existing caller — including the analyzer's
+runtime-vs-static diff — sees the exact legacy dict shape.
+
+Manual instruments (:class:`Counter`, :class:`Gauge`, :class:`Histogram`) are
+for the non-engine subsystems: checkpoint phase durations land in histograms,
+tracer drop counts in a counter. They are plain Python objects guarded by the
+GIL — increments are a dict-free attribute add.
+
+Export: :meth:`InstrumentRegistry.samples` yields flat ``Sample`` rows;
+``export.to_prometheus_text`` / ``export.to_metrics_json`` render them.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import weakref
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+# name prefix for every sample this library exports
+PREFIX = "metrics_tpu_"
+
+# EngineStats integer fields exported one counter each (field name == suffix)
+_ENGINE_COUNTER_FIELDS = (
+    "eager_calls",
+    "cache_misses",
+    "cache_hits",
+    "donated_calls",
+    "bucketed_calls",
+    "key_fast_hits",
+)
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted(labels.items()))
+
+
+@dataclass
+class Sample:
+    """One exported time-series point: ``name{labels} value``."""
+
+    name: str
+    labels: Dict[str, str]
+    value: float
+    kind: str  # "counter" | "gauge" | "histogram_bucket" | "histogram_sum" | "histogram_count"
+    help: str = ""
+
+
+class Counter:
+    """Monotonic accumulator."""
+
+    __slots__ = ("name", "labels", "help", "value")
+
+    def __init__(self, name: str, labels: Dict[str, str], help: str = "") -> None:
+        self.name, self.labels, self.help = name, labels, help
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        self.value += amount
+
+    def samples(self) -> List[Sample]:
+        return [Sample(self.name, self.labels, self.value, "counter", self.help)]
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ("name", "labels", "help", "value")
+
+    def __init__(self, name: str, labels: Dict[str, str], help: str = "") -> None:
+        self.name, self.labels, self.help = name, labels, help
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+    def samples(self) -> List[Sample]:
+        return [Sample(self.name, self.labels, self.value, "gauge", self.help)]
+
+
+# log-spaced seconds buckets covering 100 us .. ~100 s — wide enough for both
+# a host_copy of a few MB and a cold XLA compile
+DEFAULT_SECONDS_BUCKETS = (
+    1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 1e-1, 3e-1, 1.0, 3.0, 10.0, 30.0, 100.0,
+)
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics: each bucket counts
+    observations ``<= le``, with a final ``+Inf`` bucket equal to count)."""
+
+    __slots__ = ("name", "labels", "help", "buckets", "counts", "sum", "count")
+
+    def __init__(
+        self,
+        name: str,
+        labels: Dict[str, str],
+        buckets: Sequence[float] = DEFAULT_SECONDS_BUCKETS,
+        help: str = "",
+    ) -> None:
+        self.name, self.labels, self.help = name, labels, help
+        self.buckets = tuple(sorted(buckets))
+        self.counts = [0] * len(self.buckets)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.sum += value
+        self.count += 1
+        for i, le in enumerate(self.buckets):
+            if value <= le:
+                self.counts[i] += 1
+
+    def samples(self) -> List[Sample]:
+        out = []
+        for le, c in zip(self.buckets, self.counts):
+            out.append(Sample(
+                f"{self.name}_bucket", {**self.labels, "le": repr(float(le))},
+                float(c), "histogram_bucket", self.help,
+            ))
+        out.append(Sample(
+            f"{self.name}_bucket", {**self.labels, "le": "+Inf"},
+            float(self.count), "histogram_bucket", self.help,
+        ))
+        out.append(Sample(f"{self.name}_sum", dict(self.labels), self.sum, "histogram_sum", self.help))
+        out.append(Sample(f"{self.name}_count", dict(self.labels), float(self.count), "histogram_count", self.help))
+        return out
+
+
+class InstrumentRegistry:
+    """Get-or-create registry of instruments plus weakly-held live engines.
+
+    ``counter/gauge/histogram`` return the existing instrument when the
+    ``(name, labels)`` identity was seen before, so call sites never need to
+    cache handles. Engines self-register at construction
+    (:func:`register_engine`); dead ones drop out of snapshots automatically
+    via their weakrefs.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._instruments: Dict[Tuple[str, Tuple], Any] = {}
+        self._engines: List[weakref.ref] = []
+
+    # ------------------------------------------------------------------ #
+    # manual instruments
+    # ------------------------------------------------------------------ #
+    def _get_or_create(self, cls: type, name: str, labels: Dict[str, str],
+                       help: str = "", **kw: Any) -> Any:
+        if not name.startswith(PREFIX):
+            name = PREFIX + name
+        key = (name, _label_key(labels))
+        with self._lock:
+            inst = self._instruments.get(key)
+            if inst is None:
+                inst = cls(name, dict(labels), help=help, **kw)
+                self._instruments[key] = inst
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"instrument {name}{labels} already registered as "
+                    f"{type(inst).__name__}, requested {cls.__name__}"
+                )
+            return inst
+
+    def counter(self, name: str, help: str = "", **labels: str) -> Counter:
+        return self._get_or_create(Counter, name, labels, help)
+
+    def gauge(self, name: str, help: str = "", **labels: str) -> Gauge:
+        return self._get_or_create(Gauge, name, labels, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_SECONDS_BUCKETS, **labels: str) -> Histogram:
+        return self._get_or_create(Histogram, name, labels, help, buckets=buckets)
+
+    # ------------------------------------------------------------------ #
+    # engine registration — the EngineStats bridge
+    # ------------------------------------------------------------------ #
+    def register_engine(self, engine: Any) -> None:
+        """Weakly track a live engine; its ``EngineStats`` fields appear in
+        snapshots as ``metrics_tpu_engine_*{kind=...,owner=...}`` counters."""
+        with self._lock:
+            self._engines.append(weakref.ref(engine))
+
+    def live_engines(self) -> List[Any]:
+        out, kept = [], []
+        with self._lock:
+            for ref in self._engines:
+                engine = ref()
+                if engine is not None:
+                    out.append(engine)
+                    kept.append(ref)
+            self._engines = kept
+        return out
+
+    def _engine_samples(self) -> Iterable[Sample]:
+        for engine in self.live_engines():
+            stats = engine.stats
+            labels = {"kind": engine._kind, "owner": engine._owner_name()}
+            for fname in _ENGINE_COUNTER_FIELDS:
+                yield Sample(f"{PREFIX}engine_{fname}", dict(labels),
+                             float(getattr(stats, fname)), "counter")
+            yield Sample(f"{PREFIX}engine_compiled_calls", dict(labels),
+                         float(stats.compiled_calls), "counter")
+            yield Sample(f"{PREFIX}engine_compile_seconds", dict(labels),
+                         float(getattr(stats, "compile_seconds", 0.0)), "counter")
+            for op, n in stats.collective_counts.items():
+                yield Sample(f"{PREFIX}engine_collective_ops", {**labels, "op": op},
+                             float(n), "counter")
+            for op, n in stats.collective_bytes.items():
+                yield Sample(f"{PREFIX}engine_collective_bytes", {**labels, "op": op},
+                             float(n), "counter")
+            broken = 1.0 if getattr(engine, "broken", None) else 0.0
+            yield Sample(f"{PREFIX}engine_fallback_active", dict(labels), broken, "gauge")
+            last_step = getattr(stats, "last_fallback_step", None)
+            if last_step is not None:
+                yield Sample(f"{PREFIX}engine_last_fallback_step", dict(labels),
+                             float(last_step), "gauge")
+
+    # ------------------------------------------------------------------ #
+    def samples(self) -> List[Sample]:
+        """Flat snapshot of every instrument plus every live engine's stats."""
+        out: List[Sample] = []
+        with self._lock:
+            instruments = list(self._instruments.values())
+        for inst in instruments:
+            out.extend(inst.samples())
+        out.extend(self._engine_samples())
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-friendly snapshot: ``{name: [{labels, value}, ...]}``."""
+        grouped: Dict[str, Any] = {}
+        for s in self.samples():
+            grouped.setdefault(s.name, []).append(
+                {"labels": s.labels, "value": s.value, "kind": s.kind}
+            )
+        return grouped
+
+    def clear(self) -> None:
+        """Drop every manual instrument and engine registration (tests)."""
+        with self._lock:
+            self._instruments.clear()
+            self._engines.clear()
+
+
+# the process-wide default registry; engines register here at construction
+REGISTRY = InstrumentRegistry()
+
+
+def register_engine(engine: Any) -> None:
+    """Module-level convenience over ``REGISTRY.register_engine``."""
+    REGISTRY.register_engine(engine)
+
+
+def get_registry() -> InstrumentRegistry:
+    return REGISTRY
+
+
+# --------------------------------------------------------------------------- #
+# legacy engine_stats() views
+# --------------------------------------------------------------------------- #
+def engine_stats_view(update_engine: Any, compute_engine: Any) -> Dict[str, Any]:
+    """The exact dict ``Metric.engine_stats()`` has always returned, assembled
+    from the live engines (``None`` slots for engines not yet built):
+    ``{"update": EngineStats|None, "compute": EngineStats|None,
+    "fallback_reasons": {"<kind>:<Owner>": why}}``."""
+    stats: Dict[str, Any] = {
+        "update": update_engine.stats if update_engine is not None else None,
+        "compute": compute_engine.stats if compute_engine is not None else None,
+    }
+    reasons: Dict[str, str] = {}
+    for kind, s in stats.items():
+        if s is not None:
+            for owner, why in s.fallback_reasons.items():
+                reasons[f"{kind}:{owner}"] = why
+    stats["fallback_reasons"] = reasons
+    return stats
+
+
+def merge_member_reasons(reasons: Dict[str, str], member_name: str,
+                         member_reasons: Dict[str, str]) -> None:
+    """Fold one collection member's fallback reasons into the collection-level
+    dict, prefixed with the member's *name* — two members sharing a metric
+    class (``{"a": F1(), "b": F1()}``) must not collide on ``"update:F1"``."""
+    for key, why in member_reasons.items():
+        reasons[f"{member_name}.{key}"] = why

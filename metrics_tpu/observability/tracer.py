@@ -1,0 +1,281 @@
+"""The event tracer: a bounded ring buffer of timestamped runtime spans.
+
+Every lifecycle event of the compiled engines and their satellite subsystems —
+engine dispatch (eager warmup / cache-miss compile / cached call / donated
+call / permanent fallback), fused-streak detach/realias, sync bucket builds,
+shard placement, checkpoint save/restore phases — is recorded here as a
+:class:`TraceEvent` when tracing is enabled.
+
+Off by default, and the disabled path is a **single branch-predictable flag
+check**: every instrumentation site in the hot paths reads the module-level
+:data:`active` boolean and skips everything else when it is ``False``. No
+tracer object is consulted, no clock is read, no string is built. The 4x
+fused-update win (``docs/fused_collection_update.md``) therefore pays one
+``LOAD_GLOBAL`` + jump per dispatch, which is unmeasurable against a ~1.6 ms
+step (guarded by ``tests/observability/test_overhead.py`` and recorded in
+``BENCH_r12.json``).
+
+Design notes:
+
+- **Ring buffer, not a log.** Events land in a ``collections.deque`` with a
+  fixed ``maxlen``; when full, the oldest events are evicted and ``dropped``
+  counts them. A tracer left enabled for a week of serving cannot OOM the
+  host — it holds the *last* ``capacity`` events, which is what you want when
+  debugging "why did step N suddenly take 40 ms".
+- **Host-side only.** Events are plain Python objects; nothing here touches
+  jax values, so recording never forces a device sync. Sites that run at jit
+  *trace* time (the sync bucket builder) record trace-time facts (bucket
+  layout, collective op/byte tallies) — which is exactly when those facts
+  exist.
+- **Clock**: ``time.perf_counter_ns() // 1000`` — monotonic microseconds, the
+  unit Chrome trace events use natively. ``tid`` is the recording thread, so
+  async checkpoint writes appear as their own track in Perfetto.
+
+Enable with :func:`enable` / the ``METRICS_TPU_TRACE=1`` environment variable,
+or scoped with the :func:`trace` context manager::
+
+    from metrics_tpu import observability as obs
+
+    with obs.trace() as tracer:
+        coll.update(logits, target)
+        coll.compute()
+    obs.write_chrome_trace("trace.json", tracer)
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, Generator, Iterable, List, Optional, Tuple
+
+import contextlib
+
+_ENV_FLAG = "METRICS_TPU_TRACE"
+_ENV_CAPACITY = "METRICS_TPU_TRACE_CAPACITY"
+
+DEFAULT_CAPACITY = 65536
+
+# Phase constants (Chrome trace-event "ph" vocabulary subset we emit).
+PH_COMPLETE = "X"  # span with ts + dur
+PH_INSTANT = "i"  # zero-duration marker
+PH_METADATA = "M"  # process/thread naming (added by the exporter)
+
+# The event catalog — every `name` the runtime emits, by category. Kept here
+# (not in the doc only) so tests and the exporter's summarize view can assert
+# against one source of truth. See docs/observability.md for semantics.
+EVENT_CATALOG: Dict[str, Tuple[str, ...]] = {
+    "engine": (
+        "dispatch/eager",  # warmup / fallback execution of the raw update
+        "dispatch/compile",  # cache-miss: first compiled call (dur = wall compile+run)
+        "dispatch/cached",  # steady-state compiled call (arg donated=True/False)
+        "dispatch/fallback",  # permanent revert to eager (arg reason)
+    ),
+    "streak": (
+        "streak/detach",  # fused streak begins: members detach from leaders
+        "streak/realias",  # observation point: members realias to leader state
+    ),
+    "sync": (
+        "sync/bucket_build",  # one bucketed sync build (args: collective tallies)
+    ),
+    "shard": (
+        "shard/place",  # Metric.shard_state placement
+        "shard/unshard",  # Metric.unshard_state gather-back
+        "mesh/build",  # parallel.mesh.make_mesh
+    ),
+    "checkpoint": (
+        "checkpoint/save/snapshot",  # build_shard: live state -> payload pytree
+        "checkpoint/save/host_copy",  # device->host transfer of the payload
+        "checkpoint/save/write",  # npz + sidecar into the pending dir (fsync)
+        "checkpoint/save/commit",  # manifest + COMMIT + atomic rename
+        "checkpoint/restore/verify",  # manifest/checksum/fingerprint checks
+        "checkpoint/restore/apply",  # folded state applied to the live object
+    ),
+}
+
+
+@dataclass
+class TraceEvent:
+    """One timeline entry. Field names mirror the Chrome trace-event schema
+    (``ts``/``dur`` in microseconds) so export is a dict copy, not a mapping."""
+
+    name: str
+    cat: str
+    ph: str
+    ts: int  # microseconds (monotonic clock)
+    dur: int = 0  # microseconds; 0 for instants
+    tid: int = 0
+    args: Dict[str, Any] = field(default_factory=dict)
+
+
+def _now_us() -> int:
+    return time.perf_counter_ns() // 1000
+
+
+class EventTracer:
+    """Bounded ring-buffer recorder. Thread-safe: the deque append is atomic
+    and the drop counter sits behind the GIL; ``events()`` snapshots."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        if capacity <= 0:
+            raise ValueError(f"tracer capacity must be positive, got {capacity}")
+        self.capacity = int(capacity)
+        self._events: deque = deque(maxlen=self.capacity)
+        self.dropped = 0  # events evicted by the ring bound
+        self.started_us = _now_us()
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def record(
+        self,
+        name: str,
+        cat: str,
+        ph: str = PH_INSTANT,
+        ts: Optional[int] = None,
+        dur: int = 0,
+        args: Optional[Dict[str, Any]] = None,
+    ) -> TraceEvent:
+        event = TraceEvent(
+            name=name,
+            cat=cat,
+            ph=ph,
+            ts=_now_us() if ts is None else int(ts),
+            dur=int(dur),
+            tid=threading.get_ident() & 0xFFFFFFFF,
+            args=args or {},
+        )
+        if len(self._events) == self.capacity:
+            self.dropped += 1
+        self._events.append(event)
+        return event
+
+    def events(self) -> List[TraceEvent]:
+        """Snapshot of the buffered events, oldest first."""
+        return list(self._events)
+
+    def clear(self) -> None:
+        self._events.clear()
+        self.dropped = 0
+        self.started_us = _now_us()
+
+    def counts_by_name(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for e in self._events:
+            out[e.name] = out.get(e.name, 0) + 1
+        return out
+
+
+# --------------------------------------------------------------------------- #
+# the global switch — THE single flag every hot site checks
+# --------------------------------------------------------------------------- #
+# `active` is the branch-predictable gate: instrumentation sites read this one
+# module attribute and do nothing else when it is False. It is redundant with
+# `_tracer is not None` by construction; it exists so the disabled check is a
+# plain boolean load with no comparison against None-able state.
+active: bool = False
+_tracer: Optional[EventTracer] = None
+_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    """Whether runtime tracing is currently on."""
+    return active
+
+
+def get_tracer() -> Optional[EventTracer]:
+    """The live tracer (``None`` while disabled)."""
+    return _tracer
+
+
+def enable(capacity: Optional[int] = None) -> EventTracer:
+    """Turn tracing on process-wide; returns the (possibly new) tracer.
+
+    Re-enabling with the same capacity keeps the existing buffer (events
+    accumulate across enable/disable cycles until :meth:`EventTracer.clear`);
+    passing a different ``capacity`` swaps in a fresh ring.
+    """
+    global active, _tracer
+    with _lock:
+        cap = capacity if capacity is not None else int(
+            os.environ.get(_ENV_CAPACITY, DEFAULT_CAPACITY)
+        )
+        if _tracer is None or _tracer.capacity != cap:
+            _tracer = EventTracer(cap)
+        active = True
+        return _tracer
+
+
+def disable() -> Optional[EventTracer]:
+    """Turn tracing off; the buffer is kept (inspect/export it afterwards)."""
+    global active
+    with _lock:
+        active = False
+        return _tracer
+
+
+@contextlib.contextmanager
+def trace(capacity: Optional[int] = None) -> Generator[EventTracer, None, None]:
+    """Enable tracing for the duration of the block (restores the prior state).
+
+    Yields a *fresh* tracer so the block's events are exactly the buffer
+    contents — nested use shares the outer tracer instead.
+    """
+    global _tracer
+    if active:  # nested: ride the outer tracer
+        yield _tracer  # type: ignore[misc]
+        return
+    prev = _tracer
+    with _lock:
+        _tracer = EventTracer(capacity if capacity is not None else DEFAULT_CAPACITY)
+    tracer = enable(_tracer.capacity)
+    try:
+        yield tracer
+    finally:
+        disable()
+        with _lock:
+            if prev is not None:
+                _tracer = prev
+
+
+# --------------------------------------------------------------------------- #
+# emit helpers (call sites MUST gate on `active` themselves — these assume
+# tracing is on so the disabled path never pays a function call)
+# --------------------------------------------------------------------------- #
+def emit_instant(name: str, cat: str, **args: Any) -> None:
+    """Record a zero-duration marker (gate on :data:`active` first)."""
+    tracer = _tracer
+    if tracer is not None:
+        tracer.record(name, cat, PH_INSTANT, args=args)
+
+
+def emit_complete(name: str, cat: str, ts_us: int, dur_us: int, **args: Any) -> None:
+    """Record a finished span from explicit timestamps (microseconds)."""
+    tracer = _tracer
+    if tracer is not None:
+        tracer.record(name, cat, PH_COMPLETE, ts=ts_us, dur=max(int(dur_us), 0), args=args)
+
+
+@contextlib.contextmanager
+def span(name: str, cat: str, **args: Any) -> Generator[Dict[str, Any], None, None]:
+    """Record the block as a complete event. Yields the ``args`` dict so the
+    body can attach results (byte tallies, step indices) before the span
+    closes. Safe to enter with tracing off (no-op) — but hot sites should
+    still gate on :data:`active` to skip the context-manager machinery."""
+    if not active:
+        yield {}
+        return
+    t0 = _now_us()
+    try:
+        yield args
+    finally:
+        emit_complete(name, cat, t0, _now_us() - t0, **args)
+
+
+def _env_autostart() -> None:
+    if os.environ.get(_ENV_FLAG, "0").lower() in ("1", "true", "on"):
+        enable()
+
+
+_env_autostart()

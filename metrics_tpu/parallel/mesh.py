@@ -13,6 +13,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from metrics_tpu.observability import tracer as _otrace
+
 
 def make_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str], devices=None) -> Mesh:
     """Build a named device mesh; sizes may contain one -1 (fill remaining)."""
@@ -32,6 +34,12 @@ def make_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str], devices=None
             "ensure_virtual_devices)."
         )
     arr = np.asarray(devices[:n]).reshape(sizes)
+    if _otrace.active:
+        _otrace.emit_instant(
+            "mesh/build", "shard",
+            axes=dict(zip(axis_names, (int(s) for s in sizes))),
+            devices=n, platform=devices[0].platform if devices else "none",
+        )
     return Mesh(arr, tuple(axis_names))
 
 

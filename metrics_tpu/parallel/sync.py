@@ -32,6 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array, lax
 
+from metrics_tpu.observability import tracer as _otrace
+
 AxisNames = Union[str, Tuple[str, ...]]
 
 # Reduction vocabulary (reference: metric.py:196-207 resolves these at add_state).
@@ -408,6 +410,32 @@ def sync_state(
     """
     if axis_name is None:
         return dict(state)
+    if not _otrace.active:
+        return _sync_state_impl(state, reductions, axis_name, bucketed, shard_axes)
+    # tracer on: record one sync/bucket_build span per sync with this build's
+    # own collective tally (a nested count_collectives box — outer user boxes
+    # still see every tick). sync_state runs at trace time, which is exactly
+    # when the bucket layout and payload bytes exist; the host clock only
+    # touches the Python-side event object, never the traced program.
+    t0_us = _otrace._now_us()
+    with count_collectives() as box:
+        out = _sync_state_impl(state, reductions, axis_name, bucketed, shard_axes)
+    _otrace.emit_complete(
+        "sync/bucket_build", "sync", t0_us, _otrace._now_us() - t0_us,
+        axis=str(axis_name), leaves=len(state),
+        collectives=dict(box["by_kind"]),
+        collective_bytes=dict(box["bytes_by_kind"]),
+    )
+    return out
+
+
+def _sync_state_impl(
+    state: Dict[str, Any],
+    reductions: Dict[str, Optional[Union[str, Callable]]],
+    axis_name: AxisNames,
+    bucketed: Optional[bool],
+    shard_axes: Optional[Dict[str, int]],
+) -> Dict[str, Any]:
     if bucketed is None:
         bucketed = bucketed_sync_enabled()
     shard_axes = shard_axes or {}

@@ -1,4 +1,15 @@
-"""Profiling helpers: trace annotations and a compiled-vs-eager step timer.
+"""Profiling helpers: ``jax.profiler`` trace annotations and a
+compiled-vs-eager step timer — the **device-side half** of the observability
+story (full architecture, event catalog, and Perfetto workflow:
+``docs/observability.md``).
+
+This module annotates and times the *device* timeline through the jax
+profiler (XPlane traces for TensorBoard/Perfetto); the *host* timeline —
+engine dispatch lifecycle, sync bucket builds, checkpoint phases — is
+recorded by :mod:`metrics_tpu.observability`, whose engines wrap compiled
+dispatches in ``TraceAnnotation`` names (``metrics_tpu/<Owner>.<kind>``)
+while the tracer is on, so the two halves line up when loaded together in
+Perfetto.
 
 Reference parity: the reference has no tracer — only the usage-logging hook
 (metric.py:86) and the ``check_forward_no_full_state`` micro-benchmark

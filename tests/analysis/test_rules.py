@@ -5,6 +5,9 @@ abstract-eval stage (stage 2) over the mock 8-device mesh.
 The fixtures live at module top level so ``inspect.getsourcefile`` resolves
 this file and the AST stage lints real source, suppression comments included.
 """
+import time
+from time import monotonic
+
 import jax.numpy as jnp
 import pytest
 
@@ -12,7 +15,9 @@ from metrics_tpu.analysis import ast_stage, eval_stage
 from metrics_tpu.analysis.registry import Entry
 from metrics_tpu.analysis.rules import ERROR, RULES, parse_suppressions
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.observability import tracer as _otrace
 from metrics_tpu.parallel import sync as _sync
+from metrics_tpu.utils.checks import _is_concrete
 
 
 # --------------------------------------------------------------------------- #
@@ -75,6 +80,69 @@ class ScalarStateMetric(Metric):
 
     def compute(self):
         return self.count
+
+
+class ClockReadMetric(Metric):
+    """A007: host-clock read in update — a trace-time constant under jit."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, values):
+        t0 = time.perf_counter()  # noqa: F841
+        self.total = self.total + jnp.sum(values)
+
+    def compute(self):
+        return self.total
+
+
+class TracerEmitMetric(Metric):
+    """A007: tracer emit from a jit-facing method fires once per compile."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.total = self.total + jnp.sum(values)
+
+    def compute(self):
+        _otrace.emit_instant("my_metric/compute", "engine")
+        return self.total
+
+
+class BareClockMetric(Metric):
+    """A007 via a `from time import monotonic` binding."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, values):
+        start = monotonic()  # noqa: F841
+        self.total = self.total + jnp.sum(values)
+
+    def compute(self):
+        return self.total
+
+
+class GuardedClockMetric(Metric):
+    """Control for A007: clock reads under an _is_concrete guard are
+    host-side by design (same exemption as A001/A002)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self._last_update_s = 0.0
+
+    def update(self, values):
+        if _is_concrete(values):
+            self._last_update_s = time.perf_counter()
+        self.total = self.total + jnp.sum(values)
+
+    def compute(self):
+        return self.total
 
 
 class SuppressedHostMetric(Metric):
@@ -202,6 +270,9 @@ class TestASTStage:
             (BranchyMetric, "A002"),
             (HiddenWriteMetric, "A003"),
             (ScalarStateMetric, "A004"),
+            (ClockReadMetric, "A007"),
+            (TracerEmitMetric, "A007"),
+            (BareClockMetric, "A007"),
         ],
         ids=lambda x: getattr(x, "__name__", x),
     )
@@ -214,6 +285,9 @@ class TestASTStage:
 
     def test_clean_metric_has_no_findings(self):
         assert _lint(CleanMetric) == []
+
+    def test_guarded_clock_read_is_exempt(self):
+        assert "A007" not in _active_rules(_lint(GuardedClockMetric))
 
     def test_inline_suppression_keeps_finding_but_marks_it(self):
         findings = _lint(SuppressedHostMetric)

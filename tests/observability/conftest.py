@@ -1,0 +1,14 @@
+"""Observability suite hygiene: tracing is process-global state, so every
+test leaves it the way it found it (off, with no leftover buffer)."""
+import pytest
+
+from metrics_tpu.observability import tracer as _otrace
+
+
+@pytest.fixture(autouse=True)
+def _tracer_off_after_each_test():
+    yield
+    _otrace.disable()
+    tracer = _otrace.get_tracer()
+    if tracer is not None:
+        tracer.clear()

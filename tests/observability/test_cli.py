@@ -1,0 +1,93 @@
+"""``python -m metrics_tpu.observability`` golden tests (pure host-side)."""
+import json
+
+import pytest
+
+from metrics_tpu import observability as obs
+from metrics_tpu.observability import tracer as _otrace
+from metrics_tpu.observability.__main__ import main
+
+
+@pytest.fixture
+def trace_file(tmp_path):
+    t = obs.EventTracer()
+    t.record("dispatch/compile", "engine", ph=_otrace.PH_COMPLETE, ts=100, dur=5000,
+             args={"compile_s": 0.005})
+    t.record("dispatch/cached", "engine", ph=_otrace.PH_COMPLETE, ts=6000, dur=40)
+    t.record("dispatch/cached", "engine", ph=_otrace.PH_COMPLETE, ts=7000, dur=60)
+    t.record("sync/bucket_build", "sync", ph=_otrace.PH_COMPLETE, ts=8000, dur=300,
+             args={"collectives": {"psum": 1}})
+    return str(obs.write_chrome_trace(tmp_path / "trace.json", t))
+
+
+class TestDump:
+    def test_table_lists_every_event(self, trace_file, capsys):
+        assert main(["dump", trace_file]) == 0
+        out = capsys.readouterr().out
+        assert "dispatch/compile" in out and "sync/bucket_build" in out
+        assert "-- 4 events" in out
+
+    def test_cat_and_name_filters(self, trace_file, capsys):
+        assert main(["dump", trace_file, "--cat", "sync"]) == 0
+        out = capsys.readouterr().out
+        assert "sync/bucket_build" in out and "dispatch/" not in out
+        assert main(["dump", trace_file, "--name", "cached", "--limit", "1"]) == 0
+        out = capsys.readouterr().out
+        assert out.count("dispatch/cached") == 1
+
+    def test_json_output_is_parseable(self, trace_file, capsys):
+        assert main(["dump", trace_file, "--json"]) == 0
+        rows = json.loads(capsys.readouterr().out)
+        assert [r["name"] for r in rows] == [
+            "dispatch/compile", "dispatch/cached", "dispatch/cached", "sync/bucket_build",
+        ]
+        assert rows[0]["args"] == {"compile_s": 0.005}
+
+
+class TestSummarize:
+    def test_aggregates_sorted_by_total_time(self, trace_file, capsys):
+        assert main(["summarize", trace_file, "--json"]) == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert list(summary["events"])[0] == "dispatch/compile"  # 5000us dominates
+        cached = summary["events"]["dispatch/cached"]
+        assert cached["count"] == 2 and cached["total_us"] == 100.0
+
+    def test_human_output_mentions_span(self, trace_file, capsys):
+        assert main(["summarize", trace_file]) == 0
+        assert "4 events over" in capsys.readouterr().out
+
+
+class TestDiff:
+    def test_diff_json(self, trace_file, tmp_path, capsys):
+        t = obs.EventTracer()
+        t.record("dispatch/cached", "engine", ph=_otrace.PH_COMPLETE, ts=0, dur=500)
+        t.record("dispatch/fallback", "engine", args={"reason": "boom"})
+        other = obs.write_chrome_trace(tmp_path / "b.json", t)
+        assert main(["diff", trace_file, str(other), "--json"]) == 0
+        diff = json.loads(capsys.readouterr().out)
+        assert "dispatch/fallback" in diff["only_b"]
+        assert "sync/bucket_build" in diff["only_a"]
+        assert diff["events"]["dispatch/cached"]["total_us"]["delta"] == 400.0
+
+    def test_diff_table(self, trace_file, capsys):
+        assert main(["diff", trace_file, trace_file]) == 0
+        out = capsys.readouterr().out
+        assert "span:" in out and "dispatch/cached" in out
+
+
+class TestValidate:
+    def test_valid_file_passes(self, trace_file, capsys):
+        assert main(["validate", trace_file]) == 0
+        assert "valid (4 events)" in capsys.readouterr().out
+
+    def test_invalid_file_fails_with_problems(self, tmp_path, capsys):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"traceEvents": [{"ph": "X"}]}))
+        assert main(["validate", str(bad)]) == 1
+        assert "missing keys" in capsys.readouterr().err
+
+    def test_unreadable_file_fails(self, tmp_path, capsys):
+        garbled = tmp_path / "garbled.json"
+        garbled.write_text("{nope")
+        assert main(["validate", str(garbled)]) == 1
+        assert "unreadable" in capsys.readouterr().err

@@ -1,0 +1,174 @@
+"""Exporters: Chrome trace schema round-trip, Prometheus text, analytics."""
+import json
+
+import numpy as np
+import pytest
+
+from metrics_tpu import observability as obs
+from metrics_tpu.observability import tracer as _otrace
+from metrics_tpu.observability.instruments import InstrumentRegistry
+
+
+def _sample_tracer():
+    t = obs.EventTracer()
+    t.record("dispatch/cached", "engine", ph=_otrace.PH_COMPLETE, ts=100, dur=50,
+             args={"donated": True})
+    t.record("dispatch/eager", "engine", args={"owner": "F1Score"})
+    t.record("sync/bucket_build", "sync", ph=_otrace.PH_COMPLETE, ts=200, dur=30,
+             args={"collective_bytes": {"psum": np.int64(16)}})
+    return t
+
+
+class TestChromeTrace:
+    def test_export_is_valid_perfetto_input(self):
+        doc = obs.to_chrome_trace(_sample_tracer())
+        assert obs.validate_chrome_trace(doc) == []
+
+    def test_object_format_shape(self):
+        doc = obs.to_chrome_trace(_sample_tracer(), process_name="myproc")
+        assert doc["displayTimeUnit"] == "ms"
+        assert doc["otherData"]["producer"] == "metrics_tpu.observability"
+        meta = [r for r in doc["traceEvents"] if r["ph"] == "M"]
+        assert any(r["name"] == "process_name" and r["args"]["name"] == "myproc"
+                   for r in meta)
+        assert any(r["name"] == "thread_name" for r in meta)
+
+    def test_phase_specific_fields(self):
+        doc = obs.to_chrome_trace(_sample_tracer())
+        data = [r for r in doc["traceEvents"] if r["ph"] != "M"]
+        complete = next(r for r in data if r["name"] == "dispatch/cached")
+        assert complete["ph"] == "X" and complete["dur"] == 50
+        instant = next(r for r in data if r["name"] == "dispatch/eager")
+        assert instant["ph"] == "i" and instant["s"] == "t"
+
+    def test_args_are_json_safe(self):
+        doc = obs.to_chrome_trace(_sample_tracer())
+        text = json.dumps(doc)  # numpy scalars must not leak into the doc
+        rec = next(r for r in doc["traceEvents"] if r["name"] == "sync/bucket_build")
+        assert rec["args"]["collective_bytes"]["psum"] == 16
+        assert isinstance(rec["args"]["collective_bytes"]["psum"], int)
+        assert "sync/bucket_build" in text
+
+    def test_write_and_load_round_trip(self, tmp_path):
+        tracer = _sample_tracer()
+        path = obs.write_chrome_trace(tmp_path / "t.json", tracer)
+        doc = obs.load_trace(path)
+        assert obs.validate_chrome_trace(doc) == []
+        assert doc == obs.to_chrome_trace(tracer)
+
+    def test_dropped_events_recorded(self):
+        t = obs.EventTracer(capacity=1)
+        t.record("a", "x")
+        t.record("b", "x")
+        assert obs.to_chrome_trace(t)["otherData"]["dropped_events"] == 1
+
+    @pytest.mark.parametrize(
+        "doc, fragment",
+        [
+            ([], "traceEvents"),
+            ({"traceEvents": {}}, "array"),
+            ({"traceEvents": ["nope"]}, "not an object"),
+            ({"traceEvents": [{"ph": "X"}]}, "missing keys"),
+            ({"traceEvents": [{"name": "a", "ph": "Z", "ts": 0, "pid": 1, "tid": 1}]}, "unknown phase"),
+            ({"traceEvents": [{"name": "a", "ph": "X", "ts": 0, "pid": 1, "tid": 1, "dur": -1}]}, "dur"),
+            ({"traceEvents": [{"name": "a", "ph": "i", "ts": 0, "pid": 1, "tid": 1, "s": "q"}]}, "scope"),
+            ({"traceEvents": [{"name": "a", "ph": "i", "ts": 0, "pid": 1, "tid": 1, "args": 3}]}, "args"),
+        ],
+    )
+    def test_validate_rejects_malformed_documents(self, doc, fragment):
+        problems = obs.validate_chrome_trace(doc)
+        assert problems and fragment in problems[0]
+
+
+class TestPrometheus:
+    def test_counter_gauge_rendering(self):
+        reg = InstrumentRegistry()
+        reg.counter("requests_total", help="reqs", route="/a").inc(3)
+        reg.gauge("queue_depth").set(7)
+        text = obs.to_prometheus_text(reg)
+        assert "# TYPE metrics_tpu_requests_total counter" in text
+        assert "# HELP metrics_tpu_requests_total reqs" in text
+        assert 'metrics_tpu_requests_total{route="/a"} 3' in text
+        assert "# TYPE metrics_tpu_queue_depth gauge" in text
+        assert "metrics_tpu_queue_depth 7" in text
+
+    def test_histogram_cumulative_buckets(self):
+        reg = InstrumentRegistry()
+        h = reg.histogram("dur_seconds", buckets=(0.1, 1.0), op="save")
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        text = obs.to_prometheus_text(reg)
+        assert "# TYPE metrics_tpu_dur_seconds histogram" in text
+        assert 'metrics_tpu_dur_seconds_bucket{le="0.1",op="save"} 1' in text
+        assert 'metrics_tpu_dur_seconds_bucket{le="1.0",op="save"} 2' in text
+        assert 'metrics_tpu_dur_seconds_bucket{le="+Inf",op="save"} 3' in text
+        assert 'metrics_tpu_dur_seconds_count{op="save"} 3' in text
+        assert 'metrics_tpu_dur_seconds_sum{op="save"} 5.55' in text
+
+    def test_label_escaping(self):
+        reg = InstrumentRegistry()
+        reg.counter("odd_total", tag='he said "hi"\nback\\slash').inc()
+        text = obs.to_prometheus_text(reg)
+        assert r'tag="he said \"hi\"\nback\\slash"' in text
+
+    def test_get_or_create_returns_same_instrument(self):
+        reg = InstrumentRegistry()
+        a = reg.counter("c_total", op="x")
+        b = reg.counter("c_total", op="x")
+        assert a is b
+        assert reg.counter("c_total", op="y") is not a
+
+    def test_kind_conflict_raises(self):
+        reg = InstrumentRegistry()
+        reg.counter("thing")
+        with pytest.raises(TypeError):
+            reg.gauge("thing")
+
+    def test_counters_refuse_negative_increments(self):
+        reg = InstrumentRegistry()
+        with pytest.raises(ValueError):
+            reg.counter("c_total").inc(-1)
+
+    def test_json_snapshot_groups_by_name(self):
+        reg = InstrumentRegistry()
+        reg.counter("c_total", op="x").inc(2)
+        snap = obs.to_metrics_json(reg)
+        assert snap["metrics_tpu_c_total"] == [
+            {"labels": {"op": "x"}, "value": 2.0, "kind": "counter"}
+        ]
+
+
+def _doc(events):
+    return {
+        "traceEvents": [
+            {"name": n, "cat": c, "ph": "X", "ts": ts, "dur": dur, "pid": 1, "tid": 1}
+            for (n, c, ts, dur) in events
+        ],
+        "otherData": {"dropped_events": 0},
+    }
+
+
+class TestAnalytics:
+    def test_summarize_aggregates_per_name(self):
+        doc = _doc([("a", "x", 0, 10), ("a", "x", 20, 30), ("b", "y", 5, 1)])
+        s = obs.summarize_trace(doc)
+        assert s["total_events"] == 3
+        assert s["span_us"] == 50.0  # 0 .. 20+30
+        assert list(s["events"]) == ["a", "b"]  # sorted by total time
+        a = s["events"]["a"]
+        assert (a["count"], a["total_us"], a["mean_us"], a["max_us"]) == (2, 40.0, 20.0, 30.0)
+
+    def test_diff_reports_deltas_and_one_sided_events(self):
+        a = _doc([("shared", "x", 0, 10), ("gone", "x", 0, 5)])
+        b = _doc([("shared", "x", 0, 30), ("new", "x", 0, 7)])
+        d = obs.diff_traces(a, b)
+        assert d["only_a"] == ["gone"]
+        assert d["only_b"] == ["new"]
+        shared = d["events"]["shared"]
+        assert shared["total_us"]["delta"] == 20.0
+        assert shared["total_ratio"] == 3.0
+
+    def test_summarize_empty_doc(self):
+        s = obs.summarize_trace({"traceEvents": []})
+        assert s["total_events"] == 0 and s["span_us"] == 0.0

@@ -1,0 +1,121 @@
+"""Disabled-path overhead guard: tracing off must cost one flag check.
+
+The cross-PR acceptance number (tracer-off fused update within 3% of the
+pre-observability baseline) is recorded by ``bench.py --observability`` into
+``BENCH_r12.json`` — a unit test cannot hold a run-to-run 3% bound without
+flaking on shared CI hosts. What it *can* hold:
+
+* tracer-off must not be slower than tracer-on beyond timer noise (the off
+  path is a strict subset of the on path), and
+* the tracer-off fused collection update must stay within the same
+  2x-of-raw-jit + fixed-floor envelope the engine dispatch guard
+  (tests/core/test_compiled_update_engine.py) has enforced since before the
+  tracer existed — if the flag checks were doing real work, this breaks.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import (
+    Accuracy,
+    F1Score,
+    MetricCollection,
+    Precision,
+    Recall,
+    observability as obs,
+)
+
+NUM_CLASSES = 256
+BATCH = 256
+STEPS = 32
+
+
+def _collection():
+    return MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES, average="micro"),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "precision": Precision(num_classes=NUM_CLASSES, average="macro"),
+            "recall": Recall(num_classes=NUM_CLASSES, average="macro"),
+        }
+    )
+
+
+def _batch():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(BATCH, NUM_CLASSES)), dtype=jnp.float32)
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=jnp.int32)
+    return logits, target
+
+
+def _fused_us_per_step(coll, logits, target, reps=3):
+    for _ in range(3):  # warmup sighting + compile + donation
+        coll.update(logits, target)
+
+    def one_rep():
+        coll.reset()
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            coll.update(logits, target)
+        jax.block_until_ready(next(iter(coll.values())).get_state())
+        return (time.perf_counter() - t0) / STEPS * 1e6
+
+    return min(one_rep() for _ in range(reps))
+
+
+def test_disabled_tracer_is_not_slower_than_enabled():
+    logits, target = _batch()
+    assert not obs.enabled()
+    off_us = _fused_us_per_step(_collection(), logits, target)
+    with obs.trace():
+        on_us = _fused_us_per_step(_collection(), logits, target)
+    # the off path is a strict subset of the on path; 15% + 50us headroom
+    # absorbs CI timer noise without hiding a real regression (an accidental
+    # always-on emit would cost far more than that)
+    assert off_us <= on_us * 1.15 + 50, (
+        f"tracer-off fused update slower than tracer-on: "
+        f"{off_us:.1f}us vs {on_us:.1f}us per step"
+    )
+
+
+def test_disabled_path_stays_in_the_dispatch_envelope():
+    """Same envelope as the engine dispatch guard: stateful fused update
+    within 2x of hand-driving the raw jitted update_state, plus a fixed
+    bookkeeping floor. The tracer's flag checks must live inside it."""
+    logits, target = _batch()
+    assert not obs.enabled()
+
+    m = Accuracy(num_classes=NUM_CLASSES)
+    raw = Accuracy(num_classes=NUM_CLASSES, compiled_update=False)
+    step = jax.jit(raw.update_state)
+    state = step(raw.init_state(), logits, target)
+    jax.block_until_ready(state)
+
+    def time_raw():
+        s = step(raw.init_state(), logits, target)
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            s = step(s, logits, target)
+        jax.block_until_ready(s)
+        return (time.perf_counter() - t0) / STEPS
+
+    for _ in range(3):
+        m.update(logits, target)
+
+    def time_stateful():
+        m.reset()
+        m.update(logits, target)
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            m.update(logits, target)
+        jax.block_until_ready(m.get_state())
+        return (time.perf_counter() - t0) / STEPS
+
+    raw_s = min(time_raw() for _ in range(3))
+    stateful_s = min(time_stateful() for _ in range(3))
+    assert stateful_s <= 2.0 * raw_s + 150e-6, (
+        f"tracer-off stateful update outside the dispatch envelope: "
+        f"{stateful_s * 1e6:.1f}us vs raw {raw_s * 1e6:.1f}us per step"
+    )

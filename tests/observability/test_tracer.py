@@ -1,0 +1,156 @@
+"""The event tracer itself: ring bound, switch semantics, emit helpers."""
+import threading
+
+import pytest
+
+from metrics_tpu import observability as obs
+from metrics_tpu.observability import tracer as _otrace
+
+
+class TestRingBuffer:
+    def test_capacity_bounds_the_buffer_and_counts_drops(self):
+        t = obs.EventTracer(capacity=4)
+        for i in range(10):
+            t.record(f"e{i}", "test")
+        assert len(t) == 4
+        assert t.dropped == 6
+        assert [e.name for e in t.events()] == ["e6", "e7", "e8", "e9"]
+
+    def test_clear_resets_buffer_and_drop_counter(self):
+        t = obs.EventTracer(capacity=2)
+        for i in range(5):
+            t.record(f"e{i}", "test")
+        t.clear()
+        assert len(t) == 0 and t.dropped == 0
+
+    def test_capacity_must_be_positive(self):
+        with pytest.raises(ValueError):
+            obs.EventTracer(capacity=0)
+
+    def test_record_defaults(self):
+        t = obs.EventTracer()
+        e = t.record("x", "test")
+        assert e.ph == _otrace.PH_INSTANT
+        assert e.dur == 0
+        assert e.ts > 0
+        assert e.tid == threading.get_ident() & 0xFFFFFFFF
+        assert e.args == {}
+
+    def test_counts_by_name(self):
+        t = obs.EventTracer()
+        for name in ("a", "b", "a", "a"):
+            t.record(name, "test")
+        assert t.counts_by_name() == {"a": 3, "b": 1}
+
+
+class TestSwitch:
+    def test_off_by_default(self):
+        assert not obs.enabled()
+        assert not _otrace.active
+
+    def test_enable_disable(self):
+        tracer = obs.enable(capacity=128)
+        try:
+            assert obs.enabled() and _otrace.active
+            assert obs.get_tracer() is tracer
+            assert tracer.capacity == 128
+        finally:
+            obs.disable()
+        assert not obs.enabled()
+        # the buffer survives disable for post-hoc export
+        assert obs.get_tracer() is tracer
+
+    def test_reenable_same_capacity_keeps_buffer(self):
+        tracer = obs.enable(capacity=64)
+        try:
+            tracer.record("before", "test")
+            obs.disable()
+            tracer2 = obs.enable(capacity=64)
+            assert tracer2 is tracer
+            assert tracer2.counts_by_name() == {"before": 1}
+        finally:
+            obs.disable()
+
+    def test_trace_context_is_scoped_and_fresh(self):
+        with obs.trace() as tracer:
+            assert obs.enabled()
+            assert len(tracer) == 0
+            _otrace.emit_instant("inside", "test")
+        assert not obs.enabled()
+        assert tracer.counts_by_name() == {"inside": 1}
+
+    def test_nested_trace_rides_the_outer_tracer(self):
+        with obs.trace() as outer:
+            with obs.trace() as inner:
+                assert inner is outer
+                _otrace.emit_instant("nested", "test")
+            assert obs.enabled()  # inner exit must not kill the outer scope
+            _otrace.emit_instant("after", "test")
+        assert outer.counts_by_name() == {"nested": 1, "after": 1}
+
+
+class TestEmitHelpers:
+    def test_emit_instant_records_args(self):
+        with obs.trace() as tracer:
+            _otrace.emit_instant("marker", "engine", reason="x", step=3)
+        (e,) = tracer.events()
+        assert (e.name, e.cat, e.ph) == ("marker", "engine", _otrace.PH_INSTANT)
+        assert e.args == {"reason": "x", "step": 3}
+
+    def test_emit_complete_uses_explicit_timestamps(self):
+        with obs.trace() as tracer:
+            _otrace.emit_complete("spanned", "sync", 1000, 250, leaves=4)
+        (e,) = tracer.events()
+        assert (e.ph, e.ts, e.dur) == (_otrace.PH_COMPLETE, 1000, 250)
+
+    def test_emit_complete_clamps_negative_duration(self):
+        with obs.trace() as tracer:
+            _otrace.emit_complete("clock-skew", "test", 1000, -5)
+        assert tracer.events()[0].dur == 0
+
+    def test_span_records_block_and_attaches_args(self):
+        with obs.trace() as tracer:
+            with _otrace.span("work", "checkpoint", step=1) as args:
+                args["bytes"] = 42
+        (e,) = tracer.events()
+        assert e.ph == _otrace.PH_COMPLETE
+        assert e.args == {"step": 1, "bytes": 42}
+        assert e.dur >= 0
+
+    def test_emit_helpers_safe_without_a_tracer(self, monkeypatch):
+        """emit_* assume call sites gated on `active`; they must still be
+        harmless (not crash) when no tracer exists at all."""
+        monkeypatch.setattr(_otrace, "active", False)
+        monkeypatch.setattr(_otrace, "_tracer", None)
+        _otrace.emit_instant("ghost", "test")
+        _otrace.emit_complete("ghost", "test", 0, 0)
+        with _otrace.span("ghost", "test"):
+            pass
+        assert obs.get_tracer() is None
+
+    def test_span_is_noop_while_disabled(self):
+        tracer = obs.enable()
+        obs.disable()
+        tracer.clear()
+        with _otrace.span("ghost", "test"):
+            pass
+        assert "ghost" not in tracer.counts_by_name()
+
+
+class TestCatalog:
+    def test_event_names_are_unique_across_categories(self):
+        seen = set()
+        for names in obs.EVENT_CATALOG.values():
+            for name in names:
+                assert name not in seen
+                seen.add(name)
+
+    def test_catalog_covers_the_lifecycle(self):
+        flat = {n for names in obs.EVENT_CATALOG.values() for n in names}
+        for required in (
+            "dispatch/eager", "dispatch/compile", "dispatch/cached",
+            "dispatch/fallback", "streak/detach", "streak/realias",
+            "sync/bucket_build", "shard/place",
+            "checkpoint/save/write", "checkpoint/restore/apply",
+        ):
+            assert required in flat
