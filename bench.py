@@ -1700,6 +1700,146 @@ def bench_observability() -> None:
     print(json.dumps(record), flush=True)
 
 
+def bench_offhost() -> None:
+    """``--offhost`` (also run by ``--observability``): the off-host telemetry
+    loop measured end to end — scrape latency of the live HTTP server while a
+    fused-update streak populates the registry, the 8-host shard merge +
+    device correlation wall time, and the regression watchdog's self-check
+    over the whole checked-in BENCH trajectory including this round —
+    recorded into ``BENCH_r13.json``. Host-side CPU bench."""
+    import glob as _glob
+    import statistics
+    import urllib.request
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, Recall, observability
+    from metrics_tpu.observability import regress as _regress
+    from metrics_tpu.observability import shards as _shards
+
+    coll = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES, average="micro"),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "precision": Precision(num_classes=NUM_CLASSES, average="macro"),
+            "recall": Recall(num_classes=NUM_CLASSES, average="macro"),
+        }
+    )
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(BATCH, NUM_CLASSES)), dtype=jnp.float32)
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=jnp.int32)
+
+    observability.enable()
+    try:
+        server = observability.serve(port=0)
+        for _ in range(WARMUP):
+            coll.update(logits, target)
+        for _ in range(STEPS):
+            coll.update(logits, target)
+        jax.block_until_ready(coll.compute())
+
+        def scrape_ms(endpoint, n=30):
+            times, size = [], 0
+            for _ in range(n):
+                t0 = time.perf_counter()
+                with urllib.request.urlopen(server.url + endpoint, timeout=10) as resp:
+                    size = len(resp.read())
+                times.append((time.perf_counter() - t0) * 1e3)
+            times.sort()
+            return {
+                "p50_ms": round(statistics.median(times), 3),
+                "p95_ms": round(times[int(0.95 * (len(times) - 1))], 3),
+                "payload_bytes": size,
+            }
+
+        scrape = {ep.strip("/").replace(".", "_"): scrape_ms(ep)
+                  for ep in ("/metrics", "/trace", "/healthz")}
+
+        # multi-host merge: N shards of this buffer under distinct host ids
+        hosts = 8
+        base = _shards.build_trace_shard(host_id="h0")
+        shard_docs = [json.loads(json.dumps(base)) for _ in range(hosts)]
+        for i, doc in enumerate(shard_docs):
+            doc["otherData"]["shard"]["host_id"] = f"h{i}"
+        t0 = time.perf_counter()
+        merged = _shards.merge_trace_shards(shard_docs)
+        merge_wall_ms = (time.perf_counter() - t0) * 1e3
+        merged_valid = not observability.validate_chrome_trace(merged)
+
+        # correlation against a synthetic device trace mirroring the streak's
+        # dispatch spans under their TraceAnnotation names
+        device_events = []
+        for rec in merged["traceEvents"]:
+            args = rec.get("args") or {}
+            if rec.get("ph") == "M" or "owner" not in args or "kind" not in args:
+                continue
+            device_events.append({
+                "name": _shards.dispatch_annotation(args["owner"], args["kind"]),
+                "cat": "device", "ph": "X", "ts": rec["ts"] + 40_000,
+                "dur": max(1, rec.get("dur", 1)), "pid": 99, "tid": 0,
+            })
+        t0 = time.perf_counter()
+        combined = _shards.correlate_device_trace(merged, {"traceEvents": device_events})
+        correlate_wall_ms = (time.perf_counter() - t0) * 1e3
+        correlation = combined["otherData"]["correlation"]
+    finally:
+        observability.shutdown()
+        observability.disable()
+
+    record = {
+        # headline: what one /metrics scrape of a live streak costs — the
+        # per-poll price an external Prometheus pays
+        "metric": "offhost_scrape_metrics_p50_ms",
+        "value": scrape["metrics"]["p50_ms"],
+        "unit": "ms",
+        "extra": {
+            "config": "config2_collection",
+            "num_classes": NUM_CLASSES,
+            "streak_steps": STEPS,
+            "scrape": scrape,
+            "merge": {
+                "hosts": hosts,
+                "events_per_shard": sum(
+                    1 for r in base["traceEvents"] if r.get("ph") != "M"),
+                "merge_wall_ms": round(merge_wall_ms, 3),
+                "merged_valid": merged_valid,
+                "correlate_wall_ms": round(correlate_wall_ms, 3),
+                "correlated_matched": correlation["matched"],
+                "correlated_host_dispatches": correlation["host_dispatches"],
+            },
+        },
+    }
+
+    # the watchdog self-check: judge this round (in memory) against the
+    # checked-in trajectory before recording it
+    rounds = [
+        r for r in _regress.load_rounds(
+            sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json"))))
+        if r.name != "r13"
+    ]
+    rounds.append(_regress.Round("r13", "<this-run>", record))
+    report = _regress.check_trajectory(rounds)
+    record["extra"]["regress"] = {
+        "ok": report.ok,
+        "regression_count": len(report.regressions),
+        "keys_checked": report.keys_checked,
+        "regressions": [r.describe() for r in report.regressions],
+    }
+
+    with open(os.path.join(REPO, "BENCH_r13.json"), "w") as fh:
+        json.dump(record, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(record), flush=True)
+    if not report.ok:
+        print("[bench] offhost round REGRESSED vs rolling baseline:", file=sys.stderr)
+        for r in report.regressions:
+            print(f"[bench]   {r.describe()}", file=sys.stderr)
+        sys.exit(1)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
@@ -1712,7 +1852,15 @@ def main() -> None:
         "--observability",
         action="store_true",
         help="measure tracer on/off overhead on the config2 fused update and "
-        "the traced eval-loop event volume, record into BENCH_r12.json",
+        "the traced eval-loop event volume, record into BENCH_r12.json; then "
+        "run --offhost for BENCH_r13.json",
+    )
+    parser.add_argument(
+        "--offhost",
+        action="store_true",
+        help="measure live scrape-server latency, 8-host shard merge + device "
+        "correlation wall time, and run the regression watchdog over the "
+        "BENCH trajectory; record into BENCH_r13.json",
     )
     parser.add_argument(
         "--checkpoint",
@@ -1746,6 +1894,10 @@ def main() -> None:
         return
     if args.observability:
         bench_observability()
+        bench_offhost()
+        return
+    if args.offhost:
+        bench_offhost()
         return
     if args.checkpoint:
         bench_checkpoint()

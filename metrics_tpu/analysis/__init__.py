@@ -137,17 +137,33 @@ def run_analysis(
 
 def audit_paths(paths: Sequence[str]) -> Report:
     """``--paths`` mode: scan arbitrary files for direct metric-state reads
-    (A006) — the fused-streak staleness caveat, statically."""
+    (A006, the fused-streak staleness caveat) and host-clock / tracer-emit
+    calls (A007), statically.
+
+    Files named in an ``ANALYSIS_MODULE_SPECS`` dict (collected from
+    :data:`registry.MODULE_SPEC_SOURCES`) get the spec's ``allow`` rules
+    suppressed here with the spec's reason — audit mode only; ``lint_class``
+    never reads module specs, so jit-facing metric methods keep A007."""
     t0 = time.perf_counter()
     report = Report()
     entries = registry.build_registry()
     for entry in entries:
         eval_stage.instantiate(entry)
     universe = registry.state_name_universe(entries)
+    module_specs = registry.collect_module_specs()
     for path in paths:
         with open(path, "r") as fh:
             source = fh.read()
-        report.findings.extend(ast_stage.lint_source(path, source, universe))
+        findings = ast_stage.lint_source(path, source, universe)
+        spec = registry.module_spec_for_path(module_specs, path)
+        if spec:
+            allowed = set(spec.get("allow", ()))
+            reason = spec.get("reason", "module-spec exemption")
+            for f in findings:
+                if f.rule in allowed and not f.suppressed:
+                    f.suppressed = True
+                    f.extra["exempt"] = reason
+        report.findings.extend(findings)
     report.findings.sort(key=Finding.sort_key)
     report.elapsed_s = time.perf_counter() - t0
     return report

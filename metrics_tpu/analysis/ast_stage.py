@@ -599,9 +599,55 @@ def lint_class(
     return findings
 
 
+def _root_name_of(node: ast.AST) -> Optional[str]:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _audit_clock_findings(ctx: ModuleContext) -> List[Finding]:
+    """File-wide A007 sweep for audit mode: every host-clock read or tracer
+    emit in the file, regardless of the enclosing def. Noisier by design than
+    the per-method lint — audit mode is opt-in (``--paths``), and host-side
+    modules are expected to carry an ``ANALYSIS_MODULE_SPECS`` exemption (or
+    inline ``# metrics-tpu: allow[A007]``) saying *why* they may touch clocks."""
+    findings: List[Finding] = []
+
+    def emit(node: ast.Call, what: str) -> None:
+        findings.append(
+            Finding(
+                rule="A007",
+                obj=ctx.filename,
+                message=f"{what} — host-side by nature; if this file is jit-facing, "
+                "record at the dispatch layer instead, otherwise exempt the "
+                "module via ANALYSIS_MODULE_SPECS with a reason",
+                file=ctx.filename,
+                line=node.lineno,
+            )
+        )
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id in ctx.clock_names:
+                emit(node, f"`{func.id}()` (host clock / tracer emit)")
+            continue
+        if not isinstance(func, ast.Attribute):
+            continue
+        root = _root_name_of(func)
+        if root in ctx.time_aliases and func.attr in CLOCK_FUNCS:
+            emit(node, f"host-clock read `{root}.{func.attr}()`")
+        elif root in ctx.tracer_aliases and func.attr in TRACER_EMITS:
+            emit(node, f"tracer call `{root}.{func.attr}(...)`")
+    return findings
+
+
 def lint_source(filename: str, source: str, global_state_names: Set[str]) -> List[Finding]:
     """Audit mode (``--paths``): scan arbitrary code for foreign-state reads
-    (A006) — the ROADMAP's stale-member-state caveat, detected statically."""
+    (A006) — the ROADMAP's stale-member-state caveat — and for host-clock /
+    tracer-emit calls (A007, file-wide; see :func:`_audit_clock_findings`)."""
     try:
         ctx = ModuleContext(filename, textwrap.dedent(source))
     except SyntaxError as err:
@@ -628,6 +674,7 @@ def lint_source(filename: str, source: str, global_state_names: Set[str]) -> Lis
                 line=node.lineno,
             )
         )
+    findings.extend(_audit_clock_findings(ctx))
     for f in findings:
         if f.line is not None and f.rule in ctx.suppressions.get(f.line, ()):
             f.suppressed = True

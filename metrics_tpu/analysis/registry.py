@@ -67,6 +67,14 @@ SPEC_MODULES = (
     "metrics_tpu.wrappers",
 )
 
+# packages that publish ANALYSIS_MODULE_SPECS: per-*file* audit-mode
+# exemptions, keyed by repo-relative path. These apply ONLY to ``--paths``
+# audits (audit_paths/lint_source) — lint_class never consults them, so a
+# jit-facing metric method in an exempt file is still flagged.
+MODULE_SPEC_SOURCES = (
+    "metrics_tpu.observability",
+)
+
 
 @dataclass
 class Entry:
@@ -119,6 +127,29 @@ def collect_specs() -> Dict[str, Dict[str, Any]]:
         for name, spec in getattr(mod, "ANALYSIS_SPECS", {}).items():
             specs[name] = spec
     return specs
+
+
+def collect_module_specs() -> Dict[str, Dict[str, Any]]:
+    """Audit-mode file exemptions: ``{repo-relative path: {"allow": (...),
+    "reason": ...}}``, gathered from every package in MODULE_SPEC_SOURCES."""
+    specs: Dict[str, Dict[str, Any]] = {}
+    for modname in MODULE_SPEC_SOURCES:
+        mod = importlib.import_module(modname)
+        for path, spec in getattr(mod, "ANALYSIS_MODULE_SPECS", {}).items():
+            specs[path.replace("\\", "/")] = spec
+    return specs
+
+
+def module_spec_for_path(
+    specs: Dict[str, Dict[str, Any]], path: str
+) -> Optional[Dict[str, Any]]:
+    """Match an audited file path (absolute or relative) against the
+    repo-relative keys of :func:`collect_module_specs`."""
+    p = path.replace("\\", "/")
+    for key, spec in specs.items():
+        if p == key or p.endswith("/" + key):
+            return spec
+    return None
 
 
 def metric_classes() -> List[Type]:

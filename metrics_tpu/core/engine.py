@@ -60,6 +60,7 @@ import numpy as np
 
 from metrics_tpu.observability import instruments as _instruments
 from metrics_tpu.observability import tracer as _otrace
+from metrics_tpu.observability.shards import dispatch_annotation as _dispatch_annotation
 from metrics_tpu.parallel import sync as _sync
 from metrics_tpu.utils.checks import _tracing_active
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -391,7 +392,7 @@ class _EngineBase:
         Only called off the plain hot path (cold compile, or tracer active)."""
         if not _otrace.active:
             return fn(state, *args, **kwargs)
-        with jax.profiler.TraceAnnotation(f"metrics_tpu/{self._owner_name()}.{self._kind}"):
+        with jax.profiler.TraceAnnotation(_dispatch_annotation(self._owner_name(), self._kind)):
             return fn(state, *args, **kwargs)
 
     def _dispatch(self, plain_fn: Callable, donate_fn: Callable,

@@ -16,8 +16,26 @@ Three pieces (see ``docs/observability.md`` for the full architecture):
   trace-event JSON (loads in Perfetto next to ``jax.profiler`` device
   traces), Prometheus text / JSON snapshots, and summarize/diff analytics.
 
-``python -m metrics_tpu.observability`` dumps, summarizes, validates, and
-diffs trace files from the command line.
+Plus the off-host layer (PR 8, ``docs/observability.md`` "Serving and
+merging"):
+
+* :mod:`~metrics_tpu.observability.server` — a stdlib background HTTP
+  **scrape server** (``/metrics``, ``/stats.json``, ``/trace``,
+  ``/healthz``) behind :func:`serve`/:func:`shutdown` and
+  ``METRICS_TPU_OBS_PORT``, degrading to a push-to-spool fallback when the
+  host cannot accept inbound scrapes;
+* :mod:`~metrics_tpu.observability.shards` — per-host **trace shards**
+  (host id + wall/monotonic epoch anchor) merged by
+  :func:`merge_trace_shards` into one clock-aligned multi-host Perfetto
+  trace, and :func:`correlate_device_trace` joining host dispatch spans
+  with the device-side ``jax.profiler.TraceAnnotation`` timeline;
+* :mod:`~metrics_tpu.observability.regress` — the **bench regression
+  watchdog** over the repo's ``BENCH_r*.json`` trajectory
+  (``python -m metrics_tpu.observability regress BENCH_r*.json``).
+
+``python -m metrics_tpu.observability`` dumps, summarizes, validates, diffs
+and merges trace files, and runs the regression watchdog, from the command
+line.
 
 Quick start::
 
@@ -65,6 +83,28 @@ from metrics_tpu.observability.export import (
     validate_chrome_trace,
     write_chrome_trace,
 )
+from metrics_tpu.observability.server import (
+    ObservabilityServer,
+    TraceSpool,
+    get_server,
+    serve,
+    shutdown,
+)
+from metrics_tpu.observability.shards import (
+    build_trace_shard,
+    correlate_device_trace,
+    dispatch_annotation,
+    merge_spool_dir,
+    merge_trace_shards,
+    parse_dispatch_annotation,
+    write_trace_shard,
+)
+from metrics_tpu.observability.regress import (
+    RegressReport,
+    check_paths,
+    check_trajectory,
+    load_rounds,
+)
 
 __all__ = [
     "DEFAULT_CAPACITY",
@@ -91,4 +131,41 @@ __all__ = [
     "diff_traces",
     "to_prometheus_text",
     "to_metrics_json",
+    # off-host layer
+    "ObservabilityServer",
+    "TraceSpool",
+    "serve",
+    "shutdown",
+    "get_server",
+    "build_trace_shard",
+    "write_trace_shard",
+    "merge_trace_shards",
+    "merge_spool_dir",
+    "correlate_device_trace",
+    "dispatch_annotation",
+    "parse_dispatch_annotation",
+    "RegressReport",
+    "check_paths",
+    "check_trajectory",
+    "load_rounds",
 ]
+
+# the analyzer's module-spec surface: A007 (host clocks / tracer emits) is
+# exempted for these files *in --paths audit mode only* — they are the
+# host-side telemetry plane, where wall clocks are the whole point. The
+# exemption never applies to jit-facing metric methods (lint_class ignores
+# it; pinned by tests/analysis/test_rules.py).
+ANALYSIS_MODULE_SPECS = {
+    "metrics_tpu/observability/server.py": {
+        "allow": ("A007",),
+        "reason": "HTTP scrape server: host-side by design, never traced under jit",
+    },
+    "metrics_tpu/observability/shards.py": {
+        "allow": ("A007",),
+        "reason": "trace shard writer/merger: epoch anchors require wall clocks",
+    },
+    "metrics_tpu/observability/tracer.py": {
+        "allow": ("A007",),
+        "reason": "the tracer itself: owns the monotonic clock every span is stamped with",
+    },
+}

@@ -12,6 +12,14 @@ object-format Chrome trace):
   deltas, plus events present on only one side.
 * ``validate FILE`` — schema-check the file as Perfetto input; exit 1 with
   the problem list when invalid.
+* ``merge OUT SHARD [SHARD ...] [--device-trace FILE]`` — merge per-host
+  trace shards (``shards.write_trace_shard`` / the server's ``/trace``
+  endpoint) into one clock-aligned multi-host Perfetto trace; with
+  ``--device-trace``, correlate the merged host timeline with a device-side
+  profile export on the way out.
+* ``regress FILE [FILE ...]`` — the bench regression watchdog: judge the
+  newest ``BENCH_r*.json`` round against the rolling per-key baseline of the
+  earlier rounds; exit 1 on regression (``--all`` replays every round).
 
 Pure stdlib — runs anywhere, no jax required on the analysis machine.
 """
@@ -23,6 +31,8 @@ import sys
 from typing import Any, Dict, List, Optional
 
 from metrics_tpu.observability import export as _export
+from metrics_tpu.observability import regress as _regress
+from metrics_tpu.observability import shards as _shards
 
 
 def _cmd_dump(ns: argparse.Namespace) -> int:
@@ -115,6 +125,59 @@ def _cmd_validate(ns: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_merge(ns: argparse.Namespace) -> int:
+    doc = _shards.merge_trace_shards(ns.shards)
+    if ns.device_trace:
+        doc = _shards.correlate_device_trace(doc, _export.load_trace(ns.device_trace))
+    problems = _export.validate_chrome_trace(doc)
+    if problems:  # merge output must always be valid Perfetto input
+        for p in problems:
+            print(f"merge produced invalid trace: {p}", file=sys.stderr)
+        return 2
+    with open(ns.out, "w") as fh:
+        json.dump(doc, fh, separators=(",", ":"))
+    other = doc.get("otherData", {})
+    n = sum(1 for r in doc["traceEvents"] if r.get("ph") != "M")
+    line = f"{ns.out}: {n} events from hosts {other.get('merged_hosts', [])}"
+    if other.get("unaligned"):
+        line += f" (unaligned: {other['unaligned']})"
+    if "correlation" in other:
+        c = other["correlation"]
+        line += (f"; correlated {c['matched']}/{c['host_dispatches']} dispatch spans "
+                 f"with {c['device_annotations']} device annotations")
+    print(line)
+    return 0
+
+
+def _cmd_regress(ns: argparse.Namespace) -> int:
+    report = _regress.check_paths(
+        ns.files,
+        threshold_pct=ns.threshold_pct,
+        pct_points=ns.pct_points,
+        window=ns.window,
+        min_history=ns.min_history,
+        all_rounds=ns.all,
+    )
+    if ns.json:
+        json.dump(report.to_dict(), sys.stdout, indent=2)
+        print()
+    else:
+        for name, note in sorted(report.notes.items()):
+            print(f"note: {name}: {note}", file=sys.stderr)
+        for r in report.regressions:
+            print(f"REGRESSION {r.describe()}")
+        print(
+            f"rounds {', '.join(report.checked_rounds) or '(none)'}: "
+            f"{report.keys_checked} watched key(s) checked, "
+            f"{report.keys_skipped_no_history} without history, "
+            f"{len(report.regressions)} regression(s)"
+        )
+    if not report.checked_rounds:
+        print("no parseable bench round to judge", file=sys.stderr)
+        return 2
+    return 0 if report.ok else 1
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="python -m metrics_tpu.observability",
@@ -144,6 +207,45 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("validate", help="schema-check a trace file as Perfetto input")
     p.add_argument("file")
     p.set_defaults(fn=_cmd_validate)
+
+    p = sub.add_parser("merge", help="merge per-host trace shards into one Perfetto trace")
+    p.add_argument("out", help="output trace file")
+    p.add_argument("shards", nargs="+", help="shard files (shards.write_trace_shard / GET /trace)")
+    p.add_argument(
+        "--device-trace",
+        help="device-side Chrome-trace export to correlate via TraceAnnotation names",
+    )
+    p.set_defaults(fn=_cmd_merge)
+
+    p = sub.add_parser(
+        "regress", help="bench regression watchdog over BENCH_r*.json rounds"
+    )
+    p.add_argument("files", nargs="+", help="bench round files, any order")
+    p.add_argument(
+        "--threshold-pct", type=float, default=_regress.DEFAULT_THRESHOLD_PCT,
+        help="ratio regression threshold for duration/throughput keys "
+        f"(default {_regress.DEFAULT_THRESHOLD_PCT:g}%%)",
+    )
+    p.add_argument(
+        "--pct-points", type=float, default=_regress.DEFAULT_PCT_POINTS,
+        help="absolute threshold for *_pct keys, in percentage points "
+        f"(default {_regress.DEFAULT_PCT_POINTS:g})",
+    )
+    p.add_argument(
+        "--window", type=int, default=_regress.DEFAULT_WINDOW,
+        help=f"rolling-baseline window in rounds (default {_regress.DEFAULT_WINDOW})",
+    )
+    p.add_argument(
+        "--min-history", type=int, default=_regress.DEFAULT_MIN_HISTORY,
+        help="earlier observations a key needs before it is judged "
+        f"(default {_regress.DEFAULT_MIN_HISTORY})",
+    )
+    p.add_argument(
+        "--all", action="store_true",
+        help="judge every round against its predecessors, not just the newest",
+    )
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_regress)
     return parser
 
 

@@ -168,7 +168,13 @@ def validate_chrome_trace(doc: Any) -> List[str]:
 # Prometheus / JSON metrics snapshot
 # --------------------------------------------------------------------------- #
 def _escape_label(value: str) -> str:
+    # exposition format: label values escape backslash, double-quote, newline
     return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(text: str) -> str:
+    # HELP lines escape backslash and newline only (quotes are literal)
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt_labels(labels: Dict[str, str]) -> str:
@@ -178,25 +184,53 @@ def _fmt_labels(labels: Dict[str, str]) -> str:
     return "{" + inner + "}"
 
 
+def _fmt_value(value: float) -> str:
+    if value != value:  # NaN
+        return "NaN"
+    if value == float("inf"):
+        return "+Inf"
+    if value == float("-inf"):
+        return "-Inf"
+    return str(int(value)) if float(value).is_integer() else repr(float(value))
+
+
 def to_prometheus_text(registry: Optional["_instruments.InstrumentRegistry"] = None) -> str:
     """Render the registry (default: the process registry) in the Prometheus
-    text exposition format, ``# TYPE`` headers included."""
+    text exposition format.
+
+    Strictly to spec (tests/observability/test_exporters.py round-trips this
+    through an unforgiving line parser): one ``# HELP`` + ``# TYPE`` header
+    per metric family, **all samples of a family contiguous** (engine samples
+    arrive interleaved per-engine, so families are regrouped here), label
+    values escaped (``\\``, ``"``, newline), and ``+Inf``/``-Inf``/``NaN``
+    rendered the way Prometheus spells them.
+    """
     reg = registry if registry is not None else _instruments.get_registry()
-    lines: List[str] = []
-    typed: set = set()
+    # group samples into families, preserving first-seen family order
+    families: List[str] = []
+    by_family: Dict[str, List] = {}
+    kinds: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
     for s in reg.samples():
-        family = s.name
-        kind = s.kind
+        family, kind = s.name, s.kind
         if kind.startswith("histogram"):
             family = s.name.rsplit("_", 1)[0]
             kind = "histogram"
-        if family not in typed:
-            typed.add(family)
-            if s.help:
-                lines.append(f"# HELP {family} {s.help}")
-            lines.append(f"# TYPE {family} {kind}")
-        value = int(s.value) if float(s.value).is_integer() else s.value
-        lines.append(f"{s.name}{_fmt_labels(s.labels)} {value}")
+        if family not in by_family:
+            families.append(family)
+            by_family[family] = []
+            kinds[family] = kind
+        by_family[family].append(s)
+        if s.help and family not in helps:
+            helps[family] = s.help
+    lines: List[str] = []
+    for family in families:
+        help_text = helps.get(family, f"metrics_tpu sample family {family}.")
+        lines.append(f"# HELP {family} {_escape_help(help_text)}")
+        lines.append(f"# TYPE {family} {kinds[family]}")
+        for s in by_family[family]:
+            # `s` is a Sample dataclass, not metric state
+            lines.append(f"{s.name}{_fmt_labels(s.labels)} {_fmt_value(s.value)}")  # metrics-tpu: allow[A006]
     return "\n".join(lines) + ("\n" if lines else "")
 
 

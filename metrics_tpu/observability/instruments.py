@@ -26,6 +26,7 @@ Export: :meth:`InstrumentRegistry.samples` yields flat ``Sample`` rows;
 from __future__ import annotations
 
 import math
+import os
 import threading
 import weakref
 from dataclasses import dataclass, field
@@ -34,15 +35,25 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 # name prefix for every sample this library exports
 PREFIX = "metrics_tpu_"
 
-# EngineStats integer fields exported one counter each (field name == suffix)
+# EngineStats integer fields exported one counter each (field name == suffix),
+# with the help text the Prometheus exposition carries for the family
 _ENGINE_COUNTER_FIELDS = (
-    "eager_calls",
-    "cache_misses",
-    "cache_hits",
-    "donated_calls",
-    "bucketed_calls",
-    "key_fast_hits",
+    ("eager_calls", "Dispatches executed eagerly (warmup or fallback)."),
+    ("cache_misses", "Dispatch keys that compiled a new executable."),
+    ("cache_hits", "Dispatches served by an already-compiled executable."),
+    ("donated_calls", "Compiled dispatches that donated the state buffers."),
+    ("bucketed_calls", "Dispatches routed through pow2 batch bucketing."),
+    ("key_fast_hits", "Dispatch keys resolved by the id-keyed signature memo."),
 )
+
+_ENGINE_HELP = {
+    "compiled_calls": "Total compiled dispatches (cache hits + misses).",
+    "compile_seconds": "Cumulative wall time spent tracing and compiling.",
+    "collective_ops": "Trace-time collective op count, by kind.",
+    "collective_bytes": "Trace-time collective payload bytes, by kind.",
+    "fallback_active": "1 while the engine is permanently reverted to eager.",
+    "last_fallback_step": "Dispatch index of the engine's permanent fallback.",
+}
 
 
 def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
@@ -215,35 +226,41 @@ class InstrumentRegistry:
         for engine in self.live_engines():
             stats = engine.stats
             labels = {"kind": engine._kind, "owner": engine._owner_name()}
-            for fname in _ENGINE_COUNTER_FIELDS:
+            for fname, help_text in _ENGINE_COUNTER_FIELDS:
                 yield Sample(f"{PREFIX}engine_{fname}", dict(labels),
-                             float(getattr(stats, fname)), "counter")
+                             float(getattr(stats, fname)), "counter", help_text)
             yield Sample(f"{PREFIX}engine_compiled_calls", dict(labels),
-                         float(stats.compiled_calls), "counter")
+                         float(stats.compiled_calls), "counter",
+                         _ENGINE_HELP["compiled_calls"])
             yield Sample(f"{PREFIX}engine_compile_seconds", dict(labels),
-                         float(getattr(stats, "compile_seconds", 0.0)), "counter")
+                         float(getattr(stats, "compile_seconds", 0.0)), "counter",
+                         _ENGINE_HELP["compile_seconds"])
             for op, n in stats.collective_counts.items():
                 yield Sample(f"{PREFIX}engine_collective_ops", {**labels, "op": op},
-                             float(n), "counter")
+                             float(n), "counter", _ENGINE_HELP["collective_ops"])
             for op, n in stats.collective_bytes.items():
                 yield Sample(f"{PREFIX}engine_collective_bytes", {**labels, "op": op},
-                             float(n), "counter")
+                             float(n), "counter", _ENGINE_HELP["collective_bytes"])
             broken = 1.0 if getattr(engine, "broken", None) else 0.0
-            yield Sample(f"{PREFIX}engine_fallback_active", dict(labels), broken, "gauge")
+            yield Sample(f"{PREFIX}engine_fallback_active", dict(labels), broken,
+                         "gauge", _ENGINE_HELP["fallback_active"])
             last_step = getattr(stats, "last_fallback_step", None)
             if last_step is not None:
                 yield Sample(f"{PREFIX}engine_last_fallback_step", dict(labels),
-                             float(last_step), "gauge")
+                             float(last_step), "gauge",
+                             _ENGINE_HELP["last_fallback_step"])
 
     # ------------------------------------------------------------------ #
     def samples(self) -> List[Sample]:
-        """Flat snapshot of every instrument plus every live engine's stats."""
+        """Flat snapshot of every instrument plus every live engine's stats
+        plus the process/tracer gauges (RSS, ring saturation)."""
         out: List[Sample] = []
         with self._lock:
             instruments = list(self._instruments.values())
         for inst in instruments:
             out.extend(inst.samples())
         out.extend(self._engine_samples())
+        out.extend(_process_samples())
         return out
 
     def snapshot(self) -> Dict[str, Any]:
@@ -260,6 +277,72 @@ class InstrumentRegistry:
         with self._lock:
             self._instruments.clear()
             self._engines.clear()
+
+
+def _rss_bytes() -> Optional[int]:
+    """Resident set size via ``/proc`` (Linux), ``resource`` elsewhere."""
+    try:
+        with open("/proc/self/statm") as fh:
+            return int(fh.read().split()[1]) * (os.sysconf("SC_PAGE_SIZE") or 4096)
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+
+        rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(rss_kb) * 1024  # Linux reports KiB (peak, not current — best effort)
+    except Exception:
+        return None
+
+
+def _process_samples() -> Iterable[Sample]:
+    """Process- and tracer-level samples computed at snapshot time.
+
+    These are *derived* gauges, not stored instruments: the tracer's drop
+    counter and ring fill are read off the live ring (so a scrape sees ring
+    saturation the moment it happens — the "is my capacity too small" signal),
+    and ``rss_bytes`` is read from the OS. Exported names:
+
+    * ``metrics_tpu_tracer_dropped_events_total`` — events evicted by the
+      ring bound since the last :meth:`EventTracer.clear`;
+    * ``metrics_tpu_tracer_ring_events`` / ``_ring_capacity`` /
+      ``_ring_utilization`` — current fill, bound, and their ratio;
+    * ``metrics_tpu_tracer_active`` — 1 while tracing is enabled;
+    * ``metrics_tpu_process_rss_bytes`` — resident set size.
+    """
+    from metrics_tpu.observability import tracer as _tracer_mod
+
+    tracer = _tracer_mod.get_tracer()
+    dropped = float(tracer.dropped) if tracer is not None else 0.0
+    events = float(len(tracer)) if tracer is not None else 0.0
+    capacity = float(tracer.capacity) if tracer is not None else 0.0
+    yield Sample(
+        f"{PREFIX}tracer_dropped_events_total", {}, dropped, "counter",
+        "Trace events evicted by the ring buffer bound.",
+    )
+    yield Sample(
+        f"{PREFIX}tracer_ring_events", {}, events, "gauge",
+        "Trace events currently buffered in the ring.",
+    )
+    yield Sample(
+        f"{PREFIX}tracer_ring_capacity", {}, capacity, "gauge",
+        "Ring buffer capacity (0 = no tracer constructed yet).",
+    )
+    yield Sample(
+        f"{PREFIX}tracer_ring_utilization", {},
+        (events / capacity) if capacity else 0.0, "gauge",
+        "Ring fill fraction; 1.0 means the next event evicts the oldest.",
+    )
+    yield Sample(
+        f"{PREFIX}tracer_active", {}, 1.0 if _tracer_mod.enabled() else 0.0, "gauge",
+        "Whether runtime tracing is currently enabled.",
+    )
+    rss = _rss_bytes()
+    if rss is not None:
+        yield Sample(
+            f"{PREFIX}process_rss_bytes", {}, float(rss), "gauge",
+            "Resident set size of this process.",
+        )
 
 
 # the process-wide default registry; engines register here at construction

@@ -11,6 +11,15 @@ dispatches in ``TraceAnnotation`` names (``metrics_tpu/<Owner>.<kind>``)
 while the tracer is on, so the two halves line up when loaded together in
 Perfetto.
 
+The annotation names are the **correlation bridge**: they are built by
+:func:`dispatch_annotation` (re-exported here from
+:mod:`metrics_tpu.observability.shards`, the single source of truth), and
+:func:`metrics_tpu.observability.correlate_device_trace` uses the inverse
+(:func:`parse_dispatch_annotation`) to join a device-side trace export with
+the host tracer's ``dispatch/*`` spans — one merged Perfetto screen with the
+host and device tracks aligned. A multi-host workflow walkthrough lives in
+``docs/observability.md`` ("Serving and merging").
+
 Reference parity: the reference has no tracer — only the usage-logging hook
 (metric.py:86) and the ``check_forward_no_full_state`` micro-benchmark
 (utilities/checks.py:625-723, ported as
@@ -25,6 +34,12 @@ from contextlib import contextmanager
 from typing import Any, Callable, Dict, Generator, Optional
 
 import jax
+
+from metrics_tpu.observability.shards import (  # noqa: F401 — the bridge's public home
+    ANNOTATION_PREFIX,
+    dispatch_annotation,
+    parse_dispatch_annotation,
+)
 
 
 @contextmanager

@@ -388,3 +388,85 @@ class TestEvalStage:
             Entry(cls=CleanMetric, spec={"init": {"no_such_kwarg": 1}, "inputs": _SPEC["inputs"]})
         )
         assert [f.rule for f in findings] == ["E003"]
+
+
+# --------------------------------------------------------------------------- #
+# audit mode (--paths) — file-wide A007 and the module-spec exemption
+# --------------------------------------------------------------------------- #
+_CLOCKY_SOURCE = '''
+import time
+from time import monotonic
+from metrics_tpu.observability import tracer as _otrace
+
+def heartbeat():
+    t0 = time.perf_counter()
+    t1 = monotonic()
+    _otrace.emit_instant("server/poll", "server")
+    return t0, t1
+
+def quiet():
+    return time.time_ns()  # metrics-tpu: allow[A007]
+'''
+
+
+class TestAuditMode:
+    def test_audit_flags_every_clock_and_tracer_call(self):
+        findings = ast_stage.lint_source("somefile.py", _CLOCKY_SOURCE, set())
+        a007 = [f for f in findings if f.rule == "A007"]
+        assert len(a007) == 4
+        active = [f for f in a007 if not f.suppressed]
+        assert len(active) == 3  # the inline allow[] silences the fourth
+        messages = " | ".join(f.message for f in active)
+        assert "time.perf_counter" in messages
+        assert "monotonic()" in messages
+        assert "_otrace.emit_instant" in messages
+
+    def test_observability_host_modules_are_spec_exempt(self):
+        from metrics_tpu.analysis.registry import (
+            collect_module_specs,
+            module_spec_for_path,
+        )
+
+        specs = collect_module_specs()
+        for path in (
+            "metrics_tpu/observability/server.py",
+            "metrics_tpu/observability/shards.py",
+            "metrics_tpu/observability/tracer.py",
+        ):
+            spec = module_spec_for_path(specs, f"/root/anywhere/{path}")
+            assert spec is not None, path
+            assert "A007" in spec["allow"]
+            assert spec["reason"]
+        assert module_spec_for_path(specs, "metrics_tpu/core/engine.py") is None
+        # suffix matching must not cross path-segment boundaries
+        assert module_spec_for_path(specs, "not_metrics_tpu/observability/server.py") is None
+
+    def test_audit_paths_suppresses_with_reason(self, tmp_path, monkeypatch):
+        from metrics_tpu import analysis as _analysis
+        from metrics_tpu.analysis import registry as _registry
+
+        target = tmp_path / "clocky.py"
+        target.write_text(_CLOCKY_SOURCE)
+        monkeypatch.setattr(
+            _registry, "collect_module_specs",
+            lambda: {"clocky.py": {"allow": ("A007",), "reason": "host-side poller"}},
+        )
+        report = _analysis.audit_paths([str(target)])
+        a007 = [f for f in report.findings if f.rule == "A007"]
+        assert a007 and all(f.suppressed for f in a007)
+        assert any(f.extra.get("exempt") == "host-side poller" for f in a007)
+        assert report.errors == 0
+
+    def test_exemption_never_reaches_jit_facing_methods(self, monkeypatch):
+        """The module-spec exemption is audit-only: even with this test file
+        itself spec-exempted for A007, lint_class still flags the clock read
+        in ClockReadMetric.update."""
+        from metrics_tpu.analysis import registry as _registry
+
+        monkeypatch.setattr(
+            _registry, "collect_module_specs",
+            lambda: {"tests/analysis/test_rules.py": {"allow": ("A007",),
+                                                      "reason": "leak probe"}},
+        )
+        findings = _lint(ClockReadMetric)
+        assert _active_rules(findings) == ["A007"]

@@ -1,7 +1,9 @@
-"""Observability suite hygiene: tracing is process-global state, so every
-test leaves it the way it found it (off, with no leftover buffer)."""
+"""Observability suite hygiene: tracing and the scrape server are
+process-global state, so every test leaves them the way it found them
+(tracing off with no leftover buffer, no server thread still bound)."""
 import pytest
 
+from metrics_tpu.observability import server as _oserver
 from metrics_tpu.observability import tracer as _otrace
 
 
@@ -12,3 +14,4 @@ def _tracer_off_after_each_test():
     tracer = _otrace.get_tracer()
     if tracer is not None:
         tracer.clear()
+    _oserver.shutdown()

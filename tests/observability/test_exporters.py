@@ -139,6 +139,131 @@ class TestPrometheus:
         ]
 
 
+import re
+
+
+class _StrictPromParser:
+    """An unforgiving reader of the text exposition format: every line must be
+    a HELP/TYPE header or a sample; families must be contiguous; label values
+    are unescaped back to their originals."""
+
+    NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+    SAMPLE_RE = re.compile(
+        rf"^(?P<name>{NAME})(?:\{{(?P<labels>.*)\}})? (?P<value>[^ ]+)$")
+    LABEL_RE = re.compile(
+        rf'(?P<key>{NAME})="(?P<value>(?:[^"\\]|\\.)*)"(?:,|$)')
+    HEADER_RE = re.compile(rf"^# (?P<kw>HELP|TYPE) (?P<name>{NAME}) (?P<rest>.*)$")
+    KINDS = {"counter", "gauge", "histogram", "summary", "untyped"}
+
+    @staticmethod
+    def _unescape_label(value):
+        return value.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+
+    @staticmethod
+    def _parse_value(text):
+        return {"+Inf": float("inf"), "-Inf": float("-inf"), "NaN": float("nan")}.get(
+            text, None) if text in ("+Inf", "-Inf", "NaN") else float(text)
+
+    def parse(self, text):
+        assert text.endswith("\n"), "exposition must end with a newline"
+        families, samples = {}, []
+        current, closed = None, set()
+        for lineno, line in enumerate(text.splitlines(), start=1):
+            assert line.strip(), f"line {lineno}: blank line"
+            header = self.HEADER_RE.match(line)
+            if header:
+                fam = header.group("name")
+                assert fam not in closed, f"line {lineno}: family {fam} reopened"
+                if header.group("kw") == "HELP":
+                    if current is not None:
+                        closed.add(current)
+                    current = fam
+                    assert header.group("rest"), f"line {lineno}: empty HELP"
+                    families[fam] = {"help": header.group("rest"), "type": None}
+                else:
+                    assert fam == current, f"line {lineno}: TYPE without its HELP"
+                    assert header.group("rest") in self.KINDS, line
+                    families[fam]["type"] = header.group("rest")
+                continue
+            m = self.SAMPLE_RE.match(line)
+            assert m, f"line {lineno}: unparseable sample {line!r}"
+            name = m.group("name")
+            fam = current
+            assert fam is not None and (
+                name == fam or (families[fam]["type"] == "histogram"
+                                and name in (f"{fam}_bucket", f"{fam}_sum", f"{fam}_count"))
+            ), f"line {lineno}: sample {name} outside its family block ({fam})"
+            labels = {}
+            raw = m.group("labels")
+            if raw:
+                consumed = 0
+                for lm in self.LABEL_RE.finditer(raw):
+                    labels[lm.group("key")] = self._unescape_label(lm.group("value"))
+                    consumed = lm.end()
+                assert consumed == len(raw), f"line {lineno}: trailing label junk"
+            samples.append((name, labels, self._parse_value(m.group("value"))))
+        return families, samples
+
+
+class TestPrometheusRoundTrip:
+    def test_live_registry_exposition_parses_strictly(self):
+        # the real process registry with an engine attached: engine counters,
+        # process gauges, and the tracer's dropped-events counter all present
+        obs.enable()
+        acc = metrics_for_roundtrip()
+        logits = np.random.randn(8, 4).astype(np.float32)
+        target = np.random.randint(0, 4, size=(8,))
+        acc.update(logits, target)
+        from metrics_tpu.observability.instruments import get_registry
+
+        text = obs.to_prometheus_text(get_registry())
+        families, samples = _StrictPromParser().parse(text)
+        names = {s[0] for s in samples}
+        assert "metrics_tpu_tracer_dropped_events_total" in names
+        assert "metrics_tpu_tracer_ring_utilization" in names
+        assert any(n.startswith("metrics_tpu_engine_") for n in names)
+        assert families["metrics_tpu_tracer_dropped_events_total"]["type"] == "counter"
+        # every family got a non-default-free HELP and a TYPE
+        assert all(f["help"] and f["type"] for f in families.values())
+
+    def test_awkward_label_values_round_trip(self):
+        reg = InstrumentRegistry()
+        awkward = 'quote " backslash \\ newline \n tab\tdone'
+        reg.counter("edge_total", help="edge cases", tag=awkward).inc(2)
+        reg.gauge("nan_gauge", help="nan").set(float("nan"))
+        reg.gauge("inf_gauge", help="inf").set(float("inf"))
+        h = reg.histogram("lat_seconds", help="lat", buckets=(0.5,))
+        h.observe(0.1)
+        text = obs.to_prometheus_text(reg)
+        families, samples = _StrictPromParser().parse(text)
+        by_name = {}
+        for name, labels, value in samples:
+            by_name.setdefault(name, []).append((labels, value))
+        ((labels, value),) = by_name["metrics_tpu_edge_total"]
+        assert labels == {"tag": awkward}  # escape -> parse -> original
+        assert value == 2.0
+        assert by_name["metrics_tpu_nan_gauge"][0][1] != by_name["metrics_tpu_nan_gauge"][0][1]
+        assert by_name["metrics_tpu_inf_gauge"][0][1] == float("inf")
+        assert families["metrics_tpu_lat_seconds"]["type"] == "histogram"
+        bucket_labels = [l for (l, v) in by_name["metrics_tpu_lat_seconds_bucket"]]
+        assert {"le": "0.5"} in bucket_labels and {"le": "+Inf"} in bucket_labels
+
+    def test_interleaved_engine_families_are_regrouped(self):
+        # two instruments sharing names but differing labels arrive
+        # interleaved; the exposition must still keep each family contiguous
+        reg = InstrumentRegistry()
+        for owner in ("A", "B"):
+            reg.counter("hits_total", help="h", owner=owner).inc()
+            reg.gauge("depth", help="d", owner=owner).set(1)
+        _StrictPromParser().parse(obs.to_prometheus_text(reg))
+
+
+def metrics_for_roundtrip():
+    from metrics_tpu import Accuracy
+
+    return Accuracy(num_classes=4)
+
+
 def _doc(events):
     return {
         "traceEvents": [

@@ -1,0 +1,190 @@
+"""Bench regression watchdog: key classification, record extraction,
+rolling-baseline judgement, and the repo's own BENCH trajectory as the
+always-green fixture."""
+import glob
+import json
+import os
+
+import pytest
+
+from metrics_tpu.observability import __main__ as obs_main
+from metrics_tpu.observability import regress as _regress
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _write_round(tmp_path, name, record):
+    path = tmp_path / f"BENCH_{name}.json"
+    path.write_text(json.dumps(record))
+    return str(path)
+
+
+def _record(value=100.0, **extra):
+    return {
+        "metric": "fused_update_us_per_step",
+        "value": value,
+        "unit": "us",
+        "extra": extra,
+    }
+
+
+class TestClassifyKey:
+    @pytest.mark.parametrize(
+        "key, expected",
+        [
+            ("extra.fused.fused_update_us_per_step", _regress.LOWER_IS_BETTER),
+            ("extra.t.compile_s", _regress.LOWER_IS_BETTER),
+            ("extra.scrape.scrape_p50_ms", _regress.LOWER_IS_BETTER),
+            ("extra.sync.collective_bytes", _regress.LOWER_IS_BETTER),
+            ("extra.merge.merge_wall_s", _regress.LOWER_IS_BETTER),
+            ("extra.tput.steps_per_sec", _regress.HIGHER_IS_BETTER),
+            ("extra.engine.speedup", _regress.HIGHER_IS_BETTER),
+            ("extra.model.mfu_pct", _regress.HIGHER_IS_BETTER),
+            ("extra.overhead.tracer_overhead_pct", _regress.PCT_POINTS),
+            ("value.overhead_pct_max", _regress.PCT_POINTS),
+            ("extra.cfg.num_classes", None),
+            ("extra.flags.chrome_trace_valid", None),
+            ("extra.fused.fused_update_us_per_step_tracer_off", None),
+        ],
+    )
+    def test_direction(self, key, expected):
+        assert _regress.classify_key(key) == expected
+
+
+class TestLoading:
+    def test_direct_record(self, tmp_path):
+        p = _write_round(tmp_path, "r01", _record(42.0))
+        (r,) = _regress.load_rounds([p])
+        assert r.ok and r.name == "r01"
+        assert r.record["value"] == 42.0
+
+    def test_driver_wrapper_with_noisy_tail(self, tmp_path):
+        record = _record(7.5)
+        wrapper = {
+            "n": 3, "cmd": "python bench.py", "rc": 0,
+            "tail": "WARNING: platform noise\n"
+                    'log prefix {"metric": "stale", "value": 1}\n'
+                    f"more noise {json.dumps(record)}\n",
+        }
+        p = _write_round(tmp_path, "r02", wrapper)
+        (r,) = _regress.load_rounds([p])
+        assert r.ok
+        assert r.record["value"] == 7.5  # last parseable record line wins
+
+    def test_unparseable_tail_is_a_note_not_a_crash(self, tmp_path):
+        p = _write_round(tmp_path, "r03", {"n": 1, "cmd": "x", "rc": 0, "tail": "truncated {\"met"})
+        (r,) = _regress.load_rounds([p])
+        assert not r.ok and "no parseable" in r.note
+
+    def test_rounds_sort_numerically(self, tmp_path):
+        paths = [_write_round(tmp_path, name, _record()) for name in ("r10", "r2", "r1")]
+        names = [r.name for r in _regress.load_rounds(paths)]
+        assert names == ["r1", "r2", "r10"]
+
+    def test_headline_flattens_under_metric_name(self):
+        flat = _regress.flatten_record(_record(33.0, cfg={"batch": 1024}))
+        assert flat["value.fused_update_us_per_step"] == 33.0
+        assert flat["extra.cfg.batch"] == 1024.0
+
+
+class TestJudgement:
+    def _trajectory(self, tmp_path, values, extra_fn=None):
+        paths = []
+        for i, v in enumerate(values, start=1):
+            extra = extra_fn(i, v) if extra_fn else {}
+            paths.append(_write_round(tmp_path, f"r{i:02d}", _record(v, **extra)))
+        return paths
+
+    def test_stable_trajectory_is_ok(self, tmp_path):
+        paths = self._trajectory(tmp_path, [100, 104, 98, 101])
+        report = _regress.check_paths(paths)
+        assert report.ok
+        assert report.checked_rounds == ["r04"]
+        assert report.keys_checked >= 1
+
+    def test_degraded_duration_regresses(self, tmp_path):
+        paths = self._trajectory(tmp_path, [100, 104, 98, 200])
+        report = _regress.check_paths(paths)
+        assert not report.ok
+        (reg,) = report.regressions
+        assert reg.key == "value.fused_update_us_per_step"
+        assert reg.round == "r04"
+        assert reg.direction == _regress.LOWER_IS_BETTER
+        assert reg.delta > 50.0
+        assert "lower is better" in reg.describe()
+
+    def test_throughput_drop_regresses(self, tmp_path):
+        def extra(i, v):
+            return {"tput": {"steps_per_sec": 1000.0 if i < 4 else 300.0}}
+        paths = self._trajectory(tmp_path, [100, 100, 100, 100], extra)
+        report = _regress.check_paths(paths)
+        assert any(r.key == "extra.tput.steps_per_sec" for r in report.regressions)
+
+    def test_pct_keys_use_absolute_points(self, tmp_path):
+        def extra(i, v):
+            return {"overhead_pct": 1.0 if i < 4 else 8.0}
+        paths = self._trajectory(tmp_path, [100, 100, 100, 100], extra)
+        # 1% -> 8% is a 8x ratio but only 7 points: under the 10-point default
+        assert _regress.check_paths(paths).ok
+        def extra2(i, v):
+            return {"overhead_pct": 1.0 if i < 4 else 15.0}
+        (tmp_path / "b").mkdir()
+        paths2 = self._trajectory(tmp_path / "b", [100, 100, 100, 100], extra2)
+        report = _regress.check_paths(paths2)
+        assert any(r.direction == _regress.PCT_POINTS for r in report.regressions)
+
+    def test_new_key_without_history_is_skipped(self, tmp_path):
+        def extra(i, v):
+            return {"scrape": {"p50_ms": 3.0}} if i == 4 else {}
+        paths = self._trajectory(tmp_path, [100, 100, 100, 100], extra)
+        report = _regress.check_paths(paths)
+        assert report.ok
+        assert report.keys_skipped_no_history >= 1
+
+    def test_only_newest_round_is_judged_by_default(self, tmp_path):
+        # r03 is a spike that recovered: latest-only mode stays green,
+        # all_rounds replays history and flags the spike where it happened
+        paths = self._trajectory(tmp_path, [100, 100, 400, 100])
+        assert _regress.check_paths(paths).ok
+        replay = _regress.check_paths(paths, all_rounds=True)
+        assert any(r.round == "r03" for r in replay.regressions)
+
+    def test_rolling_window_bounds_the_baseline(self, tmp_path):
+        # old slow rounds age out of the 2-round window: baseline is the
+        # recent fast pair, so the jump back to 300 regresses
+        paths = self._trajectory(tmp_path, [300, 310, 100, 102, 300])
+        assert not _regress.check_paths(paths, window=2).ok
+        # with the full history in the window the median forgives it
+        assert _regress.check_paths(paths, window=5).ok
+
+
+class TestRepoTrajectory:
+    def _repo_rounds(self):
+        return sorted(glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json")))
+
+    def test_checked_in_trajectory_is_green(self):
+        paths = self._repo_rounds()
+        assert len(paths) >= 12, "BENCH trajectory missing from repo root"
+        report = _regress.check_paths(paths)
+        assert report.checked_rounds, report.notes
+        assert report.ok, [r.describe() for r in report.regressions]
+
+    def test_cli_exit_codes(self, tmp_path):
+        paths = self._repo_rounds()
+        assert obs_main.main(["regress", *paths]) == 0
+        # synthetically degrade a new newest round: re-record r12's watched
+        # duration 100x slower and its overhead 50 points up
+        latest = json.loads(open(os.path.join(REPO_ROOT, "BENCH_r12.json")).read())
+        record, note = _regress._extract_record(latest)
+        assert record is not None, note
+        bad = json.loads(json.dumps(record))
+        bad["extra"]["baseline_fused_update_us_per_step"] *= 100.0
+        bad["extra"]["tracer_on_overhead_pct"] += 50.0
+        bad_path = str(tmp_path / "BENCH_r99.json")
+        with open(bad_path, "w") as fh:
+            json.dump(bad, fh)
+        assert obs_main.main(["regress", *paths, bad_path]) == 1
+        empty = str(tmp_path / "BENCH_r98.json")
+        with open(empty, "w") as fh:
+            json.dump({"n": 1, "cmd": "x", "rc": 0, "tail": "no record"}, fh)
+        assert obs_main.main(["regress", empty]) == 2

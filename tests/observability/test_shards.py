@@ -1,0 +1,213 @@
+"""Multi-host trace shards: anchors, merge, spool, and device correlation."""
+import json
+
+from metrics_tpu import observability as obs
+from metrics_tpu.observability import shards as _shards
+from metrics_tpu.observability import tracer as _otrace
+
+
+def _shard(host_id, events, unix_us, monotonic_us, pid=1234):
+    """Hand-built shard: events carry monotonic-domain timestamps; the anchor
+    maps them onto the wall-clock axis (offset = unix_us - monotonic_us)."""
+    return {
+        "traceEvents": [
+            {"name": "process_name", "ph": "M", "ts": 0, "pid": pid, "tid": 0,
+             "args": {"name": f"host:{host_id}"}},
+            *events,
+        ],
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "metrics_tpu.observability",
+            "dropped_events": 0,
+            "shard": {
+                "format": _shards.SHARD_FORMAT_VERSION,
+                "host_id": host_id,
+                "pid": pid,
+                "epoch_anchor": {"unix_us": unix_us, "monotonic_us": monotonic_us},
+            },
+        },
+    }
+
+
+def _span(name, ts, dur=10, pid=1234, args=None):
+    rec = {"name": name, "cat": "engine", "ph": "X", "ts": ts, "dur": dur,
+           "pid": pid, "tid": 7}
+    if args is not None:
+        rec["args"] = args
+    return rec
+
+
+class TestAnnotationBridge:
+    def test_round_trip(self):
+        name = _shards.dispatch_annotation("Accuracy", "update")
+        assert name == "metrics_tpu/Accuracy.update"
+        assert _shards.parse_dispatch_annotation(name) == ("Accuracy", "update")
+
+    def test_non_bridge_names_do_not_parse(self):
+        for name in ("jit_update", "metrics_tpu/", "metrics_tpu/NoKind",
+                     "other/Accuracy.update", "metrics_tpu/A.b.c extra"):
+            assert _shards.parse_dispatch_annotation(name) is None
+
+    def test_profiling_reexports_the_same_functions(self):
+        from metrics_tpu.utils import profiling
+
+        assert profiling.dispatch_annotation is _shards.dispatch_annotation
+        assert profiling.parse_dispatch_annotation is _shards.parse_dispatch_annotation
+
+
+class TestShardBuilding:
+    def test_epoch_anchor_is_paired_microseconds(self):
+        a = _shards.epoch_anchor()
+        assert set(a) == {"unix_us", "monotonic_us"}
+        assert a["unix_us"] > 10**15  # wall clock is past 2001 in us
+        assert a["monotonic_us"] >= 0
+
+    def test_build_trace_shard_annotates_the_doc(self):
+        t = obs.EventTracer()
+        t.record("dispatch/cached", "engine", ph=_otrace.PH_COMPLETE, ts=50, dur=5)
+        doc = _shards.build_trace_shard(t, host_id="hostA")
+        assert obs.validate_chrome_trace(doc) == []
+        shard = doc["otherData"]["shard"]
+        assert shard["host_id"] == "hostA"
+        assert shard["format"] == _shards.SHARD_FORMAT_VERSION
+        assert "epoch_anchor" in shard
+
+    def test_write_is_atomic_and_overwrites_per_host(self, tmp_path):
+        t = obs.EventTracer()
+        t.record("a", "x")
+        p1 = _shards.write_trace_shard(tmp_path, t, host_id="worker/0")
+        p2 = _shards.write_trace_shard(tmp_path, t, host_id="worker/0")
+        assert p1 == p2  # same host re-spools over its previous shard
+        assert _shards.list_trace_shards(tmp_path) == [p1]
+        assert not any(n.endswith(".tmp") for n in [p1])
+        with open(p1) as fh:
+            assert json.load(fh)["otherData"]["shard"]["host_id"] == "worker/0"
+
+
+class TestMerge:
+    def test_two_hosts_get_distinct_pids_and_aligned_clocks(self):
+        # host A's monotonic zero is 500us before its events; host B's clock
+        # started ~100ms earlier. On the wall axis B's span precedes A's.
+        doc_a = _shard("A", [_span("dispatch/cached", ts=600)],
+                       unix_us=1_000_000, monotonic_us=500)
+        doc_b = _shard("B", [_span("dispatch/eager", ts=100_050)],
+                       unix_us=1_000_000, monotonic_us=100_000)
+        merged = _shards.merge_trace_shards([doc_a, doc_b])
+        assert obs.validate_chrome_trace(merged) == []
+        data = [r for r in merged["traceEvents"] if r["ph"] != "M"]
+        assert {r["pid"] for r in data} == {1, 2}
+        by_name = {r["name"]: r for r in data}
+        # wall: A = 1_000_100, B = 1_000_050 -> rebased to t0 = B's wall time
+        assert by_name["dispatch/eager"]["ts"] == 0
+        assert by_name["dispatch/cached"]["ts"] == 50
+        assert by_name["dispatch/eager"]["ts"] < by_name["dispatch/cached"]["ts"]
+        assert merged["otherData"]["t0_unix_us"] == 1_000_050
+        assert merged["otherData"]["merged_hosts"] == ["A", "B"]
+        assert merged["otherData"]["unaligned"] == []
+
+    def test_process_tracks_are_named_per_host(self):
+        merged = _shards.merge_trace_shards([
+            _shard("A", [_span("x", ts=1)], 10, 0),
+            _shard("B", [_span("y", ts=1)], 10, 0),
+        ])
+        names = {r["pid"]: r["args"]["name"]
+                 for r in merged["traceEvents"]
+                 if r["ph"] == "M" and r["name"] == "process_name"}
+        assert names == {1: "host:A", 2: "host:B"}
+
+    def test_anchorless_shard_merges_unshifted_and_is_flagged(self):
+        plain = {"traceEvents": [_span("z", ts=7)], "otherData": {"dropped_events": 0}}
+        anchored = _shard("A", [_span("x", ts=3)], unix_us=5, monotonic_us=3)
+        merged = _shards.merge_trace_shards([anchored, plain])
+        assert merged["otherData"]["unaligned"] == ["shard1"]
+        assert obs.validate_chrome_trace(merged) == []
+
+    def test_dropped_events_accumulate(self):
+        a = _shard("A", [_span("x", ts=1)], 10, 0)
+        a["otherData"]["dropped_events"] = 3
+        b = _shard("B", [_span("y", ts=1)], 10, 0)
+        b["otherData"]["dropped_events"] = 4
+        merged = _shards.merge_trace_shards([a, b])
+        assert merged["otherData"]["dropped_events"] == 7
+
+    def test_merge_spool_dir_round_trip(self, tmp_path):
+        for host in ("hostA", "hostB"):
+            t = obs.EventTracer()
+            t.record("dispatch/cached", "engine", ph=_otrace.PH_COMPLETE, ts=10, dur=2)
+            _shards.write_trace_shard(tmp_path, t, host_id=host)
+        merged = _shards.merge_spool_dir(tmp_path)
+        assert obs.validate_chrome_trace(merged) == []
+        assert merged["otherData"]["merged_hosts"] == ["hostA", "hostB"]
+        pids = {r["pid"] for r in merged["traceEvents"] if r["ph"] != "M"}
+        assert pids == {1, 2}
+
+
+class TestCorrelation:
+    def _host_doc(self):
+        return {
+            "traceEvents": [
+                {"name": "process_name", "ph": "M", "ts": 0, "pid": 1, "tid": 0,
+                 "args": {"name": "host:A"}},
+                _span("dispatch/cached", ts=100, pid=1,
+                      args={"owner": "Accuracy", "kind": "update"}),
+                _span("dispatch/cached", ts=300, pid=1,
+                      args={"owner": "Accuracy", "kind": "update"}),
+                _span("sync/bucket_build", ts=200, pid=1),
+            ],
+            "displayTimeUnit": "ms",
+            "otherData": {"dropped_events": 0},
+        }
+
+    def _device_doc(self):
+        ann = _shards.dispatch_annotation("Accuracy", "update")
+        return {
+            "traceEvents": [
+                _span(ann, ts=5000, dur=8, pid=99),
+                _span(ann, ts=5200, dur=8, pid=99),
+                _span("fusion.123", ts=5100, dur=2, pid=99),
+            ],
+        }
+
+    def test_kth_dispatch_matches_kth_annotation(self):
+        combined = _shards.correlate_device_trace(self._host_doc(), self._device_doc())
+        assert obs.validate_chrome_trace(combined) == []
+        corr = combined["otherData"]["correlation"]
+        assert corr["matched"] == 2
+        assert corr["host_dispatches"] == 2
+        assert corr["device_annotations"] == 2
+        assert corr["device_events"] == 3
+        # offset estimated from the first matched pair: 100 - 5000
+        assert corr["offset_us"] == -4900.0
+        data = [r for r in combined["traceEvents"] if r["ph"] != "M"]
+        dev = [r for r in data if r["pid"] == 2]
+        assert {r["ts"] for r in dev} == {100.0, 300.0, 200.0}
+        host_matched = [r for r in data if r.get("args", {}).get("annotation")]
+        assert len(host_matched) == 2
+        assert all(r["args"]["annotation"].startswith("metrics_tpu/") for r in host_matched)
+
+    def test_explicit_offset_wins(self):
+        combined = _shards.correlate_device_trace(
+            self._host_doc(), self._device_doc(), offset_us=-5000.0)
+        dev_ts = sorted(r["ts"] for r in combined["traceEvents"]
+                        if r.get("pid") == 2 and r["ph"] != "M")
+        assert dev_ts == [0.0, 100.0, 200.0]
+
+    def test_device_track_is_named(self):
+        combined = _shards.correlate_device_trace(
+            self._host_doc(), self._device_doc(), device_name="device:tpu0")
+        meta = [r for r in combined["traceEvents"]
+                if r["ph"] == "M" and r["name"] == "process_name" and r["pid"] == 2]
+        assert meta and meta[0]["args"]["name"] == "device:tpu0"
+
+    def test_merge_then_correlate_is_still_valid(self):
+        shard = _shard("A", [
+            _span("dispatch/cached", ts=100,
+                  args={"owner": "F1Score", "kind": "compute"}),
+        ], unix_us=1_000, monotonic_us=0)
+        merged = _shards.merge_trace_shards([shard])
+        device = {"traceEvents": [
+            _span(_shards.dispatch_annotation("F1Score", "compute"), ts=1, pid=42),
+        ]}
+        combined = _shards.correlate_device_trace(merged, device)
+        assert obs.validate_chrome_trace(combined) == []
+        assert combined["otherData"]["correlation"]["matched"] == 1
