@@ -1840,6 +1840,182 @@ def bench_offhost() -> None:
         sys.exit(1)
 
 
+def bench_partitioned_dispatch() -> None:
+    """``--partitioned-dispatch``: the ISSUE-9 headline — config2 plus one
+    host-readback straggler plus one ``batch_buckets`` member, partitioned
+    dispatch (fused majority + bucketed + eager straggler) vs the pre-PR
+    behaviour where one untraceable member demoted the *whole* collection to
+    the eager loop. Computes must be bitwise-identical between the two arms;
+    recorded into ``BENCH_r14.json``. Host-side CPU bench."""
+    import glob as _glob
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, F1Score, Metric, MetricCollection, Precision, Recall
+    from metrics_tpu.observability import regress as _regress
+
+    class HostReadback(Metric):
+        """An untraceable straggler: the host round-trip breaks the fused
+        trace probe, so the dispatcher migrates it to the eager set."""
+
+        full_state_update = False
+
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, preds, target):
+            self.total = self.total + float(jnp.sum(target))
+
+        def compute(self):
+            return self.total
+
+    class BucketedPositives(Metric):
+        """A ragged-batch counter under pow2 bucketing: bucket padding rows
+        are zeros, so the padded sum is exact."""
+
+        full_state_update = False
+
+        def __init__(self, **kwargs):
+            super().__init__(batch_buckets=True, **kwargs)
+            self.add_state("total", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+        def update(self, preds, target):
+            self.total = self.total + jnp.sum(target).astype(jnp.float32)
+
+        def compute(self):
+            return self.total
+
+    def build(**coll_kwargs):
+        return MetricCollection(
+            {
+                "acc": Accuracy(num_classes=NUM_CLASSES, average="micro"),
+                "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+                "precision": Precision(num_classes=NUM_CLASSES, average="macro"),
+                "recall": Recall(num_classes=NUM_CLASSES, average="macro"),
+                "host": HostReadback(),
+                "bucketed_pos": BucketedPositives(),
+            },
+            **coll_kwargs,
+        )
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(BATCH, NUM_CLASSES)), dtype=jnp.float32)
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=jnp.int32)
+
+    def one_rep(coll, steps=STEPS):
+        coll.reset()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            coll.update(logits, target)
+        jax.block_until_ready(next(iter(coll.values())).get_state())
+        return (time.perf_counter() - t0) / steps * 1e6
+
+    # "before" arm: the whole-collection eager demotion — one untraceable
+    # member used to revert everything to the per-member eager loop, the same
+    # baseline PR 3's 4.03x fused win was measured against. Reps of the three
+    # arms are interleaved so host noise (thermal / scheduler drift) hits
+    # them evenly instead of biasing whichever arm ran last.
+    demoted = build(fused_update=False, compute_groups=False)
+    grouped = build(fused_update=False)
+    partitioned = build()
+    arms = (demoted, grouped, partitioned)
+    for coll in arms:
+        for _ in range(WARMUP):
+            coll.update(logits, target)
+    reps = {id(coll): [] for coll in arms}
+    for _ in range(5):
+        for coll in arms:
+            reps[id(coll)].append(one_rep(coll))
+    eager_us = min(reps[id(demoted)])
+    grouped_us = min(reps[id(grouped)])
+    part_us = min(reps[id(partitioned)])
+    stats = partitioned.engine_stats()
+    part_view = stats["partition"]
+
+    # numeric parity: same stream through both arms, computes must match bitwise
+    ref, ours = build(fused_update=False, compute_groups=False), build()
+    for i in range(6):
+        chunk_logits = jnp.asarray(rng.normal(size=(BATCH, NUM_CLASSES)), dtype=jnp.float32)
+        chunk_target = jnp.asarray(
+            rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=jnp.int32
+        )
+        ref.update(chunk_logits, chunk_target)
+        ours.update(chunk_logits, chunk_target)
+    ref_vals, our_vals = ref.compute(), ours.compute()
+    bitwise = set(ref_vals) == set(our_vals) and all(
+        np.asarray(ref_vals[k]).tobytes() == np.asarray(our_vals[k]).tobytes()
+        for k in ref_vals
+    )
+
+    speedup = eager_us / part_us if part_us else None
+    record = {
+        # headline: what partition-aware dispatch buys back on a collection
+        # that the old engine would have demoted wholesale
+        "metric": "partitioned_dispatch_speedup",
+        "value": round(speedup, 2) if speedup else None,
+        "unit": "x",
+        "extra": {
+            "config": "config2_plus_straggler_plus_bucketed",
+            "num_classes": NUM_CLASSES,
+            "batch": BATCH,
+            "partitioned_us_per_step": round(part_us, 2),
+            "eager_demotion_us_per_step": round(eager_us, 2),
+            "grouped_eager_us_per_step": round(grouped_us, 2),
+            "partition_speedup": round(speedup, 2) if speedup else None,
+            "vs_grouped_eager": round(grouped_us / part_us, 2) if part_us else None,
+            "bitwise_identical": bool(bitwise),
+            "partition": {
+                "update": {
+                    name: info["path"] for name, info in part_view["update"].items()
+                },
+                "compute": {
+                    name: info["path"] for name, info in part_view["compute"].items()
+                },
+                "builds": part_view["builds"],
+                "repartitions": part_view["repartitions"],
+                "migrations": part_view["migrations"],
+                "stable_hits": part_view["stable_hits"],
+            },
+        },
+    }
+
+    # watchdog self-check: judge this round against the checked-in trajectory
+    rounds = [
+        r for r in _regress.load_rounds(
+            sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json"))))
+        if r.name != "r14"
+    ]
+    rounds.append(_regress.Round("r14", "<this-run>", record))
+    report = _regress.check_trajectory(rounds)
+    record["extra"]["regress"] = {
+        "ok": report.ok,
+        "regression_count": len(report.regressions),
+        "keys_checked": report.keys_checked,
+        "regressions": [r.describe() for r in report.regressions],
+    }
+
+    with open(os.path.join(REPO, "BENCH_r14.json"), "w") as fh:
+        json.dump(record, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(record), flush=True)
+    problems = []
+    if not bitwise:
+        problems.append("partitioned computes are not bitwise-identical to the eager arm")
+    if speedup is not None and speedup < 3.0:
+        problems.append(f"partition speedup {speedup:.2f}x is below the 3x acceptance floor")
+    if not report.ok:
+        problems.extend(r.describe() for r in report.regressions)
+    if problems:
+        print("[bench] partitioned-dispatch round FAILED its gates:", file=sys.stderr)
+        for p in problems:
+            print(f"[bench]   {p}", file=sys.stderr)
+        sys.exit(1)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
@@ -1861,6 +2037,13 @@ def main() -> None:
         help="measure live scrape-server latency, 8-host shard merge + device "
         "correlation wall time, and run the regression watchdog over the "
         "BENCH trajectory; record into BENCH_r13.json",
+    )
+    parser.add_argument(
+        "--partitioned-dispatch",
+        action="store_true",
+        help="measure partition-aware collection dispatch (fused + bucketed + "
+        "eager straggler) vs the old whole-collection eager demotion and "
+        "record into BENCH_r14.json",
     )
     parser.add_argument(
         "--checkpoint",
@@ -1898,6 +2081,9 @@ def main() -> None:
         return
     if args.offhost:
         bench_offhost()
+        return
+    if args.partitioned_dispatch:
+        bench_partitioned_dispatch()
         return
     if args.checkpoint:
         bench_checkpoint()

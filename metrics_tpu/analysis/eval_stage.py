@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.analysis.registry import Entry
 from metrics_tpu.analysis.rules import Finding
+from metrics_tpu.core.engine import classify_compute_member, classify_update_member
 from metrics_tpu.parallel import sync as _sync
 
 AXIS = "data"
@@ -242,6 +243,20 @@ def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Findi
                 message=f"eval_shape over update_state failed: {_err(e)}",
             )
         )
+        path, reason = classify_update_member(inst)
+        if path == "fused":
+            findings.append(
+                Finding(
+                    rule="E109",
+                    obj=entry.name,
+                    message=f"partition drift (update): the runtime dispatcher's static "
+                    f"probes place this metric in the fused update set ({reason}), but "
+                    f"update_state cannot abstract-eval — the first fused collection "
+                    f"dispatch pays a failed trace plus a member migration; construct "
+                    f"with compiled_update=False to pre-assign the eager set",
+                    extra={"kind": "update", "static_path": path},
+                )
+            )
         return findings
 
     t1, t2 = jax.tree_util.tree_structure(out1), jax.tree_util.tree_structure(out2)
@@ -381,6 +396,21 @@ def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Findi
                 f"{_err(e)} — the compiled compute engine will run this metric eagerly",
             )
         )
+        cpath, creason = classify_compute_member(inst)
+        if cpath == "fused":
+            findings.append(
+                Finding(
+                    rule="E109",
+                    obj=entry.name,
+                    message=f"partition drift (compute): the runtime dispatcher's static "
+                    f"probes place this metric in the fused compute set ({creason}), but "
+                    f"sync_compute_state cannot trace under the mock mesh — the first "
+                    f"fused collection finalize pays a failed trace plus a member "
+                    f"migration; construct with compiled_compute=False to pre-assign "
+                    f"the eager set",
+                    extra={"kind": "compute", "static_path": cpath},
+                )
+            )
 
     # ---------------------------------------------------------- sharded leg --
     findings.extend(_evaluate_sharded(entry, inst, state))

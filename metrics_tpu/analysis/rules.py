@@ -160,6 +160,16 @@ _EVAL_RULES = (
         "reduced as if replicated, which double-counts (psum) or misorders "
         "(gather) the disjoint per-device blocks.",
     ),
+    Rule(
+        "E109", "partition-classification-drift", WARNING,
+        "the runtime partition dispatcher's static probes would place this "
+        "metric in a collection's fused set, but the abstract-eval sweep "
+        "shows its update_state/compute_state cannot actually trace under "
+        "the mock 8-device mesh — the first compiled collection dispatch "
+        "will pay one failed trace plus a member migration. Opt the metric "
+        "out up front (compiled_update=False / compiled_compute=False) to "
+        "skip the probe cost.",
+    ),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in (*_AST_RULES, *_EVAL_RULES)}

@@ -3,10 +3,15 @@ from metrics_tpu.core.collections import MetricCollection  # noqa: F401
 from metrics_tpu.core.buffers import CatBuffer  # noqa: F401
 from metrics_tpu.core.engine import (  # noqa: F401
     CollectionComputeEngine,
+    CollectionDispatcher,
+    CollectionPartition,
     CollectionUpdateEngine,
     CompiledComputeEngine,
     CompiledUpdateEngine,
     EngineStats,
+    PartitionStats,
+    classify_compute_member,
+    classify_update_member,
     compiled_compute_enabled,
     compiled_update_enabled,
     fused_update_enabled,

@@ -97,8 +97,13 @@ class MetricCollection:
         self._compiled_update = compiled_update
         self._compiled_compute = compiled_compute
         self._fused_update = fused_update
-        self._update_engine: Any = None  # lazily-built CollectionUpdateEngine
-        self._compute_engine: Any = None  # lazily-built CollectionComputeEngine
+        # the partition-aware dispatcher (lazily-built CollectionDispatcher)
+        # routes update()/compute() to {fused, bucketed, eager} member sets;
+        # _update_engine/_compute_engine mirror the fused-subset engines it
+        # builds (None while no fused set exists or dispatch never ran)
+        self._dispatcher: Any = None
+        self._update_engine: Any = None
+        self._compute_engine: Any = None
         # True while fused dispatches advance only the group leaders; members
         # are detached (state attrs None) and realiased lazily at finalize
         self._members_stale = False
@@ -199,8 +204,10 @@ class MetricCollection:
         # members must be whole before membership changes: a member that moves
         # to another group would otherwise keep its detached (poisoned) state
         self._realias_members()
-        # group membership is baked into the fused executables' closures, so
-        # any cached compiled update/compute is stale the moment groups change
+        # group membership is baked into the partition and the fused
+        # executables' closures, so any cached dispatcher or compiled
+        # update/compute is stale the moment groups change
+        self._dispatcher = None
         self._update_engine = None
         self._compute_engine = None
         self._groups = []
@@ -280,38 +287,41 @@ class MetricCollection:
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         return self.forward(*args, **kwargs)
 
-    def _maybe_engine(self) -> Optional[Any]:
-        """The fused compiled-update engine, or None when disabled (the
-        dedicated ``fused_update`` surface first, then the ``compiled_update``
-        umbrella; per-collection flags beat the globals in both directions)."""
+    def _fused_update_enabled(self) -> bool:
+        """Whether ``update()`` may route through the partition dispatcher's
+        fused engine (the dedicated ``fused_update`` surface first, then the
+        ``compiled_update`` umbrella; per-collection flags beat the globals in
+        both directions)."""
         from metrics_tpu.core import engine as _engine
 
         fused = self._fused_update
         if fused is None:
             fused = _engine.fused_update_enabled()
         if not fused:
-            return None
+            return False
         enabled = self._compiled_update
         if enabled is None:
             enabled = _engine.compiled_update_enabled()
-        if not enabled:
-            return None
-        if self._update_engine is None:
-            self._update_engine = _engine.CollectionUpdateEngine(self)
-        return self._update_engine
+        return bool(enabled)
 
-    def _maybe_compute_engine(self) -> Optional[Any]:
-        """The fused compiled-compute engine, or None when disabled."""
+    def _fused_compute_enabled(self) -> bool:
+        """Whether ``compute()`` may route through the dispatcher's fused
+        compute engine."""
         from metrics_tpu.core import engine as _engine
 
         enabled = self._compiled_compute
         if enabled is None:
             enabled = _engine.compiled_compute_enabled()
-        if not enabled:
-            return None
-        if self._compute_engine is None:
-            self._compute_engine = _engine.CollectionComputeEngine(self)
-        return self._compute_engine
+        return bool(enabled)
+
+    def _get_dispatcher(self) -> Any:
+        """The partition-aware dispatcher, built lazily on first fused-path
+        dispatch (see :class:`metrics_tpu.core.engine.CollectionDispatcher`)."""
+        from metrics_tpu.core import engine as _engine
+
+        if self._dispatcher is None:
+            self._dispatcher = _engine.CollectionDispatcher(self)
+        return self._dispatcher
 
     def engine_stats(self) -> Dict[str, Any]:
         """Dispatch counters and fallback reasons across the collection.
@@ -338,18 +348,37 @@ class MetricCollection:
             members[name] = member_stats
             _instruments.merge_member_reasons(reasons, name, member_stats["fallback_reasons"])
         stats["members"] = members
+        # engines retired by a partition migration keep their recorded cause
+        # visible even after a subset successor replaced them
+        if self._dispatcher is not None:
+            for key, why in self._dispatcher._retired_reasons.items():
+                reasons.setdefault(key, why)
+        stats["partition"] = _instruments.collection_partition_view(self)
         return stats
 
     def update(self, *args: Any, **kwargs: Any) -> None:
-        """Fused update: one update per compute group; members share the
+        """Partitioned update: one update per compute group; members share the
         leader's (immutable) state by reference. Reference: :160-179.
 
-        With the compiled-update engine enabled, the whole loop below runs as
-        one cached jitted executable from the second call per input signature."""
-        engine = self._maybe_engine()
-        if engine is not None and engine.eligible(args, kwargs) and engine.dispatch(args, kwargs):
+        With the fused path enabled, dispatch routes through the
+        partition-aware dispatcher: the fused member set runs as one cached
+        jitted executable from the second call per input signature,
+        ``batch_buckets`` members run through their pow2-bucketed per-metric
+        engines, and only eager stragglers pay the per-group loop below."""
+        if self._fused_update_enabled():
+            self._get_dispatcher().update(args, kwargs)
             return
-        for group in self._groups:
+        self._eager_update_groups(self._groups, args, kwargs)
+        # the loop above rebroadcast every multi-member group
+        self._members_stale = False
+
+    def _eager_update_groups(self, groups: Sequence[Sequence[str]], args: Tuple, kwargs: Dict) -> None:
+        """The per-group eager update loop over ``groups`` only: each leader
+        updates through its own facade (its per-metric engine — including the
+        pow2-bucketed path — still applies) and multi-member groups rebroadcast
+        the leader's state. Does not touch ``_members_stale``: the caller knows
+        whether every group went through here."""
+        for group in groups:
             leader = self._metrics.__getitem__(group[0])
             leader.update(*args, **leader._filter_kwargs(**kwargs))
             if len(group) > 1:
@@ -363,32 +392,28 @@ class MetricCollection:
                     m._update_count = leader._update_count
                     m._computed = None
                     m._shared_state_ids = shared
-        # the loop above rebroadcast every multi-member group
-        self._members_stale = False
 
     def compute(self) -> Dict[str, Any]:
         """One sync per group, value per member. Reference: :241-253.
 
-        With the compiled-compute engine enabled (and no real distributed sync
-        or other escape hatch in play), the whole per-member loop below runs as
-        one cached jitted executable from the second call per state signature;
-        each member's ``_computed`` cache is populated from the fused result."""
+        With the fused path enabled (and no real distributed sync or other
+        escape hatch in play), the partition's fused member set runs as one
+        cached jitted executable from the second call per state signature —
+        each member's ``_computed`` cache populated from the fused result —
+        while eager-classified groups run the per-group loop."""
         # fused updates advance only the leaders; members must be whole before
         # the compute engine probes them (and before the eager loop below)
         self._realias_members()
-        engine = self._maybe_compute_engine()
-        if engine is not None and engine.eligible():
-            handled, values = engine.dispatch()
-            if handled:
-                res = {}
-                for group in self._groups:
-                    for name in group:
-                        m = self._metrics.__getitem__(name)
-                        m._computed = _squeeze_if_scalar(values[name])
-                        res[self._set_name(name)] = m._computed
-                return _flatten_results(res)
+        if self._fused_compute_enabled():
+            return _flatten_results(self._get_dispatcher().compute())
+        return _flatten_results(self._eager_compute_groups(self._groups))
+
+    def _eager_compute_groups(self, groups: Sequence[Sequence[str]]) -> Dict[str, Any]:
+        """The per-group eager compute loop over ``groups`` only: one sync per
+        group leader, value per member (each member's own compute engine still
+        applies). Returns the raw (unflattened) results dict."""
         res: Dict[str, Any] = {}
-        for group in self._groups:
+        for group in groups:
             leader = self._metrics.__getitem__(group[0])
             leader.sync(should_sync=leader._to_sync)
             synced_state = leader.get_state()
@@ -411,7 +436,7 @@ class MetricCollection:
                 local = leader.get_state()
                 for name in group[1:]:
                     self._metrics.__getitem__(name).set_state(local)
-        return _flatten_results(res)
+        return res
 
     def reset(self) -> None:
         for m in self.values():
@@ -448,6 +473,7 @@ class MetricCollection:
                     member = self._metrics.__getitem__(name)
                     member.set_state(state)
                     member._shared_state_ids = shared
+        self._dispatcher = None  # placement is part of the partition key
         self._update_engine = None
         self._compute_engine = None
         self._invalidate_dispatch()
@@ -459,6 +485,7 @@ class MetricCollection:
         for _, m in self.items(keep_base=True):
             if m._state_sharding is not None:
                 m.unshard_state()
+        self._dispatcher = None
         self._update_engine = None
         self._compute_engine = None
         self._invalidate_dispatch()
@@ -557,14 +584,18 @@ class MetricCollection:
         return self.compute_state(states)
 
     def __getstate__(self) -> Dict[str, Any]:
-        """Drop the fused engines (jitted executables close over ``self``);
-        clones/unpickled copies rebuild them lazily."""
+        """Drop the dispatcher and fused engines (jitted executables close
+        over ``self``); clones/unpickled copies rebuild them lazily."""
         # never capture detached (None) member states in a clone/pickle
         self._realias_members()
-        return {k: v for k, v in self.__dict__.items() if k not in ("_update_engine", "_compute_engine")}
+        return {
+            k: v for k, v in self.__dict__.items()
+            if k not in ("_dispatcher", "_update_engine", "_compute_engine")
+        }
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)
+        self._dispatcher = None
         self._update_engine = None
         self._compute_engine = None
 

@@ -39,6 +39,19 @@ Design points:
   metric whose update treats rows independently. Either way at most
   ``log2(max_batch)`` signatures ever compile.
 
+- **Partition-aware collection dispatch.** ``MetricCollection.update()`` /
+  ``compute()`` route through one :class:`CollectionDispatcher` that classifies
+  the compute groups into {fused, bucketed, eager} member sets using the same
+  static eligibility probes the per-metric engines use
+  (:func:`classify_update_member` / :func:`classify_compute_member`). The
+  compilable majority runs as one donated fused program, ``batch_buckets``
+  members keep their pow2-bucketed per-metric engines, and only true
+  stragglers pay the eager loop. The partition is cached and keyed on the
+  members' cheap eligibility flags (the signature-memo idiom), so steady-state
+  dispatch is a tuple compare; a member whose trace fails *at runtime* is
+  migrated to the eager set alone — the fused program is rebuilt over the
+  remainder instead of the whole collection demoting to eager.
+
 Global switches: ``set_compiled_update(False)`` (or the environment variable
 ``METRICS_TPU_COMPILED_UPDATE=0``) disables the update engine process-wide and
 ``set_compiled_compute(False)`` / ``METRICS_TPU_COMPILED_COMPUTE=0`` the
@@ -616,64 +629,186 @@ class CompiledUpdateEngine(_EngineBase):
         return True
 
 
+# --------------------------------------------------------------------------- #
+# partition classification — the static eligibility probes, per member
+# --------------------------------------------------------------------------- #
+# Path vocabulary shared by the dispatcher, engine_stats() partition views,
+# the Prometheus gauges, and analyzer rule E109.
+PATH_FUSED = "fused"
+PATH_BUCKETED = "bucketed"
+PATH_EAGER = "eager"
+
+
+def classify_update_member(metric: Any) -> Tuple[str, str]:
+    """Which update path a member belongs on, and why.
+
+    Returns ``(path, reason)`` with ``path`` one of ``"fused"`` (compilable
+    into the collection's donated fused program), ``"bucketed"``
+    (``batch_buckets=True`` — the pow2-bucketed per-metric engine owns ragged
+    shapes), or ``"eager"`` (a true straggler). These are exactly the static
+    checks the pre-partition collection engine applied to the *whole*
+    collection; the dispatcher applies them per compute-group leader, and
+    analyzer rule E109 diffs them against the abstract-eval findings."""
+    if getattr(metric, "_compiled_update", None) is False:
+        return PATH_EAGER, "compiled_update=False"
+    if metric._child_metrics():
+        return PATH_EAGER, "has child metrics"
+    if not metric.supports_compiled_update:
+        return PATH_EAGER, "state unsupported by compiled update (unbounded list state)"
+    if getattr(metric, "_batch_buckets", False):
+        return PATH_BUCKETED, "batch_buckets=True (pow2-bucketed per-metric engine)"
+    return PATH_FUSED, "compilable"
+
+
+def classify_compute_member(metric: Any) -> Tuple[str, str]:
+    """Which compute path a member belongs on (``"fused"`` or ``"eager"``) and
+    why — the static half of the old whole-collection eligibility probe; the
+    dynamic escapes (pending sync, synced state, never updated) stay per-call
+    in :meth:`CollectionComputeEngine.eligible`."""
+    if getattr(metric, "_compiled_compute", None) is False:
+        return PATH_EAGER, "compiled_compute=False"
+    if metric._child_metrics():
+        return PATH_EAGER, "has child metrics"
+    if not metric.supports_compiled_compute:
+        return PATH_EAGER, "compute_state unsupported by compiled compute"
+    if metric.compute_on_cpu:
+        return PATH_EAGER, "compute_on_cpu=True"
+    if metric.dist_sync_fn is not None:
+        return PATH_EAGER, "custom dist_sync_fn"
+    return PATH_FUSED, "compilable"
+
+
+def _classify_update_groups(coll: Any, migrated: Dict[str, str]):
+    """Partition the collection's compute groups for ``update()``.
+
+    The dispatch unit is the compute group: only the leader updates (members
+    alias its state), so the leader's classification decides the whole group —
+    matching the leader-only checks the pre-partition engine applied. Returns
+    ``(fused, bucketed, eager)`` leader-name tuples plus a per-member
+    ``{name: {"path", "reason"}}`` map."""
+    fused, bucketed, eager = [], [], []
+    members: Dict[str, Dict[str, str]] = {}
+    for group in coll._groups:
+        lname = group[0]
+        if lname in migrated:
+            path, reason = PATH_EAGER, f"migrated at runtime: {migrated[lname]}"
+        else:
+            path, reason = classify_update_member(coll._metrics[lname])
+        {PATH_FUSED: fused, PATH_BUCKETED: bucketed, PATH_EAGER: eager}[path].append(lname)
+        for name in group:
+            r = reason if name == lname else f"follows group leader {lname!r}: {reason}"
+            members[name] = {"path": path, "reason": r}
+    return tuple(fused), tuple(bucketed), tuple(eager), members
+
+
+def _classify_compute_groups(coll: Any, migrated: Dict[str, str]):
+    """Partition the compute groups for ``compute()``: a group fuses only when
+    *every* member's finalize is compilable (one straggling member's
+    ``compute_state`` would poison the group's shared program). Returns
+    ``(fused, eager)`` leader-name tuples plus the per-member map."""
+    fused, eager = [], []
+    members: Dict[str, Dict[str, str]] = {}
+    for group in coll._groups:
+        lname = group[0]
+        if lname in migrated:
+            for name in group:
+                members[name] = {
+                    "path": PATH_EAGER,
+                    "reason": f"migrated at runtime: {migrated[lname]}",
+                }
+            eager.append(lname)
+            continue
+        infos = {name: classify_compute_member(coll._metrics[name]) for name in group}
+        stragglers = [n for n, (p, _) in infos.items() if p != PATH_FUSED]
+        if stragglers:
+            eager.append(lname)
+            for name in group:
+                path, reason = infos[name]
+                if path == PATH_FUSED:
+                    reason = f"group demoted by {stragglers[0]!r}: {infos[stragglers[0]][1]}"
+                members[name] = {"path": PATH_EAGER, "reason": reason}
+        else:
+            fused.append(lname)
+            for name in group:
+                members[name] = {"path": PATH_FUSED, "reason": infos[name][1]}
+    return tuple(fused), tuple(eager), members
+
+
 class CollectionUpdateEngine(_EngineBase):
-    """Fused jitted update over a MetricCollection's compute groups.
+    """Fused jitted update over a subset of a MetricCollection's compute groups.
 
-    Jits the collection's pure ``update_state`` (one ``{leader: state}`` dict
-    in, one out), so a whole collection step — every group's canonicalization
-    and counting — runs as a single XLA program. Invalidated whenever group
-    membership changes (``MetricCollection._rebuild_groups``)."""
+    Jits the subset's pure ``update_state`` (one ``{leader: state}`` dict in,
+    one out), so the fused partition's whole step — every fused group's
+    canonicalization and counting — runs as a single XLA program.
+    ``group_names=None`` fuses every group (direct construction); the
+    :class:`CollectionDispatcher` passes only its fused set. The static
+    eligibility probes live in :func:`classify_update_member` and run at
+    partition build, so :meth:`eligible` keeps just the per-call dynamic
+    checks. Invalidated whenever membership or the partition changes."""
 
-    def __init__(self, collection: Any) -> None:
+    _opt_out = "fused_update=False"
+
+    def __init__(self, collection: Any, group_names: Optional[Tuple[str, ...]] = None) -> None:
+        if group_names is None:
+            group_names = tuple(g[0] for g in collection._groups)
+        self._group_names = tuple(group_names)
+        subset = frozenset(self._group_names)
         super().__init__(donate=all(
-            getattr(collection._metrics[g[0]], "_donate_state", True) for g in collection._groups
+            getattr(collection._metrics[g[0]], "_donate_state", True)
+            for g in collection._groups if g[0] in subset
         ))
         self.collection = collection
+        # membership and partition are fixed for this engine's lifetime
+        # (rebuilds and re-partitions drop the engine), so the subset's group
+        # lists are snapshotted once
+        self._subset_groups = tuple(
+            tuple(g) for g in collection._groups if g[0] in subset
+        )
 
         # per-leader sharding constraints (see CompiledUpdateEngine): mixed
         # collections pin only their sharded leaders' leaves, the rest pass
         # through untouched
         def _update_constrained(states, *args, **kwargs):
-            return collection._constrain_states(collection.update_state(states, *args, **kwargs))
+            out = {}
+            for group in collection._groups:
+                if group[0] not in subset:
+                    continue
+                leader = collection._metrics[group[0]]
+                out[group[0]] = leader._constrain_state(
+                    leader.update_state(states[group[0]], *args, **leader._filter_kwargs(**kwargs))
+                )
+            return out
 
         self._jit_plain = jax.jit(_update_constrained)
         self._jit_donate = jax.jit(_update_constrained, donate_argnums=(0,))
-        # group membership is fixed for this engine's lifetime (rebuilds drop
-        # the engine), so the leaders' default-leaf ids are computed once
         self._default_ids = frozenset(
             _protected_leaf_ids(*self._leaders(), include_shared=False)
         )
 
     def _leaders(self):
         coll = self.collection
-        return [coll._metrics[g[0]] for g in coll._groups]
+        return [coll._metrics[g[0]] for g in self._subset_groups]
 
     def eligible(self, args: Tuple, kwargs: Dict) -> bool:
+        """Per-call dynamic checks only; the static member probes were applied
+        at partition build (mid-run flag flips re-key the partition)."""
         if self._broken is not None or _tracing_active():
             return False
-        if not _leaves_compilable((args, kwargs)):
-            return False
-        for leader in self._leaders():
-            if not leader.supports_compiled_update or leader._child_metrics():
-                return False
-            if getattr(leader, "_compiled_update", None) is False:
-                return False
-            if getattr(leader, "_batch_buckets", False):
-                return False  # bucketing runs per-metric in the eager loop
-        return True
+        return _leaves_compilable((args, kwargs))
 
     def dispatch(self, args: Tuple, kwargs: Dict) -> bool:
         coll = self.collection
-        states = {g[0]: coll._metrics[g[0]].get_state() for g in coll._groups}
-        # Detach group members ONCE: members hold references to the leader's
-        # (shared) state leaves, which would defeat the donation refcount
-        # guard. While detached (``_members_stale``), only leaders advance —
-        # members are realiased lazily at finalize
+        states = {g[0]: coll._metrics[g[0]].get_state() for g in self._subset_groups}
+        # Detach the fused groups' members ONCE: members hold references to the
+        # leader's (shared) state leaves, which would defeat the donation
+        # refcount guard. While detached (``_members_stale``), only leaders
+        # advance — members are realiased lazily at finalize
         # (:meth:`MetricCollection._realias_members`) instead of being
         # rebroadcast on every step. A warmup/fallback return runs the
         # collection's eager loop, which rebroadcasts and clears the flag.
+        # Non-fused groups never detach: their eager loop rebroadcasts per step.
         if not coll._members_stale:
-            for group in coll._groups:
+            for group in self._subset_groups:
                 for name in group[1:]:
                     coll._metrics[name]._detach_states()
             coll._members_stale = True
@@ -681,7 +816,7 @@ class CollectionUpdateEngine(_EngineBase):
                 _otrace.emit_instant(
                     "streak/detach", "streak",
                     owner=self._owner_name(),
-                    members=sum(len(g) - 1 for g in coll._groups),
+                    members=sum(len(g) - 1 for g in self._subset_groups),
                 )
         handled, new_states = self._dispatch(
             self._jit_plain, self._jit_donate, states, args, kwargs,
@@ -689,7 +824,7 @@ class CollectionUpdateEngine(_EngineBase):
         )
         if not handled:
             return False
-        for group in coll._groups:
+        for group in self._subset_groups:
             leader = coll._metrics[group[0]]
             leader.set_state(new_states[group[0]])
             leader._update_count += 1
@@ -753,48 +888,58 @@ class CompiledComputeEngine(_EngineBase):
 
 
 class CollectionComputeEngine(_EngineBase):
-    """Fused jitted compute over a MetricCollection's compute groups.
+    """Fused jitted compute over a subset of a MetricCollection's compute groups.
 
     Jits one function mapping ``{leader: state}`` to per-member raw values
-    (base names, unflattened), so a whole collection finalize — every group's
-    reduction math — runs as a single XLA program and each member's
-    ``_computed`` cache can still be populated from the result. Invalidated
-    whenever group membership changes (``MetricCollection._rebuild_groups``).
-    """
+    (base names, unflattened), so the fused partition's finalize — every fused
+    group's reduction math — runs as a single XLA program and each member's
+    ``_computed`` cache can still be populated from the result.
+    ``group_names=None`` fuses every group (direct construction); the
+    :class:`CollectionDispatcher` passes only its compute-fused set. Static
+    member probes live in :func:`classify_compute_member`; :meth:`eligible`
+    keeps the per-call dynamic escapes. Invalidated whenever membership or the
+    partition changes."""
 
     _kind = "compute"
     _target = "compute_state"
     _opt_out = "compiled_compute=False"
     _result_is_state = False
 
-    def __init__(self, collection: Any) -> None:
+    def __init__(self, collection: Any, group_names: Optional[Tuple[str, ...]] = None) -> None:
         super().__init__(donate=False)
         self.collection = collection
+        if group_names is None:
+            group_names = tuple(g[0] for g in collection._groups)
+        self._group_names = tuple(group_names)
+        subset = frozenset(self._group_names)
+        self._subset_groups = tuple(
+            tuple(g) for g in collection._groups if g[0] in subset
+        )
         self._jit = jax.jit(self._member_values)
 
     def _member_values(self, states: Dict[str, Any]) -> Dict[str, Any]:
         coll = self.collection
         return {
             name: coll._metrics[name].compute_state(states[group[0]])
-            for group in coll._groups
+            for group in self._subset_groups
             for name in group
         }
 
     def eligible(self) -> bool:
-        coll = self.collection
+        """Per-call dynamic escapes over the fused subset; a False here means
+        the dispatcher runs the whole collection through the eager loop for
+        this call (sync ordering, unsync bookkeeping, and the never-updated
+        warning all live there) without re-partitioning."""
         if self._broken is not None or _tracing_active():
             return False
-        for group in coll._groups:
+        coll = self.collection
+        for group in self._subset_groups:
             leader = coll._metrics[group[0]]
             if leader._to_sync and _sync.distributed_available():
                 return False  # real sync due: the eager per-group loop owns it
             for name in group:
                 m = coll._metrics[name]
-                if getattr(m, "_compiled_compute", None) is False:
-                    return False
-                if m._child_metrics() or not m.supports_compiled_compute:
-                    return False
-                if m.compute_on_cpu or m.dist_sync_fn is not None or m._is_synced:
+                if m._is_synced:
                     return False
                 if m._update_count == 0:
                     return False  # keep the eager loop's never-updated warning
@@ -803,7 +948,373 @@ class CollectionComputeEngine(_EngineBase):
     def dispatch(self) -> Tuple[bool, Any]:
         """Returns ``(handled, {member_base_name: raw_value})``."""
         coll = self.collection
-        states = {g[0]: coll._metrics[g[0]].get_state() for g in coll._groups}
+        states = {g[0]: coll._metrics[g[0]].get_state() for g in self._subset_groups}
         if not _leaves_compilable(states):
             return False, None
         return self._dispatch(self._jit, self._jit, states, (), {}, frozenset())
+
+
+# --------------------------------------------------------------------------- #
+# the partition-aware dispatcher
+# --------------------------------------------------------------------------- #
+@dataclass
+class PartitionStats:
+    """Partition lifecycle counters for one dispatcher (all monotonic)."""
+
+    builds: int = 0  # partitions constructed (first build + every rebuild)
+    repartitions: int = 0  # rebuilds caused by a changed partition key
+    migrations: int = 0  # members moved to the eager set by a runtime fallback
+    stable_hits: int = 0  # dispatches served by the cached partition
+
+
+@dataclass(frozen=True)
+class CollectionPartition:
+    """One cached classification of a collection's compute groups.
+
+    ``update_*`` / ``compute_*`` hold group-leader names per path;
+    ``update_members`` / ``compute_members`` map every member name to its
+    ``{"path", "reason"}`` view (the shape ``engine_stats()["partition"]``
+    exposes). Immutable: membership/flag/placement changes and runtime
+    migrations build a replacement via :meth:`CollectionDispatcher._build_partition`.
+    """
+
+    key: Tuple
+    update_fused: Tuple[str, ...]
+    update_bucketed: Tuple[str, ...]
+    update_eager: Tuple[str, ...]
+    compute_fused: Tuple[str, ...]
+    compute_eager: Tuple[str, ...]
+    update_members: Dict[str, Dict[str, str]]
+    compute_members: Dict[str, Dict[str, str]]
+    # the non-fused groups, precomputed so the steady-state dispatch fast
+    # path is a lookup instead of a per-call scan of coll._groups (membership
+    # changes drop the dispatcher, so group identity is stable here)
+    update_rest: Tuple[Tuple[str, ...], ...] = ()
+    compute_rest: Tuple[Tuple[str, ...], ...] = ()
+
+
+class CollectionDispatcher:
+    """Partition-aware dispatch for ``MetricCollection.update()/compute()``.
+
+    At first dispatch (and whenever the cheap per-member eligibility flags
+    change — the partition key, compared every call like the signature memo)
+    the compute groups are classified into {fused, bucketed, eager} sets via
+    :func:`classify_update_member` / :func:`classify_compute_member`. Each set
+    then runs on its best path:
+
+    * **fused** — one donated jitted program over the fused leaders
+      (:class:`CollectionUpdateEngine` / :class:`CollectionComputeEngine`
+      built over the subset), with the fused-streak detach/realias and
+      donation guards scoped to the fused groups only;
+    * **bucketed** — the eager per-group loop, where each leader's own
+      pow2-bucketed :class:`CompiledUpdateEngine` owns its ragged shapes;
+    * **eager** — the plain per-group loop.
+
+    A member whose fused trace fails at runtime is migrated to the eager set
+    alone (``partition/migrate``): the fused program is rebuilt over the
+    remainder instead of the whole collection demoting to eager.
+    """
+
+    def __init__(self, collection: Any) -> None:
+        self.collection = collection
+        self.stats = PartitionStats()
+        self._partition: Optional[CollectionPartition] = None
+        self._update_engine: Optional[CollectionUpdateEngine] = None
+        self._compute_engine: Optional[CollectionComputeEngine] = None
+        # group leader name -> first-line reason, accumulated by migrations;
+        # folded into the partition key so a migration survives re-keying
+        self._migrated_update: Dict[str, str] = {}
+        self._migrated_compute: Dict[str, str] = {}
+        # fallback reasons of engines retired by a migration, keyed
+        # "<kind>:<Owner>" — keeps the cause visible in engine_stats() after
+        # the broken engine is replaced by its subset successor
+        self._retired_reasons: Dict[str, str] = {}
+        # partition counters show up in observability snapshots as
+        # metrics_tpu_partition_*{owner=...}
+        _instruments.register_dispatcher(self)
+
+    def __deepcopy__(self, memo: Dict) -> None:
+        # clones/pickles rebuild their dispatcher (and its engines) lazily
+        return None
+
+    # ------------------------------------------------------------------ #
+    # partition lifecycle
+    # ------------------------------------------------------------------ #
+    def _partition_key(self) -> Tuple:
+        """Cheap per-member eligibility flags, snapshotted every dispatch.
+
+        Only attribute reads — the construction-time facts the full probes
+        walk (child metrics, registered list states) cannot change without a
+        membership rebuild, which drops the dispatcher outright. Migrated
+        members are part of the key so their eager placement is sticky."""
+        coll = self.collection
+        parts = []
+        for group in coll._groups:
+            leader = coll._metrics[group[0]]
+            parts.append((
+                tuple(group),
+                getattr(leader, "_compiled_update", None) is False,
+                bool(getattr(leader, "_batch_buckets", False)),
+                leader._state_sharding is not None,
+                group[0] in self._migrated_update,
+                group[0] in self._migrated_compute,
+                tuple(
+                    (
+                        getattr(coll._metrics[name], "_compiled_compute", None) is False,
+                        bool(coll._metrics[name].compute_on_cpu),
+                        coll._metrics[name].dist_sync_fn is not None,
+                    )
+                    for name in group
+                ),
+            ))
+        return tuple(parts)
+
+    def _ensure_partition(self) -> CollectionPartition:
+        key = self._partition_key()
+        part = self._partition
+        if part is not None and key == part.key:
+            self.stats.stable_hits += 1
+            return part
+        return self._build_partition(key)
+
+    def _build_partition(self, key: Optional[Tuple] = None) -> CollectionPartition:
+        coll = self.collection
+        if key is None:
+            key = self._partition_key()
+        rebuild = self._partition is not None
+        # members must be whole before the fused subset changes: a member
+        # leaving the fused set mid-streak would otherwise keep its detached
+        # (poisoned) state
+        coll._realias_members()
+        u_fused, u_bucketed, u_eager, u_members = _classify_update_groups(
+            coll, self._migrated_update
+        )
+        c_fused, c_eager, c_members = _classify_compute_groups(
+            coll, self._migrated_compute
+        )
+        u_set, c_set = frozenset(u_fused), frozenset(c_fused)
+        part = CollectionPartition(
+            key=key,
+            update_fused=u_fused, update_bucketed=u_bucketed, update_eager=u_eager,
+            compute_fused=c_fused, compute_eager=c_eager,
+            update_members=u_members, compute_members=c_members,
+            update_rest=tuple(g for g in coll._groups if g[0] not in u_set),
+            compute_rest=tuple(g for g in coll._groups if g[0] not in c_set),
+        )
+        self._partition = part
+        # the fused subsets are baked into the engines' jit closures
+        self._update_engine = None
+        self._compute_engine = None
+        coll._update_engine = None
+        coll._compute_engine = None
+        self.stats.builds += 1
+        if rebuild:
+            self.stats.repartitions += 1
+        if _otrace.active:
+            _otrace.emit_instant(
+                "partition/rebuild" if rebuild else "partition/build", "partition",
+                owner=type(coll).__name__,
+                fused=len(u_fused), bucketed=len(u_bucketed), eager=len(u_eager),
+                compute_fused=len(c_fused), compute_eager=len(c_eager),
+            )
+        return part
+
+    def _ensure_update_engine(self, part: CollectionPartition) -> Optional[CollectionUpdateEngine]:
+        if self._update_engine is None and part.update_fused:
+            engine = CollectionUpdateEngine(self.collection, part.update_fused)
+            self._update_engine = engine
+            self.collection._update_engine = engine
+        return self._update_engine
+
+    def _ensure_compute_engine(self, part: CollectionPartition) -> Optional[CollectionComputeEngine]:
+        if self._compute_engine is None and part.compute_fused:
+            engine = CollectionComputeEngine(self.collection, part.compute_fused)
+            self._compute_engine = engine
+            self.collection._compute_engine = engine
+        return self._compute_engine
+
+    # ------------------------------------------------------------------ #
+    # runtime migration — one member trips, the rest keep the fused path
+    # ------------------------------------------------------------------ #
+    def _migrate(self, kind: str, culprits: Dict[str, str], engine: Any) -> CollectionPartition:
+        migrated = self._migrated_update if kind == "update" else self._migrated_compute
+        migrated.update(culprits)
+        self.stats.migrations += len(culprits)
+        for owner, why in engine.stats.fallback_reasons.items():
+            self._retired_reasons.setdefault(f"{kind}:{owner}", why)
+        if _otrace.active:
+            _otrace.emit_instant(
+                "partition/migrate", "partition",
+                owner=type(self.collection).__name__, kind=kind,
+                members=sorted(culprits),
+                reason=next(iter(culprits.values()))[:200],
+            )
+        return self._build_partition()
+
+    def _migrate_update(self, engine: CollectionUpdateEngine,
+                        args: Tuple, kwargs: Dict) -> CollectionPartition:
+        """The fused update engine just broke: find which fused leader(s)
+        cannot trace (abstract-eval probe of each ``update_state``) and move
+        only their groups to the eager set; with no attributable culprit the
+        whole fused set demotes (correctness over optimism)."""
+        coll = self.collection
+        part = self._partition
+        culprits: Dict[str, str] = {}
+        for lname in part.update_fused:
+            leader = coll._metrics[lname]
+            try:
+                fkwargs = leader._filter_kwargs(**kwargs)
+                jax.eval_shape(
+                    lambda s, a, k, _m=leader: _m.update_state(s, *a, **k),
+                    leader.get_state(), args, fkwargs,
+                )
+            except Exception as err:
+                culprits[lname] = f"{type(err).__name__}: {err}".splitlines()[0][:200]
+        if not culprits:
+            broken = (engine.broken or "trace failure").splitlines()[0][:200]
+            culprits = {lname: broken for lname in part.update_fused}
+        return self._migrate("update", culprits, engine)
+
+    def _migrate_compute(self, engine: CollectionComputeEngine) -> CollectionPartition:
+        """Symmetric probe for the fused compute engine: a group migrates when
+        any of its members' ``compute_state`` cannot abstract-eval."""
+        coll = self.collection
+        part = self._partition
+        culprits: Dict[str, str] = {}
+        for lname in part.compute_fused:
+            group = next(g for g in coll._groups if g[0] == lname)
+            leader = coll._metrics[lname]
+            state = leader.get_state()
+            for name in group:
+                try:
+                    jax.eval_shape(
+                        lambda s, _m=coll._metrics[name]: _m.compute_state(s), state
+                    )
+                except Exception as err:
+                    culprits[lname] = (
+                        f"{name}: {type(err).__name__}: {err}".splitlines()[0][:200]
+                    )
+                    break
+        if not culprits:
+            broken = (engine.broken or "trace failure").splitlines()[0][:200]
+            culprits = {lname: broken for lname in part.compute_fused}
+        return self._migrate("compute", culprits, engine)
+
+    # ------------------------------------------------------------------ #
+    # dispatch
+    # ------------------------------------------------------------------ #
+    def update(self, args: Tuple, kwargs: Dict) -> None:
+        coll = self.collection
+        part = self._ensure_partition()
+        handled_fused = False
+        if part.update_fused:
+            engine = self._ensure_update_engine(part)
+            if engine.eligible(args, kwargs):
+                handled_fused = engine.dispatch(args, kwargs)
+                if not handled_fused and engine.broken is not None:
+                    part = self._migrate_update(engine, args, kwargs)
+        if handled_fused:
+            rest = part.update_rest
+        else:
+            # warmup, transient ineligibility, or a fresh migration: the eager
+            # loop runs every group this call (rebroadcasting detached members)
+            rest = coll._groups
+        if rest:
+            coll._eager_update_groups(rest, args, kwargs)
+        if not handled_fused:
+            coll._members_stale = False
+
+    def compute(self) -> Dict[str, Any]:
+        """Raw (unflattened) ``{output_name: value}`` in declaration order;
+        the caller flattens. Members are already whole (the collection
+        realiases before dispatching here)."""
+        coll = self.collection
+        part = self._ensure_partition()
+        values = None
+        if part.compute_fused:
+            engine = self._ensure_compute_engine(part)
+            if engine.eligible():
+                handled, vals = engine.dispatch()
+                if handled:
+                    values = vals
+                elif engine.broken is not None:
+                    part = self._migrate_compute(engine)
+        from metrics_tpu.utils.data import _squeeze_if_scalar
+
+        if values is not None:
+            fused = frozenset(part.compute_fused)
+            eager_groups = part.compute_rest
+        else:
+            fused = frozenset()
+            eager_groups = coll._groups
+        eager_res = coll._eager_compute_groups(eager_groups) if eager_groups else {}
+        res: Dict[str, Any] = {}
+        for group in coll._groups:
+            if group[0] in fused:
+                for name in group:
+                    m = coll._metrics[name]
+                    m._computed = _squeeze_if_scalar(values[name])
+                    res[coll._set_name(name)] = m._computed
+            else:
+                for name in group:
+                    key = coll._set_name(name)
+                    if key in eager_res:
+                        res[key] = eager_res[key]
+        return res
+
+    # ------------------------------------------------------------------ #
+    # observability views
+    # ------------------------------------------------------------------ #
+    def partition_view(self) -> Dict[str, Any]:
+        """The ``engine_stats()["partition"]`` payload: per-member path +
+        classification reason for both dispatch kinds, plus the lifecycle
+        counters. Classifies transiently when no partition is cached yet."""
+        part = self._partition
+        if part is not None:
+            u_members, c_members = part.update_members, part.compute_members
+        else:
+            _, _, _, u_members = _classify_update_groups(self.collection, self._migrated_update)
+            _, _, c_members = _classify_compute_groups(self.collection, self._migrated_compute)
+        return {
+            "update": {name: dict(info) for name, info in u_members.items()},
+            "compute": {name: dict(info) for name, info in c_members.items()},
+            "builds": self.stats.builds,
+            "repartitions": self.stats.repartitions,
+            "migrations": self.stats.migrations,
+            "stable_hits": self.stats.stable_hits,
+        }
+
+
+def collection_partition_view(coll: Any) -> Dict[str, Any]:
+    """Partition view for a collection with or without a live dispatcher
+    (transient classification, zero counters, when dispatch never ran)."""
+    dispatcher = getattr(coll, "_dispatcher", None)
+    if dispatcher is not None:
+        return dispatcher.partition_view()
+    _, _, _, u_members = _classify_update_groups(coll, {})
+    _, _, c_members = _classify_compute_groups(coll, {})
+    return {
+        "update": u_members,
+        "compute": c_members,
+        "builds": 0, "repartitions": 0, "migrations": 0, "stable_hits": 0,
+    }
+
+
+def metric_partition_view(metric: Any) -> Dict[str, Any]:
+    """Single-metric ``engine_stats()["partition"]``: which path each dispatch
+    kind takes (static classification, overridden by a recorded runtime
+    fallback on the metric's own engines)."""
+    u_path, u_reason = classify_update_member(metric)
+    engine = getattr(metric, "_update_engine", None)
+    if engine is not None and engine.broken is not None:
+        u_path = PATH_EAGER
+        u_reason = f"runtime fallback: {engine.broken.splitlines()[0][:200]}"
+    c_path, c_reason = classify_compute_member(metric)
+    engine = getattr(metric, "_compute_engine", None)
+    if engine is not None and engine.broken is not None:
+        c_path = PATH_EAGER
+        c_reason = f"runtime fallback: {engine.broken.splitlines()[0][:200]}"
+    return {
+        "update": {"path": u_path, "reason": u_reason},
+        "compute": {"path": c_path, "reason": c_reason},
+    }

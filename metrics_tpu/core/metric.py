@@ -894,8 +894,16 @@ class Metric:
         (:func:`metrics_tpu.observability.instruments.engine_stats_view`) over
         the same live :class:`EngineStats` objects that registry exports as
         Prometheus-style counters — one source of truth, two read paths.
+
+        ``partition`` maps each dispatch kind to the path this metric would be
+        assigned by a collection's partition dispatcher (``fused`` /
+        ``bucketed`` / ``eager``) and the classification reason, with recorded
+        runtime fallbacks on this instance's own engines overriding the static
+        classification.
         """
-        return _instruments.engine_stats_view(self._update_engine, self._compute_engine)
+        stats = _instruments.engine_stats_view(self._update_engine, self._compute_engine)
+        stats["partition"] = _instruments.metric_partition_view(self)
+        return stats
 
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)

@@ -171,6 +171,7 @@ class InstrumentRegistry:
         self._lock = threading.Lock()
         self._instruments: Dict[Tuple[str, Tuple], Any] = {}
         self._engines: List[weakref.ref] = []
+        self._dispatchers: List[weakref.ref] = []
 
     # ------------------------------------------------------------------ #
     # manual instruments
@@ -222,6 +223,55 @@ class InstrumentRegistry:
             self._engines = kept
         return out
 
+    # ------------------------------------------------------------------ #
+    # dispatcher registration — the partition bridge
+    # ------------------------------------------------------------------ #
+    def register_dispatcher(self, dispatcher: Any) -> None:
+        """Weakly track a collection's partition dispatcher; its member
+        assignments and lifecycle counters appear in snapshots as
+        ``metrics_tpu_partition_*{owner=...}`` series."""
+        with self._lock:
+            self._dispatchers.append(weakref.ref(dispatcher))
+
+    def live_dispatchers(self) -> List[Any]:
+        out, kept = [], []
+        with self._lock:
+            for ref in self._dispatchers:
+                dispatcher = ref()
+                if dispatcher is not None:
+                    out.append(dispatcher)
+                    kept.append(ref)
+            self._dispatchers = kept
+        return out
+
+    def _partition_samples(self) -> Iterable[Sample]:
+        for dispatcher in self.live_dispatchers():
+            owner = type(dispatcher.collection).__name__
+            labels = {"owner": owner}
+            view = dispatcher.partition_view()
+            for kind in ("update", "compute"):
+                counts: Dict[str, int] = {}
+                for info in view[kind].values():
+                    counts[info["path"]] = counts.get(info["path"], 0) + 1
+                for path, n in sorted(counts.items()):
+                    yield Sample(
+                        f"{PREFIX}partition_members",
+                        {**labels, "kind": kind, "path": path},
+                        float(n), "gauge",
+                        "Collection members currently assigned to each dispatch path.",
+                    )
+            stats = dispatcher.stats
+            for fname, help_text in (
+                ("builds", "Partitions constructed (first build + rebuilds)."),
+                ("repartitions", "Partition rebuilds caused by a changed key."),
+                ("migrations", "Members migrated to the eager set by a runtime fallback."),
+                ("stable_hits", "Dispatches served by the cached partition."),
+            ):
+                yield Sample(
+                    f"{PREFIX}partition_{fname}", dict(labels),
+                    float(getattr(stats, fname)), "counter", help_text,
+                )
+
     def _engine_samples(self) -> Iterable[Sample]:
         for engine in self.live_engines():
             stats = engine.stats
@@ -260,6 +310,7 @@ class InstrumentRegistry:
         for inst in instruments:
             out.extend(inst.samples())
         out.extend(self._engine_samples())
+        out.extend(self._partition_samples())
         out.extend(_process_samples())
         return out
 
@@ -273,10 +324,12 @@ class InstrumentRegistry:
         return grouped
 
     def clear(self) -> None:
-        """Drop every manual instrument and engine registration (tests)."""
+        """Drop every manual instrument and engine/dispatcher registration
+        (tests)."""
         with self._lock:
             self._instruments.clear()
             self._engines.clear()
+            self._dispatchers.clear()
 
 
 def _rss_bytes() -> Optional[int]:
@@ -354,6 +407,11 @@ def register_engine(engine: Any) -> None:
     REGISTRY.register_engine(engine)
 
 
+def register_dispatcher(dispatcher: Any) -> None:
+    """Module-level convenience over ``REGISTRY.register_dispatcher``."""
+    REGISTRY.register_dispatcher(dispatcher)
+
+
 def get_registry() -> InstrumentRegistry:
     return REGISTRY
 
@@ -386,3 +444,20 @@ def merge_member_reasons(reasons: Dict[str, str], member_name: str,
     class (``{"a": F1(), "b": F1()}``) must not collide on ``"update:F1"``."""
     for key, why in member_reasons.items():
         reasons[f"{member_name}.{key}"] = why
+
+
+def collection_partition_view(coll: Any) -> Dict[str, Any]:
+    """The ``engine_stats()["partition"]`` payload for a collection: member
+    name -> assigned dispatch path + classification reason per kind, plus the
+    partition lifecycle counters. Lazy import: the engine module imports this
+    one at load time."""
+    from metrics_tpu.core import engine as _engine
+
+    return _engine.collection_partition_view(coll)
+
+
+def metric_partition_view(metric: Any) -> Dict[str, Any]:
+    """The single-metric ``engine_stats()["partition"]`` payload."""
+    from metrics_tpu.core import engine as _engine
+
+    return _engine.metric_partition_view(metric)

@@ -76,6 +76,11 @@ EVENT_CATALOG: Dict[str, Tuple[str, ...]] = {
         "streak/detach",  # fused streak begins: members detach from leaders
         "streak/realias",  # observation point: members realias to leader state
     ),
+    "partition": (
+        "partition/build",  # first classification into {fused,bucketed,eager}
+        "partition/rebuild",  # partition key changed: flags/placement re-keyed
+        "partition/migrate",  # runtime fallback moved member(s) to the eager set
+    ),
     "sync": (
         "sync/bucket_build",  # one bucketed sync build (args: collective tallies)
     ),

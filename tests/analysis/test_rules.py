@@ -244,6 +244,15 @@ class ShardIgnorantSyncMetric(ShardedCleanMetric):
         return {k: _sync.sync_array(v, "sum", axis_name) for k, v in state.items()}
 
 
+class ValueDependentComputeMetric(CleanMetric):
+    """E107 + E109: compute's output shape depends on state *values*, so the
+    fused compute leg cannot trace — yet the partition dispatcher's static
+    probes still classify the metric as fused-compute."""
+
+    def compute(self):
+        return jnp.nonzero(jnp.ones((4,)) * self.total)[0]  # metrics-tpu: allow[A002]
+
+
 _SPEC = {"init": {}, "inputs": [("float32", (8,))]}
 
 
@@ -378,6 +387,36 @@ class TestEvalStage:
         assert e108, [f.rule for f in findings]
         assert all("cannot be validated" in f.message for f in e108)
         assert not any("reduced as if replicated" in f.message for f in e108)
+
+    def test_untraceable_update_drift_is_E101_plus_E109(self):
+        # statically fused-eligible, but the update leg cannot abstract-eval:
+        # the runtime dispatcher would pay a failed trace + migration
+        findings = _evaluate(SuppressedHostMetric)
+        rules = _active_rules(findings)
+        assert "E101" in rules and "E109" in rules, rules
+        e109 = [f for f in findings if f.rule == "E109"]
+        assert len(e109) == 1
+        assert e109[0].extra["kind"] == "update"
+        assert e109[0].severity == "warning"
+
+    def test_update_opt_out_silences_E109(self):
+        # compiled_update=False pre-assigns the eager set — no drift to report
+        findings = _evaluate(SuppressedHostMetric, dict(_SPEC, init={"compiled_update": False}))
+        rules = {f.rule for f in findings if not f.suppressed}
+        assert "E101" in rules and "E109" not in rules
+
+    def test_untraceable_compute_drift_is_E107_plus_E109(self):
+        findings = _evaluate(ValueDependentComputeMetric)
+        rules = _active_rules(findings)
+        assert "E107" in rules and "E109" in rules, rules
+        e109 = [f for f in findings if f.rule == "E109"]
+        assert len(e109) == 1
+        assert e109[0].extra["kind"] == "compute"
+
+    def test_compute_opt_out_silences_E109(self):
+        findings = _evaluate(ValueDependentComputeMetric, dict(_SPEC, init={"compiled_compute": False}))
+        rules = {f.rule for f in findings if not f.suppressed}
+        assert "E107" in rules and "E109" not in rules
 
     def test_missing_spec_is_E002(self):
         findings = eval_stage.evaluate_entry(Entry(cls=CleanMetric, spec=None))

@@ -269,7 +269,10 @@ class _HostReadbackMetric(Metric):
 
 
 class TestEagerFallback:
-    def test_one_untraceable_member_reverts_collection(self):
+    def test_one_untraceable_member_migrates_alone(self):
+        """A runtime trace failure migrates only the culprit to the eager
+        set; the rest of the collection keeps (a rebuilt) fused program
+        instead of the old whole-collection eager demotion."""
         coll = MetricCollection(
             {"acc": Accuracy(), "host": _HostReadbackMetric()}
         )
@@ -277,16 +280,25 @@ class TestEagerFallback:
         t = jnp.asarray(np.random.default_rng(1).integers(0, 2, 32))
         with warnings.catch_warnings(record=True) as caught:
             warnings.simplefilter("always")
-            for _ in range(4):
+            for _ in range(6):
                 coll.update(p, t)
         assert any("engine disabled" in str(w.message) for w in caught)
-        assert coll._update_engine.broken is not None
-        assert coll._update_engine.stats.compiled_calls == 0
-        # every eager update landed: nothing was lost to the failed probe
+        dispatcher = coll._dispatcher
+        assert dispatcher.stats.migrations == 1
+        assert set(dispatcher._migrated_update) == {"host"}
+        part = dispatcher._partition
+        assert part.update_fused == ("acc",)
+        assert part.update_eager == ("host",)
+        # the remainder's fused subset engine is live and compiled
+        assert coll._update_engine.broken is None
+        assert coll._update_engine.stats.compiled_calls >= 1
+        # the retired engine's cause stays visible in the merged reasons
+        assert any(k.startswith("update:") for k in coll.engine_stats()["fallback_reasons"])
+        # every update landed: nothing was lost to the failed probe/migration
         np.testing.assert_allclose(
-            float(coll.compute()["host"]), 4 * float(jnp.sum(p)), rtol=1e-6
+            float(coll.compute()["host"]), 6 * float(jnp.sum(p)), rtol=1e-6
         )
-        assert coll["acc"]._update_count == 4
+        assert coll["acc"]._update_count == 6
 
     def test_fallback_is_permanent_and_warns_once(self):
         coll = MetricCollection({"host": _HostReadbackMetric()})

@@ -249,7 +249,7 @@ class TestEngineStatsView:
     def test_metric_engine_stats_shape_is_backward_compatible(self):
         m = Accuracy(num_classes=NUM_CLASSES)
         stats = m.engine_stats()
-        assert set(stats) == {"update", "compute", "fallback_reasons"}
+        assert set(stats) == {"update", "compute", "fallback_reasons", "partition"}
         assert stats["update"] is None and stats["fallback_reasons"] == {}
         m.update(*_batch())
         stats = m.engine_stats()
