@@ -2016,6 +2016,246 @@ def bench_partitioned_dispatch() -> None:
         sys.exit(1)
 
 
+def bench_resilience() -> None:
+    """``--resilience``: the ISSUE-10 resilience layer measured end to end —
+    the per-op cost of the retry wrapper every storage byte now funnels
+    through, the chaos harness's disabled/armed overhead on the config2 fused
+    update (the tracer-off discipline: *disabled* must cost nothing), a
+    3-seed deterministic chaos sweep (engine faults + flaky storage) asserting
+    the final compute is bitwise-equal to the fault-free run, and the
+    probation re-promotion latency in dispatches — recorded into
+    ``BENCH_r15.json`` and judged by the regression watchdog. Host-side CPU
+    bench."""
+    import contextlib
+    import glob as _glob
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from metrics_tpu import (
+        Accuracy,
+        F1Score,
+        MetricCollection,
+        Precision,
+        Recall,
+        set_probation,
+    )
+    from metrics_tpu.checkpoint import (
+        InMemoryStorage,
+        restore_checkpoint,
+        save_checkpoint,
+        use_storage,
+    )
+    from metrics_tpu.observability import regress as _regress
+    from metrics_tpu.resilience import FaultSpec, RetryPolicy, call_with_retry
+    from metrics_tpu.resilience import chaos as _chaos
+
+    def build():
+        return MetricCollection(
+            {
+                "acc": Accuracy(num_classes=NUM_CLASSES, average="micro"),
+                "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+                "precision": Precision(num_classes=NUM_CLASSES, average="macro"),
+                "recall": Recall(num_classes=NUM_CLASSES, average="macro"),
+            }
+        )
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(BATCH, NUM_CLASSES)), dtype=jnp.float32)
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=jnp.int32)
+
+    # --- retry-path overhead: what call_with_retry costs per successful op --
+    # (the wrapper runs on EVERY storage op now — its happy-path cost is the
+    # per-byte tax of the resilience layer, so it gets measured, not assumed)
+    n_ops = 50_000
+
+    def noop():
+        return None
+
+    policy = RetryPolicy(seed=0)
+    jrng = policy.rng()
+    for _ in range(1000):  # warm both paths
+        noop()
+        call_with_retry(noop, policy, op="bench", rng=jrng)
+    t0 = time.perf_counter()
+    for _ in range(n_ops):
+        noop()
+    raw_us = (time.perf_counter() - t0) / n_ops * 1e6
+    t0 = time.perf_counter()
+    for _ in range(n_ops):
+        call_with_retry(noop, policy, op="bench", rng=jrng)
+    wrapped_us = (time.perf_counter() - t0) / n_ops * 1e6
+
+    # --- chaos disabled vs armed-but-silent on the fused update ------------
+    def fused_us_per_step(coll, steps=STEPS, reps=3):
+        for _ in range(WARMUP):
+            coll.update(logits, target)
+
+        def one_rep():
+            coll.reset()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                coll.update(logits, target)
+            jax.block_until_ready(next(iter(coll.values())).get_state())
+            return (time.perf_counter() - t0) / steps * 1e6
+
+        return min(one_rep() for _ in range(reps))
+
+    off_us = fused_us_per_step(build())
+    # armed with a spec that never fires: pays the full plan-consult path
+    # (lock + spec scan) every dispatch — the honest upper bound on what a
+    # *quiet* armed harness costs
+    with _chaos.plan([FaultSpec("engine/dispatch", nth=10**9)], seed=0):
+        armed_us = fused_us_per_step(build())
+
+    # --- 3-seed chaos sweep: faulty final compute must equal fault-free ----
+    steps_total = 24
+    batches = []
+    for _ in range(steps_total):
+        batches.append(
+            (
+                jnp.asarray(rng.normal(size=(BATCH, NUM_CLASSES)), dtype=jnp.float32),
+                jnp.asarray(rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=jnp.int32),
+            )
+        )
+
+    def eval_loop(seed=None):
+        """Update streak -> checkpoint save -> restore into a fresh
+        collection -> compute, optionally under a seeded fault plan."""
+        specs = [
+            # one compiled-dispatch fault: fallback + migration + probation
+            FaultSpec("engine/dispatch", nth=5, times=1),
+            # flaky storage: deterministic every-Nth transient errors (the
+            # retry wrapper's next attempt is the N+1th call and succeeds)
+            FaultSpec("storage/write", every=7, times=4),
+            FaultSpec("storage/read", every=5, times=4),
+            # seed-sensitive flakiness on the read path
+            FaultSpec("storage/read", probability=0.2, times=3),
+        ]
+        store = InMemoryStorage()
+        set_probation(3)
+        try:
+            with contextlib.ExitStack() as stack:
+                stack.enter_context(use_storage(store))
+                plan_ = None
+                if seed is not None:
+                    plan_ = stack.enter_context(_chaos.plan(specs, seed=seed))
+                coll = build()
+                for lg, tg in batches:
+                    coll.update(lg, tg)
+                save_checkpoint(coll, "bench-resilience/ckpt", world_size=1, shard_index=0)
+                fresh = build()
+                restore_checkpoint(fresh, "bench-resilience/ckpt", host_count=1)
+                vals = fresh.compute()
+                fired = plan_.fired() if plan_ is not None else 0
+            return {k: np.asarray(v).tobytes() for k, v in vals.items()}, fired
+        finally:
+            set_probation(None)
+
+    baseline, _ = eval_loop(seed=None)
+    sweep = {}
+    for seed in (0, 1, 2):
+        vals, fired = eval_loop(seed=seed)
+        sweep[f"seed{seed}"] = {
+            "bitwise_equal": vals == baseline,
+            "faults_fired": fired,
+        }
+    pass_rate = sum(1 for s in sweep.values() if s["bitwise_equal"]) / len(sweep)
+
+    # --- probation re-promotion latency ------------------------------------
+    # one injected dispatch fault demotes the fused set; with cooldown=3 the
+    # dispatcher re-probes after the cooldown and a compiled trial dispatch
+    # re-promotes — the latency is the dispatch distance migrate->repromote
+    cooldown = 3
+    set_probation(cooldown)
+    migrate_step = promote_step = None
+    try:
+        coll = build()
+        with _chaos.plan([FaultSpec("engine/dispatch", nth=4, times=1)], seed=0):
+            for step in range(1, 64):
+                coll.update(logits, target)
+                pv = coll.engine_stats()["partition"]
+                if migrate_step is None and pv["migrations"] > 0:
+                    migrate_step = step
+                if pv["repromotions"] > 0:
+                    promote_step = step
+                    break
+    finally:
+        set_probation(None)
+    repromote_latency = (
+        promote_step - migrate_step
+        if promote_step is not None and migrate_step is not None
+        else None
+    )
+
+    record = {
+        # headline: the sweep's bitwise-equality pass rate — the property the
+        # whole resilience layer exists to defend
+        "metric": "resilience_chaos_sweep_pass_rate",
+        "value": pass_rate,
+        "unit": "ratio",
+        "extra": {
+            "config": "config2_collection",
+            "num_classes": NUM_CLASSES,
+            "sweep_steps": steps_total,
+            "sweep": sweep,
+            "retry": {
+                "noop_raw_us_per_op": round(raw_us, 4),
+                "noop_wrapped_us_per_op": round(wrapped_us, 4),
+                "wrapper_overhead_us_per_op": round(wrapped_us - raw_us, 4),
+            },
+            "chaos": {
+                "fused_update_us_per_step_chaos_off": round(off_us, 2),
+                "fused_update_us_per_step_chaos_armed": round(armed_us, 2),
+                "armed_overhead_pct": round((armed_us / off_us - 1.0) * 100, 2),
+            },
+            "probation": {
+                "cooldown_dispatches": cooldown,
+                "migrate_step": migrate_step,
+                "repromote_step": promote_step,
+                "repromotion_latency_dispatches": repromote_latency,
+            },
+        },
+    }
+
+    # watchdog self-check: judge this round against the checked-in trajectory
+    rounds = [
+        r for r in _regress.load_rounds(
+            sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json"))))
+        if r.name != "r15"
+    ]
+    rounds.append(_regress.Round("r15", "<this-run>", record))
+    report = _regress.check_trajectory(rounds)
+    record["extra"]["regress"] = {
+        "ok": report.ok,
+        "regression_count": len(report.regressions),
+        "keys_checked": report.keys_checked,
+        "regressions": [r.describe() for r in report.regressions],
+    }
+
+    with open(os.path.join(REPO, "BENCH_r15.json"), "w") as fh:
+        json.dump(record, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(record), flush=True)
+    problems = []
+    if pass_rate < 1.0:
+        failed = sorted(k for k, s in sweep.items() if not s["bitwise_equal"])
+        problems.append(
+            f"chaos sweep pass rate {pass_rate:.2f} < 1.0 (failed: {', '.join(failed)})"
+        )
+    if repromote_latency is None:
+        problems.append("probation trial never re-promoted the fused set")
+    if not report.ok:
+        problems.extend(r.describe() for r in report.regressions)
+    if problems:
+        print("[bench] resilience round FAILED its gates:", file=sys.stderr)
+        for p in problems:
+            print(f"[bench]   {p}", file=sys.stderr)
+        sys.exit(1)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
@@ -2044,6 +2284,14 @@ def main() -> None:
         help="measure partition-aware collection dispatch (fused + bucketed + "
         "eager straggler) vs the old whole-collection eager demotion and "
         "record into BENCH_r14.json",
+    )
+    parser.add_argument(
+        "--resilience",
+        action="store_true",
+        help="measure retry-wrapper per-op overhead, chaos armed/disabled "
+        "overhead on the fused update, the 3-seed deterministic chaos sweep's "
+        "bitwise pass rate, and probation re-promotion latency; record into "
+        "BENCH_r15.json",
     )
     parser.add_argument(
         "--checkpoint",
@@ -2084,6 +2332,9 @@ def main() -> None:
         return
     if args.partitioned_dispatch:
         bench_partitioned_dispatch()
+        return
+    if args.resilience:
+        bench_resilience()
         return
     if args.checkpoint:
         bench_checkpoint()

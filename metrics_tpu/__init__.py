@@ -55,9 +55,11 @@ from metrics_tpu.core import (  # noqa: F401
     compiled_compute_enabled,
     compiled_update_enabled,
     fused_update_enabled,
+    probation_cooldown,
     set_compiled_compute,
     set_compiled_update,
     set_fused_update,
+    set_probation,
 )
 from metrics_tpu import checkpoint  # noqa: F401
 from metrics_tpu.checkpoint import (  # noqa: F401
@@ -66,6 +68,7 @@ from metrics_tpu.checkpoint import (  # noqa: F401
     verify_checkpoint,
 )
 from metrics_tpu import observability  # noqa: F401
+from metrics_tpu import resilience  # noqa: F401
 from metrics_tpu.detection import MeanAveragePrecision  # noqa: F401
 from metrics_tpu.image import (  # noqa: F401
     ErrorRelativeGlobalDimensionlessSynthesis,
@@ -138,11 +141,14 @@ __all__ = [
     "set_compiled_update", "compiled_update_enabled",
     "set_compiled_compute", "compiled_compute_enabled",
     "set_fused_update", "fused_update_enabled",
+    "set_probation", "probation_cooldown",
     "set_bucketed_sync", "bucketed_sync_enabled",
     # checkpoint
     "checkpoint", "save_checkpoint", "restore_checkpoint", "verify_checkpoint",
     # observability (event tracer, instrument registry, exporters)
     "observability",
+    # resilience (chaos harness, retry policies, non-finite guard)
+    "resilience",
     # aggregation
     "CatMetric", "MaxMetric", "MeanMetric", "MinMetric", "SumMetric",
     # audio

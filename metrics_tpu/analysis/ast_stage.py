@@ -297,6 +297,7 @@ class _MethodLinter:
             self._scan_expr_tree(node)
             return
         if isinstance(node, ast.Try):
+            self._check_handlers(node)
             for s in (*node.body, *node.orelse, *node.finalbody):
                 self.visit_stmt(s)
             for handler in node.handlers:
@@ -308,6 +309,25 @@ class _MethodLinter:
             return
         # nested defs/classes and anything else: still scan for violations
         self._scan_expr_tree(node)
+
+    def _check_handlers(self, node: ast.Try) -> None:
+        """A008: over-broad exception handlers in jit-facing methods. Catching
+        ``Exception`` here hides the trace failures the engine fallback exists
+        to surface; a handler that re-raises (even conditionally) is fine."""
+        for handler in node.handlers:
+            broad = _broad_handler_name(handler)
+            if broad is None:
+                continue
+            if _handler_reraises(handler):
+                continue
+            label = "bare `except:`" if broad == "" else f"`except {broad}:`"
+            self.emit(
+                "A008",
+                handler,
+                f"{label} with no re-raise inside {self.fn.name}() — swallows the "
+                "trace failures the compiled engines' fallback depends on; catch "
+                "narrow exception types or re-raise after handling",
+            )
 
     def _visit_if(self, node: ast.If) -> None:
         guard = any(
@@ -605,6 +625,59 @@ def _root_name_of(node: ast.AST) -> Optional[str]:
     return node.id if isinstance(node, ast.Name) else None
 
 
+def _broad_handler_name(handler: ast.ExceptHandler) -> Optional[str]:
+    """``""`` for a bare ``except:``, ``"Exception"``/``"BaseException"`` for
+    the over-broad names (including inside a tuple), ``None`` for narrow
+    handlers."""
+    t = handler.type
+    if t is None:
+        return ""
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in ("Exception", "BaseException"):
+            return n.id
+        if isinstance(n, ast.Attribute) and n.attr in ("Exception", "BaseException"):
+            return n.attr
+    return None
+
+
+def _handler_reraises(handler: ast.ExceptHandler) -> bool:
+    return any(
+        isinstance(n, ast.Raise) for s in handler.body for n in ast.walk(s)
+    )
+
+
+def _audit_except_findings(ctx: ModuleContext) -> List[Finding]:
+    """File-wide A008 sweep for audit mode: bare ``except:`` and ``except
+    BaseException:`` without a re-raise, wherever they appear. Plain ``except
+    Exception`` is deliberately tolerated file-wide — host-side cleanup code
+    catches it legitimately; the per-method lint holds jit-facing metric
+    methods to the stricter bar."""
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            broad = _broad_handler_name(handler)
+            if broad not in ("", "BaseException"):
+                continue
+            if _handler_reraises(handler):
+                continue
+            label = "bare `except:`" if broad == "" else f"`except {broad}:`"
+            findings.append(
+                Finding(
+                    rule="A008",
+                    obj=ctx.filename,
+                    message=f"{label} with no re-raise swallows KeyboardInterrupt/"
+                    "SystemExit and injected chaos faults; catch narrow exception "
+                    "types, re-raise after handling, or suppress with a reason",
+                    file=ctx.filename,
+                    line=handler.lineno,
+                )
+            )
+    return findings
+
+
 def _audit_clock_findings(ctx: ModuleContext) -> List[Finding]:
     """File-wide A007 sweep for audit mode: every host-clock read or tracer
     emit in the file, regardless of the enclosing def. Noisier by design than
@@ -646,8 +719,10 @@ def _audit_clock_findings(ctx: ModuleContext) -> List[Finding]:
 
 def lint_source(filename: str, source: str, global_state_names: Set[str]) -> List[Finding]:
     """Audit mode (``--paths``): scan arbitrary code for foreign-state reads
-    (A006) — the ROADMAP's stale-member-state caveat — and for host-clock /
-    tracer-emit calls (A007, file-wide; see :func:`_audit_clock_findings`)."""
+    (A006) — the ROADMAP's stale-member-state caveat — for host-clock /
+    tracer-emit calls (A007, file-wide; see :func:`_audit_clock_findings`),
+    and for swallowing exception handlers (A008, bare/``BaseException`` only;
+    see :func:`_audit_except_findings`)."""
     try:
         ctx = ModuleContext(filename, textwrap.dedent(source))
     except SyntaxError as err:
@@ -675,6 +750,7 @@ def lint_source(filename: str, source: str, global_state_names: Set[str]) -> Lis
             )
         )
     findings.extend(_audit_clock_findings(ctx))
+    findings.extend(_audit_except_findings(ctx))
     for f in findings:
         if f.line is not None and f.rule in ctx.suppressions.get(f.line, ()):
             f.suppressed = True

@@ -87,6 +87,15 @@ _AST_RULES = (
         "record telemetry at the dispatch layer (metrics_tpu.observability) "
         "or guard with _is_concrete/_tracing_active.",
     ),
+    Rule(
+        "A008", "overbroad-except", ERROR,
+        "bare ``except:`` / ``except BaseException:`` (or, in jit-facing "
+        "metric methods, ``except Exception:``) with no re-raise — swallows "
+        "KeyboardInterrupt, injected chaos faults, and the trace failures the "
+        "engines' fallback and the retry policy's transient-vs-fatal "
+        "classification depend on; catch narrow exception types or re-raise "
+        "after handling.",
+    ),
 )
 
 # --------------------------------------------------------------------------- #

@@ -60,10 +60,35 @@ from metrics_tpu.checkpoint.restore import (
     verify_all,
     verify_checkpoint,
 )
+from metrics_tpu.checkpoint.storage import (
+    InMemoryStorage,
+    LocalStorage,
+    ObjectStorage,
+    Storage,
+    get_retry_policy,
+    get_storage,
+    set_retry_policy,
+    set_storage,
+    use_retry_policy,
+    use_storage,
+)
+from metrics_tpu.resilience.retry import RetryPolicy
 
 __all__ = [
     "FORMAT_VERSION",
     "SaveHandle",
+    # pluggable storage backends + retry policy (docs/resilience.md)
+    "Storage",
+    "LocalStorage",
+    "ObjectStorage",
+    "InMemoryStorage",
+    "get_storage",
+    "set_storage",
+    "use_storage",
+    "RetryPolicy",
+    "get_retry_policy",
+    "set_retry_policy",
+    "use_retry_policy",
     "RestoreInfo",
     "VerifyReport",
     "save_checkpoint",
@@ -216,7 +241,7 @@ def save_checkpoint(
                 _emit_phase("checkpoint/save/commit", w1, w2,
                             step=handle.step, committed=handle.committed)
             _observe_phases("save", handle.timings)
-        except BaseException as err:  # surfaced by wait()
+        except BaseException as err:  # surfaced by wait()  # metrics-tpu: allow[A008]
             handle._error = err
 
     if blocking:

@@ -74,7 +74,16 @@ def _cmd_verify(root: str, step: Optional[int], check_all: bool) -> int:
         reports = [verify_checkpoint(root, step)]
     for report in reports:
         _print_report(report)
-    return 0 if all(r.ok for r in reports) else 1
+    bad = [r for r in reports if not r.ok]
+    if bad:
+        first = bad[0]
+        print(
+            f"error: first corrupt step is {first.step} "
+            f"({len(bad)} of {len(reports)} step(s) failed verification)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
 
 
 def _cmd_merge(root: str, out_root: str, step: Optional[int]) -> int:
@@ -89,11 +98,12 @@ def _cmd_merge(root: str, out_root: str, step: Optional[int]) -> int:
     return 0 if report.ok else 1
 
 
-def _cmd_clean(root: str) -> int:
-    removed = _io.clean_pending(root)
+def _cmd_clean(root: str, dry_run: bool = False) -> int:
+    removed = _io.clean_pending(root, dry_run=dry_run)
+    verb = "would remove" if dry_run else "removed"
     for path in removed:
-        print(f"removed {path}")
-    print(f"{len(removed)} pending dir(s) reaped")
+        print(f"{verb} {path}")
+    print(f"{len(removed)} pending dir(s) {'found' if dry_run else 'reaped'}")
     return 0
 
 
@@ -120,6 +130,8 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("clean", help="remove aborted .pending directories")
     p.add_argument("root")
+    p.add_argument("--dry-run", action="store_true",
+                   help="list what would be removed without touching anything")
 
     args = parser.parse_args(argv)
     if args.cmd == "inspect":
@@ -128,7 +140,7 @@ def main(argv=None) -> int:
         return _cmd_verify(args.root, args.step, args.all)
     if args.cmd == "merge":
         return _cmd_merge(args.root, args.out_root, args.step)
-    return _cmd_clean(args.root)
+    return _cmd_clean(args.root, dry_run=args.dry_run)
 
 
 if __name__ == "__main__":

@@ -13,32 +13,41 @@ Layout under a checkpoint root::
 Protocol (the preemption contract):
 
 1. Every host writes its payload + sidecar into the shared ``.pending``
-   directory. Each file lands via write-to-temp + ``os.replace`` + fsync, so a
-   file either exists complete or not at all.
+   directory. Each file lands via the backend's atomic write (local: temp +
+   ``os.replace`` + fsync), so a file either exists complete or not at all.
 2. When all ``world_size`` sidecars are present, the last finishing host
    aggregates them into ``MANIFEST.json``, then writes the ``COMMIT`` marker
-   — strictly after every shard is fully on disk — and finally renames the
-   pending directory to its committed name (one atomic ``os.rename``).
+   — strictly after every shard is fully durable — and finally publishes the
+   pending directory under its committed name (local: one atomic
+   ``os.rename``; object stores: copy-then-delete with COMMIT copied last).
 3. Readers only ever consider non-pending directories that contain ``COMMIT``.
 
 A process killed at ANY point therefore leaves either a committed snapshot
 from before the save, plus possibly a ``.pending`` junk directory (ignored by
 readers, reaped by :func:`clean_pending`), or the fully committed new
 snapshot. There is no in-between state a reader can observe.
+
+Every byte moves through the pluggable :class:`~metrics_tpu.checkpoint.storage.Storage`
+backend (:func:`~metrics_tpu.checkpoint.storage.set_storage`) under the
+process-wide retry policy, and each phase carries a chaos fault point
+(``ckpt/write``, ``ckpt/commit``, ``ckpt/read``, ``ckpt/manifest`` — see
+:mod:`metrics_tpu.resilience.chaos`).
 """
 from __future__ import annotations
 
-import hashlib
+import io as _pyio
 import json
 import os
 import re
-import tempfile
 import threading
-from typing import Any, Dict, List, Optional, Tuple
+import zipfile
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from metrics_tpu.checkpoint.format import FORMAT_VERSION
+from metrics_tpu.checkpoint.storage import get_storage, storage_op
+from metrics_tpu.resilience import chaos as _chaos
 from metrics_tpu.utils.exceptions import MetricsUserError
 
 MANIFEST_NAME = "MANIFEST.json"
@@ -85,12 +94,15 @@ def shard_basename(shard_index: int, world_size: int) -> str:
 
 def available_steps(root: str) -> List[int]:
     """Committed (COMMIT-marked) snapshot steps under ``root``, ascending."""
-    if not os.path.isdir(root):
+    st = get_storage()
+    if not storage_op("exists", lambda: st.isdir(root)):
         return []
     steps = []
-    for name in os.listdir(root):
+    for name in storage_op("list", lambda: st.listdir(root)):
         m = _STEP_RE.match(name)
-        if m and os.path.exists(os.path.join(root, name, COMMIT_NAME)):
+        if m and storage_op(
+            "exists", lambda n=name: st.exists(os.path.join(root, n, COMMIT_NAME))
+        ):
             steps.append(int(m.group(1)))
     return sorted(steps)
 
@@ -100,94 +112,79 @@ def latest_step(root: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
-def clean_pending(root: str) -> List[str]:
+def clean_pending(root: str, dry_run: bool = False) -> List[str]:
     """Remove leftover ``.pending`` directories (aborted saves). Returns the
-    removed paths. Never touches committed snapshots."""
-    removed = []
-    if not os.path.isdir(root):
+    removed paths — with ``dry_run`` they are only listed, nothing is
+    touched. Never touches committed snapshots."""
+    st = get_storage()
+    removed: List[str] = []
+    if not storage_op("exists", lambda: st.isdir(root)):
         return removed
-    for name in os.listdir(root):
+    for name in storage_op("list", lambda: st.listdir(root)):
         if name.endswith(PENDING_SUFFIX) and _STEP_RE.match(name[: -len(PENDING_SUFFIX)]):
             path = os.path.join(root, name)
-            for fname in os.listdir(path):
-                os.unlink(os.path.join(path, fname))
-            os.rmdir(path)
+            if not dry_run:
+                storage_op("delete", lambda p=path: st.delete_tree(p))
             removed.append(path)
     return removed
 
 
 # --------------------------------------------------------------------------- #
-# durable file primitives
+# durable file primitives (routed through the pluggable backend)
 # --------------------------------------------------------------------------- #
-def _fsync_path(path: str) -> None:
-    fd = os.open(path, os.O_RDONLY)
-    try:
-        os.fsync(fd)
-    finally:
-        os.close(fd)
-
-
-def _fsync_dir(path: str) -> None:
-    try:
-        _fsync_path(path)
-    except OSError:  # some filesystems refuse O_RDONLY on dirs; best effort
-        pass
-
-
 def atomic_write_bytes(path: str, data: bytes) -> None:
-    """Write ``data`` so that ``path`` is either absent or complete."""
-    dirname = os.path.dirname(path)
-    fd, tmp = tempfile.mkstemp(dir=dirname, prefix=".tmp.", suffix=os.path.basename(path))
-    try:
-        with os.fdopen(fd, "wb") as fh:
-            fh.write(data)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
-    _fsync_dir(dirname)
+    """Write ``data`` so that ``path`` is either absent or complete.
+
+    Carries the ``ckpt/write`` partial-write fault point: a scheduled
+    ``partial_write`` spec truncates the payload *before* the atomic write,
+    modelling a torn write that still got published — the checksum layer is
+    what must catch it downstream.
+    """
+    if _chaos.active:
+        frac = _chaos.partial_write_fraction("ckpt/write")
+        if frac is not None:
+            data = data[: int(len(data) * frac)]
+    st = get_storage()
+    storage_op("write", lambda: st.write_atomic(path, data))
 
 
 def atomic_write_json(path: str, obj: Any) -> None:
     atomic_write_bytes(path, json.dumps(obj, indent=1, sort_keys=True).encode())
 
 
+def read_bytes(path: str) -> bytes:
+    st = get_storage()
+    return storage_op("read", lambda: st.read_bytes(path))
+
+
 def read_json(path: str) -> Any:
-    with open(path, "r") as fh:
-        return json.load(fh)
+    return json.loads(read_bytes(path).decode())
 
 
 def sha256_file(path: str) -> str:
-    h = hashlib.sha256()
-    with open(path, "rb") as fh:
-        for chunk in iter(lambda: fh.read(1 << 20), b""):
-            h.update(chunk)
-    return h.hexdigest()
+    st = get_storage()
+    return storage_op("sha256", lambda: st.sha256(path))
+
+
+def file_size(path: str) -> int:
+    st = get_storage()
+    return storage_op("size", lambda: st.size(path))
+
+
+def path_exists(path: str) -> bool:
+    st = get_storage()
+    return storage_op("exists", lambda: st.exists(path))
 
 
 def save_npz(path: str, payload: Dict[str, np.ndarray]) -> None:
-    """Atomic ``np.savez`` (write temp, fsync, replace)."""
-    dirname = os.path.dirname(path)
-    fd, tmp = tempfile.mkstemp(dir=dirname, prefix=".tmp.", suffix=".npz")
-    os.close(fd)
-    try:
-        with open(tmp, "wb") as fh:
-            np.savez(fh, **payload)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
-    _fsync_dir(dirname)
+    """Atomic npz write (serialize to bytes, then one atomic backend write)."""
+    buf = _pyio.BytesIO()
+    np.savez(buf, **payload)
+    atomic_write_bytes(path, buf.getvalue())
 
 
 def load_npz(path: str) -> Dict[str, np.ndarray]:
-    with np.load(path, allow_pickle=False) as npz:
+    with np.load(_pyio.BytesIO(read_bytes(path)), allow_pickle=False) as npz:
         return {k: npz[k] for k in npz.files}
 
 
@@ -204,7 +201,10 @@ def write_shard(
     """Phase 1 for one host: payload npz + sidecar json into the pending dir."""
     if not (0 <= shard_index < world_size):
         raise CheckpointError(f"shard_index {shard_index} out of range for world_size {world_size}")
-    os.makedirs(pending, exist_ok=True)
+    if _chaos.active:
+        _chaos.maybe_fail("ckpt/write", shard=shard_index, world=world_size)
+    st = get_storage()
+    storage_op("makedirs", lambda: st.makedirs(pending))
     base = shard_basename(shard_index, world_size)
     npz_path = os.path.join(pending, base + ".npz")
     save_npz(npz_path, payload)
@@ -215,7 +215,7 @@ def write_shard(
             "shard_index": shard_index,
             "world_size": world_size,
             "npz": base + ".npz",
-            "bytes": os.path.getsize(npz_path),
+            "bytes": file_size(npz_path),
             "sha256": sha256_file(npz_path),
         }
     )
@@ -229,18 +229,22 @@ def try_commit(root: str, step: int, world_size: int) -> bool:
     Returns True when the snapshot is committed (by this call or an earlier
     one); False when shards are still missing. The COMMIT marker is written
     strictly after all shards and the manifest are durable, and the pending
-    directory becomes visible to readers only through the final atomic rename.
+    directory becomes visible to readers only through the final publish
+    rename.
     """
+    if _chaos.active:
+        _chaos.maybe_fail("ckpt/commit", step=int(step))
+    st = get_storage()
     final = step_dir(root, step)
-    if os.path.exists(os.path.join(final, COMMIT_NAME)):
+    if path_exists(os.path.join(final, COMMIT_NAME)):
         return True
     pending = pending_dir(root, step)
-    if not os.path.isdir(pending):
+    if not storage_op("exists", lambda: st.isdir(pending)):
         return False
     sidecars = []
     for i in range(world_size):
         p = os.path.join(pending, shard_basename(i, world_size) + ".json")
-        if not os.path.exists(p):
+        if not path_exists(p):
             return False
         sidecars.append(read_json(p))
     fingerprints = [json.dumps(s.get("fingerprint"), sort_keys=True) for s in sidecars]
@@ -282,8 +286,7 @@ def try_commit(root: str, step: int, world_size: int) -> bool:
             sort_keys=True,
         ).encode(),
     )
-    os.rename(pending, final)
-    _fsync_dir(root)
+    storage_op("rename", lambda: st.rename(pending, final))
     return True
 
 
@@ -296,7 +299,7 @@ def resolve_step(root: str, step: Optional[int]) -> int:
         if latest is None:
             raise CheckpointNotFoundError(f"no committed checkpoint under {root!r}")
         return latest
-    if not os.path.exists(os.path.join(step_dir(root, step), COMMIT_NAME)):
+    if not path_exists(os.path.join(step_dir(root, step), COMMIT_NAME)):
         raise CheckpointNotFoundError(
             f"no committed checkpoint for step {step} under {root!r} "
             f"(available: {available_steps(root) or 'none'})"
@@ -305,13 +308,15 @@ def resolve_step(root: str, step: Optional[int]) -> int:
 
 
 def read_manifest(root: str, step: int) -> Dict[str, Any]:
+    if _chaos.active:
+        _chaos.maybe_fail("ckpt/manifest", step=int(step))
     d = step_dir(root, step)
     commit_path = os.path.join(d, COMMIT_NAME)
     manifest_path = os.path.join(d, MANIFEST_NAME)
-    if not os.path.exists(commit_path):
+    if not path_exists(commit_path):
         raise CheckpointNotFoundError(f"step {step} under {root!r} has no COMMIT marker")
     try:
-        commit = json.loads(open(commit_path, "rb").read().decode())
+        commit = json.loads(read_bytes(commit_path).decode())
     except (ValueError, OSError) as err:
         raise CheckpointCorruptError(f"unreadable COMMIT marker for step {step}: {err}") from err
     if commit.get("format_version") != FORMAT_VERSION:
@@ -319,7 +324,7 @@ def read_manifest(root: str, step: int) -> Dict[str, Any]:
             f"checkpoint format version {commit.get('format_version')!r} != "
             f"supported {FORMAT_VERSION} (step {step} under {root!r})"
         )
-    if not os.path.exists(manifest_path):
+    if not path_exists(manifest_path):
         raise CheckpointCorruptError(f"step {step} is committed but {MANIFEST_NAME} is missing")
     if commit.get("manifest_sha256") != sha256_file(manifest_path):
         raise CheckpointCorruptError(
@@ -330,11 +335,13 @@ def read_manifest(root: str, step: int) -> Dict[str, Any]:
 
 def load_shard_payload(root: str, step: int, shard_entry: Dict[str, Any], verify: bool = True) -> Dict[str, np.ndarray]:
     """Load one shard's npz, checking size + sha256 against the manifest."""
+    if _chaos.active:
+        _chaos.maybe_fail("ckpt/read", step=int(step), npz=shard_entry.get("npz"))
     path = os.path.join(step_dir(root, step), shard_entry["npz"])
-    if not os.path.exists(path):
+    if not path_exists(path):
         raise CheckpointCorruptError(f"shard payload {shard_entry['npz']} of step {step} is missing")
     if verify:
-        size = os.path.getsize(path)
+        size = file_size(path)
         if size != shard_entry["bytes"]:
             raise CheckpointCorruptError(
                 f"shard {shard_entry['npz']} of step {step} is truncated: "
@@ -348,7 +355,9 @@ def load_shard_payload(root: str, step: int, shard_entry: Dict[str, Any], verify
             )
     try:
         return load_npz(path)
-    except (ValueError, OSError, KeyError) as err:
+    except (ValueError, OSError, KeyError, zipfile.BadZipFile) as err:
+        # BadZipFile: a torn npz write (zip directory lives at the END of the
+        # file) — the shape every partial_write chaos fault produces
         raise CheckpointCorruptError(
             f"shard {shard_entry['npz']} of step {step} is unreadable: {err}"
         ) from err

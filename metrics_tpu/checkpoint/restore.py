@@ -29,7 +29,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.observability import tracer as _otrace
+from metrics_tpu.observability.instruments import REGISTRY as _REGISTRY
 from metrics_tpu.checkpoint import io as _io
+from metrics_tpu.utils.prints import rank_zero_warn
 from metrics_tpu.checkpoint.format import (
     SELF_KEY,
     describe,
@@ -55,6 +57,9 @@ class RestoreInfo:
     # host-side shard load/fold — everything before live state is touched) and
     # apply_s (folded state applied + dispatch invalidation)
     timings: Dict[str, float] = field(default_factory=dict)
+    # newest committed step that failed verification when this restore fell
+    # back to an older verifiable one (None on the normal path)
+    fallback_from: Optional[int] = None
 
 
 @dataclass
@@ -155,6 +160,7 @@ def restore_checkpoint(
     host_index: Optional[int] = None,
     host_count: Optional[int] = None,
     verify_payload: bool = True,
+    fallback_to_verified: bool = True,
 ) -> RestoreInfo:
     """Load a committed snapshot into a live Metric / MetricCollection.
 
@@ -162,6 +168,17 @@ def restore_checkpoint(
     ``jax.process_count()``; pass them explicitly to reshard (e.g.
     ``host_count=1`` folds every shard into this process). All verification
     and folding completes before any live state is replaced.
+
+    **Graceful degradation**: when ``step`` is ``None`` (restore-latest) and
+    the newest committed step fails checksum/manifest verification, the
+    restore walks older committed steps — newest first — and loads the
+    latest *verifiable* one instead of raising (``fallback_to_verified=False``
+    restores the old raise-on-first-corruption behavior). The skipped step is
+    recorded in ``RestoreInfo.fallback_from``, warned about, counted in
+    ``metrics_tpu_checkpoint_restore_fallbacks_total``, and traced as a
+    ``checkpoint/restore/fallback`` event. An explicitly requested ``step``
+    never falls back, and fingerprint mismatches (wrong live object) are
+    never skipped — only corruption is.
     """
     import jax
 
@@ -177,44 +194,77 @@ def restore_checkpoint(
             host_index = 0
 
     t0 = time.perf_counter()
-    step = _io.resolve_step(root, step)
-    manifest = _io.read_manifest(root, step)
-
-    live_fp = object_fingerprint(obj)
-    diff = fingerprint_diff(manifest["fingerprint"], live_fp)
-    if diff:
-        raise _io.CheckpointMismatchError(
-            f"checkpoint step {step} under {root!r} does not match the live "
-            f"{type(obj).__name__}; refusing to restore. Diff (checkpoint vs live):\n  "
-            + "\n  ".join(diff)
-        )
-
-    world_size = int(manifest["world_size"])
-    mine = assign_shards(world_size, host_index, host_count)
-    shard_entries = {int(s["shard_index"]): s for s in manifest["shards"]}
+    requested = step
+    if requested is None and fallback_to_verified:
+        candidates = sorted(_io.available_steps(root), reverse=True)
+        if not candidates:
+            raise _io.CheckpointNotFoundError(f"no committed checkpoint under {root!r}")
+    else:
+        candidates = [_io.resolve_step(root, requested)]
 
     kind, members = describe(obj)
+    live_fp = object_fingerprint(obj)
 
-    # pass 1: load + fold on host memory; the live object is untouched
-    loaded: List[Tuple[int, Dict[str, np.ndarray], Dict[str, Any]]] = []
-    for idx in mine:
-        entry = shard_entries[idx]
-        loaded.append((idx, _io.load_shard_payload(root, step, entry, verify=verify_payload), entry))
-
-    folded: Dict[str, Tuple[Dict[str, Any], int]] = {}
-    for key, metric in members.items():
-        if not loaded:
-            # more restore hosts than shards: this host starts from defaults
-            folded[key] = ({k: v for k, v in metric.init_state().items()}, 0)
-            continue
-        states, counts = [], []
-        leaves = None
-        for _idx, payload, entry in loaded:
-            mmeta = entry["members"][key]
-            leaves = mmeta["leaves"]
-            states.append(_decode_member_state(payload, key, leaves))
-            counts.append(int(mmeta["update_count"]))
-        folded[key] = fold_member_shards(metric, key, states, counts, leaves)
+    # pass 1: load + fold on host memory; the live object is untouched. Only
+    # *corruption* moves on to the next (older) candidate — a fingerprint
+    # mismatch or missing step raises straight out.
+    first_err: Optional[_io.CheckpointCorruptError] = None
+    fallback_from: Optional[int] = None
+    for attempt_i, cand in enumerate(candidates):
+        try:
+            manifest = _io.read_manifest(root, cand)
+            diff = fingerprint_diff(manifest["fingerprint"], live_fp)
+            if diff:
+                raise _io.CheckpointMismatchError(
+                    f"checkpoint step {cand} under {root!r} does not match the live "
+                    f"{type(obj).__name__}; refusing to restore. Diff (checkpoint vs live):\n  "
+                    + "\n  ".join(diff)
+                )
+            world_size = int(manifest["world_size"])
+            mine = assign_shards(world_size, host_index, host_count)
+            shard_entries = {int(s["shard_index"]): s for s in manifest["shards"]}
+            loaded: List[Tuple[int, Dict[str, np.ndarray], Dict[str, Any]]] = []
+            for idx in mine:
+                entry = shard_entries[idx]
+                loaded.append(
+                    (idx, _io.load_shard_payload(root, cand, entry, verify=verify_payload), entry)
+                )
+            folded: Dict[str, Tuple[Dict[str, Any], int]] = {}
+            for key, metric in members.items():
+                if not loaded:
+                    # more restore hosts than shards: this host starts from defaults
+                    folded[key] = ({k: v for k, v in metric.init_state().items()}, 0)
+                    continue
+                states, counts = [], []
+                leaves = None
+                for _idx, payload, entry in loaded:
+                    mmeta = entry["members"][key]
+                    leaves = mmeta["leaves"]
+                    states.append(_decode_member_state(payload, key, leaves))
+                    counts.append(int(mmeta["update_count"]))
+                folded[key] = fold_member_shards(metric, key, states, counts, leaves)
+            step = cand
+            break
+        except _io.CheckpointCorruptError as err:
+            if first_err is None:
+                first_err, fallback_from = err, cand
+            if attempt_i + 1 >= len(candidates):
+                raise  # nothing older verifies: surface the (newest) failure
+            rank_zero_warn(
+                f"checkpoint step {cand} under {root!r} failed verification "
+                f"({type(err).__name__}: {err}); falling back to an older committed step"
+            )
+    if fallback_from is not None:
+        _REGISTRY.counter(
+            "checkpoint_restore_fallbacks_total",
+            "Restores that skipped a corrupt newest step for an older verifiable one.",
+        ).inc()
+        if _otrace.active:
+            _otrace.emit_instant(
+                "checkpoint/restore/fallback", "checkpoint",
+                from_step=int(fallback_from), to_step=int(step),
+                error=f"{type(first_err).__name__}: {str(first_err)[:160]}",
+            )
     t1 = time.perf_counter()
     if _otrace.active:
         _otrace.emit_complete(
@@ -261,6 +311,7 @@ def restore_checkpoint(
         host_index=host_index,
         host_count=host_count,
         timings={"verify_s": t1 - t0, "apply_s": t2 - t1, "total_s": t2 - t0},
+        fallback_from=fallback_from,
     )
 
 

@@ -15,8 +15,10 @@ from metrics_tpu.core.engine import (  # noqa: F401
     compiled_compute_enabled,
     compiled_update_enabled,
     fused_update_enabled,
+    probation_cooldown,
     set_compiled_compute,
     set_compiled_update,
     set_fused_update,
+    set_probation,
 )
 from metrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: F401

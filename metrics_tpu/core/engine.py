@@ -75,6 +75,8 @@ from metrics_tpu.observability import instruments as _instruments
 from metrics_tpu.observability import tracer as _otrace
 from metrics_tpu.observability.shards import dispatch_annotation as _dispatch_annotation
 from metrics_tpu.parallel import sync as _sync
+from metrics_tpu.resilience import chaos as _chaos
+from metrics_tpu.resilience import guard as _guard
 from metrics_tpu.utils.checks import _tracing_active
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -150,6 +152,39 @@ def set_fused_update(enabled: Optional[bool]) -> None:
     _global_fused_enabled = enabled
 
 
+_ENV_PROBATION = "METRICS_TPU_PROBATION_COOLDOWN"
+_DEFAULT_PROBATION_COOLDOWN = 25
+# failed re-probe trials before a migration becomes permanent; with the
+# exponential cooldown this bounds total trial cost at ~2^6 * cooldown calls
+_MAX_PROBATION_TRIALS = 6
+
+_global_probation: Optional[int] = None  # None = follow the environment
+
+
+def probation_cooldown() -> int:
+    """Dispatches a migrated member waits before its first re-probe trial.
+
+    ``0`` disables probation: runtime migrations are permanent (the
+    pre-resilience behavior). Each failed trial doubles the wait, and after
+    ``_MAX_PROBATION_TRIALS`` failures the member stays eager for good.
+    """
+    if _global_probation is not None:
+        return _global_probation
+    try:
+        return max(int(os.environ.get(_ENV_PROBATION, _DEFAULT_PROBATION_COOLDOWN)), 0)
+    except ValueError:
+        return _DEFAULT_PROBATION_COOLDOWN
+
+
+def set_probation(cooldown: Optional[int]) -> None:
+    """Set the probation cooldown (dispatches between a runtime migration and
+    its first re-promotion trial). ``None`` restores the environment default
+    (``METRICS_TPU_PROBATION_COOLDOWN``, 25); ``0`` disables probation so
+    migrations are permanent."""
+    global _global_probation
+    _global_probation = None if cooldown is None else max(int(cooldown), 0)
+
+
 def backend_supports_donation() -> bool:
     """Buffer donation is honored on TPU/GPU and (since jax 0.4.x) XLA:CPU —
     donated inputs are invalidated and their buffers reused in place."""
@@ -185,6 +220,10 @@ class EngineStats:
     # 1-based engine dispatch count at which the permanent eager fallback
     # happened (None = never fell back); pins "which member fell back *when*"
     last_fallback_step: Optional[int] = None
+    # "<ExcType>: <first line, truncated>" of the exception behind the
+    # fallback (None while healthy) — the partition views surface it so a
+    # degraded member names its killer without digging through warnings
+    last_fallback_exception: Optional[str] = None
 
     @property
     def compiled_calls(self) -> int:
@@ -436,6 +475,13 @@ class _EngineBase:
                     break
         fn = donate_fn if donate_ok else plain_fn
         try:
+            if _chaos.active:
+                # inside the try on purpose: an injected fault exercises the
+                # exact fallback/migration path a real trace failure takes
+                _chaos.maybe_fail(
+                    "engine/compile" if count == _WARMUP_CALLS else "engine/dispatch",
+                    owner=self._owner_name(), kind=self._kind,
+                )
             if count == _WARMUP_CALLS:
                 # the first compiled call traces: capture the collective tally
                 # (op counts + approx payload bytes per kind) into the stats.
@@ -474,6 +520,10 @@ class _EngineBase:
             self.stats.fallback_reasons[self._owner_name()] = self._broken
             self.stats.last_fallback_step = (
                 self.stats.eager_calls + self.stats.compiled_calls + 1
+            )
+            msg = str(err).splitlines()[0][:160] if str(err) else ""
+            self.stats.last_fallback_exception = (
+                f"{type(err).__name__}: {msg}" if msg else type(err).__name__
             )
             if _otrace.active:
                 _otrace.emit_instant(
@@ -965,6 +1015,8 @@ class PartitionStats:
     repartitions: int = 0  # rebuilds caused by a changed partition key
     migrations: int = 0  # members moved to the eager set by a runtime fallback
     stable_hits: int = 0  # dispatches served by the cached partition
+    probations: int = 0  # migrations granted a bounded re-probe schedule
+    repromotions: int = 0  # probation trials that returned member(s) to fused
 
 
 @dataclass(frozen=True)
@@ -1029,6 +1081,16 @@ class CollectionDispatcher:
         # "<kind>:<Owner>" — keeps the cause visible in engine_stats() after
         # the broken engine is replaced by its subset successor
         self._retired_reasons: Dict[str, str] = {}
+        # probation ledger: a migrated leader gets bounded re-probe trials
+        # instead of a permanent eager sentence (docs/resilience.md).
+        # (kind, leader) -> {"failures", "next_retry" (dispatch# | None),
+        # "reason"}; next_retry None = trial in flight or probation exhausted
+        self._probation: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._reprobing: Dict[str, set] = {"update": set(), "compute": set()}
+        self._dispatch_count = 0
+        # last_fallback_exception of the most recently retired engine, so the
+        # partition view names the killer after the engine itself is replaced
+        self._last_fallback_exception: Optional[str] = None
         # partition counters show up in observability snapshots as
         # metrics_tpu_partition_*{owner=...}
         _instruments.register_dispatcher(self)
@@ -1136,12 +1198,35 @@ class CollectionDispatcher:
     # ------------------------------------------------------------------ #
     # runtime migration — one member trips, the rest keep the fused path
     # ------------------------------------------------------------------ #
-    def _migrate(self, kind: str, culprits: Dict[str, str], engine: Any) -> CollectionPartition:
+    def _migrate(self, kind: str, culprits: Dict[str, str], engine: Any,
+                 transient: bool) -> CollectionPartition:
         migrated = self._migrated_update if kind == "update" else self._migrated_compute
         migrated.update(culprits)
         self.stats.migrations += len(culprits)
         for owner, why in engine.stats.fallback_reasons.items():
             self._retired_reasons.setdefault(f"{kind}:{owner}", why)
+        if engine.stats.last_fallback_exception is not None:
+            self._last_fallback_exception = engine.stats.last_fallback_exception
+        cooldown = probation_cooldown()
+        for lname, why in culprits.items():
+            self._reprobing[kind].discard(lname)  # a failed trial re-migrates
+            entry = self._probation.setdefault(
+                (kind, lname), {"failures": 0, "next_retry": None, "reason": why}
+            )
+            entry["failures"] += 1
+            entry["reason"] = why
+            if transient and cooldown > 0 and entry["failures"] <= _MAX_PROBATION_TRIALS:
+                # exponential cooldown: every failed trial doubles the wait
+                entry["next_retry"] = (
+                    self._dispatch_count + cooldown * (2 ** (entry["failures"] - 1))
+                )
+                self.stats.probations += 1
+            else:
+                # probation off/exhausted — or the abstract-eval probe itself
+                # attributed the culprit, meaning the member deterministically
+                # cannot trace: a re-probe would recompile only to fail the
+                # same way, so the demotion is permanent
+                entry["next_retry"] = None
         if _otrace.active:
             _otrace.emit_instant(
                 "partition/migrate", "partition",
@@ -1170,10 +1255,15 @@ class CollectionDispatcher:
                 )
             except Exception as err:
                 culprits[lname] = f"{type(err).__name__}: {err}".splitlines()[0][:200]
-        if not culprits:
-            broken = (engine.broken or "trace failure").splitlines()[0][:200]
-            culprits = {lname: broken for lname in part.update_fused}
-        return self._migrate("update", culprits, engine)
+        if culprits:
+            # the probe itself names the culprit(s): a deterministic trace
+            # failure — permanent demotion, no probation trials
+            return self._migrate("update", culprits, engine, transient=False)
+        # probe passes for every member: the failure was a runtime one
+        # (transient I/O, injected fault, ...) — eligible for re-probation
+        broken = (engine.broken or "trace failure").splitlines()[0][:200]
+        culprits = {lname: broken for lname in part.update_fused}
+        return self._migrate("update", culprits, engine, transient=True)
 
     def _migrate_compute(self, engine: CollectionComputeEngine) -> CollectionPartition:
         """Symmetric probe for the fused compute engine: a group migrates when
@@ -1195,23 +1285,70 @@ class CollectionDispatcher:
                         f"{name}: {type(err).__name__}: {err}".splitlines()[0][:200]
                     )
                     break
-        if not culprits:
-            broken = (engine.broken or "trace failure").splitlines()[0][:200]
-            culprits = {lname: broken for lname in part.compute_fused}
-        return self._migrate("compute", culprits, engine)
+        if culprits:
+            return self._migrate("compute", culprits, engine, transient=False)
+        broken = (engine.broken or "trace failure").splitlines()[0][:200]
+        culprits = {lname: broken for lname in part.compute_fused}
+        return self._migrate("compute", culprits, engine, transient=True)
+
+    # ------------------------------------------------------------------ #
+    # probation — bounded re-probe instead of a permanent eager sentence
+    # ------------------------------------------------------------------ #
+    def _tick_probation(self, kind: str) -> None:
+        """Advance the dispatch clock and return due probationers to their
+        original path for one trial: the migrated entry is removed, which
+        re-keys the partition so the member rejoins its fused set on the next
+        ``_ensure_partition``. A compiled fused dispatch then re-promotes for
+        good (:meth:`_confirm_repromotions`); another fallback re-migrates
+        with a doubled cooldown (:meth:`_migrate`)."""
+        self._dispatch_count += 1
+        if not self._probation:
+            return
+        migrated = self._migrated_update if kind == "update" else self._migrated_compute
+        for (k, lname), entry in self._probation.items():
+            if (
+                k == kind
+                and entry["next_retry"] is not None
+                and self._dispatch_count >= entry["next_retry"]
+                and lname in migrated
+            ):
+                del migrated[lname]  # key change -> rebuild rejoins the member
+                entry["next_retry"] = None  # trial in flight
+                self._reprobing[kind].add(lname)
+
+    def _confirm_repromotions(self, kind: str, fused: Tuple[str, ...]) -> None:
+        """A compiled fused dispatch just succeeded: probationers in the fused
+        set survived their trial — clear their records for good."""
+        promoted = sorted(l for l in self._reprobing[kind] if l in fused)
+        if not promoted:
+            return
+        for lname in promoted:
+            self._reprobing[kind].discard(lname)
+            self._probation.pop((kind, lname), None)
+        self.stats.repromotions += len(promoted)
+        if _otrace.active:
+            _otrace.emit_instant(
+                "partition/repromote", "partition",
+                owner=type(self.collection).__name__, kind=kind,
+                members=promoted,
+            )
 
     # ------------------------------------------------------------------ #
     # dispatch
     # ------------------------------------------------------------------ #
     def update(self, args: Tuple, kwargs: Dict) -> None:
         coll = self.collection
+        self._tick_probation("update")
         part = self._ensure_partition()
         handled_fused = False
         if part.update_fused:
             engine = self._ensure_update_engine(part)
             if engine.eligible(args, kwargs):
                 handled_fused = engine.dispatch(args, kwargs)
-                if not handled_fused and engine.broken is not None:
+                if handled_fused:
+                    if self._reprobing["update"]:
+                        self._confirm_repromotions("update", part.update_fused)
+                elif engine.broken is not None:
                     part = self._migrate_update(engine, args, kwargs)
         if handled_fused:
             rest = part.update_rest
@@ -1229,6 +1366,7 @@ class CollectionDispatcher:
         the caller flattens. Members are already whole (the collection
         realiases before dispatching here)."""
         coll = self.collection
+        self._tick_probation("compute")
         part = self._ensure_partition()
         values = None
         if part.compute_fused:
@@ -1237,6 +1375,8 @@ class CollectionDispatcher:
                 handled, vals = engine.dispatch()
                 if handled:
                     values = vals
+                    if self._reprobing["compute"]:
+                        self._confirm_repromotions("compute", part.compute_fused)
                 elif engine.broken is not None:
                     part = self._migrate_compute(engine)
         from metrics_tpu.utils.data import _squeeze_if_scalar
@@ -1260,6 +1400,8 @@ class CollectionDispatcher:
                     key = coll._set_name(name)
                     if key in eager_res:
                         res[key] = eager_res[key]
+        if _guard.active:
+            _guard.inspect(type(coll).__name__, "compute", res)
         return res
 
     # ------------------------------------------------------------------ #
@@ -1282,6 +1424,17 @@ class CollectionDispatcher:
             "repartitions": self.stats.repartitions,
             "migrations": self.stats.migrations,
             "stable_hits": self.stats.stable_hits,
+            "probations": self.stats.probations,
+            "repromotions": self.stats.repromotions,
+            "probation": {
+                f"{kind}:{lname}": {
+                    "failures": entry["failures"],
+                    "next_retry": entry["next_retry"],
+                    "reason": entry["reason"],
+                }
+                for (kind, lname), entry in self._probation.items()
+            },
+            "last_fallback_exception": self._last_fallback_exception,
         }
 
 
@@ -1297,6 +1450,8 @@ def collection_partition_view(coll: Any) -> Dict[str, Any]:
         "update": u_members,
         "compute": c_members,
         "builds": 0, "repartitions": 0, "migrations": 0, "stable_hits": 0,
+        "probations": 0, "repromotions": 0,
+        "probation": {}, "last_fallback_exception": None,
     }
 
 
@@ -1304,17 +1459,21 @@ def metric_partition_view(metric: Any) -> Dict[str, Any]:
     """Single-metric ``engine_stats()["partition"]``: which path each dispatch
     kind takes (static classification, overridden by a recorded runtime
     fallback on the metric's own engines)."""
+    last_exc = None
     u_path, u_reason = classify_update_member(metric)
     engine = getattr(metric, "_update_engine", None)
     if engine is not None and engine.broken is not None:
         u_path = PATH_EAGER
         u_reason = f"runtime fallback: {engine.broken.splitlines()[0][:200]}"
+        last_exc = engine.stats.last_fallback_exception
     c_path, c_reason = classify_compute_member(metric)
     engine = getattr(metric, "_compute_engine", None)
     if engine is not None and engine.broken is not None:
         c_path = PATH_EAGER
         c_reason = f"runtime fallback: {engine.broken.splitlines()[0][:200]}"
+        last_exc = engine.stats.last_fallback_exception or last_exc
     return {
         "update": {"path": u_path, "reason": u_reason},
         "compute": {"path": c_path, "reason": c_reason},
+        "last_fallback_exception": last_exc,
     }

@@ -40,6 +40,8 @@ from metrics_tpu.observability import instruments as _instruments
 from metrics_tpu.observability import tracer as _otrace
 from metrics_tpu.parallel import mesh as _meshlib
 from metrics_tpu.parallel import sync as _sync
+from metrics_tpu.resilience import guard as _guard
+from metrics_tpu.utils.checks import _tracing_active
 from metrics_tpu.utils.data import (
     _flatten,
     _squeeze_if_scalar,
@@ -755,9 +757,20 @@ class Metric:
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
             self._computed = None
             self._update_count += 1
+            # opt-in non-finite guard: snapshotting prev state holds extra leaf
+            # refs (suppressing donation), which is the documented cost of
+            # arming the guard; the disabled path is the one flag read
+            guard_on = _guard.active and not _tracing_active()
+            prev = self.get_state() if guard_on else None
             engine = self._maybe_engine()
             if engine is None or not engine.dispatch(args, kwargs):
                 update(*args, **kwargs)
+            if guard_on and _guard.inspect(
+                type(self).__name__, "update", self.get_state()
+            ):
+                # quarantine: drop the poisoned batch wholesale
+                self.set_state(prev)
+                self._update_count -= 1
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
 
@@ -832,6 +845,8 @@ class Metric:
             return
         self._cache = self.get_state()
         self._sync_dist(dist_sync_fn or self.dist_sync_fn, process_group=process_group)
+        if _guard.active and not _tracing_active():
+            _guard.inspect(type(self).__name__, "sync", self.get_state())
         self._is_synced = True
 
     def unsync(self, should_unsync: bool = True) -> None:
@@ -925,12 +940,16 @@ class Metric:
                     handled, value = engine.dispatch()
                     if handled:
                         self._computed = _squeeze_if_scalar(value)
+                        if _guard.active and not _tracing_active():
+                            _guard.inspect(type(self).__name__, "compute", self._computed)
                         return self._computed
             with self.sync_context(
                 dist_sync_fn=self.dist_sync_fn, should_sync=self._to_sync, should_unsync=self._should_unsync
             ):
                 value = compute(*args, **kwargs)
                 self._computed = _squeeze_if_scalar(value)
+            if _guard.active and not _tracing_active():
+                _guard.inspect(type(self).__name__, "compute", self._computed)
             return self._computed
 
         self._compute = compute  # unwrapped, used by the pure protocol

@@ -266,6 +266,8 @@ class InstrumentRegistry:
                 ("repartitions", "Partition rebuilds caused by a changed key."),
                 ("migrations", "Members migrated to the eager set by a runtime fallback."),
                 ("stable_hits", "Dispatches served by the cached partition."),
+                ("probations", "Migrations granted a bounded re-probe schedule."),
+                ("repromotions", "Probation trials that returned member(s) to the fused set."),
             ):
                 yield Sample(
                     f"{PREFIX}partition_{fname}", dict(labels),

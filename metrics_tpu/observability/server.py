@@ -75,6 +75,12 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         t0 = time.perf_counter()
         try:
+            # imported lazily: observability.__init__ pulls this module in, so
+            # a top-level resilience import would be circular
+            from metrics_tpu.resilience import chaos as _chaos
+
+            if _chaos.active:
+                _chaos.maybe_fail("server/scrape", path=path)
             handler = {
                 "/metrics": self._get_metrics,
                 "/stats.json": self._get_stats,

@@ -80,6 +80,7 @@ EVENT_CATALOG: Dict[str, Tuple[str, ...]] = {
         "partition/build",  # first classification into {fused,bucketed,eager}
         "partition/rebuild",  # partition key changed: flags/placement re-keyed
         "partition/migrate",  # runtime fallback moved member(s) to the eager set
+        "partition/repromote",  # probation trial succeeded: member(s) rejoined fused
     ),
     "sync": (
         "sync/bucket_build",  # one bucketed sync build (args: collective tallies)
@@ -96,6 +97,14 @@ EVENT_CATALOG: Dict[str, Tuple[str, ...]] = {
         "checkpoint/save/commit",  # manifest + COMMIT + atomic rename
         "checkpoint/restore/verify",  # manifest/checksum/fingerprint checks
         "checkpoint/restore/apply",  # folded state applied to the live object
+        "checkpoint/restore/fallback",  # newest step corrupt: older verifiable step used
+        "ckpt/retry",  # one storage-op retry scheduled (or giveup) by RetryPolicy
+    ),
+    "chaos": (
+        "chaos/fault",  # the fault-injection harness fired a scheduled fault
+    ),
+    "guard": (
+        "guard/nonfinite",  # non-finite state detected at a guarded boundary
     ),
 }
 

@@ -33,6 +33,7 @@ import numpy as np
 from jax import Array, lax
 
 from metrics_tpu.observability import tracer as _otrace
+from metrics_tpu.resilience import chaos as _chaos
 
 AxisNames = Union[str, Tuple[str, ...]]
 
@@ -436,6 +437,10 @@ def _sync_state_impl(
     bucketed: Optional[bool],
     shard_axes: Optional[Dict[str, int]],
 ) -> Dict[str, Any]:
+    if _chaos.active:
+        # bucket builds run at trace time, so an injected fault here surfaces
+        # exactly where a real layout bug would: inside the traced sync
+        _chaos.maybe_fail("sync/bucket_build", leaves=len(state))
     if bucketed is None:
         bucketed = bucketed_sync_enabled()
     shard_axes = shard_axes or {}

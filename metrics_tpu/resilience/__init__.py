@@ -1,0 +1,48 @@
+"""metrics_tpu.resilience: deterministic chaos, bounded retries, degradation.
+
+Three pieces, one theme — the stack keeps producing *correct* numbers while
+the world misbehaves:
+
+* :mod:`~metrics_tpu.resilience.chaos` — a seeded fault-injection harness.
+  Fault points at the failure-prone seams (engine compile/dispatch, sync
+  bucket build, checkpoint I/O phases, storage-backend ops, scrape server)
+  replay a reproducible fault schedule so tests can assert the final
+  ``compute()`` is bitwise-equal to the fault-free run.
+* :mod:`~metrics_tpu.resilience.retry` — :class:`RetryPolicy` /
+  :func:`call_with_retry`: bounded retries with exponential backoff, seeded
+  jitter, per-op timeouts, and transient-vs-fatal classification. Wraps
+  every op of the pluggable checkpoint storage backends
+  (:mod:`metrics_tpu.checkpoint.storage`).
+* :mod:`~metrics_tpu.resilience.guard` — opt-in non-finite state guard at
+  the update/sync/compute boundaries with raise/warn/quarantine policies.
+
+Graceful-degradation behaviors live at their seams: dispatcher probation and
+re-promotion in :mod:`metrics_tpu.core.engine`, restore's
+fallback-to-latest-verifiable-step in :mod:`metrics_tpu.checkpoint.restore`.
+See ``docs/resilience.md`` for the full story.
+"""
+from metrics_tpu.resilience import chaos, guard, retry  # noqa: F401
+from metrics_tpu.resilience.chaos import (  # noqa: F401
+    ChaosError,
+    FaultPlan,
+    FaultSpec,
+    KNOWN_SITES,
+)
+from metrics_tpu.resilience.guard import NonFiniteStateError, guarded, set_guard  # noqa: F401
+from metrics_tpu.resilience.retry import RetryPolicy, call_with_retry, default_classify  # noqa: F401
+
+__all__ = [
+    "chaos",
+    "retry",
+    "guard",
+    "ChaosError",
+    "FaultPlan",
+    "FaultSpec",
+    "KNOWN_SITES",
+    "RetryPolicy",
+    "call_with_retry",
+    "default_classify",
+    "NonFiniteStateError",
+    "set_guard",
+    "guarded",
+]

@@ -1,0 +1,156 @@
+"""Opt-in non-finite state guard at the update/sync/compute boundaries.
+
+A NaN that slips into accumulated metric state is the quietest failure in the
+stack: every later ``compute()`` is poisoned, and by the time a dashboard
+shows ``nan`` the offending batch is long gone. This guard checks state for
+non-finite values at the facade boundaries — after each eager-visible
+``update()``, after ``sync()``, and on the ``compute()`` result — under one
+of three policies:
+
+* ``"raise"`` — raise :class:`NonFiniteStateError` naming the bad leaves;
+* ``"warn"`` — ``rank_zero_warn`` + count, state untouched;
+* ``"quarantine"`` — at the **update** boundary, roll the state back to its
+  pre-update snapshot (the poisoned batch is dropped and counted); at the
+  sync/compute boundaries, where there is no batch to drop, behaves as
+  ``"warn"``.
+
+Off by default and **opt-in for a reason**: checking finiteness forces the
+device values to the host, which defeats the async-dispatch pipelining the
+compiled engines exist for. The disabled path follows the tracer-off
+discipline — hot sites read the module-level :data:`active` boolean and do
+nothing else. Compiled *fused collection* streak interiors are not checked
+(member state is intentionally stale there); the guard sees state at the
+eager-visible boundaries only.
+
+Every trip increments ``metrics_tpu_guard_nonfinite_total{owner,where,policy}``
+and emits a ``guard/nonfinite`` tracer instant.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from metrics_tpu.observability import tracer as _otrace
+from metrics_tpu.observability.instruments import REGISTRY as _REGISTRY
+from metrics_tpu.utils.exceptions import MetricsUserError
+from metrics_tpu.utils.prints import rank_zero_warn
+
+POLICIES = ("raise", "warn", "quarantine")
+
+_ENV_FLAG = "METRICS_TPU_GUARD"  # set to a policy name to arm at import
+
+
+class NonFiniteStateError(MetricsUserError):
+    """Non-finite values crossed a guarded boundary under policy='raise'."""
+
+    def __init__(self, owner: str, where: str, leaves: List[str]) -> None:
+        super().__init__(
+            f"non-finite values in {owner} at the {where} boundary: "
+            f"{', '.join(leaves)} (guard policy 'raise'; see docs/resilience.md)"
+        )
+        self.owner = owner
+        self.where = where
+        self.leaves = leaves
+
+
+active: bool = False
+_policy: str = "warn"
+_lock = threading.Lock()
+
+
+def guard_policy() -> Optional[str]:
+    """The armed policy, or ``None`` while the guard is off."""
+    return _policy if active else None
+
+
+def set_guard(policy: Optional[str]) -> None:
+    """Arm the guard with a policy, or disarm with ``None``."""
+    global active, _policy
+    if policy is not None and policy not in POLICIES:
+        raise ValueError(f"unknown guard policy {policy!r}; expected one of {POLICIES}")
+    with _lock:
+        if policy is None:
+            active = False
+        else:
+            _policy = policy
+            active = True
+
+
+@contextlib.contextmanager
+def guarded(policy: str = "warn"):
+    """Arm the guard for the block; restores the prior state on exit."""
+    prev = guard_policy()
+    set_guard(policy)
+    try:
+        yield
+    finally:
+        set_guard(prev)
+
+
+def nonfinite_leaves(tree: Any, prefix: str = "") -> List[str]:
+    """Names of float leaves in ``tree`` holding any non-finite value.
+
+    Walks the value as a jax pytree (so registered containers like CatBuffer
+    contribute their array leaves); non-float and non-array leaves are
+    skipped. Forces a host readback — callers gate on :data:`active`.
+    """
+    import jax
+
+    bad: List[str] = []
+    if isinstance(tree, dict):
+        for name, val in tree.items():
+            bad.extend(nonfinite_leaves(val, f"{prefix}{name}"))
+        return bad
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+        try:
+            arr = np.asarray(leaf)
+        except (TypeError, ValueError):
+            continue
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        if not np.isfinite(arr).all():
+            bad.append(prefix if prefix else f"leaf[{i}]")
+    return bad
+
+
+def inspect(owner: str, where: str, tree: Any) -> bool:
+    """Check ``tree`` at a boundary; returns True when the caller should roll
+    back (quarantine at the update boundary). Callers gate on :data:`active`.
+    """
+    bad = nonfinite_leaves(tree)
+    if not bad:
+        return False
+    pol = _policy
+    _REGISTRY.counter(
+        "guard_nonfinite_total",
+        "Non-finite state detections at guarded boundaries.",
+        owner=owner, where=where, policy=pol,
+    ).inc()
+    if _otrace.active:
+        _otrace.emit_instant(
+            "guard/nonfinite", "guard", owner=owner, where=where,
+            policy=pol, leaves=list(bad),
+        )
+    if pol == "raise":
+        raise NonFiniteStateError(owner, where, bad)
+    quarantined = pol == "quarantine" and where == "update"
+    rank_zero_warn(
+        f"metrics_tpu guard: non-finite values in {owner} at the {where} "
+        f"boundary ({', '.join(bad)}); "
+        + ("update quarantined (state rolled back)." if quarantined
+           else f"policy={pol!r}, state left as-is.")
+    )
+    return quarantined
+
+
+def _env_autostart() -> None:
+    val = os.environ.get(_ENV_FLAG, "").strip().lower()
+    if val in POLICIES:
+        set_guard(val)
+
+
+_env_autostart()

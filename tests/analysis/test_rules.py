@@ -509,3 +509,147 @@ class TestAuditMode:
         )
         findings = _lint(ClockReadMetric)
         assert _active_rules(findings) == ["A007"]
+
+
+# --------------------------------------------------------------------------- #
+# A008 — over-broad exception handlers (jit-facing methods + audit mode)
+# --------------------------------------------------------------------------- #
+class SwallowingMetric(Metric):
+    """A008: ``except Exception: pass`` in a jit-facing method swallows the
+    trace failures the engine fallback and chaos harness depend on."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, values):
+        try:
+            self.total = self.total + values.sum()
+        except Exception:
+            pass
+
+    def compute(self):
+        return self.total
+
+
+class ReRaisingMetric(Metric):
+    """Clean: a broad handler that re-raises is a legitimate cleanup shape."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, values):
+        try:
+            self.total = self.total + values.sum()
+        except Exception:
+            self.total = self.total
+            raise
+
+    def compute(self):
+        return self.total
+
+
+class NarrowHandlerMetric(Metric):
+    """Clean: catching specific exception types is exactly the fix A008 asks
+    for."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, values):
+        try:
+            self.total = self.total + values.sum()
+        except (TypeError, ValueError):
+            self.total = self.total
+
+    def compute(self):
+        return self.total
+
+
+class SuppressedSwallowingMetric(Metric):
+    """A008 present but inline-suppressed."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, values):
+        try:
+            self.total = self.total + values.sum()
+        except Exception:  # metrics-tpu: allow[A008]
+            pass
+
+    def compute(self):
+        return self.total
+
+
+_EXCEPTY_SOURCE = '''
+def swallows_everything():
+    try:
+        risky()
+    except:
+        pass
+
+def swallows_base():
+    try:
+        risky()
+    except BaseException:
+        cleanup()
+
+def reraises():
+    try:
+        risky()
+    except BaseException:
+        cleanup()
+        raise
+
+def plain_exception_is_file_wide_tolerated():
+    try:
+        risky()
+    except Exception:
+        pass
+
+def suppressed():
+    try:
+        risky()
+    except:  # metrics-tpu: allow[A008]
+        pass
+'''
+
+
+class TestA008:
+    def test_swallowing_update_is_flagged(self):
+        findings = _lint(SwallowingMetric)
+        assert _active_rules(findings) == ["A008"]
+        f = next(f for f in findings if f.rule == "A008")
+        assert f.obj.startswith("SwallowingMetric")
+        assert f.file and f.file.endswith("test_rules.py") and f.line
+
+    def test_reraising_and_narrow_handlers_are_clean(self):
+        assert "A008" not in _active_rules(_lint(ReRaisingMetric))
+        assert "A008" not in _active_rules(_lint(NarrowHandlerMetric))
+
+    def test_inline_allow_suppresses_but_reports(self):
+        findings = _lint(SuppressedSwallowingMetric)
+        assert [f.rule for f in findings] == ["A008"]
+        assert findings[0].suppressed
+        assert _active_rules(findings) == []
+
+    def test_audit_flags_bare_and_baseexception_only(self):
+        findings = ast_stage.lint_source("somefile.py", _EXCEPTY_SOURCE, set())
+        a008 = [f for f in findings if f.rule == "A008"]
+        # bare + BaseException without re-raise + the suppressed bare one;
+        # the re-raising handler and the plain `except Exception` are not
+        # audit findings (Exception breadth is only an error in jit-facing
+        # metric methods)
+        assert len(a008) == 3
+        active = [f for f in a008 if not f.suppressed]
+        assert len(active) == 2
+        messages = " | ".join(f.message for f in active)
+        assert "bare" in messages
+        assert "BaseException" in messages
+
+    def test_a008_is_an_error_severity_rule(self):
+        assert RULES["A008"].severity == ERROR

@@ -1,0 +1,20 @@
+"""Resilience suite hygiene: the chaos plan, the non-finite guard, the
+probation cooldown, and the storage/retry selections are process-global —
+every test leaves them exactly as it found them (harness disarmed, guard
+off, env-default cooldown, LocalStorage + default RetryPolicy)."""
+import pytest
+
+from metrics_tpu.checkpoint import storage as _storage
+from metrics_tpu.core.engine import set_probation
+from metrics_tpu.resilience import chaos as _chaos
+from metrics_tpu.resilience import guard as _guard
+
+
+@pytest.fixture(autouse=True)
+def _pristine_resilience_globals():
+    yield
+    _chaos.uninstall()
+    _guard.set_guard(None)
+    set_probation(None)
+    _storage.set_storage(None)
+    _storage.set_retry_policy(None)

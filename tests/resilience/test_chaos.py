@@ -1,0 +1,176 @@
+"""The deterministic fault harness itself: scheduling, replay, site matching,
+the three fault kinds, and the wired fault points (sync bucket build, scrape
+server)."""
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu.resilience import ChaosError, FaultSpec, KNOWN_SITES
+from metrics_tpu.resilience import chaos
+
+pytestmark = pytest.mark.chaos
+
+
+class TestScheduling:
+    def test_nth_fires_exactly_once(self):
+        with chaos.plan([FaultSpec("x/site", nth=3)]) as p:
+            for i in range(1, 6):
+                if i == 3:
+                    with pytest.raises(ChaosError):
+                        chaos.maybe_fail("x/site")
+                else:
+                    chaos.maybe_fail("x/site")
+        assert p.fired("x/site") == 1
+        assert [e.call for e in p.log] == [3]
+
+    def test_every_with_times_cap(self):
+        fired = []
+        with chaos.plan([FaultSpec("x/site", every=2, times=2)]):
+            for i in range(1, 9):
+                try:
+                    chaos.maybe_fail("x/site")
+                except ChaosError:
+                    fired.append(i)
+        assert fired == [2, 4]
+
+    def test_default_schedule_is_every_call(self):
+        with chaos.plan([FaultSpec("x/site")]) as p:
+            for _ in range(3):
+                with pytest.raises(ChaosError):
+                    chaos.maybe_fail("x/site")
+        assert p.fired() == 3
+
+    def test_probability_schedule_replays_bitwise(self):
+        def run(seed):
+            hits = []
+            with chaos.plan([FaultSpec("x/site", probability=0.5)], seed=seed):
+                for i in range(64):
+                    try:
+                        chaos.maybe_fail("x/site")
+                    except ChaosError:
+                        hits.append(i)
+            return hits
+
+        assert run(7) == run(7)
+        assert run(7) != run(8)
+
+    def test_two_specs_draw_independent_streams(self):
+        def hits(plan_, site):
+            return [e.call for e in plan_.log if e.site == site]
+
+        with chaos.plan(
+            [FaultSpec("a/site", probability=0.5), FaultSpec("b/site", probability=0.5)],
+            seed=3,
+        ) as p:
+            for _ in range(64):
+                for site in ("a/site", "b/site"):
+                    try:
+                        chaos.maybe_fail(site)
+                    except ChaosError:
+                        pass
+        assert hits(p, "a/site") != hits(p, "b/site")
+
+    def test_wildcard_site_matching(self):
+        spec = FaultSpec("storage/*")
+        assert spec.matches("storage/write") and spec.matches("storage/read")
+        assert not spec.matches("ckpt/write")
+        with chaos.plan([FaultSpec("storage/*", nth=1)]):
+            with pytest.raises(ChaosError):
+                chaos.maybe_fail("storage/read")
+            chaos.maybe_fail("storage/write")  # per-spec counter already past nth
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            FaultSpec("x", kind="explode")
+        with pytest.raises(ValueError):
+            FaultSpec("x", nth=2, every=3)
+        with pytest.raises(ValueError):
+            FaultSpec("x", probability=1.5)
+        with pytest.raises(ValueError):
+            FaultSpec("x", kind="partial_write", fraction=1.0)
+
+
+class TestFaultKinds:
+    def test_partial_write_fraction(self):
+        with chaos.plan(
+            [FaultSpec("ckpt/write", kind="partial_write", nth=2, fraction=0.25)]
+        ) as p:
+            assert chaos.partial_write_fraction("ckpt/write") is None
+            assert chaos.partial_write_fraction("ckpt/write") == 0.25
+            assert chaos.partial_write_fraction("ckpt/write") is None
+        assert p.fired() == 1
+
+    def test_latency_kind_sleeps_then_proceeds(self):
+        with chaos.plan([FaultSpec("x/site", kind="latency", latency_s=0.02, nth=1)]) as p:
+            t0 = time.perf_counter()
+            chaos.maybe_fail("x/site")  # sleeps, must NOT raise
+            assert time.perf_counter() - t0 >= 0.015
+        assert p.fired() == 1
+
+    def test_transient_flag_rides_the_error(self):
+        with chaos.plan([FaultSpec("x/site", transient=False, message="boom")]):
+            with pytest.raises(ChaosError) as exc:
+                chaos.maybe_fail("x/site")
+        assert exc.value.transient is False
+        assert "boom" in str(exc.value)
+
+
+class TestLifecycle:
+    def test_disabled_path_is_inert(self):
+        assert chaos.active is False
+        chaos.maybe_fail("x/site")  # no plan armed: a no-op
+        assert chaos.partial_write_fraction("x/site") is None
+
+    def test_plan_context_always_disarms(self):
+        with pytest.raises(RuntimeError, match="body blew up"):
+            with chaos.plan([FaultSpec("x/site", nth=10**9)]):
+                assert chaos.active and chaos.current_plan() is not None
+                raise RuntimeError("body blew up")
+        assert chaos.active is False and chaos.current_plan() is None
+
+    def test_known_sites_cover_the_documented_seams(self):
+        for site in (
+            "engine/compile", "engine/dispatch", "sync/bucket_build",
+            "ckpt/write", "ckpt/commit", "ckpt/read", "ckpt/manifest",
+            "storage/write", "storage/read", "server/scrape",
+        ):
+            assert site in KNOWN_SITES
+
+
+class TestWiredSites:
+    def test_sync_bucket_build_fault_fires_at_trace_time(self):
+        from metrics_tpu.parallel.sync import sync_state
+
+        devs = jax.local_device_count()
+        x = jnp.ones((devs, 4), jnp.float32)
+
+        def f(v):
+            return sync_state({"total": v}, {"total": "sum"}, "i")["total"]
+
+        with chaos.plan([FaultSpec("sync/bucket_build", nth=1)]) as p:
+            with pytest.raises(ChaosError):
+                jax.pmap(f, axis_name="i")(x)
+        assert p.fired("sync/bucket_build") == 1
+
+    @pytest.mark.network
+    def test_scrape_fault_is_a_500_not_a_crash(self):
+        from metrics_tpu import observability
+
+        observability.enable()
+        try:
+            server = observability.serve(port=0)
+            with chaos.plan([FaultSpec("server/scrape", nth=1)]):
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(server.url + "/healthz", timeout=10)
+                assert exc.value.code == 500
+                # next scrape (the fault was nth=1) succeeds: the server
+                # degraded one response, it did not die
+                with urllib.request.urlopen(server.url + "/healthz", timeout=10) as resp:
+                    assert resp.status == 200
+        finally:
+            observability.shutdown()
+            observability.disable()

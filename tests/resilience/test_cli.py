@@ -1,0 +1,85 @@
+"""The checkpoint CLI's resilience-facing subcommands: ``verify --all``
+(exit non-zero naming the first corrupt step) and ``clean --dry-run``."""
+import os
+
+import jax.numpy as jnp
+
+from metrics_tpu import MeanMetric
+from metrics_tpu.checkpoint import available_steps, save_checkpoint
+from metrics_tpu.checkpoint import io as _io
+from metrics_tpu.checkpoint.__main__ import main as cli_main
+
+
+def _save_steps(root, n=3):
+    m = MeanMetric()
+    for i in range(n):
+        m.update(jnp.asarray(float(i + 1), jnp.float32))
+        save_checkpoint(m, root, world_size=1, shard_index=0)
+    return available_steps(root)
+
+
+def _corrupt(root, step):
+    sdir = _io.step_dir(root, step)
+    npz = next(n for n in os.listdir(sdir) if n.endswith(".npz"))
+    path = os.path.join(sdir, npz)
+    with open(path, "r+b") as fh:
+        data = bytearray(fh.read())
+        data[len(data) // 2] ^= 0xFF
+        fh.seek(0)
+        fh.write(bytes(data))
+
+
+class TestVerifyAll:
+    def test_all_clean_exits_zero(self, tmp_path, capsys):
+        root = str(tmp_path / "ckpt")
+        steps = _save_steps(root)
+        assert cli_main(["verify", root, "--all"]) == 0
+        out = capsys.readouterr().out
+        for step in steps:
+            assert f"step {step}: OK" in out
+
+    def test_corruption_exits_nonzero_naming_first_bad_step(self, tmp_path, capsys):
+        root = str(tmp_path / "ckpt")
+        steps = _save_steps(root)
+        _corrupt(root, steps[1])
+        _corrupt(root, steps[2])
+        assert cli_main(["verify", root, "--all"]) == 1
+        captured = capsys.readouterr()
+        assert f"first corrupt step is {steps[1]}" in captured.err
+        assert "2 of 3 step(s) failed verification" in captured.err
+        assert f"step {steps[0]}: OK" in captured.out
+        assert f"step {steps[1]}: FAIL" in captured.out
+
+    def test_empty_root_exits_nonzero(self, tmp_path, capsys):
+        assert cli_main(["verify", str(tmp_path / "empty"), "--all"]) == 1
+        assert "no committed checkpoint" in capsys.readouterr().err
+
+
+class TestCleanDryRun:
+    def _orphan_pending(self, root):
+        pending = _io.pending_dir(root, 99)
+        os.makedirs(pending)
+        with open(os.path.join(pending, "junk.npz"), "wb") as fh:
+            fh.write(b"aborted save")
+        return pending
+
+    def test_dry_run_lists_without_touching(self, tmp_path, capsys):
+        root = str(tmp_path / "ckpt")
+        _save_steps(root, n=1)
+        pending = self._orphan_pending(root)
+        assert cli_main(["clean", root, "--dry-run"]) == 0
+        out = capsys.readouterr().out
+        assert f"would remove {pending}" in out
+        assert "1 pending dir(s) found" in out
+        assert os.path.isdir(pending), "--dry-run must not delete anything"
+
+    def test_real_clean_reaps_and_spares_committed(self, tmp_path, capsys):
+        root = str(tmp_path / "ckpt")
+        steps = _save_steps(root, n=1)
+        pending = self._orphan_pending(root)
+        assert cli_main(["clean", root]) == 0
+        out = capsys.readouterr().out
+        assert f"removed {pending}" in out
+        assert "1 pending dir(s) reaped" in out
+        assert not os.path.exists(pending)
+        assert available_steps(root) == steps  # committed snapshots untouched

@@ -1,0 +1,158 @@
+"""Graceful degradation: restore falls back to the latest *verifiable* step
+when the newest is corrupt, and the opt-in non-finite guard enforces its
+raise/warn/quarantine policies at the facade boundaries."""
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import MeanMetric, MeanSquaredError, SumMetric
+from metrics_tpu.checkpoint import (
+    CheckpointCorruptError,
+    available_steps,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from metrics_tpu.checkpoint import io as _io
+from metrics_tpu.resilience import NonFiniteStateError, guarded
+from metrics_tpu.resilience import guard as _guard
+
+
+def _corrupt_newest_payload(root):
+    """Flip bytes inside the newest step's npz so its checksum fails."""
+    step = available_steps(root)[-1]
+    sdir = _io.step_dir(root, step)
+    npz = next(n for n in os.listdir(sdir) if n.endswith(".npz"))
+    path = os.path.join(sdir, npz)
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    with open(path, "wb") as fh:
+        fh.write(bytes(data))
+    return step
+
+
+class TestRestoreFallback:
+    def _two_steps(self, tmp_path):
+        root = str(tmp_path / "ckpt")
+        m = MeanMetric()
+        m.update(jnp.asarray(1.0, jnp.float32))
+        save_checkpoint(m, root, world_size=1, shard_index=0)
+        m.update(jnp.asarray(3.0, jnp.float32))
+        save_checkpoint(m, root, world_size=1, shard_index=0)
+        return root
+
+    def test_falls_back_to_latest_verifiable_step(self, tmp_path):
+        root = self._two_steps(tmp_path)
+        bad_step = _corrupt_newest_payload(root)
+        good_step = available_steps(root)[0]
+        fresh = MeanMetric()
+        with pytest.warns(UserWarning, match="fall"):
+            info = restore_checkpoint(fresh, root, host_count=1)
+        assert info.step == good_step
+        assert info.fallback_from == bad_step
+        assert float(np.asarray(fresh.compute())) == 1.0  # the older snapshot
+
+    def test_explicit_step_never_falls_back(self, tmp_path):
+        root = self._two_steps(tmp_path)
+        bad_step = _corrupt_newest_payload(root)
+        with pytest.raises(CheckpointCorruptError):
+            restore_checkpoint(MeanMetric(), root, step=bad_step, host_count=1)
+
+    def test_opt_out_restores_raise_on_first_corruption(self, tmp_path):
+        root = self._two_steps(tmp_path)
+        _corrupt_newest_payload(root)
+        with pytest.raises(CheckpointCorruptError):
+            restore_checkpoint(
+                MeanMetric(), root, host_count=1, fallback_to_verified=False
+            )
+
+    def test_no_fallback_needed_reports_none(self, tmp_path):
+        root = self._two_steps(tmp_path)
+        fresh = MeanMetric()
+        info = restore_checkpoint(fresh, root, host_count=1)
+        assert info.fallback_from is None
+        assert info.step == available_steps(root)[-1]
+        assert float(np.asarray(fresh.compute())) == 2.0
+
+    def test_every_step_corrupt_raises_the_newest_error(self, tmp_path):
+        root = self._two_steps(tmp_path)
+        _corrupt_newest_payload(root)
+        # corrupt the older one too
+        older = available_steps(root)[0]
+        sdir = _io.step_dir(root, older)
+        npz = next(n for n in os.listdir(sdir) if n.endswith(".npz"))
+        with open(os.path.join(sdir, npz), "r+b") as fh:
+            data = bytearray(fh.read())
+            data[len(data) // 2] ^= 0xFF
+            fh.seek(0)
+            fh.write(bytes(data))
+        with pytest.raises(CheckpointCorruptError):
+            with pytest.warns(UserWarning):
+                restore_checkpoint(MeanMetric(), root, host_count=1)
+
+
+def _poisoned(value=jnp.nan):
+    """A batch whose squared error carries ``value`` into MSE state."""
+    return jnp.asarray([value], jnp.float32), jnp.asarray([0.0], jnp.float32)
+
+
+class TestNonFiniteGuard:
+    def test_off_by_default(self):
+        assert _guard.active is False
+        m = MeanSquaredError()
+        m.update(*_poisoned())  # no guard: the nan sails into state
+        assert np.isnan(np.asarray(m.compute()))
+
+    def test_warn_counts_and_keeps_state(self):
+        m = MeanSquaredError()
+        with guarded("warn"):
+            with pytest.warns(UserWarning, match="non-finite"):
+                m.update(*_poisoned())
+        assert np.isnan(np.asarray(m.compute()))  # state deliberately untouched
+
+    def test_raise_policy_raises_at_update(self):
+        m = MeanSquaredError()
+        with guarded("raise"):
+            with pytest.raises(NonFiniteStateError) as exc:
+                m.update(*_poisoned(jnp.inf))
+        assert exc.value.where == "update"
+        assert exc.value.owner == "MeanSquaredError"
+
+    def test_quarantine_rolls_back_the_poisoned_update(self):
+        m = MeanSquaredError()
+        m.update(jnp.asarray([1.0], jnp.float32), jnp.asarray([0.0], jnp.float32))
+        with guarded("quarantine"):
+            with pytest.warns(UserWarning, match="quarantined"):
+                m.update(*_poisoned())
+        # the poisoned batch is dropped: state and count as before
+        assert float(np.asarray(m.compute())) == 1.0
+        m.update(jnp.asarray([3.0], jnp.float32), jnp.asarray([1.0], jnp.float32))
+        assert float(np.asarray(m.compute())) == 2.5
+
+    def test_raise_policy_covers_the_compute_boundary(self):
+        m = SumMetric()
+        m.update(jnp.asarray(1.0, jnp.float32))
+        # poison the state behind the facade so update-boundary checks miss it
+        m.set_state({"value": jnp.asarray(jnp.nan, jnp.float32)})
+        with guarded("raise"):
+            with pytest.raises(NonFiniteStateError) as exc:
+                m.compute()
+        assert exc.value.where == "compute"
+
+    def test_guarded_context_restores_prior_policy(self):
+        assert _guard.guard_policy() is None
+        with guarded("warn"):
+            assert _guard.guard_policy() == "warn"
+            with guarded("raise"):
+                assert _guard.guard_policy() == "raise"
+            assert _guard.guard_policy() == "warn"
+        assert _guard.guard_policy() is None
+
+    def test_nonfinite_leaves_names_the_bad_leaf(self):
+        tree = {
+            "ok": jnp.ones((2,), jnp.float32),
+            "bad": jnp.asarray([1.0, jnp.nan], jnp.float32),
+            "ints": jnp.zeros((2,), jnp.int32),  # non-float leaves are skipped
+        }
+        assert _guard.nonfinite_leaves(tree) == ["bad"]

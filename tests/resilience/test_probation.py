@@ -1,0 +1,169 @@
+"""Dispatcher probation: a runtime migration is a bounded re-probe schedule,
+not a permanent eager sentence. Covers the full lifecycle (migrate -> cooldown
+-> trial -> re-promotion), the cooldown=0 opt-out, and exponential backoff on
+failed trials."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    Accuracy,
+    MetricCollection,
+    Precision,
+    probation_cooldown,
+    set_probation,
+)
+from metrics_tpu.resilience import FaultSpec
+from metrics_tpu.resilience import chaos
+
+pytestmark = [pytest.mark.chaos, pytest.mark.filterwarnings("ignore::UserWarning")]
+
+
+def _build():
+    return MetricCollection(
+        {
+            "acc": Accuracy(num_classes=4, average="micro"),
+            "prec": Precision(num_classes=4, average="macro"),
+        }
+    )
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    logits = jnp.asarray(rng.normal(size=(16, 4)), dtype=jnp.float32)
+    target = jnp.asarray(rng.integers(0, 4, size=(16,)), dtype=jnp.int32)
+    return logits, target
+
+
+def _pv(coll):
+    return coll.engine_stats()["partition"]
+
+
+class TestKnobs:
+    def test_set_probation_overrides_and_restores_env_default(self):
+        default = probation_cooldown()
+        set_probation(7)
+        assert probation_cooldown() == 7
+        set_probation(None)
+        assert probation_cooldown() == default
+
+    def test_env_default(self, monkeypatch):
+        set_probation(None)
+        monkeypatch.setenv("METRICS_TPU_PROBATION_COOLDOWN", "11")
+        assert probation_cooldown() == 11
+
+
+class TestLifecycle:
+    def test_migration_then_cooldown_then_repromotion(self):
+        set_probation(2)
+        logits, target = _batch()
+        coll = _build()
+        migrate_at = promote_at = None
+        # the 3rd compiled steady-state dispatch faults once: fallback,
+        # migration, probation — then the trial dispatch re-promotes
+        with chaos.plan([FaultSpec("engine/dispatch", nth=3, times=1)]):
+            for step in range(1, 40):
+                coll.update(logits, target)
+                pv = _pv(coll)
+                if migrate_at is None and pv["migrations"]:
+                    migrate_at = step
+                    assert pv["probations"] >= 1
+                    assert pv["probation"], "probation ledger must hold the demoted members"
+                if pv["repromotions"]:
+                    promote_at = step
+                    break
+        assert migrate_at is not None, "injected dispatch fault never migrated"
+        assert promote_at is not None, "probation trial never re-promoted"
+        assert promote_at > migrate_at
+        pv = _pv(coll)
+        assert pv["probation"] == {}, "a survived trial clears the ledger for good"
+        assert all(info["path"] == "fused" for info in pv["update"].values())
+        # the faulted run still computes the exact same numbers
+        reference = _build()
+        for _ in range(promote_at):
+            reference.update(logits, target)
+        ours, ref = coll.compute(), reference.compute()
+        assert set(ours) == set(ref)
+        for key in ref:
+            assert np.asarray(ours[key]).tobytes() == np.asarray(ref[key]).tobytes()
+
+    def test_migration_records_last_fallback_exception(self):
+        set_probation(0)
+        logits, target = _batch()
+        coll = _build()
+        with chaos.plan([FaultSpec("engine/dispatch", nth=3, times=1, message="kaboom")]):
+            for _ in range(8):
+                coll.update(logits, target)
+        pv = _pv(coll)
+        assert pv["migrations"] >= 1
+        assert pv["last_fallback_exception"] is not None
+        assert pv["last_fallback_exception"].startswith("ChaosError")
+        assert "kaboom" in pv["last_fallback_exception"]
+
+
+class TestOptOutAndBackoff:
+    def test_cooldown_zero_makes_migration_permanent(self):
+        set_probation(0)
+        logits, target = _batch()
+        coll = _build()
+        with chaos.plan([FaultSpec("engine/dispatch", nth=3, times=1)]):
+            for _ in range(40):
+                coll.update(logits, target)
+        pv = _pv(coll)
+        assert pv["migrations"] >= 1
+        assert pv["probations"] == 0
+        assert pv["repromotions"] == 0
+        assert any(info["path"] == "eager" for info in pv["update"].values())
+
+    def test_deterministic_trace_failure_is_not_reprobed(self):
+        """A member whose update genuinely cannot trace (host readback) is
+        attributed by the post-mortem probe and demoted permanently: no
+        probation trials, no repeated recompiles on the steady-state path."""
+        from metrics_tpu import Metric
+
+        class HostReadback(Metric):
+            full_state_update = False
+
+            def __init__(self, **kwargs):
+                super().__init__(**kwargs)
+                self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+            def update(self, logits, target):
+                self.total = self.total + float(jnp.sum(target))
+
+            def compute(self):
+                return self.total
+
+        set_probation(2)  # short cooldown: trials WOULD fire if scheduled
+        logits, target = _batch()
+        coll = MetricCollection(
+            {"acc": Accuracy(num_classes=4, average="micro"), "host": HostReadback()}
+        )
+        for _ in range(30):
+            coll.update(logits, target)
+        pv = _pv(coll)
+        host_migrations = [
+            e for (_, name), e in coll._dispatcher._probation.items() if name == "host"
+        ]
+        assert pv["update"]["host"]["path"] == "eager"
+        assert pv["update"]["acc"]["path"] == "fused"
+        assert pv["probations"] == 0, "a deterministic culprit must not be re-probed"
+        assert pv["repromotions"] == 0
+        assert all(e["failures"] == 1 for e in host_migrations)
+
+    def test_failed_trials_re_migrate_with_backoff(self):
+        set_probation(1)
+        logits, target = _batch()
+        coll = _build()
+        # EVERY compiled attempt faults (compile probes included): the first
+        # failure migrates, every re-probe trial fails again and re-migrates
+        # with a doubled cooldown until the trial budget is spent
+        with chaos.plan([FaultSpec("engine/*", every=1)]):
+            for _ in range(60):
+                coll.update(logits, target)
+            pv = _pv(coll)
+        assert pv["repromotions"] == 0
+        assert pv["migrations"] >= 2, "a failed trial must count as a fresh migration"
+        entries = list(pv["probation"].values())
+        assert entries
+        assert max(e["failures"] for e in entries) >= 2

@@ -1,0 +1,132 @@
+"""RetryPolicy / call_with_retry semantics: bounded attempts, exponential
+backoff with seeded jitter, per-op timeouts, transient-vs-fatal
+classification, and the retry/giveup counters."""
+import time
+
+import pytest
+
+from metrics_tpu.observability.instruments import REGISTRY
+from metrics_tpu.resilience import ChaosError, RetryPolicy, call_with_retry, default_classify
+
+FAST = RetryPolicy(backoff_base_s=0.0, backoff_max_s=0.0, jitter=0.0, seed=0)
+
+
+class Flaky:
+    """Fails the first ``failures`` calls with ``err``, then returns "ok"."""
+
+    def __init__(self, failures, err=None):
+        self.failures = failures
+        self.calls = 0
+        self.err = err if err is not None else OSError("flaky")
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise self.err
+        return "ok"
+
+
+class TestAttempts:
+    def test_transient_recovers_within_budget(self):
+        fn = Flaky(3)
+        assert call_with_retry(fn, FAST) == "ok"
+        assert fn.calls == 4
+
+    def test_giveup_reraises_last_error(self):
+        fn = Flaky(10)
+        with pytest.raises(OSError, match="flaky"):
+            call_with_retry(fn, FAST)
+        assert fn.calls == FAST.max_attempts
+
+    def test_fatal_short_circuits(self):
+        fn = Flaky(10, err=FileNotFoundError("gone"))
+        with pytest.raises(FileNotFoundError):
+            call_with_retry(fn, FAST)
+        assert fn.calls == 1
+
+    def test_chaos_transient_flag_is_respected(self):
+        ok = Flaky(1, err=ChaosError("x", transient=True))
+        assert call_with_retry(ok, FAST) == "ok"
+        fatal = Flaky(1, err=ChaosError("x", transient=False))
+        with pytest.raises(ChaosError):
+            call_with_retry(fatal, FAST)
+        assert fatal.calls == 1
+
+    def test_custom_classifier_wins(self):
+        pol = RetryPolicy(
+            backoff_base_s=0.0, jitter=0.0,
+            classify=lambda e: isinstance(e, ValueError),
+        )
+        assert call_with_retry(Flaky(1, err=ValueError("transient here")), pol) == "ok"
+        with pytest.raises(OSError):
+            call_with_retry(Flaky(1), pol)  # OSError is fatal under this classifier
+
+    def test_op_timeout_bounds_the_attempt_train(self):
+        pol = RetryPolicy(
+            max_attempts=1000, backoff_base_s=0.02, backoff_multiplier=1.0,
+            jitter=0.0, op_timeout_s=0.06, seed=0,
+        )
+        fn = Flaky(10_000)
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            call_with_retry(fn, pol)
+        assert time.monotonic() - t0 < 2.0
+        assert fn.calls < 10
+
+
+class TestBackoff:
+    def test_exponential_capped_and_jitter_bounded(self):
+        pol = RetryPolicy(
+            backoff_base_s=0.01, backoff_multiplier=2.0, backoff_max_s=0.05,
+            jitter=0.5, seed=42,
+        )
+        rng = pol.rng()
+        for attempt in range(1, 8):
+            bound = min(0.01 * 2.0 ** (attempt - 1), 0.05)
+            delay = pol.backoff_for(attempt, rng)
+            assert bound * 0.5 <= delay <= bound
+
+    def test_seeded_jitter_is_deterministic(self):
+        pol = RetryPolicy(seed=7)
+        r1, r2 = pol.rng(), pol.rng()
+        a = [pol.backoff_for(k, r1) for k in range(1, 6)]
+        b = [pol.backoff_for(k, r2) for k in range(1, 6)]
+        assert a == b
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError):
+            RetryPolicy(jitter=1.5)
+        with pytest.raises(ValueError):
+            RetryPolicy(backoff_multiplier=0.5)
+
+
+class TestClassifyAndCounters:
+    def test_default_classify_table(self):
+        assert default_classify(TimeoutError()) is True
+        assert default_classify(ConnectionError()) is True
+        assert default_classify(OSError()) is True
+        assert default_classify(InterruptedError()) is True
+        assert default_classify(FileNotFoundError()) is False
+        assert default_classify(PermissionError()) is False
+        assert default_classify(NotADirectoryError()) is False
+        assert default_classify(ValueError()) is False
+
+    def test_retry_and_giveup_counters(self):
+        retries = REGISTRY.counter(
+            "checkpoint_retries_total",
+            "Storage-backend ops retried after a transient error, by op.",
+            op="unit",
+        )
+        giveups = REGISTRY.counter(
+            "checkpoint_retry_giveups_total",
+            "Storage-backend ops that exhausted retries (or hit a fatal error), by op.",
+            op="unit",
+        )
+        r0, g0 = retries.value, giveups.value
+        call_with_retry(Flaky(2), FAST, op="unit")
+        assert retries.value == r0 + 2
+        with pytest.raises(OSError):
+            call_with_retry(Flaky(10), FAST, op="unit")
+        assert giveups.value == g0 + 1
