@@ -2256,6 +2256,200 @@ def bench_resilience() -> None:
         sys.exit(1)
 
 
+def bench_tenancy() -> None:
+    """``--tenancy``: the ISSUE-11 multi-tenant engine measured end to end —
+    per-tenant update cost of one TenantSet dispatch vs N independent
+    per-stream dispatches of the same (jitted, shared) fused program at
+    N in {16, 256, 1024}; the ragged-arrival invariants at 1024 capacity / 37
+    active (one cached executable across occupancy churn, zero recompiles for
+    reset/evict/admit); and the tenant-batched sync's collective count, which
+    must not grow with N — recorded into ``BENCH_r16.json`` and judged by the
+    regression watchdog. Host-side CPU bench."""
+    import glob as _glob
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection, TenantSet
+    from metrics_tpu.observability import regress as _regress
+    from metrics_tpu.parallel import sync as _sync
+
+    n_classes, per_tenant_batch, steps = 16, 64, 8
+
+    def build():
+        return MetricCollection(
+            {
+                "acc": Accuracy(num_classes=n_classes, average="micro"),
+                "mse": MeanSquaredError(),
+            }
+        )
+
+    rng = np.random.default_rng(0)
+
+    def batch(n):
+        preds = jnp.asarray(
+            rng.integers(0, n_classes, size=(n, per_tenant_batch)), dtype=jnp.int32
+        )
+        target = jnp.asarray(
+            rng.integers(0, n_classes, size=(n, per_tenant_batch)), dtype=jnp.int32
+        )
+        return preds, target
+
+    # --- per-tenant dispatch cost: one stacked executable vs N dispatches ---
+    sweep = {}
+    for n in (16, 256, 1024):
+        preds, target = batch(n)
+        ids = [f"t{i}" for i in range(n)]
+
+        ts = TenantSet(build(), capacity=n, name=f"bench-{n}")
+        for tid in ids:
+            ts.admit(tid)
+        for _ in range(WARMUP):
+            ts.update(ids, preds, target)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            ts.update(ids, preds, target)
+        jax.block_until_ready(ts.stacked_states)
+        stacked_us = (time.perf_counter() - t0) / steps * 1e6
+
+        # baseline: the best a per-stream loop can do — ONE shared jitted
+        # fused program (no per-stream compile), paying only the Python
+        # dispatch + state bookkeeping per tenant per step
+        ref = build()
+        step_fn = jax.jit(ref.update_state)
+        s0 = ref.init_state(preds[0], target[0])
+        states = [jax.tree_util.tree_map(jnp.array, s0) for _ in range(n)]
+        for i in range(n):  # warm the one executable, touch every state once
+            states[i] = step_fn(states[i], preds[i], target[i])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            for i in range(n):
+                states[i] = step_fn(states[i], preds[i], target[i])
+        jax.block_until_ready(states[-1])
+        loop_us = (time.perf_counter() - t0) / steps * 1e6
+
+        sweep[f"n{n}"] = {
+            "stacked_us_per_step": round(stacked_us, 1),
+            "stacked_us_per_tenant": round(stacked_us / n, 3),
+            "per_stream_loop_us_per_step": round(loop_us, 1),
+            "per_stream_loop_us_per_tenant": round(loop_us / n, 3),
+            "speedup": round(loop_us / stacked_us, 2),
+            "executables": int(ts.stats.compiles),
+        }
+
+    # --- ragged arrival at 1024 capacity / 37 active ------------------------
+    cap, active = 1024, 37
+    ts = TenantSet(build(), capacity=cap, name="bench-ragged")
+    for i in range(cap):
+        ts.admit(f"t{i}")
+    all_ids = ts.tenant_ids()
+    preds, target = batch(active)
+    ts.update(all_ids[:active], preds, target)  # first 37-dispatch compiles
+    compiles_after_first = int(ts.stats.compiles)
+    for off in range(1, 9):  # churn the active subset; same pow2 bucket
+        subset = [all_ids[(off * 101 + j) % cap] for j in range(active)]
+        ts.update(subset, preds, target)
+    ragged_recompiles = int(ts.stats.compiles) - compiles_after_first
+
+    before = int(ts.stats.compiles)
+    ts.reset(all_ids[:5])
+    reset_compiles = int(ts.stats.compiles) - before  # first width-8 reset program
+    ts.evict(all_ids[0])  # warm the width-1 scrub program once
+    ts.admit(all_ids[0])
+    before = int(ts.stats.compiles)
+    ts.reset(all_ids[5:10])
+    ts.evict(all_ids[0])
+    ts.admit("fresh")
+    ts.update(all_ids[1 : active + 1], preds, target)
+    lifecycle_recompiles = int(ts.stats.compiles) - before
+
+    # --- tenant-batched sync: collective count must not grow with N --------
+    def collectives_at(n):
+        s = TenantSet(build(), capacity=n, name=f"sync-{n}")
+        for i in range(n):
+            s.admit(f"t{i}")
+        with _sync.count_collectives() as box:
+            jax.make_jaxpr(
+                lambda st: s.sync_states(st, "data"), axis_env=[("data", 8)]
+            )(s.stacked_states)
+        return box["count"]
+
+    coll_16, coll_1024 = collectives_at(16), collectives_at(1024)
+
+    n256 = sweep["n256"]
+    record = {
+        # headline: per-tenant dispatch speedup at N=256 — the reason the
+        # tenancy subsystem exists
+        "metric": "tenancy_speedup_n256",
+        "value": n256["speedup"],
+        "unit": "x",
+        "extra": {
+            "config": "acc+mse_collection",
+            "num_classes": n_classes,
+            "per_tenant_batch": per_tenant_batch,
+            "steps": steps,
+            "sweep": sweep,
+            "ragged": {
+                "capacity": cap,
+                "active": active,
+                "executables_after_first_dispatch": compiles_after_first,
+                "recompiles_over_8_occupancy_churns": ragged_recompiles,
+                "first_reset_compiles": reset_compiles,
+                "reset_evict_admit_redispatch_recompiles": lifecycle_recompiles,
+                "cache_hits": int(ts.stats.cache_hits),
+            },
+            "sync": {
+                "collectives_n16": coll_16,
+                "collectives_n1024": coll_1024,
+            },
+        },
+    }
+
+    # watchdog self-check: judge this round against the checked-in trajectory
+    rounds = [
+        r for r in _regress.load_rounds(
+            sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json"))))
+        if r.name != "r16"
+    ]
+    rounds.append(_regress.Round("r16", "<this-run>", record))
+    report = _regress.check_trajectory(rounds)
+    record["extra"]["regress"] = {
+        "ok": report.ok,
+        "regression_count": len(report.regressions),
+        "keys_checked": report.keys_checked,
+        "regressions": [r.describe() for r in report.regressions],
+    }
+
+    with open(os.path.join(REPO, "BENCH_r16.json"), "w") as fh:
+        json.dump(record, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(record), flush=True)
+    problems = []
+    if n256["speedup"] < 10.0:
+        problems.append(f"N=256 stacked speedup {n256['speedup']}x < 10x")
+    if ragged_recompiles != 0:
+        problems.append(
+            f"occupancy churn inside the 64-bucket recompiled {ragged_recompiles}x"
+        )
+    if lifecycle_recompiles != 0:
+        problems.append(
+            f"reset/evict/admit cycle recompiled {lifecycle_recompiles}x"
+        )
+    if coll_1024 != coll_16:
+        problems.append(
+            f"sync collectives grew with N: {coll_16} at N=16 vs {coll_1024} at N=1024"
+        )
+    if not report.ok:
+        problems.extend(r.describe() for r in report.regressions)
+    if problems:
+        print("[bench] tenancy round FAILED its gates:", file=sys.stderr)
+        for p in problems:
+            print(f"[bench]   {p}", file=sys.stderr)
+        sys.exit(1)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
@@ -2292,6 +2486,14 @@ def main() -> None:
         "overhead on the fused update, the 3-seed deterministic chaos sweep's "
         "bitwise pass rate, and probation re-promotion latency; record into "
         "BENCH_r15.json",
+    )
+    parser.add_argument(
+        "--tenancy",
+        action="store_true",
+        help="measure TenantSet stacked dispatch vs N independent per-stream "
+        "dispatches at N=16/256/1024, the ragged 1024/37 zero-recompile "
+        "invariants, and tenant-batched sync collective counts; record into "
+        "BENCH_r16.json",
     )
     parser.add_argument(
         "--checkpoint",
@@ -2335,6 +2537,9 @@ def main() -> None:
         return
     if args.resilience:
         bench_resilience()
+        return
+    if args.tenancy:
+        bench_tenancy()
         return
     if args.checkpoint:
         bench_checkpoint()

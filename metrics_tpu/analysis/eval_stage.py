@@ -28,7 +28,11 @@ import jax.numpy as jnp
 
 from metrics_tpu.analysis.registry import Entry
 from metrics_tpu.analysis.rules import Finding
-from metrics_tpu.core.engine import classify_compute_member, classify_update_member
+from metrics_tpu.core.engine import (
+    classify_compute_member,
+    classify_tenant_member,
+    classify_update_member,
+)
 from metrics_tpu.parallel import sync as _sync
 
 AXIS = "data"
@@ -414,6 +418,20 @@ def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Findi
 
     # ---------------------------------------------------------- sharded leg --
     findings.extend(_evaluate_sharded(entry, inst, state))
+
+    # ----------------------------------------------------------- tenant leg --
+    tpath, treason = classify_tenant_member(inst)
+    if tpath != "tenant_stacked":
+        findings.append(
+            Finding(
+                rule="E110",
+                obj=entry.name,
+                message=f"not tenant-stackable: {treason} — a TenantSet holding this "
+                f"metric runs its compute group as per-tenant eager clones and "
+                f"refuses to checkpoint",
+                extra={"tenant_path": tpath},
+            )
+        )
 
     for f in findings:
         if f.rule in entry.allow:

@@ -179,6 +179,16 @@ _EVAL_RULES = (
         "out up front (compiled_update=False / compiled_compute=False) to "
         "skip the probe cost.",
     ),
+    Rule(
+        "E110", "tenant-unstackable", WARNING,
+        "this metric cannot join a TenantSet's stacked leading-axis state "
+        "(CatBuffer/list state, a non-elementwise dist_reduce_fx, mesh-sharded "
+        "state, or an update/compute that cannot fuse) — a TenantSet holding "
+        "it demotes the member's whole compute group to per-tenant eager "
+        "clones, paying one Python dispatch per active tenant per step "
+        "instead of one vmapped executable, and the set refuses to "
+        "checkpoint.",
+    ),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in (*_AST_RULES, *_EVAL_RULES)}

@@ -226,7 +226,9 @@ def metric_fingerprint(metric: Metric) -> Dict[str, Any]:
 
 
 def object_fingerprint(obj: Any) -> Dict[str, Any]:
-    """Fingerprint of a Metric or MetricCollection (member-keyed)."""
+    """Fingerprint of a Metric, MetricCollection, or TenantSet."""
+    if getattr(obj, "_is_tenant_set", False):
+        return obj.fingerprint()
     kind, members = describe(obj)
     fp: Dict[str, Any] = {
         "format_version": FORMAT_VERSION,
@@ -266,7 +268,13 @@ def build_shard(obj: Any) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
     ``shard_meta`` carries the per-member leaves metadata, update counts, and
     the object fingerprint (identical across shards; the committer refuses a
     shard set whose fingerprints diverge).
+
+    A :class:`~metrics_tpu.tenancy.TenantSet` builds its own shard: the whole
+    stacked pytree as ``tenant/{leader}.{state}`` arrays plus the slot table —
+    one snapshot persists every tenant (kind ``"tenant_set"``).
     """
+    if getattr(obj, "_is_tenant_set", False):
+        return obj._ckpt_payload()
     kind, members = describe(obj)
     payload: Dict[str, np.ndarray] = {}
     members_meta: Dict[str, Any] = {}

@@ -193,6 +193,14 @@ def restore_checkpoint(
         except Exception:
             host_index = 0
 
+    if getattr(obj, "_is_tenant_set", False):
+        return _restore_tenant_set(
+            obj, root, step,
+            host_index=host_index, host_count=host_count,
+            verify_payload=verify_payload,
+            fallback_to_verified=fallback_to_verified,
+        )
+
     t0 = time.perf_counter()
     requested = step
     if requested is None and fallback_to_verified:
@@ -315,6 +323,106 @@ def restore_checkpoint(
     )
 
 
+def _restore_tenant_set(
+    obj: Any,
+    root: str,
+    step: Optional[int],
+    *,
+    host_index: int,
+    host_count: int,
+    verify_payload: bool,
+    fallback_to_verified: bool,
+) -> RestoreInfo:
+    """Restore a :class:`~metrics_tpu.tenancy.TenantSet` from its snapshot.
+
+    Tenant slots are host-local (each host's set serves its own tenants), so
+    there is no cross-shard fold: this host loads exactly the shard written by
+    its ``host_index``. A world-size change therefore refuses — re-partition
+    tenants explicitly with ``export_tenant``/``import_tenant`` instead.
+    Fingerprint gating and the corruption-fallback walk match the Metric path.
+    """
+    t0 = time.perf_counter()
+    requested = step
+    if requested is None and fallback_to_verified:
+        candidates = sorted(_io.available_steps(root), reverse=True)
+        if not candidates:
+            raise _io.CheckpointNotFoundError(f"no committed checkpoint under {root!r}")
+    else:
+        candidates = [_io.resolve_step(root, requested)]
+    live_fp = obj.fingerprint()
+    first_err: Optional[_io.CheckpointCorruptError] = None
+    fallback_from: Optional[int] = None
+    for attempt_i, cand in enumerate(candidates):
+        try:
+            manifest = _io.read_manifest(root, cand)
+            diff = fingerprint_diff(manifest["fingerprint"], live_fp)
+            if diff:
+                raise _io.CheckpointMismatchError(
+                    f"checkpoint step {cand} under {root!r} does not match the live "
+                    f"TenantSet; refusing to restore. Diff (checkpoint vs live):\n  "
+                    + "\n  ".join(diff)
+                )
+            world_size = int(manifest["world_size"])
+            if world_size != host_count:
+                raise _io.CheckpointMismatchError(
+                    f"TenantSet checkpoint step {cand} was written by {world_size} "
+                    f"host(s) but is being restored onto {host_count}: tenant slots "
+                    "are host-local and cannot be folded — move individual tenants "
+                    "with export_tenant()/import_tenant() instead."
+                )
+            entry = next(
+                s for s in manifest["shards"] if int(s["shard_index"]) == host_index
+            )
+            payload = _io.load_shard_payload(root, cand, entry, verify=verify_payload)
+            step = cand
+            break
+        except _io.CheckpointCorruptError as err:
+            if first_err is None:
+                first_err, fallback_from = err, cand
+            if attempt_i + 1 >= len(candidates):
+                raise
+            rank_zero_warn(
+                f"checkpoint step {cand} under {root!r} failed verification "
+                f"({type(err).__name__}: {err}); falling back to an older committed step"
+            )
+    if fallback_from is not None:
+        _REGISTRY.counter(
+            "checkpoint_restore_fallbacks_total",
+            "Restores that skipped a corrupt newest step for an older verifiable one.",
+        ).inc()
+        if _otrace.active:
+            _otrace.emit_instant(
+                "checkpoint/restore/fallback", "checkpoint",
+                from_step=int(fallback_from), to_step=int(step),
+                error=f"{type(first_err).__name__}: {str(first_err)[:160]}",
+            )
+    t1 = time.perf_counter()
+    if _otrace.active:
+        _otrace.emit_complete(
+            "checkpoint/restore/verify", "checkpoint",
+            int(t0 * 1e6), int((t1 - t0) * 1e6),
+            step=step, shards=1, world_size=world_size,
+        )
+    obj._apply_snapshot(payload, entry["members"])
+    t2 = time.perf_counter()
+    if _otrace.active:
+        _otrace.emit_complete(
+            "checkpoint/restore/apply", "checkpoint",
+            int(t1 * 1e6), int((t2 - t1) * 1e6),
+            step=step, members=1,
+        )
+    return RestoreInfo(
+        root=root,
+        step=step,
+        world_size=world_size,
+        shards_loaded=(host_index,),
+        host_index=host_index,
+        host_count=host_count,
+        timings={"verify_s": t1 - t0, "apply_s": t2 - t1, "total_s": t2 - t0},
+        fallback_from=fallback_from,
+    )
+
+
 # --------------------------------------------------------------------------- #
 # verification (no live object needed)
 # --------------------------------------------------------------------------- #
@@ -345,8 +453,12 @@ def verify_checkpoint(root: str, step: Optional[int] = None) -> VerifyReport:
             report.ok = False
             report.issues.append(str(err))
             continue
-        # every manifest leaf must be present in the payload
+        # every manifest leaf must be present in the payload (tenant_set
+        # shards carry a slot table instead of per-member leaves metadata —
+        # the checksum pass above already covered their payload)
         for member_key, mmeta in entry["members"].items():
+            if "leaves" not in mmeta:
+                continue
             try:
                 _decode_member_state(payload, member_key, mmeta["leaves"])
             except _io.CheckpointError as err:

@@ -439,6 +439,9 @@ class MetricCollection:
         return res
 
     def reset(self) -> None:
+        # keeps the dispatcher, its partition, and the fused engines: default
+        # leaves match the running shapes/dtypes, so reset→update cycles reuse
+        # every cached executable (zero recompiles — see Metric.reset)
         for m in self.values():
             m.reset()
 
@@ -545,6 +548,17 @@ class MetricCollection:
             leader = self._metrics.__getitem__(g[0])
             out[g[0]] = leader.init_state(*example_args, **leader._filter_kwargs(**example_kwargs))
         return out
+
+    def reset_state(
+        self, states: Dict[str, StateDict], mask: Optional[Any] = None
+    ) -> Dict[str, StateDict]:
+        """Pure fused reset: every group restored to defaults. With a boolean
+        ``mask`` of shape ``(N,)`` the states are treated as tenant-stacked
+        and only masked rows reset (see :meth:`Metric.reset_state`)."""
+        return {
+            g[0]: self._metrics.__getitem__(g[0]).reset_state(states[g[0]], mask)
+            for g in self._groups
+        }
 
     def update_state(self, states: Dict[str, StateDict], *args: Any, **kwargs: Any) -> Dict[str, StateDict]:
         """Pure fused update — jit this (optionally together with the model

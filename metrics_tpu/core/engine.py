@@ -687,6 +687,12 @@ class CompiledUpdateEngine(_EngineBase):
 PATH_FUSED = "fused"
 PATH_BUCKETED = "bucketed"
 PATH_EAGER = "eager"
+PATH_TENANT = "tenant_stacked"
+
+# reductions whose tenant axis folds into the flat sync buckets (an
+# elementwise reduce of a stacked buffer is the stacked elementwise reduce);
+# cat/None/callable reductions change layout per tenant and cannot stack
+_TENANT_STACKABLE_REDUCTIONS = ("sum", "mean", "max", "min")
 
 
 def classify_update_member(metric: Any) -> Tuple[str, str]:
@@ -726,6 +732,51 @@ def classify_compute_member(metric: Any) -> Tuple[str, str]:
     if metric.dist_sync_fn is not None:
         return PATH_EAGER, "custom dist_sync_fn"
     return PATH_FUSED, "compilable"
+
+
+def classify_tenant_member(metric: Any) -> Tuple[str, str]:
+    """Whether a member can join a :class:`~metrics_tpu.tenancy.TenantSet`'s
+    stacked leading-axis state, and why (not).
+
+    ``"tenant_stacked"`` members run N tenants through one vmapped, donated,
+    cached executable; everything else falls back to per-tenant eager clones.
+    Stacking needs strictly more than fusing: the member must be fused-
+    classifiable for *both* dispatch kinds, every registered state must be a
+    dense fixed-shape array (a ``CatBuffer``'s fill count makes its compaction
+    and compute value-dependent per tenant; list/tuple states have
+    data-dependent shape), every reduction must be elementwise (so the
+    tenant-batched sync folds the tenant axis into the flat buckets without
+    changing collective count), and the state must not be mesh-sharded (the
+    tenant axis would fight the placement). Analyzer rule E110 reports this
+    classification statically for every registered metric class."""
+    from metrics_tpu.core.buffers import CatBuffer
+
+    path, reason = classify_update_member(metric)
+    if path != PATH_FUSED:
+        return PATH_EAGER, f"update not stackable: {reason}"
+    cpath, creason = classify_compute_member(metric)
+    if cpath != PATH_FUSED:
+        return PATH_EAGER, f"compute not stackable: {creason}"
+    for name, default in metric._defaults.items():
+        if isinstance(default, CatBuffer):
+            return PATH_EAGER, (
+                f"state {name!r} is a CatBuffer: its fill count makes compaction and "
+                "compute value-dependent per tenant"
+            )
+        if isinstance(default, (list, tuple)):
+            return PATH_EAGER, (
+                f"state {name!r} is a {type(default).__name__}: data-dependent state shape"
+            )
+    for name, red in metric._reductions.items():
+        if red not in _TENANT_STACKABLE_REDUCTIONS:
+            tag = red if isinstance(red, str) or red is None else "callable"
+            return PATH_EAGER, (
+                f"state {name!r} dist_reduce_fx {tag!r} is not elementwise: the "
+                "tenant-batched sync cannot fold its tenant axis into a flat bucket"
+            )
+    if metric._state_sharding is not None:
+        return PATH_EAGER, "sharded state: the tenant axis would conflict with the mesh placement"
+    return PATH_TENANT, "stackable (fused update/compute, dense states, elementwise reductions)"
 
 
 def _classify_update_groups(coll: Any, migrated: Dict[str, str]):
@@ -782,6 +833,39 @@ def _classify_compute_groups(coll: Any, migrated: Dict[str, str]):
             for name in group:
                 members[name] = {"path": PATH_FUSED, "reason": infos[name][1]}
     return tuple(fused), tuple(eager), members
+
+
+def _classify_tenant_groups(coll: Any, migrated: Dict[str, str]):
+    """Partition the compute groups for tenant-stacked dispatch: a group
+    stacks only when *every* member is tenant-stackable (one member's
+    value-dependent compute would poison the group's shared vmapped program).
+    Returns ``(stacked, eager)`` leader-name tuples plus the per-member map."""
+    stacked, eager = [], []
+    members: Dict[str, Dict[str, str]] = {}
+    for group in coll._groups:
+        lname = group[0]
+        if lname in migrated:
+            for name in group:
+                members[name] = {
+                    "path": PATH_EAGER,
+                    "reason": f"migrated at runtime: {migrated[lname]}",
+                }
+            eager.append(lname)
+            continue
+        infos = {name: classify_tenant_member(coll._metrics[name]) for name in group}
+        stragglers = [n for n, (p, _) in infos.items() if p != PATH_TENANT]
+        if stragglers:
+            eager.append(lname)
+            for name in group:
+                path, reason = infos[name]
+                if path == PATH_TENANT:
+                    reason = f"group demoted by {stragglers[0]!r}: {infos[stragglers[0]][1]}"
+                members[name] = {"path": PATH_EAGER, "reason": reason}
+        else:
+            stacked.append(lname)
+            for name in group:
+                members[name] = {"path": PATH_TENANT, "reason": infos[name][1]}
+    return tuple(stacked), tuple(eager), members
 
 
 class CollectionUpdateEngine(_EngineBase):
@@ -1043,6 +1127,12 @@ class CollectionPartition:
     # changes drop the dispatcher, so group identity is stable here)
     update_rest: Tuple[Tuple[str, ...], ...] = ()
     compute_rest: Tuple[Tuple[str, ...], ...] = ()
+    # the tenant-stacked partition class (populated only for dispatchers built
+    # with a tenant context — see metrics_tpu.tenancy.TenantSet): leaders whose
+    # groups stack into the leading-axis state vs per-tenant eager fallbacks
+    tenant_stacked: Tuple[str, ...] = ()
+    tenant_eager: Tuple[str, ...] = ()
+    tenant_members: Dict[str, Dict[str, str]] = field(default_factory=dict)
 
 
 class CollectionDispatcher:
@@ -1067,8 +1157,13 @@ class CollectionDispatcher:
     remainder instead of the whole collection demoting to eager.
     """
 
-    def __init__(self, collection: Any) -> None:
+    def __init__(self, collection: Any, tenant_context: Any = None) -> None:
         self.collection = collection
+        # a metrics_tpu.tenancy.TenantSet hosting this dispatcher; when set,
+        # partitions also carry the tenant_stacked member class and the view
+        # grows a "tenant" section (the classification itself is static — the
+        # TenantSet owns the stacked state and the vmapped executables)
+        self.tenant_context = tenant_context
         self.stats = PartitionStats()
         self._partition: Optional[CollectionPartition] = None
         self._update_engine: Optional[CollectionUpdateEngine] = None
@@ -1077,6 +1172,7 @@ class CollectionDispatcher:
         # folded into the partition key so a migration survives re-keying
         self._migrated_update: Dict[str, str] = {}
         self._migrated_compute: Dict[str, str] = {}
+        self._migrated_tenant: Dict[str, str] = {}
         # fallback reasons of engines retired by a migration, keyed
         # "<kind>:<Owner>" — keeps the cause visible in engine_stats() after
         # the broken engine is replaced by its subset successor
@@ -1120,6 +1216,7 @@ class CollectionDispatcher:
                 leader._state_sharding is not None,
                 group[0] in self._migrated_update,
                 group[0] in self._migrated_compute,
+                group[0] in self._migrated_tenant,
                 tuple(
                     (
                         getattr(coll._metrics[name], "_compiled_compute", None) is False,
@@ -1154,6 +1251,13 @@ class CollectionDispatcher:
         c_fused, c_eager, c_members = _classify_compute_groups(
             coll, self._migrated_compute
         )
+        t_stacked: Tuple[str, ...] = ()
+        t_eager: Tuple[str, ...] = ()
+        t_members: Dict[str, Dict[str, str]] = {}
+        if self.tenant_context is not None:
+            t_stacked, t_eager, t_members = _classify_tenant_groups(
+                coll, self._migrated_tenant
+            )
         u_set, c_set = frozenset(u_fused), frozenset(c_fused)
         part = CollectionPartition(
             key=key,
@@ -1162,6 +1266,8 @@ class CollectionDispatcher:
             update_members=u_members, compute_members=c_members,
             update_rest=tuple(g for g in coll._groups if g[0] not in u_set),
             compute_rest=tuple(g for g in coll._groups if g[0] not in c_set),
+            tenant_stacked=t_stacked, tenant_eager=t_eager,
+            tenant_members=t_members,
         )
         self._partition = part
         # the fused subsets are baked into the engines' jit closures
@@ -1291,6 +1397,22 @@ class CollectionDispatcher:
         culprits = {lname: broken for lname in part.compute_fused}
         return self._migrate("compute", culprits, engine, transient=True)
 
+    def migrate_tenant(self, leader: str, reason: str) -> CollectionPartition:
+        """Move one group out of the tenant-stacked set after a runtime
+        failure in the stacked program (called by the hosting TenantSet).
+        Sticky via the partition key, like update/compute migrations; the
+        TenantSet then serves that group through per-tenant eager clones."""
+        self._migrated_tenant[leader] = reason.splitlines()[0][:200]
+        self.stats.migrations += 1
+        self._retired_reasons.setdefault(f"tenant:{leader}", reason[:200])
+        if _otrace.active:
+            _otrace.emit_instant(
+                "partition/migrate", "partition",
+                owner=type(self.collection).__name__, kind="tenant",
+                members=[leader], reason=reason[:200],
+            )
+        return self._build_partition()
+
     # ------------------------------------------------------------------ #
     # probation — bounded re-probe instead of a permanent eager sentence
     # ------------------------------------------------------------------ #
@@ -1414,10 +1536,16 @@ class CollectionDispatcher:
         part = self._partition
         if part is not None:
             u_members, c_members = part.update_members, part.compute_members
+            t_members = part.tenant_members
         else:
             _, _, _, u_members = _classify_update_groups(self.collection, self._migrated_update)
             _, _, c_members = _classify_compute_groups(self.collection, self._migrated_compute)
-        return {
+            t_members = {}
+            if self.tenant_context is not None:
+                _, _, t_members = _classify_tenant_groups(
+                    self.collection, self._migrated_tenant
+                )
+        view: Dict[str, Any] = {
             "update": {name: dict(info) for name, info in u_members.items()},
             "compute": {name: dict(info) for name, info in c_members.items()},
             "builds": self.stats.builds,
@@ -1436,6 +1564,9 @@ class CollectionDispatcher:
             },
             "last_fallback_exception": self._last_fallback_exception,
         }
+        if self.tenant_context is not None:
+            view["tenant"] = {name: dict(info) for name, info in t_members.items()}
+        return view
 
 
 def collection_partition_view(coll: Any) -> Dict[str, Any]:

@@ -464,6 +464,51 @@ class Metric:
                     state[k] = CatBuffer(jnp.zeros(ref.data.shape, ref.data.dtype), 0)
         return state
 
+    def reset_state(self, state: StateDict, mask: Optional[Any] = None) -> StateDict:
+        """Pure reset: return ``state`` restored to the registered defaults.
+
+        With ``mask=None`` this is ``init_state`` over the incoming state's
+        structure (CatBuffer capacities and materialized shapes are kept).
+        With a boolean ``mask`` of shape ``(N,)`` the state is treated as
+        tenant-stacked along a leading axis of size N and only rows where
+        ``mask`` is True are restored — a ``jnp.where`` per leaf, so the same
+        compiled program serves every occupancy pattern and resetting tenant k
+        never disturbs the other rows (metrics_tpu.tenancy per-tenant reset).
+        Jittable either way; masked reset requires dense fixed-shape leaves.
+        """
+        if mask is None:
+            out: StateDict = {}
+            for attr, default in self._defaults.items():
+                cur = state.get(attr)
+                if isinstance(cur, CatBuffer) and cur.materialized:
+                    out[attr] = CatBuffer(jnp.zeros_like(cur.data), 0)
+                elif isinstance(cur, list):
+                    out[attr] = []
+                else:
+                    out[attr] = _copy_state_value(default)
+            return out
+        m = jnp.asarray(mask)
+        if m.dtype != jnp.bool_ or m.ndim != 1:
+            raise MetricsUserError(
+                f"{type(self).__name__}.reset_state: mask must be a 1-D boolean "
+                f"array over the leading (tenant) axis, got shape {m.shape} "
+                f"dtype {m.dtype}."
+            )
+        out = {}
+        for attr, default in self._defaults.items():
+            cur = state[attr]
+            if isinstance(cur, (CatBuffer, list, tuple)):
+                raise MetricsUserError(
+                    f"{type(self).__name__}.reset_state: state {attr!r} is a "
+                    f"{type(cur).__name__} — masked (tenant-stacked) reset needs "
+                    "dense fixed-shape array leaves; this metric is not "
+                    "tenant-stackable (analysis rule E110)."
+                )
+            arr = jnp.asarray(cur)
+            sel = m.reshape((-1,) + (1,) * (arr.ndim - 1))
+            out[attr] = jnp.where(sel, jnp.asarray(default, arr.dtype), arr)
+        return out
+
     def get_state(self) -> StateDict:
         return {k: _copy_state_value(getattr(self, k)) for k in self._defaults}
 
@@ -968,7 +1013,14 @@ class Metric:
     # lifecycle
     # ------------------------------------------------------------------ #
     def reset(self) -> None:
-        """Restore registered states to their defaults (reference: metric.py:524-543)."""
+        """Restore registered states to their defaults (reference: metric.py:524-543).
+
+        Deliberately leaves ``_update_engine`` / ``_compute_engine`` (and any
+        owning dispatcher's partition) untouched: the default leaves have the
+        same shapes/dtypes as the running state, so the cached executables
+        stay valid and a reset→update cycle costs zero recompiles. Pinned by
+        tests/core/test_partitioned_dispatch.py's stable_hits regression.
+        """
         self._update_count = 0
         self._forward_cache = None
         self._computed = None

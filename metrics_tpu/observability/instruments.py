@@ -172,6 +172,7 @@ class InstrumentRegistry:
         self._instruments: Dict[Tuple[str, Tuple], Any] = {}
         self._engines: List[weakref.ref] = []
         self._dispatchers: List[weakref.ref] = []
+        self._tenant_sets: List[weakref.ref] = []
 
     # ------------------------------------------------------------------ #
     # manual instruments
@@ -244,6 +245,61 @@ class InstrumentRegistry:
             self._dispatchers = kept
         return out
 
+    # ------------------------------------------------------------------ #
+    # tenant-set registration — the multi-tenant bridge
+    # ------------------------------------------------------------------ #
+    def register_tenant_set(self, tenant_set: Any) -> None:
+        """Weakly track a :class:`metrics_tpu.tenancy.TenantSet`; its occupancy
+        and lifecycle counters appear in snapshots as
+        ``metrics_tpu_tenant_*{owner=...}`` series, with a per-tenant label
+        dimension on ``metrics_tpu_tenant_updates_total``."""
+        with self._lock:
+            self._tenant_sets.append(weakref.ref(tenant_set))
+
+    def live_tenant_sets(self) -> List[Any]:
+        out, kept = [], []
+        with self._lock:
+            for ref in self._tenant_sets:
+                ts = ref()
+                if ts is not None:
+                    out.append(ts)
+                    kept.append(ref)
+            self._tenant_sets = kept
+        return out
+
+    def _tenant_samples(self) -> Iterable[Sample]:
+        for ts in self.live_tenant_sets():
+            labels = {"owner": ts.name}
+            yield Sample(f"{PREFIX}tenant_active", dict(labels),
+                         float(ts.active_count), "gauge",
+                         "Tenants currently admitted to this TenantSet.")
+            yield Sample(f"{PREFIX}tenant_capacity", dict(labels),
+                         float(ts.capacity), "gauge",
+                         "Stacked slot capacity of this TenantSet.")
+            yield Sample(f"{PREFIX}tenant_bucket_width", dict(labels),
+                         float(ts.stats.last_bucket), "gauge",
+                         "pow2 tenant bucket width of the most recent dispatch.")
+            yield Sample(f"{PREFIX}tenant_executables", dict(labels),
+                         float(ts.stats.compiles), "gauge",
+                         "Distinct compiled executables serving this TenantSet.")
+            for fname, help_text in (
+                ("admits", "Tenants admitted over the set's lifetime."),
+                ("evicts", "Tenants evicted over the set's lifetime."),
+                ("resets", "Per-tenant resets over the set's lifetime."),
+                ("dispatches", "Stacked update dispatches served."),
+                ("cache_hits", "Dispatches served by a cached executable."),
+            ):
+                yield Sample(f"{PREFIX}tenant_{fname}_total", dict(labels),
+                             float(getattr(ts.stats, fname)), "counter", help_text)
+            # the per-tenant label dimension: one series per *active* tenant
+            for tid, n in ts.tenant_update_counts().items():
+                yield Sample(
+                    f"{PREFIX}tenant_updates_total",
+                    {**labels, "tenant": str(tid)},
+                    float(n), "counter",
+                    "Stacked updates applied to each active tenant.",
+                )
+
     def _partition_samples(self) -> Iterable[Sample]:
         for dispatcher in self.live_dispatchers():
             owner = type(dispatcher.collection).__name__
@@ -313,6 +369,7 @@ class InstrumentRegistry:
             out.extend(inst.samples())
         out.extend(self._engine_samples())
         out.extend(self._partition_samples())
+        out.extend(self._tenant_samples())
         out.extend(_process_samples())
         return out
 
@@ -332,6 +389,7 @@ class InstrumentRegistry:
             self._instruments.clear()
             self._engines.clear()
             self._dispatchers.clear()
+            self._tenant_sets.clear()
 
 
 def _rss_bytes() -> Optional[int]:
@@ -412,6 +470,11 @@ def register_engine(engine: Any) -> None:
 def register_dispatcher(dispatcher: Any) -> None:
     """Module-level convenience over ``REGISTRY.register_dispatcher``."""
     REGISTRY.register_dispatcher(dispatcher)
+
+
+def register_tenant_set(tenant_set: Any) -> None:
+    """Module-level convenience over ``REGISTRY.register_tenant_set``."""
+    REGISTRY.register_tenant_set(tenant_set)
 
 
 def get_registry() -> InstrumentRegistry:

@@ -103,6 +103,13 @@ EVENT_CATALOG: Dict[str, Tuple[str, ...]] = {
     "chaos": (
         "chaos/fault",  # the fault-injection harness fired a scheduled fault
     ),
+    "tenancy": (
+        "tenancy/dispatch",  # one stacked update dispatch (args: tenants, bucket, tenant ids)
+        "tenancy/compute",  # one stacked compute dispatch over the active tenants
+        "tenancy/reset",  # masked per-tenant reset (args: tenant ids)
+        "tenancy/admit",  # tenant admitted to a stacked slot (args: tenant, slot)
+        "tenancy/evict",  # tenant evicted, slot returned to the free list
+    ),
     "guard": (
         "guard/nonfinite",  # non-finite state detected at a guarded boundary
     ),

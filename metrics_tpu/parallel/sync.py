@@ -377,6 +377,52 @@ def _sync_bucketed_catbuffers(
     return out
 
 
+def sync_stacked_states(
+    states: Dict[str, Dict[str, Any]],
+    reductions: Dict[str, Dict[str, Optional[Union[str, Callable]]]],
+    axis_name: Optional[AxisNames],
+) -> Dict[str, Dict[str, Any]]:
+    """Tenant-batched bucketed sync (metrics_tpu.tenancy, ISSUE-11 tentpole).
+
+    ``states`` is a ``{leader: {state: leaf}}`` pytree whose leaves carry a
+    leading *tenant* axis of size N (the :class:`~metrics_tpu.tenancy.TenantSet`
+    capacity). An elementwise reduce of a stacked buffer is the stacked
+    elementwise reduce, so the tenant axis simply folds into the flat
+    ``(reduction, dtype)`` buckets of :func:`_sync_bucketed`: every leader's
+    leaves ravel into the same buckets and the collective count per sync is
+    exactly the per-(reduction, dtype) bucket count — independent of N and of
+    the number of leaders (pinned by tests/tenancy/test_tenant_sync.py).
+
+    Only elementwise reductions are legal here; ``cat``/``None``/callable tags
+    change layout per tenant and are rejected at classification time
+    (``classify_tenant_member``) — hitting one is a routing bug, so it raises.
+    ``axis_name=None`` is the no-axis identity fast path.
+    """
+    if axis_name is None:
+        return {lname: dict(st) for lname, st in states.items()}
+    entries: List[Tuple[str, Array, Optional[str]]] = []
+    for lname, st in states.items():
+        reds = reductions[lname]
+        for name, leaf in st.items():
+            red = reds.get(name)
+            if red not in ("sum", "mean", "max", "min"):
+                raise ValueError(
+                    f"sync_stacked_states: state {lname!r}.{name!r} has "
+                    f"non-elementwise reduction {red!r} — its tenant axis cannot "
+                    "fold into a flat bucket (classify_tenant_member should have "
+                    "demoted this group)."
+                )
+            # \x1f never appears in metric/state names; joins leader+state into
+            # one flat key so all leaders share the same bucket namespace
+            entries.append((f"{lname}\x1f{name}", leaf, red))
+    synced = _sync_bucketed(entries, axis_name)
+    out: Dict[str, Dict[str, Any]] = {lname: {} for lname in states}
+    for key, leaf in synced.items():
+        lname, name = key.split("\x1f", 1)
+        out[lname][name] = leaf
+    return out
+
+
 def sync_state(
     state: Dict[str, Any],
     reductions: Dict[str, Optional[Union[str, Callable]]],

@@ -82,6 +82,9 @@ KNOWN_SITES = (
     "storage/exists",
     "storage/sha256",
     "server/scrape",
+    "tenancy/dispatch",
+    "tenancy/admit",
+    "tenancy/evict",
 )
 
 

@@ -1,0 +1,15 @@
+"""metrics_tpu.tenancy — multi-tenant streaming metrics (ISSUE-11 tentpole).
+
+One process serving thousands of concurrent experiment/session streams should
+not pay one compiled program (or one Python dispatch loop) per stream. A
+:class:`TenantSet` stacks N structurally-identical :class:`~metrics_tpu.MetricCollection`
+states into a single leading-axis pytree and routes ``update``/``compute``
+through one vmapped, donated, cached executable — one compile serves every
+tenant, ragged arrival rides pow2 bucketing over the tenant dimension, and
+per-tenant reset/evict/admit are mask/scatter programs that never recompile.
+
+See docs/tenancy.md for the stacking model and which member classes stack.
+"""
+from metrics_tpu.tenancy.tenant_set import TenantSet, TenantStats  # noqa: F401
+
+__all__ = ["TenantSet", "TenantStats"]

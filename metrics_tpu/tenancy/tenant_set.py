@@ -1,0 +1,702 @@
+"""TenantSet: N collections' states as one leading-axis pytree.
+
+The stacking model
+------------------
+A :class:`TenantSet` owns ``capacity`` *slots*. Each admitted tenant maps to
+one slot; a slot's state is row ``slot`` of every stacked leaf. The template
+collection is classified once by the partition dispatcher
+(:func:`metrics_tpu.core.engine.classify_tenant_member`): groups whose update
+*and* compute trace, whose states are dense fixed-shape arrays, and whose
+reductions are elementwise go **tenant_stacked** — their states live as
+``(capacity, *shape)`` arrays updated by one vmapped, donated, cached
+executable. Everything else (CatBuffer/list states, value-dependent computes,
+``cat``/callable reductions, sharded states) goes **tenant_eager**: per-tenant
+state dicts driven through the pure protocol one tenant at a time.
+
+Ragged arrival
+--------------
+A dispatch carrying k tenants runs the ``_next_pow2(k)``-wide bucket: update
+argument rows are padded to the bucket width and the slot-index vector is
+padded with the out-of-range sentinel ``capacity``, so the gather clamps
+(``jnp.minimum``) and the write-back scatter **drops** padding rows
+(``.at[idx].set(..., mode="drop")``). Occupancy changes — 37 active of 1024,
+then 38, then 5 — therefore reuse the same executable per bucket width;
+masked/inactive tenants' rows are never addressed, so their state is
+bit-for-bit untouched (pinned by tests/tenancy/test_tenant_set.py).
+
+Lifecycle
+---------
+``admit`` is pure host bookkeeping (slots are kept at the registered defaults
+by construction and by ``evict``'s masked reset), ``reset``/``evict`` run a
+cached masked-reset program (:meth:`metrics_tpu.Metric.reset_state`), and
+``export_tenant``/``import_tenant`` move one tenant's rows without touching
+the rest. None of these recompile once their bucket width is warm — pinned by
+the dispatcher's ``stable_hits`` counter.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.core import engine as _engine
+from metrics_tpu.core.collections import MetricCollection, _flatten_results
+from metrics_tpu.core.metric import Metric, StateDict, _copy_state_value
+from metrics_tpu.observability import instruments as _instruments
+from metrics_tpu.observability import tracer as _otrace
+from metrics_tpu.parallel import sync as _sync
+from metrics_tpu.resilience import chaos as _chaos
+from metrics_tpu.utils.data import _squeeze_if_scalar
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+TenantId = Any  # str or int (checkpointable); validated at admit
+
+
+@dataclass
+class TenantStats:
+    """Lifecycle counters for one TenantSet (all monotonic except last_bucket)."""
+
+    dispatches: int = 0  # stacked update dispatches served
+    compiles: int = 0  # distinct executables traced (update/compute/reset/import)
+    cache_hits: int = 0  # dispatches/computes/resets served by a cached executable
+    admits: int = 0
+    evicts: int = 0
+    resets: int = 0  # per-tenant resets (evictions' slot-scrubs not included)
+    last_bucket: int = 0  # pow2 tenant bucket width of the most recent dispatch
+    eager_tenant_updates: int = 0  # per-tenant eager-path updates (unstackable groups)
+
+
+def _is_array(x: Any) -> bool:
+    return isinstance(x, (jnp.ndarray, np.ndarray)) and not isinstance(x, (bool,))
+
+
+class TenantSet:
+    """N structurally-identical collections behind one compiled program.
+
+    Args:
+        template: the per-tenant ``MetricCollection`` (a bare ``Metric`` is
+            wrapped). The instance is used for classification and as the pure
+            update/compute/reset implementation; its own state is never
+            advanced by tenant dispatches.
+        capacity: number of tenant slots (the stacked leading-axis size).
+        name: label for ``metrics_tpu_tenant_*`` observability series.
+    """
+
+    # duck-type marker for checkpoint/format dispatch (avoids an import cycle)
+    _is_tenant_set = True
+
+    def __init__(
+        self,
+        template: Any,
+        capacity: int = 1024,
+        name: Optional[str] = None,
+    ) -> None:
+        if isinstance(template, Metric):
+            template = MetricCollection(template)
+        if not isinstance(template, MetricCollection):
+            raise MetricsUserError(
+                f"TenantSet template must be a Metric or MetricCollection, got "
+                f"{type(template).__name__}"
+            )
+        if capacity < 1:
+            raise MetricsUserError(f"TenantSet capacity must be >= 1, got {capacity}")
+        self.template = template
+        self.capacity = int(capacity)
+        self.name = name or f"TenantSet[{type(template).__name__}]"
+        self.stats = TenantStats()
+        # the template's partition dispatcher carries the tenant_stacked
+        # member class; TenantSet dispatches bump its stable_hits, so the
+        # existing partition counters pin "zero recompiles" for tenancy too
+        self._dispatcher = _engine.CollectionDispatcher(template, tenant_context=self)
+        part = self._dispatcher._ensure_partition()
+        stacked_set = frozenset(part.tenant_stacked)
+        self._stacked_groups: Tuple[Tuple[str, ...], ...] = tuple(
+            tuple(g) for g in template._groups if g[0] in stacked_set
+        )
+        self._eager_groups: Tuple[Tuple[str, ...], ...] = tuple(
+            tuple(g) for g in template._groups if g[0] not in stacked_set
+        )
+        # stacked state: {leader: {state: (capacity, *shape) array}}
+        self._stacked: Dict[str, StateDict] = {}
+        for group in self._stacked_groups:
+            leader = template._metrics[group[0]]
+            base = leader.init_state()
+            # .astype pins a strong dtype: a weak-typed default (jnp.array(0.0))
+            # would flip to strong on the first reset/update program output,
+            # changing the stacked pytree's abstract signature and retracing
+            # every cached executable once
+            self._stacked[group[0]] = {
+                k: jnp.array(
+                    jnp.broadcast_to(jnp.asarray(v)[None], (self.capacity,) + jnp.shape(v))
+                ).astype(jnp.asarray(v).dtype)
+                for k, v in base.items()
+            }
+        # eager (unstackable) groups: one state dict per occupied slot
+        self._eager_states: Dict[str, Dict[int, StateDict]] = {
+            g[0]: {} for g in self._eager_groups
+        }
+        # slot table
+        self._slot_of: Dict[TenantId, int] = {}
+        self._tenant_at: List[Optional[TenantId]] = [None] * self.capacity
+        self._free: List[int] = list(range(self.capacity - 1, -1, -1))  # pop() -> 0 first
+        self._update_counts = np.zeros((self.capacity,), dtype=np.int64)
+        # executable cache; keys are ("update", B, treedef, roles) /
+        # ("compute", B) / ("reset", B) / ("import",)
+        self._exec: Dict[Tuple, Any] = {}
+        _instruments.register_tenant_set(self)
+
+    # ------------------------------------------------------------------ #
+    # introspection
+    # ------------------------------------------------------------------ #
+    @property
+    def active_count(self) -> int:
+        return len(self._slot_of)
+
+    def tenant_ids(self) -> List[TenantId]:
+        """Active tenant ids in slot order (stable across dispatches)."""
+        return [t for t in self._tenant_at if t is not None]
+
+    def tenant_update_counts(self) -> Dict[TenantId, int]:
+        return {t: int(self._update_counts[s]) for t, s in sorted(
+            self._slot_of.items(), key=lambda kv: kv[1]
+        )}
+
+    def partition_view(self) -> Dict[str, Any]:
+        """The dispatcher's partition view (includes the ``tenant`` section)."""
+        return self._dispatcher.partition_view()
+
+    def _slots_for(self, tenant_ids: Sequence[TenantId]) -> List[int]:
+        seen: set = set()
+        slots: List[int] = []
+        for tid in tenant_ids:
+            if tid in seen:
+                raise MetricsUserError(
+                    f"TenantSet: duplicate tenant id {tid!r} in one dispatch — "
+                    "the write-back scatter would be undefined; coalesce the "
+                    "tenant's rows first."
+                )
+            seen.add(tid)
+            slot = self._slot_of.get(tid)
+            if slot is None:
+                raise MetricsUserError(
+                    f"TenantSet: tenant {tid!r} is not admitted (active: "
+                    f"{self.active_count}/{self.capacity}); call admit() first."
+                )
+            slots.append(slot)
+        return slots
+
+    def _bucket(self, k: int) -> int:
+        return _engine._next_pow2(max(k, 1))
+
+    def _padded_idx(self, slots: Sequence[int], width: int) -> jnp.ndarray:
+        # padding rows carry the out-of-range sentinel `capacity`: the gather
+        # clamps them (jnp.minimum) and the scatter drops them (mode="drop")
+        idx = np.full((width,), self.capacity, dtype=np.int32)
+        idx[: len(slots)] = slots
+        return jnp.asarray(idx)
+
+    # ------------------------------------------------------------------ #
+    # lifecycle: admit / evict / reset
+    # ------------------------------------------------------------------ #
+    def admit(self, tenant_id: TenantId) -> int:
+        """Bind a tenant to a free slot; returns the slot. Pure host-side
+        bookkeeping (slot rows are already at the registered defaults), so
+        admission can never recompile anything."""
+        if not isinstance(tenant_id, (str, int)) or isinstance(tenant_id, bool):
+            raise MetricsUserError(
+                f"TenantSet tenant ids must be str or int (checkpointable), got "
+                f"{type(tenant_id).__name__}"
+            )
+        if _chaos.active:
+            _chaos.maybe_fail("tenancy/admit", tenant=str(tenant_id), active=self.active_count)
+        if tenant_id in self._slot_of:
+            raise MetricsUserError(f"TenantSet: tenant {tenant_id!r} is already admitted")
+        if not self._free:
+            raise MetricsUserError(
+                f"TenantSet at capacity ({self.capacity}): evict a tenant before "
+                f"admitting {tenant_id!r}"
+            )
+        slot = self._free.pop()
+        self._slot_of[tenant_id] = slot
+        self._tenant_at[slot] = tenant_id
+        for group in self._eager_groups:
+            leader = self.template._metrics[group[0]]
+            self._eager_states[group[0]][slot] = leader.init_state()
+        self.stats.admits += 1
+        if _otrace.active:
+            _otrace.emit_instant(
+                "tenancy/admit", "tenancy", owner=self.name,
+                tenant=str(tenant_id), slot=slot, active=self.active_count,
+            )
+        return slot
+
+    def evict(self, tenant_id: TenantId) -> None:
+        """Release a tenant's slot. The slot's stacked rows are scrubbed back
+        to the defaults through the cached masked-reset program (so the next
+        ``admit`` is pure bookkeeping); no recompile once the 1-wide reset
+        bucket is warm."""
+        if _chaos.active:
+            _chaos.maybe_fail("tenancy/evict", tenant=str(tenant_id), active=self.active_count)
+        slot = self._slot_of.get(tenant_id)
+        if slot is None:
+            raise MetricsUserError(f"TenantSet: tenant {tenant_id!r} is not admitted")
+        self._reset_slots([slot])
+        del self._slot_of[tenant_id]
+        self._tenant_at[slot] = None
+        self._free.append(slot)
+        for group in self._eager_groups:
+            self._eager_states[group[0]].pop(slot, None)
+        self.stats.evicts += 1
+        if _otrace.active:
+            _otrace.emit_instant(
+                "tenancy/evict", "tenancy", owner=self.name,
+                tenant=str(tenant_id), slot=slot, active=self.active_count,
+            )
+
+    def reset(self, tenant_ids: Optional[Sequence[TenantId]] = None) -> None:
+        """Reset the named tenants (default: all active) to the registered
+        defaults without disturbing any other tenant's streak. Runs the cached
+        masked-reset program for the ids' pow2 bucket — zero recompiles across
+        reset cycles (the shapes never change)."""
+        ids = list(tenant_ids) if tenant_ids is not None else self.tenant_ids()
+        if not ids:
+            return
+        slots = self._slots_for(ids)
+        self._reset_slots(slots)
+        for group in self._eager_groups:
+            leader = self.template._metrics[group[0]]
+            for slot in slots:
+                self._eager_states[group[0]][slot] = leader.init_state()
+        self.stats.resets += len(ids)
+        if _otrace.active:
+            _otrace.emit_instant(
+                "tenancy/reset", "tenancy", owner=self.name,
+                tenants=[str(t) for t in ids[:32]], count=len(ids),
+            )
+
+    def _reset_slots(self, slots: Sequence[int]) -> None:
+        self._update_counts[list(slots)] = 0
+        if not self._stacked:
+            return
+        width = self._bucket(len(slots))
+        idx = self._padded_idx(slots, width)
+        key = ("reset", width)
+        program = self._exec.get(key)
+        if program is None:
+            coll = self.template
+
+            def _reset(stacked: Dict[str, StateDict], idx: jnp.ndarray) -> Dict[str, StateDict]:
+                self.stats.compiles += 1  # trace-time side effect: once per compile
+                mask = jnp.zeros((self.capacity,), dtype=bool).at[idx].set(True, mode="drop")
+                return {
+                    lname: coll._metrics[lname].reset_state(st, mask)
+                    for lname, st in stacked.items()
+                }
+
+            donate = (0,) if _engine.backend_supports_donation() else ()
+            program = jax.jit(_reset, donate_argnums=donate)
+            self._exec[key] = program
+        else:
+            self.stats.cache_hits += 1
+        self._stacked = program(self._stacked, idx)
+        self._dispatcher._ensure_partition()  # stable-partition heartbeat
+
+    # ------------------------------------------------------------------ #
+    # the stacked update dispatch
+    # ------------------------------------------------------------------ #
+    def update(self, tenant_ids: Sequence[TenantId], *args: Any, **kwargs: Any) -> None:
+        """Advance every named tenant by its row of the update arguments.
+
+        Array arguments whose leading dimension equals ``len(tenant_ids)``
+        are per-tenant rows (vmapped); other arrays broadcast to every tenant;
+        non-array Python values are static config. One cached executable per
+        (pow2 bucket width, argument structure) serves every occupancy —
+        dispatching 37 of 1024 tenants runs the 64-wide bucket with dropped
+        padding rows and never touches the other 987 rows.
+        """
+        if _chaos.active:
+            _chaos.maybe_fail(
+                "tenancy/dispatch", tenants=len(tenant_ids), active=self.active_count
+            )
+        k = len(tenant_ids)
+        if k == 0:
+            return
+        slots = self._slots_for(tenant_ids)
+        width = self._bucket(k)
+        if self._stacked:
+            self._dispatch_stacked(slots, width, k, args, kwargs)
+        if self._eager_groups:
+            self._dispatch_eager(slots, k, args, kwargs)
+        self._update_counts[slots] += 1
+        self.stats.dispatches += 1
+        self.stats.last_bucket = width
+        self._dispatcher._ensure_partition()  # stable-partition heartbeat
+
+    def _split_leaves(
+        self, k: int, width: int, args: Tuple, kwargs: Dict
+    ) -> Tuple[Any, List[jnp.ndarray], List[jnp.ndarray], Tuple]:
+        """Partition update-argument leaves into batched (padded to the bucket
+        width), broadcast (dynamic, unbatched), and static roles."""
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        roles: List[Any] = []
+        batched: List[jnp.ndarray] = []
+        bcast: List[jnp.ndarray] = []
+        for leaf in leaves:
+            if _is_array(leaf) and getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == k:
+                arr = jnp.asarray(leaf)
+                if width > k:
+                    arr = jnp.concatenate(
+                        [arr, jnp.zeros((width - k,) + arr.shape[1:], arr.dtype)]
+                    )
+                roles.append("b")
+                batched.append(arr)
+            elif _is_array(leaf):
+                roles.append("c")
+                bcast.append(jnp.asarray(leaf))
+            else:
+                try:
+                    hash(leaf)
+                except TypeError:
+                    raise MetricsUserError(
+                        f"TenantSet.update: argument leaf {leaf!r} is neither an "
+                        "array nor hashable static config; pass arrays (leading "
+                        "tenant axis for per-tenant rows) or hashable scalars."
+                    ) from None
+                roles.append(("s", leaf))
+        return treedef, batched, bcast, tuple(roles)
+
+    def _dispatch_stacked(
+        self, slots: List[int], width: int, k: int, args: Tuple, kwargs: Dict
+    ) -> None:
+        treedef, batched, bcast, roles = self._split_leaves(k, width, args, kwargs)
+        shapes = tuple((a.shape[1:], str(a.dtype)) for a in batched)
+        bshapes = tuple((a.shape, str(a.dtype)) for a in bcast)
+        key = ("update", width, treedef, roles, shapes, bshapes)
+        program = self._exec.get(key)
+        t0_us = _otrace._now_us() if _otrace.active else 0
+        if program is None:
+            coll = self.template
+            groups = self._stacked_groups
+
+            def _run(
+                stacked: Dict[str, StateDict],
+                idx: jnp.ndarray,
+                batched_in: List[jnp.ndarray],
+                bcast_in: List[jnp.ndarray],
+            ) -> Dict[str, StateDict]:
+                self.stats.compiles += 1  # trace-time side effect
+                safe = jnp.minimum(idx, self.capacity - 1)
+                gathered = jax.tree_util.tree_map(lambda l: l[safe], stacked)
+
+                def one(state: Dict[str, StateDict], brow: List[jnp.ndarray]):
+                    flat: List[Any] = []
+                    bi = ci = 0
+                    for role in roles:
+                        if role == "b":
+                            flat.append(brow[bi]); bi += 1
+                        elif role == "c":
+                            flat.append(bcast_in[ci]); ci += 1  # closed-over: broadcast
+                        else:
+                            flat.append(role[1])
+                    a, kw = jax.tree_util.tree_unflatten(treedef, flat)
+                    out = {}
+                    for group in groups:
+                        leader = coll._metrics[group[0]]
+                        out[group[0]] = leader.update_state(
+                            state[group[0]], *a, **leader._filter_kwargs(**kw)
+                        )
+                    return out
+
+                new = jax.vmap(one, in_axes=(0, 0))(gathered, batched_in)
+                # scatter rows back; padding rows (idx == capacity) are dropped,
+                # so masked/absent tenants' state is bit-for-bit untouched
+                return jax.tree_util.tree_map(
+                    lambda l, n: l.at[idx].set(n.astype(l.dtype), mode="drop"),
+                    stacked, new,
+                )
+
+            donate = (0,) if _engine.backend_supports_donation() else ()
+            program = jax.jit(_run, donate_argnums=donate)
+            self._exec[key] = program
+        else:
+            self.stats.cache_hits += 1
+        idx = self._padded_idx(slots, width)
+        self._stacked = program(self._stacked, idx, batched, bcast)
+        if _otrace.active:
+            _otrace.emit_complete(
+                "tenancy/dispatch", "tenancy", t0_us, _otrace._now_us() - t0_us,
+                owner=self.name, tenants=k, bucket=width, active=self.active_count,
+            )
+
+    def _dispatch_eager(self, slots: List[int], k: int, args: Tuple, kwargs: Dict) -> None:
+        """Unstackable groups: one pure update_state per tenant per group."""
+        for i, slot in enumerate(slots):
+            row_args = tuple(self._row(a, i, k) for a in args)
+            row_kwargs = {kk: self._row(v, i, k) for kk, v in kwargs.items()}
+            for group in self._eager_groups:
+                leader = self.template._metrics[group[0]]
+                state = self._eager_states[group[0]][slot]
+                self._eager_states[group[0]][slot] = leader.update_state(
+                    state, *row_args, **leader._filter_kwargs(**row_kwargs)
+                )
+                self.stats.eager_tenant_updates += 1
+
+    @staticmethod
+    def _row(leaf: Any, i: int, k: int) -> Any:
+        if _is_array(leaf) and getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == k:
+            return leaf[i]
+        return leaf
+
+    # ------------------------------------------------------------------ #
+    # compute
+    # ------------------------------------------------------------------ #
+    def compute(
+        self, tenant_ids: Optional[Sequence[TenantId]] = None
+    ) -> Dict[TenantId, Dict[str, Any]]:
+        """Per-tenant metric values, ``{tenant_id: {output_name: value}}``.
+
+        Stacked groups compute through one vmapped executable over the ids'
+        pow2 bucket (no donation: the stacked state stays live); unstackable
+        groups compute per tenant through the pure protocol.
+        """
+        ids = list(tenant_ids) if tenant_ids is not None else self.tenant_ids()
+        if not ids:
+            return {}
+        slots = self._slots_for(ids)
+        k = len(ids)
+        stacked_rows: Optional[Dict[str, Any]] = None
+        t0_us = _otrace._now_us() if _otrace.active else 0
+        if self._stacked:
+            width = self._bucket(k)
+            key = ("compute", width)
+            program = self._exec.get(key)
+            if program is None:
+                coll = self.template
+                groups = self._stacked_groups
+
+                def _compute(stacked: Dict[str, StateDict], idx: jnp.ndarray) -> Dict[str, Any]:
+                    self.stats.compiles += 1  # trace-time side effect
+                    safe = jnp.minimum(idx, self.capacity - 1)
+                    gathered = jax.tree_util.tree_map(lambda l: l[safe], stacked)
+
+                    def one(state: Dict[str, StateDict]) -> Dict[str, Any]:
+                        res: Dict[str, Any] = {}
+                        for group in groups:
+                            for name in group:
+                                m = coll._metrics[name]
+                                res[coll._set_name(name)] = m.compute_state(state[group[0]])
+                        return res
+
+                    return jax.vmap(one)(gathered)
+
+                program = jax.jit(_compute)
+                self._exec[key] = program
+            else:
+                self.stats.cache_hits += 1
+            idx = self._padded_idx(slots, width)
+            stacked_rows = program(self._stacked, idx)
+        out: Dict[TenantId, Dict[str, Any]] = {}
+        for i, (tid, slot) in enumerate(zip(ids, slots)):
+            res: Dict[str, Any] = {}
+            for group in self.template._groups:
+                if group[0] in self._eager_states:
+                    state = self._eager_states[group[0]][slot]
+                    for name in group:
+                        m = self.template._metrics[name]
+                        res[self.template._set_name(name)] = m.compute_state(state)
+                elif stacked_rows is not None:
+                    for name in group:
+                        key_name = self.template._set_name(name)
+                        res[key_name] = jax.tree_util.tree_map(
+                            lambda v: v[i], stacked_rows[key_name]
+                        )
+            out[tid] = {
+                kk: _squeeze_if_scalar(vv) for kk, vv in _flatten_results(res).items()
+            }
+        if _otrace.active:
+            _otrace.emit_complete(
+                "tenancy/compute", "tenancy", t0_us, _otrace._now_us() - t0_us,
+                owner=self.name, tenants=k, active=self.active_count,
+            )
+        return out
+
+    # ------------------------------------------------------------------ #
+    # tenant-batched sync (pure; call under shard_map/pmap)
+    # ------------------------------------------------------------------ #
+    def sync_states(
+        self, stacked: Dict[str, StateDict], axis_name: Any
+    ) -> Dict[str, StateDict]:
+        """Cross-device sync of a stacked state pytree: the tenant axis folds
+        into the flat (reduction, dtype) buckets, so the collective count per
+        sync is independent of both N and the number of stacked groups (see
+        :func:`metrics_tpu.parallel.sync.sync_stacked_states`)."""
+        reductions = {
+            group[0]: dict(self.template._metrics[group[0]]._reductions)
+            for group in self._stacked_groups
+        }
+        return _sync.sync_stacked_states(stacked, reductions, axis_name)
+
+    @property
+    def stacked_states(self) -> Dict[str, StateDict]:
+        """The live stacked state pytree (read-only view by convention)."""
+        return self._stacked
+
+    # ------------------------------------------------------------------ #
+    # single-tenant export / import (evict+admit without touching the rest)
+    # ------------------------------------------------------------------ #
+    def _template_aux(self) -> Dict[str, Dict[str, Any]]:
+        """Update-determined python config (``Accuracy.mode``, ...) per member.
+        Stacked tenants are structurally identical streams, so this config is
+        shared — it lives on the template, not per tenant."""
+        from metrics_tpu.checkpoint.format import metric_aux
+
+        return {name: metric_aux(m) for name, m in self.template._metrics.items()}
+
+    def _apply_template_aux(self, aux: Dict[str, Dict[str, Any]]) -> None:
+        for name, attrs in (aux or {}).items():
+            m = self.template._metrics.get(name)
+            if m is None:
+                continue
+            for aname, aval in attrs.items():
+                if aval is not None:
+                    setattr(m, aname, aval)
+
+    def export_tenant(self, tenant_id: TenantId) -> Dict[str, Any]:
+        """One tenant's state as host arrays: ``{"states", "eager_states",
+        "update_count", "aux"}``. Pure reads — no other tenant's rows move."""
+        slot = self._slot_of.get(tenant_id)
+        if slot is None:
+            raise MetricsUserError(f"TenantSet: tenant {tenant_id!r} is not admitted")
+        states = {
+            lname: {k: np.asarray(leaf[slot]) for k, leaf in st.items()}
+            for lname, st in self._stacked.items()
+        }
+        eager = {
+            lname: {
+                k: _copy_state_value(v)
+                for k, v in self._eager_states[lname][slot].items()
+            }
+            for lname in self._eager_states
+        }
+        return {
+            "states": states,
+            "eager_states": eager,
+            "update_count": int(self._update_counts[slot]),
+            "aux": self._template_aux(),
+        }
+
+    def import_tenant(self, tenant_id: TenantId, snapshot: Dict[str, Any]) -> int:
+        """Admit (if absent) and load one tenant's exported state via a cached
+        single-row scatter — the other ``capacity - 1`` rows are untouched and
+        nothing recompiles once the import program is warm."""
+        slot = self._slot_of.get(tenant_id)
+        if slot is None:
+            slot = self.admit(tenant_id)
+        if self._stacked:
+            rows = {
+                lname: {k: jnp.asarray(v) for k, v in st.items()}
+                for lname, st in snapshot["states"].items()
+            }
+            key = ("import",)
+            program = self._exec.get(key)
+            if program is None:
+
+                def _import(
+                    stacked: Dict[str, StateDict], idx: jnp.ndarray, rows_in: Dict[str, StateDict]
+                ) -> Dict[str, StateDict]:
+                    self.stats.compiles += 1  # trace-time side effect
+                    return jax.tree_util.tree_map(
+                        lambda l, r: l.at[idx].set(r[None].astype(l.dtype), mode="drop"),
+                        stacked, rows_in,
+                    )
+
+                donate = (0,) if _engine.backend_supports_donation() else ()
+                program = jax.jit(_import, donate_argnums=donate)
+                self._exec[key] = program
+            else:
+                self.stats.cache_hits += 1
+            self._stacked = program(self._stacked, jnp.asarray([slot], jnp.int32), rows)
+        for lname, st in (snapshot.get("eager_states") or {}).items():
+            if lname in self._eager_states:
+                self._eager_states[lname][slot] = {
+                    k: _copy_state_value(v) for k, v in st.items()
+                }
+        self._apply_template_aux(snapshot.get("aux") or {})
+        self._update_counts[slot] = int(snapshot.get("update_count", 0))
+        return slot
+
+    # ------------------------------------------------------------------ #
+    # checkpoint integration (metrics_tpu.checkpoint calls these)
+    # ------------------------------------------------------------------ #
+    def fingerprint(self) -> Dict[str, Any]:
+        """Static identity for restore gating: capacity + template fingerprint."""
+        from metrics_tpu.checkpoint.format import FORMAT_VERSION, object_fingerprint
+
+        return {
+            "format_version": FORMAT_VERSION,
+            "kind": "tenant_set",
+            "capacity": self.capacity,
+            "template": object_fingerprint(self.template),
+        }
+
+    def _ckpt_payload(self) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+        """``(payload, shard_meta)`` for :func:`metrics_tpu.checkpoint.build_shard`.
+
+        The whole stacked pytree lands as ``tenant/{leader}.{state}`` arrays —
+        one snapshot restores all tenants. Unstackable (eager) groups hold
+        per-tenant CatBuffer/list state with no stable on-disk stacking; a set
+        with eager groups refuses to snapshot rather than drop them silently.
+        """
+        if self._eager_groups:
+            eager = ", ".join(g[0] for g in self._eager_groups)
+            raise MetricsUserError(
+                f"TenantSet checkpointing requires a fully stackable template; "
+                f"groups [{eager}] are tenant_eager (see partition_view()['tenant'] "
+                "for the reasons and analysis rule E110)."
+            )
+        payload = {
+            f"tenant/{lname}.{k}": np.asarray(leaf)
+            for lname, st in self._stacked.items()
+            for k, leaf in st.items()
+        }
+        shard_meta = {
+            "kind": "tenant_set",
+            "members": {
+                "__tenants__": {
+                    "capacity": self.capacity,
+                    "slots": [[tid, slot] for tid, slot in sorted(
+                        self._slot_of.items(), key=lambda kv: kv[1]
+                    )],
+                    "update_counts": [int(c) for c in self._update_counts],
+                    "aux": self._template_aux(),
+                }
+            },
+            "fingerprint": self.fingerprint(),
+        }
+        return payload, shard_meta
+
+    def _apply_snapshot(self, payload: Dict[str, np.ndarray], members_meta: Dict[str, Any]) -> None:
+        """Replace every tenant's state from a loaded shard (restore pass 2).
+
+        Shapes/dtypes are fingerprint-gated equal, so the cached executables
+        survive the restore — the next dispatch is a cache hit, not a compile.
+        """
+        info = members_meta["__tenants__"]
+        stacked: Dict[str, StateDict] = {}
+        for group in self._stacked_groups:
+            lname = group[0]
+            stacked[lname] = {
+                k: jnp.asarray(payload[f"tenant/{lname}.{k}"])
+                for k in self._stacked[lname]
+            }
+        self._stacked = stacked
+        self._slot_of = {tid: int(slot) for tid, slot in info["slots"]}
+        self._tenant_at = [None] * self.capacity
+        for tid, slot in self._slot_of.items():
+            self._tenant_at[slot] = tid
+        self._free = [s for s in range(self.capacity - 1, -1, -1) if self._tenant_at[s] is None]
+        self._update_counts = np.asarray(info["update_counts"], dtype=np.int64).copy()
+        self._apply_template_aux(info.get("aux") or {})

@@ -253,6 +253,22 @@ class ValueDependentComputeMetric(CleanMetric):
         return jnp.nonzero(jnp.ones((4,)) * self.total)[0]  # metrics-tpu: allow[A002]
 
 
+class CatReductionMetric(Metric):
+    """E110: dense state under a ``cat`` reduction — fine for the compiled
+    engines, but a TenantSet cannot fold its tenant axis into the flat sync
+    buckets, so the member demotes to per-tenant eager clones."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("vals", default=jnp.zeros((4,)), dist_reduce_fx="cat")
+
+    def update(self, values):
+        self.vals = self.vals + values[:4]
+
+    def compute(self):
+        return self.vals.sum()
+
+
 _SPEC = {"init": {}, "inputs": [("float32", (8,))]}
 
 
@@ -417,6 +433,23 @@ class TestEvalStage:
         findings = _evaluate(ValueDependentComputeMetric, dict(_SPEC, init={"compiled_compute": False}))
         rules = {f.rule for f in findings if not f.suppressed}
         assert "E107" in rules and "E109" not in rules
+
+    def test_tenant_unstackable_is_E110(self):
+        findings = _evaluate(CatReductionMetric)
+        e110 = [f for f in findings if f.rule == "E110" and not f.suppressed]
+        assert len(e110) == 1
+        assert e110[0].severity == "warning"
+        assert "cat" in e110[0].message and "eager" in e110[0].message
+        assert e110[0].extra["tenant_path"] == "eager"
+
+    def test_stackable_metric_has_no_E110(self):
+        findings = _evaluate(CleanMetric)
+        assert "E110" not in {f.rule for f in findings}
+
+    def test_E110_is_suppressible_via_spec_allow(self):
+        findings = _evaluate(CatReductionMetric, dict(_SPEC, allow=("E110",)))
+        e110 = [f for f in findings if f.rule == "E110"]
+        assert e110 and all(f.suppressed for f in e110)
 
     def test_missing_spec_is_E002(self):
         findings = eval_stage.evaluate_entry(Entry(cls=CleanMetric, spec=None))

@@ -127,6 +127,32 @@ class TestPartitionStability:
         assert set(part.update_fused) == {g[0] for g in coll._groups}
         assert part.update_bucketed == () and part.update_eager == ()
 
+    def test_reset_keeps_partition_and_executables(self):
+        """The stable_hits regression pinned by Metric.reset()'s audit note:
+        reset restores default leaves with the SAME shapes/dtypes, so neither
+        the partition nor any cached executable is invalidated — a
+        reset->update cycle costs zero recompiles, forever."""
+        coll = _config2()
+        p, t = _data()
+        for _ in range(4):
+            coll.update(p, t)
+        warm = coll.engine_stats()["update"]
+        warm_misses, warm_eager = warm.cache_misses, warm.eager_calls
+        prev_hits = coll._dispatcher.stats.stable_hits
+        for _cycle in range(3):
+            coll.reset()
+            for _ in range(4):
+                coll.update(p, t)
+            stats = coll._dispatcher.stats
+            assert stats.builds == 1
+            assert stats.repartitions == 0
+            assert stats.migrations == 0
+            assert stats.stable_hits > prev_hits
+            prev_hits = stats.stable_hits
+            engine = coll.engine_stats()["update"]
+            assert engine.cache_misses == warm_misses  # no retrace after reset
+            assert engine.eager_calls == warm_eager  # no warmup restart either
+
     def test_flag_flip_rebuilds_partition(self):
         coll = _config2()
         p, t = _data()

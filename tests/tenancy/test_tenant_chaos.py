@@ -1,0 +1,105 @@
+"""Chaos injection at the tenancy boundaries: faults fire BEFORE mutation.
+
+Pins the ISSUE-11 fault contract: each tenancy site (`tenancy/dispatch`,
+`tenancy/admit`, `tenancy/evict`) is injectable via the deterministic chaos
+harness, a fired fault leaves NO partial state (occupancy and per-tenant
+update counts unchanged), and the interrupted operation succeeds on retry.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.resilience import FaultSpec
+from metrics_tpu.resilience import chaos
+from metrics_tpu.resilience.chaos import ChaosError, KNOWN_SITES
+
+
+class TinyMean(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("count", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.total = self.total + jnp.sum(values)
+        self.count = self.count + float(np.prod(values.shape))
+
+    def compute(self):
+        return self.total / jnp.maximum(self.count, 1.0)
+
+
+def _ts(n_admit=2):
+    ts = mt.TenantSet(mt.MetricCollection({"mean": TinyMean()}), capacity=4)
+    for i in range(n_admit):
+        ts.admit(f"t{i}")
+    return ts
+
+
+def test_tenancy_sites_are_registered():
+    for site in ("tenancy/dispatch", "tenancy/admit", "tenancy/evict"):
+        assert site in KNOWN_SITES
+
+
+def test_dispatch_fault_leaves_no_partial_state():
+    ts = _ts()
+    ts.update(["t0", "t1"], jnp.ones((2, 4), jnp.float32))
+    counts = dict(ts.tenant_update_counts())
+    before = {t: np.asarray(v["mean"]) for t, v in ts.compute().items()}
+    with chaos.plan([FaultSpec("tenancy/dispatch", nth=1, times=1)], seed=0) as p:
+        with pytest.raises(ChaosError):
+            ts.update(["t0", "t1"], jnp.full((2, 4), 9.0, jnp.float32))
+        assert p.fired("tenancy/dispatch") == 1
+        assert ts.tenant_update_counts() == counts
+        after = {t: np.asarray(v["mean"]) for t, v in ts.compute().items()}
+        for t in before:
+            np.testing.assert_array_equal(before[t], after[t])
+        # the plan's budget is spent — the retry goes through
+        ts.update(["t0", "t1"], jnp.full((2, 4), 9.0, jnp.float32))
+    assert ts.tenant_update_counts()["t0"] == counts["t0"] + 1
+
+
+def test_admit_fault_leaves_no_slot_assigned():
+    ts = _ts()
+    with chaos.plan([FaultSpec("tenancy/admit", nth=1, times=1)], seed=0) as p:
+        with pytest.raises(ChaosError):
+            ts.admit("t9")
+        assert p.fired("tenancy/admit") == 1
+        assert ts.active_count == 2
+        assert "t9" not in ts.tenant_ids()
+        ts.admit("t9")  # retry succeeds
+    assert "t9" in ts.tenant_ids()
+    assert ts.active_count == 3
+
+
+def test_evict_fault_keeps_tenant_state():
+    ts = _ts()
+    ts.update(["t0", "t1"], jnp.ones((2, 4), jnp.float32))
+    before = np.asarray(ts.compute(["t1"])["t1"]["mean"])
+    with chaos.plan([FaultSpec("tenancy/evict", nth=1, times=1)], seed=0) as p:
+        with pytest.raises(ChaosError):
+            ts.evict("t1")
+        assert p.fired("tenancy/evict") == 1
+        assert "t1" in ts.tenant_ids()
+        np.testing.assert_array_equal(
+            np.asarray(ts.compute(["t1"])["t1"]["mean"]), before
+        )
+        ts.evict("t1")  # retry succeeds
+    assert "t1" not in ts.tenant_ids()
+    assert ts.active_count == 1
+
+
+def test_nth_dispatch_fault_is_deterministic():
+    """nth=3 means exactly the third dispatch fails — replayable by seed."""
+    for _ in range(2):
+        ts = _ts()
+        with chaos.plan([FaultSpec("tenancy/dispatch", nth=3, times=1)], seed=7):
+            ts.update(["t0"], jnp.ones((1, 4), jnp.float32))
+            ts.update(["t0"], jnp.ones((1, 4), jnp.float32))
+            with pytest.raises(ChaosError):
+                ts.update(["t0"], jnp.ones((1, 4), jnp.float32))
+        assert ts.tenant_update_counts()["t0"] == 2

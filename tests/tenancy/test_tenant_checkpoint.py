@@ -1,0 +1,160 @@
+"""TenantSet checkpointing: one snapshot for all tenants, typed refusals.
+
+Pins the ISSUE-11 checkpoint contract: a TenantSet saves its whole slot table
+in one shard, restores bitwise with per-tenant update counts intact, and
+refuses — with actionable errors — the two cases that cannot round-trip:
+eager compute groups (analysis rule E110) and a changed world size (tenant
+slots are host-local; move tenants with export_tenant/import_tenant).
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointMismatchError,
+    available_steps,
+    restore_checkpoint,
+    save_checkpoint,
+    verify_checkpoint,
+)
+from metrics_tpu.checkpoint import io as _io
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+
+class TinyMean(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("count", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.total = self.total + jnp.sum(values)
+        self.count = self.count + float(np.prod(values.shape))
+
+    def compute(self):
+        return self.total / jnp.maximum(self.count, 1.0)
+
+
+def _populated_set(capacity=8):
+    ts = mt.TenantSet(mt.MetricCollection({"mean": TinyMean()}), capacity=capacity)
+    for tid in ("a", "b", "c"):
+        ts.admit(tid)
+    ts.update(["a", "b", "c"], jnp.arange(12, dtype=jnp.float32).reshape(3, 4))
+    ts.update(["b"], jnp.full((1, 4), 100.0, jnp.float32))
+    return ts
+
+
+def _fresh_like(capacity=8):
+    ts = mt.TenantSet(mt.MetricCollection({"mean": TinyMean()}), capacity=capacity)
+    for tid in ("a", "b", "c"):
+        ts.admit(tid)
+    return ts
+
+
+class TestRoundTrip:
+    def test_save_verify_restore_parity(self, tmp_path):
+        root = str(tmp_path / "ckpt")
+        ts = _populated_set()
+        before = ts.compute()
+        save_checkpoint(ts, root, world_size=1, shard_index=0)
+
+        report = verify_checkpoint(root)
+        assert report.ok
+
+        fresh = _fresh_like()
+        info = restore_checkpoint(fresh, root, host_count=1)
+        assert info.fallback_from is None
+        after = fresh.compute()
+        for tid in ("a", "b", "c"):
+            np.testing.assert_array_equal(
+                np.asarray(before[tid]["mean"]), np.asarray(after[tid]["mean"])
+            )
+        assert fresh.tenant_update_counts() == ts.tenant_update_counts()
+        assert fresh.tenant_ids() == ts.tenant_ids()
+
+    def test_restore_does_not_perturb_executable_cache(self, tmp_path):
+        """A restored stacked pytree has the same abstract signature as a live
+        one, so the next dispatch at a warmed width is a cache hit."""
+        root = str(tmp_path / "ckpt")
+        save_checkpoint(_populated_set(), root, world_size=1, shard_index=0)
+        fresh = _fresh_like()
+        fresh.update(["a", "b", "c"], jnp.ones((3, 4), jnp.float32))  # warm width 4
+        compiles = fresh.stats.compiles
+        restore_checkpoint(fresh, root, host_count=1)
+        fresh.update(["a", "b", "c"], jnp.ones((3, 4), jnp.float32))
+        assert fresh.stats.compiles == compiles
+        assert fresh.stats.cache_hits >= 1
+
+    def test_fallback_to_older_verifiable_step(self, tmp_path):
+        root = str(tmp_path / "ckpt")
+        ts = _populated_set()
+        save_checkpoint(ts, root, world_size=1, shard_index=0)
+        good = ts.compute()
+        ts.update(["a"], jnp.full((1, 4), 7.0, jnp.float32))
+        save_checkpoint(ts, root, world_size=1, shard_index=0)
+        # tear the newest step's payload
+        bad_step = available_steps(root)[-1]
+        sdir = _io.step_dir(root, bad_step)
+        npz = next(n for n in os.listdir(sdir) if n.endswith(".npz"))
+        path = os.path.join(sdir, npz)
+        data = bytearray(open(path, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        with open(path, "wb") as fh:
+            fh.write(bytes(data))
+
+        fresh = _fresh_like()
+        with pytest.warns(UserWarning, match="fall"):
+            info = restore_checkpoint(fresh, root, host_count=1)
+        assert info.fallback_from == bad_step
+        assert info.step == available_steps(root)[0]
+        after = fresh.compute()
+        for tid in ("a", "b", "c"):
+            np.testing.assert_array_equal(
+                np.asarray(good[tid]["mean"]), np.asarray(after[tid]["mean"])
+            )
+
+    def test_explicit_corrupt_step_raises(self, tmp_path):
+        root = str(tmp_path / "ckpt")
+        save_checkpoint(_populated_set(), root, world_size=1, shard_index=0)
+        bad_step = available_steps(root)[-1]
+        sdir = _io.step_dir(root, bad_step)
+        npz = next(n for n in os.listdir(sdir) if n.endswith(".npz"))
+        path = os.path.join(sdir, npz)
+        data = bytearray(open(path, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        with open(path, "wb") as fh:
+            fh.write(bytes(data))
+        with pytest.raises(CheckpointCorruptError):
+            restore_checkpoint(_fresh_like(), root, step=bad_step, host_count=1)
+
+
+class TestRefusals:
+    def test_capacity_mismatch_is_fingerprint_error(self, tmp_path):
+        root = str(tmp_path / "ckpt")
+        save_checkpoint(_populated_set(capacity=8), root, world_size=1, shard_index=0)
+        with pytest.raises(CheckpointMismatchError):
+            restore_checkpoint(_fresh_like(capacity=16), root, host_count=1)
+
+    def test_world_size_change_refused_with_migration_hint(self, tmp_path):
+        root = str(tmp_path / "ckpt")
+        save_checkpoint(_populated_set(), root, world_size=1, shard_index=0)
+        with pytest.raises(CheckpointMismatchError, match="export_tenant"):
+            restore_checkpoint(_fresh_like(), root, host_count=2, host_index=0)
+
+    def test_eager_group_refuses_to_save(self, tmp_path):
+        ts = mt.TenantSet(
+            mt.MetricCollection({"mean": TinyMean(), "cat": mt.CatMetric()}),
+            capacity=4,
+        )
+        ts.admit("a")
+        ts.update(["a"], jnp.ones((1, 4), jnp.float32))
+        with pytest.raises(MetricsUserError, match="E110"):
+            save_checkpoint(ts, str(tmp_path / "ckpt"), world_size=1, shard_index=0)

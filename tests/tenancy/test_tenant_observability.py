@@ -1,0 +1,136 @@
+"""Tenancy observability: tracer events and Prometheus tenant series.
+
+Pins the ISSUE-11 observability contract: every tenant lifecycle operation
+emits a `tenancy/*` trace event with owner/bucket context, and the instrument
+registry exports `metrics_tpu_tenant_*` series — including the per-tenant
+label dimension on `metrics_tpu_tenant_updates_total` — in strictly parseable
+exposition format.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+import metrics_tpu as mt
+from metrics_tpu import observability as obs
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.observability.instruments import InstrumentRegistry
+from tests.observability.test_exporters import _StrictPromParser
+
+
+class TinyMean(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("count", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.total = self.total + jnp.sum(values)
+        self.count = self.count + float(np.prod(values.shape))
+
+    def compute(self):
+        return self.total / jnp.maximum(self.count, 1.0)
+
+
+def _exercised_set(name=None):
+    ts = mt.TenantSet(
+        mt.MetricCollection({"mean": TinyMean()}), capacity=8, name=name
+    )
+    for tid in ("a", "b", "c"):
+        ts.admit(tid)
+    ts.update(["a", "b", "c"], jnp.ones((3, 4), jnp.float32))
+    ts.update(["a", "b"], jnp.ones((2, 4), jnp.float32))
+    ts.compute()
+    ts.reset(["c"])
+    ts.evict("c")
+    return ts
+
+
+class TestTracer:
+    def test_lifecycle_emits_tenancy_events(self):
+        with obs.trace() as tracer:
+            _exercised_set()
+            counts = tracer.counts_by_name()
+        assert counts["tenancy/admit"] == 3
+        assert counts["tenancy/dispatch"] == 2
+        assert counts["tenancy/compute"] == 1
+        assert counts["tenancy/reset"] == 1
+        assert counts["tenancy/evict"] == 1
+
+    def test_dispatch_event_carries_bucket_context(self):
+        with obs.trace() as tracer:
+            ts = _exercised_set(name="svc")
+            events = [e for e in tracer.events() if e.name == "tenancy/dispatch"]
+        assert len(events) == 2
+        for ev, (k, bucket) in zip(events, ((3, 4), (2, 2))):
+            assert ev.args["owner"] == ts.name == "svc"
+            assert ev.args["tenants"] == k
+            assert ev.args["bucket"] == bucket  # exact pow2: 3 -> 4, 2 -> 2
+
+    def test_disabled_tracer_emits_nothing(self):
+        _exercised_set()
+        with obs.trace() as tracer:
+            counts = tracer.counts_by_name()
+        assert not any(n.startswith("tenancy/") for n in counts)
+
+
+class TestPrometheus:
+    def test_tenant_series_parse_strictly(self):
+        reg = InstrumentRegistry()
+        ts = _exercised_set(name="svc")
+        reg.register_tenant_set(ts)
+        text = obs.to_prometheus_text(reg)
+        families, samples = _StrictPromParser().parse(text)
+
+        by_name = {}
+        for name, labels, value in samples:
+            by_name.setdefault(name, []).append((labels, value))
+
+        gauges = {
+            "metrics_tpu_tenant_active": 2.0,  # c was evicted
+            "metrics_tpu_tenant_capacity": 8.0,
+            "metrics_tpu_tenant_bucket_width": 2.0,  # last dispatch was k=2
+            "metrics_tpu_tenant_executables": float(ts.stats.compiles),
+        }
+        for name, expect in gauges.items():
+            assert families[name]["type"] == "gauge"
+            (labels, value), = by_name[name]
+            assert labels == {"owner": "svc"}
+            assert value == expect
+
+        counters = {
+            "metrics_tpu_tenant_admits_total": 3.0,
+            "metrics_tpu_tenant_evicts_total": 1.0,
+            "metrics_tpu_tenant_resets_total": 1.0,
+            "metrics_tpu_tenant_dispatches_total": 2.0,
+        }
+        for name, expect in counters.items():
+            assert families[name]["type"] == "counter"
+            (labels, value), = by_name[name]
+            assert labels == {"owner": "svc"}
+            assert value == expect
+
+    def test_per_tenant_update_label_dimension(self):
+        reg = InstrumentRegistry()
+        ts = _exercised_set(name="svc")
+        reg.register_tenant_set(ts)
+        _, samples = _StrictPromParser().parse(obs.to_prometheus_text(reg))
+        updates = {
+            labels["tenant"]: value
+            for name, labels, value in samples
+            if name == "metrics_tpu_tenant_updates_total"
+        }
+        # only ACTIVE tenants get a series; the evicted c disappears
+        assert updates == {"a": 2.0, "b": 2.0}
+
+    def test_dead_set_drops_out_of_exposition(self):
+        reg = InstrumentRegistry()
+        ts = _exercised_set(name="svc")
+        reg.register_tenant_set(ts)
+        assert "metrics_tpu_tenant_active" in obs.to_prometheus_text(reg)
+        del ts  # weakref registration: a collected set leaves no stale series
+        import gc
+
+        gc.collect()
+        assert "metrics_tpu_tenant_active" not in obs.to_prometheus_text(reg)

@@ -1,0 +1,386 @@
+"""TenantSet core invariants (ISSUE 11): stacked dispatch bitwise parity vs
+independent per-tenant streams across ragged occupancies, pow2 bucket
+executable caching (occupancy churn never recompiles), masked-tenant state
+immutability, zero-recompile reset/evict/admit pinned through the dispatcher's
+``stable_hits`` counter, single-tenant export/import, and the user-error
+surface (duplicate ids, unadmitted tenants, capacity, bad templates)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.core.engine import PATH_EAGER, PATH_TENANT, classify_tenant_member
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+
+class TinyMean(Metric):
+    """Cheap dense-state metric so the 1024-tenant sweeps stay fast."""
+
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("count", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.total = self.total + jnp.sum(values)
+        self.count = self.count + float(np.prod(values.shape))
+
+    def compute(self):
+        return self.total / jnp.maximum(self.count, 1.0)
+
+
+class TinyMax(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("peak", default=jnp.full((), -jnp.inf, jnp.float32), dist_reduce_fx="max")
+
+    def update(self, values):
+        self.peak = jnp.maximum(self.peak, jnp.max(values))
+
+    def compute(self):
+        return self.peak
+
+
+def _tiny_set(capacity, n_admit=None):
+    ts = mt.TenantSet(
+        mt.MetricCollection({"mean": TinyMean(), "mx": TinyMax()}), capacity=capacity
+    )
+    for i in range(n_admit if n_admit is not None else capacity):
+        ts.admit(f"t{i}")
+    return ts
+
+
+# ----------------------------------------------------------- classification --
+class TestClassification:
+    def test_dense_elementwise_metric_stacks(self):
+        path, reason = classify_tenant_member(TinyMean())
+        assert path == PATH_TENANT and "stackable" in reason
+
+    def test_catbuffer_metric_is_eager(self):
+        path, reason = classify_tenant_member(mt.CatMetric())
+        assert path == PATH_EAGER
+
+    def test_partition_view_has_tenant_section(self):
+        ts = _tiny_set(4)
+        view = ts.partition_view()
+        assert set(view["tenant"]) == {"mean", "mx"}
+        assert all(info["path"] == PATH_TENANT for info in view["tenant"].values())
+
+    def test_eager_member_reason_is_reported(self):
+        ts = mt.TenantSet(
+            mt.MetricCollection({"mean": TinyMean(), "cat": mt.CatMetric()}), capacity=2
+        )
+        info = ts.partition_view()["tenant"]["cat"]
+        assert info["path"] == PATH_EAGER and info["reason"]
+
+
+# ------------------------------------------------------------------- parity --
+class TestOccupancyParity:
+    CAP = 1024
+
+    @pytest.mark.parametrize("k", [1, 37, 64, 1000])
+    def test_ragged_occupancy_bitwise_parity(self, k):
+        """k of 1024 active tenants: the stacked dispatch must be bit-for-bit
+        identical to k independent pure-protocol streams."""
+        ts = _tiny_set(self.CAP)
+        ids = ts.tenant_ids()
+        rng = np.random.default_rng(k)
+        ref_mean, ref_max = TinyMean(), TinyMax()
+        states = {}
+        touched = set()
+        for _ in range(2):
+            sel = rng.choice(self.CAP, size=k, replace=False)
+            vals = jnp.asarray(rng.normal(size=(k, 4)), jnp.float32)
+            ts.update([ids[i] for i in sel], vals)
+            for j, i in enumerate(sel):
+                sm, sx = states.get(i, (ref_mean.init_state(), ref_max.init_state()))
+                states[i] = (
+                    ref_mean.update_state(sm, vals[j]),
+                    ref_max.update_state(sx, vals[j]),
+                )
+                touched.add(int(i))
+        out = ts.compute([ids[i] for i in sorted(touched)])
+        for i in sorted(touched):
+            got = out[ids[i]]
+            assert np.array_equal(
+                np.asarray(got["mean"]), np.asarray(ref_mean.compute_state(states[i][0]))
+            )
+            assert np.array_equal(
+                np.asarray(got["mx"]), np.asarray(ref_max.compute_state(states[i][1]))
+            )
+
+    def test_real_collection_parity(self):
+        """Accuracy + MSE through the stacked path vs stateful collections."""
+        k, b, c = 3, 16, 4
+        template = mt.MetricCollection(
+            {"acc": mt.Accuracy(num_classes=c), "mse": mt.MeanSquaredError()}
+        )
+        ts = mt.TenantSet(template, capacity=8)
+        refs = {
+            f"t{i}": mt.MetricCollection(
+                {"acc": mt.Accuracy(num_classes=c), "mse": mt.MeanSquaredError()}
+            )
+            for i in range(k)
+        }
+        for tid in refs:
+            ts.admit(tid)
+        rng = np.random.default_rng(0)
+        for _ in range(3):
+            preds = jnp.asarray(rng.integers(0, c, (k, b)), jnp.int32)
+            target = jnp.asarray(rng.integers(0, c, (k, b)), jnp.int32)
+            ts.update(list(refs), preds, target)
+            for j, coll in enumerate(refs.values()):
+                coll.update(preds[j], target[j])
+        out = ts.compute()
+        for tid, coll in refs.items():
+            expect = coll.compute()
+            assert set(out[tid]) == set(expect)
+            for name in expect:
+                assert np.array_equal(
+                    np.asarray(out[tid][name]), np.asarray(expect[name])
+                ), (tid, name)
+
+    def test_batched_broadcast_and_static_leaves(self):
+        """A ``(k,)``-leading array is per-tenant rows, other arrays broadcast
+        to every tenant, python scalars are static config."""
+
+        class Scaled(Metric):
+            full_state_update = False
+
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_state("total", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+            def update(self, values, weight, gain):
+                self.total = self.total + jnp.sum(values * weight) * gain
+
+            def compute(self):
+                return self.total
+
+        ts = mt.TenantSet(mt.MetricCollection(Scaled()), capacity=4)
+        ts.admit("a"); ts.admit("b")
+        vals = jnp.asarray([[1.0, 2.0], [3.0, 4.0]], jnp.float32)  # per-tenant rows
+        weight = jnp.asarray([2.0, 0.5], jnp.float32)  # shape (2,) == k: per-tenant
+        ts.update(["a", "b"], vals, weight, 3.0)
+        out = ts.compute()
+        assert np.asarray(out["a"]["Scaled"]) == pytest.approx((1 + 2) * 2 * 3)
+        assert np.asarray(out["b"]["Scaled"]) == pytest.approx((3 + 4) * 0.5 * 3)
+        # broadcast leaf: shape (3,) != k, the same vector reaches both tenants
+        w3 = jnp.asarray([1.0, 2.0, 3.0], jnp.float32)
+        vals3 = jnp.asarray([[1.0, 1.0, 1.0], [2.0, 2.0, 2.0]], jnp.float32)
+        ts.update(["a", "b"], vals3, w3, 1.0)
+        out = ts.compute()
+        assert np.asarray(out["a"]["Scaled"]) == pytest.approx((1 + 2) * 2 * 3 + 6)
+        assert np.asarray(out["b"]["Scaled"]) == pytest.approx((3 + 4) * 0.5 * 3 + 12)
+
+
+# ------------------------------------------------------ executable caching --
+class TestBucketCaching:
+    def test_one_executable_across_occupancy_churn(self):
+        ts = _tiny_set(1024)
+        ids = ts.tenant_ids()
+        rng = np.random.default_rng(0)
+        vals = jnp.asarray(rng.normal(size=(37, 4)), jnp.float32)
+        ts.update(ids[:37], vals)
+        assert ts.stats.compiles == 1
+        for off in (1, 101, 500):  # different 37-subsets: same 64-wide bucket
+            ts.update(ids[off : off + 37], vals)
+        ts.update(ids[:33], vals[:33])  # 33 -> same pow2 bucket (64)
+        assert ts.stats.compiles == 1
+        assert ts.stats.cache_hits == 4
+        assert ts.stats.last_bucket == 64
+
+    def test_bucket_transition_compiles_once_per_width(self):
+        ts = _tiny_set(64)
+        ids = ts.tenant_ids()
+        vals = jnp.asarray(np.ones((40, 4), np.float32))
+        ts.update(ids[:40], vals)  # 64-wide bucket
+        ts.update(ids[:16], vals[:16])  # 16-wide bucket
+        ts.update(ids[:9], vals[:9])  # 16-wide bucket again
+        assert ts.stats.compiles == 2
+        ts.update(ids[:10], vals[:10])
+        assert ts.stats.compiles == 2  # still inside the 16 bucket
+
+    def test_reset_evict_admit_never_recompile_once_warm(self):
+        ts = _tiny_set(64)
+        ids = ts.tenant_ids()
+        vals = jnp.asarray(np.ones((5, 4), np.float32))
+        ts.update(ids[:5], vals)
+        ts.reset(ids[:5])  # first width-8 reset program
+        ts.evict(ids[0])  # first width-1 scrub program
+        ts.admit(ids[0])
+        warm = ts.stats.compiles
+        for _ in range(3):
+            ts.update(ids[:5], vals)
+            ts.reset(ids[1:6])
+            ts.evict(ids[2])
+            ts.admit(ids[2])
+        assert ts.stats.compiles == warm
+        # the template dispatcher's stability counters pin the same invariant
+        stats = ts._dispatcher.stats
+        assert stats.builds == 1
+        assert stats.repartitions == 0 and stats.migrations == 0
+        assert stats.stable_hits > 0
+
+    def test_compute_executable_is_cached(self):
+        ts = _tiny_set(16)
+        ids = ts.tenant_ids()
+        vals = jnp.asarray(np.ones((3, 4), np.float32))
+        ts.update(ids[:3], vals)
+        before = ts.stats.compiles
+        ts.compute(ids[:3])
+        assert ts.stats.compiles == before + 1
+        ts.compute(ids[1:4])
+        ts.compute(ids[:4])  # k=4 -> same pow2 bucket as k=3
+        assert ts.stats.compiles == before + 1
+        assert ts.stats.cache_hits == 2
+
+
+# -------------------------------------------------------------- immutability --
+class TestMaskedImmutability:
+    def test_absent_tenants_rows_are_bitwise_untouched(self):
+        ts = _tiny_set(8)
+        ids = ts.tenant_ids()
+        initial = {
+            ln: {k: np.asarray(v) for k, v in st.items()}
+            for ln, st in ts.stacked_states.items()
+        }
+        vals = jnp.asarray(np.random.default_rng(0).normal(size=(2, 4)), jnp.float32)
+        for _ in range(3):
+            ts.update([ids[0], ids[2]], vals)
+        untouched_rows = [1, 3, 4, 5, 6, 7]
+        for ln, st in ts.stacked_states.items():
+            for k, leaf in st.items():
+                assert np.array_equal(
+                    np.asarray(leaf)[untouched_rows], initial[ln][k][untouched_rows]
+                ), (ln, k)
+
+    def test_reset_of_some_leaves_others_mid_streak(self):
+        ts = _tiny_set(8)
+        ids = ts.tenant_ids()
+        vals = jnp.asarray(np.ones((3, 4), np.float32))
+        ts.update(ids[:3], vals)
+        before = np.asarray(ts.stacked_states["mean"]["total"]).copy()
+        ts.reset([ids[1]])
+        after = np.asarray(ts.stacked_states["mean"]["total"])
+        assert after[1] == 0.0
+        assert np.array_equal(after[[0, 2]], before[[0, 2]])
+
+    def test_evicted_slot_is_scrubbed_for_the_next_tenant(self):
+        ts = _tiny_set(4, n_admit=1)
+        ts.update(["t0"], jnp.asarray(np.ones((1, 4), np.float32)))
+        ts.evict("t0")
+        ts.admit("newcomer")
+        out = ts.compute(["newcomer"])
+        assert np.asarray(out["newcomer"]["mean"]) == 0.0  # defaults, not t0's streak
+
+
+# ----------------------------------------------------------- export / import --
+class TestExportImport:
+    def test_round_trip_is_bitwise(self):
+        ts = _tiny_set(8)
+        ids = ts.tenant_ids()
+        vals = jnp.asarray(np.random.default_rng(1).normal(size=(2, 4)), jnp.float32)
+        ts.update(ids[:2], vals)
+        snap = ts.export_tenant(ids[0])
+        other = _tiny_set(4, n_admit=0)
+        other.import_tenant("moved", snap)
+        a = ts.compute([ids[0]])[ids[0]]
+        b = other.compute(["moved"])["moved"]
+        for name in a:
+            assert np.array_equal(np.asarray(a[name]), np.asarray(b[name]))
+        assert other.tenant_update_counts()["moved"] == 1
+
+    def test_import_does_not_touch_other_rows(self):
+        ts = _tiny_set(8)
+        ids = ts.tenant_ids()
+        vals = jnp.asarray(np.ones((2, 4), np.float32))
+        ts.update(ids[:2], vals)
+        before = np.asarray(ts.stacked_states["mean"]["total"]).copy()
+        snap = ts.export_tenant(ids[0])
+        ts.import_tenant(ids[3], snap)
+        after = np.asarray(ts.stacked_states["mean"]["total"])
+        assert np.array_equal(after[[0, 1, 2]], before[[0, 1, 2]])
+        assert after[3] == before[0]
+
+
+# ------------------------------------------------------------- mixed / eager --
+class TestEagerGroups:
+    def test_mixed_stacked_and_eager_parity(self):
+        template = mt.MetricCollection({"mean": TinyMean(), "cat": mt.CatMetric()})
+        ts = mt.TenantSet(template, capacity=4)
+        ts.admit("a"); ts.admit("b")
+        vals = jnp.asarray([[1.0, 2.0], [3.0, 4.0]], jnp.float32)
+        ts.update(["a", "b"], vals)
+        ts.update(["b"], vals[:1] * 10)
+        out = ts.compute()
+        assert np.asarray(out["a"]["mean"]) == pytest.approx(1.5)
+        assert np.allclose(np.asarray(out["a"]["cat"]), [1.0, 2.0])
+        assert np.allclose(np.asarray(out["b"]["cat"]), [3.0, 4.0, 10.0, 20.0])
+        assert ts.stats.eager_tenant_updates == 3  # 2 tenants + 1 tenant
+
+    def test_fully_eager_template_works(self):
+        ts = mt.TenantSet(mt.CatMetric(), capacity=2)
+        ts.admit(0)
+        ts.update([0], jnp.asarray([[1.0, 2.0]], jnp.float32))
+        assert np.allclose(np.asarray(ts.compute()[0]["CatMetric"]), [1.0, 2.0])
+        assert ts.stats.compiles == 0  # nothing stacked, nothing traced
+
+
+# -------------------------------------------------------------------- errors --
+class TestErrors:
+    def test_duplicate_tenant_in_one_dispatch(self):
+        ts = _tiny_set(4)
+        with pytest.raises(MetricsUserError, match="duplicate tenant"):
+            ts.update(["t0", "t0"], jnp.zeros((2, 4), jnp.float32))
+
+    def test_unadmitted_tenant(self):
+        ts = _tiny_set(4, n_admit=1)
+        with pytest.raises(MetricsUserError, match="not admitted"):
+            ts.update(["ghost"], jnp.zeros((1, 4), jnp.float32))
+
+    def test_admit_twice(self):
+        ts = _tiny_set(4, n_admit=1)
+        with pytest.raises(MetricsUserError, match="already admitted"):
+            ts.admit("t0")
+
+    def test_admit_beyond_capacity(self):
+        ts = _tiny_set(2)
+        with pytest.raises(MetricsUserError, match="at capacity"):
+            ts.admit("overflow")
+
+    def test_evict_unknown(self):
+        ts = _tiny_set(2)
+        with pytest.raises(MetricsUserError, match="not admitted"):
+            ts.evict("ghost")
+
+    def test_bad_tenant_id_type(self):
+        ts = _tiny_set(4, n_admit=0)
+        for bad in (True, 1.5, ("a",)):
+            with pytest.raises(MetricsUserError, match="str or int"):
+                ts.admit(bad)
+
+    def test_bad_template_type(self):
+        with pytest.raises(MetricsUserError, match="Metric or MetricCollection"):
+            mt.TenantSet({"acc": mt.Accuracy()}, capacity=4)
+
+    def test_bad_capacity(self):
+        with pytest.raises(MetricsUserError, match="capacity"):
+            mt.TenantSet(TinyMean(), capacity=0)
+
+    def test_unhashable_static_arg(self):
+        # a set is a pytree *leaf* (unlike dict/list) and is unhashable
+        ts = _tiny_set(4)
+        with pytest.raises(MetricsUserError, match="hashable"):
+            ts.update(["t0"], jnp.zeros((1, 4), jnp.float32), {"unhashable", "set"})
+
+    def test_empty_dispatch_is_a_noop(self):
+        ts = _tiny_set(4)
+        ts.update([], jnp.zeros((0, 4), jnp.float32))
+        ts.reset([])
+        assert ts.stats.dispatches == 0 and ts.stats.compiles == 0

@@ -1,0 +1,142 @@
+"""Tenant-batched bucketed sync: collective-count independence and parity.
+
+Pins the ISSUE-11 sync contract: a TenantSet's cross-device sync folds the
+tenant axis into the flat (reduction, dtype) buckets, so the collective count
+per sync is independent of capacity N and of the number of stacked groups —
+and the synced values match a per-leaf tree_map of the reduction exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.parallel import count_collectives
+from metrics_tpu.parallel.sync import sync_stacked_states
+
+
+class TinyMean(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("count", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.total = self.total + jnp.sum(values)
+        self.count = self.count + float(np.prod(values.shape))
+
+    def compute(self):
+        return self.total / jnp.maximum(self.count, 1.0)
+
+
+class TinyMax(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("peak", default=jnp.full((), -jnp.inf), dist_reduce_fx="max")
+
+    def update(self, values):
+        self.peak = jnp.maximum(self.peak, jnp.max(values))
+
+    def compute(self):
+        return self.peak
+
+
+def _tenant_set(capacity, n_admit):
+    ts = mt.TenantSet(
+        mt.MetricCollection({"mean": TinyMean(), "mx": TinyMax()}),
+        capacity=capacity,
+    )
+    ids = [f"t{i}" for i in range(n_admit)]
+    for tid in ids:
+        ts.admit(tid)
+    ts.update(ids, jnp.arange(n_admit * 4, dtype=jnp.float32).reshape(n_admit, 4))
+    return ts, ids
+
+
+def _count(ts):
+    with count_collectives() as box:
+        jax.make_jaxpr(
+            lambda st: ts.sync_states(st, "data"), axis_env=[("data", 8)]
+        )(ts.stacked_states)
+    return box
+
+
+class TestCollectiveCount:
+    def test_count_independent_of_capacity(self):
+        small, _ = _tenant_set(16, 3)
+        large, _ = _tenant_set(1024, 37)
+        b_small, b_large = _count(small), _count(large)
+        # one (sum, f32) bucket + one (max, f32) bucket, regardless of N
+        assert b_small["count"] == b_large["count"] == 2
+        assert b_small["by_kind"] == b_large["by_kind"]
+
+    def test_count_independent_of_group_count(self):
+        one = mt.TenantSet(mt.MetricCollection({"mean": TinyMean()}), capacity=16)
+        one.admit("a")
+        one.update(["a"], jnp.ones((1, 4), jnp.float32))
+        two, _ = _tenant_set(16, 1)
+        # TinyMax adds a max bucket; TinyMean's two sum leaves share ONE bucket
+        assert _count(one)["count"] == 1
+        assert _count(two)["count"] == 2
+
+    def test_payload_scales_with_capacity(self):
+        small, _ = _tenant_set(16, 3)
+        large, _ = _tenant_set(1024, 37)
+        b_small, b_large = _count(small), _count(large)
+        assert b_large["bytes"] == b_small["bytes"] * (1024 // 16)
+
+
+class TestNumericParity:
+    def test_pmap_sum_and_max_parity(self):
+        n_dev = jax.local_device_count()
+        assert n_dev == 8  # pinned by tests/conftest.py's XLA flag
+        ts, _ = _tenant_set(8, 5)
+        base = ts.stacked_states
+        # distinct per-device replicas: device d holds base * (d + 1)
+        dev_stacked = jax.tree_util.tree_map(
+            lambda v: jnp.stack([v * (d + 1.0) for d in range(n_dev)]), base
+        )
+        synced = jax.pmap(
+            lambda st: ts.sync_states(st, "data"), axis_name="data"
+        )(dev_stacked)
+        scale = float(sum(range(1, n_dev + 1)))
+        for lname, st in base.items():
+            for name, leaf in st.items():
+                got = np.asarray(synced[lname][name])
+                ref = np.asarray(leaf)
+                if name == "peak":
+                    expect = ref * n_dev  # max over d of ref*(d+1)
+                else:
+                    expect = ref * scale
+                for d in range(n_dev):
+                    np.testing.assert_array_equal(got[d], expect)
+
+    def test_no_axis_is_identity(self):
+        ts, _ = _tenant_set(8, 3)
+        synced = ts.sync_states(ts.stacked_states, None)
+        for lname, st in ts.stacked_states.items():
+            for name, leaf in st.items():
+                np.testing.assert_array_equal(
+                    np.asarray(synced[lname][name]), np.asarray(leaf)
+                )
+
+
+class TestErrors:
+    def test_non_elementwise_reduction_raises(self):
+        states = {"m": {"buf": jnp.zeros((4, 2), jnp.float32)}}
+        reductions = {"m": {"buf": "cat"}}
+
+        def trace():
+            jax.make_jaxpr(
+                lambda st: sync_stacked_states(st, reductions, "data"),
+                axis_env=[("data", 8)],
+            )(states)
+
+        with pytest.raises(ValueError, match="non-elementwise"):
+            trace()
