@@ -1583,6 +1583,328 @@ def bench_sharded_state() -> None:
     print(json.dumps(record), flush=True)
 
 
+def _sharded_compute_child() -> None:
+    """``--child sharded_compute``: the gather-free finalize on the 8-device
+    CPU mesh (device count forced by the parent's XLA_FLAGS).
+
+    For each config the child traces ``sync_compute_state`` both ways under
+    ``count_collectives`` — the reshard fallback
+    (``compute_state(sync_states(...))``) vs the shipped routing (which takes
+    the ``compute_sharded_state`` protocol for declarers) — and, for the big
+    states, times both paths as jitted ``shard_map`` programs over the same
+    sharded global state. Protocol metrics must spend zero ``"reshard"``
+    bytes and match the replicated twin."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from metrics_tpu import (
+        Accuracy,
+        BinnedPrecisionRecallCurve,
+        ConfusionMatrix,
+        F1Score,
+        MatthewsCorrCoef,
+        MetricCollection,
+        Precision,
+        Recall,
+    )
+    from metrics_tpu.parallel import count_collectives, make_mesh
+
+    world = int(os.environ.get("BENCH_SHARD_WORLD", "8"))
+    devices = jax.devices()
+    if len(devices) < world:
+        raise RuntimeError(f"expected {world} forced host devices, got {len(devices)}")
+    mesh = make_mesh([world], ["data"], devices[:world])
+    rng = np.random.default_rng(0)
+
+    def _activated(m, update_args, n_steps=2):
+        """Updated replicated metric with the analyzer-style placement
+        sentinel: full-shaped state, ``active_shard_axes`` live, no device
+        placement needed for tracing (shard_map splits it at run time)."""
+        for a in update_args[:n_steps]:
+            m.update(*a)
+        state = {k: getattr(m, k) for k in m._defaults}
+        m._state_sharding = (mesh, "data")
+        return m, state
+
+    def trace_paths(m, state) -> dict:
+        """Trace-time bytes-by-kind: reshard fallback vs shipped routing.
+
+        Both functions see what ``shard_map`` would hand them — sharded
+        leaves as this device's local block, replicated leaves full-shaped."""
+        local = {}
+        for k, v in state.items():
+            ax = m.active_shard_axes.get(k)
+            local[k] = (
+                v if ax is None else jax.lax.slice_in_dim(v, 0, v.shape[ax] // world, axis=ax)
+            )
+        out = {}
+        for key, fn in (
+            ("fallback", lambda s: m.compute_state(m.sync_states(s, "data"))),
+            ("routed", lambda s: m.sync_compute_state(s, "data")),
+        ):
+            with count_collectives() as box:
+                jax.make_jaxpr(fn, axis_env=[("data", world)])(local)
+            out[key] = {
+                "bytes_by_kind": dict(box["bytes_by_kind"]),
+                "collectives_by_kind": dict(box["by_kind"]),
+            }
+        return out
+
+    def timed_paths(m, state, reps=20) -> dict:
+        """us/step for both finalize paths as jitted shard_map programs."""
+        in_specs = (
+            {k: P("data") if m.active_shard_axes.get(k) is not None else P() for k in state},
+        )
+
+        def _program(fn):
+            return jax.jit(
+                shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=P(), check_rep=False)
+            )
+
+        out = {}
+        for key, fn in (
+            ("fallback", lambda s: m.compute_state(m.sync_states(s, "data"))),
+            ("routed", lambda s: m.sync_compute_state(s, "data")),
+        ):
+            prog = _program(fn)
+            jax.block_until_ready(prog(state))  # compile + warm
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(prog(state))
+                ts.append((time.perf_counter() - t0) * 1e6)
+            out[f"{key}_us_per_step"] = round(float(np.median(ts)), 1)
+        routed = _program(lambda s: m.sync_compute_state(s, "data"))
+        out["routed_result"] = routed(state)
+        return out
+
+    def _equal(a, b, exact=True):
+        la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+        cmp = (
+            (lambda x, y: np.array_equal(np.asarray(x), np.asarray(y), equal_nan=True))
+            if exact
+            else (lambda x, y: np.allclose(np.asarray(x), np.asarray(y), rtol=1e-6, atol=1e-6))
+        )
+        return len(la) == len(lb) and all(cmp(x, y) for x, y in zip(la, lb))
+
+    def run_big(build, update_args, exact=True) -> dict:
+        ref = build()
+        for a in update_args[:2]:
+            ref.update(*a)
+        expect = ref.compute()
+        m, state = _activated(build(), update_args)
+        rec = trace_paths(m, state)
+        rec.update(timed_paths(m, state))
+        rec["equal_vs_replicated"] = bool(_equal(expect, rec.pop("routed_result"), exact))
+        rec["supports_protocol"] = bool(m.supports_sharded_compute)
+        return rec
+
+    # --- config2: per-member before/after routing ---------------------------
+    coll = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES, average="micro"),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "precision": Precision(num_classes=NUM_CLASSES, average="macro"),
+            "recall": Recall(num_classes=NUM_CLASSES, average="macro"),
+        }
+    )
+    logits = jnp.asarray(rng.normal(size=(BATCH, NUM_CLASSES)), dtype=jnp.float32)
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=jnp.int32)
+    config2 = {}
+    for name, member in coll.items():
+        m, state = _activated(member, [(logits, target)] * 2)
+        config2[name] = {
+            "supports_protocol": bool(m.supports_sharded_compute),
+            **trace_paths(m, state),
+        }
+
+    # --- the big states: trace bytes + timed shard_map finalize -------------
+    c = 4096
+    cm_args = [
+        (
+            jnp.asarray(rng.integers(0, c, size=(8192,)), dtype=jnp.int32),
+            jnp.asarray(rng.integers(0, c, size=(8192,)), dtype=jnp.int32),
+        )
+        for _ in range(2)
+    ]
+    confusion = run_big(lambda: ConfusionMatrix(num_classes=c, normalize="true"), cm_args)
+    matthews = run_big(lambda: MatthewsCorrCoef(num_classes=c), cm_args)
+
+    bc, bt = 1024, 64
+    pr_args = [
+        (
+            jnp.asarray(rng.random((2048, bc), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, bc, size=(2048,)), dtype=jnp.int32),
+        )
+        for _ in range(2)
+    ]
+    binned = run_big(
+        lambda: BinnedPrecisionRecallCurve(num_classes=bc, thresholds=bt), pr_args
+    )
+
+    print(
+        json.dumps(
+            {
+                "world": world,
+                "config2": config2,
+                "confusion_4096": confusion,
+                "matthews_4096": matthews,
+                "binned_pr_1024x64": binned,
+            }
+        ),
+        flush=True,
+    )
+
+
+def bench_sharded_compute() -> None:
+    """``--sharded-compute``: the sharded-compute protocol on the 8-device
+    mesh (reshard bytes before vs after, finalize us/step both ways) plus the
+    streaming restore plan's modeled peak vs the gather-everything baseline,
+    recorded into ``BENCH_r17.json`` and judged by the regression watchdog.
+    Host-side CPU bench (forced device counts in a child process)."""
+    import glob as _glob
+    import shutil
+    import tempfile
+
+    from metrics_tpu.observability import regress as _regress
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_SHARD_WORLD"] = "8"
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    child = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", "sharded_compute"],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=1500.0,
+        cwd=REPO,
+    )
+    if child.returncode != 0:
+        raise RuntimeError(f"sharded-compute child failed:\n{child.stderr[-2000:]}")
+    mesh8 = json.loads(child.stdout.strip().splitlines()[-1])
+
+    # --- restore: streaming reshard plan vs gather-everything ---------------
+    # An 8-host ConfusionMatrix checkpoint folded onto 2 hosts: host 0 claims
+    # 4 shards; the plan holds one payload resident at a time.
+    from metrics_tpu import ConfusionMatrix
+    from metrics_tpu.checkpoint import restore_checkpoint, save_checkpoint
+
+    c, n_hosts, m_hosts = 2048, 8, 2
+    rng = np.random.default_rng(1)
+    tmp = tempfile.mkdtemp(prefix="bench_reshard_plan_")
+    try:
+        root = os.path.join(tmp, "ckpt")
+        for i in range(n_hosts):
+            m = ConfusionMatrix(num_classes=c)
+            m.update(
+                rng.integers(0, c, size=(4096,)).astype(np.int32),
+                rng.integers(0, c, size=(4096,)).astype(np.int32),
+            )
+            save_checkpoint(m, root, step=0, shard_index=i, world_size=n_hosts)
+        t0 = time.perf_counter()
+        info = restore_checkpoint(
+            ConfusionMatrix(num_classes=c), root, host_index=0, host_count=m_hosts
+        )
+        restore_ms = round((time.perf_counter() - t0) * 1e3, 3)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    restore = {
+        "config": f"confmat{c}_{n_hosts}to{m_hosts}",
+        "shards_loaded": list(info.shards_loaded),
+        "plan_peak_bytes": int(info.plan_peak_bytes),
+        "gather_peak_bytes": int(info.gather_peak_bytes),
+        "measured_peak_bytes": int(info.measured_peak_bytes),
+        "peak_reduction_x": round(info.gather_peak_bytes / max(1, info.plan_peak_bytes), 2),
+        "restore_wall_ms": restore_ms,
+    }
+
+    confusion = mesh8["confusion_4096"]
+    reshard_after = int(confusion["routed"]["bytes_by_kind"].get("reshard", 0))
+    record = {
+        # headline: reshard bytes spent by the 4096-class confusion matrix's
+        # finalize on the 8-device mesh — the protocol's whole point is zero
+        "metric": "sharded_compute_confmat4096_reshard_bytes",
+        "value": reshard_after,
+        "unit": "bytes",
+        "extra": {
+            "world": mesh8["world"],
+            "fallback_reshard_bytes": int(
+                confusion["fallback"]["bytes_by_kind"].get("reshard", 0)
+            ),
+            "confmat4096_routed_us_per_step": confusion["routed_us_per_step"],
+            "confmat4096_fallback_us_per_step": confusion["fallback_us_per_step"],
+            "confusion_4096": confusion,
+            "matthews_4096": mesh8["matthews_4096"],
+            "binned_pr_1024x64": mesh8["binned_pr_1024x64"],
+            "config2_members": mesh8["config2"],
+            "restore": restore,
+        },
+    }
+
+    # watchdog self-check: judge this round against the checked-in trajectory
+    rounds = [
+        r
+        for r in _regress.load_rounds(sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json"))))
+        if r.name != "r17"
+    ]
+    rounds.append(_regress.Round("r17", "<this-run>", record))
+    report = _regress.check_trajectory(rounds)
+    record["extra"]["regress"] = {
+        "ok": report.ok,
+        "regression_count": len(report.regressions),
+        "keys_checked": report.keys_checked,
+        "regressions": [r.describe() for r in report.regressions],
+    }
+
+    with open(os.path.join(REPO, "BENCH_r17.json"), "w") as fh:
+        json.dump(record, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(record), flush=True)
+
+    problems = []
+    for name, rec in (
+        ("confusion_4096", confusion),
+        ("matthews_4096", mesh8["matthews_4096"]),
+        ("binned_pr_1024x64", mesh8["binned_pr_1024x64"]),
+    ):
+        got = int(rec["routed"]["bytes_by_kind"].get("reshard", 0))
+        if got != 0:
+            problems.append(f"{name}: protocol path spent {got} reshard bytes (want 0)")
+        if not rec["equal_vs_replicated"]:
+            problems.append(f"{name}: sharded finalize diverged from the replicated twin")
+    for name in ("precision", "recall"):
+        got = int(mesh8["config2"][name]["routed"]["bytes_by_kind"].get("reshard", 0))
+        if got != 0:
+            problems.append(f"config2.{name}: protocol path spent {got} reshard bytes")
+    if int(mesh8["config2"]["f1"]["routed"]["bytes_by_kind"].get("reshard", 0)) == 0:
+        problems.append(
+            "config2.f1: non-declarer spent zero reshard bytes — the MRO guard "
+            "should have routed it through the fallback"
+        )
+    if not restore["plan_peak_bytes"] < restore["gather_peak_bytes"]:
+        problems.append(
+            f"restore plan peak {restore['plan_peak_bytes']} not below gather "
+            f"baseline {restore['gather_peak_bytes']}"
+        )
+    if not restore["measured_peak_bytes"] < restore["gather_peak_bytes"]:
+        problems.append(
+            f"measured restore peak {restore['measured_peak_bytes']} not below "
+            f"gather baseline {restore['gather_peak_bytes']}"
+        )
+    if not report.ok:
+        problems.extend(r.describe() for r in report.regressions)
+    if problems:
+        print("[bench] sharded-compute round FAILED its gates:", file=sys.stderr)
+        for p in problems:
+            print(f"[bench]   {p}", file=sys.stderr)
+        sys.exit(1)
+
+
 def bench_observability() -> None:
     """``--observability``: tracer on/off overhead on the config2 fused
     update (the ISSUE-7 hard rule: tracer *off* must not move the 4x fused
@@ -2507,7 +2829,17 @@ def main() -> None:
         help="measure replicated-vs-sharded per-device state bytes and "
         "collective bytes at mesh widths 1/4/8 and record into BENCH_r11.json",
     )
-    parser.add_argument("--child", choices=["sync_overhead", "sharded_state", *_CHILD_BENCHES])
+    parser.add_argument(
+        "--sharded-compute",
+        action="store_true",
+        help="measure the gather-free sharded-compute protocol (reshard bytes "
+        "before vs after, finalize us/step both ways on the 8-device mesh) and "
+        "the streaming restore plan's peak-vs-gather bytes; record into "
+        "BENCH_r17.json and judge with the regression watchdog",
+    )
+    parser.add_argument(
+        "--child", choices=["sync_overhead", "sharded_state", "sharded_compute", *_CHILD_BENCHES]
+    )
     parser.add_argument(
         "--sync-scaling",
         action="store_true",
@@ -2547,6 +2879,9 @@ def main() -> None:
     if args.sharded_state:
         bench_sharded_state()
         return
+    if args.sharded_compute:
+        bench_sharded_compute()
+        return
     if args.sync_scaling:
         out = {}
         for w in (2, 4, 8, 16):
@@ -2563,6 +2898,9 @@ def main() -> None:
         return
     if args.child == "sharded_state":
         _sharded_state_child()
+        return
+    if args.child == "sharded_compute":
+        _sharded_compute_child()
         return
     if args.child in _CHILD_BENCHES:
         import jax

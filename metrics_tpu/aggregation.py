@@ -17,7 +17,7 @@ from typing import Any, Callable, List, Tuple, Union
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.core.metric import Metric
+from metrics_tpu.core.metric import Metric, StateDict
 from metrics_tpu.utils.checks import _is_concrete
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -189,6 +189,20 @@ class CatMetric(BaseAggregator):
         if isinstance(self.value, list) and self.value:  # metrics-tpu: allow[A002] — eager-only list branch; the CatBuffer branch is the compiled path
             return dim_zero_cat(self.value)
         return self.value
+
+    def compute_sharded_state(self, state: StateDict, axis_name: str) -> Array:
+        from metrics_tpu.core.buffers import CatBuffer
+
+        value = state["value"]
+        if isinstance(value, CatBuffer):
+            # a buffer gather is the result-sized collective here: it ticks
+            # "all_gather" (CatBuffer.gather), never "reshard"
+            if value.materialized:
+                value = value.gather(axis_name)
+            return value.to_array() if value else jnp.zeros((0,))
+        if isinstance(value, list) and value:  # metrics-tpu: allow[A002] — eager-only list branch mirrors compute()
+            return dim_zero_cat(value)
+        return value
 
 
 class MeanMetric(BaseAggregator):

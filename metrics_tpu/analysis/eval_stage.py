@@ -17,6 +17,11 @@ traces its pure protocol *without running a single FLOP*:
   metric's ``sync_states`` must not route more psum/all_gather *bytes* than
   the canonical sharded ``sync_state`` — a sync override that reduces a
   sharded leaf's disjoint blocks as if replicated is numerically wrong.
+* a **reshard-at-compute leg** (E111) for shard_axis declarers without
+  ``compute_sharded_state``: the jaxpr of ``compute_state`` is scanned for
+  reduction primitives that collapse a dimension of the sharded extent — a
+  statically shard-reducible finalize that still re-materializes the tiled
+  state is left-on-the-table headroom, flagged as a warning.
 """
 from __future__ import annotations
 
@@ -190,6 +195,91 @@ def _evaluate_sharded(entry: Entry, inst: Any, state: Any) -> List[Finding]:
                     },
                 )
             )
+    return findings
+
+
+# reductions whose jaxpr `axes` param names the array dimensions they collapse
+_REDUCE_PRIMS = frozenset(
+    {"reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+     "reduce_and", "reduce_or", "argmax", "argmin"}
+)
+
+
+def _sub_jaxprs(params: Dict[str, Any]):
+    """Nested jaxprs inside an eqn's params (pjit bodies, cond branches, ...)."""
+    for v in params.values():
+        for item in v if isinstance(v, (list, tuple)) else (v,):
+            if hasattr(item, "jaxpr"):  # ClosedJaxpr
+                yield item.jaxpr
+            elif hasattr(item, "eqns"):  # Jaxpr
+                yield item
+
+
+def _reduced_extents(jaxpr: Any) -> set:
+    """Dimension sizes collapsed by a reduction primitive anywhere in the
+    jaxpr (recursing through call/cond bodies)."""
+    out: set = set()
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in _REDUCE_PRIMS:
+            shape = tuple(getattr(eqn.invars[0].aval, "shape", ()))
+            for ax in eqn.params.get("axes", ()):
+                if -len(shape) <= ax < len(shape):
+                    out.add(int(shape[ax]))
+        for sub in _sub_jaxprs(eqn.params):
+            out |= _reduced_extents(sub)
+    return out
+
+
+def _evaluate_reshard_at_compute(entry: Entry, inst: Any, state: Any) -> List[Finding]:
+    """The E111 leg: shard_axis declarers that still pay reshard-at-compute.
+
+    A metric whose finalize reduces *over* its sharded dimension could run
+    ``compute`` on the local shard block and combine only the result — the
+    sharded-compute protocol — but without ``compute_sharded_state`` the sync
+    stage re-materializes the tiled state first. The probe is static: trace
+    ``compute_state`` and look for a reduction primitive collapsing a
+    dimension whose size matches a sharded leaf's extent. Extent matching can
+    false-positive on a coincidentally equal-sized unsharded dimension, which
+    is why this is a warning with a spec-level ``allow`` escape, not an error.
+    """
+    findings: List[Finding] = []
+    # tuple (multi-axis) placements never route the protocol, so they are
+    # not headroom the protocol could claim; single-int declarations only
+    declared = {n: a for n, a in dict(inst.shard_axes).items() if isinstance(a, int)}
+    if not declared or inst.supports_sharded_compute or not isinstance(state, dict):
+        return findings
+    extents: Dict[str, int] = {}
+    for name, ax in declared.items():
+        shape = tuple(getattr(state.get(name), "shape", ()))
+        if shape and -len(shape) <= ax < len(shape):
+            extents[name] = int(shape[ax])
+    if not extents:
+        return findings
+    try:
+        traced = jax.make_jaxpr(inst.compute_state)(state)
+    except Exception as e:  # noqa: BLE001 — untraceable compute is E107's beat
+        entry.notes.append(f"reshard-at-compute probe skipped: {_err(e)}")
+        return findings
+    reduced = _reduced_extents(traced.jaxpr)
+    hits = sorted(name for name, dim in extents.items() if dim in reduced)
+    if hits:
+        findings.append(
+            Finding(
+                rule="E111",
+                obj=entry.name,
+                message=f"compute reduces over the sharded extent of state "
+                f"{', '.join(hits)} (shard_axis={ {n: declared[n] for n in hits} }) "
+                "but the metric ships no compute_sharded_state — every sharded "
+                "finalize re-materializes the tiled state before reducing it; "
+                "declare the sharded-compute protocol to combine only the "
+                "result instead",
+                extra={
+                    "states": hits,
+                    "shard_axes": {n: int(declared[n]) for n in hits},
+                    "extents": {n: extents[n] for n in hits},
+                },
+            )
+        )
     return findings
 
 
@@ -419,6 +509,9 @@ def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Findi
     # ---------------------------------------------------------- sharded leg --
     findings.extend(_evaluate_sharded(entry, inst, state))
 
+    # ------------------------------------------------ reshard-at-compute leg --
+    findings.extend(_evaluate_reshard_at_compute(entry, inst, state))
+
     # ----------------------------------------------------------- tenant leg --
     tpath, treason = classify_tenant_member(inst)
     if tpath != "tenant_stacked":
@@ -429,7 +522,7 @@ def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Findi
                 message=f"not tenant-stackable: {treason} — a TenantSet holding this "
                 f"metric runs its compute group as per-tenant eager clones and "
                 f"refuses to checkpoint",
-                extra={"tenant_path": tpath},
+                extra={"tenant_path": tpath, "tenant_reason": treason},
             )
         )
 

@@ -189,6 +189,17 @@ _EVAL_RULES = (
         "instead of one vmapped executable, and the set refuses to "
         "checkpoint.",
     ),
+    Rule(
+        "E111", "reshard-at-compute", WARNING,
+        "this metric declares shard_axis state and its finalize is statically "
+        "shard-reducible (a reduction primitive in the compute_state jaxpr "
+        "collapses a dimension of the sharded extent), yet it ships no "
+        "compute_sharded_state — with sharded state active every finalize "
+        "re-materializes the tiled state (billed as \"reshard\" bytes) before "
+        "reducing it; implement the sharded-compute protocol (compute on the "
+        "local block, combine only the result via psum_result/gather_result) "
+        "to make compute gather-free.",
+    ),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in (*_AST_RULES, *_EVAL_RULES)}

@@ -52,9 +52,11 @@ from metrics_tpu.checkpoint.io import (
     write_shard,
 )
 from metrics_tpu.checkpoint.restore import (
+    ReshardPlan,
     RestoreInfo,
     VerifyReport,
     assign_shards,
+    build_reshard_plan,
     merge_shards,
     restore_checkpoint,
     verify_all,
@@ -89,8 +91,10 @@ __all__ = [
     "get_retry_policy",
     "set_retry_policy",
     "use_retry_policy",
+    "ReshardPlan",
     "RestoreInfo",
     "VerifyReport",
+    "build_reshard_plan",
     "save_checkpoint",
     "restore_checkpoint",
     "verify_checkpoint",

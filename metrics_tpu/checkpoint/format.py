@@ -55,6 +55,14 @@ SELF_KEY = "__self__"
 MERGEABLE_TAGS = ("sum", "mean", "max", "min", "cat", "none")
 
 
+def shard_axis_meta(shard_axis: Any) -> Any:
+    """JSON-stable form of a declared shard axis: int, or list for the
+    multi-axis (tuple) declarations placed over 2-D+ meshes."""
+    if isinstance(shard_axis, (tuple, list)):
+        return [int(a) for a in shard_axis]
+    return int(shard_axis)
+
+
 def reduction_tag(red: Any) -> str:
     """Stable string form of a ``dist_reduce_fx`` for the manifest."""
     if red is None:
@@ -141,7 +149,7 @@ def metric_leaves(metric: Metric, prefix: str) -> Tuple[Dict[str, np.ndarray], D
                 "materialized": bool(val.materialized),
             }
             if shard_axis is not None:
-                entry["shard_axis"] = int(shard_axis)
+                entry["shard_axis"] = shard_axis_meta(shard_axis)
             if val.materialized:
                 arr = np.asarray(val.to_array())  # raises loudly on overflow
                 payload[key] = arr
@@ -170,7 +178,7 @@ def metric_leaves(metric: Metric, prefix: str) -> Tuple[Dict[str, np.ndarray], D
                 "shape": [int(s) for s in arr.shape],
             }
             if shard_axis is not None:
-                meta[name]["shard_axis"] = int(shard_axis)
+                meta[name]["shard_axis"] = shard_axis_meta(shard_axis)
     return payload, meta
 
 
@@ -215,7 +223,7 @@ def metric_fingerprint(metric: Metric) -> Dict[str, Any]:
         # declaration, so checkpoints written before a class gained (or after
         # it lost) the declaration stay restorable
         if metric._shard_axes.get(name) is not None:
-            states[name]["shard_axis"] = int(metric._shard_axes[name])
+            states[name]["shard_axis"] = shard_axis_meta(metric._shard_axes[name])
     sig = metric._update_signature()
     return {
         "class": type(metric).__name__,

@@ -18,6 +18,15 @@ metric's own :meth:`~metrics_tpu.Metric.merge_states` — the same primitive
 that backs cross-batch accumulation and cross-device sync — so a folded
 restore is bitwise-identical to having accumulated on fewer hosts from the
 start for all mergeable reductions.
+
+**The reshard plan**: shard folding is *streamed*, never gathered. Before any
+payload is read, the manifest metadata is compiled into an explicit
+:class:`ReshardPlan` — a load → fold → free step sequence per assigned shard
+with byte estimates — and the executor walks it one shard at a time, merging
+into the running fold and dropping each payload before loading the next. Peak
+host memory is bounded by O(folded state + one transfer block) instead of the
+gather-everything O(sum of assigned payloads + state); the plan, both modeled
+peaks, and the measured resident peak are surfaced on :class:`RestoreInfo`.
 """
 from __future__ import annotations
 
@@ -44,6 +53,26 @@ from metrics_tpu.core.metric import Metric
 
 
 @dataclass
+class ReshardPlan:
+    """Minimal-collective fold schedule for one host's assigned shards.
+
+    Compiled from manifest metadata alone (no payload reads): per shard a
+    ``load`` (npz into host memory, transfer-block bytes), a ``fold`` (merge
+    into the running state; bytes = modeled resident folded state after the
+    merge) and a ``free`` (payload dropped). ``plan_peak_bytes`` is the
+    modeled streaming peak — max over steps of folded state + the one live
+    transfer block; ``gather_peak_bytes`` models the load-everything
+    alternative that holds every assigned payload while folding.
+    """
+
+    world_size: int                 # shards the checkpoint was written with
+    shards: Tuple[int, ...]         # this host's assigned shard indices
+    steps: List[Dict[str, Any]] = field(default_factory=list)
+    plan_peak_bytes: int = 0
+    gather_peak_bytes: int = 0
+
+
+@dataclass
 class RestoreInfo:
     """What a restore actually did (returned by ``restore_checkpoint``)."""
 
@@ -60,6 +89,14 @@ class RestoreInfo:
     # newest committed step that failed verification when this restore fell
     # back to an older verifiable one (None on the normal path)
     fallback_from: Optional[int] = None
+    # the executed fold schedule (None for tenant-set restores, which load
+    # exactly one host-local shard and never fold)
+    reshard_plan: Optional[ReshardPlan] = None
+    # convenience mirrors of the plan's modeled peaks plus the *observed*
+    # resident peak (payload + folded state bytes) during the streaming fold
+    plan_peak_bytes: int = 0
+    gather_peak_bytes: int = 0
+    measured_peak_bytes: int = 0
 
 
 @dataclass
@@ -138,6 +175,84 @@ def fold_member_shards(
         state = metric.merge_states(state, incoming, (count, inc_count))
         count += inc_count
     return state, count
+
+
+def _entry_decoded_bytes(entry: Dict[str, Any]) -> Tuple[int, int]:
+    """``(dense_bytes, concat_bytes)`` decoded-state estimate for one shard.
+
+    Dense mergeable leaves (sum/mean/max/min arrays) keep their shape across
+    folds — one resident copy regardless of shard count; concatenating leaves
+    (``cat`` arrays, materialized CatBuffer prefixes) accumulate per shard.
+    List-leaf element shapes live only in the payload, so they are covered by
+    the transfer-block term (the manifest's npz ``bytes``), not the state term.
+    """
+    dense = 0
+    concat = 0
+    for mmeta in entry["members"].values():
+        for meta in (mmeta.get("leaves") or {}).values():
+            kind = meta["kind"]
+            if kind == "array":
+                n = 1
+                for s in meta["shape"]:
+                    n *= int(s)
+                nb = n * np.dtype(meta["dtype"]).itemsize
+                if meta["reduction"] == "cat":
+                    concat += nb
+                else:
+                    dense += nb
+            elif kind == "catbuffer" and meta.get("materialized"):
+                n = int(meta["count"])
+                for s in meta.get("item_shape", []):
+                    n *= int(s)
+                concat += n * np.dtype(meta["dtype"]).itemsize
+    return dense, concat
+
+
+def build_reshard_plan(manifest: Dict[str, Any], shards: Tuple[int, ...]) -> ReshardPlan:
+    """Compile the streaming fold schedule for ``shards`` from the manifest.
+
+    Pure metadata: byte figures come from the recorded npz sizes and per-leaf
+    shape/dtype entries, so the plan (and its peak bound) exists before any
+    payload I/O happens.
+    """
+    entries = {int(s["shard_index"]): s for s in manifest["shards"]}
+    steps: List[Dict[str, Any]] = []
+    dense = 0
+    concat_cum = 0
+    plan_peak = 0
+    payload_total = 0
+    for idx in shards:
+        entry = entries[idx]
+        nbytes = int(entry["bytes"])
+        payload_total += nbytes
+        d, c = _entry_decoded_bytes(entry)
+        dense = max(dense, d)
+        concat_cum += c
+        steps.append({"op": "load", "shard": idx, "bytes": nbytes})
+        steps.append({"op": "fold", "shard": idx, "bytes": dense + concat_cum})
+        steps.append({"op": "free", "shard": idx, "bytes": nbytes})
+        plan_peak = max(plan_peak, dense + concat_cum + nbytes)
+    return ReshardPlan(
+        world_size=int(manifest["world_size"]),
+        shards=tuple(shards),
+        steps=steps,
+        plan_peak_bytes=plan_peak,
+        gather_peak_bytes=payload_total + dense + concat_cum,
+    )
+
+
+def _state_resident_nbytes(state: Dict[str, Any]) -> int:
+    """Resident host/device bytes of one decoded or folded member state."""
+    total = 0
+    for val in state.values():
+        if isinstance(val, CatBuffer):
+            if val.materialized:
+                total += int(val.data.nbytes)
+        elif isinstance(val, (list, tuple)):
+            total += sum(int(getattr(v, "nbytes", 0)) for v in val)
+        else:
+            total += int(getattr(val, "nbytes", 0))
+    return total
 
 
 def assign_shards(world_size: int, host_index: int, host_count: int) -> Tuple[int, ...]:
@@ -231,26 +346,44 @@ def restore_checkpoint(
             world_size = int(manifest["world_size"])
             mine = assign_shards(world_size, host_index, host_count)
             shard_entries = {int(s["shard_index"]): s for s in manifest["shards"]}
-            loaded: List[Tuple[int, Dict[str, np.ndarray], Dict[str, Any]]] = []
+            plan = build_reshard_plan(manifest, mine)
+            folded: Dict[str, Tuple[Dict[str, Any], int]] = {}
+            first_entry: Optional[Dict[str, Any]] = None
+            measured_peak = 0
+            # walk the plan: load one shard, fold it into every member's
+            # running state, free the payload before the next load. The merge
+            # order matches :func:`fold_member_shards` left-to-right, so the
+            # streamed result is bitwise-identical to the gather-everything
+            # fold — only the peak host footprint changes
             for idx in mine:
                 entry = shard_entries[idx]
-                loaded.append(
-                    (idx, _io.load_shard_payload(root, cand, entry, verify=verify_payload), entry)
-                )
-            folded: Dict[str, Tuple[Dict[str, Any], int]] = {}
-            for key, metric in members.items():
-                if not loaded:
-                    # more restore hosts than shards: this host starts from defaults
-                    folded[key] = ({k: v for k, v in metric.init_state().items()}, 0)
-                    continue
-                states, counts = [], []
-                leaves = None
-                for _idx, payload, entry in loaded:
+                payload = _io.load_shard_payload(root, cand, entry, verify=verify_payload)
+                if first_entry is None:
+                    first_entry = entry
+                payload_nbytes = sum(int(a.nbytes) for a in payload.values())
+                for key, metric in members.items():
                     mmeta = entry["members"][key]
                     leaves = mmeta["leaves"]
-                    states.append(_decode_member_state(payload, key, leaves))
-                    counts.append(int(mmeta["update_count"]))
-                folded[key] = fold_member_shards(metric, key, states, counts, leaves)
+                    incoming = _decode_member_state(payload, key, leaves)
+                    inc_count = int(mmeta["update_count"])
+                    if key not in folded:
+                        _check_foldable(leaves, len(mine), key)
+                        folded[key] = (incoming, inc_count)
+                    else:
+                        state0, count0 = folded[key]
+                        folded[key] = (
+                            metric.merge_states(state0, incoming, (count0, inc_count)),
+                            count0 + inc_count,
+                        )
+                resident = payload_nbytes + sum(
+                    _state_resident_nbytes(s) for s, _ in folded.values()
+                )
+                measured_peak = max(measured_peak, resident)
+                del payload
+            for key, metric in members.items():
+                if key not in folded:
+                    # more restore hosts than shards: this host starts from defaults
+                    folded[key] = ({k: v for k, v in metric.init_state().items()}, 0)
             step = cand
             break
         except _io.CheckpointCorruptError as err:
@@ -290,11 +423,11 @@ def restore_checkpoint(
             # placement so the round-trip keeps the 1/width device footprint
             for name in metric._shard_axes:
                 setattr(metric, name, metric._place_sharded_value(name, getattr(metric, name)))
-        if loaded:
+        if first_entry is not None:
             # update-determined python config (Accuracy.mode, ...); identical
             # across shards (the committer pinned the fingerprints equal and
             # mixed input modes raise at update time)
-            for aux_name, aux_val in (loaded[0][2]["members"][key].get("aux") or {}).items():
+            for aux_name, aux_val in (first_entry["members"][key].get("aux") or {}).items():
                 setattr(metric, aux_name, aux_val)
         metric._update_count = count
         metric._is_synced = False
@@ -320,6 +453,10 @@ def restore_checkpoint(
         host_count=host_count,
         timings={"verify_s": t1 - t0, "apply_s": t2 - t1, "total_s": t2 - t0},
         fallback_from=fallback_from,
+        reshard_plan=plan,
+        plan_peak_bytes=plan.plan_peak_bytes,
+        gather_peak_bytes=plan.gather_peak_bytes,
+        measured_peak_bytes=measured_peak,
     )
 
 

@@ -21,9 +21,12 @@ from typing import Any, List, Tuple, Union
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.core.metric import Metric
+import jax
+
+from metrics_tpu.core.metric import Metric, StateDict
 from metrics_tpu.ops.classification.average_precision import _average_precision_compute_with_precision_recall
 from metrics_tpu.ops.classification.binned_pallas import binned_stat_counts
+from metrics_tpu.parallel import sync as _psync
 from metrics_tpu.utils.data import METRIC_EPS, to_onehot
 
 
@@ -124,6 +127,29 @@ class BinnedPrecisionRecallCurve(Metric):
             return precisions[0, :], recalls[0, :], self.thresholds
         return list(precisions), list(recalls), [self.thresholds for _ in range(self.num_classes)]
 
+    def _binned_pr_local(self, state: StateDict) -> Tuple[Array, Array]:
+        """Per-class precision/recall rows from this device's class block.
+
+        The curve integration is row-wise — identical math to :meth:`compute`
+        on the local ``(C/width, T)`` block, so gathered results match the
+        replicated path bitwise.
+        """
+        TPs, FPs, FNs = state["TPs"], state["FPs"], state["FNs"]
+        nloc = TPs.shape[0]
+        precisions = (TPs + METRIC_EPS) / (TPs + FPs + METRIC_EPS)
+        recalls = TPs / (TPs + FNs + METRIC_EPS)
+        precisions = jnp.concatenate([precisions, jnp.ones((nloc, 1), dtype=precisions.dtype)], axis=1)
+        recalls = jnp.concatenate([recalls, jnp.zeros((nloc, 1), dtype=recalls.dtype)], axis=1)
+        return precisions, recalls
+
+    def compute_sharded_state(self, state: StateDict, axis_name: str):
+        p_local, r_local = self._binned_pr_local(state)
+        precisions = _psync.gather_result(p_local, axis_name, axis=0)
+        recalls = _psync.gather_result(r_local, axis_name, axis=0)
+        if self.num_classes == 1:
+            return precisions[0, :], recalls[0, :], self.thresholds
+        return list(precisions), list(recalls), [self.thresholds for _ in range(self.num_classes)]
+
 
 class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
     """Average precision over a binned PR curve. Reference: :182-230.
@@ -142,6 +168,15 @@ class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
     def compute(self) -> Union[List[Array], Array]:  # type: ignore[override]
         precisions, recalls, _ = super().compute()
         return _average_precision_compute_with_precision_recall(precisions, recalls, self.num_classes, average=None)
+
+    def compute_sharded_state(self, state: StateDict, axis_name: str) -> Union[List[Array], Array]:
+        p_local, r_local = self._binned_pr_local(state)
+        # AP integration is row-local: only the (C,) result crosses shards
+        ap_local = jax.vmap(lambda p, r: -jnp.sum((r[1:] - r[:-1]) * p[:-1]))(p_local, r_local)
+        ap = _psync.gather_result(ap_local, axis_name, axis=0)
+        if self.num_classes == 1:
+            return ap[0]
+        return list(ap)
 
 
 class BinnedRecallAtFixedPrecision(BinnedPrecisionRecallCurve):
@@ -183,3 +218,16 @@ class BinnedRecallAtFixedPrecision(BinnedPrecisionRecallCurve):
             recalls_at_p.append(r)
             thresholds_at_p.append(t)
         return jnp.stack(recalls_at_p), jnp.stack(thresholds_at_p)
+
+    def compute_sharded_state(self, state: StateDict, axis_name: str) -> Tuple[Array, Array]:
+        p_local, r_local = self._binned_pr_local(state)
+        # the lexicographic max is per-class: vmap over the local rows, gather
+        # the two (C,) result vectors
+        r_at_p, t_at_p = jax.vmap(
+            lambda p, r: _recall_at_precision(p, r, self.thresholds, self.min_precision)
+        )(p_local, r_local)
+        recalls_at_p = _psync.gather_result(r_at_p, axis_name, axis=0)
+        thresholds_at_p = _psync.gather_result(t_at_p, axis_name, axis=0)
+        if self.num_classes == 1:
+            return recalls_at_p[0], thresholds_at_p[0]
+        return recalls_at_p, thresholds_at_p

@@ -6,8 +6,12 @@ from typing import Any, Optional
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.core.metric import Metric
-from metrics_tpu.ops.classification.confusion_matrix import _confusion_matrix_compute, _confusion_matrix_update
+from metrics_tpu.core.metric import Metric, StateDict
+from metrics_tpu.ops.classification.confusion_matrix import (
+    _confusion_matrix_compute,
+    _confusion_matrix_compute_sharded,
+    _confusion_matrix_update,
+)
 from metrics_tpu.utils.checks import _check_arg_choice
 
 
@@ -59,3 +63,7 @@ class ConfusionMatrix(Metric):
 
     def compute(self) -> Array:
         return _confusion_matrix_compute(self.confmat, self.normalize)
+
+    def compute_sharded_state(self, state: StateDict, axis_name: str) -> Array:
+        # finalize on the local row block; only the (normalized) result moves
+        return _confusion_matrix_compute_sharded(state["confmat"], self.normalize, axis_name)

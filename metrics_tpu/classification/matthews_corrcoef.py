@@ -6,8 +6,12 @@ from typing import Any
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.core.metric import Metric
-from metrics_tpu.ops.classification.matthews_corrcoef import _matthews_corrcoef_compute, _matthews_corrcoef_update
+from metrics_tpu.core.metric import Metric, StateDict
+from metrics_tpu.ops.classification.matthews_corrcoef import (
+    _matthews_corrcoef_compute,
+    _matthews_corrcoef_compute_sharded,
+    _matthews_corrcoef_update,
+)
 
 
 class MatthewsCorrCoef(Metric):
@@ -48,3 +52,6 @@ class MatthewsCorrCoef(Metric):
 
     def compute(self) -> Array:
         return _matthews_corrcoef_compute(self.confmat)
+
+    def compute_sharded_state(self, state: StateDict, axis_name: str) -> Array:
+        return _matthews_corrcoef_compute_sharded(state["confmat"], axis_name)

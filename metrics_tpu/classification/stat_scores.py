@@ -12,7 +12,7 @@ from typing import Any, Optional, Tuple
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.core.metric import Metric
+from metrics_tpu.core.metric import Metric, StateDict
 from metrics_tpu.ops.classification.stat_scores import _stat_scores_compute, _stat_scores_update
 
 
@@ -115,3 +115,13 @@ class StatScores(Metric):
     def compute(self) -> Array:
         tp, fp, tn, fn = self._get_final_stats()
         return _stat_scores_compute(tp, fp, tn, fn)
+
+    def compute_sharded_state(self, state: StateDict, axis_name: str) -> Array:
+        # macro layout only (the only layout that declares shard_axis): the
+        # (C, 5) stack is elementwise per class, so the local block finalizes
+        # in place and one small result gather rebuilds the class dim —
+        # bitwise-identical to the replicated path
+        from metrics_tpu.parallel import sync as _psync
+
+        block = _stat_scores_compute(state["tp"], state["fp"], state["tn"], state["fn"])
+        return _psync.gather_result(block, axis_name, axis=0)

@@ -188,8 +188,10 @@ class Metric:
         self._defaults: Dict[str, StateValue] = {}
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, Optional[Union[str, Callable]]] = {}
-        self._shard_axes: Dict[str, int] = {}  # declared shardable state axes
-        self._state_sharding: Optional[Tuple[Any, str]] = None  # (mesh, axis_name) once shard_state() ran
+        # declared shardable state axes: name -> int or tuple of ints (grid)
+        self._shard_axes: Dict[str, Union[int, Tuple[int, ...]]] = {}
+        # (mesh, axis_name-or-names) once shard_state() ran
+        self._state_sharding: Optional[Tuple[Any, Union[str, Tuple[str, ...]]]] = None
 
         self._update_count = 0
         self._forward_cache: Any = None
@@ -214,7 +216,7 @@ class Metric:
         dist_reduce_fx: Optional[Union[str, Callable]] = None,
         persistent: bool = False,
         bufferable: Optional[bool] = None,
-        shard_axis: Optional[int] = None,
+        shard_axis: Optional[Union[int, Tuple[int, ...]]] = None,
     ) -> None:
         """Register a state variable (reference: metric.py:149-217).
 
@@ -241,6 +243,12 @@ class Metric:
         ``compute()`` becomes a single reshard (no psum) for these leaves.
         ``CatBuffer`` states may only declare ``shard_axis=0`` (the sample
         axis).
+
+        ``shard_axis`` may also be a *tuple* of distinct axes (e.g. ``(0, 1)``
+        for a class × threshold grid): :meth:`shard_state` then pairs each
+        array axis positionally with a mesh axis name, splitting the leaf over
+        a multi-dimensional mesh — each device holds a tile instead of a
+        stripe.
         """
         if (
             not isinstance(default, (jnp.ndarray, np.ndarray, CatBuffer))
@@ -265,8 +273,14 @@ class Metric:
                 )
             default = CatBuffer.empty(self.buffer_capacity)
         if shard_axis is not None:
-            if not isinstance(shard_axis, int):
-                raise ValueError(f"`shard_axis` must be an int or None but got {shard_axis!r}")
+            if isinstance(shard_axis, (tuple, list)):
+                shard_axis = tuple(shard_axis)
+                if not shard_axis or not all(isinstance(a, int) for a in shard_axis):
+                    raise ValueError(
+                        f"`shard_axis` tuple must be non-empty ints but got {shard_axis!r}"
+                    )
+            elif not isinstance(shard_axis, int):
+                raise ValueError(f"`shard_axis` must be an int, a tuple of ints, or None but got {shard_axis!r}")
             if isinstance(default, list):
                 raise ValueError(
                     f"state {name!r}: unbounded list states cannot declare `shard_axis` "
@@ -279,10 +293,18 @@ class Metric:
             if isinstance(default, jnp.ndarray):
                 if default.ndim == 0:
                     raise ValueError(f"state {name!r}: scalar states cannot declare `shard_axis`")
-                if not (-default.ndim <= shard_axis < default.ndim):
-                    raise ValueError(
-                        f"state {name!r}: shard_axis {shard_axis} out of range for default of rank {default.ndim}"
-                    )
+                axes = shard_axis if isinstance(shard_axis, tuple) else (shard_axis,)
+                for a in axes:
+                    if not (-default.ndim <= a < default.ndim):
+                        raise ValueError(
+                            f"state {name!r}: shard_axis {a} out of range for default of rank {default.ndim}"
+                        )
+                if isinstance(shard_axis, tuple):
+                    normalized = tuple(a % default.ndim for a in shard_axis)
+                    if len(set(normalized)) != len(normalized):
+                        raise ValueError(
+                            f"state {name!r}: shard_axis tuple {shard_axis!r} names the same array axis twice"
+                        )
             self._shard_axes[name] = shard_axis
 
         self._defaults[name] = _copy_state_value(default)
@@ -299,12 +321,12 @@ class Metric:
     # sharded state placement (SPMD scale-out; ROADMAP "shard metric state")
     # ------------------------------------------------------------------ #
     @property
-    def shard_axes(self) -> Dict[str, int]:
-        """Declared shardable state axes (name → axis), active or not."""
+    def shard_axes(self) -> Dict[str, Union[int, Tuple[int, ...]]]:
+        """Declared shardable state axes (name → axis or axes), active or not."""
         return dict(self._shard_axes)
 
     @property
-    def active_shard_axes(self) -> Dict[str, int]:
+    def active_shard_axes(self) -> Dict[str, Union[int, Tuple[int, ...]]]:
         """Shard axes in effect: non-empty only after :meth:`shard_state`.
 
         This is what the sync path consumes — a declaration alone must not
@@ -315,15 +337,20 @@ class Metric:
         return dict(self._shard_axes) if self._state_sharding is not None else {}
 
     @property
-    def state_sharding(self) -> Optional[Tuple[Any, str]]:
-        """The ``(mesh, axis_name)`` placement from :meth:`shard_state`, or None."""
+    def state_sharding(self) -> Optional[Tuple[Any, Union[str, Tuple[str, ...]]]]:
+        """The ``(mesh, axis_name)`` placement from :meth:`shard_state`, or None.
+
+        ``axis_name`` is a single mesh-axis name for 1-D placements or a tuple
+        of names for multi-axis (grid) placements."""
         return self._state_sharding
 
     def _leaf_sharding(self, name: str, val: Any):
         """NamedSharding for one sharded leaf under the active placement."""
         mesh, axis_name = self._state_sharding  # type: ignore[misc]
         if isinstance(val, CatBuffer):
-            return _meshlib.sample_sharded(mesh, axis_name)
+            # CatBuffers shard the sample axis over the first mesh axis only
+            first = axis_name[0] if isinstance(axis_name, tuple) else axis_name
+            return _meshlib.sample_sharded(mesh, first)
         return _meshlib.shard_spec(mesh, self._shard_axes[name], jnp.ndim(val), axis_name)
 
     def _place_sharded_value(self, name: str, val: Any) -> Any:
@@ -339,7 +366,7 @@ class Metric:
             )
         return jax.device_put(val, self._leaf_sharding(name, val))
 
-    def shard_state(self, mesh: Any = None, axis_name: str = "data") -> "Metric":
+    def shard_state(self, mesh: Any = None, axis_name: Union[str, Tuple[str, ...]] = "data") -> "Metric":
         """Place every ``shard_axis``-declared state leaf sharded over ``mesh``.
 
         After this call the declared leaves (and their defaults, so ``reset``
@@ -358,11 +385,34 @@ class Metric:
         shard dimension not divisible by the mesh width still works (GSPMD
         pads internally) but wastes the padding — the analyzer's sharded-spec
         rule flags it. Returns ``self`` for chaining.
+
+        ``axis_name`` may be a *tuple* of mesh-axis names for states declared
+        with a tuple ``shard_axis`` (grid sharding over a multi-dimensional
+        mesh): each array axis in the tuple pairs positionally with a mesh
+        axis name. States declaring a single int axis shard over the first
+        name.
         """
+        names = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+        if not names or not all(isinstance(n, str) for n in names):
+            raise ValueError(f"`axis_name` must be a mesh-axis name or non-empty tuple of names, got {axis_name!r}")
         if mesh is None:
-            mesh = _meshlib.data_parallel_mesh(axis_name=axis_name)
-        if axis_name not in mesh.axis_names:
-            raise ValueError(f"axis {axis_name!r} is not an axis of the mesh {mesh.axis_names}")
+            mesh = _meshlib.data_parallel_mesh(axis_name=names[0]) if len(names) == 1 else None
+            if mesh is None:
+                raise ValueError(
+                    "shard_state: a multi-axis placement needs an explicit mesh "
+                    "(see metrics_tpu.parallel.make_mesh / grid_sharded)"
+                )
+        for n in names:
+            if n not in mesh.axis_names:
+                raise ValueError(f"axis {n!r} is not an axis of the mesh {mesh.axis_names}")
+        max_rank = max(
+            (len(a) for a in self._shard_axes.values() if isinstance(a, tuple)), default=1
+        )
+        if max_rank > len(names):
+            raise ValueError(
+                f"a state declares {max_rank} shard axes but shard_state received "
+                f"only {len(names)} mesh axis name(s) {names!r}"
+            )
         if not self._shard_axes:
             rank_zero_warn(
                 f"{type(self).__name__}.shard_state: no state declares a `shard_axis`; "
@@ -388,21 +438,33 @@ class Metric:
         return self
 
     def unshard_state(self) -> "Metric":
-        """Undo :meth:`shard_state`: gather sharded leaves back to replicated."""
+        """Undo :meth:`shard_state`: gather sharded leaves back to replicated.
+
+        The host-side gather is a re-materialization like the sync path's
+        reshard bucket, so it ticks :func:`~metrics_tpu.parallel.sync.count_collectives`
+        as ``"reshard"`` per leaf — byte tallies across a
+        sharded→compute→unshard round trip see every re-materialization.
+        """
         if self._state_sharding is None:
             return self
 
-        def gather(val):
+        def gather(val, tick=True):
             if isinstance(val, CatBuffer):
                 if not val.materialized:
                     return val
+                if tick:
+                    _sync._tick_collective("reshard", _sync._leaf_nbytes(val.data))
                 return CatBuffer(jax.device_put(np.asarray(val.data)), val.count, val.capacity, val.overflowed)
+            if tick:
+                _sync._tick_collective("reshard", _sync._leaf_nbytes(val))
             return jax.device_put(np.asarray(val))
 
         t0_us = _otrace._now_us() if _otrace.active else 0
         for name in self._shard_axes:
             setattr(self, name, gather(getattr(self, name)))
-            self._defaults[name] = gather(self._defaults[name])
+            # the default is a placement template, not live state: re-homing it
+            # is free of cross-device traffic worth billing
+            self._defaults[name] = gather(self._defaults[name], tick=False)
         self._state_sharding = None
         self._update_engine = None
         self._compute_engine = None
@@ -669,7 +731,12 @@ class Metric:
                 out[attr] = reduce_fn(jnp.stack([jnp.asarray(a), jnp.asarray(b)]))
         return out
 
-    def sync_states(self, state: StateDict, axis_name: Union[str, Tuple[str, ...]]) -> StateDict:
+    def sync_states(
+        self,
+        state: StateDict,
+        axis_name: Union[str, Tuple[str, ...]],
+        keep_sharded: bool = False,
+    ) -> StateDict:
         """Pure: emit collectives over ``axis_name`` per reduction tag. Must be
         called inside a ``shard_map``/``pmap`` program over that axis.
 
@@ -683,8 +750,19 @@ class Metric:
         Once :meth:`shard_state` has run, the declared-sharded leaves skip the
         reduction buckets: their per-device values are disjoint blocks, so
         they re-materialize through the reshard bucket instead (one tiled
-        ``all_gather`` along the shard axis, zero psum traffic)."""
-        return _sync.sync_state(state, self._reductions, axis_name, shard_axes=self.active_shard_axes)
+        ``all_gather`` along the shard axis, zero psum traffic).
+
+        ``keep_sharded=True`` (the sharded-compute protocol) leaves the
+        sharded leaves as per-device disjoint blocks — no reshard at all —
+        while replicated leaves still sync; :meth:`compute_sharded_state`
+        then finishes the reduction locally."""
+        return _sync.sync_state(
+            state,
+            self._reductions,
+            axis_name,
+            shard_axes=self.active_shard_axes,
+            keep_sharded=keep_sharded,
+        )
 
     def sync_compute_state(self, state: StateDict, axis_name: Optional[Union[str, Tuple[str, ...]]] = None) -> Any:
         """Pure fused sync+compute: the cross-device collectives (when
@@ -695,8 +773,24 @@ class Metric:
         ``axis_name=None`` skips the sync stage entirely (the no-axis fast
         path), making the function jittable outside any collective program.
         The sync stage inherits the bucketed (coalesced) collectives of
-        :meth:`sync_states`."""
+        :meth:`sync_states`.
+
+        When the metric's state is actively sharded and it implements
+        :meth:`compute_sharded_state`, the sync stage keeps sharded leaves on
+        their shards (``keep_sharded=True``) and the finalize runs on the
+        local block, combining only the small *result* across shards — zero
+        ``"reshard"`` bytes instead of re-materializing the tiled state.
+        Routing stays keyed off the active placement; multi-axis placements
+        (tuple ``axis_name``) always take the reshard path, since the
+        protocol's combine helpers address a single named axis."""
         if axis_name is not None:
+            if (
+                isinstance(axis_name, str)
+                and self.active_shard_axes
+                and self.supports_sharded_compute
+            ):
+                state = self.sync_states(state, axis_name, keep_sharded=True)
+                return self.compute_sharded_state(state, axis_name)
             state = self.sync_states(state, axis_name)
         return self.compute_state(state)
 
@@ -708,6 +802,45 @@ class Metric:
         value-dependent shape) are discovered by the engine's trace probe and
         revert to eager permanently."""
         return not any(isinstance(v, list) for v in self._defaults.values())
+
+    def compute_sharded_state(self, state: StateDict, axis_name: str) -> Any:
+        """Pure: metric value from a *still-sharded* state pytree.
+
+        The sharded-compute protocol: metrics whose finalize is a per-shard
+        reduction plus a small cross-shard combine override this to run
+        ``compute`` on the local shard block and combine only the result —
+        :func:`~metrics_tpu.parallel.sync.psum_result` for summed scalars,
+        :func:`~metrics_tpu.parallel.sync.gather_result` for per-class rows —
+        instead of re-materializing the tiled state. ``state`` arrives from
+        ``sync_states(..., keep_sharded=True)``: sharded leaves are this
+        device's disjoint block, replicated leaves are already synced. Must
+        preserve the replicated path's results (bitwise for integer and
+        per-shard-local float math; cross-shard float reductions follow the
+        documented 1-ulp carve-out).
+        """
+        raise NotImplementedError
+
+    @property
+    def supports_sharded_compute(self) -> bool:
+        """True when this class ships a ``compute_sharded_state`` matching its
+        ``compute``.
+
+        Guarded by MRO position: the class defining ``compute_sharded_state``
+        must sit at the same or a more-derived position than the class
+        defining ``compute``. A subclass that overrides ``compute`` (Jaccard
+        over ConfusionMatrix, Accuracy over StatScores, ...) without its own
+        sharded variant would otherwise inherit a parent's
+        ``compute_sharded_state`` that finalizes the *parent's* metric —
+        wrong results; such subclasses fall back to the reshard path instead.
+        """
+        cls = type(self)
+        csc_owner = next((c for c in cls.__mro__ if "compute_sharded_state" in c.__dict__), None)
+        if csc_owner is None or csc_owner is Metric:
+            return False
+        compute_owner = next((c for c in cls.__mro__ if "compute" in c.__dict__), None)
+        if compute_owner is None:
+            return False
+        return cls.__mro__.index(csc_owner) <= cls.__mro__.index(compute_owner)
 
     # ------------------------------------------------------------------ #
     # stateful facade: forward / update / compute

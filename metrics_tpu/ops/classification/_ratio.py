@@ -13,7 +13,7 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.ops.classification.stat_scores import _reduce_stat_scores
+from metrics_tpu.ops.classification.stat_scores import _reduce_stat_scores, _reduce_stat_scores_sharded
 from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
 
 
@@ -43,5 +43,41 @@ def mask_absent_and_reduce(
         weights=weights,
         average=average,
         mdmc_average=mdmc_average,
+        zero_division=zero_division,
+    )
+
+
+def mask_absent_and_reduce_sharded(
+    numerator: Array,
+    denominator: Array,
+    tp: Array,
+    fp: Array,
+    fn: Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    axis_name: str,
+    weights: Optional[Array] = None,
+    zero_division: int = 0,
+) -> Array:
+    """Sharded-compute twin of :func:`mask_absent_and_reduce`.
+
+    The absent-class sentinel is elementwise (block-local); the reduction
+    combines only results across shards (:func:`_reduce_stat_scores_sharded`).
+    """
+    if mdmc_average != MDMCAverageMethod.SAMPLEWISE and average in (
+        AverageMethod.MACRO,
+        AverageMethod.NONE,
+        None,
+    ):
+        absent = (tp + fp + fn) == 0
+        numerator = jnp.where(absent, -1, numerator)
+        denominator = jnp.where(absent, -1, denominator)
+    return _reduce_stat_scores_sharded(
+        numerator=numerator,
+        denominator=denominator,
+        weights=weights,
+        average=average,
+        mdmc_average=mdmc_average,
+        axis_name=axis_name,
         zero_division=zero_division,
     )

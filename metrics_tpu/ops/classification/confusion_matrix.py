@@ -59,6 +59,32 @@ def _confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -
     return confmat
 
 
+def _confusion_matrix_compute_sharded(confmat: Array, normalize: Optional[str], axis_name: str) -> Array:
+    """Sharded-compute variant of :func:`_confusion_matrix_compute`.
+
+    ``confmat`` is this device's disjoint block of rows (state sharded along
+    the true-class axis). Row-wise normalization (``"true"``) is block-local;
+    ``"pred"``/``"all"`` need the global column/total sums, combined as one
+    small ``psum`` of the partial sums. The normalized block then gathers as
+    a *result* — no tiled state re-materialization, zero reshard bytes.
+    ``normalize=None``/``"true"`` match the replicated path bitwise; the
+    psum'd divisors follow the 1-ulp cross-shard float carve-out.
+    """
+    from metrics_tpu.parallel import sync as _psync
+
+    _check_arg_choice(normalize, "normalize", ("true", "pred", "all", "none", None))
+    if normalize is not None and normalize != "none":
+        confmat = confmat.astype(jnp.float32)
+        if normalize == "true":
+            confmat = confmat / jnp.sum(confmat, axis=1, keepdims=True)
+        elif normalize == "pred":
+            confmat = confmat / _psync.psum_result(jnp.sum(confmat, axis=0, keepdims=True), axis_name)
+        elif normalize == "all":
+            confmat = confmat / _psync.psum_result(jnp.sum(confmat), axis_name)
+        confmat = jnp.where(jnp.isnan(confmat), 0.0, confmat)
+    return _psync.gather_result(confmat, axis_name, axis=0)
+
+
 def confusion_matrix(
     preds: Array,
     target: Array,

@@ -10,7 +10,7 @@ from typing import Optional, Tuple
 
 from jax import Array
 
-from metrics_tpu.ops.classification._ratio import mask_absent_and_reduce
+from metrics_tpu.ops.classification._ratio import mask_absent_and_reduce, mask_absent_and_reduce_sharded
 from metrics_tpu.ops.classification.stat_scores import _stat_scores_update
 from metrics_tpu.utils.checks import _check_avg_args
 
@@ -22,9 +22,27 @@ def _precision_compute(tp: Array, fp: Array, fn: Array, average: Optional[str], 
     )
 
 
+def _precision_compute_sharded(
+    tp: Array, fp: Array, fn: Array, average: Optional[str], mdmc_average: Optional[str], axis_name: str
+) -> Array:
+    return mask_absent_and_reduce_sharded(
+        tp, tp + fp, tp, fp, fn, average, mdmc_average, axis_name,
+        weights=None if average != "weighted" else tp + fn,
+    )
+
+
 def _recall_compute(tp: Array, fp: Array, fn: Array, average: Optional[str], mdmc_average: Optional[str]) -> Array:
     return mask_absent_and_reduce(
         tp, tp + fn, tp, fp, fn, average, mdmc_average,
+        weights=None if average != "weighted" else tp + fn,
+    )
+
+
+def _recall_compute_sharded(
+    tp: Array, fp: Array, fn: Array, average: Optional[str], mdmc_average: Optional[str], axis_name: str
+) -> Array:
+    return mask_absent_and_reduce_sharded(
+        tp, tp + fn, tp, fp, fn, average, mdmc_average, axis_name,
         weights=None if average != "weighted" else tp + fn,
     )
 

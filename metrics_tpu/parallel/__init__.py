@@ -3,6 +3,7 @@ from metrics_tpu.parallel.mesh import (  # noqa: F401
     batch_sharded,
     class_sharded,
     data_parallel_mesh,
+    grid_sharded,
     make_mesh,
     replicated,
     sample_sharded,
@@ -15,6 +16,8 @@ from metrics_tpu.parallel.sync import (  # noqa: F401
     current_sync_axes,
     distributed_available,
     gather_all_arrays,
+    gather_result,
+    psum_result,
     reduce,
     set_bucketed_sync,
     sync_array,

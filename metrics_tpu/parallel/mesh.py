@@ -7,7 +7,7 @@ matrix), with collectives riding ICI.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
@@ -136,13 +136,51 @@ def sample_sharded(mesh: Mesh, axis_name: str = "data") -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(axis_name))
 
 
+def grid_sharded(
+    mesh: Mesh,
+    axis_names: Tuple[str, ...],
+    shard_axes: Tuple[int, ...],
+    ndim: int,
+) -> NamedSharding:
+    """Sharding for grid-partitioned state (multi-axis ``shard_axis`` tuples).
+
+    Each array axis in ``shard_axes`` pairs positionally with a mesh axis name
+    in ``axis_names``: a ``(C, T)`` class × threshold leaf with
+    ``shard_axes=(0, 1)`` over a ``("cls", "thr")`` mesh holds a
+    ``(C/cls_width, T/thr_width)`` tile per device.
+
+    >>> import jax
+    >>> mesh = make_mesh([1, 1], ["cls", "thr"], jax.devices()[:1])
+    >>> grid_sharded(mesh, ("cls", "thr"), (0, 1), 2).spec
+    PartitionSpec('cls', 'thr')
+    >>> grid_sharded(mesh, ("cls", "thr"), (1,), 2).spec
+    PartitionSpec(None, 'cls')
+    """
+    if len(shard_axes) > len(axis_names):
+        raise ValueError(
+            f"grid_sharded: {len(shard_axes)} shard axes but only "
+            f"{len(axis_names)} mesh axis name(s) {axis_names!r}"
+        )
+    ndim = max(ndim, 1)
+    spec = [None] * ndim
+    for name, axis in zip(axis_names, shard_axes):
+        spec[axis % ndim] = name
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
 def shard_spec(
-    mesh: Mesh, shard_axis: Optional[int], ndim: int, axis_name: str = "data"
+    mesh: Mesh,
+    shard_axis: Optional[Union[int, Tuple[int, ...]]],
+    ndim: int,
+    axis_name: Union[str, Tuple[str, ...]] = "data",
 ) -> NamedSharding:
     """NamedSharding for a state leaf given its ``shard_axis`` declaration.
 
     ``shard_axis=None`` means the leaf is replicated (the default for every
-    state); an integer partitions that dimension over ``axis_name``.
+    state); an integer partitions that dimension over ``axis_name`` (the first
+    name when ``axis_name`` is a tuple); a tuple of integers partitions each
+    listed dimension over the positionally-matching mesh axis name
+    (:func:`grid_sharded`).
 
     >>> import jax
     >>> mesh = make_mesh([1], ["data"], jax.devices()[:1])
@@ -153,5 +191,8 @@ def shard_spec(
     """
     if shard_axis is None:
         return replicated(mesh)
+    names = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    if isinstance(shard_axis, tuple):
+        return grid_sharded(mesh, names, shard_axis, ndim)
     ndim = max(ndim, 1)
-    return class_sharded(mesh, axis_name, shard_axis % ndim, ndim)
+    return class_sharded(mesh, names[0], shard_axis % ndim, ndim)

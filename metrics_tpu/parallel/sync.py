@@ -240,6 +240,29 @@ def sync_array(x: Array, reduction: Optional[Union[str, Callable]], axis_name: O
     raise ValueError(f"Unknown dist_reduce_fx {reduction!r}; expected one of {_REDUCTIONS} or a callable.")
 
 
+def psum_result(x: Array, axis_name: AxisNames) -> Array:
+    """Cross-shard sum of a *result* (sharded-compute protocol combine).
+
+    Metrics implementing ``compute_sharded_state`` finish their reduction on
+    the local shard and combine only the small result — this helper is the
+    ``psum`` half of that combine, ticked so :func:`count_collectives` can
+    show the protocol moved result bytes instead of reshard bytes.
+    """
+    _tick_collective("psum", _leaf_nbytes(x))
+    return lax.psum(x, axis_name)
+
+
+def gather_result(x: Array, axis_name: AxisNames, axis: int = 0) -> Array:
+    """Cross-shard concat of per-shard *result* blocks along ``axis``.
+
+    The ``all_gather`` half of the sharded-compute combine: each device owns
+    the result rows for its shard block, one tiled gather rebuilds the global
+    result. Ticked as ``"all_gather"`` — reshard bytes stay zero.
+    """
+    _tick_collective("all_gather", _leaf_nbytes(x))
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
 def _sync_bucketed(entries: List[Tuple[str, Array, Optional[str]]], axis_name: AxisNames) -> Dict[str, Any]:
     """One collective per (reduction, dtype) bucket — gradient-bucketing for
     metric state (ISSUE-3 tentpole; arXiv:2305.06942 fused-collective shape).
@@ -327,6 +350,34 @@ def _sync_resharded(
             offset += width
             full = seg.reshape((gathered.shape[0],) + m.shape[1:])
             out[name] = jnp.moveaxis(full, 0, axis)
+    return out
+
+
+def _sync_resharded_multi(
+    entries: List[Tuple[str, Array, Tuple[int, ...]]], axis_name: AxisNames
+) -> Dict[str, Any]:
+    """Multi-axis reshard: leaves sharded along a *tuple* of array axes.
+
+    A grid leaf (class × threshold counts over a 2-D mesh) declares
+    ``shard_axis=(a0, a1)``; mesh axis names pair with the tuple positionally,
+    so re-materialization is one tiled ``all_gather`` per sharded axis, each
+    ticked ``"reshard"``. Gathers run left-to-right over the tuple — the
+    result is the full global leaf regardless of order.
+    """
+    names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    out: Dict[str, Any] = {}
+    for name, arr, axes in entries:
+        arr = jnp.asarray(arr)
+        axes = tuple(a % max(arr.ndim, 1) for a in axes)
+        if len(axes) > len(names):
+            raise ValueError(
+                f"state {name!r} is sharded along {len(axes)} axes but the sync "
+                f"spans only {len(names)} mesh axis name(s) {names!r}"
+            )
+        for mesh_axis, axis in zip(names, axes):
+            _tick_collective("reshard", _leaf_nbytes(arr))
+            arr = lax.all_gather(arr, mesh_axis, axis=axis, tiled=True)
+        out[name] = arr
     return out
 
 
@@ -428,7 +479,8 @@ def sync_state(
     reductions: Dict[str, Optional[Union[str, Callable]]],
     axis_name: Optional[AxisNames],
     bucketed: Optional[bool] = None,
-    shard_axes: Optional[Dict[str, int]] = None,
+    shard_axes: Optional[Dict[str, Union[int, Tuple[int, ...]]]] = None,
+    keep_sharded: bool = False,
 ) -> Dict[str, Any]:
     """Synchronize a whole state pytree by per-state reduction tag.
 
@@ -454,11 +506,20 @@ def sync_state(
     shard axis, zero psum traffic. Sharded ``CatBuffer`` states (sample-axis
     sharding) take the same gather-with-fill-counts path as replicated ones
     but tick as ``"reshard"``: their per-device payloads are already disjoint.
+    Axis values may be ints or tuples of ints — tuple leaves re-materialize
+    through :func:`_sync_resharded_multi`, one gather per sharded axis.
+
+    ``keep_sharded=True`` is the sharded-compute protocol's entry: leaves
+    named in ``shard_axes`` (dense and ``CatBuffer``) pass through *unchanged*
+    — still per-device disjoint blocks — while replicated leaves sync as
+    usual. The caller's ``compute_sharded_state`` then finishes the reduction
+    locally and combines only the small result (:func:`psum_result` /
+    :func:`gather_result`), so the reshard bucket never runs.
     """
     if axis_name is None:
         return dict(state)
     if not _otrace.active:
-        return _sync_state_impl(state, reductions, axis_name, bucketed, shard_axes)
+        return _sync_state_impl(state, reductions, axis_name, bucketed, shard_axes, keep_sharded)
     # tracer on: record one sync/bucket_build span per sync with this build's
     # own collective tally (a nested count_collectives box — outer user boxes
     # still see every tick). sync_state runs at trace time, which is exactly
@@ -466,7 +527,7 @@ def sync_state(
     # touches the Python-side event object, never the traced program.
     t0_us = _otrace._now_us()
     with count_collectives() as box:
-        out = _sync_state_impl(state, reductions, axis_name, bucketed, shard_axes)
+        out = _sync_state_impl(state, reductions, axis_name, bucketed, shard_axes, keep_sharded)
     _otrace.emit_complete(
         "sync/bucket_build", "sync", t0_us, _otrace._now_us() - t0_us,
         axis=str(axis_name), leaves=len(state),
@@ -481,7 +542,8 @@ def _sync_state_impl(
     reductions: Dict[str, Optional[Union[str, Callable]]],
     axis_name: AxisNames,
     bucketed: Optional[bool],
-    shard_axes: Optional[Dict[str, int]],
+    shard_axes: Optional[Dict[str, Union[int, Tuple[int, ...]]]],
+    keep_sharded: bool = False,
 ) -> Dict[str, Any]:
     if _chaos.active:
         # bucket builds run at trace time, so an injected fault here surfaces
@@ -495,6 +557,7 @@ def _sync_state_impl(
     out: Dict[str, Any] = {}
     entries: List[Tuple[str, Array, Optional[str]]] = []
     shard_entries: List[Tuple[str, Array, int]] = []
+    multi_shard_entries: List[Tuple[str, Array, Tuple[int, ...]]] = []
     buf_entries: List[Tuple[str, CatBuffer]] = []
     shard_buf_entries: List[Tuple[str, CatBuffer]] = []
     rewrap: Dict[str, type] = {}
@@ -508,14 +571,22 @@ def _sync_state_impl(
             if not val.materialized:
                 out[name] = val
             elif name in shard_axes:
-                shard_buf_entries.append((name, val))
+                if keep_sharded:
+                    out[name] = val
+                else:
+                    shard_buf_entries.append((name, val))
             elif bucketed:
                 buf_entries.append((name, val))
             else:
                 out[name] = val.gather(axis_name)
             continue
         if name in shard_axes and not isinstance(val, (list, tuple)):
-            shard_entries.append((name, val, shard_axes[name]))
+            if keep_sharded:
+                out[name] = val
+            elif isinstance(shard_axes[name], tuple):
+                multi_shard_entries.append((name, val, shard_axes[name]))
+            else:
+                shard_entries.append((name, val, shard_axes[name]))
             continue
         if isinstance(val, (list, tuple)):
             if len(val) == 0:
@@ -537,6 +608,8 @@ def _sync_state_impl(
         out.update(_sync_bucketed(entries, axis_name))
     if shard_entries:
         out.update(_sync_resharded(shard_entries, axis_name))
+    if multi_shard_entries:
+        out.update(_sync_resharded_multi(multi_shard_entries, axis_name))
     if buf_entries:
         out.update(_sync_bucketed_catbuffers(buf_entries, axis_name))
     if shard_buf_entries:

@@ -222,7 +222,10 @@ class TreedefDriftUpdateMetric(CleanMetric):
 
 
 class ShardedCleanMetric(Metric):
-    """Control for E108: a class-sharded vector state with canonical sync."""
+    """Control for E108/E111: a class-sharded vector state with canonical
+    sync AND the sharded-compute protocol (its finalize reduces over the
+    sharded extent, so without ``compute_sharded_state`` it would be exactly
+    the reshard-at-compute headroom E111 flags)."""
 
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
@@ -233,6 +236,9 @@ class ShardedCleanMetric(Metric):
 
     def compute(self):
         return self.counts.sum()
+
+    def compute_sharded_state(self, state, axis_name):
+        return _sync.psum_result(state["counts"].sum(), axis_name)
 
 
 class ShardIgnorantSyncMetric(ShardedCleanMetric):
@@ -251,6 +257,62 @@ class ValueDependentComputeMetric(CleanMetric):
 
     def compute(self):
         return jnp.nonzero(jnp.ones((4,)) * self.total)[0]  # metrics-tpu: allow[A002]
+
+
+class ReshardAtComputeMetric(Metric):
+    """E111: class-sharded counts whose finalize sums over the sharded
+    extent, with no compute_sharded_state — the finalize re-materializes the
+    tiled state (reshard bytes) for a reduction that could run on the shard."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("counts", default=jnp.zeros((8,)), dist_reduce_fx="sum", shard_axis=0)
+
+    def update(self, values):
+        self.counts = self.counts + values
+
+    def compute(self):
+        return self.counts.sum()
+
+
+class ProtocolDeclaredMetric(ReshardAtComputeMetric):
+    """Control for E111: the same finalize, but the sharded-compute protocol
+    is declared — exactly the fix the rule asks for."""
+
+    def compute_sharded_state(self, state, axis_name):
+        return _sync.psum_result(state["counts"].sum(), axis_name)
+
+
+class ElementwiseShardedComputeMetric(Metric):
+    """Control for E111: sharded state whose finalize is elementwise — no
+    reduction over the sharded extent, nothing the protocol could shortcut."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("counts", default=jnp.zeros((8,)), dist_reduce_fx="sum", shard_axis=0)
+
+    def update(self, values):
+        self.counts = self.counts + values
+
+    def compute(self):
+        return self.counts * 2.0
+
+
+class OffAxisReductionMetric(Metric):
+    """Control for E111: the finalize reduces a (row-local) dimension whose
+    extent differs from the sharded one — shard-local math, not headroom."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state(
+            "table", default=jnp.zeros((8, 3)), dist_reduce_fx="sum", shard_axis=0
+        )
+
+    def update(self, values):
+        self.table = self.table + values[:, None] * jnp.ones((1, 3))
+
+    def compute(self):
+        return self.table.sum(axis=1)
 
 
 class CatReductionMetric(Metric):
@@ -433,6 +495,33 @@ class TestEvalStage:
         findings = _evaluate(ValueDependentComputeMetric, dict(_SPEC, init={"compiled_compute": False}))
         rules = {f.rule for f in findings if not f.suppressed}
         assert "E107" in rules and "E109" not in rules
+
+    def test_reshard_at_compute_is_E111(self):
+        findings = _evaluate(ReshardAtComputeMetric)
+        e111 = [f for f in findings if f.rule == "E111" and not f.suppressed]
+        assert len(e111) == 1, [f.rule for f in findings]
+        assert e111[0].severity == "warning"
+        assert "compute_sharded_state" in e111[0].message
+        assert e111[0].extra["states"] == ["counts"]
+        assert e111[0].extra["shard_axes"] == {"counts": 0}
+        assert e111[0].extra["extents"] == {"counts": 8}
+
+    def test_protocol_declaration_silences_E111(self):
+        findings = _evaluate(ProtocolDeclaredMetric)
+        assert "E111" not in {f.rule for f in findings}
+
+    def test_elementwise_sharded_compute_has_no_E111(self):
+        findings = _evaluate(ElementwiseShardedComputeMetric)
+        assert "E111" not in {f.rule for f in findings}
+
+    def test_off_axis_reduction_has_no_E111(self):
+        findings = _evaluate(OffAxisReductionMetric)
+        assert "E111" not in {f.rule for f in findings}
+
+    def test_E111_is_suppressible_via_spec_allow(self):
+        findings = _evaluate(ReshardAtComputeMetric, dict(_SPEC, allow=("E111",)))
+        e111 = [f for f in findings if f.rule == "E111"]
+        assert e111 and all(f.suppressed for f in e111)
 
     def test_tenant_unstackable_is_E110(self):
         findings = _evaluate(CatReductionMetric)

@@ -25,6 +25,8 @@ from metrics_tpu import AUROC, AveragePrecision, BinnedAveragePrecision, BinnedP
 from metrics_tpu.parallel.sync import sync_axes
 from metrics_tpu.utils.exceptions import MetricsUserError
 
+pytestmark = pytest.mark.mesh8
+
 WORLD = 8
 N = 24  # samples per device
 

@@ -95,6 +95,7 @@ def _trace_count(reds, state, bucketed):
 
 
 # ----------------------------------------------------------------- parity ----
+@pytest.mark.mesh8
 def test_bitwise_parity_vs_per_leaf(mesh):
     out_b = _run_sync(mesh, _STATE, _REDS, bucketed=True)
     out_p = _run_sync(mesh, _STATE, _REDS, bucketed=False)
@@ -105,6 +106,7 @@ def test_bitwise_parity_vs_per_leaf(mesh):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # bitwise
 
 
+@pytest.mark.mesh8
 def test_metric_sync_states_bitwise_parity(mesh):
     """A real metric's sync_states: bucketed vs per-leaf inside shard_map."""
     m = StatScores(reduce="macro", num_classes=5, compiled_compute=False)
@@ -127,6 +129,7 @@ def test_metric_sync_states_bitwise_parity(mesh):
     np.testing.assert_array_equal(run(True), run(False))
 
 
+@pytest.mark.mesh8
 def test_collection_sync_states_bitwise_parity(mesh):
     """Whole-collection sync: the group-leader state set syncs bucketed."""
     coll = MetricCollection(
@@ -161,6 +164,7 @@ def test_collection_sync_states_bitwise_parity(mesh):
 
 
 # ------------------------------------------------------- container types -----
+@pytest.mark.mesh8
 def test_tuple_state_stays_tuple(mesh):
     """Regression: tuple states used to come back as [synced] lists, changing
     the pytree structure across a sync and forcing recompiles."""
@@ -209,6 +213,7 @@ def test_singleton_buckets_match_per_leaf_count():
     assert _trace_count(reds, state, bucketed=True) == 2
 
 
+@pytest.mark.mesh8
 def test_stat_scores_collection_counts(mesh):
     """The config2-shaped sync: a stat-scores state (5 same-dtype sum leaves)
     collapses to ONE psum."""
@@ -247,6 +252,7 @@ def test_env_flag(monkeypatch):
 
 
 # ------------------------------------------------------------- callables -----
+@pytest.mark.mesh8
 def test_callable_reduction_stays_per_leaf(mesh):
     """Custom dist_reduce_fx callables see the stacked (world, ...) gather —
     bucketing must leave them alone."""
@@ -345,6 +351,7 @@ def _run_buffer_sync(mesh, bucketed):
     return jax.jit(f)(jnp.ones((WORLD,), jnp.float32))
 
 
+@pytest.mark.mesh8
 def test_catbuffer_bitwise_parity_vs_gather(mesh):
     """Bucketed CatBuffer sync (one stacked meta gather + one data gather per
     dtype) must be bitwise-identical to per-buffer ``CatBuffer.gather``."""
@@ -354,6 +361,7 @@ def test_catbuffer_bitwise_parity_vs_gather(mesh):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.mesh8
 def test_catbuffer_sync_content(mesh):
     """The synced buffer holds the device-order concatenation of every
     device's valid prefix, at capacity WORLD * cap."""

@@ -218,6 +218,7 @@ def mesh():
     return Mesh(np.asarray(devices[:WORLD]), ("data",))
 
 
+@pytest.mark.mesh8
 def test_buffered_gather_compaction(mesh):
     """Each device appends a different number of valid rows; the gathered
     buffer holds every row exactly once, in device order."""
@@ -238,6 +239,7 @@ def test_buffered_gather_compaction(mesh):
     assert out.capacity == WORLD * 4
 
 
+@pytest.mark.mesh8
 def test_ddp_buffered_curve_metric(mesh):
     """VERDICT item 4 'done' criterion: a curve metric under shard_map with
     strided batches matches sklearn on the concatenation."""

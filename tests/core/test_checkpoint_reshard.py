@@ -187,3 +187,97 @@ def test_callable_reduction_refuses_fold_but_allows_same_world(tmp_path):
     # N != M would have to fold with unknowable semantics — refused
     with pytest.raises(CheckpointMismatchError, match="folded|reduction"):
         restore_checkpoint(_CallableReduce(), str(tmp_path), host_index=0, host_count=1)
+
+
+# ------------------------------------------------------ reshard planner ------
+def test_reshard_plan_structure_and_peaks(tmp_path):
+    """The plan is compiled from manifest metadata alone: a load/fold/free
+    triple per assigned shard, with the streaming peak bounded by folded
+    state + one transfer block — strictly below gather-everything for N>1."""
+    from metrics_tpu.checkpoint import build_reshard_plan
+    from metrics_tpu.checkpoint import io as _io
+    from metrics_tpu import ConfusionMatrix
+
+    make = lambda: ConfusionMatrix(num_classes=64)
+    for i in range(N):
+        m = make()
+        rng = np.random.default_rng(i)
+        m.update(
+            jnp.asarray(rng.integers(0, 64, (128,))), jnp.asarray(rng.integers(0, 64, (128,)))
+        )
+        save_checkpoint(m, str(tmp_path), step=0, shard_index=i, world_size=N)
+
+    manifest = _io.read_manifest(str(tmp_path), 0)
+    plan = build_reshard_plan(manifest, assign_shards(N, 0, 1))
+    assert plan.world_size == N and plan.shards == tuple(range(N))
+    assert [s["op"] for s in plan.steps] == ["load", "fold", "free"] * N
+    # dense sum state: the fold never grows past one (64, 64) int32 copy
+    state_bytes = 64 * 64 * 4
+    assert all(s["bytes"] == state_bytes for s in plan.steps if s["op"] == "fold")
+    largest_payload = max(int(s["bytes"]) for s in manifest["shards"])
+    assert plan.plan_peak_bytes <= state_bytes + largest_payload
+    assert plan.plan_peak_bytes < plan.gather_peak_bytes
+    # modeled baseline really is the sum of every assigned payload
+    assert plan.gather_peak_bytes == sum(int(s["bytes"]) for s in manifest["shards"]) + state_bytes
+
+
+def test_streaming_restore_n_to_m_peak_below_gather(tmp_path):
+    """N=8 shards folded onto M=3 hosts through the planner: results bitwise
+    vs the reference fold, and the measured resident peak stays strictly
+    below the gather-everything model on every host that folds >1 shard."""
+    from metrics_tpu import ConfusionMatrix
+
+    make = lambda: ConfusionMatrix(num_classes=64)
+    ref = make()
+    for i in range(N):
+        m = make()
+        rng = np.random.default_rng(i)
+        batch = (
+            jnp.asarray(rng.integers(0, 64, (128,))),
+            jnp.asarray(rng.integers(0, 64, (128,))),
+        )
+        m.update(*batch)
+        ref.update(*batch)
+        save_checkpoint(m, str(tmp_path), step=0, shard_index=i, world_size=N)
+
+    M = 3
+    folded_total = np.zeros((64, 64), np.int64)
+    for host in range(M):
+        m = make()
+        info = restore_checkpoint(m, str(tmp_path), host_index=host, host_count=M)
+        assert info.reshard_plan is not None
+        assert info.reshard_plan.shards == assign_shards(N, host, M)
+        assert info.plan_peak_bytes == info.reshard_plan.plan_peak_bytes
+        assert info.gather_peak_bytes == info.reshard_plan.gather_peak_bytes
+        if len(info.shards_loaded) > 1:
+            assert info.measured_peak_bytes < info.gather_peak_bytes
+            assert info.plan_peak_bytes < info.gather_peak_bytes
+        assert info.measured_peak_bytes > 0
+        folded_total += np.asarray(m.confmat, dtype=np.int64)
+    np.testing.assert_array_equal(np.asarray(ref.confmat, dtype=np.int64), folded_total)
+
+
+def test_single_shard_plan_degenerates(tmp_path):
+    """N == M: one shard per host — streaming and gathering coincide."""
+    _save_world(Accuracy, str(tmp_path), world=2)
+    m = Accuracy()
+    info = restore_checkpoint(m, str(tmp_path), host_index=1, host_count=2)
+    plan = info.reshard_plan
+    assert plan is not None and plan.shards == (1,)
+    assert [s["op"] for s in plan.steps] == ["load", "fold", "free"]
+    assert plan.plan_peak_bytes == plan.gather_peak_bytes
+
+
+def test_catbuffer_plan_accumulates_concat_bytes(tmp_path):
+    """Concatenating leaves grow the fold: the modeled fold bytes must be
+    non-decreasing across shards and the final figure covers every prefix."""
+    from metrics_tpu.checkpoint import build_reshard_plan
+    from metrics_tpu.checkpoint import io as _io
+
+    make = lambda: AUROC(buffer_capacity=512)
+    _save_world(make, str(tmp_path), world=4)
+    manifest = _io.read_manifest(str(tmp_path), 0)
+    plan = build_reshard_plan(manifest, assign_shards(4, 0, 1))
+    fold_bytes = [s["bytes"] for s in plan.steps if s["op"] == "fold"]
+    assert fold_bytes == sorted(fold_bytes)
+    assert fold_bytes[-1] > fold_bytes[0]

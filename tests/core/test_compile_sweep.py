@@ -125,6 +125,7 @@ def _run_level(make, batch, level):
 
 
 @pytest.mark.parametrize("name", sorted(INVENTORY), ids=str)
+@pytest.mark.mesh8
 def test_compile_sweep(name):
     entry = INVENTORY[name]
     if entry.skip and importlib.util.find_spec(entry.skip) is None:

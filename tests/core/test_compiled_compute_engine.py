@@ -235,6 +235,7 @@ def test_sync_state_no_axis_is_identity():
         assert out[k] is state[k]  # fast path: no collective, no copy
 
 
+@pytest.mark.mesh8
 def test_plain_jit_sync_compute_folds_sync(mesh):
     """Outside any collective program, jit(sync_compute_state) == compute."""
     m = StatScores(reduce="macro", num_classes=5, compiled_compute=False)
@@ -245,6 +246,7 @@ def test_plain_jit_sync_compute_folds_sync(mesh):
     np.testing.assert_array_equal(np.asarray(fused), np.asarray(m.compute()))
 
 
+@pytest.mark.mesh8
 def test_fused_sync_compute_bitwise_parity(mesh):
     """The engine's jitted unit (sync_states ∘ compute_state) must be
     bitwise-identical to the eager two-step sync inside a shard_map."""
@@ -279,6 +281,7 @@ def test_fused_sync_compute_bitwise_parity(mesh):
     np.testing.assert_array_equal(run(fused), run(eager))  # bitwise
 
 
+@pytest.mark.mesh8
 def test_mean_reduction_fused_sync_parity(mesh):
     m = MeanSquaredError(compiled_compute=False)
 

@@ -24,6 +24,7 @@ def mesh():
     return Mesh(np.asarray(devices[:WORLD]), ("data",))
 
 
+@pytest.mark.mesh8
 def test_sum_sync(mesh):
     m = DummyMetricSum()
 
@@ -38,6 +39,7 @@ def test_sum_sync(mesh):
     assert np.allclose(np.asarray(out), sum(range(WORLD)))  # identical on every device
 
 
+@pytest.mark.mesh8
 def test_cat_sync_preserves_order(mesh):
     m = DummyListMetric()
 
@@ -51,6 +53,7 @@ def test_cat_sync_preserves_order(mesh):
     np.testing.assert_allclose(np.asarray(out[0]), np.arange(WORLD))
 
 
+@pytest.mark.mesh8
 def test_all_reduction_tags(mesh):
     class Multi(Metric):
         def __init__(self):
@@ -81,6 +84,7 @@ def test_all_reduction_tags(mesh):
     np.testing.assert_allclose(out, [vals.sum(), vals.mean(), vals.max(), vals.min()])
 
 
+@pytest.mark.mesh8
 def test_custom_callable_reduction(mesh):
     class Custom(Metric):
         def __init__(self):

@@ -16,6 +16,8 @@ from metrics_tpu import Accuracy, MeanSquaredError
 from metrics_tpu.parallel.sync import sync_axes
 from tests.helpers.testers import DummyMetricSum
 
+pytestmark = pytest.mark.mesh8
+
 WORLD = 8
 
 

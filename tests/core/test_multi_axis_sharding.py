@@ -1,0 +1,266 @@
+"""Multi-axis sharded state: tuple ``shard_axis`` over 2-D meshes.
+
+``add_state(..., shard_axis=(0, 1))`` declares a grid leaf (class x threshold)
+whose dimensions pair positionally with the mesh axis names handed to
+``shard_state(mesh, axis_name=("cls", "thr"))``. Pinned on the 8-device CPU
+mesh folded as 4x2:
+
+* placement: each device holds a 1/8 grid block under
+  ``PartitionSpec("cls", "thr")`` (the :func:`~metrics_tpu.parallel.grid_sharded`
+  spec helper);
+* sync routing: one tiled all-gather per mesh axis, every tick tagged
+  ``"reshard"``;
+* parity: integer grids stay bitwise; float computes that reduce *over* a
+  sharded mesh axis carry the 1-ulp cross-shard carve-out;
+* lifecycle: reset / ``state_dict`` / checkpoint round trips restore both the
+  values and the 2-D placement, and the leaf metadata + fingerprint carry the
+  axis tuple (JSON list form).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import metrics_tpu
+from metrics_tpu.parallel import grid_sharded, make_mesh
+from metrics_tpu.parallel.sync import count_collectives
+
+WORLD = 8
+SHAPE = (16, 8)
+
+
+@pytest.fixture()
+def mesh2d():
+    devices = jax.devices()
+    if len(devices) < WORLD:
+        pytest.skip("needs 8 devices")
+    return make_mesh([4, 2], ["cls", "thr"], devices[:WORLD])
+
+
+class GridMetric(metrics_tpu.Metric):
+    """Integer class x threshold grid: bitwise across every placement."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state(
+            "grid", default=jnp.zeros(SHAPE, jnp.int32), dist_reduce_fx="sum", shard_axis=(0, 1)
+        )
+
+    def update(self, x):
+        self.grid = self.grid + x
+
+    def compute(self):
+        return self.grid.sum(axis=1)
+
+
+def _grid_batch():
+    rng = np.random.default_rng(0)
+    return jnp.asarray(rng.integers(0, 100, size=SHAPE), dtype=jnp.int32)
+
+
+def _block_nbytes(leaf):
+    return int(leaf.addressable_shards[0].data.nbytes)
+
+
+# --------------------------------------------------------------------------- #
+# declaration + placement surface
+# --------------------------------------------------------------------------- #
+def test_add_state_tuple_validation():
+    class Bad(metrics_tpu.Metric):
+        def __init__(self, default, shard_axis, **kw):
+            super().__init__(**kw)
+            self.add_state("s", default=default, dist_reduce_fx="sum", shard_axis=shard_axis)
+
+        def update(self):
+            pass
+
+        def compute(self):
+            return self.s
+
+    with pytest.raises(ValueError, match="non-empty ints"):
+        Bad(jnp.zeros((4, 4)), (0, "1"))
+    with pytest.raises(ValueError, match="out of range"):
+        Bad(jnp.zeros((4, 4)), (0, 2))
+    with pytest.raises(ValueError, match="same array axis twice"):
+        Bad(jnp.zeros((4, 4)), (1, -1))
+    with pytest.raises(ValueError):
+        Bad(jnp.zeros((4, 4)), ())
+    # negative entries are accepted and normalized at placement
+    assert Bad(jnp.zeros((4, 4)), (0, -1)).shard_axes == {"s": (0, -1)}
+
+
+@pytest.mark.mesh8
+def test_grid_sharded_spec(mesh2d):
+    s = grid_sharded(mesh2d, ("cls", "thr"), (0, 1), 2)
+    assert s.spec == P("cls", "thr")
+    s = grid_sharded(mesh2d, ("cls", "thr"), (1, 0), 3)
+    assert s.spec == P("thr", "cls", None)
+    with pytest.raises(ValueError):
+        grid_sharded(mesh2d, ("cls",), (0, 1), 2)
+
+
+@pytest.mark.mesh8
+def test_shard_state_multi_axis_requirements(mesh2d):
+    with pytest.raises(ValueError, match="mesh"):
+        GridMetric().shard_state(axis_name=("cls", "thr"))
+    with pytest.raises(Exception, match="axis"):
+        GridMetric().shard_state(mesh2d, axis_name=("cls", "model"))
+    with pytest.raises(ValueError):
+        # rank-2 declaration needs two mesh axes
+        GridMetric().shard_state(mesh2d, axis_name=("cls",))
+
+
+@pytest.mark.mesh8
+def test_multi_axis_placement(mesh2d):
+    m = GridMetric().shard_state(mesh2d, axis_name=("cls", "thr"))
+    assert m.grid.sharding.spec == P("cls", "thr")
+    assert _block_nbytes(m.grid) * WORLD == int(m.grid.nbytes)
+    assert m.active_shard_axes == {"grid": (0, 1)}
+
+
+# --------------------------------------------------------------------------- #
+# parity + sync routing
+# --------------------------------------------------------------------------- #
+@pytest.mark.mesh8
+def test_multi_axis_parity_bitwise(mesh2d):
+    x = _grid_batch()
+    ref = GridMetric()
+    ref.update(x)
+    want = np.asarray(ref.compute())
+
+    m = GridMetric().shard_state(mesh2d, axis_name=("cls", "thr"))
+    m.update(x)
+    assert np.array_equal(want, np.asarray(m.compute()))
+    # placement survives the compiled update
+    assert m.grid.sharding.spec == P("cls", "thr")
+
+
+@pytest.mark.mesh8
+def test_multi_axis_sync_reshards_per_mesh_axis(mesh2d):
+    m = GridMetric().shard_state(mesh2d, axis_name=("cls", "thr"))
+    local = {"grid": jnp.zeros((SHAPE[0] // 4, SHAPE[1] // 2), jnp.int32)}
+    with count_collectives() as box:
+        jax.make_jaxpr(
+            lambda s: m.sync_states(s, ("cls", "thr")),
+            axis_env=[("cls", 4), ("thr", 2)],
+        )(local)
+    # one tiled gather per mesh axis, both billed as reshard: the (4, 4)
+    # block gathers to (16, 4) over cls, then to (16, 8) over thr
+    assert box["by_kind"] == {"reshard": 2}
+    assert box["bytes_by_kind"]["reshard"] == 4 * 4 * 4 + 16 * 4 * 4
+
+
+@pytest.mark.mesh8
+def test_multi_axis_never_routes_sharded_compute(mesh2d):
+    """The result-combine helpers address one named axis; grid placements
+    always re-materialize even if the class declares the protocol."""
+
+    class GridWithProtocol(GridMetric):
+        def compute(self):
+            return self.grid.sum(axis=1)
+
+        def compute_sharded_state(self, state, axis_name):  # pragma: no cover
+            raise AssertionError("must not route for tuple axis names")
+
+    m = GridWithProtocol().shard_state(mesh2d, axis_name=("cls", "thr"))
+    assert m.supports_sharded_compute
+    local = {"grid": jnp.zeros((4, 4), jnp.int32)}
+    with count_collectives() as box:
+        jax.make_jaxpr(
+            lambda s: m.sync_compute_state(s, axis_name=("cls", "thr")),
+            axis_env=[("cls", 4), ("thr", 2)],
+        )(local)
+    assert box["by_kind"].get("reshard", 0) == 2
+
+
+# --------------------------------------------------------------------------- #
+# lifecycle: reset / state_dict / checkpoint
+# --------------------------------------------------------------------------- #
+@pytest.mark.mesh8
+def test_multi_axis_reset_keeps_placement(mesh2d):
+    m = GridMetric().shard_state(mesh2d, axis_name=("cls", "thr"))
+    m.update(_grid_batch())
+    m.reset()
+    assert m.grid.sharding.spec == P("cls", "thr")
+    assert int(np.asarray(m.grid).sum()) == 0
+
+
+@pytest.mark.mesh8
+def test_multi_axis_state_dict_roundtrip(mesh2d):
+    x = _grid_batch()
+
+    def build():
+        m = GridMetric()
+        m._persistent["grid"] = True
+        return m.shard_state(mesh2d, axis_name=("cls", "thr"))
+
+    src = build()
+    src.update(x)
+    dst = build()
+    dst.load_state_dict(src.state_dict())
+    assert dst.grid.sharding.spec == P("cls", "thr")
+    assert _block_nbytes(dst.grid) * WORLD == int(dst.grid.nbytes)
+    assert np.array_equal(np.asarray(src.compute()), np.asarray(dst.compute()))
+
+
+@pytest.mark.mesh8
+def test_multi_axis_checkpoint_roundtrip(mesh2d, tmp_path):
+    from metrics_tpu.checkpoint import restore_checkpoint, save_checkpoint
+
+    x = _grid_batch()
+    src = GridMetric().shard_state(mesh2d, axis_name=("cls", "thr"))
+    src.update(x)
+    want = np.asarray(src.compute())
+    save_checkpoint(src, str(tmp_path), step=1)
+
+    dst = GridMetric().shard_state(mesh2d, axis_name=("cls", "thr"))
+    restore_checkpoint(dst, str(tmp_path))
+    assert dst.grid.sharding.spec == P("cls", "thr")
+    assert _block_nbytes(dst.grid) * WORLD == int(dst.grid.nbytes)
+    assert np.array_equal(want, np.asarray(dst.compute()))
+
+    # the payload stays placement-free: restores replicated too
+    flat = GridMetric()
+    restore_checkpoint(flat, str(tmp_path))
+    assert np.array_equal(want, np.asarray(flat.compute()))
+
+
+@pytest.mark.mesh8
+def test_multi_axis_leaf_meta_and_fingerprint(mesh2d):
+    from metrics_tpu.checkpoint.format import (
+        fingerprint_diff,
+        metric_fingerprint,
+        metric_leaves,
+    )
+
+    m = GridMetric()
+    fp = metric_fingerprint(m)
+    assert fp["states"]["grid"]["shard_axis"] == [0, 1]
+    _, meta = metric_leaves(m, "")
+    assert meta["grid"]["shard_axis"] == [0, 1]
+
+    # back-compat: pre-declaration checkpoints restore; conflicting tuples diff
+    import copy
+
+    old = copy.deepcopy(fp)
+    del old["states"]["grid"]["shard_axis"]
+    assert fingerprint_diff(old, fp) == []
+    conflicting = copy.deepcopy(fp)
+    conflicting["states"]["grid"]["shard_axis"] = [1, 0]
+    assert fingerprint_diff(conflicting, fp)
+
+
+@pytest.mark.mesh8
+def test_multi_axis_unshard(mesh2d):
+    x = _grid_batch()
+    m = GridMetric().shard_state(mesh2d, axis_name=("cls", "thr"))
+    m.update(x)
+    want = np.asarray(m.compute())
+    with count_collectives() as box:
+        m.unshard_state()
+    assert box["by_kind"] == {"reshard": 1}
+    assert m.active_shard_axes == {}
+    assert m.grid.nbytes == _block_nbytes(m.grid) if not hasattr(m.grid, "addressable_shards") else True
+    assert np.array_equal(want, np.asarray(m.compute()))

@@ -18,6 +18,8 @@ from metrics_tpu import Accuracy, F1Score, MeanSquaredError, MetricCollection
 NUM_CLASSES = 7
 
 
+pytestmark = pytest.mark.mesh8
+
 @pytest.fixture(scope="module")
 def mesh():
     devices = jax.devices()

@@ -1,0 +1,281 @@
+"""Gather-free sharded compute: the ``compute_sharded_state`` protocol.
+
+Metrics whose finalize factors into a per-shard reduction plus a small
+cross-shard combine declare :meth:`Metric.compute_sharded_state`; with an
+active placement and a single named axis, ``sync_compute_state`` routes there
+instead of re-materializing tiled state, so the only collectives are
+result-sized ``psum``/``all_gather`` — ``"reshard"`` bytes drop to zero.
+
+Pinned here on the 8-device CPU mesh:
+
+* every declaring metric matches its replicated twin under ``shard_map``
+  (bitwise for integer/elementwise finalizes, 1-ulp for cross-shard float
+  reductions) while spending zero ``"reshard"`` bytes;
+* subclasses that override ``compute`` without re-declaring the sharded twin
+  fall back to the reshard path (the MRO guard in
+  ``supports_sharded_compute``);
+* multi-axis placements and inactive declarations never route through the
+  protocol.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+import metrics_tpu
+from metrics_tpu import (
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+    CatMetric,
+    ConfusionMatrix,
+    F1Score,
+    MatthewsCorrCoef,
+    Precision,
+    Recall,
+    StatScores,
+)
+from metrics_tpu.parallel import make_mesh
+from metrics_tpu.parallel.sync import count_collectives
+
+WORLD = 8
+
+
+@pytest.fixture()
+def mesh():
+    devices = jax.devices()
+    if len(devices) < WORLD:
+        pytest.skip("needs 8 devices")
+    return make_mesh([WORLD], ["data"], devices[:WORLD])
+
+
+def _rng():
+    return np.random.default_rng(0)
+
+
+def _leaves_equal(a, b, exact=True):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    if exact:
+        return all(
+            np.array_equal(np.asarray(x), np.asarray(y), equal_nan=True)
+            for x, y in zip(la, lb)
+        )
+    return all(
+        np.allclose(np.asarray(x), np.asarray(y), rtol=1e-6, atol=1e-6, equal_nan=True)
+        for x, y in zip(la, lb)
+    )
+
+
+# --------------------------------------------------------------------------- #
+# eligibility: the MRO guard
+# --------------------------------------------------------------------------- #
+def test_declaring_metrics_support_sharded_compute():
+    declaring = [
+        ConfusionMatrix(num_classes=8),
+        MatthewsCorrCoef(num_classes=8),
+        StatScores(reduce="macro", num_classes=8),
+        Precision(average="macro", num_classes=8),
+        Recall(average="none", num_classes=8),
+        BinnedPrecisionRecallCurve(num_classes=8, thresholds=5),
+        BinnedAveragePrecision(num_classes=8, thresholds=5),
+        BinnedRecallAtFixedPrecision(num_classes=8, thresholds=5, min_precision=0.5),
+        CatMetric(buffer_capacity=16),
+    ]
+    for m in declaring:
+        assert m.supports_sharded_compute, type(m).__name__
+
+
+def test_compute_override_disables_inherited_sharded_compute():
+    """A subclass redefining ``compute`` silently invalidates a parent's
+    sharded twin — the guard must refuse it rather than compute wrong."""
+    assert not F1Score(num_classes=8, average="macro").supports_sharded_compute
+
+    class _Doubled(ConfusionMatrix):
+        def compute(self):
+            return super().compute() * 2
+
+    assert not _Doubled(num_classes=8).supports_sharded_compute
+
+    class _Redeclared(_Doubled):
+        def compute_sharded_state(self, state, axis_name):
+            return super().compute_sharded_state(state, axis_name) * 2
+
+    assert _Redeclared(num_classes=8).supports_sharded_compute
+
+
+def test_base_stub_raises():
+    with pytest.raises(NotImplementedError):
+        metrics_tpu.Metric.compute_sharded_state(
+            ConfusionMatrix(num_classes=4), {}, "data"
+        )
+
+
+# --------------------------------------------------------------------------- #
+# parity sweep: replicated compute vs sharded protocol under shard_map
+# --------------------------------------------------------------------------- #
+def _cls_data(C=64, n=4096):
+    rng = _rng()
+    return (
+        jnp.asarray(rng.integers(0, C, size=(n,))),
+        jnp.asarray(rng.integers(0, C, size=(n,))),
+    )
+
+
+def _prob_data(C=64, n=512):
+    rng = _rng()
+    return (
+        jnp.asarray(rng.random((n, C), dtype=np.float32)),
+        jnp.asarray(rng.integers(0, C, size=(n,))),
+    )
+
+
+C = 64
+
+_PROTOCOL_CASES = [
+    ("confmat", lambda: ConfusionMatrix(num_classes=C), _cls_data, True),
+    ("confmat_norm_true", lambda: ConfusionMatrix(num_classes=C, normalize="true"), _cls_data, True),
+    ("confmat_norm_pred", lambda: ConfusionMatrix(num_classes=C, normalize="pred"), _cls_data, False),
+    ("confmat_norm_all", lambda: ConfusionMatrix(num_classes=C, normalize="all"), _cls_data, False),
+    ("matthews", lambda: MatthewsCorrCoef(num_classes=C), _cls_data, True),
+    ("stat_scores_macro", lambda: StatScores(reduce="macro", num_classes=C), _cls_data, True),
+    ("precision_macro", lambda: Precision(average="macro", num_classes=C), _cls_data, False),
+    ("precision_none", lambda: Precision(average="none", num_classes=C), _cls_data, True),
+    ("recall_weighted", lambda: Recall(average="weighted", num_classes=C), _cls_data, False),
+    ("binned_pr_curve", lambda: BinnedPrecisionRecallCurve(num_classes=C, thresholds=16), _prob_data, True),
+    ("binned_ap", lambda: BinnedAveragePrecision(num_classes=C, thresholds=16), _prob_data, False),
+    ("binned_recall_at_p", lambda: BinnedRecallAtFixedPrecision(num_classes=C, thresholds=16, min_precision=0.5), _prob_data, True),
+]
+
+
+@pytest.mark.parametrize(
+    "build,data_fn,exact",
+    [c[1:] for c in _PROTOCOL_CASES],
+    ids=[c[0] for c in _PROTOCOL_CASES],
+)
+@pytest.mark.mesh8
+def test_protocol_parity_zero_reshard(mesh, build, data_fn, exact):
+    args = data_fn()
+    ref = build()
+    ref.update(*args)
+    expect = ref.compute()
+
+    m = build()
+    m.update(*args)
+    state = {k: getattr(m, k) for k in m._defaults}
+    m._state_sharding = (mesh, "data")
+    assert m.supports_sharded_compute
+    active = m.active_shard_axes
+    in_specs = ({k: P("data") if active.get(k) is not None else P() for k in state},)
+    fn = shard_map(
+        lambda st: m.sync_compute_state(st, axis_name="data"),
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=P(),
+        check_rep=False,
+    )
+    with count_collectives() as box:
+        got = fn(state)
+
+    assert _leaves_equal(expect, got, exact=exact)
+    # the protocol's whole point: zero state re-materialization
+    assert box["bytes_by_kind"].get("reshard", 0) == 0
+    assert box["by_kind"].get("reshard", 0) == 0
+    # ...while the combine really did cross shards with result-sized traffic
+    assert box["count"] >= 1
+
+
+@pytest.mark.mesh8
+def test_catmetric_protocol_gathers_without_reshard(mesh):
+    """CatMetric's sharded buffer normally re-materializes through the
+    ``"reshard"``-tagged catbuffer bucket; the protocol routes through
+    ``CatBuffer.gather`` (three ``all_gather`` ticks) instead."""
+    from metrics_tpu.utils.exceptions import MetricsUserError
+
+    m = CatMetric(buffer_capacity=WORLD * 2).shard_state(mesh)
+    m.update(jnp.arange(WORLD * 2, dtype=jnp.float32))
+    state = {"value": m.value}
+
+    # the trace legitimately dies at to_array() (data-dependent shape, same as
+    # the replicated compute under jit) — but only after the combine ran, so
+    # the collective accounting for the protocol leg is already complete
+    with count_collectives() as box:
+        with pytest.raises(MetricsUserError, match="to_array"):
+            jax.make_jaxpr(
+                lambda s: m.sync_compute_state(s, axis_name="data"),
+                axis_env=[("data", WORLD)],
+            )(state)
+    assert box["by_kind"].get("reshard", 0) == 0
+    assert box["by_kind"].get("all_gather", 0) == 3
+
+    with count_collectives() as box:
+        jax.make_jaxpr(
+            lambda s: m.sync_states(s, "data"), axis_env=[("data", WORLD)]
+        )(state)
+    assert box["by_kind"].get("reshard", 0) >= 1
+
+
+# --------------------------------------------------------------------------- #
+# routing: who takes the protocol path, who resharding
+# --------------------------------------------------------------------------- #
+def _local_confmat_block():
+    return {"confmat": jnp.zeros((C // WORLD, C), jnp.int32)}
+
+
+@pytest.mark.mesh8
+def test_protocol_traffic_is_result_sized(mesh):
+    m = ConfusionMatrix(num_classes=C).shard_state(mesh)
+    with count_collectives() as box:
+        jax.make_jaxpr(
+            lambda s: m.sync_compute_state(s, axis_name="data"),
+            axis_env=[("data", WORLD)],
+        )(_local_confmat_block())
+    # one result gather of the local block, nothing tagged as reshard
+    assert box["by_kind"] == {"all_gather": 1}
+    assert box["bytes_by_kind"]["all_gather"] == (C // WORLD) * C * 4
+    assert box["bytes_by_kind"].get("reshard", 0) == 0
+
+
+@pytest.mark.mesh8
+def test_non_declaring_metric_still_reshards(mesh):
+    m = F1Score(num_classes=C, average="macro").shard_state(mesh)
+    with count_collectives() as box:
+        jax.make_jaxpr(
+            lambda s: m.sync_compute_state(s, axis_name="data"),
+            axis_env=[("data", WORLD)],
+        )(m.init_state())
+    assert box["by_kind"].get("reshard", 0) >= 1
+
+
+def test_inactive_sharding_skips_protocol():
+    """Declaration alone must not route: per-device values of an unsharded
+    metric inside shard_map are replicas, and the protocol would gather
+    duplicates."""
+    m = ConfusionMatrix(num_classes=C)
+    assert m.supports_sharded_compute and m.active_shard_axes == {}
+    with count_collectives() as box:
+        jax.make_jaxpr(
+            lambda s: m.sync_compute_state(s, axis_name="data"),
+            axis_env=[("data", WORLD)],
+        )(m.init_state())
+    assert box["by_kind"].get("all_gather", 0) == 0
+    assert box["by_kind"].get("psum", 0) >= 1
+
+
+@pytest.mark.mesh8
+def test_axis_name_none_skips_protocol(mesh):
+    """The facade/GSPMD path (axis_name=None) computes on the global sharded
+    array under jit; the protocol is for explicit named-axis traces only."""
+    rng = _rng()
+    m = ConfusionMatrix(num_classes=C).shard_state(mesh)
+    m.update(
+        jnp.asarray(rng.integers(0, C, size=(128,))),
+        jnp.asarray(rng.integers(0, C, size=(128,))),
+    )
+    with count_collectives() as box:
+        out = m.sync_compute_state({"confmat": m.confmat}, None)
+    assert out.shape == (C, C)
+    assert box["count"] == 0

@@ -126,11 +126,13 @@ def test_declaration_is_inert():
     assert box["by_kind"].get("psum", 0) >= 1
 
 
+@pytest.mark.mesh8
 def test_shard_state_requires_known_axis(mesh):
     with pytest.raises(Exception, match="axis"):
         _Declared().shard_state(mesh, axis_name="model")
 
 
+@pytest.mark.mesh8
 def test_shard_state_without_declarations_warns(mesh):
     with pytest.warns(UserWarning, match="shard_axis"):
         Accuracy(num_classes=4, average="micro").shard_state(mesh)
@@ -185,6 +187,7 @@ def _catbuffer_case():
     [_confmat_case, _precision_case, _binned_case, _catbuffer_case],
     ids=["confmat", "precision_macro", "binned_pr", "catbuffer"],
 )
+@pytest.mark.mesh8
 def test_sharded_parity_and_footprint(mesh, case):
     build, data, exact = case()
     ref = build()
@@ -208,6 +211,7 @@ def test_sharded_parity_and_footprint(mesh, case):
     assert _leaves_equal(expect, m.compute(), exact=exact)
 
 
+@pytest.mark.mesh8
 def test_sharded_update_uses_compiled_donated_engine(mesh):
     rng = _rng()
     m = ConfusionMatrix(num_classes=64).shard_state(mesh)
@@ -226,6 +230,7 @@ def test_sharded_update_uses_compiled_donated_engine(mesh):
 # --------------------------------------------------------------------------- #
 # sync routing: sharded leaves never psum
 # --------------------------------------------------------------------------- #
+@pytest.mark.mesh8
 def test_sharded_leaves_spend_zero_psum_bytes(mesh):
     m = ConfusionMatrix(num_classes=64).shard_state(mesh)
     with count_collectives() as box:
@@ -238,6 +243,7 @@ def test_sharded_leaves_spend_zero_psum_bytes(mesh):
     assert box["bytes_by_kind"]["reshard"] == 64 * 64 * 4
 
 
+@pytest.mark.mesh8
 def test_mixed_state_splits_buckets(mesh):
     """Micro-Accuracy scalars keep their psum bucket; macro leaves reshard."""
     coll = MetricCollection(
@@ -268,6 +274,7 @@ def _sharded_spec(leaf):
     return getattr(leaf.sharding, "spec", None)
 
 
+@pytest.mark.mesh8
 def test_reset_keeps_placement(mesh):
     rng = _rng()
     m = ConfusionMatrix(num_classes=64).shard_state(mesh)
@@ -280,6 +287,7 @@ def test_reset_keeps_placement(mesh):
     assert np.asarray(m.confmat).sum() == 0
 
 
+@pytest.mark.mesh8
 def test_state_dict_roundtrip_keeps_placement(mesh):
     rng = _rng()
     preds = jnp.asarray(rng.integers(0, 64, size=(128,)))
@@ -299,6 +307,7 @@ def test_state_dict_roundtrip_keeps_placement(mesh):
     assert _leaves_equal(src.compute(), dst.compute())
 
 
+@pytest.mark.mesh8
 def test_checkpoint_roundtrip_sharded(mesh, tmp_path):
     from metrics_tpu.checkpoint import restore_checkpoint, save_checkpoint
 
@@ -349,6 +358,7 @@ def test_checkpoint_fingerprint_shard_axis_back_compat():
     assert fingerprint_diff(conflicting, live)
 
 
+@pytest.mark.mesh8
 def test_sharded_catbuffer_keeps_overflow_flag(mesh):
     """The sticky `overflowed` flag must survive sharded placement, the
     per-step sharding constraint inside compiled updates, and the gather back
@@ -369,6 +379,7 @@ def test_sharded_catbuffer_keeps_overflow_flag(mesh):
     assert bool(m.value.overflowed)
 
 
+@pytest.mark.mesh8
 def test_unshard_state(mesh):
     rng = _rng()
     m = ConfusionMatrix(num_classes=64).shard_state(mesh)
@@ -383,9 +394,36 @@ def test_unshard_state(mesh):
     assert np.array_equal(before, np.asarray(m.compute()))
 
 
+@pytest.mark.mesh8
+def test_unshard_round_trip_reshard_accounting(mesh):
+    """Every host-side re-materialization is billed as ``"reshard"`` — the
+    sharded→compute→unshard round trip spends exactly one state-sized tick
+    (at unshard; the facade compute runs gather-free under GSPMD)."""
+    rng = _rng()
+    m = ConfusionMatrix(num_classes=64).shard_state(mesh)
+    m.update(
+        jnp.asarray(rng.integers(0, 64, size=(128,))),
+        jnp.asarray(rng.integers(0, 64, size=(128,))),
+    )
+    with count_collectives() as box:
+        m.compute()
+        m.unshard_state()
+    assert box["by_kind"] == {"reshard": 1}
+    assert box["bytes_by_kind"] == {"reshard": 64 * 64 * 4}
+
+    # catbuffer states bill their payload buffer the same way
+    c = CatMetric(buffer_capacity=WORLD * 4).shard_state(mesh)
+    c.update(jnp.arange(WORLD * 4, dtype=jnp.float32))
+    with count_collectives() as box:
+        c.unshard_state()
+    assert box["by_kind"] == {"reshard": 1}
+    assert box["bytes_by_kind"] == {"reshard": WORLD * 4 * 4}
+
+
 # --------------------------------------------------------------------------- #
 # fused collection streaks with mixed members
 # --------------------------------------------------------------------------- #
+@pytest.mark.mesh8
 def test_fused_collection_mixed_sharded_members(mesh):
     rng = _rng()
     data = [
@@ -427,6 +465,7 @@ def test_fused_collection_mixed_sharded_members(mesh):
     assert _per_device_nbytes(confmat) * WORLD == int(confmat.nbytes)
 
 
+@pytest.mark.mesh8
 def test_collection_unshard_state(mesh):
     rng = _rng()
     coll = MetricCollection(
@@ -445,6 +484,7 @@ def test_collection_unshard_state(mesh):
 # --------------------------------------------------------------------------- #
 # engine capture: collective bytes land in EngineStats
 # --------------------------------------------------------------------------- #
+@pytest.mark.mesh8
 def test_engine_stats_record_reshard_bytes(mesh):
     rng = _rng()
     m = ConfusionMatrix(num_classes=64).shard_state(mesh)

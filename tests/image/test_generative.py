@@ -129,6 +129,7 @@ def test_fid_streaming_precision_noncentered():
     np.testing.assert_allclose(float(fid.compute()), want, rtol=5e-2, atol=5e-3)
 
 
+@pytest.mark.mesh8
 def test_fid_distributed_sync():
     # joint Welford sync over an 8-device mesh == oracle on all shards
     import jax

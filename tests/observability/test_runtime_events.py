@@ -171,6 +171,7 @@ class TestSyncBucketEvents:
 
 
 class TestShardAndMeshEvents:
+    @pytest.mark.mesh8
     def test_shard_place_and_unshard(self):
         if len(jax.devices()) < 8:
             pytest.skip("needs the 8-device conftest mesh")

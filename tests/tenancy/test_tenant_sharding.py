@@ -1,0 +1,137 @@
+"""Tenancy × sharding interplay: a mesh-placed sharded member must never be
+silently stacked — the tenant leading axis would fight the placement.
+
+Pinned here:
+
+* ``classify_tenant_member`` demotes a ``shard_state``-placed metric with the
+  engine's stable reason string; an *unplaced* ``shard_axis`` declaration is
+  inert and still stacks;
+* a TenantSet whose template carries a placed sharded member runs that
+  member's group as per-tenant eager clones (reason surfaced in
+  ``partition_view``) and stays bitwise-correct against independent
+  replicated references, while unrelated groups keep the stacked path;
+* the analyzer's E110 finding names the demotion in its extras
+  (``tenant_reason``) when sharding is what demotes.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import metrics_tpu as mt
+from metrics_tpu import ConfusionMatrix
+from metrics_tpu.core.engine import PATH_EAGER, PATH_TENANT, classify_tenant_member
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.parallel import make_mesh
+
+WORLD = 8
+C = 8
+
+DEMOTION_REASON = "sharded state: the tenant axis would conflict with the mesh placement"
+
+
+@pytest.fixture()
+def mesh():
+    devices = jax.devices()
+    if len(devices) < WORLD:
+        pytest.skip("needs 8 devices")
+    return make_mesh([WORLD], ["data"], devices[:WORLD])
+
+
+class ShardedCounts(Metric):
+    """Dense class-sharded counts: tenant-stackable until a placement lands."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state(
+            "counts", default=jnp.zeros((C,), jnp.int32), dist_reduce_fx="sum", shard_axis=0
+        )
+
+    def update(self, labels, *_):
+        self.counts = self.counts + jnp.bincount(labels, length=C).astype(jnp.int32)
+
+    def compute(self):
+        return self.counts.sum()
+
+
+def _labels(seed, n=32):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, C, size=(n,)), jnp.int32)
+
+
+# --------------------------------------------------------------- classifier --
+def test_unplaced_shard_axis_declaration_still_stacks():
+    # the declaration alone is inert (no placement => leaves are replicas)
+    path, reason = classify_tenant_member(ShardedCounts())
+    assert path == PATH_TENANT, reason
+    assert ConfusionMatrix(num_classes=C).shard_axes == {"confmat": 0}
+    path, _ = classify_tenant_member(ConfusionMatrix(num_classes=C))
+    assert path == PATH_TENANT
+
+
+@pytest.mark.mesh8
+def test_placed_sharded_member_demotes_with_stable_reason(mesh):
+    m = ConfusionMatrix(num_classes=C).shard_state(mesh)
+    path, reason = classify_tenant_member(m)
+    assert path == PATH_EAGER
+    assert reason == DEMOTION_REASON
+
+
+# ----------------------------------------------------------------- TenantSet --
+@pytest.mark.mesh8
+def test_tenant_set_demotes_sharded_group_and_stays_correct(mesh):
+    template = mt.MetricCollection(
+        {"cm": ConfusionMatrix(num_classes=C).shard_state(mesh), "counts": ShardedCounts()}
+    )
+    ts = mt.TenantSet(template, capacity=4)
+    view = ts.partition_view()["tenant"]
+    assert view["cm"]["path"] == PATH_EAGER
+    assert DEMOTION_REASON in view["cm"]["reason"]
+    # the unplaced member's group keeps the stacked path
+    assert view["counts"]["path"] == PATH_TENANT
+
+    tenants = ("a", "b", "c")
+    for t in tenants:
+        ts.admit(t)
+    refs = {t: ConfusionMatrix(num_classes=C) for t in tenants}
+    ref_counts = {t: ShardedCounts() for t in tenants}
+    for step in range(2):
+        preds = jnp.stack([_labels(10 * step + i) for i in range(len(tenants))])
+        target = jnp.stack([_labels(100 * step + i) for i in range(len(tenants))])
+        ts.update(list(tenants), preds, target)
+        for i, t in enumerate(tenants):
+            refs[t].update(preds[i], target[i])
+            ref_counts[t].update(preds[i])
+    assert ts.stats.eager_tenant_updates > 0
+    out = ts.compute(list(tenants))
+    for t in tenants:
+        assert np.array_equal(np.asarray(out[t]["cm"]), np.asarray(refs[t].compute()))
+        assert np.array_equal(
+            np.asarray(out[t]["counts"]), np.asarray(ref_counts[t].compute())
+        )
+
+
+# ------------------------------------------------------------------ analyzer --
+def test_demotion_reason_named_in_E110_extras():
+    from metrics_tpu.analysis.eval_stage import evaluate_entry
+    from metrics_tpu.analysis.registry import Entry
+
+    spec = {"inputs": [("int32", (32,))]}
+
+    # no placement: no E110 at all
+    findings = evaluate_entry(Entry(cls=ShardedCounts, spec=dict(spec)))
+    assert "E110" not in {f.rule for f in findings}
+
+    def _placed():
+        m = ShardedCounts()
+        # the analyzer's device-free stand-in for an active placement (the
+        # same sentinel shape the E108 leg uses)
+        m._state_sharding = ("__test__", "data")
+        return m
+
+    findings = evaluate_entry(Entry(cls=ShardedCounts, spec=dict(spec, init_fn=_placed)))
+    e110 = [f for f in findings if f.rule == "E110"]
+    assert len(e110) == 1
+    assert e110[0].extra["tenant_path"] == PATH_EAGER
+    assert e110[0].extra["tenant_reason"] == DEMOTION_REASON
