@@ -2772,6 +2772,203 @@ def bench_tenancy() -> None:
         sys.exit(1)
 
 
+def bench_serve() -> None:
+    """``--serve``: the ISSUE-13 ingestion front-end measured end to end over
+    real loopback HTTP — per-post ingest latency (p50/p99) and throughput of
+    ragged round-robin posts into a 16-tenant set with every pow2 coalesce
+    bucket pre-warmed (so the steady-state phase must be recompile-free),
+    plus rejection behavior at 2x overload with a chaos-stalled consumer
+    (every rejection surfaced as 429 + Retry-After, exact admission
+    accounting, every admitted batch applied) — recorded into
+    ``BENCH_r18.json`` and judged by the regression watchdog. Host-side CPU
+    bench."""
+    import glob as _glob
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+    from metrics_tpu import serve as _serve
+    from metrics_tpu.observability import regress as _regress
+    from metrics_tpu.resilience import chaos as _chaos
+
+    n_classes, per_tenant_batch, n_tenants, steps = 16, 64, 16, 24
+
+    def build():
+        return MetricCollection(
+            {
+                "acc": Accuracy(num_classes=n_classes, average="micro"),
+                "mse": MeanSquaredError(),
+            }
+        )
+
+    rng = np.random.default_rng(0)
+    ids = [f"t{i}" for i in range(n_tenants)]
+
+    def batch(n=n_tenants):
+        preds = rng.integers(0, n_classes, size=(n, per_tenant_batch)).astype(np.int32)
+        target = rng.integers(0, n_classes, size=(n, per_tenant_batch)).astype(np.int32)
+        return preds, target
+
+    # --- steady-state ingest: latency + throughput + zero recompiles --------
+    server = _serve.IngestServer(build(), queue_capacity=256).start()
+    try:
+        client = _serve.IngestClient(server.url)
+        ts = server.pipeline.tenant_set
+        # warm every pow2 coalesce bucket the dispatcher can hit, so the
+        # measured phase is the recompile-free steady state by construction
+        preds, target = batch()
+        with server.pipeline.apply_lock:
+            for w in (1, 2, 4, 8, 16):
+                ts.apply_batch(ids[:w], preds[:w], target[:w], auto_admit=True)
+        assert server.drain(30.0)
+        warm_compiles = int(ts.stats.compiles)
+
+        lat_us = []
+        t_wall = time.perf_counter()
+        for step in range(steps):
+            preds, target = batch()
+            for j, tid in enumerate(ids):
+                t0 = time.perf_counter()
+                doc = client.post(tid, preds[j], target[j])
+                lat_us.append((time.perf_counter() - t0) * 1e6)
+                if not doc.get("admitted"):
+                    raise RuntimeError(f"steady-state post rejected: {doc}")
+        posts = steps * n_tenants
+        throughput = posts / (time.perf_counter() - t_wall)
+        assert server.drain(30.0)
+        stats = server.stats()
+        steady_recompiles = int(ts.stats.compiles) - warm_compiles
+        lat_us.sort()
+        p50_us = lat_us[len(lat_us) // 2]
+        p99_us = lat_us[min(len(lat_us) - 1, int(len(lat_us) * 0.99))]
+        steady = {
+            "posts": posts,
+            "ingest_p50_us": round(p50_us, 1),
+            "ingest_p99_us": round(p99_us, 1),
+            "ingest_throughput_per_sec": round(throughput, 1),
+            "steady_state_recompiles": steady_recompiles,
+            "partition_builds": stats["tenant_set"]["partition_builds"],
+            "partition_stable_hits": stats["tenant_set"]["partition_stable_hits"],
+            "dispatches": stats["dispatcher"]["dispatches"],
+            "max_coalesce_width": stats["dispatcher"]["max_width"],
+            "executables": int(ts.stats.compiles),
+            "applied": stats["ledger"]["applied"],
+            "dead_letters": stats["dispatcher"]["dead_letters"],
+        }
+    finally:
+        server.stop(drain=False)
+
+    # --- 2x overload: a chaos-stalled consumer against a bounded queue ------
+    overload_cap = 16
+    server = _serve.IngestServer(
+        build(), queue_capacity=overload_cap, per_tenant_cap=overload_cap,
+        retry_after_s=1.0,
+    ).start()
+    try:
+        client = _serve.IngestClient(server.url)
+        offered = 2 * overload_cap
+        admitted = rejected = 0
+        reasons = {}
+        preds, target = batch(offered)
+        with _chaos.plan(
+            [_chaos.FaultSpec("serve/coalesce", kind="latency", latency_s=0.25)],
+            seed=0,
+        ):
+            for j in range(offered):
+                doc = client.post(ids[j % n_tenants], preds[j], target[j])
+                if doc.get("admitted"):
+                    admitted += 1
+                else:
+                    rejected += 1
+                    reasons[doc["reason"]] = reasons.get(doc["reason"], 0) + 1
+                    if doc["status"] != 429 or "retry_after_s" not in doc:
+                        raise RuntimeError(f"unsurfaced rejection: {doc}")
+        assert server.drain(30.0)  # chaos disarmed: the backlog applies
+        ostats = server.stats()
+        overload = {
+            "offered": offered,
+            "admitted": admitted,
+            "rejected": rejected,
+            "rejected_fraction": round(rejected / offered, 3),
+            "reject_reasons": reasons,
+            "queue_admitted_total": ostats["queue"]["admitted_total"],
+            "queue_rejected_total": ostats["queue"]["rejected_total"],
+            "applied_after_drain": ostats["ledger"]["applied"],
+            "dead_letters": ostats["dispatcher"]["dead_letters"],
+        }
+    finally:
+        server.stop(drain=False)
+
+    record = {
+        # headline: tail ingest latency of one HTTP post on the steady-state
+        # (recompile-free) path — what a producer actually waits on
+        "metric": "serve_ingest_p99_us",
+        "value": steady["ingest_p99_us"],
+        "unit": "us",
+        "extra": {
+            "config": "acc+mse_collection_http",
+            "num_classes": n_classes,
+            "per_tenant_batch": per_tenant_batch,
+            "tenants": n_tenants,
+            "steps": steps,
+            "steady": steady,
+            "overload": overload,
+        },
+    }
+
+    # watchdog self-check: judge this round against the checked-in trajectory
+    rounds = [
+        r for r in _regress.load_rounds(
+            sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json"))))
+        if r.name != "r18"
+    ]
+    rounds.append(_regress.Round("r18", "<this-run>", record))
+    report = _regress.check_trajectory(rounds)
+    record["extra"]["regress"] = {
+        "ok": report.ok,
+        "regression_count": len(report.regressions),
+        "keys_checked": report.keys_checked,
+        "regressions": [r.describe() for r in report.regressions],
+    }
+
+    with open(os.path.join(REPO, "BENCH_r18.json"), "w") as fh:
+        json.dump(record, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(record), flush=True)
+    problems = []
+    if steady["steady_state_recompiles"] != 0:
+        problems.append(
+            f"steady-state ingest recompiled {steady['steady_state_recompiles']}x "
+            "(pow2 bucketing should absorb queue-depth churn)"
+        )
+    if steady["partition_builds"] != 1:
+        problems.append(f"partition built {steady['partition_builds']}x (want 1)")
+    if steady["applied"] != steady["posts"]:  # warmup bypassed the ledger
+        problems.append(
+            f"steady ledger applied {steady['applied']} != {steady['posts']} posts"
+        )
+    if steady["dead_letters"] or overload["dead_letters"]:
+        problems.append("dead letters on a healthy path")
+    if overload["admitted"] + overload["rejected"] != overload["offered"]:
+        problems.append("overload accounting leaked an offer")
+    if overload["rejected"] == 0:
+        problems.append("2x overload produced zero rejections (queue unbounded?)")
+    if overload["applied_after_drain"] != overload["admitted"]:
+        problems.append(
+            f"admitted {overload['admitted']} but applied "
+            f"{overload['applied_after_drain']} — an admitted batch was dropped"
+        )
+    if not report.ok:
+        problems.extend(r.describe() for r in report.regressions)
+    if problems:
+        print("[bench] serve round FAILED its gates:", file=sys.stderr)
+        for p in problems:
+            print(f"[bench]   {p}", file=sys.stderr)
+        sys.exit(1)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
@@ -2816,6 +3013,14 @@ def main() -> None:
         "dispatches at N=16/256/1024, the ragged 1024/37 zero-recompile "
         "invariants, and tenant-batched sync collective counts; record into "
         "BENCH_r16.json",
+    )
+    parser.add_argument(
+        "--serve",
+        action="store_true",
+        help="measure the HTTP ingestion front-end: steady-state per-post "
+        "latency (p50/p99) + throughput with zero recompiles, and rejection "
+        "behavior at 2x overload against a chaos-stalled consumer; record "
+        "into BENCH_r18.json and judge with the regression watchdog",
     )
     parser.add_argument(
         "--checkpoint",
@@ -2872,6 +3077,9 @@ def main() -> None:
         return
     if args.tenancy:
         bench_tenancy()
+        return
+    if args.serve:
+        bench_serve()
         return
     if args.checkpoint:
         bench_checkpoint()

@@ -71,6 +71,7 @@ from metrics_tpu import observability  # noqa: F401
 from metrics_tpu import resilience  # noqa: F401
 from metrics_tpu import tenancy  # noqa: F401
 from metrics_tpu.tenancy import TenantSet  # noqa: F401
+from metrics_tpu import serve  # noqa: F401
 from metrics_tpu.detection import MeanAveragePrecision  # noqa: F401
 from metrics_tpu.image import (  # noqa: F401
     ErrorRelativeGlobalDimensionlessSynthesis,

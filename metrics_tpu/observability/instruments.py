@@ -173,6 +173,7 @@ class InstrumentRegistry:
         self._engines: List[weakref.ref] = []
         self._dispatchers: List[weakref.ref] = []
         self._tenant_sets: List[weakref.ref] = []
+        self._ingest_pipelines: List[weakref.ref] = []
 
     # ------------------------------------------------------------------ #
     # manual instruments
@@ -266,6 +267,54 @@ class InstrumentRegistry:
                     kept.append(ref)
             self._tenant_sets = kept
         return out
+
+    # ------------------------------------------------------------------ #
+    # ingest-pipeline registration — the serving bridge
+    # ------------------------------------------------------------------ #
+    def register_ingest_pipeline(self, pipeline: Any) -> None:
+        """Weakly track a :class:`metrics_tpu.serve.IngestPipeline`; its queue
+        depth, ledger, and dispatcher counters appear in snapshots as
+        ``metrics_tpu_ingest_*{queue=...}`` series (alongside the admission
+        counters/histograms the pipeline ticks directly)."""
+        with self._lock:
+            self._ingest_pipelines.append(weakref.ref(pipeline))
+
+    def live_ingest_pipelines(self) -> List[Any]:
+        out, kept = [], []
+        with self._lock:
+            for ref in self._ingest_pipelines:
+                pipeline = ref()
+                if pipeline is not None:
+                    out.append(pipeline)
+                    kept.append(ref)
+            self._ingest_pipelines = kept
+        return out
+
+    def _ingest_samples(self) -> Iterable[Sample]:
+        for pipeline in self.live_ingest_pipelines():
+            labels = {"queue": pipeline.name}
+            queue = pipeline.queue
+            yield Sample(f"{PREFIX}ingest_queue_depth", dict(labels),
+                         float(len(queue)), "gauge",
+                         "Observation batches currently queued for dispatch.")
+            yield Sample(f"{PREFIX}ingest_queue_capacity", dict(labels),
+                         float(queue.capacity), "gauge",
+                         "Admission bound of the ingest queue.")
+            yield Sample(f"{PREFIX}ingest_draining", dict(labels),
+                         1.0 if queue.closed else 0.0, "gauge",
+                         "1 while the queue is closed to new admissions.")
+            stats = pipeline.dispatcher.stats
+            for fname, help_text in (
+                ("dispatches", "Coalesced device dispatches applied."),
+                ("observations", "Admitted observations applied to tenant state."),
+                ("retries", "Transient dispatch faults retried by the consumer."),
+                ("dead_letters", "Admitted observations parked on the dead-letter list."),
+            ):
+                yield Sample(f"{PREFIX}ingest_dispatch_{fname}_total", dict(labels),
+                             float(getattr(stats, fname)), "counter", help_text)
+            yield Sample(f"{PREFIX}ingest_last_coalesce_width", dict(labels),
+                         float(stats.last_width), "gauge",
+                         "Distinct tenants in the most recent coalesced dispatch.")
 
     def _tenant_samples(self) -> Iterable[Sample]:
         for ts in self.live_tenant_sets():
@@ -370,6 +419,7 @@ class InstrumentRegistry:
         out.extend(self._engine_samples())
         out.extend(self._partition_samples())
         out.extend(self._tenant_samples())
+        out.extend(self._ingest_samples())
         out.extend(_process_samples())
         return out
 
@@ -390,6 +440,7 @@ class InstrumentRegistry:
             self._engines.clear()
             self._dispatchers.clear()
             self._tenant_sets.clear()
+            self._ingest_pipelines.clear()
 
 
 def _rss_bytes() -> Optional[int]:
@@ -475,6 +526,11 @@ def register_dispatcher(dispatcher: Any) -> None:
 def register_tenant_set(tenant_set: Any) -> None:
     """Module-level convenience over ``REGISTRY.register_tenant_set``."""
     REGISTRY.register_tenant_set(tenant_set)
+
+
+def register_ingest_pipeline(pipeline: Any) -> None:
+    """Module-level convenience over ``REGISTRY.register_ingest_pipeline``."""
+    REGISTRY.register_ingest_pipeline(pipeline)
 
 
 def get_registry() -> InstrumentRegistry:

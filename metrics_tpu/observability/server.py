@@ -21,12 +21,14 @@ the scrape handler's own CPU slice.
 
 Lifecycle: :func:`serve` starts the process-wide server (port from the
 argument or ``METRICS_TPU_OBS_PORT``; port 0 = OS-assigned), :func:`shutdown`
-stops it and joins the thread. Hosts that cannot accept inbound connections
-(NAT'd workers, firewalled pods) use the **push-to-spool fallback**: pass
-``spool_dir=`` (or set ``METRICS_TPU_OBS_SPOOL``) and a bind failure
-degrades to a :class:`TraceSpool` handle whose :meth:`TraceSpool.flush`
-writes this host's trace shard into the shared directory for a central
-merger to sweep.
+stops it and joins the thread. The bind/port-0/daemon-thread mechanics live
+in the shared :mod:`metrics_tpu.utils.httpd` helper (the ingestion server,
+:mod:`metrics_tpu.serve.server`, runs the same lifecycle). Hosts that cannot
+accept inbound connections (NAT'd workers, firewalled pods) use the
+**push-to-spool fallback**: pass ``spool_dir=`` (or set
+``METRICS_TPU_OBS_SPOOL``) and a bind failure degrades to a
+:class:`TraceSpool` handle whose :meth:`TraceSpool.flush` writes this host's
+trace shard into the shared directory for a central merger to sweep.
 
 The scrape server observes itself: handler latency lands in a
 ``metrics_tpu_obs_scrape_seconds{endpoint=...}`` histogram, so the next
@@ -38,13 +40,14 @@ import json
 import os
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any, Dict, Optional, Tuple, Union
 
 from metrics_tpu.observability import export as _export
 from metrics_tpu.observability import instruments as _instruments
 from metrics_tpu.observability import shards as _shards
 from metrics_tpu.observability import tracer as _tracer
+from metrics_tpu.utils import httpd as _httpd
 
 PORT_ENV = "METRICS_TPU_OBS_PORT"
 SPOOL_ENV = "METRICS_TPU_OBS_SPOOL"
@@ -154,24 +157,30 @@ class ObservabilityServer:
         self.registry = registry if registry is not None else _instruments.get_registry()
         self.host_id = host_id if host_id is not None else _shards.default_host_id()
         self.started_monotonic = time.monotonic()
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
+        # the shared bind/port-0/daemon-thread lifecycle (utils/httpd.py)
+        self._life = _httpd.DaemonHTTPServer(
+            _make_handler(self), host=host, port=port,
+            thread_name="metrics-tpu-obs-server",
+        )
 
     # ------------------------------------------------------------------ #
     @property
     def port(self) -> int:
         """The bound port (only meaningful after :meth:`start`)."""
-        if self._httpd is None:
-            return self.requested_port
-        return self._httpd.server_address[1]
+        return self._life.port
 
     @property
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        return self._life.url
 
     @property
     def running(self) -> bool:
-        return self._thread is not None and self._thread.is_alive()
+        return self._life.running
+
+    @property
+    def _thread(self) -> Optional[threading.Thread]:
+        # kept for introspection/back-compat (tests join on it)
+        return self._life._thread
 
     def start(self) -> "ObservabilityServer":
         """Bind and start serving on a daemon thread; returns ``self``.
@@ -179,30 +188,15 @@ class ObservabilityServer:
         Raises ``OSError`` when the port is taken — :func:`serve` turns that
         into the spool fallback.
         """
-        if self._httpd is not None:
-            return self
-        httpd = ThreadingHTTPServer((self.host, self.requested_port), _make_handler(self))
-        httpd.daemon_threads = True
-        self._httpd = httpd
-        self.started_monotonic = time.monotonic()
-        self._thread = threading.Thread(
-            target=httpd.serve_forever,
-            kwargs={"poll_interval": 0.1},
-            name=f"metrics-tpu-obs-server:{self.port}",
-            daemon=True,
-        )
-        self._thread.start()
+        was_running = self._life.running
+        self._life.start()
+        if not was_running:
+            self.started_monotonic = time.monotonic()
         return self
 
     def stop(self, timeout: float = 5.0) -> None:
         """Stop serving, close the socket, and join the thread."""
-        httpd, thread = self._httpd, self._thread
-        self._httpd, self._thread = None, None
-        if httpd is not None:
-            httpd.shutdown()
-            httpd.server_close()
-        if thread is not None:
-            thread.join(timeout)
+        self._life.stop(timeout)
 
     # ------------------------------------------------------------------ #
     def observe_scrape(self, path: str, seconds: float) -> None:
@@ -273,19 +267,21 @@ def serve(
     with _server_lock:
         if _server is not None and (_server.kind == "spool" or _server.running):
             return _server
-        if port is None:
-            port = int(os.environ.get(PORT_ENV, "0") or "0")
+        port = _httpd.resolve_port(port, PORT_ENV)
         if spool_dir is None:
             spool_dir = os.environ.get(SPOOL_ENV) or None
-        try:
-            _server = ObservabilityServer(
+        fallback = None
+        if spool_dir is not None:
+            fallback = lambda err: TraceSpool(  # noqa: E731
+                spool_dir, host_id=host_id,
+                reason=f"bind {host}:{port} failed: {err}",
+            )
+        _server = _httpd.start_with_fallback(
+            lambda: ObservabilityServer(
                 port=port, host=host, registry=registry, host_id=host_id,
-            ).start()
-        except OSError as err:
-            if spool_dir is None:
-                raise
-            _server = TraceSpool(spool_dir, host_id=host_id,
-                                 reason=f"bind {host}:{port} failed: {err}")
+            ).start(),
+            fallback,
+        )
         return _server
 
 

@@ -113,6 +113,15 @@ EVENT_CATALOG: Dict[str, Tuple[str, ...]] = {
     "guard": (
         "guard/nonfinite",  # non-finite state detected at a guarded boundary
     ),
+    "serve": (
+        "serve/ingest",  # one observation admitted to the ingest queue
+        "serve/reject",  # one observation rejected at admission (args: reason)
+        "serve/coalesce",  # consumer pulled a distinct-tenant batch (args: width)
+        "serve/dispatch",  # coalesced batch applied to the TenantSet (args: attempts)
+        "serve/read",  # staleness-bounded tenant read served
+        "serve/drain",  # graceful drain: every admitted batch accounted for
+        "serve/dead_letter",  # a batch parked on the dead-letter list (args: error)
+    ),
 }
 
 

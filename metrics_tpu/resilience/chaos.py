@@ -85,6 +85,10 @@ KNOWN_SITES = (
     "tenancy/dispatch",
     "tenancy/admit",
     "tenancy/evict",
+    "serve/ingest",
+    "serve/coalesce",
+    "serve/dispatch",
+    "serve/read",
 )
 
 

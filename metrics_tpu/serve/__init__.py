@@ -1,0 +1,48 @@
+"""metrics_tpu.serve — the online ingestion front-end.
+
+ingest → batch → dispatch → serve: per-tenant observation batches arrive
+over HTTP (or in-process), a bounded queue applies admission control and
+backpressure, a coalescer folds ragged arrivals into distinct-tenant device
+batches, one dispatcher thread drives them through a
+:class:`~metrics_tpu.tenancy.TenantSet` (pow2-bucketed, recompile-free in
+steady state), and reads serve each tenant's ``compute()`` with an explicit
+staleness bound. See ``docs/serving.md``.
+"""
+from metrics_tpu.serve.client import IngestClient, offline_replay
+from metrics_tpu.serve.coalesce import (
+    Admission,
+    BoundedIngestQueue,
+    Observation,
+)
+from metrics_tpu.serve.dispatcher import DeadLetter, Dispatcher, DispatchStats
+from metrics_tpu.serve.server import (
+    DeadlineMissed,
+    IngestPipeline,
+    IngestServer,
+    UnknownTenant,
+    decode_body,
+    encode_npz,
+    get_server,
+    serve,
+    shutdown,
+)
+
+__all__ = [
+    "Admission",
+    "BoundedIngestQueue",
+    "DeadLetter",
+    "DeadlineMissed",
+    "Dispatcher",
+    "DispatchStats",
+    "IngestClient",
+    "IngestPipeline",
+    "IngestServer",
+    "Observation",
+    "UnknownTenant",
+    "decode_body",
+    "encode_npz",
+    "get_server",
+    "offline_replay",
+    "serve",
+    "shutdown",
+]

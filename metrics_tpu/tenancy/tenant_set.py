@@ -335,6 +335,34 @@ class TenantSet:
         self.stats.last_bucket = width
         self._dispatcher._ensure_partition()  # stable-partition heartbeat
 
+    def apply_batch(
+        self,
+        tenant_ids: Sequence[TenantId],
+        *args: Any,
+        auto_admit: bool = False,
+        **kwargs: Any,
+    ) -> Dict[TenantId, int]:
+        """One ingestion dispatch: optionally admit, then :meth:`update`.
+
+        The entry point the serving stack's dispatcher thread uses
+        (:mod:`metrics_tpu.serve`): with ``auto_admit=True`` tenants seen for
+        the first time are admitted before the stacked update — admission is
+        pure host-side bookkeeping, so the combined call still never
+        recompiles in steady state. Returns each tenant's post-dispatch
+        update count (the "last applied step" echoed by served reads).
+        Raises :class:`~metrics_tpu.utils.exceptions.MetricsUserError` at
+        capacity, exactly like :meth:`admit` — the caller owns admission
+        control and must reject upstream instead of evicting silently.
+        """
+        if auto_admit:
+            for tid in tenant_ids:
+                if tid not in self._slot_of:
+                    self.admit(tid)
+        self.update(tenant_ids, *args, **kwargs)
+        return {
+            tid: int(self._update_counts[self._slot_of[tid]]) for tid in tenant_ids
+        }
+
     def _split_leaves(
         self, k: int, width: int, args: Tuple, kwargs: Dict
     ) -> Tuple[Any, List[jnp.ndarray], List[jnp.ndarray], Tuple]:

@@ -226,6 +226,30 @@ class TestPrometheusRoundTrip:
         # every family got a non-default-free HELP and a TYPE
         assert all(f["help"] and f["type"] for f in families.values())
 
+    def test_ingest_pipeline_series_parse_strictly(self):
+        """The serving bridge: a registered IngestPipeline exports
+        metrics_tpu_ingest_* gauges/counters through the same strict
+        Prometheus exposition as every other family."""
+        from metrics_tpu import MetricCollection, MeanSquaredError
+        from metrics_tpu.serve import IngestPipeline
+
+        reg = InstrumentRegistry()
+        pipeline = IngestPipeline(
+            MetricCollection({"mse": MeanSquaredError()}),
+            queue_capacity=4, name="export-test",
+        )
+        reg.register_ingest_pipeline(pipeline)
+        pipeline.post("t0", np.ones((4,), np.float32), np.zeros((4,), np.float32))
+        text = obs.to_prometheus_text(reg)
+        families, samples = _StrictPromParser().parse(text)
+        by_name = {s[0]: s for s in samples}
+        name, labels, value = by_name["metrics_tpu_ingest_queue_depth"]
+        assert labels == {"queue": "export-test"} and value == 1.0
+        assert by_name["metrics_tpu_ingest_queue_capacity"][2] == 4.0
+        assert "metrics_tpu_ingest_dispatch_dead_letters_total" in by_name
+        assert families["metrics_tpu_ingest_queue_depth"]["type"] == "gauge"
+        assert families["metrics_tpu_ingest_dispatch_retries_total"]["type"] == "counter"
+
     def test_awkward_label_values_round_trip(self):
         reg = InstrumentRegistry()
         awkward = 'quote " backslash \\ newline \n tab\tdone'

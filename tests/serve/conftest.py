@@ -1,0 +1,22 @@
+"""Serving suite hygiene: the ingest singleton, the chaos plan, the tracer,
+and the instrument registry are process-global — every test leaves them the
+way it found them (server drained and stopped, harness disarmed, tracing
+off, registry cleared)."""
+import pytest
+
+from metrics_tpu.observability import tracer as _otrace
+from metrics_tpu.observability.instruments import REGISTRY
+from metrics_tpu.resilience import chaos as _chaos
+from metrics_tpu.serve import server as _iserver
+
+
+@pytest.fixture(autouse=True)
+def _pristine_serve_globals():
+    yield
+    _chaos.uninstall()
+    _iserver.shutdown(drain=False, timeout=5.0)
+    _otrace.disable()
+    tracer = _otrace.get_tracer()
+    if tracer is not None:
+        tracer.clear()
+    REGISTRY.clear()

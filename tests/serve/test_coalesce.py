@@ -1,0 +1,134 @@
+"""Unit tests for the bounded ingest queue and the ragged-arrival coalescer
+(no sockets, no dispatcher thread — pure admission/coalescing semantics)."""
+import numpy as np
+import pytest
+
+from metrics_tpu.serve import BoundedIngestQueue, Observation
+
+
+def _obs(tid, *shapes):
+    return Observation(tid, tuple(np.zeros(s, np.float32) for s in shapes))
+
+
+class TestAdmission:
+    def test_admits_until_capacity_then_rejects_queue_full(self):
+        q = BoundedIngestQueue(capacity=3, per_tenant_cap=3)
+        for i in range(3):
+            adm = q.offer(_obs(f"t{i}", (4,)))
+            assert adm.admitted and adm.seq == i + 1
+        adm = q.offer(_obs("t3", (4,)))
+        assert not adm.admitted
+        assert adm.reason == "queue_full"
+        assert adm.queue_depth == 3
+        assert q.admitted_total == 3 and q.rejected_total == 1
+
+    def test_retry_after_header_is_http_delta_seconds(self):
+        q = BoundedIngestQueue(capacity=1, retry_after_s=2.5)
+        q.offer(_obs("a", (2,)))
+        adm = q.offer(_obs("b", (2,)))
+        assert adm.retry_after_s == 2.5
+        assert adm.retry_after_header == "3"  # ceil, integer, >= 1
+        assert BoundedIngestQueue(capacity=1, retry_after_s=0.1).retry_after_s == 0.1
+
+    def test_per_tenant_cap_is_fairness_not_capacity(self):
+        """A hot tenant hits its cap while a cold tenant still gets slots."""
+        q = BoundedIngestQueue(capacity=8, per_tenant_cap=2)
+        assert q.offer(_obs("hog", (2,))).admitted
+        assert q.offer(_obs("hog", (2,))).admitted
+        adm = q.offer(_obs("hog", (2,)))
+        assert not adm.admitted and adm.reason == "tenant_cap"
+        assert q.offer(_obs("cold", (2,))).admitted  # others unaffected
+
+    def test_default_cap_is_quarter_of_capacity(self):
+        assert BoundedIngestQueue(capacity=256).per_tenant_cap == 64
+        assert BoundedIngestQueue(capacity=2).per_tenant_cap == 1
+
+    def test_close_rejects_draining_and_reopen_admits(self):
+        q = BoundedIngestQueue(capacity=4)
+        q.close()
+        adm = q.offer(_obs("a", (2,)))
+        assert not adm.admitted and adm.reason == "draining"
+        q.reopen()
+        assert q.offer(_obs("a", (2,))).admitted
+
+    def test_invalid_bounds_raise(self):
+        with pytest.raises(ValueError):
+            BoundedIngestQueue(capacity=0)
+        with pytest.raises(ValueError):
+            BoundedIngestQueue(capacity=4, per_tenant_cap=0)
+
+
+class TestCoalesce:
+    def test_distinct_tenants_one_batch(self):
+        q = BoundedIngestQueue(capacity=16)
+        for tid in ("a", "b", "c"):
+            q.offer(_obs(tid, (4,)))
+        batch = q.pop_coalesced(max_width=8, timeout=0.1)
+        assert [o.tenant_id for o in batch] == ["a", "b", "c"]
+        assert len(q) == 0
+
+    def test_same_tenant_second_obs_waits_for_next_batch(self):
+        """FIFO per tenant: a duplicate tenant never joins the same batch
+        (the stacked scatter would be undefined)."""
+        q = BoundedIngestQueue(capacity=16)
+        for tid in ("a", "b", "a", "c", "a"):
+            q.offer(_obs(tid, (4,)))
+        first = q.pop_coalesced(max_width=8, timeout=0.1)
+        assert [o.tenant_id for o in first] == ["a", "b", "c"]
+        second = q.pop_coalesced(max_width=8, timeout=0.1)
+        assert [o.tenant_id for o in second] == ["a"]
+        third = q.pop_coalesced(max_width=8, timeout=0.1)
+        assert [o.tenant_id for o in third] == ["a"]
+        assert q.pop_coalesced(timeout=0.01) is None
+
+    def test_signature_split_keeps_shapes_separate(self):
+        """Mixed arrival shapes coalesce per-signature, FIFO-respecting."""
+        q = BoundedIngestQueue(capacity=16)
+        q.offer(_obs("a", (4,)))
+        q.offer(_obs("b", (8,)))   # different shape: next signature group
+        q.offer(_obs("c", (4,)))
+        first = q.pop_coalesced(max_width=8, timeout=0.1)
+        assert [o.tenant_id for o in first] == ["a", "c"]
+        second = q.pop_coalesced(max_width=8, timeout=0.1)
+        assert [o.tenant_id for o in second] == ["b"]
+
+    def test_static_config_participates_in_signature(self):
+        q = BoundedIngestQueue(capacity=16)
+        q.offer(Observation("a", (np.zeros(2, np.float32),), {"gain": 2.0}))
+        q.offer(Observation("b", (np.zeros(2, np.float32),), {"gain": 3.0}))
+        batch = q.pop_coalesced(timeout=0.1)
+        assert [o.tenant_id for o in batch] == ["a"]  # gain repr differs
+
+    def test_max_width_caps_the_batch(self):
+        q = BoundedIngestQueue(capacity=64)
+        for i in range(10):
+            q.offer(_obs(f"t{i}", (2,)))
+        batch = q.pop_coalesced(max_width=4, timeout=0.1)
+        assert len(batch) == 4
+        assert len(q) == 6
+
+    def test_per_tenant_depth_released_on_pop(self):
+        q = BoundedIngestQueue(capacity=8, per_tenant_cap=1)
+        q.offer(_obs("a", (2,)))
+        assert not q.offer(_obs("a", (2,))).admitted
+        q.pop_coalesced(timeout=0.1)
+        assert q.tenant_depth("a") == 0
+        assert q.offer(_obs("a", (2,))).admitted  # slot freed
+
+    def test_empty_timeout_returns_none(self):
+        q = BoundedIngestQueue(capacity=4)
+        assert q.pop_coalesced(timeout=0.01) is None
+
+    def test_closed_and_drained_returns_none(self):
+        q = BoundedIngestQueue(capacity=4)
+        q.offer(_obs("a", (2,)))
+        q.close()
+        assert q.pop_coalesced(timeout=0.1) is not None  # drains the backlog
+        assert q.pop_coalesced(timeout=0.1) is None      # then signals done
+
+    def test_wait_empty(self):
+        q = BoundedIngestQueue(capacity=4)
+        q.offer(_obs("a", (2,)))
+        assert not q.wait_empty(timeout=0.05)
+        q.pop_coalesced(timeout=0.1)
+        assert q.wait_empty(timeout=0.05)

@@ -1,0 +1,226 @@
+"""End-to-end tests of the ingestion front-end over real loopback HTTP:
+bitwise parity with the offline replay oracle, zero steady-state recompiles,
+backpressure (429 + Retry-After), per-tenant fairness, tenant capacity,
+staleness-bounded reads, and graceful drain."""
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu import serve as msv
+from metrics_tpu.observability.instruments import REGISTRY
+
+pytestmark = pytest.mark.network
+
+
+def _factory():
+    return mt.MetricCollection(
+        {"acc": mt.Accuracy(num_classes=4), "mse": mt.MeanSquaredError()}
+    )
+
+
+def _post_batches(client, rng, tenants, steps, log):
+    """Ragged arrivals: each step posts a random subset of the tenants."""
+    for _ in range(steps):
+        k = int(rng.integers(1, len(tenants) + 1))
+        for t in sorted(rng.choice(len(tenants), size=k, replace=False)):
+            preds = rng.integers(0, 4, (8,)).astype(np.int32)
+            target = rng.integers(0, 4, (8,)).astype(np.int32)
+            doc = client.post(tenants[t], preds, target)
+            assert doc["admitted"], doc
+            log.append((tenants[t], (preds, target), {}))
+
+
+class TestEndToEnd:
+    def test_ragged_http_ingest_matches_offline_replay_bitwise(self):
+        """The acceptance property: ragged per-tenant batches over HTTP,
+        coalesced and stacked on the device, must be bitwise-equal to the
+        pure per-tenant offline replay — with zero steady-state recompiles
+        (stable_hits monotone, partition builds == 1)."""
+        server = msv.IngestServer(_factory(), queue_capacity=256).start()
+        try:
+            client = msv.IngestClient(server.url)
+            tenants = [f"tenant-{i}" for i in range(7)]
+            rng = np.random.default_rng(42)
+            log = []
+            _post_batches(client, rng, tenants, steps=4, log=log)
+            assert server.drain(30.0)
+            warm_compiles = server.stats()["tenant_set"]["compiles"]
+            _post_batches(client, rng, tenants, steps=8, log=log)
+            assert server.drain(30.0)
+            stats = server.stats()
+            # 0 recompiles after warmup: pow2 bucketing absorbs raggedness
+            assert stats["tenant_set"]["compiles"] == warm_compiles
+            assert stats["tenant_set"]["partition_builds"] == 1
+            assert stats["tenant_set"]["partition_stable_hits"] >= stats["dispatcher"]["dispatches"]
+            assert stats["ledger"]["admitted"] == stats["ledger"]["applied"] == len(log)
+            assert stats["dispatcher"]["dead_letters"] == 0
+
+            expect = msv.offline_replay(_factory, log)
+            for tid, ref in expect.items():
+                doc = client.read(tid, max_staleness_steps=0, timeout_s=10)
+                assert doc["status"] == 200
+                assert doc["staleness_steps"] == 0
+                for name, want in ref.items():
+                    got = np.asarray(doc["values"][name], dtype=want.dtype)
+                    assert np.array_equal(got, want), (tid, name)
+        finally:
+            server.stop(drain=False)
+
+    def test_json_body_reaches_the_same_state_as_npz(self):
+        results = {}
+        for encoding in ("npz", "json"):
+            server = msv.IngestServer(_factory()).start()
+            try:
+                client = msv.IngestClient(server.url)
+                preds = np.asarray([1, 2, 3, 0], np.int32)
+                target = np.asarray([1, 1, 3, 2], np.int32)
+                doc = client.post("t0", preds, target, encoding=encoding)
+                assert doc["admitted"], doc
+                assert server.drain(10.0)
+                read = client.read("t0", max_staleness_steps=0)
+                results[encoding] = read["values"]
+            finally:
+                server.stop(drain=False)
+        # JSON ints decode as int64 vs npz int32 — values must still agree
+        for name in results["npz"]:
+            assert np.allclose(results["npz"][name], results["json"][name])
+
+    def test_read_echoes_the_staleness_contract(self):
+        server = msv.IngestServer(_factory()).start()
+        try:
+            client = msv.IngestClient(server.url)
+            preds = np.zeros((4,), np.int32)
+            client.post("t0", preds, preds)
+            doc = client.read("t0", max_staleness_steps=0, timeout_s=10)
+            assert doc["last_applied_step"] == 1
+            assert doc["admitted_steps"] == 1
+            assert doc["staleness_steps"] == 0
+            assert doc["dead_lettered_steps"] == 0
+            assert doc["max_staleness_steps"] == 0
+        finally:
+            server.stop(drain=False)
+
+    def test_unknown_tenant_reads_404(self):
+        server = msv.IngestServer(_factory()).start()
+        try:
+            assert msv.IngestClient(server.url).read("ghost")["status"] == 404
+        finally:
+            server.stop(drain=False)
+
+
+class TestBackpressure:
+    """Admission control with the consumer deliberately NOT running, so the
+    queue state is exact — no race against the dispatcher draining it."""
+
+    def _stalled_server(self, **kw):
+        server = msv.IngestServer(_factory(), **kw)
+        server._life.start()  # HTTP up; dispatcher intentionally not started
+        return server
+
+    def test_full_queue_answers_429_with_retry_after(self):
+        server = self._stalled_server(queue_capacity=3, retry_after_s=2.0)
+        try:
+            client = msv.IngestClient(server.url)
+            x = np.zeros((4,), np.int32)
+            for i in range(3):
+                assert client.post(f"t{i}", x, x)["admitted"]
+            doc = client.post("t3", x, x)
+            assert doc["status"] == 429
+            assert doc["reason"] == "queue_full"
+            assert doc["retry_after_s"] == 2.0  # the Retry-After header
+            assert server.stats()["queue"]["rejected_total"] == 1
+        finally:
+            server.stop(drain=False, timeout=1.0)
+
+    def test_per_tenant_fairness_cap_shields_cold_tenants(self):
+        server = self._stalled_server(queue_capacity=8, per_tenant_cap=2)
+        try:
+            client = msv.IngestClient(server.url)
+            x = np.zeros((4,), np.int32)
+            assert client.post("hog", x, x)["admitted"]
+            assert client.post("hog", x, x)["admitted"]
+            doc = client.post("hog", x, x)
+            assert doc["status"] == 429 and doc["reason"] == "tenant_cap"
+            assert "retry_after_s" in doc
+            assert client.post("cold", x, x)["admitted"]  # fairness
+        finally:
+            server.stop(drain=False, timeout=1.0)
+
+    def test_tenant_set_capacity_rejects_new_tenants(self):
+        ts = mt.TenantSet(_factory(), capacity=2)
+        server = self._stalled_server()
+        server.pipeline.tenant_set = ts
+        try:
+            client = msv.IngestClient(server.url)
+            x = np.zeros((4,), np.int32)
+            assert client.post("t0", x, x)["admitted"]
+            assert client.post("t1", x, x)["admitted"]
+            doc = client.post("t2", x, x)
+            assert doc["status"] == 429 and doc["reason"] == "tenant_capacity"
+            # known tenants still ingest
+            assert client.post("t0", x, x)["admitted"]
+        finally:
+            server.stop(drain=False, timeout=1.0)
+
+    def test_stalled_consumer_misses_the_read_deadline(self):
+        server = self._stalled_server()
+        try:
+            client = msv.IngestClient(server.url)
+            x = np.zeros((4,), np.int32)
+            assert client.post("t0", x, x)["admitted"]
+            doc = client.read("t0", max_staleness_steps=0, timeout_s=0.2)
+            assert doc["status"] == 503
+            assert doc["reason"] == "deadline_missed"
+            assert doc["staleness_steps"] == 1
+            assert "retry_after_s" in doc
+            missed = [
+                s for s in REGISTRY.samples()
+                if s.name == "metrics_tpu_ingest_deadline_missed_total"
+            ]
+            assert missed and missed[0].value == 1.0
+            # an unbounded read answers immediately with the stale echo
+            doc = client.read("t0")
+            assert doc["status"] == 200 and doc["staleness_steps"] == 1
+            assert doc["values"] is None  # nothing materialized on device yet
+        finally:
+            server.stop(drain=False, timeout=1.0)
+
+    def test_retry_loop_eventually_lands_when_consumer_resumes(self):
+        server = self._stalled_server(queue_capacity=1, retry_after_s=0.02)
+        try:
+            client = msv.IngestClient(server.url)
+            x = np.zeros((4,), np.int32)
+            assert client.post("t0", x, x)["admitted"]
+            assert client.post("t1", x, x)["status"] == 429
+            server.pipeline.start()  # consumer comes alive
+            doc = client.post_with_retry("t1", x, x, max_attempts=50)
+            assert doc["admitted"], doc
+        finally:
+            server.stop(drain=False, timeout=2.0)
+
+
+class TestGracefulDrain:
+    def test_drain_applies_every_admitted_batch(self):
+        server = msv.IngestServer(_factory(), queue_capacity=256).start()
+        client = msv.IngestClient(server.url)
+        rng = np.random.default_rng(7)
+        log = []
+        _post_batches(client, rng, [f"t{i}" for i in range(5)], steps=6, log=log)
+        posted = len(log)
+        # posts during the drain are rejected loudly, not dropped quietly
+        server.pipeline.queue.close()
+        x = np.zeros((8,), np.int32)
+        doc = client.post("t0", x, x)
+        assert doc["status"] == 503 and doc["reason"] == "draining"
+        assert "retry_after_s" in doc
+        assert server.stop(drain=True, timeout=30.0)
+        ledger = server.pipeline.stats()["ledger"]
+        assert ledger["admitted"] == ledger["applied"] == posted
+        assert ledger["dead_lettered"] == 0
+        # the pipeline stays readable after the socket is gone
+        per_tenant = {}
+        for tid, _, _ in log:
+            per_tenant[tid] = per_tenant.get(tid, 0) + 1
+        for tid, n in per_tenant.items():
+            doc = server.pipeline.read(tid, max_staleness_steps=0, timeout_s=1.0)
+            assert doc["last_applied_step"] == n

@@ -1,0 +1,124 @@
+"""Pins the shared HTTP-server lifecycle helper (metrics_tpu.utils.httpd):
+bind, port 0, daemon thread, idempotent stop, and the "taken port never
+kills a shared-pod job" fallback — implemented once, used by BOTH servers
+(the observability scrape server and the ingestion front-end)."""
+import socket
+from http.server import BaseHTTPRequestHandler
+
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.observability.server import ObservabilityServer
+from metrics_tpu.serve import IngestPipeline, IngestServer
+from metrics_tpu.serve import server as _iserver
+from metrics_tpu.utils import httpd as _httpd
+
+pytestmark = pytest.mark.network
+
+
+class _NoopHandler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802
+        self.send_response(204)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def log_message(self, *a):  # noqa: A002
+        pass
+
+
+def _collection():
+    return mt.MetricCollection({"mse": mt.MeanSquaredError()})
+
+
+class TestDaemonHTTPServer:
+    def test_port0_binds_ephemeral_and_stop_is_idempotent(self):
+        life = _httpd.DaemonHTTPServer(_NoopHandler)
+        assert life.port == 0
+        life.start()
+        try:
+            assert life.port > 0
+            assert life.url == f"http://127.0.0.1:{life.port}"
+            assert life.running
+            assert life.start() is life  # idempotent start
+        finally:
+            life.stop()
+            life.stop()  # idempotent stop
+        assert not life.running
+
+    def test_taken_port_raises_oserror(self):
+        with socket.socket() as blocker:
+            blocker.bind(("127.0.0.1", 0))
+            taken = blocker.getsockname()[1]
+            with pytest.raises(OSError):
+                _httpd.DaemonHTTPServer(_NoopHandler, port=taken).start()
+
+    def test_start_with_fallback_degrades_instead_of_raising(self):
+        err = OSError(98, "Address already in use")
+
+        def boom():
+            raise err
+
+        handle = _httpd.start_with_fallback(boom, lambda e: ("degraded", e))
+        assert handle == ("degraded", err)
+        with pytest.raises(OSError):
+            _httpd.start_with_fallback(boom, None)  # no fallback: propagate
+
+    def test_resolve_port_argument_env_then_zero(self, monkeypatch):
+        monkeypatch.setenv("T_PORT", "4242")
+        assert _httpd.resolve_port(1234, "T_PORT") == 1234
+        assert _httpd.resolve_port(None, "T_PORT") == 4242
+        monkeypatch.delenv("T_PORT")
+        assert _httpd.resolve_port(None, "T_PORT") == 0
+
+
+class TestSharedAcrossBothServers:
+    def test_both_servers_run_the_same_lifecycle(self):
+        """The pin: one lifecycle implementation, two servers on top of it."""
+        obs = ObservabilityServer()
+        ingest = IngestServer(_collection())
+        assert isinstance(obs._life, _httpd.DaemonHTTPServer)
+        assert isinstance(ingest._life, _httpd.DaemonHTTPServer)
+        obs.start()
+        ingest.start()
+        try:
+            assert obs.running and ingest.running
+            assert obs.port != ingest.port
+        finally:
+            ingest.stop(drain=False)
+            obs.stop()
+        assert not obs.running and not ingest.running
+
+    def test_serve_singleton_falls_back_to_local_pipeline(self):
+        """A taken port degrades the ingest singleton to the in-process
+        pipeline (kind 'local') instead of killing the job."""
+        with socket.socket() as blocker:
+            blocker.bind(("127.0.0.1", 0))
+            taken = blocker.getsockname()[1]
+            handle = _iserver.serve(_collection(), port=taken, fallback_local=True)
+            try:
+                assert isinstance(handle, IngestPipeline)
+                assert handle.kind == "local"
+                assert "failed" in handle.fallback_reason
+                # the degraded handle still ingests and serves in-process
+                import numpy as np
+                adm = handle.post("t0", np.ones((4,), np.float32),
+                                  np.zeros((4,), np.float32))
+                assert adm.admitted
+                assert handle.drain(10.0)
+                doc = handle.read("t0", max_staleness_steps=0)
+                assert doc["staleness_steps"] == 0
+            finally:
+                _iserver.shutdown()
+        assert _iserver.get_server() is None
+
+    def test_serve_singleton_is_idempotent(self):
+        first = _iserver.serve(_collection())
+        try:
+            assert _iserver.serve() is first  # no template needed on re-entry
+        finally:
+            _iserver.shutdown()
+
+    def test_serve_needs_a_template_on_first_call(self):
+        from metrics_tpu.utils.exceptions import MetricsUserError
+        with pytest.raises(MetricsUserError):
+            _iserver.serve()

@@ -1,0 +1,196 @@
+"""Deterministic chaos over the ingestion path: overload, slow consumer, and
+mid-request preemption replay bitwise under seeded FaultSpec schedules, and
+an admitted batch is NEVER silently dropped — every rejection is surfaced,
+every failure is dead-lettered and visible."""
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu import serve as msv
+from metrics_tpu.resilience.chaos import KNOWN_SITES, ChaosError, FaultSpec
+from metrics_tpu.resilience import chaos as _chaos
+
+pytestmark = pytest.mark.chaos
+
+
+def _factory():
+    return mt.MetricCollection(
+        {"acc": mt.Accuracy(num_classes=4), "mse": mt.MeanSquaredError()}
+    )
+
+
+def _run_chaosd_ingest(specs, seed, steps=12, tenants=4):
+    """One chaos'd HTTP ingest run; returns (admitted log, final values,
+    rejection statuses). The client is sequential, so the serve/ingest spec
+    stream ordering — and with it the admitted set — is seed-deterministic."""
+    server = msv.IngestServer(_factory(), queue_capacity=64).start()
+    try:
+        client = msv.IngestClient(server.url)
+        rng = np.random.default_rng(seed)
+        log, statuses = [], []
+        with _chaos.plan(specs, seed=seed):
+            for step in range(steps):
+                tid = f"t{step % tenants}"
+                preds = rng.integers(0, 4, (8,)).astype(np.int32)
+                target = rng.integers(0, 4, (8,)).astype(np.int32)
+                doc = client.post(tid, preds, target)
+                statuses.append((doc["status"], doc.get("reason", "")))
+                if doc["admitted"]:
+                    log.append((tid, (preds, target), {}))
+            assert server.drain(30.0)
+        values = {}
+        for tid in sorted({t for t, _, _ in log}):
+            doc = client.read(tid, max_staleness_steps=0, timeout_s=10)
+            assert doc["status"] == 200
+            values[tid] = {k: np.asarray(v) for k, v in doc["values"].items()}
+        stats = server.stats()
+        return log, values, statuses, stats
+    finally:
+        server.stop(drain=False)
+
+
+class TestSites:
+    def test_serve_sites_are_registered(self):
+        for site in ("serve/ingest", "serve/coalesce", "serve/dispatch", "serve/read"):
+            assert site in KNOWN_SITES
+
+    def test_unknown_site_still_rejected_by_spec(self):
+        with pytest.raises(ValueError):
+            FaultSpec("serve/ingest", kind="nope")
+
+
+class TestIngressFaults:
+    def test_ingress_fault_surfaces_as_503_and_state_matches_replay(self):
+        """Every 3rd post is killed at admission: the client sees 503
+        reason=fault, nothing enters the queue, and the final state is the
+        offline replay of exactly the admitted posts."""
+        specs = [FaultSpec("serve/ingest", kind="error", every=3, transient=False)]
+        log, values, statuses, stats = _run_chaosd_ingest(specs, seed=0)
+        faulted = [s for s in statuses if s == (503, "fault")]
+        assert len(faulted) == 4  # every 3rd of 12 sequential posts
+        assert len(log) == 8
+        assert stats["ledger"]["admitted"] == stats["ledger"]["applied"] == 8
+        expect = msv.offline_replay(_factory, log)
+        for tid, ref in expect.items():
+            for name, want in ref.items():
+                got = values[tid][name].astype(want.dtype)
+                assert np.array_equal(got, want), (tid, name)
+
+    def test_same_seed_replays_bitwise(self):
+        specs = [FaultSpec("serve/ingest", kind="error", probability=0.4)]
+        a = _run_chaosd_ingest(specs, seed=11)
+        b = _run_chaosd_ingest(specs, seed=11)
+        assert a[2] == b[2]  # identical rejection pattern
+        assert [t for t, _, _ in a[0]] == [t for t, _, _ in b[0]]  # same admitted set
+        assert sorted(a[1]) == sorted(b[1])
+        for tid in a[1]:
+            for name in a[1][tid]:
+                assert np.array_equal(a[1][tid][name], b[1][tid][name]), (tid, name)
+
+
+class TestDispatchFaults:
+    def test_transient_dispatch_faults_retry_without_state_loss(self):
+        """serve/dispatch fires BEFORE any state moves, so a transient fault
+        retried by the consumer is invisible in the final values."""
+        specs = [FaultSpec("serve/dispatch", kind="error", every=2, times=3,
+                           transient=True)]
+        log, values, statuses, stats = _run_chaosd_ingest(specs, seed=3)
+        assert all(s == (200, "") for s in statuses)  # ingress untouched
+        # how many of the (up to 3) faults fire depends on how arrivals
+        # coalesced — but at least one does, and none leaks into the state
+        assert 1 <= stats["dispatcher"]["retries"] <= 3
+        assert stats["dispatcher"]["dead_letters"] == 0
+        assert stats["ledger"]["admitted"] == stats["ledger"]["applied"] == len(log)
+        expect = msv.offline_replay(_factory, log)
+        for tid, ref in expect.items():
+            for name, want in ref.items():
+                assert np.array_equal(values[tid][name].astype(want.dtype), want)
+
+    def test_nontransient_dispatch_fault_dead_letters_loudly(self):
+        """A permanent apply failure parks the batch on the dead-letter list:
+        the ledger accounts for it, healthz degrades, and the tenant's read
+        reports the loss — never a silent drop."""
+        server = msv.IngestServer(_factory(), queue_capacity=64).start()
+        try:
+            client = msv.IngestClient(server.url)
+            x = np.zeros((8,), np.int32)
+            with _chaos.plan([FaultSpec("serve/dispatch", kind="error", nth=1,
+                                        transient=False)], seed=0):
+                assert client.post("t0", x, x)["admitted"]
+                assert server.pipeline.drain(10.0)  # accounted, not applied
+            stats = server.stats()
+            assert stats["dispatcher"]["dead_letters"] == 1
+            assert stats["ledger"]["dead_lettered"] == 1
+            assert stats["ledger"]["applied"] == 0
+            assert client.healthz()["status"] == "degraded"
+            doc = client.read("t0", max_staleness_steps=0, timeout_s=5)
+            assert doc["dead_lettered_steps"] == 1
+            assert doc["last_applied_step"] == 0
+            assert doc["staleness_steps"] == 0  # dead != pending
+        finally:
+            server.stop(drain=False)
+
+
+class TestReadFaults:
+    def test_read_fault_is_a_retryable_503(self):
+        server = msv.IngestServer(_factory()).start()
+        try:
+            client = msv.IngestClient(server.url)
+            x = np.zeros((8,), np.int32)
+            client.post("t0", x, x)
+            assert server.drain(10.0)
+            with _chaos.plan([FaultSpec("serve/read", kind="error", nth=1)], seed=0):
+                doc = client.read("t0")
+                assert doc["status"] == 503 and doc["reason"] == "fault"
+                doc = client.read("t0")  # next read succeeds
+                assert doc["status"] == 200
+        finally:
+            server.stop(drain=False)
+
+    def test_in_process_read_fault_raises(self):
+        pipeline = msv.IngestPipeline(_factory()).start()
+        try:
+            pipeline.post("t0", np.zeros((8,), np.int32), np.zeros((8,), np.int32))
+            assert pipeline.drain(10.0)
+            with _chaos.plan([FaultSpec("serve/read", kind="error", nth=1)], seed=0):
+                with pytest.raises(ChaosError):
+                    pipeline.read("t0")
+        finally:
+            pipeline.stop(drain=False)
+
+
+class TestSlowConsumerSweep:
+    def _sweep_once(self, seed):
+        """Slow-consumer chaos: latency at serve/coalesce varies the coalesce
+        widths run to run, but the final state depends only on the admitted
+        set — the serving stack's core determinism argument."""
+        specs = [
+            FaultSpec("serve/coalesce", kind="latency", latency_s=0.03,
+                      probability=0.5),
+            FaultSpec("serve/dispatch", kind="error", every=5, transient=True),
+        ]
+        return _run_chaosd_ingest(specs, seed=seed, steps=10)
+
+    def test_slow_consumer_quick(self):
+        log, values, statuses, stats = self._sweep_once(seed=0)
+        assert all(s == (200, "") for s in statuses)
+        assert stats["ledger"]["admitted"] == stats["ledger"]["applied"] == len(log)
+        expect = msv.offline_replay(_factory, log)
+        for tid, ref in expect.items():
+            for name, want in ref.items():
+                assert np.array_equal(values[tid][name].astype(want.dtype), want)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_slow_consumer_three_seed_sweep(self, seed):
+        """The 3-seed sweep: under seeded slow-consumer + transient-dispatch
+        chaos every admitted batch lands and the state is bitwise the
+        offline replay, independent of the timing-dependent coalescing."""
+        log, values, statuses, stats = self._sweep_once(seed=seed)
+        assert stats["ledger"]["admitted"] == stats["ledger"]["applied"] == len(log)
+        assert stats["dispatcher"]["dead_letters"] == 0
+        expect = msv.offline_replay(_factory, log)
+        for tid, ref in expect.items():
+            for name, want in ref.items():
+                assert np.array_equal(values[tid][name].astype(want.dtype), want), (
+                    seed, tid, name)

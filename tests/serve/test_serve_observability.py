@@ -1,0 +1,122 @@
+"""The serving stack observes itself: every catalogued serve/* event is
+emitted by a live scenario, and the metrics_tpu_ingest_* instrument series
+land in the registry (and therefore in the Prometheus exposition)."""
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu import observability as obs
+from metrics_tpu import serve as msv
+from metrics_tpu.observability.instruments import REGISTRY, InstrumentRegistry
+from metrics_tpu.observability.tracer import EVENT_CATALOG
+from metrics_tpu.resilience import chaos as _chaos
+from metrics_tpu.resilience.chaos import FaultSpec
+
+
+def _factory():
+    return mt.MetricCollection({"mse": mt.MeanSquaredError()})
+
+
+class TestEventCatalog:
+    def test_serve_category_is_catalogued(self):
+        assert set(EVENT_CATALOG["serve"]) == {
+            "serve/ingest", "serve/reject", "serve/coalesce", "serve/dispatch",
+            "serve/read", "serve/drain", "serve/dead_letter",
+        }
+
+    def test_every_catalogued_serve_event_is_emitted_live(self):
+        """One scenario per catalogue entry: admit, reject (full queue),
+        coalesce, dispatch, read, drain, dead-letter (injected apply fault)."""
+        x = np.ones((4,), np.float32)
+        with obs.trace() as tracer:
+            pipeline = msv.IngestPipeline(_factory(), queue_capacity=1,
+                                          name="obs-test")
+            assert pipeline.post("a", x, x).admitted      # serve/ingest
+            assert not pipeline.post("b", x, x).admitted  # serve/reject
+            pipeline.start()                              # coalesce+dispatch
+            assert pipeline.drain(10.0)                   # serve/drain
+            pipeline.read("a", max_staleness_steps=0)     # serve/read
+            with _chaos.plan([FaultSpec("serve/dispatch", kind="error",
+                                        transient=False)], seed=0):
+                assert pipeline.post("a", x, x).admitted
+                assert pipeline.drain(10.0)               # serve/dead_letter
+            pipeline.stop(drain=False)
+        counts = tracer.counts_by_name()
+        for name in EVENT_CATALOG["serve"]:
+            assert counts.get(name, 0) >= 1, name
+        # and nothing emitted off-catalogue
+        flat = {n for names in EVENT_CATALOG.values() for n in names}
+        served = [e for e in tracer.events() if e.cat == "serve"]
+        assert served and all(e.name in flat for e in served)
+
+    def test_event_payloads_carry_the_load_bearing_args(self):
+        x = np.ones((4,), np.float32)
+        with obs.trace() as tracer:
+            pipeline = msv.IngestPipeline(_factory()).start()
+            pipeline.post("a", x, x)
+            assert pipeline.drain(10.0)
+            pipeline.read("a", max_staleness_steps=0)
+            pipeline.stop(drain=False)
+        events = {e.name: e for e in tracer.events()}
+        assert events["serve/ingest"].args["seq"] == 1
+        assert events["serve/coalesce"].args["width"] == 1
+        assert events["serve/dispatch"].args["tenants"] == 1
+        assert events["serve/read"].args["staleness"] == 0
+
+
+class TestIngestInstruments:
+    def test_pipeline_gauges_and_counters_land_in_snapshots(self):
+        reg = InstrumentRegistry()
+        pipeline = msv.IngestPipeline(_factory(), queue_capacity=8,
+                                      name="snap-test")
+        reg.register_ingest_pipeline(pipeline)
+        x = np.ones((4,), np.float32)
+        pipeline.post("a", x, x)
+        by_name = {s.name: s for s in reg.samples()
+                   if s.labels.get("queue") == "snap-test"}
+        assert by_name["metrics_tpu_ingest_queue_depth"].value == 1.0
+        assert by_name["metrics_tpu_ingest_queue_capacity"].value == 8.0
+        assert by_name["metrics_tpu_ingest_draining"].value == 0.0
+        assert by_name["metrics_tpu_ingest_dispatch_observations_total"].value == 0.0
+        pipeline.start()
+        assert pipeline.drain(10.0)
+        pipeline.stop(drain=False)
+        by_name = {s.name: s for s in reg.samples()
+                   if s.labels.get("queue") == "snap-test"}
+        assert by_name["metrics_tpu_ingest_queue_depth"].value == 0.0
+        assert by_name["metrics_tpu_ingest_dispatch_observations_total"].value == 1.0
+        assert by_name["metrics_tpu_ingest_last_coalesce_width"].value == 1.0
+        assert by_name["metrics_tpu_ingest_draining"].value == 1.0
+
+    def test_admission_counters_tick_on_the_global_registry(self):
+        pipeline = msv.IngestPipeline(_factory(), queue_capacity=1,
+                                      per_tenant_cap=1, name="adm-test")
+        x = np.ones((4,), np.float32)
+        assert pipeline.post("a", x, x).admitted
+        assert not pipeline.post("b", x, x).admitted  # queue_full
+        samples = {(s.name, s.labels.get("reason", "")): s.value
+                   for s in REGISTRY.samples() if s.labels.get("queue") == "adm-test"}
+        assert samples[("metrics_tpu_ingest_admitted_total", "")] == 1.0
+        assert samples[("metrics_tpu_ingest_rejected_total", "queue_full")] == 1.0
+
+    def test_coalesce_width_histogram_observes_pow2_bins(self):
+        pipeline = msv.IngestPipeline(_factory(), name="hist-test")
+        x = np.ones((4,), np.float32)
+        for tid in ("a", "b", "c"):
+            pipeline.post(tid, x, x)
+        batch = pipeline.queue.pop_coalesced(max_width=8, timeout=0.5)
+        assert len(batch) == 3
+        hist = [s for s in REGISTRY.samples()
+                if s.name == "metrics_tpu_ingest_coalesce_width_bucket"
+                and s.labels.get("queue") == "hist-test"]
+        # cumulative: the width-3 observation lands in the le=4 pow2 bin
+        by_le = {s.labels["le"]: s.value for s in hist}
+        assert by_le["2.0"] == 0.0 and by_le["4.0"] == 1.0
+
+    def test_registry_clear_drops_pipeline_registrations(self):
+        reg = InstrumentRegistry()
+        pipeline = msv.IngestPipeline(_factory(), name="clear-test")
+        reg.register_ingest_pipeline(pipeline)
+        assert reg.live_ingest_pipelines() == [pipeline]
+        reg.clear()
+        assert reg.live_ingest_pipelines() == []
