@@ -1905,6 +1905,298 @@ def bench_sharded_compute() -> None:
         sys.exit(1)
 
 
+def _quantized_sync_child() -> None:
+    """``--child quantized_sync``: the transport codec layer on the 8-device
+    CPU mesh (device count forced by the parent's XLA_FLAGS).
+
+    Three configs: the merged config2 state (one int32-sum bucket — the fused
+    collection sync), a 4096-class ConfusionMatrix (trace-time wire accounting
+    only: 64 MiB logical), and a capacity-256 TenantSet stacked sync. For each
+    transport the child records wire-vs-logical bytes from the trace-time
+    box, and for config2 also the *measured* max relative error of a real
+    shard_map sync against the exact transport plus the jitted sync wall
+    time — the error must sit under the abstract E112 bound the analyzer
+    reports."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu import (
+        Accuracy, ConfusionMatrix, F1Score, MetricCollection, Precision, Recall,
+    )
+    from metrics_tpu.core.metric import Metric
+    from metrics_tpu.parallel.sync import (
+        count_collectives, sync_stacked_states, sync_state, transport_error_bound,
+    )
+    from metrics_tpu.tenancy import TenantSet
+
+    world = 8
+    devices = jax.devices()
+    if len(devices) < world:
+        raise RuntimeError(f"expected {world} forced host devices, got {len(devices)}")
+    mesh = Mesh(np.asarray(devices[:world]), ("data",))
+    rng = np.random.default_rng(0)
+
+    # ---- config2: merged member states, one flat dict (the fused sync) -----
+    coll = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES, average="micro"),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "precision": Precision(num_classes=NUM_CLASSES, average="macro"),
+            "recall": Recall(num_classes=NUM_CLASSES, average="macro"),
+        }
+    )
+    logits = jnp.asarray(rng.normal(size=(BATCH, NUM_CLASSES)), dtype=jnp.float32)
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=jnp.int32)
+    coll.update(logits, target)
+    flat_state, flat_reds = {}, {}
+    for mname, m in coll.items():
+        for sname, leaf in m.metric_state.items():
+            flat_state[f"{mname}.{sname}"] = jnp.asarray(leaf)
+            flat_reds[f"{mname}.{sname}"] = m._reductions[sname]
+
+    def trace_bytes(state, reds, transport, stacked=False, reductions=None):
+        transports = (
+            None if transport == "exact"
+            else {k: transport for k in state}
+        )
+        with count_collectives() as box:
+            if stacked:
+                tmap = (
+                    None if transport == "exact"
+                    else {l: {n: transport for n in st} for l, st in state.items()}
+                )
+                jax.make_jaxpr(
+                    lambda st: sync_stacked_states(st, reductions, "data", transports=tmap),
+                    axis_env=[("data", world)],
+                )(state)
+            else:
+                jax.make_jaxpr(
+                    lambda st: sync_state(st, reds, "data", transports=transports),
+                    axis_env=[("data", world)],
+                )(state)
+        wire = sum(v["wire"] for v in box["bytes_by_transport"].values())
+        logical = sum(v["logical"] for v in box["bytes_by_transport"].values())
+        return {
+            "wire_bytes": int(wire),
+            "logical_bytes": int(logical),
+            "by_transport": {k: dict(v) for k, v in box["bytes_by_transport"].items()},
+            "collectives": dict(box["by_kind"]),
+            "refusals": len(box["refusals"]),
+        }
+
+    def measured(transport):
+        transports = None if transport == "exact" else {k: transport for k in flat_state}
+
+        def body(s):
+            local = jax.tree_util.tree_map(lambda x: x[0], s)
+            out = sync_state(local, flat_reds, "data", transports=transports)
+            return jax.tree_util.tree_map(lambda x: jnp.expand_dims(x, 0), out)
+
+        f = jax.jit(shard_map(
+            body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_rep=False
+        ))
+        stacked = jax.tree_util.tree_map(
+            lambda a: jnp.stack([a * (i + 1) for i in range(world)]), flat_state
+        )
+        out = jax.block_until_ready(f(stacked))  # compile + first run
+        reps = [_timed(lambda: jax.block_until_ready(f(stacked))) for _ in range(5)]
+        return out, min(reps) * 1e3
+
+    exact_out, exact_ms = measured("exact")
+    config2 = {"transports": {}}
+    for t in ("exact", "bf16", "int8"):
+        rec = trace_bytes(flat_state, flat_reds, t)
+        out, sync_ms = measured(t)
+        # error in the bound's own frame: relative to the bucket's
+        # max-magnitude exact value (the whole flat concat is one bucket)
+        denom = max(
+            float(max(np.max(np.abs(np.asarray(v, np.float64))) for v in exact_out.values())),
+            1e-30,
+        )
+        err = max(
+            float(np.max(np.abs(np.asarray(out[k], np.float64) - np.asarray(exact_out[k], np.float64))))
+            for k in flat_state
+        ) / denom
+        bound = transport_error_bound(t, world)
+        rec.update(
+            sync_ms=round(sync_ms, 3),
+            max_rel_err=err,
+            error_bound=bound,
+            wire_reduction_x=round(rec["logical_bytes"] / max(1, rec["wire_bytes"]), 3),
+        )
+        config2["transports"][t] = rec
+
+    # ---- confmat-4096: trace-time wire accounting only (64 MiB logical) ----
+    cm = ConfusionMatrix(num_classes=4096)
+    cm.update(
+        jnp.asarray(rng.integers(0, 4096, size=(8192,)), dtype=jnp.int32),
+        jnp.asarray(rng.integers(0, 4096, size=(8192,)), dtype=jnp.int32),
+    )
+    cm_state = {k: jnp.asarray(v) for k, v in cm.metric_state.items()}
+    confmat = {
+        "transports": {
+            t: dict(
+                trace_bytes(cm_state, dict(cm._reductions), t),
+                error_bound=transport_error_bound(t, world),
+            )
+            for t in ("exact", "bf16", "int8", "sparse_count")
+        }
+    }
+
+    # ---- tenancy N=256: stacked sync, collective count independent of N ----
+    class TinySum(Metric):
+        full_state_update = False
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", default=jnp.zeros((16,), jnp.float32), dist_reduce_fx="sum")
+            self.add_state("count", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+        def update(self, values):
+            self.total = self.total + values[:16]
+            self.count = self.count + 1.0
+
+        def compute(self):
+            return self.total / jnp.maximum(self.count, 1.0)
+
+    def stacked_at(capacity, n_admit):
+        ts = TenantSet(MetricCollection({"mean": TinySum()}), capacity=capacity)
+        ids = [f"t{i}" for i in range(n_admit)]
+        for tid in ids:
+            ts.admit(tid)
+        ts.update(ids, jnp.ones((n_admit, 16), jnp.float32))
+        reds = {
+            lname: {n: ts.template._metrics[lname]._reductions[n] for n in st}
+            for lname, st in ts.stacked_states.items()
+        }
+        return ts.stacked_states, reds
+
+    st256, reds256 = stacked_at(256, 37)
+    st16, reds16 = stacked_at(16, 3)
+    tenancy = {"capacity": 256, "transports": {}}
+    for t in ("exact", "bf16", "int8"):
+        big = trace_bytes(st256, None, t, stacked=True, reductions=reds256)
+        small = trace_bytes(st16, None, t, stacked=True, reductions=reds16)
+        big["count_independent_of_n"] = big["collectives"] == small["collectives"]
+        tenancy["transports"][t] = big
+
+    print(
+        json.dumps({
+            "world": world,
+            "config2": config2,
+            "confmat_4096": confmat,
+            "tenancy": tenancy,
+        }),
+        flush=True,
+    )
+
+
+def bench_quantized_sync() -> None:
+    """``--quantized-sync``: wire-byte reduction and measured quantization
+    error of the bf16/int8 (and sparse_count) sync transports on the 8-device
+    mesh — config2's merged bucketed sync, a 4096-class confusion matrix, and
+    a capacity-256 tenancy stacked sync; recorded into ``BENCH_r19.json`` and
+    judged by the regression watchdog. Host-side CPU bench (forced device
+    count in a child process).
+
+    Hard gates: exact stays bitwise (zero measured error); bf16 cuts config2
+    wire bytes >= 1.9x and int8 >= 3.5x; every measured error sits under the
+    abstract E112 bound the analyzer reports for the same bucket."""
+    import glob as _glob
+
+    from metrics_tpu.observability import regress as _regress
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    child = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", "quantized_sync"],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=1500.0,
+        cwd=REPO,
+    )
+    if child.returncode != 0:
+        raise RuntimeError(f"quantized-sync child failed:\n{child.stderr[-2000:]}")
+    mesh8 = json.loads(child.stdout.strip().splitlines()[-1])
+
+    c2 = mesh8["config2"]["transports"]
+    record = {
+        # headline: config2's int8 wire bytes per sync — lower is better,
+        # the exact baseline rides in extra
+        "metric": "quantized_sync_config2_int8_wire_bytes",
+        "value": c2["int8"]["wire_bytes"],
+        "unit": "bytes",
+        "extra": {
+            "world": mesh8["world"],
+            "config2_exact_wire_bytes": c2["exact"]["wire_bytes"],
+            "config2_bf16_wire_reduction_x": c2["bf16"]["wire_reduction_x"],
+            "config2_int8_wire_reduction_x": c2["int8"]["wire_reduction_x"],
+            "config2_bf16_max_rel_err": c2["bf16"]["max_rel_err"],
+            "config2_int8_max_rel_err": c2["int8"]["max_rel_err"],
+            "config2": mesh8["config2"],
+            "confmat_4096": mesh8["confmat_4096"],
+            "tenancy": mesh8["tenancy"],
+        },
+    }
+
+    # watchdog self-check: judge this round against the checked-in trajectory
+    rounds = [
+        r
+        for r in _regress.load_rounds(sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json"))))
+        if r.name != "r19"
+    ]
+    rounds.append(_regress.Round("r19", "<this-run>", record))
+    report = _regress.check_trajectory(rounds)
+    record["extra"]["regress"] = {
+        "ok": report.ok,
+        "regression_count": len(report.regressions),
+        "keys_checked": report.keys_checked,
+        "regressions": [r.describe() for r in report.regressions],
+    }
+
+    with open(os.path.join(REPO, "BENCH_r19.json"), "w") as fh:
+        json.dump(record, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(record), flush=True)
+
+    problems = []
+    if c2["exact"]["max_rel_err"] != 0.0:
+        problems.append(
+            f"exact transport measured error {c2['exact']['max_rel_err']} (want bitwise 0)"
+        )
+    if c2["bf16"]["wire_reduction_x"] < 1.9:
+        problems.append(
+            f"config2 bf16 wire reduction {c2['bf16']['wire_reduction_x']}x < 1.9x"
+        )
+    if c2["int8"]["wire_reduction_x"] < 3.5:
+        problems.append(
+            f"config2 int8 wire reduction {c2['int8']['wire_reduction_x']}x < 3.5x"
+        )
+    for t in ("bf16", "int8"):
+        if c2[t]["max_rel_err"] > c2[t]["error_bound"]:
+            problems.append(
+                f"config2 {t} measured error {c2[t]['max_rel_err']} exceeds the "
+                f"E112 bound {c2[t]['error_bound']}"
+            )
+        if c2[t]["refusals"]:
+            problems.append(f"config2 {t} bucket was refused — nothing was measured")
+        if not mesh8["tenancy"]["transports"][t]["count_independent_of_n"]:
+            problems.append(f"tenancy {t}: collective count depends on capacity N")
+    if not report.ok:
+        problems.extend(r.describe() for r in report.regressions)
+    if problems:
+        print("[bench] quantized-sync round FAILED its gates:", file=sys.stderr)
+        for p in problems:
+            print(f"[bench]   {p}", file=sys.stderr)
+        sys.exit(1)
+
+
 def bench_observability() -> None:
     """``--observability``: tracer on/off overhead on the config2 fused
     update (the ISSUE-7 hard rule: tracer *off* must not move the 4x fused
@@ -3043,7 +3335,17 @@ def main() -> None:
         "BENCH_r17.json and judge with the regression watchdog",
     )
     parser.add_argument(
-        "--child", choices=["sync_overhead", "sharded_state", "sharded_compute", *_CHILD_BENCHES]
+        "--quantized-sync",
+        action="store_true",
+        help="measure wire-byte reduction and quantization error of the "
+        "bf16/int8/sparse_count sync transports (config2 merged sync, "
+        "confmat-4096, capacity-256 tenancy) on the 8-device mesh and record "
+        "into BENCH_r19.json; gates: bf16 >= 1.9x, int8 >= 3.5x, error <= "
+        "the E112 bound",
+    )
+    parser.add_argument(
+        "--child",
+        choices=["sync_overhead", "sharded_state", "sharded_compute", "quantized_sync", *_CHILD_BENCHES],
     )
     parser.add_argument(
         "--sync-scaling",
@@ -3090,6 +3392,9 @@ def main() -> None:
     if args.sharded_compute:
         bench_sharded_compute()
         return
+    if args.quantized_sync:
+        bench_quantized_sync()
+        return
     if args.sync_scaling:
         out = {}
         for w in (2, 4, 8, 16):
@@ -3109,6 +3414,9 @@ def main() -> None:
         return
     if args.child == "sharded_compute":
         _sharded_compute_child()
+        return
+    if args.child == "quantized_sync":
+        _quantized_sync_child()
         return
     if args.child in _CHILD_BENCHES:
         import jax

@@ -86,7 +86,13 @@ from metrics_tpu.image import (  # noqa: F401
     StructuralSimilarityIndexMeasure,
     UniversalImageQualityIndex,
 )
-from metrics_tpu.parallel import bucketed_sync_enabled, set_bucketed_sync  # noqa: F401
+from metrics_tpu.parallel import (  # noqa: F401
+    bucketed_sync_enabled,
+    set_bucketed_sync,
+    set_sync_transport,
+    sync_transport_default,
+    transport_error_bound,
+)
 from metrics_tpu.retrieval import (  # noqa: F401
     RetrievalFallOut,
     RetrievalHitRate,
@@ -146,6 +152,7 @@ __all__ = [
     "set_fused_update", "fused_update_enabled",
     "set_probation", "probation_cooldown",
     "set_bucketed_sync", "bucketed_sync_enabled",
+    "set_sync_transport", "sync_transport_default", "transport_error_bound",
     # checkpoint
     "checkpoint", "save_checkpoint", "restore_checkpoint", "verify_checkpoint",
     # observability (event tracer, instrument registry, exporters)

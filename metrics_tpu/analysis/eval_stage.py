@@ -131,7 +131,11 @@ def _evaluate_sharded(entry: Entry, inst: Any, state: Any) -> List[Finding]:
     with _sync.count_collectives() as canon:
         try:
             jax.make_jaxpr(
-                lambda s: _sync.sync_state(s, dict(inst._reductions), AXIS, shard_axes=live),
+                lambda s: _sync.sync_state(
+                    s, dict(inst._reductions), AXIS, shard_axes=live,
+                    transports=dict(getattr(inst, "_sync_transports", {}) or {}),
+                    tolerances=dict(getattr(inst, "_sync_tolerances", {}) or {}),
+                ),
                 axis_env=[(AXIS, WORLD)],
             )(dict(state) if isinstance(state, dict) else state)
         except Exception as e:  # noqa: BLE001
@@ -404,8 +408,15 @@ def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Findi
 
     with _sync.count_collectives() as budget_box:
         try:
+            # the canonical budget trace carries the instance's transport
+            # declarations: a declared int8 bucket legitimately adds its scale
+            # exchange, and the budget must grow with it, not flag it
             jax.make_jaxpr(
-                lambda s: _sync.sync_state(s, dict(inst._reductions), AXIS),
+                lambda s: _sync.sync_state(
+                    s, dict(inst._reductions), AXIS,
+                    transports=dict(getattr(inst, "_sync_transports", {}) or {}),
+                    tolerances=dict(getattr(inst, "_sync_tolerances", {}) or {}),
+                ),
                 axis_env=[(AXIS, WORLD)],
             )(dict(state) if isinstance(state, dict) else state)
         except Exception as e:  # noqa: BLE001 — canonical sync must trace; treat as untraceable
@@ -472,6 +483,57 @@ def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Findi
                         "budget": allowed,
                         "by_kind": dict(box["by_kind"]),
                         "bytes_by_kind": dict(box["bytes_by_kind"]),
+                    },
+                )
+            )
+
+    # -------------------------------------------------------- transport leg --
+    # E112: generalize the E106 budget sweep to quantization error. The plan
+    # is pure (abstract shapes + mesh width, nothing traced) and shares the
+    # exact gate the runtime uses, so a refusal reported here IS the runtime
+    # fallback — the declared transport never engages on this mesh.
+    transports = dict(getattr(inst, "_sync_transports", {}) or {})
+    if isinstance(state, dict) and (
+        transports or _sync.sync_transport_default() != "exact"
+    ):
+        plan = _sync.transport_plan(
+            state,
+            dict(inst._reductions),
+            WORLD,
+            transports=transports,
+            tolerances=dict(getattr(inst, "_sync_tolerances", {}) or {}),
+            shard_axes=inst.active_shard_axes,
+        )
+        for bucket in plan:
+            refusal = bucket.get("refusal")
+            if refusal is None:
+                continue
+            bound = refusal.get("bound")
+            detail = (
+                f"predicted worst-case error {bound:.4g} > tolerance "
+                f"{refusal['tolerance']:.4g} on the {WORLD}-device canonical mesh"
+                if refusal.get("reason") == "error_budget" and bound is not None
+                else f"reason: {refusal.get('reason')}"
+            )
+            findings.append(
+                Finding(
+                    rule="E112",
+                    obj=entry.name,
+                    message=(
+                        f"sync transport {bucket['requested']!r} is refused for the "
+                        f"(reduction={bucket['reduction']!r}, dtype={bucket['dtype']}) "
+                        f"bucket of {bucket['elements']} element(s) "
+                        f"(states {', '.join(bucket['names'])}): {detail} — the bucket "
+                        "falls back to the exact transport at runtime"
+                    ),
+                    extra={
+                        "requested": bucket["requested"],
+                        "reduction": str(bucket["reduction"]),
+                        "dtype": bucket["dtype"],
+                        "kind": bucket["kind"],
+                        "elements": bucket["elements"],
+                        "states": list(bucket["names"]),
+                        "refusal": dict(refusal),
                     },
                 )
             )

@@ -200,6 +200,17 @@ _EVAL_RULES = (
         "local block, combine only the result via psum_result/gather_result) "
         "to make compute gather-free.",
     ),
+    Rule(
+        "E112", "sync-transport-budget", WARNING,
+        "a declared (or globally defaulted) quantized sync transport fails "
+        "its error-budget gate on the canonical mesh: the worst-case "
+        "quantization error bound computed from abstract shapes and the mesh "
+        "width exceeds the bucket's declared (or defaulted) tolerance, so at "
+        "runtime the bucket silently falls back to the exact transport and "
+        "the expected wire-byte saving never materializes — widen the "
+        "tolerance (add_state(..., sync_tolerance=)), pick a cheaper-error "
+        "transport, or drop the declaration.",
+    ),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in (*_AST_RULES, *_EVAL_RULES)}

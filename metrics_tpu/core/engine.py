@@ -209,6 +209,14 @@ class EngineStats:
     # runs under a collective context, e.g. inside shard_map.
     collective_counts: Dict[str, int] = field(default_factory=dict)
     collective_bytes: Dict[str, int] = field(default_factory=dict)
+    # wire-vs-logical byte split per sync transport (ISSUE-14):
+    # {transport: {"wire": bytes actually crossing the link, "logical": bytes
+    # the exact path would have moved}} — collective_bytes above counts wire
+    # bytes, so a quantized program shows the saving here, not a discrepancy
+    collective_bytes_by_transport: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    # buckets whose requested quantized transport the error-budget gate
+    # refused back to exact while tracing compiled calls
+    transport_refusals: int = 0
     # metric/collection class name -> why the engine permanently reverted it to
     # the eager path; feeds ``engine_stats()`` so runtime fallbacks can be
     # diffed against the static analyzer's findings (metrics_tpu.analysis)
@@ -496,6 +504,13 @@ class _EngineBase:
                     self.stats.collective_counts[kind] = self.stats.collective_counts.get(kind, 0) + n
                 for kind, n in box["bytes_by_kind"].items():
                     self.stats.collective_bytes[kind] = self.stats.collective_bytes.get(kind, 0) + n
+                for transport, split in box["bytes_by_transport"].items():
+                    per = self.stats.collective_bytes_by_transport.setdefault(
+                        transport, {"wire": 0, "logical": 0}
+                    )
+                    per["wire"] += split["wire"]
+                    per["logical"] += split["logical"]
+                self.stats.transport_refusals += len(box["refusals"])
                 if _otrace.active:
                     now_us = _otrace._now_us()
                     _otrace.emit_complete(
@@ -505,6 +520,8 @@ class _EngineBase:
                         compile_s=compile_s,
                         collectives=dict(box["by_kind"]),
                         collective_bytes=dict(box["bytes_by_kind"]),
+                        bytes_by_transport={k: dict(v) for k, v in box["bytes_by_transport"].items()},
+                        transport_refusals=len(box["refusals"]),
                     )
             elif _otrace.active:
                 t0_us = _otrace._now_us()

@@ -188,6 +188,11 @@ class Metric:
         self._defaults: Dict[str, StateValue] = {}
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, Optional[Union[str, Callable]]] = {}
+        # declared per-state sync transports / error tolerances (ISSUE-14);
+        # config only — they select how sync bytes cross the wire, never what
+        # the state means, so they stay out of checkpoint fingerprints
+        self._sync_transports: Dict[str, str] = {}
+        self._sync_tolerances: Dict[str, float] = {}
         # declared shardable state axes: name -> int or tuple of ints (grid)
         self._shard_axes: Dict[str, Union[int, Tuple[int, ...]]] = {}
         # (mesh, axis_name-or-names) once shard_state() ran
@@ -217,6 +222,8 @@ class Metric:
         persistent: bool = False,
         bufferable: Optional[bool] = None,
         shard_axis: Optional[Union[int, Tuple[int, ...]]] = None,
+        sync_transport: Optional[str] = None,
+        sync_tolerance: Optional[float] = None,
     ) -> None:
         """Register a state variable (reference: metric.py:149-217).
 
@@ -249,6 +256,19 @@ class Metric:
         array axis positionally with a mesh axis name, splitting the leaf over
         a multi-dimensional mesh — each device holds a tile instead of a
         stripe.
+
+        ``sync_transport`` declares how this state's sync bucket crosses the
+        wire: one of ``"exact"`` (the default and the bitwise escape hatch),
+        ``"bf16"``, ``"int8"``, or ``"sparse_count"`` — see
+        ``docs/quantized_sync.md``. The declaration wins over the global
+        :func:`metrics_tpu.set_sync_transport` switch but never over the
+        error-budget gate: a bucket whose predicted worst-case quantization
+        error exceeds its tolerance always falls back to exact (analyzer rule
+        E112 reports this statically). ``sync_tolerance`` is that per-state
+        relative error budget; unset states use the transport's default
+        (``parallel.sync.DEFAULT_TOLERANCES``), and the tightest declared
+        tolerance in a bucket wins. Both are *configuration*, not state —
+        checkpoints written with and without them interchange freely.
         """
         if (
             not isinstance(default, (jnp.ndarray, np.ndarray, CatBuffer))
@@ -307,6 +327,21 @@ class Metric:
                         )
             self._shard_axes[name] = shard_axis
 
+        if sync_transport is not None:
+            if sync_transport not in _sync.TRANSPORTS:
+                raise ValueError(
+                    f"state {name!r}: unknown sync_transport {sync_transport!r}; "
+                    f"expected one of {_sync.TRANSPORTS}"
+                )
+            self._sync_transports[name] = sync_transport
+        if sync_tolerance is not None:
+            sync_tolerance = float(sync_tolerance)
+            if sync_tolerance < 0.0:
+                raise ValueError(
+                    f"state {name!r}: sync_tolerance must be >= 0, got {sync_tolerance}"
+                )
+            self._sync_tolerances[name] = sync_tolerance
+
         self._defaults[name] = _copy_state_value(default)
         self._persistent[name] = persistent
         self._reductions[name] = dist_reduce_fx
@@ -320,6 +355,16 @@ class Metric:
     # ------------------------------------------------------------------ #
     # sharded state placement (SPMD scale-out; ROADMAP "shard metric state")
     # ------------------------------------------------------------------ #
+    @property
+    def sync_transports(self) -> Dict[str, str]:
+        """Declared per-state sync transports (name → transport)."""
+        return dict(self._sync_transports)
+
+    @property
+    def sync_tolerances(self) -> Dict[str, float]:
+        """Declared per-state sync error tolerances (name → relative budget)."""
+        return dict(self._sync_tolerances)
+
     @property
     def shard_axes(self) -> Dict[str, Union[int, Tuple[int, ...]]]:
         """Declared shardable state axes (name → axis or axes), active or not."""
@@ -755,13 +800,20 @@ class Metric:
         ``keep_sharded=True`` (the sharded-compute protocol) leaves the
         sharded leaves as per-device disjoint blocks — no reshard at all —
         while replicated leaves still sync; :meth:`compute_sharded_state`
-        then finishes the reduction locally."""
+        then finishes the reduction locally.
+
+        States declared with ``add_state(..., sync_transport=)`` (or the
+        global :func:`metrics_tpu.set_sync_transport` default) cross the wire
+        through their transport codec, gated by the error budget — see
+        ``docs/quantized_sync.md``."""
         return _sync.sync_state(
             state,
             self._reductions,
             axis_name,
             shard_axes=self.active_shard_axes,
             keep_sharded=keep_sharded,
+            transports=self._sync_transports,
+            tolerances=self._sync_tolerances,
         )
 
     def sync_compute_state(self, state: StateDict, axis_name: Optional[Union[str, Tuple[str, ...]]] = None) -> Any:
@@ -975,7 +1027,12 @@ class Metric:
         if dist_sync_fn is not None:
             synced = dist_sync_fn(state, self._reductions, axes)
         elif axes is not None:
-            synced = _sync.sync_state(state, self._reductions, axes, shard_axes=self.active_shard_axes)
+            synced = _sync.sync_state(
+                state, self._reductions, axes,
+                shard_axes=self.active_shard_axes,
+                transports=self._sync_transports,
+                tolerances=self._sync_tolerances,
+            )
         else:
             # eager multi-host path: gather + host-side reduce per tag
             synced = {}

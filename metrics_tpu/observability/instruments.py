@@ -51,6 +51,8 @@ _ENGINE_HELP = {
     "compile_seconds": "Cumulative wall time spent tracing and compiling.",
     "collective_ops": "Trace-time collective op count, by kind.",
     "collective_bytes": "Trace-time collective payload bytes, by kind.",
+    "transport_bytes": "Trace-time sync payload bytes, by transport and wire/logical side.",
+    "transport_refusals": "Buckets whose quantized transport the error-budget gate refused.",
     "fallback_active": "1 while the engine is permanently reverted to eager.",
     "last_fallback_step": "Dispatch index of the engine's permanent fallback.",
 }
@@ -398,6 +400,17 @@ class InstrumentRegistry:
             for op, n in stats.collective_bytes.items():
                 yield Sample(f"{PREFIX}engine_collective_bytes", {**labels, "op": op},
                              float(n), "counter", _ENGINE_HELP["collective_bytes"])
+            for transport, split in getattr(stats, "collective_bytes_by_transport", {}).items():
+                for side, n in split.items():
+                    yield Sample(
+                        f"{PREFIX}engine_transport_bytes",
+                        {**labels, "transport": transport, "side": side},
+                        float(n), "counter", _ENGINE_HELP["transport_bytes"],
+                    )
+            refused = getattr(stats, "transport_refusals", 0)
+            if refused:
+                yield Sample(f"{PREFIX}engine_transport_refusals", dict(labels),
+                             float(refused), "counter", _ENGINE_HELP["transport_refusals"])
             broken = 1.0 if getattr(engine, "broken", None) else 0.0
             yield Sample(f"{PREFIX}engine_fallback_active", dict(labels), broken,
                          "gauge", _ENGINE_HELP["fallback_active"])

@@ -84,6 +84,7 @@ EVENT_CATALOG: Dict[str, Tuple[str, ...]] = {
     ),
     "sync": (
         "sync/bucket_build",  # one bucketed sync build (args: collective tallies)
+        "sync/transport_refused",  # error-budget gate fell a bucket back to exact (args: reason)
     ),
     "shard": (
         "shard/place",  # Metric.shard_state placement

@@ -67,6 +67,283 @@ def set_bucketed_sync(enabled: Optional[bool]) -> None:
 
 
 # --------------------------------------------------------------------------- #
+# transport codecs: opt-in low-precision / compressed bucket sync (ISSUE-14)
+# --------------------------------------------------------------------------- #
+# Every (reduction, dtype) bucket syncs through a declared *transport*:
+#
+#   exact        today's path — the default and the bitwise escape hatch
+#   bf16         cast-psum-upcast for sum buckets (f32/f64 and integer counts)
+#   int8         per-block max-abs scales: one small pmax scale exchange, then
+#                a psum whose wire payload is int8 (the XLA emulation
+#                accumulates the quantized values in int32 — a production ring
+#                implementation requantizes per hop, EQuARX-style)
+#   sparse_count index+value encoding for count-like integer sum buckets whose
+#                density stays below SPARSE_COUNT_DENSITY; gathered instead of
+#                dense-psummed, with an in-trace dense fallback branch when any
+#                device overflows its slot capacity — lossless by construction
+#
+# Transports are *config*, never state: they change how bytes cross the wire,
+# not what the state means, so they never enter checkpoint fingerprints.
+TRANSPORTS = ("exact", "bf16", "int8", "sparse_count")
+
+_ENV_TRANSPORT = "METRICS_TPU_SYNC_TRANSPORT"
+_transport_default: Optional[str] = None  # None = follow the environment
+
+# int8 quantization granularity: one max-abs scale per this many elements
+INT8_BLOCK = 256
+# sparse_count per-device slot capacity as a fraction of the bucket size
+SPARSE_COUNT_DENSITY = 0.25
+
+# bf16 round-to-nearest relative error (8-bit significand incl. hidden bit)
+_EPS_BF16 = 2.0 ** -9
+# int8 symmetric quantization levels across [-max_abs, +max_abs]
+_INT8_LEVELS = 254.0
+
+# Per-transport default *relative* error tolerances (vs the bucket's
+# max-magnitude exact value) — the gate refuses any quantized bucket whose
+# predicted worst-case bound exceeds its tolerance, falling back to exact.
+# Lossless transports tolerate exactly nothing and bound exactly nothing.
+DEFAULT_TOLERANCES = {"exact": 0.0, "sparse_count": 0.0, "bf16": 0.05, "int8": 0.05}
+
+# dtypes a quantized transport may carry (sum reductions only)
+_QUANTIZABLE_DTYPES = frozenset(
+    np.dtype(d) for d in ("float32", "float64", "int32", "int64")
+)
+_SPARSE_DTYPES = frozenset(np.dtype(d) for d in ("int32", "int64"))
+
+
+def sync_transport_default() -> str:
+    """The process-wide default transport for buckets with no per-state
+    declaration (``set_sync_transport`` / ``METRICS_TPU_SYNC_TRANSPORT``,
+    ``"exact"`` unless overridden)."""
+    if _transport_default is not None:
+        return _transport_default
+    env = os.environ.get(_ENV_TRANSPORT, "exact").strip().lower()
+    return env if env in TRANSPORTS else "exact"
+
+
+def set_sync_transport(transport: Optional[str]) -> None:
+    """Set the process-wide default sync transport.
+
+    ``None`` restores the environment default (``METRICS_TPU_SYNC_TRANSPORT``,
+    ``"exact"``). Per-state ``add_state(..., sync_transport=...)`` declarations
+    take precedence over this switch; the error-budget gate takes precedence
+    over both — a bucket whose predicted quantization bound exceeds its
+    tolerance always falls back to ``exact``.
+    """
+    global _transport_default
+    if transport is not None and transport not in TRANSPORTS:
+        raise ValueError(
+            f"unknown sync transport {transport!r}; expected one of {TRANSPORTS}"
+        )
+    _transport_default = transport
+
+
+def transport_error_bound(
+    transport: str, world: int, kind: str = "psum"
+) -> float:
+    """Worst-case relative quantization error of one synced bucket.
+
+    Computed from abstract counts only (mesh width, never values), so the
+    analyzer's E112 sweep and the trace-time gate share one model. The bound
+    is relative to the bucket's max-magnitude exact value (per int8 scale
+    block for ``int8``); it is tight for cancellation-free states — the
+    nonnegative counts that dominate metric state — and documented as such
+    (docs/quantized_sync.md).
+
+    ``kind="psum"`` models cast/quantize error accumulating across ``world``
+    reduced terms; ``kind="reshard"`` models pure data movement of disjoint
+    blocks (one cast/quantize, no accumulation — mesh-width independent).
+    """
+    if transport in ("exact", "sparse_count"):
+        return 0.0
+    if transport == "bf16":
+        # psum: one cast per contributing term plus per-add rounding; reshard:
+        # a single cast. The +2 absorbs the upcast/dequant slop.
+        return (2.0 * _EPS_BF16) if kind == "reshard" else (world + 2) * 2.0 * _EPS_BF16
+    if transport == "int8":
+        # each device rounds to its scale grid: error <= scale/2 = max/254
+        return (2.0 / _INT8_LEVELS) if kind == "reshard" else (world + 2) / _INT8_LEVELS
+    raise ValueError(f"unknown sync transport {transport!r}")
+
+
+def default_tolerance(transport: str) -> float:
+    """The defaulted per-bucket tolerance for a transport (see
+    :data:`DEFAULT_TOLERANCES`); per-state ``add_state(..., sync_tolerance=)``
+    declarations override it (the tightest declared tolerance in a bucket
+    wins)."""
+    return DEFAULT_TOLERANCES[transport]
+
+
+def _transport_applicable(transport: str, red: Any, dtype: Any, kind: str = "psum") -> bool:
+    """Whether a transport can carry a (reduction, dtype) bucket at all.
+
+    Inapplicable combinations route through ``exact`` silently (this is
+    routing, not a refusal): a global ``bf16`` switch must not spam refusal
+    events for every cat/gather bucket in the program.
+    """
+    if transport == "exact":
+        return True
+    if kind == "reshard":
+        # resharded leaves are disjoint blocks — pure data movement, any
+        # "reduction" tag; sparse encoding of dense blocks is out of scope
+        return transport in ("bf16", "int8") and np.dtype(dtype) in _QUANTIZABLE_DTYPES
+    if red != "sum":
+        return False
+    if transport == "sparse_count":
+        return np.dtype(dtype) in _SPARSE_DTYPES
+    return np.dtype(dtype) in _QUANTIZABLE_DTYPES
+
+
+def _sparse_slots(nelems: int) -> int:
+    """Per-device (index, value) slot capacity for a sparse_count bucket."""
+    return max(1, min(nelems, int(np.ceil(SPARSE_COUNT_DENSITY * nelems))))
+
+
+def _gate_transport(
+    transport: str,
+    red: Any,
+    dtype: Any,
+    nelems: int,
+    world: Optional[int],
+    tolerance: Optional[float],
+    kind: str = "psum",
+) -> Tuple[str, Optional[Dict[str, Any]]]:
+    """The error-budget gate: ``(final_transport, refusal | None)``.
+
+    A requested quantized transport is *refused* (falls back to exact, with a
+    reason-carrying record) when its predicted worst-case error exceeds the
+    bucket's tolerance, when the mesh width cannot be determined, or — for
+    sparse_count — when the encoding cannot beat the dense wire bytes. A
+    transport that simply does not apply to the bucket's (reduction, dtype)
+    routes to exact with no refusal.
+    """
+    if transport == "exact":
+        return "exact", None
+    if not _transport_applicable(transport, red, dtype, kind):
+        return "exact", None
+    tol = default_tolerance(transport) if tolerance is None else float(tolerance)
+    if world is None:
+        return "exact", {
+            "transport": transport, "reason": "unknown_world",
+            "bound": None, "tolerance": tol, "elements": int(nelems),
+        }
+    bound = transport_error_bound(transport, world, kind)
+    if bound > tol:
+        return "exact", {
+            "transport": transport, "reason": "error_budget",
+            "bound": float(bound), "tolerance": tol,
+            "world": int(world), "elements": int(nelems),
+        }
+    if transport == "sparse_count":
+        itemsize = int(np.dtype(dtype).itemsize)
+        k = _sparse_slots(nelems)
+        # worst admitted wire: nnz pmax (4B) + (values ++ indices) gather
+        if 2 * k * itemsize + 4 >= nelems * itemsize:
+            return "exact", {
+                "transport": transport, "reason": "no_byte_win",
+                "bound": 0.0, "tolerance": tol,
+                "world": int(world), "elements": int(nelems),
+                "slots": int(k),
+            }
+    return transport, None
+
+
+def _axis_world(axis_name: AxisNames) -> Optional[int]:
+    """Static mesh width over ``axis_name`` at trace time (product over tuple
+    axes), or None when no axis context is bound."""
+    try:
+        names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+        world = 1
+        for name in names:
+            size = lax.psum(1, name)
+            if not isinstance(size, int):
+                return None
+            world *= size
+        return world
+    except Exception:
+        return None
+
+
+def _resolve_transport(name: str, transports: Optional[Dict[str, str]]) -> str:
+    t = (transports or {}).get(name)
+    if t is not None and t not in TRANSPORTS:
+        raise ValueError(
+            f"unknown sync transport {t!r} for state {name!r}; "
+            f"expected one of {TRANSPORTS}"
+        )
+    return t if t is not None else sync_transport_default()
+
+
+def _bucket_tolerance(
+    names: Sequence[str], tolerances: Optional[Dict[str, float]]
+) -> Optional[float]:
+    """Tightest per-state declared tolerance in a bucket, or None (use the
+    transport default)."""
+    declared = [
+        float(tolerances[n]) for n in names if tolerances and n in tolerances
+    ]
+    return min(declared) if declared else None
+
+
+def transport_plan(
+    state: Dict[str, Any],
+    reductions: Dict[str, Optional[Union[str, Callable]]],
+    world: int,
+    transports: Optional[Dict[str, str]] = None,
+    tolerances: Optional[Dict[str, float]] = None,
+    shard_axes: Optional[Dict[str, Any]] = None,
+) -> List[Dict[str, Any]]:
+    """Pure planning view of the per-bucket transport decisions ``sync_state``
+    would make on a ``world``-wide mesh — the analyzer's E112 sweep runs this
+    over abstract (``jax.ShapeDtypeStruct``-like) states; nothing is traced.
+
+    Each entry: ``{"names", "reduction", "dtype", "kind", "elements",
+    "requested", "transport", "bound", "tolerance", "refusal"}`` where
+    ``transport`` is the post-gate decision and ``refusal`` carries the gate's
+    reason when the requested transport was refused. Leaves named in
+    ``shard_axes`` plan against the mesh-width-independent ``kind="reshard"``
+    bounds, mirroring the runtime routing.
+    """
+    shard_axes = shard_axes or {}
+    groups: Dict[Tuple[Any, Any, str, str], List[Tuple[str, Any]]] = {}
+    for name, val in state.items():
+        red = reductions.get(name)
+        dtype = getattr(val, "dtype", None)
+        shape = getattr(val, "shape", None)
+        if dtype is None or shape is None or callable(red):
+            continue
+        kind = "reshard" if name in shard_axes else "psum"
+        t = _resolve_transport(name, transports)
+        groups.setdefault((red, np.dtype(dtype), t, kind), []).append((name, val))
+    plan: List[Dict[str, Any]] = []
+    for (red, dtype, requested, kind), items in groups.items():
+        names = [n for n, _ in items]
+        nelems = int(sum(int(np.prod(v.shape)) if v.shape else 1 for _, v in items))
+        tol = _bucket_tolerance(names, tolerances)
+        final, refusal = _gate_transport(
+            requested, None if kind == "reshard" else red, dtype, nelems, world,
+            tol, kind=kind,
+        )
+        eff_tol = (
+            default_tolerance(requested) if tol is None else float(tol)
+        ) if requested != "exact" else 0.0
+        plan.append({
+            "names": names,
+            "reduction": red,
+            "dtype": str(dtype),
+            "kind": kind,
+            "elements": nelems,
+            "requested": requested,
+            "transport": final,
+            "bound": transport_error_bound(final, world, kind),
+            "tolerance": eff_tol,
+            "refusal": refusal,
+        })
+    return plan
+
+
+# --------------------------------------------------------------------------- #
 # collective counting (trace-time instrumentation for benches/tests)
 # --------------------------------------------------------------------------- #
 _counter = threading.local()
@@ -87,13 +364,30 @@ def count_collectives():
     (static shape × itemsize at trace time), so traffic-elimination claims —
     e.g. *zero psum bytes for sharded leaves* — are measurable, not asserted.
 
+    With transport codecs (ISSUE-14) the byte tallies count **wire** bytes —
+    the payload at the dtype that actually crosses the wire, not the bucket's
+    logical dtype. ``"bytes_by_transport"`` breaks the same traffic down per
+    transport as ``{transport: {"wire": int, "logical": int}}`` where
+    ``logical`` is what the identical payload would have cost on the exact
+    path (codec protocol overhead — int8 scale exchanges, sparse nnz probes —
+    carries ``logical=0``). ``"refusals"`` collects the reason-carrying
+    records of every bucket whose requested transport the error-budget gate
+    refused back to exact.
+
     Boxes nest as a stack: an inner ``count_collectives`` (say, the engine's
     own first-compile capture) does not steal ticks from an outer user-level
     box — every active box sees every tick."""
     stack = getattr(_counter, "stack", None)
     if stack is None:
         stack = _counter.stack = []
-    box: Dict[str, Any] = {"count": 0, "by_kind": {}, "bytes": 0, "bytes_by_kind": {}}
+    box: Dict[str, Any] = {
+        "count": 0,
+        "by_kind": {},
+        "bytes": 0,
+        "bytes_by_kind": {},
+        "bytes_by_transport": {},
+        "refusals": [],
+    }
     stack.append(box)
     try:
         yield box
@@ -121,7 +415,36 @@ def _leaf_nbytes(x: Any) -> int:
         return 0
 
 
-def _tick_collective(kind: str, nbytes: int = 0) -> None:
+def _tick_registry_bytes(transport: str, wire: int, logical: int) -> None:
+    """Feed the instrument registry's ``metrics_tpu_sync_*`` series (lazy
+    import: observability must stay importable without parallel and vice
+    versa). Counters tick at trace time — retraces re-count, like every other
+    trace-time tally in this module."""
+    try:
+        from metrics_tpu.observability.instruments import REGISTRY
+    except Exception:
+        return
+    REGISTRY.counter(
+        "sync_wire_bytes_total",
+        "Per-device sync collective payload bytes as sent on the wire, by transport (trace-time tally).",
+        transport=transport,
+    ).inc(wire)
+    REGISTRY.counter(
+        "sync_logical_bytes_total",
+        "Per-device sync collective payload bytes at the buckets' logical dtypes, by transport (trace-time tally).",
+        transport=transport,
+    ).inc(logical)
+
+
+def _tick_collective(
+    kind: str, nbytes: int = 0, logical: Optional[int] = None, transport: str = "exact"
+) -> None:
+    """Record one collective: ``nbytes`` is the **wire** payload (the dtype
+    that actually crosses the link); ``logical`` is what the exact path would
+    have moved for the same bucket (defaults to the wire bytes — they coincide
+    for the exact transport). Codec protocol overhead passes ``logical=0``."""
+    wire_logical = nbytes if logical is None else logical
+    _tick_registry_bytes(transport, nbytes, wire_logical)
     stack = getattr(_counter, "stack", None)
     if not stack:
         return
@@ -130,6 +453,30 @@ def _tick_collective(kind: str, nbytes: int = 0) -> None:
         box["by_kind"][kind] = box["by_kind"].get(kind, 0) + 1
         box["bytes"] += nbytes
         box["bytes_by_kind"][kind] = box["bytes_by_kind"].get(kind, 0) + nbytes
+        per = box["bytes_by_transport"].setdefault(transport, {"wire": 0, "logical": 0})
+        per["wire"] += nbytes
+        per["logical"] += wire_logical
+
+
+def _tick_refusal(refusal: Dict[str, Any]) -> None:
+    """Record one error-budget refusal: into every active counting box, the
+    tracer (``sync/transport_refused``), and the registry refusal counter."""
+    stack = getattr(_counter, "stack", None)
+    if stack:
+        for box in stack:
+            box["refusals"].append(dict(refusal))
+    if _otrace.active:
+        _otrace.emit_instant("sync/transport_refused", "sync", **refusal)
+    try:
+        from metrics_tpu.observability.instruments import REGISTRY
+    except Exception:
+        return
+    REGISTRY.counter(
+        "sync_transport_refusals_total",
+        "Buckets whose requested quantized transport the error-budget gate refused back to exact.",
+        transport=str(refusal.get("transport")),
+        reason=str(refusal.get("reason")),
+    ).inc()
 
 
 def reduce(x: Array, reduction: str) -> Array:
@@ -263,9 +610,97 @@ def gather_result(x: Array, axis_name: AxisNames, axis: int = 0) -> Array:
     return lax.all_gather(x, axis_name, axis=axis, tiled=True)
 
 
-def _sync_bucketed(entries: List[Tuple[str, Array, Optional[str]]], axis_name: AxisNames) -> Dict[str, Any]:
-    """One collective per (reduction, dtype) bucket — gradient-bucketing for
-    metric state (ISSUE-3 tentpole; arXiv:2305.06942 fused-collective shape).
+# --------------------------------------------------------------------------- #
+# transport codecs: how a flat sum bucket crosses the wire
+# --------------------------------------------------------------------------- #
+def _psum_bf16(flat: Array, axis_name: AxisNames, dtype: Any) -> Array:
+    """cast → psum → upcast. Integer buckets round back after the upcast (the
+    accumulated bf16 sum of integer counts lands within the E112 bound of the
+    exact integer, but not on it)."""
+    logical = _leaf_nbytes(flat)
+    wire = flat.astype(jnp.bfloat16)
+    _tick_collective("psum", _leaf_nbytes(wire), logical=logical, transport="bf16")
+    acc = lax.psum(wire, axis_name)
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        return jnp.round(acc.astype(jnp.float32)).astype(dtype)
+    return acc.astype(dtype)
+
+
+def _psum_int8(flat: Array, axis_name: AxisNames, dtype: Any) -> Array:
+    """Two-phase quantized psum with per-block max-abs scales.
+
+    Phase 1 exchanges one f32 max-abs per :data:`INT8_BLOCK` elements (a small
+    ``pmax``, ticked with ``logical=0`` — the exact path has no counterpart);
+    every device then quantizes to the shared grid, so the accumulated sum's
+    error stays within ``world × scale/2`` per element. Phase 2 is the payload
+    psum: the wire dtype is int8 (and is ticked as such) — this XLA emulation
+    widens to int32 for the accumulation so ``world × 127`` cannot wrap,
+    where a production ring implementation requantizes per hop (EQuARX) at
+    identical wire bytes.
+    """
+    n = flat.size
+    nblocks = -(-n // INT8_BLOCK)
+    logical = _leaf_nbytes(flat)
+    padded = jnp.pad(flat.astype(jnp.float32), (0, nblocks * INT8_BLOCK - n))
+    blocks = padded.reshape(nblocks, INT8_BLOCK)
+    local_max = jnp.max(jnp.abs(blocks), axis=1)
+    _tick_collective("pmax", _leaf_nbytes(local_max), logical=0, transport="int8")
+    gmax = lax.pmax(local_max, axis_name)
+    scale = jnp.where(gmax > 0.0, gmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(blocks / scale[:, None]), -127.0, 127.0).astype(jnp.int8)
+    _tick_collective("psum", _leaf_nbytes(q), logical=logical, transport="int8")
+    acc = lax.psum(q.astype(jnp.int32), axis_name)
+    deq = (acc.astype(jnp.float32) * scale[:, None]).reshape(-1)[:n]
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        return jnp.round(deq).astype(dtype)
+    return deq.astype(dtype)
+
+
+def _psum_sparse_count(flat: Array, axis_name: AxisNames, dtype: Any) -> Array:
+    """Index+value encoding for count-like integer sum buckets — lossless.
+
+    Each device sends its ``K = ceil(density × n)`` largest-magnitude entries
+    as a ``(values ++ indices)`` gather payload; a scatter-add rebuilds the
+    dense sum (duplicate indices across devices accumulate, zero-valued filler
+    slots add nothing). A ``pmax`` of the per-device nonzero count picks the
+    branch: if any device holds more than K nonzeros the bucket falls back to
+    a dense psum *inside the trace* (``lax.cond``), so the result is exact in
+    both regimes. Both branches are genuinely in the program, so both tick —
+    the dense branch under the ``sparse_count_overflow`` label to keep the
+    admitted path's wire accounting separable.
+    """
+    n = flat.size
+    k = _sparse_slots(n)
+    logical = _leaf_nbytes(flat)
+    nnz = jnp.sum((flat != 0).astype(jnp.int32))
+    _tick_collective("pmax", 4, logical=0, transport="sparse_count")
+    worst = lax.pmax(nnz, axis_name)
+    _, idx = lax.top_k(jnp.abs(flat), k)
+    payload = jnp.concatenate([jnp.take(flat, idx), idx.astype(dtype)])
+    _tick_collective("all_gather", _leaf_nbytes(payload), logical=logical, transport="sparse_count")
+    _tick_collective("psum", logical, logical=logical, transport="sparse_count_overflow")
+
+    def _sparse(_):
+        gathered = lax.all_gather(payload, axis_name, axis=0)  # (world, 2k)
+        vals = gathered[:, :k].reshape(-1)
+        gidx = gathered[:, k:].reshape(-1).astype(jnp.int32)
+        return jnp.zeros((n,), dtype).at[gidx].add(vals)
+
+    def _dense(_):
+        return lax.psum(flat, axis_name)
+
+    return lax.cond(worst <= k, _sparse, _dense, None)
+
+
+def _sync_bucketed(
+    entries: List[Tuple[str, Array, Optional[str]]],
+    axis_name: AxisNames,
+    transports: Optional[Dict[str, str]] = None,
+    tolerances: Optional[Dict[str, float]] = None,
+) -> Dict[str, Any]:
+    """One collective per (reduction, dtype, transport) bucket —
+    gradient-bucketing for metric state (ISSUE-3 tentpole; arXiv:2305.06942
+    fused-collective shape) with opt-in transport codecs (ISSUE-14).
 
     Bucket layout: every leaf of a bucket is raveled and concatenated into one
     flat buffer, a single ``psum``/``pmean``/``pmax``/``pmin``/``all_gather``
@@ -273,13 +708,47 @@ def _sync_bucketed(entries: List[Tuple[str, Array, Optional[str]]], axis_name: A
     and reshapes it. Elementwise reductions make this bitwise-identical to the
     per-leaf path (pinned by tests on the 8-device CPU mesh); singleton buckets
     skip the flatten dance entirely and go straight through :func:`sync_array`.
+
+    Transports: each leaf resolves to its declared transport (per-state >
+    global default > ``exact``) and the transport joins the bucket key, so a
+    program with no declarations partitions *identically* to the
+    pre-transport sync — the bitwise escape hatch is the same code path, not a
+    parallel one. Quantized buckets pass the error-budget gate
+    (:func:`_gate_transport`) first; a refused bucket syncs exactly, and —
+    psum being elementwise — splitting a bucket never changes any leaf's
+    value, so refusals are value-invisible.
     """
     out: Dict[str, Any] = {}
-    buckets: Dict[Tuple[Any, Any], List[Tuple[str, Array]]] = {}
+    buckets: Dict[Tuple[Any, Any, str], List[Tuple[str, Array]]] = {}
     for name, arr, red in entries:
         arr = jnp.asarray(arr)
-        buckets.setdefault((red, arr.dtype), []).append((name, arr))
-    for (red, _dtype), items in buckets.items():
+        buckets.setdefault((red, arr.dtype, _resolve_transport(name, transports)), []).append((name, arr))
+    world = None
+    if any(t != "exact" for _, _, t in buckets):
+        world = _axis_world(axis_name)
+    for (red, dtype, requested), items in buckets.items():
+        transport = requested
+        if requested != "exact":
+            names = [n for n, _ in items]
+            nelems = int(sum(a.size for _, a in items))
+            transport, refusal = _gate_transport(
+                requested, red, np.dtype(dtype), nelems, world,
+                _bucket_tolerance(names, tolerances),
+            )
+            if refusal is not None:
+                _tick_refusal(dict(refusal, reduction=str(red), dtype=str(np.dtype(dtype)), states=names))
+        if transport != "exact":
+            flat = (
+                jnp.ravel(items[0][1]) if len(items) == 1
+                else jnp.concatenate([jnp.ravel(a) for _, a in items])
+            )
+            codec = {"bf16": _psum_bf16, "int8": _psum_int8, "sparse_count": _psum_sparse_count}[transport]
+            synced = codec(flat, axis_name, dtype)
+            offset = 0
+            for name, arr in items:
+                out[name] = synced[offset : offset + arr.size].reshape(arr.shape)
+                offset += arr.size
+            continue
         if len(items) == 1:
             name, arr = items[0]
             out[name] = sync_array(arr, red, axis_name)
@@ -296,22 +765,25 @@ def _sync_bucketed(entries: List[Tuple[str, Array, Optional[str]]], axis_name: A
             flat = jnp.concatenate([jnp.ravel(a) for _, a in shaped])
             _tick_collective("all_gather", _leaf_nbytes(flat))
             gathered = lax.all_gather(flat, axis_name, axis=0)  # (world, sum of sizes)
-            world = gathered.shape[0]
+            world_dim = gathered.shape[0]
             offset = 0
             for name, arr in shaped:
                 seg = gathered[:, offset : offset + arr.size]
                 if red == "cat":
                     # tiled semantics: device-major concat along dim 0
-                    out[name] = seg.reshape((world * arr.shape[0],) + arr.shape[1:])
+                    out[name] = seg.reshape((world_dim * arr.shape[0],) + arr.shape[1:])
                 else:
                     # stacking semantics: keep the leading per-device dim
-                    out[name] = seg.reshape((world,) + arr.shape)
+                    out[name] = seg.reshape((world_dim,) + arr.shape)
                 offset += arr.size
     return out
 
 
 def _sync_resharded(
-    entries: List[Tuple[str, Array, int]], axis_name: AxisNames
+    entries: List[Tuple[str, Array, int]],
+    axis_name: AxisNames,
+    transports: Optional[Dict[str, str]] = None,
+    tolerances: Optional[Dict[str, float]] = None,
 ) -> Dict[str, Any]:
     """Reshard bucket: sharded state leaves re-materialize at ``compute()``.
 
@@ -320,19 +792,42 @@ def _sync_resharded(
     counts, ...). There is no cross-replica reduction — every device already
     owns its slice exactly — so the sync is pure data movement: one tiled
     ``all_gather`` along the shard axis rebuilds the global leaf. Leaves with
-    the same ``(dtype, shard dimension)`` coalesce into one collective by
-    concatenating their flattened trailing dims; the rest go singleton. Every
-    op ticks :func:`count_collectives` as ``"reshard"`` so the byte tally can
-    prove sharded leaves move zero psum bytes.
+    the same ``(dtype, shard dimension, transport)`` coalesce into one
+    collective by concatenating their flattened trailing dims; the rest go
+    singleton. Every op ticks :func:`count_collectives` as ``"reshard"`` so
+    the byte tally can prove sharded leaves move zero psum bytes.
+
+    Transports: because there is no accumulation, the quantized reshard
+    codecs are mesh-width independent — ``bf16`` is one cast each way,
+    ``int8`` quantizes against one bucket-global max-abs scale (a scalar
+    ``pmax`` exchange) so every device decodes against the same grid. The
+    error-budget gate applies with ``kind="reshard"`` bounds;
+    ``sparse_count`` never applies here (dense disjoint blocks).
     """
     out: Dict[str, Any] = {}
-    buckets: Dict[Tuple[Any, int], List[Tuple[str, Array, int]]] = {}
+    buckets: Dict[Tuple[Any, int, str], List[Tuple[str, Array, int]]] = {}
     for name, arr, axis in entries:
         arr = jnp.asarray(arr)
         axis = axis % max(arr.ndim, 1)
-        buckets.setdefault((arr.dtype, int(arr.shape[axis])), []).append((name, arr, axis))
-    for (_dtype, dim), items in buckets.items():
-        if len(items) == 1:
+        t = _resolve_transport(name, transports)
+        buckets.setdefault((arr.dtype, int(arr.shape[axis]), t), []).append((name, arr, axis))
+    world = None
+    if any(t != "exact" for _, _, t in buckets):
+        world = _axis_world(axis_name)
+    for (dtype, dim, requested), items in buckets.items():
+        transport = requested
+        if requested != "exact":
+            names = [n for n, _, _ in items]
+            nelems = int(sum(a.size for _, a, _ in items))
+            transport, refusal = _gate_transport(
+                requested, None, np.dtype(dtype), nelems, world,
+                _bucket_tolerance(names, tolerances), kind="reshard",
+            )
+            if refusal is not None:
+                _tick_refusal(dict(
+                    refusal, reduction="reshard", dtype=str(np.dtype(dtype)), states=names,
+                ))
+        if transport == "exact" and len(items) == 1:
             name, arr, axis = items[0]
             _tick_collective("reshard", _leaf_nbytes(arr))
             out[name] = lax.all_gather(arr, axis_name, axis=axis, tiled=True)
@@ -341,8 +836,29 @@ def _sync_resharded(
         # concat along the raveled dim, one tiled gather, slice + restore axes
         moved = [(name, jnp.moveaxis(arr, axis, 0), axis) for name, arr, axis in items]
         flat = jnp.concatenate([m.reshape(dim, -1) for _, m, _ in moved], axis=1)
-        _tick_collective("reshard", _leaf_nbytes(flat))
-        gathered = lax.all_gather(flat, axis_name, axis=0, tiled=True)
+        if transport == "bf16":
+            wire = flat.astype(jnp.bfloat16)
+            _tick_collective("reshard", _leaf_nbytes(wire), logical=_leaf_nbytes(flat), transport="bf16")
+            gathered = lax.all_gather(wire, axis_name, axis=0, tiled=True)
+            if np.issubdtype(np.dtype(dtype), np.integer):
+                gathered = jnp.round(gathered.astype(jnp.float32)).astype(dtype)
+            else:
+                gathered = gathered.astype(dtype)
+        elif transport == "int8":
+            fl32 = flat.astype(jnp.float32)
+            _tick_collective("pmax", 4, logical=0, transport="int8")
+            gmax = lax.pmax(jnp.max(jnp.abs(fl32)), axis_name)
+            scale = jnp.where(gmax > 0.0, gmax / 127.0, 1.0)
+            q = jnp.clip(jnp.round(fl32 / scale), -127.0, 127.0).astype(jnp.int8)
+            _tick_collective("reshard", _leaf_nbytes(q), logical=_leaf_nbytes(flat), transport="int8")
+            deq = lax.all_gather(q, axis_name, axis=0, tiled=True).astype(jnp.float32) * scale
+            if np.issubdtype(np.dtype(dtype), np.integer):
+                gathered = jnp.round(deq).astype(dtype)
+            else:
+                gathered = deq.astype(dtype)
+        else:
+            _tick_collective("reshard", _leaf_nbytes(flat))
+            gathered = lax.all_gather(flat, axis_name, axis=0, tiled=True)
         offset = 0
         for (name, m, axis), (_, arr, _) in zip(moved, items):
             width = m.size // dim
@@ -432,6 +948,8 @@ def sync_stacked_states(
     states: Dict[str, Dict[str, Any]],
     reductions: Dict[str, Dict[str, Optional[Union[str, Callable]]]],
     axis_name: Optional[AxisNames],
+    transports: Optional[Dict[str, Dict[str, str]]] = None,
+    tolerances: Optional[Dict[str, Dict[str, float]]] = None,
 ) -> Dict[str, Dict[str, Any]]:
     """Tenant-batched bucketed sync (metrics_tpu.tenancy, ISSUE-11 tentpole).
 
@@ -448,10 +966,17 @@ def sync_stacked_states(
     change layout per tenant and are rejected at classification time
     (``classify_tenant_member``) — hitting one is a routing bug, so it raises.
     ``axis_name=None`` is the no-axis identity fast path.
+
+    ``transports``/``tolerances`` mirror ``reductions``' nesting
+    (``{leader: {state: ...}}``); transport joins the bucket key exactly as in
+    the single-collection sync, so the collective count per transport stays
+    independent of N and of the number of leaders.
     """
     if axis_name is None:
         return {lname: dict(st) for lname, st in states.items()}
     entries: List[Tuple[str, Array, Optional[str]]] = []
+    flat_transports: Dict[str, str] = {}
+    flat_tolerances: Dict[str, float] = {}
     for lname, st in states.items():
         reds = reductions[lname]
         for name, leaf in st.items():
@@ -465,8 +990,13 @@ def sync_stacked_states(
                 )
             # \x1f never appears in metric/state names; joins leader+state into
             # one flat key so all leaders share the same bucket namespace
-            entries.append((f"{lname}\x1f{name}", leaf, red))
-    synced = _sync_bucketed(entries, axis_name)
+            key = f"{lname}\x1f{name}"
+            entries.append((key, leaf, red))
+            if transports and name in (transports.get(lname) or {}):
+                flat_transports[key] = transports[lname][name]
+            if tolerances and name in (tolerances.get(lname) or {}):
+                flat_tolerances[key] = tolerances[lname][name]
+    synced = _sync_bucketed(entries, axis_name, flat_transports, flat_tolerances)
     out: Dict[str, Dict[str, Any]] = {lname: {} for lname in states}
     for key, leaf in synced.items():
         lname, name = key.split("\x1f", 1)
@@ -481,6 +1011,8 @@ def sync_state(
     bucketed: Optional[bool] = None,
     shard_axes: Optional[Dict[str, Union[int, Tuple[int, ...]]]] = None,
     keep_sharded: bool = False,
+    transports: Optional[Dict[str, str]] = None,
+    tolerances: Optional[Dict[str, float]] = None,
 ) -> Dict[str, Any]:
     """Synchronize a whole state pytree by per-state reduction tag.
 
@@ -515,11 +1047,21 @@ def sync_state(
     usual. The caller's ``compute_sharded_state`` then finishes the reduction
     locally and combines only the small result (:func:`psum_result` /
     :func:`gather_result`), so the reshard bucket never runs.
+
+    ``transports`` (name → transport) and ``tolerances`` (name → relative
+    error budget) select per-state transport codecs for the reduction and
+    reshard buckets — see the module-level transport vocabulary. Undeclared
+    states follow :func:`sync_transport_default`; every quantized bucket
+    passes the error-budget gate or falls back to exact with a
+    reason-carrying refusal record.
     """
     if axis_name is None:
         return dict(state)
     if not _otrace.active:
-        return _sync_state_impl(state, reductions, axis_name, bucketed, shard_axes, keep_sharded)
+        return _sync_state_impl(
+            state, reductions, axis_name, bucketed, shard_axes, keep_sharded,
+            transports, tolerances,
+        )
     # tracer on: record one sync/bucket_build span per sync with this build's
     # own collective tally (a nested count_collectives box — outer user boxes
     # still see every tick). sync_state runs at trace time, which is exactly
@@ -527,12 +1069,17 @@ def sync_state(
     # touches the Python-side event object, never the traced program.
     t0_us = _otrace._now_us()
     with count_collectives() as box:
-        out = _sync_state_impl(state, reductions, axis_name, bucketed, shard_axes, keep_sharded)
+        out = _sync_state_impl(
+            state, reductions, axis_name, bucketed, shard_axes, keep_sharded,
+            transports, tolerances,
+        )
     _otrace.emit_complete(
         "sync/bucket_build", "sync", t0_us, _otrace._now_us() - t0_us,
         axis=str(axis_name), leaves=len(state),
         collectives=dict(box["by_kind"]),
         collective_bytes=dict(box["bytes_by_kind"]),
+        bytes_by_transport={k: dict(v) for k, v in box["bytes_by_transport"].items()},
+        transport_refusals=len(box["refusals"]),
     )
     return out
 
@@ -544,6 +1091,8 @@ def _sync_state_impl(
     bucketed: Optional[bool],
     shard_axes: Optional[Dict[str, Union[int, Tuple[int, ...]]]],
     keep_sharded: bool = False,
+    transports: Optional[Dict[str, str]] = None,
+    tolerances: Optional[Dict[str, float]] = None,
 ) -> Dict[str, Any]:
     if _chaos.active:
         # bucket builds run at trace time, so an injected fault here surfaces
@@ -605,9 +1154,9 @@ def _sync_state_impl(
         else:
             out[name] = sync_array(arr, red, axis_name)
     if entries:
-        out.update(_sync_bucketed(entries, axis_name))
+        out.update(_sync_bucketed(entries, axis_name, transports, tolerances))
     if shard_entries:
-        out.update(_sync_resharded(shard_entries, axis_name))
+        out.update(_sync_resharded(shard_entries, axis_name, transports, tolerances))
     if multi_shard_entries:
         out.update(_sync_resharded_multi(multi_shard_entries, axis_name))
     if buf_entries:

@@ -21,7 +21,9 @@ from metrics_tpu.serve.server import (
     IngestServer,
     UnknownTenant,
     decode_body,
+    decode_steps,
     encode_npz,
+    encode_npz_steps,
     get_server,
     serve,
     shutdown,
@@ -40,7 +42,9 @@ __all__ = [
     "Observation",
     "UnknownTenant",
     "decode_body",
+    "decode_steps",
     "encode_npz",
+    "encode_npz_steps",
     "get_server",
     "offline_replay",
     "serve",

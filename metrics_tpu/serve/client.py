@@ -27,6 +27,7 @@ from metrics_tpu.serve.server import (
     JSON_CONTENT_TYPE,
     NPZ_CONTENT_TYPE,
     encode_npz,
+    encode_npz_steps,
 )
 
 
@@ -82,6 +83,32 @@ class IngestClient:
             f"{self.base_url}/ingest/{urllib.parse.quote(str(tenant_id), safe='')}",
             data=body,
             headers={"Content-Type": ctype},
+            method="POST",
+        )
+        status, headers, doc = _request(req, self.timeout)
+        doc["status"] = status
+        if "Retry-After" in headers:
+            doc["retry_after_s"] = float(headers["Retry-After"])
+        return doc
+
+    def post_steps(
+        self,
+        tenant_id: Any,
+        *args: Any,
+        **kwargs: Any,
+    ) -> Dict[str, Any]:
+        """POST a multi-step batch (leading step axis) in one request.
+
+        Every array must share one leading axis of length ``S``; the server
+        admits the ``S`` per-step observations in order and stops at the
+        first rejection, reporting ``steps``/``admitted_steps``/``seqs`` so
+        the caller knows exactly where to resume. Rejections are returned,
+        never raised.
+        """
+        req = urllib.request.Request(
+            f"{self.base_url}/ingest/{urllib.parse.quote(str(tenant_id), safe='')}",
+            data=encode_npz_steps(*args, **kwargs),
+            headers={"Content-Type": NPZ_CONTENT_TYPE},
             method="POST",
         )
         status, headers, doc = _request(req, self.timeout)

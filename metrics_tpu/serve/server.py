@@ -430,6 +430,90 @@ def encode_npz(*args: np.ndarray, **kwargs: np.ndarray) -> bytes:
     return buf.getvalue()
 
 
+# marker entry of a batched npz body: its scalar value is the step count and
+# every arg/kw array carries that count as a leading axis
+STEPS_KEY = "__steps__"
+
+
+def encode_npz_steps(*args: np.ndarray, **kwargs: np.ndarray) -> bytes:
+    """A batched ``POST /ingest`` body: one request, many steps.
+
+    Every array carries a leading *step* axis of equal length S; the server
+    slices the body back into S per-step observations and admits them in
+    order, amortizing the HTTP round trip over the whole window. Slicing is
+    byte-exact, so ``offline_replay`` of the per-step log stays the bitwise
+    oracle for batched posts too.
+    """
+    arrays = [np.asarray(a) for a in args] + [np.asarray(v) for v in kwargs.values()]
+    if not arrays:
+        raise ValueError("a batched body needs at least one array argument")
+    lead = {a.shape[0] if a.ndim else None for a in arrays}
+    if None in lead or len(lead) != 1:
+        raise ValueError(
+            f"every array must share one leading step axis, got shapes "
+            f"{[a.shape for a in arrays]}"
+        )
+    steps = lead.pop()
+    if steps < 1:
+        raise ValueError("a batched body needs at least one step")
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        **{STEPS_KEY: np.asarray(steps, dtype=np.int64)},
+        **{f"arg{i}": np.asarray(a) for i, a in enumerate(args)},
+        **{f"kw_{k}": np.asarray(v) for k, v in kwargs.items()},
+    )
+    return buf.getvalue()
+
+
+def decode_steps(content_type: str, body: bytes) -> Tuple[List[Tuple[Tuple, Dict[str, Any]]], bool]:
+    """``([(args, kwargs), ...], batched)`` from a request body.
+
+    A plain body (:func:`decode_body` vocabulary) decodes to one step with
+    ``batched=False``. An ``application/x-npz`` body carrying the
+    :data:`STEPS_KEY` marker decodes to S per-step ``(args, kwargs)`` tuples
+    — numpy basic slicing of the step axis, byte-exact — with
+    ``batched=True``.
+    """
+    ctype = (content_type or "").split(";", 1)[0].strip().lower()
+    if ctype == NPZ_CONTENT_TYPE:
+        with np.load(io.BytesIO(body), allow_pickle=False) as npz:
+            if STEPS_KEY in npz.files:
+                steps = int(npz[STEPS_KEY])
+                if steps < 1:
+                    raise ValueError(f"{STEPS_KEY} must be >= 1, got {steps}")
+                positional: List[Tuple[int, np.ndarray]] = []
+                kwargs: Dict[str, np.ndarray] = {}
+                for key in npz.files:
+                    if key == STEPS_KEY:
+                        continue
+                    if key.startswith("arg"):
+                        positional.append((int(key[3:]), npz[key]))
+                    elif key.startswith("kw_"):
+                        kwargs[key[3:]] = npz[key]
+                    else:
+                        raise ValueError(
+                            f"npz entry {key!r}: expected 'arg<i>', 'kw_<name>', or {STEPS_KEY!r}"
+                        )
+                positional.sort()
+                for label, arr in [(f"arg{i}", a) for i, a in positional] + [
+                    (f"kw_{k}", v) for k, v in kwargs.items()
+                ]:
+                    if arr.ndim == 0 or arr.shape[0] != steps:
+                        raise ValueError(
+                            f"batched npz entry {label!r} has shape {arr.shape}; "
+                            f"expected a leading step axis of {steps}"
+                        )
+                return [
+                    (
+                        tuple(a[i] for _, a in positional),
+                        {k: v[i] for k, v in kwargs.items()},
+                    )
+                    for i in range(steps)
+                ], True
+    return [decode_body(content_type, body)], False
+
+
 # --------------------------------------------------------------------------- #
 # the HTTP skin
 # --------------------------------------------------------------------------- #
@@ -475,40 +559,50 @@ class _IngestHandler(BaseHTTPRequestHandler):
                 return
             body = self.rfile.read(length)
             try:
-                args, kwargs = decode_body(self.headers.get("Content-Type", ""), body)
+                steps, batched = decode_steps(self.headers.get("Content-Type", ""), body)
             except Exception as err:  # noqa: BLE001 — malformed bodies -> 400
                 self._send_json(400, {"error": f"bad body: {err}"})
                 return
+            # admit the steps in order; the first rejection stops the batch so
+            # the admitted prefix is exactly what offline_replay will see, and
+            # the client knows from admitted_steps where to resume
+            seqs: List[int] = []
+            admission = None
             try:
-                admission = self.ingest_server.pipeline.post(tenant_id, *args, **kwargs)
+                for args, kwargs in steps:
+                    admission = self.ingest_server.pipeline.post(tenant_id, *args, **kwargs)
+                    if not admission.admitted:
+                        break
+                    seqs.append(admission.seq)
             except _chaos.ChaosError as err:
                 # injected ingress fault: surfaced as a retryable 503
-                self._send_json(
-                    503,
-                    {"admitted": False, "reason": "fault", "error": str(err)},
-                    retry_after="1",
-                )
+                doc = {"admitted": False, "reason": "fault", "error": str(err)}
+                if batched:
+                    doc.update(steps=len(steps), admitted_steps=len(seqs), seqs=seqs)
+                self._send_json(503, doc, retry_after="1")
                 return
-            if admission.admitted:
-                self._send_json(200, {
+            if admission is not None and admission.admitted:
+                doc = {
                     "admitted": True,
                     "tenant": tenant_id,
                     "seq": admission.seq,
                     "queue_depth": admission.queue_depth,
-                })
+                }
+                if batched:
+                    doc.update(steps=len(steps), admitted_steps=len(seqs), seqs=seqs)
+                self._send_json(200, doc)
             else:
                 status = 503 if admission.reason == "draining" else 429
-                self._send_json(
-                    status,
-                    {
-                        "admitted": False,
-                        "tenant": tenant_id,
-                        "reason": admission.reason,
-                        "queue_depth": admission.queue_depth,
-                        "retry_after_s": admission.retry_after_s,
-                    },
-                    retry_after=admission.retry_after_header,
-                )
+                doc = {
+                    "admitted": False,
+                    "tenant": tenant_id,
+                    "reason": admission.reason,
+                    "queue_depth": admission.queue_depth,
+                    "retry_after_s": admission.retry_after_s,
+                }
+                if batched:
+                    doc.update(steps=len(steps), admitted_steps=len(seqs), seqs=seqs)
+                self._send_json(status, doc, retry_after=admission.retry_after_header)
         except BrokenPipeError:
             return
         except Exception as err:  # noqa: BLE001 — a request must never kill the thread

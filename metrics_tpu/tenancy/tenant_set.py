@@ -558,14 +558,25 @@ class TenantSet:
         self, stacked: Dict[str, StateDict], axis_name: Any
     ) -> Dict[str, StateDict]:
         """Cross-device sync of a stacked state pytree: the tenant axis folds
-        into the flat (reduction, dtype) buckets, so the collective count per
-        sync is independent of both N and the number of stacked groups (see
-        :func:`metrics_tpu.parallel.sync.sync_stacked_states`)."""
+        into the flat (reduction, dtype, transport) buckets, so the collective
+        count per sync is independent of both N and the number of stacked
+        groups — under every transport (see
+        :func:`metrics_tpu.parallel.sync.sync_stacked_states`). Per-state
+        ``sync_transport``/``sync_tolerance`` declarations on the template's
+        leaders ride along unchanged."""
+        leaders = [group[0] for group in self._stacked_groups]
         reductions = {
-            group[0]: dict(self.template._metrics[group[0]]._reductions)
-            for group in self._stacked_groups
+            name: dict(self.template._metrics[name]._reductions) for name in leaders
         }
-        return _sync.sync_stacked_states(stacked, reductions, axis_name)
+        transports = {
+            name: dict(self.template._metrics[name]._sync_transports) for name in leaders
+        }
+        tolerances = {
+            name: dict(self.template._metrics[name]._sync_tolerances) for name in leaders
+        }
+        return _sync.sync_stacked_states(
+            stacked, reductions, axis_name, transports, tolerances
+        )
 
     @property
     def stacked_states(self) -> Dict[str, StateDict]:

@@ -315,6 +315,55 @@ class OffAxisReductionMetric(Metric):
         return self.table.sum(axis=1)
 
 
+class OverBudgetTransportMetric(Metric):
+    """E112: a declared bf16 transport whose tolerance is tighter than the
+    canonical-mesh error bound — the runtime gate refuses the bucket, so the
+    declaration silently buys nothing."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state(
+            "total", default=jnp.zeros((16,)), dist_reduce_fx="sum",
+            sync_transport="bf16", sync_tolerance=0.001,
+        )
+
+    def update(self, values):
+        self.total = self.total + values[:16].sum() + jnp.zeros((16,))
+
+    def compute(self):
+        return self.total.sum()
+
+
+class InBudgetTransportMetric(OverBudgetTransportMetric):
+    """Control for E112: the same declaration at the transport's default
+    tolerance — within budget on the canonical mesh, gate admits it."""
+
+    def __init__(self, **kwargs):
+        Metric.__init__(self, **kwargs)
+        self.add_state(
+            "total", default=jnp.zeros((16,)), dist_reduce_fx="sum",
+            sync_transport="bf16",
+        )
+
+
+class NoByteWinTransportMetric(Metric):
+    """E112 (reason no_byte_win): sparse_count on a bucket too small for the
+    index+value encoding to beat dense wire bytes."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state(
+            "pair", default=jnp.zeros((2,), jnp.int32), dist_reduce_fx="sum",
+            sync_transport="sparse_count",
+        )
+
+    def update(self, values):
+        self.pair = self.pair + jnp.ones((2,), jnp.int32)
+
+    def compute(self):
+        return self.pair.sum()
+
+
 class CatReductionMetric(Metric):
     """E110: dense state under a ``cat`` reduction — fine for the compiled
     engines, but a TenantSet cannot fold its tenant axis into the flat sync
@@ -539,6 +588,38 @@ class TestEvalStage:
         findings = _evaluate(CatReductionMetric, dict(_SPEC, allow=("E110",)))
         e110 = [f for f in findings if f.rule == "E110"]
         assert e110 and all(f.suppressed for f in e110)
+
+    def test_over_budget_transport_is_E112(self):
+        findings = _evaluate(OverBudgetTransportMetric)
+        e112 = [f for f in findings if f.rule == "E112" and not f.suppressed]
+        assert len(e112) == 1, [f.rule for f in findings]
+        assert e112[0].severity == "warning"
+        assert "falls back to the exact transport" in e112[0].message
+        extra = e112[0].extra
+        assert extra["requested"] == "bf16"
+        assert extra["states"] == ["total"]
+        assert extra["refusal"]["reason"] == "error_budget"
+        assert extra["refusal"]["bound"] > extra["refusal"]["tolerance"] == 0.001
+
+    def test_in_budget_transport_has_no_E112(self):
+        findings = _evaluate(InBudgetTransportMetric)
+        assert "E112" not in {f.rule for f in findings}
+
+    def test_no_byte_win_is_E112(self):
+        findings = _evaluate(NoByteWinTransportMetric)
+        e112 = [f for f in findings if f.rule == "E112" and not f.suppressed]
+        assert len(e112) == 1, [f.rule for f in findings]
+        assert e112[0].extra["refusal"]["reason"] == "no_byte_win"
+        assert "no_byte_win" in e112[0].message
+
+    def test_undeclared_metric_has_no_E112(self):
+        findings = _evaluate(CleanMetric)
+        assert "E112" not in {f.rule for f in findings}
+
+    def test_E112_is_suppressible_via_spec_allow(self):
+        findings = _evaluate(OverBudgetTransportMetric, dict(_SPEC, allow=("E112",)))
+        e112 = [f for f in findings if f.rule == "E112"]
+        assert e112 and all(f.suppressed for f in e112)
 
     def test_missing_spec_is_E002(self):
         findings = eval_stage.evaluate_entry(Entry(cls=CleanMetric, spec=None))

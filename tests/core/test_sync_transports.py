@@ -1,0 +1,377 @@
+"""Quantized & compressed sync transports (``metrics_tpu/parallel/sync.py``).
+
+The transport layer is an opt-in codec per sync bucket — ``exact`` (default,
+bitwise), ``bf16`` (cast-psum-upcast), ``int8`` (blockwise max-abs scales,
+two-phase scale exchange + quantized psum), ``sparse_count`` (index+value
+gather for near-empty count buckets). These tests pin the contract on the
+8-device CPU mesh: the all-exact configuration is the *same code path* as
+before the layer existed (bitwise, identical collective counts); quantized
+buckets land within both their declared tolerance and the abstract
+``transport_error_bound``; the error-budget gate refuses over-budget buckets
+with a reason-carrying record and falls back bitwise; wire-vs-logical byte
+accounting per transport feeds the bench/observability surfaces; selection
+precedence is per-state declaration > global switch > env; and transport is
+configuration, never state — checkpoints interchange across declarations.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu
+from metrics_tpu import Metric
+from metrics_tpu.parallel import sync as sync_mod
+from metrics_tpu.parallel.sync import (
+    DEFAULT_TOLERANCES,
+    TRANSPORTS,
+    count_collectives,
+    set_sync_transport,
+    sync_state,
+    sync_transport_default,
+    transport_error_bound,
+    transport_plan,
+)
+
+WORLD = 8
+
+
+@pytest.fixture(autouse=True)
+def _exact_default():
+    set_sync_transport(None)
+    yield
+    set_sync_transport(None)
+
+
+@pytest.fixture()
+def mesh():
+    devices = jax.devices()
+    if len(devices) < WORLD:
+        pytest.skip("needs 8 devices")
+    return Mesh(np.asarray(devices[:WORLD]), ("data",))
+
+
+# mixed dtypes/reductions: f32 sum, i32 sum (count-like), f32 max, gather
+_STATE = {
+    "fsum": jnp.linspace(0.1, 40.0, 50, dtype=jnp.float32),
+    "fsum2": jnp.asarray(3.5, jnp.float32),
+    "counts": (jnp.arange(1000, dtype=jnp.int32) % 7),
+    "hits": jnp.asarray(3, jnp.int32),
+    "mx": jnp.asarray([7.0, 1.0], jnp.float32),
+    "gather": jnp.asarray([1.0, 2.0]),
+}
+_REDS = {
+    "fsum": "sum", "fsum2": "sum", "counts": "sum", "hits": "sum",
+    "mx": "max", "gather": None,
+}
+
+
+def _per_device(state):
+    return jax.tree_util.tree_map(
+        lambda a: jnp.stack([a * (i + 1) for i in range(WORLD)]), state
+    )
+
+
+def _run_sync(mesh, state, reds, transports=None, tolerances=None):
+    def body(s):
+        local = jax.tree_util.tree_map(lambda x: x[0], s)
+        out = sync_state(
+            local, reds, "data", bucketed=True,
+            transports=transports, tolerances=tolerances,
+        )
+        return jax.tree_util.tree_map(lambda x: jnp.expand_dims(x, 0), out)
+
+    f = shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_rep=False)
+    return jax.jit(f)(_per_device(state))
+
+
+def _trace_box(reds, state, transports=None, tolerances=None):
+    with count_collectives() as box:
+        jax.make_jaxpr(
+            lambda st: sync_state(
+                st, reds, "data", bucketed=True,
+                transports=transports, tolerances=tolerances,
+            ),
+            axis_env=[("data", WORLD)],
+        )(state)
+    return box
+
+
+def _rel_err(got, want):
+    """Max abs error relative to the bucket's max-magnitude exact value —
+    the frame the error bound is stated in."""
+    got, want = np.asarray(got, np.float64), np.asarray(want, np.float64)
+    denom = max(np.max(np.abs(want)), 1e-30)
+    return float(np.max(np.abs(got - want)) / denom)
+
+
+# ------------------------------------------------------------ exact parity ---
+@pytest.mark.mesh8
+def test_exact_is_the_same_code_path(mesh):
+    """The bitwise escape hatch: an explicit all-exact transport map traces to
+    the very same jaxpr as no transport map at all — not merely equal values,
+    the identical program."""
+    exact = {name: "exact" for name in _STATE}
+    jaxpr_none = jax.make_jaxpr(
+        lambda st: sync_state(st, _REDS, "data", bucketed=True),
+        axis_env=[("data", WORLD)],
+    )(_STATE)
+    jaxpr_exact = jax.make_jaxpr(
+        lambda st: sync_state(st, _REDS, "data", bucketed=True, transports=exact),
+        axis_env=[("data", WORLD)],
+    )(_STATE)
+    assert str(jaxpr_none) == str(jaxpr_exact)
+
+    out_none = _run_sync(mesh, _STATE, _REDS)
+    out_exact = _run_sync(mesh, _STATE, _REDS, transports=exact)
+    for a, b in zip(*map(lambda t: jax.tree_util.tree_leaves(t), (out_none, out_exact))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------- quantized parity ----
+@pytest.mark.mesh8
+@pytest.mark.parametrize("transport", ["bf16", "int8"])
+def test_quantized_error_within_declared_and_abstract_bound(mesh, transport):
+    transports = {"fsum": transport, "fsum2": transport}
+    out_q = _run_sync(mesh, _STATE, _REDS, transports=transports)
+    out_e = _run_sync(mesh, _STATE, _REDS)
+    bound = transport_error_bound(transport, WORLD)
+    assert bound <= DEFAULT_TOLERANCES[transport]  # admitted, not refused
+    # the quantized bucket: within the abstract bound
+    got = np.concatenate([
+        np.asarray(out_q["fsum"][0]).ravel(), np.asarray(out_q["fsum2"][0]).ravel()])
+    want = np.concatenate([
+        np.asarray(out_e["fsum"][0]).ravel(), np.asarray(out_e["fsum2"][0]).ravel()])
+    assert _rel_err(got, want) <= bound
+    # untouched buckets stay bitwise
+    for name in ("counts", "hits", "mx", "gather"):
+        np.testing.assert_array_equal(
+            np.asarray(out_q[name]), np.asarray(out_e[name]))
+
+
+@pytest.mark.mesh8
+@pytest.mark.parametrize("transport", ["bf16", "int8"])
+def test_integer_buckets_round_back_to_integers(mesh, transport):
+    """config2's stat-score buckets are int32 sums — the codec must land on
+    the integer grid (dequant + rint), within bound of the exact count."""
+    transports = {"counts": transport, "hits": transport}
+    out_q = _run_sync(mesh, _STATE, _REDS, transports=transports)
+    out_e = _run_sync(mesh, _STATE, _REDS)
+    for name in ("counts", "hits"):
+        got, want = np.asarray(out_q[name][0]), np.asarray(out_e[name][0])
+        assert got.dtype == want.dtype  # dtype survives the round trip
+        assert _rel_err(got, want) <= transport_error_bound(transport, WORLD)
+
+
+@pytest.mark.mesh8
+def test_sparse_count_is_lossless_both_branches(mesh):
+    """sparse_count is lossless on both sides of its runtime density cond:
+    a near-empty bucket takes the sparse gather, a dense one the in-program
+    psum fallback — both must equal exact bitwise."""
+    nearly_empty = jnp.zeros((400,), jnp.int32).at[7].set(3).at[200].set(1)
+    dense = jnp.arange(400, dtype=jnp.int32) % 5 + 1
+    reds = {"s": "sum"}
+    for leaf in (nearly_empty, dense):
+        out_q = _run_sync(mesh, {"s": leaf}, reds, transports={"s": "sparse_count"})
+        out_e = _run_sync(mesh, {"s": leaf}, reds)
+        np.testing.assert_array_equal(np.asarray(out_q["s"]), np.asarray(out_e["s"]))
+
+
+# ------------------------------------------------------------------- gate ----
+@pytest.mark.mesh8
+def test_refusal_falls_back_bitwise_with_reason(mesh):
+    """A tolerance tighter than the W=8 bound refuses the bucket: the record
+    carries the reason and the bucket syncs exact — bitwise, observable in
+    bytes_by_transport."""
+    transports = {"fsum": "bf16"}
+    tolerances = {"fsum": 0.001}  # << 0.0391 bound at W=8
+    box = _trace_box(_REDS, _STATE, transports, tolerances)
+    assert len(box["refusals"]) == 1
+    ref = box["refusals"][0]
+    assert ref["reason"] == "error_budget"
+    assert ref["transport"] == "bf16"
+    assert ref["bound"] > ref["tolerance"] == 0.001
+    assert "fsum" in ref["states"]
+    assert "bf16" not in box["bytes_by_transport"]  # nothing crossed quantized
+
+    out_q = _run_sync(mesh, _STATE, _REDS, transports=transports, tolerances=tolerances)
+    out_e = _run_sync(mesh, _STATE, _REDS)
+    for a, b in zip(jax.tree_util.tree_leaves(out_q), jax.tree_util.tree_leaves(out_e)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_gate_refuses_at_wider_world():
+    """The same default-tolerance bf16 bucket that passes at W=8 fails at
+    W=16: the bound model scales with mesh width."""
+    assert transport_error_bound("bf16", 8) <= DEFAULT_TOLERANCES["bf16"]
+    assert transport_error_bound("bf16", 16) > DEFAULT_TOLERANCES["bf16"]
+    state = {"fsum": jax.ShapeDtypeStruct((50,), jnp.float32)}
+    plan8 = transport_plan(state, {"fsum": "sum"}, 8, transports={"fsum": "bf16"})
+    plan16 = transport_plan(state, {"fsum": "sum"}, 16, transports={"fsum": "bf16"})
+    assert plan8[0]["transport"] == "bf16" and plan8[0]["refusal"] is None
+    assert plan16[0]["transport"] == "exact"
+    assert plan16[0]["refusal"]["reason"] == "error_budget"
+
+
+def test_gate_routes_inapplicable_combinations_silently():
+    """A global bf16 switch must not spam refusals for max/gather buckets —
+    inapplicable combinations are routing, not refusals."""
+    set_sync_transport("bf16")
+    box = _trace_box(_REDS, _STATE)
+    assert box["refusals"] == []
+    # the sum buckets went quantized, max/gather stayed exact
+    assert "bf16" in box["bytes_by_transport"]
+    assert "exact" in box["bytes_by_transport"]
+
+
+def test_sparse_count_needs_a_byte_win():
+    """sparse_count on a tiny bucket cannot beat dense wire bytes — refused
+    with reason no_byte_win (2K slots + nnz exchange >= dense)."""
+    state = {"c": jax.ShapeDtypeStruct((2,), jnp.int32)}
+    plan = transport_plan(state, {"c": "sum"}, 8, transports={"c": "sparse_count"})
+    assert plan[0]["transport"] == "exact"
+    assert plan[0]["refusal"]["reason"] == "no_byte_win"
+
+
+# ----------------------------------------------------------- wire accounting -
+def test_wire_vs_logical_byte_accounting():
+    counts = {"counts": (jnp.arange(1000, dtype=jnp.int32) % 7)}
+    reds = {"counts": "sum"}
+    logical = 1000 * 4
+    exact = _trace_box(reds, counts)["bytes_by_transport"]
+    assert exact == {"exact": {"wire": logical, "logical": logical}}
+
+    bf16 = _trace_box(reds, counts, {"counts": "bf16"})["bytes_by_transport"]
+    assert bf16["bf16"]["logical"] == logical
+    assert bf16["bf16"]["wire"] * 2 == logical  # 4B -> 2B on the wire
+
+    int8 = _trace_box(reds, counts, {"counts": "int8"})["bytes_by_transport"]
+    assert int8["int8"]["logical"] == logical
+    # quantized payload (block-padded int8) + scale pmax rides as protocol
+    # overhead: wire ticks but logical stays 0, so the ratio is honest
+    wire = sum(v["wire"] for k, v in int8.items() if k == "int8")
+    assert logical / wire >= 3.5
+
+    # the collective count per transport: bf16 folds into one psum; int8 pays
+    # the scale pmax + quantized psum; sparse pays nnz pmax + gather + the
+    # in-program dense fallback psum
+    assert _trace_box(reds, counts, {"counts": "bf16"})["by_kind"] == {"psum": 1}
+    assert _trace_box(reds, counts, {"counts": "int8"})["by_kind"] == {"pmax": 1, "psum": 1}
+    sparse = _trace_box(reds, counts, {"counts": "sparse_count"})["by_kind"]
+    assert sparse == {"pmax": 1, "all_gather": 1, "psum": 1}
+
+
+# --------------------------------------------------------------- selection ---
+def test_selection_precedence_and_validation(monkeypatch):
+    assert sync_transport_default() == "exact"
+    monkeypatch.setenv("METRICS_TPU_SYNC_TRANSPORT", "bf16")
+    assert sync_transport_default() == "bf16"
+    set_sync_transport("int8")  # global switch beats env
+    assert sync_transport_default() == "int8"
+    # per-state declaration beats the global switch
+    assert sync_mod._resolve_transport("a", {"a": "exact"}) == "exact"
+    assert sync_mod._resolve_transport("b", {"a": "exact"}) == "int8"
+    set_sync_transport(None)
+    assert sync_transport_default() == "bf16"  # back to env
+    monkeypatch.setenv("METRICS_TPU_SYNC_TRANSPORT", "bogus")
+    assert sync_transport_default() == "exact"  # unknown env ignored
+    with pytest.raises(ValueError, match="unknown sync transport"):
+        set_sync_transport("fp4")
+    # the sync-time transports= dict validates too (not a bare KeyError later)
+    with pytest.raises(ValueError, match="unknown sync transport 'float4'"):
+        sync_mod._resolve_transport("x", {"x": "float4"})
+    for t in TRANSPORTS:
+        set_sync_transport(t)
+        assert sync_transport_default() == t
+
+
+def test_add_state_declarations_validate():
+    class _M(Metric):
+        full_state_update = False
+
+        def __init__(self, **kw):
+            super().__init__()
+            self.add_state("total", jnp.zeros((4,), jnp.float32),
+                           dist_reduce_fx="sum", **kw)
+
+        def update(self, x):
+            self.total = self.total + x
+
+        def compute(self):
+            return self.total
+
+    m = _M(sync_transport="bf16", sync_tolerance=0.04)
+    assert m.sync_transports == {"total": "bf16"}
+    assert m.sync_tolerances == {"total": 0.04}
+    assert _M().sync_transports == {}
+    with pytest.raises(Exception, match="sync_transport"):
+        _M(sync_transport="fp4")
+    with pytest.raises(Exception, match="sync_tolerance"):
+        _M(sync_transport="bf16", sync_tolerance=-0.1)
+
+
+# ------------------------------------------------------- metric integration --
+class _QuantMetric(Metric):
+    """A metric declaring a quantized transport on its sum state."""
+
+    full_state_update = False
+
+    def __init__(self, transport=None, tolerance=None):
+        super().__init__(compiled_compute=False)
+        kw = {}
+        if transport is not None:
+            kw["sync_transport"] = transport
+        if tolerance is not None:
+            kw["sync_tolerance"] = tolerance
+        self.add_state("total", jnp.zeros((32,), jnp.float32),
+                       dist_reduce_fx="sum", **kw)
+        self.add_state("n", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + x
+        self.n = self.n + 1.0
+
+    def compute(self):
+        return self.total / jnp.maximum(self.n, 1.0)
+
+
+@pytest.mark.mesh8
+def test_metric_sync_states_honors_declaration(mesh):
+    rng = np.random.default_rng(5)
+    xs = jnp.asarray(rng.uniform(0.5, 2.0, (WORLD, 32)).astype(np.float32))
+
+    def run(m):
+        def body(x):
+            state = m.update_state(m.init_state(), x[0])
+            synced = m.sync_states(state, axis_name="data")
+            return jax.tree_util.tree_map(lambda v: jnp.expand_dims(v, 0), synced)
+
+        return jax.jit(
+            shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_rep=False)
+        )(xs)
+
+    exact = run(_QuantMetric())
+    quant = run(_QuantMetric(transport="int8"))
+    got, want = np.asarray(quant["total"][0]), np.asarray(exact["total"][0])
+    assert _rel_err(got, want) <= transport_error_bound("int8", WORLD)
+    assert 0.0 < _rel_err(got, want)  # it actually quantized
+    # the undeclared state shares no bucket with the declared one: bitwise
+    np.testing.assert_array_equal(np.asarray(quant["n"]), np.asarray(exact["n"]))
+
+
+def test_transport_is_config_not_state(tmp_path):
+    """Checkpoints interchange across transport declarations — the transport
+    never reaches the state pytree or the fingerprint."""
+    a, b = _QuantMetric(transport="int8", tolerance=0.05), _QuantMetric()
+    a.update(jnp.full((32,), 2.0))
+    path = tmp_path / "ckpt"
+    metrics_tpu.save_checkpoint(a, str(path))
+    metrics_tpu.restore_checkpoint(b, str(path))
+    for name in ("total", "n"):
+        np.testing.assert_array_equal(
+            np.asarray(a.get_state()[name]), np.asarray(b.get_state()[name]))
+    # and the reverse direction: undeclared -> declared
+    metrics_tpu.save_checkpoint(b, str(path))
+    metrics_tpu.restore_checkpoint(a, str(path))
+    assert a.sync_transports == {"total": "int8"}  # declaration untouched

@@ -250,6 +250,45 @@ class TestPrometheusRoundTrip:
         assert families["metrics_tpu_ingest_queue_depth"]["type"] == "gauge"
         assert families["metrics_tpu_ingest_dispatch_retries_total"]["type"] == "counter"
 
+    def test_sync_transport_series_parse_strictly(self):
+        """The transport layer's wire accounting: tracing a quantized sync
+        ticks metrics_tpu_sync_wire_bytes_total / _logical_bytes_total (and,
+        on a refused bucket, _transport_refusals_total) in the process
+        registry — all three parse through the strict exposition."""
+        import jax
+        import jax.numpy as jnp
+        from metrics_tpu.observability.instruments import get_registry
+        from metrics_tpu.parallel.sync import sync_state
+
+        get_registry().clear()
+        try:
+            state = {"c": jnp.zeros((256,), jnp.int32), "f": jnp.zeros((64,), jnp.float32)}
+            reds = {"c": "sum", "f": "sum"}
+            jax.make_jaxpr(
+                lambda st: sync_state(
+                    st, reds, "data",
+                    transports={"c": "bf16", "f": "bf16"},
+                    tolerances={"f": 1e-6},  # refused: bound >> tolerance
+                ),
+                axis_env=[("data", 8)],
+            )(state)
+            text = obs.to_prometheus_text(get_registry())
+            families, samples = _StrictPromParser().parse(text)
+            by = {}
+            for name, labels, value in samples:
+                by[(name, tuple(sorted(labels.items())))] = value
+            assert by[("metrics_tpu_sync_wire_bytes_total", (("transport", "bf16"),))] == 512.0
+            assert by[("metrics_tpu_sync_logical_bytes_total", (("transport", "bf16"),))] == 1024.0
+            # the refused f32 bucket crossed exact at full width
+            assert by[("metrics_tpu_sync_wire_bytes_total", (("transport", "exact"),))] == 256.0
+            assert by[(
+                "metrics_tpu_sync_transport_refusals_total",
+                (("reason", "error_budget"), ("transport", "bf16")),
+            )] == 1.0
+            assert families["metrics_tpu_sync_wire_bytes_total"]["type"] == "counter"
+        finally:
+            get_registry().clear()
+
     def test_awkward_label_values_round_trip(self):
         reg = InstrumentRegistry()
         awkward = 'quote " backslash \\ newline \n tab\tdone'

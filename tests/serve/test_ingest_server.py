@@ -199,6 +199,130 @@ class TestBackpressure:
             server.stop(drain=False, timeout=2.0)
 
 
+class TestBatchedIngest:
+    """One request, many steps: ``application/x-npz`` bodies with a leading
+    step axis are sliced back into per-step observations and admitted in
+    order, so ``offline_replay`` stays the bitwise oracle."""
+
+    def test_batched_post_matches_offline_replay_bitwise(self):
+        server = msv.IngestServer(_factory(), queue_capacity=256).start()
+        try:
+            client = msv.IngestClient(server.url)
+            rng = np.random.default_rng(11)
+            steps = 6
+            preds = rng.integers(0, 4, (steps, 8)).astype(np.int32)
+            target = rng.integers(0, 4, (steps, 8)).astype(np.int32)
+            doc = client.post_steps("t0", preds, target)
+            assert doc["admitted"], doc
+            assert doc["steps"] == steps
+            assert doc["admitted_steps"] == steps
+            assert doc["seqs"] == sorted(doc["seqs"]) and len(doc["seqs"]) == steps
+            assert doc["seq"] == doc["seqs"][-1]
+            assert server.drain(30.0)
+
+            log = [("t0", (preds[i], target[i]), {}) for i in range(steps)]
+            expect = msv.offline_replay(_factory, log)
+            read = client.read("t0", max_staleness_steps=0, timeout_s=10)
+            assert read["last_applied_step"] == steps
+            for name, want in expect["t0"].items():
+                got = np.asarray(read["values"][name], dtype=want.dtype)
+                assert np.array_equal(got, want), name
+        finally:
+            server.stop(drain=False)
+
+    def test_batched_and_single_posts_reach_the_same_state(self):
+        rng = np.random.default_rng(13)
+        steps = 4
+        preds = rng.integers(0, 4, (steps, 8)).astype(np.int32)
+        target = rng.integers(0, 4, (steps, 8)).astype(np.int32)
+        results = {}
+        for mode in ("single", "batched"):
+            server = msv.IngestServer(_factory()).start()
+            try:
+                client = msv.IngestClient(server.url)
+                if mode == "single":
+                    for i in range(steps):
+                        doc = client.post("t0", preds[i], target[i])
+                        assert doc["admitted"], doc
+                        assert "steps" not in doc  # single-step shape unchanged
+                else:
+                    assert client.post_steps("t0", preds, target)["admitted"]
+                assert server.drain(10.0)
+                results[mode] = client.read("t0", max_staleness_steps=0)["values"]
+            finally:
+                server.stop(drain=False)
+        for name in results["single"]:
+            a = np.asarray(results["single"][name])
+            b = np.asarray(results["batched"][name], dtype=a.dtype)
+            assert np.array_equal(a, b), name
+
+    def test_partial_rejection_reports_the_admitted_prefix(self):
+        server = msv.IngestServer(
+            _factory(), queue_capacity=2, per_tenant_cap=64, retry_after_s=1.5)
+        server._life.start()  # HTTP up; dispatcher intentionally not started
+        try:
+            client = msv.IngestClient(server.url)
+            x = np.zeros((5, 4), np.int32)
+            doc = client.post_steps("t0", x, x)
+            assert doc["status"] == 429 and doc["reason"] == "queue_full"
+            assert doc["steps"] == 5
+            assert doc["admitted_steps"] == 2
+            assert len(doc["seqs"]) == 2
+            assert doc["retry_after_s"] > 0
+        finally:
+            server.stop(drain=False, timeout=1.0)
+
+    def test_batched_post_during_drain_is_rejected_loudly(self):
+        server = msv.IngestServer(_factory()).start()
+        try:
+            client = msv.IngestClient(server.url)
+            server.pipeline.queue.close()
+            x = np.zeros((3, 4), np.int32)
+            doc = client.post_steps("t0", x, x)
+            assert doc["status"] == 503 and doc["reason"] == "draining"
+            assert doc["steps"] == 3 and doc["admitted_steps"] == 0
+        finally:
+            server.stop(drain=False, timeout=2.0)
+
+    def test_malformed_batched_bodies_answer_400(self):
+        import io
+        import urllib.request
+
+        server = msv.IngestServer(_factory()).start()
+        try:
+            # client-side validation refuses mismatched leading axes outright
+            with pytest.raises(ValueError, match="leading step axis"):
+                msv.encode_npz_steps(np.zeros((3, 4)), np.zeros((2, 4)))
+            with pytest.raises(ValueError, match="at least one array"):
+                msv.encode_npz_steps()
+            # a hand-crafted body lying about its step count answers 400
+            buf = io.BytesIO()
+            np.savez(buf, __steps__=np.asarray(3, np.int64),
+                     arg0=np.zeros((2, 4), np.int32))
+            req = urllib.request.Request(
+                f"{server.url}/ingest/t0", data=buf.getvalue(),
+                headers={"Content-Type": "application/x-npz"}, method="POST")
+            import urllib.error
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc.value.code == 400
+            assert "leading step axis" in exc.value.read().decode()
+        finally:
+            server.stop(drain=False)
+
+    def test_decode_steps_single_body_passthrough(self):
+        body = msv.encode_npz(np.arange(4), kw=np.ones(2))
+        steps, batched = msv.decode_steps("application/x-npz", body)
+        assert not batched and len(steps) == 1
+        (args, kwargs), = steps
+        assert np.array_equal(args[0], np.arange(4))
+        assert np.array_equal(kwargs["kw"], np.ones(2))
+        body = msv.encode_npz_steps(np.arange(6).reshape(3, 2))
+        steps, batched = msv.decode_steps("application/x-npz", body)
+        assert batched and len(steps) == 3
+        assert np.array_equal(steps[2][0][0], np.asarray([4, 5]))
+
+
 class TestGracefulDrain:
     def test_drain_applies_every_admitted_batch(self):
         server = msv.IngestServer(_factory(), queue_capacity=256).start()

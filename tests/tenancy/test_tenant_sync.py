@@ -127,6 +127,64 @@ class TestNumericParity:
                 )
 
 
+class TestTransports:
+    """Quantized transports fold into the stacked buckets the same way: the
+    collective count per transport stays independent of capacity N, and a
+    per-state declaration on the template reaches the stacked sync."""
+
+    def _count_with(self, capacity, n_admit, transport):
+        ts, _ = _tenant_set(capacity, n_admit)
+        reductions = {
+            lname: {n: ts.template._metrics[lname]._reductions[n] for n in st}
+            for lname, st in ts.stacked_states.items()
+        }
+        transports = {"mean": {"total": transport, "count": transport}}
+        with count_collectives() as box:
+            jax.make_jaxpr(
+                lambda st: sync_stacked_states(
+                    st, reductions, "data", transports=transports
+                ),
+                axis_env=[("data", 8)],
+            )(ts.stacked_states)
+        return box
+
+    @pytest.mark.parametrize("transport", ["bf16", "int8"])
+    def test_count_independent_of_capacity_per_transport(self, transport):
+        b_small = self._count_with(16, 3, transport)
+        b_large = self._count_with(1024, 37, transport)
+        assert b_small["count"] == b_large["count"]
+        assert b_small["by_kind"] == b_large["by_kind"]
+        assert transport in b_small["bytes_by_transport"]
+        # quantized wire bytes still scale with N, at the reduced width
+        small_w = b_small["bytes_by_transport"][transport]["wire"]
+        large_w = b_large["bytes_by_transport"][transport]["wire"]
+        assert large_w > small_w
+
+    def test_template_declaration_reaches_stacked_sync(self):
+        class DeclaredMean(TinyMean):
+            def __init__(self, **kw):
+                Metric.__init__(self, **kw)
+                self.add_state("total", default=jnp.zeros((), jnp.float32),
+                               dist_reduce_fx="sum", sync_transport="bf16")
+                self.add_state("count", default=jnp.zeros((), jnp.float32),
+                               dist_reduce_fx="sum", sync_transport="bf16")
+
+        ts = mt.TenantSet(
+            mt.MetricCollection({"mean": DeclaredMean(), "mx": TinyMax()}),
+            capacity=16,
+        )
+        ts.admit("a")
+        ts.update(["a"], jnp.ones((1, 4), jnp.float32))
+        with count_collectives() as box:
+            jax.make_jaxpr(
+                lambda st: ts.sync_states(st, "data"), axis_env=[("data", 8)]
+            )(ts.stacked_states)
+        assert "bf16" in box["bytes_by_transport"]
+        bf16 = box["bytes_by_transport"]["bf16"]
+        assert bf16["wire"] * 2 == bf16["logical"]
+        assert box["refusals"] == []
+
+
 class TestErrors:
     def test_non_elementwise_reduction_raises(self):
         states = {"m": {"buf": jnp.zeros((4, 2), jnp.float32)}}
