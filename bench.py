@@ -2095,6 +2095,311 @@ def _quantized_sync_child() -> None:
     )
 
 
+def _incremental_sync_child() -> None:
+    """``--child incremental_sync``: the in-streak emission protocol on the
+    8-device CPU mesh.
+
+    Two configs — the merged config2 state (one int32-sum fold bucket) and a
+    4096-class ConfusionMatrix (trace-time accounting only: 64 MiB payload) —
+    each run as a 16-step streak, deferred (one finalize-time sync burst)
+    versus incremental at cadence K in {1, 4, 16}. Records trace-time
+    collective counts/bytes per emission and at finalize (the finalize-burst
+    elimination claim), measured streak wall time both ways, the retrace count
+    after warmup (the recompiles-0 gate), and the async-save overlap timings
+    (caller-blocked seconds with and without ``overlap_copy``)."""
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu import (
+        Accuracy, ConfusionMatrix, F1Score, MetricCollection, Precision, Recall,
+        save_checkpoint,
+    )
+    from metrics_tpu.parallel.sync import (
+        advance_incremental, count_collectives, finalize_incremental_state,
+        init_incremental, sync_state,
+    )
+
+    world = 8
+    steps = 16
+    devices = jax.devices()
+    if len(devices) < world:
+        raise RuntimeError(f"expected {world} forced host devices, got {len(devices)}")
+    mesh = Mesh(np.asarray(devices[:world]), ("data",))
+    rng = np.random.default_rng(0)
+
+    # ---- config2: merged member states, one flat dict (the fused sync) -----
+    coll = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES, average="micro"),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "precision": Precision(num_classes=NUM_CLASSES, average="macro"),
+            "recall": Recall(num_classes=NUM_CLASSES, average="macro"),
+        }
+    )
+    logits = jnp.asarray(rng.normal(size=(BATCH, NUM_CLASSES)), dtype=jnp.float32)
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=jnp.int32)
+    coll.update(logits, target)
+    flat_state, flat_reds = {}, {}
+    for mname, m in coll.items():
+        for sname, leaf in m.metric_state.items():
+            flat_state[f"{mname}.{sname}"] = jnp.asarray(leaf)
+            flat_reds[f"{mname}.{sname}"] = m._reductions[sname]
+
+    def _step_state(st):
+        # cheap, dtype-preserving elementwise advance standing in for the
+        # member update programs of the donated streak
+        return {k: v + jnp.ones_like(v) for k, v in st.items()}
+
+    def measured_config(state, reds):
+        modes = {k: "incremental" for k in state}
+        stacked = jax.tree_util.tree_map(
+            lambda a: jnp.stack([a * (i + 1) for i in range(world)]), state
+        )
+        smap = dict(mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_rep=False)
+
+        fin_def = []
+        def run_def(s):
+            st = jax.tree_util.tree_map(lambda x: x[0], s)
+            for _ in range(steps):
+                st = _step_state(st)
+            with count_collectives() as box:
+                out = sync_state(st, reds, "data")
+            fin_def.append({"collectives": box["count"], "bytes": box["bytes"]})
+            return jax.tree_util.tree_map(lambda x: jnp.expand_dims(x, 0), out)
+
+        f_def = jax.jit(shard_map(run_def, **smap))
+        jax.block_until_ready(f_def(stacked))
+        def_ms = min(_timed(lambda: jax.block_until_ready(f_def(stacked))) for _ in range(5)) * 1e3
+        record = {
+            "deferred": {
+                "streak_ms": round(def_ms, 3),
+                "finalize_collectives": fin_def[-1]["collectives"],
+                "finalize_bytes": fin_def[-1]["bytes"],
+            },
+            "incremental": {},
+        }
+
+        for k in (1, 4, 16):
+            traces = {"n": 0}
+            emit_boxes, fin_boxes = [], []
+
+            def run_incr(s, _k=k, _traces=traces, _emit=emit_boxes, _fin=fin_boxes):
+                _traces["n"] += 1
+                local = jax.tree_util.tree_map(lambda x: x[0], s)
+                carry = init_incremental(local, reds, modes=modes, sync_every=_k)
+                emits = []
+                for _ in range(steps):
+                    st = _step_state(carry.state)
+                    with count_collectives() as box:
+                        carry = advance_incremental(carry, st, reds, "data", modes=modes)
+                    if box["count"]:
+                        emits.append({"collectives": box["count"], "bytes": box["bytes"]})
+                with count_collectives() as box:
+                    out = finalize_incremental_state(carry, reds, "data", modes=modes)
+                _emit.append(emits)
+                _fin.append({"collectives": box["count"], "bytes": box["bytes"]})
+                return jax.tree_util.tree_map(lambda x: jnp.expand_dims(x, 0), out)
+
+            f_incr = jax.jit(shard_map(run_incr, **smap))
+            jax.block_until_ready(f_incr(stacked))
+            incr_ms = min(_timed(lambda: jax.block_until_ready(f_incr(stacked))) for _ in range(5)) * 1e3
+            emits = emit_boxes[-1]
+            record["incremental"][f"k{k}"] = {
+                "streak_ms": round(incr_ms, 3),
+                "emissions": len(emits),
+                "per_emission_collectives": emits[-1]["collectives"] if emits else 0,
+                "per_emission_bytes": emits[-1]["bytes"] if emits else 0,
+                "finalize_collectives": fin_boxes[-1]["collectives"],
+                "finalize_bytes": fin_boxes[-1]["bytes"],
+                # 7 warm calls total: any retrace after the first is a broken
+                # static-signature set (the carry must not re-key per step)
+                "retraces_after_warm": traces["n"] - 1,
+            }
+        d = record["deferred"]
+        k1 = record["incremental"]["k1"]
+        record["finalize_burst_reduction_x"] = round(
+            d["finalize_bytes"] / max(1, k1["finalize_bytes"]), 3
+        )
+        return record
+
+    config2 = measured_config(flat_state, flat_reds)
+
+    # ---- confmat-4096: trace-time accounting only (64 MiB payload) ---------
+    cm = ConfusionMatrix(num_classes=4096)
+    cm.update(
+        jnp.asarray(rng.integers(0, 4096, size=(8192,)), dtype=jnp.int32),
+        jnp.asarray(rng.integers(0, 4096, size=(8192,)), dtype=jnp.int32),
+    )
+    cm_state = {k: jnp.asarray(v) for k, v in cm.metric_state.items()}
+    cm_reds = dict(cm._reductions)
+    cm_modes = {k: "incremental" for k in cm_state}
+
+    def trace_confmat(k):
+        emit_boxes, fin_boxes = [], []
+
+        def streak(st0):
+            carry = init_incremental(dict(st0), cm_reds, modes=cm_modes, sync_every=k)
+            for _ in range(steps):
+                st = _step_state(carry.state)
+                with count_collectives() as box:
+                    carry = advance_incremental(carry, st, cm_reds, "data", modes=cm_modes)
+                if box["count"]:
+                    emit_boxes.append({"collectives": box["count"], "bytes": box["bytes"]})
+            with count_collectives() as box:
+                return finalize_incremental_state(carry, cm_reds, "data", modes=cm_modes), fin_boxes.append(
+                    {"collectives": box["count"], "bytes": box["bytes"]}
+                )
+
+        jax.make_jaxpr(lambda st: streak(st)[0], axis_env=[("data", world)])(cm_state)
+        return {
+            "emissions": len(emit_boxes),
+            "per_emission_bytes": emit_boxes[-1]["bytes"] if emit_boxes else 0,
+            "finalize_collectives": fin_boxes[-1]["collectives"],
+            "finalize_bytes": fin_boxes[-1]["bytes"],
+        }
+
+    with count_collectives() as box:
+        jax.make_jaxpr(
+            lambda st: sync_state(st, cm_reds, "data"), axis_env=[("data", world)]
+        )(cm_state)
+    confmat = {
+        "deferred": {"finalize_collectives": box["count"], "finalize_bytes": box["bytes"]},
+        "incremental": {f"k{k}": trace_confmat(k) for k in (1, 4, 16)},
+    }
+
+    # ---- async-save overlap: caller-blocked seconds with/without ----------
+    acc = Accuracy(num_classes=NUM_CLASSES)
+    acc.update(logits, target)
+    with tempfile.TemporaryDirectory() as tmp:
+        h_plain = save_checkpoint(acc, os.path.join(tmp, "plain"), blocking=False)
+        h_plain.wait()
+        h_overlap = save_checkpoint(
+            acc, os.path.join(tmp, "overlap"), blocking=False, overlap_copy=True
+        )
+        h_overlap.wait()
+    overlap = {
+        "plain_caller_blocked_s": round(
+            h_plain.timings["snapshot_s"] + h_plain.timings["host_copy_s"], 6
+        ),
+        "overlap_caller_blocked_s": round(
+            h_overlap.timings["snapshot_s"] + h_overlap.timings["copy_enqueue_s"], 6
+        ),
+        "plain_host_copy_s": round(h_plain.timings["host_copy_s"], 6),
+        "overlap_copy_enqueue_s": round(h_overlap.timings["copy_enqueue_s"], 6),
+        "overlap_thread_host_copy_s": round(h_overlap.timings["host_copy_s"], 6),
+    }
+
+    print(
+        json.dumps({
+            "world": world,
+            "steps": steps,
+            "config2": config2,
+            "confmat_4096": confmat,
+            "overlap_save": overlap,
+        }),
+        flush=True,
+    )
+
+
+def bench_incremental_sync() -> None:
+    """``--incremental-sync``: the in-streak emission protocol versus the
+    deferred finalize burst (config2 merged state and confmat-4096, cadence
+    K in {1, 4, 16}) plus the async-save overlap gain; recorded into
+    ``BENCH_r20.json`` and judged by the regression watchdog. Host-side CPU
+    bench (forced device count in a child process).
+
+    Hard gates: zero finalize collectives at every cadence that divides the
+    streak (the residue proof), finalize-burst byte reduction >= 2x on
+    config2's fully-mergeable buckets, and zero retraces after warmup at
+    every cadence (the bounded carry-signature claim)."""
+    import glob as _glob
+
+    from metrics_tpu.observability import regress as _regress
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    child = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", "incremental_sync"],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=1500.0,
+        cwd=REPO,
+    )
+    if child.returncode != 0:
+        raise RuntimeError(f"incremental-sync child failed:\n{child.stderr[-2000:]}")
+    mesh8 = json.loads(child.stdout.strip().splitlines()[-1])
+
+    c2 = mesh8["config2"]
+    record = {
+        # headline: the full 16-step incremental streak at K=1 — emissions
+        # inside the donated streak, residue-free finalize; lower is better
+        "metric": "incremental_sync_config2_k1_streak_ms",
+        "value": c2["incremental"]["k1"]["streak_ms"],
+        "unit": "ms",
+        "extra": {
+            "world": mesh8["world"],
+            "steps": mesh8["steps"],
+            "config2_deferred_streak_ms": c2["deferred"]["streak_ms"],
+            "config2_finalize_burst_reduction_x": c2["finalize_burst_reduction_x"],
+            "config2": c2,
+            "confmat_4096": mesh8["confmat_4096"],
+            "overlap_save": mesh8["overlap_save"],
+        },
+    }
+
+    # watchdog self-check: judge this round against the checked-in trajectory
+    rounds = [
+        r
+        for r in _regress.load_rounds(sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json"))))
+        if r.name != "r20"
+    ]
+    rounds.append(_regress.Round("r20", "<this-run>", record))
+    report = _regress.check_trajectory(rounds)
+    record["extra"]["regress"] = {
+        "ok": report.ok,
+        "regression_count": len(report.regressions),
+        "keys_checked": report.keys_checked,
+        "regressions": [r.describe() for r in report.regressions],
+    }
+
+    with open(os.path.join(REPO, "BENCH_r20.json"), "w") as fh:
+        json.dump(record, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(record), flush=True)
+
+    problems = []
+    for k in ("k1", "k4", "k16"):
+        for cfg_name, cfg in (("config2", c2), ("confmat_4096", mesh8["confmat_4096"])):
+            fin = cfg["incremental"][k]["finalize_collectives"]
+            if fin != 0:
+                problems.append(
+                    f"{cfg_name} {k}: finalize still pays {fin} collectives "
+                    "(cadence divides the streak — residue must be empty)"
+                )
+        retraces = c2["incremental"][k]["retraces_after_warm"]
+        if retraces != 0:
+            problems.append(f"config2 {k}: {retraces} retraces after warmup (want 0)")
+    if c2["finalize_burst_reduction_x"] < 2.0:
+        problems.append(
+            f"config2 finalize-burst reduction {c2['finalize_burst_reduction_x']}x < 2x"
+        )
+    if not report.ok:
+        problems.extend(r.describe() for r in report.regressions)
+    if problems:
+        print("[bench] incremental-sync round FAILED its gates:", file=sys.stderr)
+        for p in problems:
+            print(f"[bench]   {p}", file=sys.stderr)
+        sys.exit(1)
+
+
 def bench_quantized_sync() -> None:
     """``--quantized-sync``: wire-byte reduction and measured quantization
     error of the bf16/int8 (and sparse_count) sync transports on the 8-device
@@ -3344,8 +3649,17 @@ def main() -> None:
         "the E112 bound",
     )
     parser.add_argument(
+        "--incremental-sync",
+        action="store_true",
+        help="measure the in-streak incremental emission protocol vs the "
+        "deferred finalize burst (config2 + confmat-4096, cadence K in "
+        "{1,4,16}) and the async-save overlap gain on the 8-device mesh; "
+        "record into BENCH_r20.json; gates: zero finalize collectives, "
+        "burst byte reduction >= 2x, zero retraces after warmup",
+    )
+    parser.add_argument(
         "--child",
-        choices=["sync_overhead", "sharded_state", "sharded_compute", "quantized_sync", *_CHILD_BENCHES],
+        choices=["sync_overhead", "sharded_state", "sharded_compute", "quantized_sync", "incremental_sync", *_CHILD_BENCHES],
     )
     parser.add_argument(
         "--sync-scaling",
@@ -3395,6 +3709,9 @@ def main() -> None:
     if args.quantized_sync:
         bench_quantized_sync()
         return
+    if args.incremental_sync:
+        bench_incremental_sync()
+        return
     if args.sync_scaling:
         out = {}
         for w in (2, 4, 8, 16):
@@ -3417,6 +3734,9 @@ def main() -> None:
         return
     if args.child == "quantized_sync":
         _quantized_sync_child()
+        return
+    if args.child == "incremental_sync":
+        _incremental_sync_child()
         return
     if args.child in _CHILD_BENCHES:
         import jax

@@ -89,7 +89,11 @@ from metrics_tpu.image import (  # noqa: F401
 from metrics_tpu.parallel import (  # noqa: F401
     bucketed_sync_enabled,
     set_bucketed_sync,
+    set_sync_cadence,
+    set_sync_mode,
     set_sync_transport,
+    sync_cadence_default,
+    sync_mode_default,
     sync_transport_default,
     transport_error_bound,
 )
@@ -153,6 +157,7 @@ __all__ = [
     "set_probation", "probation_cooldown",
     "set_bucketed_sync", "bucketed_sync_enabled",
     "set_sync_transport", "sync_transport_default", "transport_error_bound",
+    "set_sync_mode", "sync_mode_default", "set_sync_cadence", "sync_cadence_default",
     # checkpoint
     "checkpoint", "save_checkpoint", "restore_checkpoint", "verify_checkpoint",
     # observability (event tracer, instrument registry, exporters)

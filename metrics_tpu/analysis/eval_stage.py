@@ -538,6 +538,56 @@ def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Findi
                 )
             )
 
+    # ------------------------------------------------------ incremental leg --
+    # E113: incremental mode is in play but this metric's whole compute group
+    # still finalizes as one deferred burst despite every leaf being
+    # emission-eligible. Shares the runtime's pure incremental_plan — a
+    # deferred routing reported here IS the runtime routing.
+    modes = dict(getattr(inst, "_sync_modes", {}) or {})
+    if isinstance(state, dict) and state and (
+        modes or _sync.sync_mode_default() == "incremental"
+    ):
+        iplan = _sync.incremental_plan(
+            state,
+            dict(inst._reductions),
+            modes=modes,
+            shard_axes=inst.active_shard_axes,
+        )
+        engaged = [n for n, e in iplan.items() if e["mode"] == "incremental"]
+        all_eligible = all(e["eligible"] for e in iplan.values())
+        if all_eligible and not engaged:
+            residue: Dict[Tuple[str, str], List[str]] = {}
+            for n, e in iplan.items():
+                key = (str(inst._reductions.get(n)), str(getattr(state[n], "dtype", "?")))
+                residue.setdefault(key, []).append(n)
+            buckets = [
+                {"reduction": red, "dtype": dt, "states": names}
+                for (red, dt), names in sorted(residue.items())
+            ]
+            bucket_desc = ", ".join(
+                "{}/{}".format(b["reduction"], b["dtype"]) for b in buckets
+            )
+            findings.append(
+                Finding(
+                    rule="E113",
+                    obj=entry.name,
+                    message=(
+                        f"every state leaf is mergeable-elementwise (fully "
+                        f"emission-eligible), but under the resolved sync modes "
+                        f"none takes in-streak emissions — compute() still pays "
+                        f"{len(buckets)} deferred residue bucket(s) ({bucket_desc}) "
+                        "in one finalize burst; declare add_state(..., "
+                        "sync_mode='incremental') or set_sync_mode('incremental') "
+                        "to move them into the donated streak"
+                    ),
+                    extra={
+                        "residue_buckets": buckets,
+                        "declared_modes": dict(modes),
+                        "global_mode": _sync.sync_mode_default(),
+                    },
+                )
+            )
+
     # ----------------------------------------------------- fused compute leg --
     try:
         jax.make_jaxpr(

@@ -211,6 +211,19 @@ _EVAL_RULES = (
         "tolerance (add_state(..., sync_tolerance=)), pick a cheaper-error "
         "transport, or drop the declaration.",
     ),
+    Rule(
+        "E113", "incremental-sync-residue", WARNING,
+        "incremental sync mode is in play (set_sync_mode / METRICS_TPU_SYNC_MODE "
+        "or a per-state sync_mode declaration) and every state leaf of this "
+        "metric is mergeable-elementwise — fully emission-eligible — yet no "
+        "leaf resolves to the incremental path, so the compute group still "
+        "routes ALL of its collectives at finalize as one deferred burst; "
+        "per-state sync_mode='deferred' declarations (or relying on a global "
+        "'deferred' default while declaring it only elsewhere) are pinning "
+        "fully-mergeable buckets to the residue set. Declare "
+        "add_state(..., sync_mode='incremental') or widen set_sync_mode to "
+        "move these buckets into the donated streak.",
+    ),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in (*_AST_RULES, *_EVAL_RULES)}

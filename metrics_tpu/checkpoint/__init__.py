@@ -129,8 +129,14 @@ class SaveHandle:
     (npz + sidecar + fsync into the pending dir), ``commit_s`` (manifest +
     atomic rename), ``total_s`` — recorded for every save (blocking or async;
     the write/commit entries appear once the background thread finishes, so
-    read them after ``wait()``). This is the baseline the ROADMAP's "overlap
-    async save with the next update step" item needs to beat.
+    read them after ``wait()``).
+
+    With ``save_checkpoint(..., blocking=False, overlap_copy=True)`` the
+    device→host transfer itself moves off the caller's critical path: the
+    caller pays only ``copy_enqueue_s`` (starting the async D2H transfers)
+    and ``host_copy_s`` is recorded from the background thread, overlapping
+    the next update step — the fused-collective overlap idea applied to
+    checkpointing (docs/incremental_sync.md#overlapping-async-saves).
     """
 
     root: str
@@ -188,6 +194,7 @@ def save_checkpoint(
     shard_index: Optional[int] = None,
     world_size: Optional[int] = None,
     blocking: bool = True,
+    overlap_copy: bool = False,
 ) -> SaveHandle:
     """Snapshot this host's shard of a Metric / MetricCollection.
 
@@ -197,9 +204,27 @@ def save_checkpoint(
     write + commit attempt run on a daemon thread — call ``handle.wait()``
     before relying on the snapshot. The snapshot becomes visible to readers
     only once every host's shard landed and one of them committed.
+
+    ``overlap_copy=True`` (async saves only) additionally overlaps the
+    device→host copy with the caller's next update step: the caller enqueues
+    non-blocking D2H transfers (``copy_to_host_async``) and returns
+    immediately; the background thread drains them before writing. Safe
+    against donation by construction — the handle's closure keeps references
+    to the device buffers, which pushes their refcount past the engines'
+    donation guard (``_DONATION_MAX_REFS``), so the next donated step copies
+    those leaves instead of aliasing them. Timings: the caller-side cost
+    shows up as ``copy_enqueue_s`` and the actual transfer as ``host_copy_s``
+    measured on the thread; the ``ckpt/overlap_copy`` tracer span records the
+    overlapped drain.
     """
     import jax
 
+    if overlap_copy and blocking:
+        raise ValueError(
+            "save_checkpoint: overlap_copy=True requires blocking=False — a "
+            "blocking save waits for the write anyway, there is nothing to "
+            "overlap the device->host copy with"
+        )
     if world_size is None:
         try:
             world_size = jax.process_count()
@@ -216,21 +241,47 @@ def save_checkpoint(
     t0 = time.perf_counter()
     payload, shard_meta = build_shard(obj)
     t1 = time.perf_counter()
-    payload = _host_copy(payload)
-    t2 = time.perf_counter()
     handle = SaveHandle(root=root, step=int(step), shard_index=shard_index, world_size=world_size)
     handle.timings["snapshot_s"] = t1 - t0
-    handle.timings["host_copy_s"] = t2 - t1
-    payload_bytes = sum(int(v.nbytes) for v in payload.values())
-    if _otrace.active:
-        _emit_phase("checkpoint/save/snapshot", t0, t1, step=handle.step, leaves=len(payload))
-        _emit_phase("checkpoint/save/host_copy", t1, t2, step=handle.step, bytes=payload_bytes)
+    if overlap_copy:
+        # start non-blocking D2H transfers and keep the *device* references in
+        # the payload: the background thread drains them while the caller's
+        # next step runs. Holding these references is what makes this safe —
+        # the engines' donation guard skips any leaf whose refcount exceeds
+        # _DONATION_MAX_REFS, so a donated next step copies rather than
+        # aliases the leaves this save still reads.
+        for v in payload.values():
+            if hasattr(v, "copy_to_host_async"):
+                v.copy_to_host_async()
+        t2 = time.perf_counter()
+        handle.timings["copy_enqueue_s"] = t2 - t1
+        payload_bytes = sum(int(getattr(v, "nbytes", 0)) for v in payload.values())
+        if _otrace.active:
+            _emit_phase("checkpoint/save/snapshot", t0, t1, step=handle.step, leaves=len(payload))
+    else:
+        payload = _host_copy(payload)
+        t2 = time.perf_counter()
+        handle.timings["host_copy_s"] = t2 - t1
+        payload_bytes = sum(int(v.nbytes) for v in payload.values())
+        if _otrace.active:
+            _emit_phase("checkpoint/save/snapshot", t0, t1, step=handle.step, leaves=len(payload))
+            _emit_phase("checkpoint/save/host_copy", t1, t2, step=handle.step, bytes=payload_bytes)
 
     def _write() -> None:
         # on async saves this runs on the daemon thread: the tracer records
         # that thread's id, so the write/commit spans land on their own
         # Perfetto track next to the main thread's update steps
+        nonlocal payload
         try:
+            if overlap_copy:
+                h0 = time.perf_counter()
+                payload = _host_copy(payload)
+                h1 = time.perf_counter()
+                handle.timings["host_copy_s"] = h1 - h0
+                if _otrace.active:
+                    _emit_phase("ckpt/overlap_copy", h0, h1,
+                                step=handle.step, bytes=payload_bytes,
+                                enqueue_s=handle.timings["copy_enqueue_s"])
             w0 = time.perf_counter()
             write_shard(pending_dir(root, handle.step), shard_index, world_size, payload, shard_meta)
             w1 = time.perf_counter()

@@ -597,6 +597,71 @@ class MetricCollection:
             states = self.sync_states(states, axis_name)
         return self.compute_state(states)
 
+    # ------------------------------------------------------------------ #
+    # incremental sync protocol (ISSUE-15): per-group carries
+    # ------------------------------------------------------------------ #
+    def init_incremental(
+        self,
+        states: Dict[str, StateDict],
+        *,
+        sync_every: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """One :class:`~metrics_tpu.parallel.sync.IncrementalCarry` per compute
+        group, wrapping the group's starting state (from :meth:`init_state`)."""
+        return {
+            g[0]: self._metrics.__getitem__(g[0]).init_incremental(
+                states[g[0]], sync_every=sync_every
+            )
+            for g in self._groups
+        }
+
+    def update_state_incremental(
+        self,
+        carries: Dict[str, Any],
+        *args: Any,
+        axis_name: Optional[Union[str, Tuple[str, ...]]] = None,
+        **kwargs: Any,
+    ) -> Dict[str, Any]:
+        """Pure fused streak step with the in-streak emission arm: every
+        group's update plus (on cadence, with ``axis_name`` bound) its
+        per-bucket partial collectives, all in the one traceable program —
+        jit this inside your ``shard_map`` train step so the emissions
+        overlap the next step's computation."""
+        out = {}
+        for group in self._groups:
+            leader = self._metrics.__getitem__(group[0])
+            out[group[0]] = leader.update_state_incremental(
+                carries[group[0]], *args, axis_name=axis_name,
+                **leader._filter_kwargs(**kwargs),
+            )
+        return out
+
+    def finalize_incremental(
+        self,
+        carries: Dict[str, Any],
+        axis_name: Optional[Union[str, Tuple[str, ...]]] = None,
+    ) -> Dict[str, StateDict]:
+        """Pure fused incremental finalize: per group, the already-emitted
+        buckets cost nothing and only cadence tails + non-incremental residue
+        sync — bitwise identical to :meth:`sync_states` over the same final
+        states for exact transports."""
+        return {
+            g[0]: self._metrics.__getitem__(g[0]).finalize_incremental(
+                carries[g[0]], axis_name
+            )
+            for g in self._groups
+        }
+
+    def sync_compute_incremental(
+        self,
+        carries: Dict[str, Any],
+        axis_name: Optional[Union[str, Tuple[str, ...]]] = None,
+    ) -> Dict[str, Any]:
+        """Pure fused incremental finalize+compute — the incremental
+        counterpart of :meth:`sync_compute_state`."""
+        states = self.finalize_incremental(carries, axis_name)
+        return self.compute_state(states)
+
     def __getstate__(self) -> Dict[str, Any]:
         """Drop the dispatcher and fused engines (jitted executables close
         over ``self``); clones/unpickled copies rebuild them lazily."""

@@ -796,6 +796,49 @@ def classify_tenant_member(metric: Any) -> Tuple[str, str]:
     return PATH_TENANT, "stackable (fused update/compute, dense states, elementwise reductions)"
 
 
+def classify_incremental_member(metric: Any) -> Tuple[str, str]:
+    """Whether a member's compute-group states take in-streak incremental
+    emissions under the *resolved* sync mode, and why (not).
+
+    Returns ``("incremental", reason)`` when at least one state leaf routes to
+    the emission arm (the rest stay deferred residue), or ``("deferred",
+    reason)`` naming the first blocker otherwise. Runs the same pure
+    :func:`metrics_tpu.parallel.sync.incremental_plan` the runtime carries and
+    the analyzer's E113 sweep consume — one planner, no drift. Purely static:
+    only defaults' shapes/dtypes and declared config are inspected."""
+    plan = _sync.incremental_plan(
+        metric._defaults,
+        metric._reductions,
+        modes=getattr(metric, "_sync_modes", None),
+        shard_axes=metric.active_shard_axes,
+    )
+    covered = [n for n, e in plan.items() if e["mode"] == "incremental"]
+    if covered:
+        return "incremental", (
+            f"{len(covered)}/{len(plan)} state leaves take in-streak emissions"
+        )
+    if not plan:
+        return "deferred", "no registered states"
+    eligible = [n for n, e in plan.items() if e["eligible"]]
+    if eligible:
+        return "deferred", "sync mode resolves to deferred for every leaf"
+    first = next(iter(plan.values()))
+    return "deferred", first["reason"]
+
+
+def _classify_incremental_groups(coll: Any) -> Dict[str, Dict[str, str]]:
+    """Per-member incremental-sync classification map (leader decides the
+    group, like every other dispatch classification)."""
+    members: Dict[str, Dict[str, str]] = {}
+    for group in coll._groups:
+        lname = group[0]
+        path, reason = classify_incremental_member(coll._metrics[lname])
+        for name in group:
+            r = reason if name == lname else f"follows group leader {lname!r}: {reason}"
+            members[name] = {"path": path, "reason": r}
+    return members
+
+
 def _classify_update_groups(coll: Any, migrated: Dict[str, str]):
     """Partition the collection's compute groups for ``update()``.
 
@@ -1139,6 +1182,12 @@ class CollectionPartition:
     compute_eager: Tuple[str, ...]
     update_members: Dict[str, Dict[str, str]]
     compute_members: Dict[str, Dict[str, str]]
+    # incremental-sync classification (ISSUE-15): which members' groups take
+    # in-streak emissions under the resolved sync mode. Purely informational
+    # for dispatch (the emission arm lives in the pure carry protocol), but
+    # cached here so mode flips re-key the partition exactly once and
+    # steady-state streaks keep builds == 1.
+    incremental_members: Dict[str, Dict[str, str]] = field(default_factory=dict)
     # the non-fused groups, precomputed so the steady-state dispatch fast
     # path is a lookup instead of a per-call scan of coll._groups (membership
     # changes drop the dispatcher, so group identity is stable here)
@@ -1223,7 +1272,7 @@ class CollectionDispatcher:
         membership rebuild, which drops the dispatcher outright. Migrated
         members are part of the key so their eager placement is sticky."""
         coll = self.collection
-        parts = []
+        parts = [("sync_mode", _sync.sync_mode_default())]
         for group in coll._groups:
             leader = coll._metrics[group[0]]
             parts.append((
@@ -1231,6 +1280,7 @@ class CollectionDispatcher:
                 getattr(leader, "_compiled_update", None) is False,
                 bool(getattr(leader, "_batch_buckets", False)),
                 leader._state_sharding is not None,
+                tuple(sorted(getattr(leader, "_sync_modes", {}).items())),
                 group[0] in self._migrated_update,
                 group[0] in self._migrated_compute,
                 group[0] in self._migrated_tenant,
@@ -1281,6 +1331,7 @@ class CollectionDispatcher:
             update_fused=u_fused, update_bucketed=u_bucketed, update_eager=u_eager,
             compute_fused=c_fused, compute_eager=c_eager,
             update_members=u_members, compute_members=c_members,
+            incremental_members=_classify_incremental_groups(coll),
             update_rest=tuple(g for g in coll._groups if g[0] not in u_set),
             compute_rest=tuple(g for g in coll._groups if g[0] not in c_set),
             tenant_stacked=t_stacked, tenant_eager=t_eager,
@@ -1554,9 +1605,11 @@ class CollectionDispatcher:
         if part is not None:
             u_members, c_members = part.update_members, part.compute_members
             t_members = part.tenant_members
+            i_members = part.incremental_members
         else:
             _, _, _, u_members = _classify_update_groups(self.collection, self._migrated_update)
             _, _, c_members = _classify_compute_groups(self.collection, self._migrated_compute)
+            i_members = _classify_incremental_groups(self.collection)
             t_members = {}
             if self.tenant_context is not None:
                 _, _, t_members = _classify_tenant_groups(
@@ -1565,6 +1618,7 @@ class CollectionDispatcher:
         view: Dict[str, Any] = {
             "update": {name: dict(info) for name, info in u_members.items()},
             "compute": {name: dict(info) for name, info in c_members.items()},
+            "incremental": {name: dict(info) for name, info in i_members.items()},
             "builds": self.stats.builds,
             "repartitions": self.stats.repartitions,
             "migrations": self.stats.migrations,
@@ -1597,6 +1651,7 @@ def collection_partition_view(coll: Any) -> Dict[str, Any]:
     return {
         "update": u_members,
         "compute": c_members,
+        "incremental": _classify_incremental_groups(coll),
         "builds": 0, "repartitions": 0, "migrations": 0, "stable_hits": 0,
         "probations": 0, "repromotions": 0,
         "probation": {}, "last_fallback_exception": None,
@@ -1620,8 +1675,10 @@ def metric_partition_view(metric: Any) -> Dict[str, Any]:
         c_path = PATH_EAGER
         c_reason = f"runtime fallback: {engine.broken.splitlines()[0][:200]}"
         last_exc = engine.stats.last_fallback_exception or last_exc
+    i_path, i_reason = classify_incremental_member(metric)
     return {
         "update": {"path": u_path, "reason": u_reason},
         "compute": {"path": c_path, "reason": c_reason},
+        "incremental": {"path": i_path, "reason": i_reason},
         "last_fallback_exception": last_exc,
     }

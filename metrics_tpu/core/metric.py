@@ -193,6 +193,9 @@ class Metric:
         # the state means, so they stay out of checkpoint fingerprints
         self._sync_transports: Dict[str, str] = {}
         self._sync_tolerances: Dict[str, float] = {}
+        # declared per-state sync modes (ISSUE-15): "incremental" states emit
+        # in-streak partial collectives; also config-only, never fingerprinted
+        self._sync_modes: Dict[str, str] = {}
         # declared shardable state axes: name -> int or tuple of ints (grid)
         self._shard_axes: Dict[str, Union[int, Tuple[int, ...]]] = {}
         # (mesh, axis_name-or-names) once shard_state() ran
@@ -224,6 +227,7 @@ class Metric:
         shard_axis: Optional[Union[int, Tuple[int, ...]]] = None,
         sync_transport: Optional[str] = None,
         sync_tolerance: Optional[float] = None,
+        sync_mode: Optional[str] = None,
     ) -> None:
         """Register a state variable (reference: metric.py:149-217).
 
@@ -269,6 +273,16 @@ class Metric:
         (``parallel.sync.DEFAULT_TOLERANCES``), and the tightest declared
         tolerance in a bucket wins. Both are *configuration*, not state —
         checkpoints written with and without them interchange freely.
+
+        ``sync_mode`` declares when this state's collective runs: ``"deferred"``
+        (at ``compute()``, the default) or ``"incremental"`` (in-streak partial
+        emissions via the incremental carry protocol — see
+        ``docs/incremental_sync.md``). The declaration wins over the global
+        :func:`metrics_tpu.set_sync_mode` switch in *both* directions, but only
+        mergeable-elementwise dense leaves can actually take emissions —
+        ``cat``/callable/``None``/sharded states stay deferred residue
+        regardless (``incremental_plan`` reports the routing). Configuration,
+        not state, like the transport knobs.
         """
         if (
             not isinstance(default, (jnp.ndarray, np.ndarray, CatBuffer))
@@ -341,6 +355,13 @@ class Metric:
                     f"state {name!r}: sync_tolerance must be >= 0, got {sync_tolerance}"
                 )
             self._sync_tolerances[name] = sync_tolerance
+        if sync_mode is not None:
+            if sync_mode not in _sync.SYNC_MODES:
+                raise ValueError(
+                    f"state {name!r}: unknown sync_mode {sync_mode!r}; "
+                    f"expected one of {_sync.SYNC_MODES}"
+                )
+            self._sync_modes[name] = sync_mode
 
         self._defaults[name] = _copy_state_value(default)
         self._persistent[name] = persistent
@@ -364,6 +385,12 @@ class Metric:
     def sync_tolerances(self) -> Dict[str, float]:
         """Declared per-state sync error tolerances (name → relative budget)."""
         return dict(self._sync_tolerances)
+
+    @property
+    def sync_modes(self) -> Dict[str, str]:
+        """Declared per-state sync modes (name → mode); undeclared states
+        follow :func:`metrics_tpu.parallel.sync.sync_mode_default`."""
+        return dict(self._sync_modes)
 
     @property
     def shard_axes(self) -> Dict[str, Union[int, Tuple[int, ...]]]:
@@ -844,6 +871,96 @@ class Metric:
                 state = self.sync_states(state, axis_name, keep_sharded=True)
                 return self.compute_sharded_state(state, axis_name)
             state = self.sync_states(state, axis_name)
+        return self.compute_state(state)
+
+    # ------------------------------------------------------------------ #
+    # incremental sync protocol (ISSUE-15): in-streak partial collectives
+    # ------------------------------------------------------------------ #
+    def incremental_plan(self, state: Optional[StateDict] = None) -> Dict[str, Dict[str, Any]]:
+        """Pure: per-leaf incremental-sync routing under the resolved mode
+        (per-state ``add_state(sync_mode=)`` > :func:`metrics_tpu.set_sync_mode`
+        > ``METRICS_TPU_SYNC_MODE`` > ``"deferred"``). See
+        :func:`metrics_tpu.parallel.sync.incremental_plan`."""
+        if state is None:
+            state = self.metric_state
+        return _sync.incremental_plan(
+            state, self._reductions, modes=self._sync_modes,
+            shard_axes=self.active_shard_axes,
+        )
+
+    def init_incremental(
+        self, state: StateDict, *, sync_every: Optional[int] = None
+    ) -> "_sync.IncrementalCarry":
+        """Pure: wrap a streak's starting ``state`` (usually
+        :meth:`init_state`) in an :class:`~metrics_tpu.parallel.sync.IncrementalCarry`.
+        ``sync_every=K`` emits every K-th update (default:
+        :func:`metrics_tpu.parallel.sync.sync_cadence_default`)."""
+        return _sync.init_incremental(
+            state, self._reductions, modes=self._sync_modes,
+            shard_axes=self.active_shard_axes, sync_every=sync_every,
+            transports=self._sync_transports,
+        )
+
+    def update_state_incremental(
+        self,
+        carry: "_sync.IncrementalCarry",
+        *args: Any,
+        axis_name: Optional[Union[str, Tuple[str, ...]]] = None,
+        **kwargs: Any,
+    ) -> "_sync.IncrementalCarry":
+        """Pure: one streak step — :meth:`update_state` plus the in-streak
+        emission arm. With ``axis_name`` bound (inside ``shard_map``/``pmap``)
+        and the cadence due, the step emits per-bucket partial collectives and
+        folds them into the carry's synced accumulator, overlapping
+        communication with the next step's computation instead of serializing
+        it all behind the streak at ``compute()``. ``axis_name=None`` never
+        emits — the carry degrades to a plain deferred state holder, keeping
+        the facade path deferred-equivalent by construction."""
+        state = self.update_state(carry.state, *args, **kwargs)
+        return _sync.advance_incremental(
+            carry, state, self._reductions, axis_name,
+            modes=self._sync_modes, shard_axes=self.active_shard_axes,
+            transports=self._sync_transports, tolerances=self._sync_tolerances,
+        )
+
+    def finalize_incremental(
+        self,
+        carry: "_sync.IncrementalCarry",
+        axis_name: Optional[Union[str, Tuple[str, ...]]] = None,
+        keep_sharded: bool = False,
+    ) -> StateDict:
+        """Pure: the globally-synced state at the end of an incremental
+        streak. Buckets the emissions covered cost nothing here; cadence
+        tails and non-incremental residue (cat/list/CatBuffer/sharded/
+        callable leaves) sync through the ordinary deferred path — bitwise
+        identical to :meth:`sync_states` over the same final state for exact
+        transports."""
+        return _sync.finalize_incremental_state(
+            carry, self._reductions, axis_name,
+            modes=self._sync_modes, shard_axes=self.active_shard_axes,
+            transports=self._sync_transports, tolerances=self._sync_tolerances,
+            keep_sharded=keep_sharded,
+        )
+
+    def sync_compute_incremental(
+        self,
+        carry: "_sync.IncrementalCarry",
+        axis_name: Optional[Union[str, Tuple[str, ...]]] = None,
+    ) -> Any:
+        """Pure fused finalize+compute for an incremental streak — the
+        incremental counterpart of :meth:`sync_compute_state`. Keeps the
+        sharded-compute protocol: actively-sharded metrics with a
+        ``compute_sharded_state`` finalize on their local blocks (sharded
+        leaves are deferred residue under incremental mode, so the protocol
+        applies unchanged)."""
+        if axis_name is not None and (
+            isinstance(axis_name, str)
+            and self.active_shard_axes
+            and self.supports_sharded_compute
+        ):
+            state = self.finalize_incremental(carry, axis_name, keep_sharded=True)
+            return self.compute_sharded_state(state, axis_name)
+        state = self.finalize_incremental(carry, axis_name)
         return self.compute_state(state)
 
     @property

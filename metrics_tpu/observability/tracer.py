@@ -85,6 +85,7 @@ EVENT_CATALOG: Dict[str, Tuple[str, ...]] = {
     "sync": (
         "sync/bucket_build",  # one bucketed sync build (args: collective tallies)
         "sync/transport_refused",  # error-budget gate fell a bucket back to exact (args: reason)
+        "sync/incremental_emit",  # one in-streak incremental emission (args: emission, fold/replace leaves, tallies)
     ),
     "shard": (
         "shard/place",  # Metric.shard_state placement
@@ -100,6 +101,7 @@ EVENT_CATALOG: Dict[str, Tuple[str, ...]] = {
         "checkpoint/restore/apply",  # folded state applied to the live object
         "checkpoint/restore/fallback",  # newest step corrupt: older verifiable step used
         "ckpt/retry",  # one storage-op retry scheduled (or giveup) by RetryPolicy
+        "ckpt/overlap_copy",  # overlapped device->host drain on the async-save thread (args: bytes, enqueue_s)
     ),
     "chaos": (
         "chaos/fault",  # the fault-injection harness fired a scheduled fault

@@ -67,6 +67,81 @@ def set_bucketed_sync(enabled: Optional[bool]) -> None:
 
 
 # --------------------------------------------------------------------------- #
+# sync mode: deferred (finalize-burst) vs incremental (in-streak emission)
+# --------------------------------------------------------------------------- #
+# ``deferred``     every collective waits for compute() — today's exact path.
+# ``incremental``  the update streak emits per-bucket partial collectives as it
+#                  runs (every step, or every K steps via the cadence knob), so
+#                  finalize finds already-synchronized buckets and pays only
+#                  the non-incremental residue. See docs/incremental_sync.md.
+#
+# Precedence mirrors the transport layer: per-state
+# ``add_state(sync_mode=...)`` > ``set_sync_mode()`` > ``METRICS_TPU_SYNC_MODE``
+# env var > ``"deferred"``.
+SYNC_MODES = ("deferred", "incremental")
+
+_ENV_SYNC_MODE = "METRICS_TPU_SYNC_MODE"
+_ENV_SYNC_EVERY = "METRICS_TPU_SYNC_EVERY"
+_sync_mode_default: Optional[str] = None  # None = follow the environment
+_sync_cadence_default: Optional[int] = None  # None = follow the environment
+
+# Reductions whose cross-device merge is elementwise — the only buckets an
+# incremental emission can cover (cat/None/callable change layout per device).
+_ELEMENTWISE = ("sum", "mean", "max", "min")
+
+
+def sync_mode_default() -> str:
+    """The process-wide default sync mode for states with no per-state
+    declaration (``set_sync_mode`` / ``METRICS_TPU_SYNC_MODE``, ``"deferred"``
+    unless overridden)."""
+    if _sync_mode_default is not None:
+        return _sync_mode_default
+    env = os.environ.get(_ENV_SYNC_MODE, "deferred").strip().lower()
+    return env if env in SYNC_MODES else "deferred"
+
+
+def set_sync_mode(mode: Optional[str]) -> None:
+    """Set the process-wide default sync mode.
+
+    ``None`` restores the environment default (``METRICS_TPU_SYNC_MODE``,
+    ``"deferred"``). Per-state ``add_state(..., sync_mode=...)`` declarations
+    take precedence over this switch in both directions — a state declared
+    ``"incremental"`` emits even under a global ``"deferred"`` default, and a
+    state declared ``"deferred"`` never emits under a global
+    ``"incremental"``.
+    """
+    global _sync_mode_default
+    if mode is not None and mode not in SYNC_MODES:
+        raise ValueError(f"unknown sync mode {mode!r}; expected one of {SYNC_MODES}")
+    _sync_mode_default = mode
+
+
+def sync_cadence_default() -> int:
+    """The default emission cadence K (emit every K-th update of an
+    incremental streak): ``set_sync_cadence`` / ``METRICS_TPU_SYNC_EVERY``,
+    1 unless overridden. The per-carry ``sync_every=`` argument of
+    :func:`init_incremental` takes precedence."""
+    if _sync_cadence_default is not None:
+        return _sync_cadence_default
+    try:
+        k = int(os.environ.get(_ENV_SYNC_EVERY, "1"))
+    except ValueError:
+        return 1
+    return max(1, k)
+
+
+def set_sync_cadence(sync_every: Optional[int]) -> None:
+    """Set the process-wide default emission cadence for incremental sync.
+
+    ``None`` restores the environment default (``METRICS_TPU_SYNC_EVERY``, 1).
+    """
+    global _sync_cadence_default
+    if sync_every is not None and int(sync_every) < 1:
+        raise ValueError(f"sync_every must be >= 1, got {sync_every}")
+    _sync_cadence_default = None if sync_every is None else int(sync_every)
+
+
+# --------------------------------------------------------------------------- #
 # transport codecs: opt-in low-precision / compressed bucket sync (ISSUE-14)
 # --------------------------------------------------------------------------- #
 # Every (reduction, dtype) bucket syncs through a declared *transport*:
@@ -208,6 +283,7 @@ def _gate_transport(
     world: Optional[int],
     tolerance: Optional[float],
     kind: str = "psum",
+    error_scale: float = 1.0,
 ) -> Tuple[str, Optional[Dict[str, Any]]]:
     """The error-budget gate: ``(final_transport, refusal | None)``.
 
@@ -217,24 +293,39 @@ def _gate_transport(
     sparse_count — when the encoding cannot beat the dense wire bytes. A
     transport that simply does not apply to the bucket's (reduction, dtype)
     routes to exact with no refusal.
+
+    ``error_scale`` multiplies the per-reduction bound before comparing it to
+    the tolerance: under incremental sync mode the n-th emission of a fold
+    bucket carries the n-th compounding of the quantization error (each delta
+    is quantized independently and the errors add), so the gate — and the
+    refusal record it hands to ``count_collectives`` — must judge the
+    *effective* cadence-adjusted bound, not the single-shot one
+    (docs/quantized_sync.md#incremental-compounding).
     """
     if transport == "exact":
         return "exact", None
     if not _transport_applicable(transport, red, dtype, kind):
         return "exact", None
     tol = default_tolerance(transport) if tolerance is None else float(tolerance)
+    scale = max(1.0, float(error_scale))
     if world is None:
-        return "exact", {
+        refusal = {
             "transport": transport, "reason": "unknown_world",
             "bound": None, "tolerance": tol, "elements": int(nelems),
         }
-    bound = transport_error_bound(transport, world, kind)
+        if scale != 1.0:
+            refusal["emissions"] = int(scale)
+        return "exact", refusal
+    bound = transport_error_bound(transport, world, kind) * scale
     if bound > tol:
-        return "exact", {
+        refusal = {
             "transport": transport, "reason": "error_budget",
             "bound": float(bound), "tolerance": tol,
             "world": int(world), "elements": int(nelems),
         }
+        if scale != 1.0:
+            refusal["emissions"] = int(scale)
+        return "exact", refusal
     if transport == "sparse_count":
         itemsize = int(np.dtype(dtype).itemsize)
         k = _sparse_slots(nelems)
@@ -293,6 +384,7 @@ def transport_plan(
     transports: Optional[Dict[str, str]] = None,
     tolerances: Optional[Dict[str, float]] = None,
     shard_axes: Optional[Dict[str, Any]] = None,
+    error_scale: float = 1.0,
 ) -> List[Dict[str, Any]]:
     """Pure planning view of the per-bucket transport decisions ``sync_state``
     would make on a ``world``-wide mesh — the analyzer's E112 sweep runs this
@@ -303,7 +395,9 @@ def transport_plan(
     ``transport`` is the post-gate decision and ``refusal`` carries the gate's
     reason when the requested transport was refused. Leaves named in
     ``shard_axes`` plan against the mesh-width-independent ``kind="reshard"``
-    bounds, mirroring the runtime routing.
+    bounds, mirroring the runtime routing. ``error_scale`` plans against the
+    cadence-compounded bound of the ``error_scale``-th incremental emission
+    (see :func:`_gate_transport`).
     """
     shard_axes = shard_axes or {}
     groups: Dict[Tuple[Any, Any, str, str], List[Tuple[str, Any]]] = {}
@@ -323,7 +417,7 @@ def transport_plan(
         tol = _bucket_tolerance(names, tolerances)
         final, refusal = _gate_transport(
             requested, None if kind == "reshard" else red, dtype, nelems, world,
-            tol, kind=kind,
+            tol, kind=kind, error_scale=error_scale,
         )
         eff_tol = (
             default_tolerance(requested) if tol is None else float(tol)
@@ -336,7 +430,8 @@ def transport_plan(
             "elements": nelems,
             "requested": requested,
             "transport": final,
-            "bound": transport_error_bound(final, world, kind),
+            "bound": transport_error_bound(final, world, kind)
+            * max(1.0, float(error_scale)),
             "tolerance": eff_tol,
             "refusal": refusal,
         })
@@ -697,6 +792,7 @@ def _sync_bucketed(
     axis_name: AxisNames,
     transports: Optional[Dict[str, str]] = None,
     tolerances: Optional[Dict[str, float]] = None,
+    error_scale: float = 1.0,
 ) -> Dict[str, Any]:
     """One collective per (reduction, dtype, transport) bucket —
     gradient-bucketing for metric state (ISSUE-3 tentpole; arXiv:2305.06942
@@ -734,6 +830,7 @@ def _sync_bucketed(
             transport, refusal = _gate_transport(
                 requested, red, np.dtype(dtype), nelems, world,
                 _bucket_tolerance(names, tolerances),
+                error_scale=error_scale,
             )
             if refusal is not None:
                 _tick_refusal(dict(refusal, reduction=str(red), dtype=str(np.dtype(dtype)), states=names))
@@ -1166,6 +1263,571 @@ def _sync_state_impl(
     for name, container in rewrap.items():
         out[name] = container((out[name],))
     return {name: out[name] for name in state}
+
+
+# --------------------------------------------------------------------------- #
+# incremental sync (ISSUE-15 tentpole): in-streak per-bucket emissions
+# --------------------------------------------------------------------------- #
+# Two per-bucket emission codecs, chosen so incremental == deferred *bitwise*
+# for exact transports:
+#
+# ``fold``     integer-dtype ``sum`` leaves. Each emission psums the delta since
+#              the last emission and adds it into a synced accumulator
+#              (``acc += psum(state - last); last = state``). Integer adds are
+#              exact and associative, so ``Σ_e psum(Δ_e) == psum(Σ_e Δ_e) ==
+#              psum(final state)`` bit for bit, even when finalize pays one
+#              residual delta psum for a cadence tail. Quantized transports
+#              compound error per emission — the gate judges the effective
+#              bound via ``error_scale``.
+#
+# ``replace``  float ``sum`` and any-dtype ``mean``/``max``/``min`` leaves.
+#              Delta-folding floats reassociates the sum (not bitwise), and
+#              max/min have no delta at all — so each emission simply runs the
+#              bucket's *full* collective and replaces the accumulator. The
+#              last emission is then literally the deferred finalize collective
+#              over the same bucket layout: when the cadence lands on the final
+#              update (``pending == 0``) the result is bitwise-identical and
+#              finalize pays nothing; a stale accumulator (cadence tail)
+#              re-syncs fully as residue.
+#
+# Everything else — ``cat``/``None``/callable reductions, list/CatBuffer
+# states, ``shard_axis`` leaves (their gather-free/reshard protocols already
+# have better finalize stories) — is *residue*: untouched by emissions, synced
+# by the ordinary deferred path at finalize.
+
+
+def _resolve_mode(name: str, modes: Optional[Dict[str, str]]) -> str:
+    m = (modes or {}).get(name)
+    if m is not None and m not in SYNC_MODES:
+        raise ValueError(
+            f"unknown sync mode {m!r} for state {name!r}; "
+            f"expected one of {SYNC_MODES}"
+        )
+    return m if m is not None else sync_mode_default()
+
+
+def incremental_plan(
+    state: Dict[str, Any],
+    reductions: Dict[str, Optional[Union[str, Callable]]],
+    modes: Optional[Dict[str, str]] = None,
+    shard_axes: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Dict[str, Any]]:
+    """Pure per-leaf routing decision for incremental sync mode.
+
+    Returns ``{name: {"mode", "codec", "eligible", "reason"}}`` where ``mode``
+    is ``"incremental"`` (the leaf takes in-streak emissions) or ``"deferred"``
+    (finalize residue), ``codec`` is ``"fold"``/``"replace"``/``None`` (see the
+    section comment above), ``eligible`` says whether the leaf *could* take
+    emissions were the mode switched on (dense array + mergeable-elementwise
+    reduction + unsharded), and ``reason`` explains a deferred routing.
+
+    Shared verbatim by the runtime carry construction, the engines'
+    ``classify_incremental_member``, and the analyzer's E113 sweep — one
+    planner, no drift. Works on abstract (``ShapeDtypeStruct``-like) leaves:
+    only ``dtype`` is inspected, never values.
+    """
+    from metrics_tpu.core.buffers import CatBuffer
+
+    shard_axes = shard_axes or {}
+    plan: Dict[str, Dict[str, Any]] = {}
+    for name, val in state.items():
+        red = reductions.get(name)
+        dtype = None if isinstance(val, CatBuffer) else getattr(val, "dtype", None)
+        if isinstance(val, (list, tuple)) or dtype is None:
+            entry = {
+                "mode": "deferred", "codec": None, "eligible": False,
+                "reason": "non-array state (list/CatBuffer) has per-device layout",
+            }
+        elif callable(red) or red not in _ELEMENTWISE:
+            entry = {
+                "mode": "deferred", "codec": None, "eligible": False,
+                "reason": f"reduction {red!r} is not mergeable-elementwise",
+            }
+        elif name in shard_axes:
+            entry = {
+                "mode": "deferred", "codec": None, "eligible": False,
+                "reason": "shard_axis leaves sync gather-free/resharded at finalize",
+            }
+        else:
+            codec = (
+                "fold"
+                if red == "sum" and np.issubdtype(np.dtype(dtype), np.integer)
+                else "replace"
+            )
+            if _resolve_mode(name, modes) == "incremental":
+                entry = {
+                    "mode": "incremental", "codec": codec, "eligible": True,
+                    "reason": "",
+                }
+            else:
+                entry = {
+                    "mode": "deferred", "codec": codec, "eligible": True,
+                    "reason": "sync mode resolves to deferred",
+                }
+        plan[name] = entry
+    return plan
+
+
+class IncrementalCarry:
+    """The streak-carried triple ``(state, acc, last)`` plus static cadence
+    bookkeeping — a registered pytree, so it jits/donates like a plain state
+    dict.
+
+    * ``state`` — the live (unsynced, per-device) state pytree the update
+      programs advance; always authoritative for residue leaves.
+    * ``acc`` — per covered leaf, the synchronized accumulator emissions fold
+      into (``fold``) or replace (``replace``).
+    * ``last`` — per ``fold`` leaf, the state as of the last emission (delta
+      base). ``replace`` leaves need no base.
+
+    The aux data ``(sync_every, pending, emissions, track_emissions)`` is
+    *static* — part of the treedef, not traced — so a per-step ``jit`` over
+    carries sees at most ``sync_every + 1`` distinct signatures (``pending``
+    cycles ``0..K-1``; saturates at ``K`` on axis-free updates). ``emissions``
+    is the emission ordinal the quantized error gate compounds by; when no
+    covered leaf uses a quantized transport (``track_emissions=False``) it
+    saturates at 1 — only "never emitted" vs "synced" matters — keeping the
+    signature set bounded for unbounded streaks.
+    """
+
+    __slots__ = ("state", "acc", "last", "sync_every", "pending", "emissions",
+                 "track_emissions")
+
+    def __init__(
+        self,
+        state: Dict[str, Any],
+        acc: Dict[str, Array],
+        last: Dict[str, Array],
+        sync_every: int = 1,
+        pending: int = 0,
+        emissions: int = 0,
+        track_emissions: bool = False,
+    ):
+        self.state = state
+        self.acc = acc
+        self.last = last
+        self.sync_every = int(sync_every)
+        self.pending = int(pending)
+        self.emissions = int(emissions)
+        self.track_emissions = bool(track_emissions)
+
+    @property
+    def synced(self) -> bool:
+        """Whether at least one emission has run (``acc`` holds real data)."""
+        return self.emissions > 0
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"IncrementalCarry(leaves={len(self.state)}, covered={len(self.acc)}, "
+            f"sync_every={self.sync_every}, pending={self.pending}, "
+            f"emissions={self.emissions})"
+        )
+
+
+jax.tree_util.register_pytree_node(
+    IncrementalCarry,
+    lambda c: (
+        (c.state, c.acc, c.last),
+        (c.sync_every, c.pending, c.emissions, c.track_emissions),
+    ),
+    lambda aux, kids: IncrementalCarry(kids[0], kids[1], kids[2], *aux),
+)
+
+
+def init_incremental(
+    state: Dict[str, Any],
+    reductions: Dict[str, Optional[Union[str, Callable]]],
+    *,
+    modes: Optional[Dict[str, str]] = None,
+    shard_axes: Optional[Dict[str, Any]] = None,
+    sync_every: Optional[int] = None,
+    transports: Optional[Dict[str, str]] = None,
+) -> IncrementalCarry:
+    """Build a fresh :class:`IncrementalCarry` around ``state``.
+
+    ``sync_every`` (default: :func:`sync_cadence_default`) sets the emission
+    cadence K — every K-th update of the streak emits. Covered leaves get a
+    zero accumulator (and, for ``fold`` codecs, a zero delta base: the default
+    state of a sum leaf folds in full on the first emission regardless of what
+    it starts at — zeros is correct *because* the first delta is
+    ``state - 0``).
+    """
+    k = sync_cadence_default() if sync_every is None else int(sync_every)
+    if k < 1:
+        raise ValueError(f"sync_every must be >= 1, got {sync_every}")
+    plan = incremental_plan(state, reductions, modes=modes, shard_axes=shard_axes)
+    acc: Dict[str, Array] = {}
+    last: Dict[str, Array] = {}
+    for name, entry in plan.items():
+        if entry["mode"] != "incremental":
+            continue
+        leaf = jnp.asarray(state[name])
+        acc[name] = jnp.zeros(leaf.shape, leaf.dtype)
+        if entry["codec"] == "fold":
+            last[name] = jnp.zeros(leaf.shape, leaf.dtype)
+    track = any(_resolve_transport(n, transports) != "exact" for n in acc)
+    return IncrementalCarry(
+        dict(state), acc, last, sync_every=k, pending=0, emissions=0,
+        track_emissions=track,
+    )
+
+
+def emit_incremental(
+    state: Dict[str, Any],
+    acc: Dict[str, Array],
+    last: Dict[str, Array],
+    reductions: Dict[str, Optional[Union[str, Callable]]],
+    axis_name: AxisNames,
+    *,
+    modes: Optional[Dict[str, str]] = None,
+    shard_axes: Optional[Dict[str, Any]] = None,
+    transports: Optional[Dict[str, str]] = None,
+    tolerances: Optional[Dict[str, float]] = None,
+    emission: int = 1,
+) -> Tuple[Dict[str, Array], Dict[str, Array]]:
+    """One in-streak emission: returns the new ``(acc, last)``.
+
+    ``fold`` leaves psum the delta since ``last`` (bucketed by (reduction,
+    dtype, transport) exactly like the deferred path, gated at the
+    ``emission``-th compounded error bound); ``replace`` leaves run their full
+    bucket collective and replace ``acc``. Emissions tick the
+    ``sync/incremental_emit`` tracer event, the chaos site
+    ``sync/incremental``, and the ``metrics_tpu_engine_incremental_*`` registry
+    series — all at trace time, like every other tally in this module.
+    """
+    if _chaos.active:
+        _chaos.maybe_fail("sync/incremental", covered=len(acc), emission=int(emission))
+    plan = incremental_plan(state, reductions, modes=modes, shard_axes=shard_axes)
+    fold_entries: List[Tuple[str, Array, Optional[str]]] = []
+    replace_entries: List[Tuple[str, Array, Optional[str]]] = []
+    for name, entry in plan.items():
+        if entry["mode"] != "incremental":
+            continue
+        if entry["codec"] == "fold":
+            fold_entries.append((name, jnp.asarray(state[name]) - last[name], "sum"))
+        else:
+            replace_entries.append((name, jnp.asarray(state[name]), reductions.get(name)))
+
+    t0_us = _otrace._now_us() if _otrace.active else 0
+    with count_collectives() as box:
+        new_acc = dict(acc)
+        new_last = dict(last)
+        if fold_entries:
+            # fold and replace leaves never share a (reduction, dtype) bucket —
+            # fold is exactly the integer-sum set — so two _sync_bucketed calls
+            # produce the same bucket layout one call would
+            synced = _sync_bucketed(
+                fold_entries, axis_name, transports, tolerances,
+                error_scale=float(emission),
+            )
+            for name, _, _ in fold_entries:
+                new_acc[name] = acc[name] + synced[name]
+                new_last[name] = jnp.asarray(state[name])
+        if replace_entries:
+            # replace emissions are single-shot collectives of the full state:
+            # error does not compound across emissions, scale stays 1
+            synced = _sync_bucketed(replace_entries, axis_name, transports, tolerances)
+            for name, _, _ in replace_entries:
+                new_acc[name] = synced[name]
+    if _otrace.active:
+        _otrace.emit_complete(
+            "sync/incremental_emit", "sync", t0_us, _otrace._now_us() - t0_us,
+            axis=str(axis_name), emission=int(emission),
+            fold_leaves=len(fold_entries), replace_leaves=len(replace_entries),
+            collectives=dict(box["by_kind"]),
+            collective_bytes=dict(box["bytes_by_kind"]),
+        )
+    try:
+        from metrics_tpu.observability.instruments import REGISTRY
+    except Exception:
+        REGISTRY = None
+    if REGISTRY is not None:
+        REGISTRY.counter(
+            "engine_incremental_emissions_total",
+            "In-streak incremental sync emissions (trace-time tally; retraces re-count).",
+        ).inc()
+    return new_acc, new_last
+
+
+def finalize_incremental(
+    state: Dict[str, Any],
+    acc: Dict[str, Array],
+    last: Dict[str, Array],
+    reductions: Dict[str, Optional[Union[str, Callable]]],
+    axis_name: Optional[AxisNames],
+    *,
+    pending: int,
+    synced: bool,
+    modes: Optional[Dict[str, str]] = None,
+    shard_axes: Optional[Dict[str, Any]] = None,
+    transports: Optional[Dict[str, str]] = None,
+    tolerances: Optional[Dict[str, float]] = None,
+    bucketed: Optional[bool] = None,
+    keep_sharded: bool = False,
+    emission: int = 1,
+) -> Dict[str, Any]:
+    """Finish an incremental streak: globally-synced state, residue-only cost.
+
+    * covered + fresh (``pending == 0`` and ≥1 emission): the accumulator *is*
+      the synced leaf — zero finalize collectives for these buckets.
+    * covered ``fold`` + cadence tail (``pending > 0``): one residual delta
+      psum per bucket, folded in — still exact for integer sums.
+    * covered ``replace`` + cadence tail, or never-emitted carries: the live
+      state re-syncs fully through the deferred path (correct by construction,
+      emissions wasted).
+    * residue leaves (cat/list/CatBuffer/sharded/callable): the ordinary
+      :func:`sync_state` deferred path, unchanged semantics including
+      ``keep_sharded``.
+
+    Sets the ``metrics_tpu_engine_incremental_deferred_residue_buckets`` gauge
+    to the number of collectives this finalize actually paid.
+    """
+    if axis_name is None:
+        return dict(state)
+    plan = incremental_plan(state, reductions, modes=modes, shard_axes=shard_axes)
+    out: Dict[str, Any] = {}
+    residue: Dict[str, Any] = {}
+    fold_tail: List[Tuple[str, Array, Optional[str]]] = []
+    for name, entry in plan.items():
+        covered = entry["mode"] == "incremental" and name in acc
+        if not covered or not synced:
+            # uncovered leaf, or a carry that never emitted (acc still zeros):
+            # the live state re-syncs through the ordinary deferred path
+            residue[name] = state[name]
+            continue
+        if pending <= 0:
+            out[name] = acc[name]
+        elif entry["codec"] == "fold":
+            fold_tail.append((name, jnp.asarray(state[name]) - last[name], "sum"))
+        else:
+            residue[name] = state[name]
+    with count_collectives() as box:
+        if fold_tail:
+            synced_tail = _sync_bucketed(
+                fold_tail, axis_name, transports, tolerances,
+                error_scale=float(emission),
+            )
+            for name, _, _ in fold_tail:
+                out[name] = acc[name] + synced_tail[name]
+        if residue:
+            out.update(
+                sync_state(
+                    residue,
+                    {n: reductions.get(n) for n in residue},
+                    axis_name,
+                    bucketed=bucketed,
+                    shard_axes={
+                        n: a for n, a in (shard_axes or {}).items() if n in residue
+                    },
+                    keep_sharded=keep_sharded,
+                    transports={
+                        n: t for n, t in (transports or {}).items() if n in residue
+                    },
+                    tolerances={
+                        n: t for n, t in (tolerances or {}).items() if n in residue
+                    },
+                )
+            )
+    try:
+        from metrics_tpu.observability.instruments import REGISTRY
+    except Exception:
+        REGISTRY = None
+    if REGISTRY is not None:
+        REGISTRY.gauge(
+            "engine_incremental_deferred_residue_buckets",
+            "Collectives the last incremental finalize still paid (cadence tails + non-incremental residue).",
+        ).set(float(box["count"]))
+    return {name: out[name] for name in state}
+
+
+def advance_incremental(
+    carry: IncrementalCarry,
+    new_state: Dict[str, Any],
+    reductions: Dict[str, Optional[Union[str, Callable]]],
+    axis_name: Optional[AxisNames] = None,
+    *,
+    modes: Optional[Dict[str, str]] = None,
+    shard_axes: Optional[Dict[str, Any]] = None,
+    transports: Optional[Dict[str, str]] = None,
+    tolerances: Optional[Dict[str, float]] = None,
+) -> IncrementalCarry:
+    """Fold one post-update state into the carry, emitting on cadence.
+
+    ``axis_name=None`` (no collective context — facade dispatch, plain jit)
+    never emits: the carry just tracks the live state and finalize falls back
+    to the full deferred sync, so the facade path stays deferred-equivalent by
+    construction. ``pending`` saturates at ``sync_every`` on that branch to
+    keep the static-signature set bounded.
+    """
+    k = carry.sync_every
+    pending = carry.pending + 1
+    if axis_name is None or not carry.acc:
+        return IncrementalCarry(
+            new_state, carry.acc, carry.last, k, min(pending, k),
+            carry.emissions, carry.track_emissions,
+        )
+    if pending < k:
+        return IncrementalCarry(
+            new_state, carry.acc, carry.last, k, pending,
+            carry.emissions, carry.track_emissions,
+        )
+    emission = carry.emissions + 1
+    acc, last = emit_incremental(
+        new_state, carry.acc, carry.last, reductions, axis_name,
+        modes=modes, shard_axes=shard_axes, transports=transports,
+        tolerances=tolerances, emission=emission,
+    )
+    return IncrementalCarry(
+        new_state, acc, last, k, 0,
+        emission if carry.track_emissions else min(emission, 1),
+        carry.track_emissions,
+    )
+
+
+def finalize_incremental_state(
+    carry: IncrementalCarry,
+    reductions: Dict[str, Optional[Union[str, Callable]]],
+    axis_name: Optional[AxisNames],
+    *,
+    modes: Optional[Dict[str, str]] = None,
+    shard_axes: Optional[Dict[str, Any]] = None,
+    transports: Optional[Dict[str, str]] = None,
+    tolerances: Optional[Dict[str, float]] = None,
+    bucketed: Optional[bool] = None,
+    keep_sharded: bool = False,
+) -> Dict[str, Any]:
+    """Carry-level wrapper over :func:`finalize_incremental`."""
+    return finalize_incremental(
+        carry.state, carry.acc, carry.last, reductions, axis_name,
+        pending=carry.pending, synced=carry.synced,
+        modes=modes, shard_axes=shard_axes, transports=transports,
+        tolerances=tolerances, bucketed=bucketed, keep_sharded=keep_sharded,
+        emission=carry.emissions + 1,
+    )
+
+
+# --------------------------------------------------------------------------- #
+# stacked (tenancy) incremental sync: the tenant axis folds into the buckets
+# --------------------------------------------------------------------------- #
+def _stacked_flat(
+    states: Dict[str, Dict[str, Any]],
+    reductions: Dict[str, Dict[str, Optional[Union[str, Callable]]]],
+    transports: Optional[Dict[str, Dict[str, str]]],
+    tolerances: Optional[Dict[str, Dict[str, float]]],
+) -> Tuple[Dict[str, Any], Dict[str, Any], Dict[str, str], Dict[str, float]]:
+    """Flatten a ``{leader: {state: leaf}}`` stacked pytree into the
+    ``\\x1f``-joined flat namespace :func:`sync_stacked_states` uses, enforcing
+    the same elementwise-only contract."""
+    flat_state: Dict[str, Any] = {}
+    flat_reds: Dict[str, Any] = {}
+    flat_transports: Dict[str, str] = {}
+    flat_tolerances: Dict[str, float] = {}
+    for lname, st in states.items():
+        reds = reductions[lname]
+        for name, leaf in st.items():
+            red = reds.get(name)
+            if red not in _ELEMENTWISE:
+                raise ValueError(
+                    f"incremental stacked sync: state {lname!r}.{name!r} has "
+                    f"non-elementwise reduction {red!r} — classify_tenant_member "
+                    "should have demoted this group."
+                )
+            key = f"{lname}\x1f{name}"
+            flat_state[key] = leaf
+            flat_reds[key] = red
+            if transports and name in (transports.get(lname) or {}):
+                flat_transports[key] = transports[lname][name]
+            if tolerances and name in (tolerances.get(lname) or {}):
+                flat_tolerances[key] = tolerances[lname][name]
+    return flat_state, flat_reds, flat_transports, flat_tolerances
+
+
+def _stacked_nest(flat: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    out: Dict[str, Dict[str, Any]] = {}
+    for key, leaf in flat.items():
+        lname, name = key.split("\x1f", 1)
+        out.setdefault(lname, {})[name] = leaf
+    return out
+
+
+def init_incremental_stacked(
+    states: Dict[str, Dict[str, Any]],
+    reductions: Dict[str, Dict[str, Optional[Union[str, Callable]]]],
+    *,
+    sync_every: Optional[int] = None,
+    transports: Optional[Dict[str, Dict[str, str]]] = None,
+    tolerances: Optional[Dict[str, Dict[str, float]]] = None,
+) -> IncrementalCarry:
+    """Incremental carry over a tenant-stacked state pytree.
+
+    Every stacked leaf is elementwise by contract, so all of them are covered;
+    the tenant axis folds into the flat buckets exactly as in
+    :func:`sync_stacked_states`, keeping the per-emission collective count
+    independent of N and of the number of leaders. The carry's ``state`` holds
+    the flat (``\\x1f``-keyed) view; :func:`finalize_incremental_stacked`
+    re-nests it.
+    """
+    flat_state, flat_reds, flat_t, _ = _stacked_flat(
+        states, reductions, transports, tolerances
+    )
+    return init_incremental(
+        flat_state, flat_reds,
+        modes={k: "incremental" for k in flat_state},
+        sync_every=sync_every, transports=flat_t,
+    )
+
+
+def advance_incremental_stacked(
+    carry: IncrementalCarry,
+    states: Dict[str, Dict[str, Any]],
+    reductions: Dict[str, Dict[str, Optional[Union[str, Callable]]]],
+    axis_name: Optional[AxisNames],
+    *,
+    transports: Optional[Dict[str, Dict[str, str]]] = None,
+    tolerances: Optional[Dict[str, Dict[str, float]]] = None,
+) -> IncrementalCarry:
+    """Stacked counterpart of :func:`advance_incremental`."""
+    flat_state, flat_reds, flat_t, flat_tol = _stacked_flat(
+        states, reductions, transports, tolerances
+    )
+    return advance_incremental(
+        carry, flat_state, flat_reds, axis_name,
+        modes={k: "incremental" for k in flat_state},
+        transports=flat_t, tolerances=flat_tol,
+    )
+
+
+def finalize_incremental_stacked(
+    carry: IncrementalCarry,
+    reductions: Dict[str, Dict[str, Optional[Union[str, Callable]]]],
+    axis_name: Optional[AxisNames],
+    *,
+    transports: Optional[Dict[str, Dict[str, str]]] = None,
+    tolerances: Optional[Dict[str, Dict[str, float]]] = None,
+) -> Dict[str, Dict[str, Any]]:
+    """Stacked counterpart of :func:`finalize_incremental_state` — returns the
+    re-nested ``{leader: {state: leaf}}`` synced pytree."""
+    flat_reds = {
+        f"{lname}\x1f{name}": red
+        for lname, reds in reductions.items()
+        for name, red in reds.items()
+    }
+    flat_t = {
+        f"{lname}\x1f{name}": t
+        for lname, per in (transports or {}).items()
+        for name, t in (per or {}).items()
+    }
+    flat_tol = {
+        f"{lname}\x1f{name}": t
+        for lname, per in (tolerances or {}).items()
+        for name, t in (per or {}).items()
+    }
+    flat = finalize_incremental_state(
+        carry, flat_reds, axis_name,
+        modes={k: "incremental" for k in carry.state},
+        transports=flat_t, tolerances=flat_tol,
+    )
+    return _stacked_nest(flat)
 
 
 # --------------------------------------------------------------------------- #

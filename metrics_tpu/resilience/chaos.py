@@ -39,6 +39,7 @@ Known sites (the registry below is documentation *and* test surface)::
     engine/compile       first compiled call of an engine (trace+compile probe)
     engine/dispatch      steady-state compiled engine call
     sync/bucket_build    bucketed sync build (runs at jit trace time)
+    sync/incremental     one in-streak incremental emission (trace time)
     ckpt/write           shard payload + sidecar write phase
     ckpt/commit          manifest/COMMIT/rename commit phase
     ckpt/read            shard payload read+verify phase
@@ -68,6 +69,7 @@ KNOWN_SITES = (
     "engine/compile",
     "engine/dispatch",
     "sync/bucket_build",
+    "sync/incremental",
     "ckpt/write",
     "ckpt/commit",
     "ckpt/read",

@@ -578,6 +578,55 @@ class TenantSet:
             stacked, reductions, axis_name, transports, tolerances
         )
 
+    def _stacked_sync_config(self):
+        leaders = [group[0] for group in self._stacked_groups]
+        return (
+            {n: dict(self.template._metrics[n]._reductions) for n in leaders},
+            {n: dict(self.template._metrics[n]._sync_transports) for n in leaders},
+            {n: dict(self.template._metrics[n]._sync_tolerances) for n in leaders},
+        )
+
+    def init_incremental_sync(
+        self, stacked: Dict[str, StateDict], *, sync_every: Optional[int] = None
+    ) -> Any:
+        """Incremental carry over a tenant-stacked state pytree (pure).
+
+        Stacked leaves are elementwise by classification, so all of them take
+        emissions; the tenant axis folds into the flat buckets exactly like
+        :meth:`sync_states`, keeping the per-emission collective count
+        independent of N and of the number of stacked groups. See
+        :func:`metrics_tpu.parallel.sync.init_incremental_stacked`."""
+        reductions, transports, tolerances = self._stacked_sync_config()
+        return _sync.init_incremental_stacked(
+            stacked, reductions, sync_every=sync_every,
+            transports=transports, tolerances=tolerances,
+        )
+
+    def advance_incremental_sync(
+        self, carry: Any, stacked: Dict[str, StateDict], axis_name: Any
+    ) -> Any:
+        """One streak step of the stacked incremental protocol (pure): fold
+        the externally-advanced stacked states into the carry, emitting the
+        N-independent per-bucket collectives on cadence."""
+        reductions, transports, tolerances = self._stacked_sync_config()
+        return _sync.advance_incremental_stacked(
+            carry, stacked, reductions, axis_name,
+            transports=transports, tolerances=tolerances,
+        )
+
+    def finalize_incremental_sync(
+        self, carry: Any, axis_name: Any
+    ) -> Dict[str, StateDict]:
+        """Finish a stacked incremental streak (pure): the re-nested
+        globally-synced ``{leader: {state: leaf}}`` pytree, bitwise identical
+        to :meth:`sync_states` over the same final states for exact
+        transports."""
+        reductions, transports, tolerances = self._stacked_sync_config()
+        return _sync.finalize_incremental_stacked(
+            carry, reductions, axis_name,
+            transports=transports, tolerances=tolerances,
+        )
+
     @property
     def stacked_states(self) -> Dict[str, StateDict]:
         """The live stacked state pytree (read-only view by convention)."""

@@ -364,6 +364,46 @@ class NoByteWinTransportMetric(Metric):
         return self.pair.sum()
 
 
+class DeferredPinnedMetric(Metric):
+    """E113: every state leaf is mergeable-elementwise — fully
+    emission-eligible — but per-state ``sync_mode='deferred'`` declarations
+    pin the whole group to one finalize burst."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state(
+            "total", default=jnp.zeros((8,)), dist_reduce_fx="sum",
+            sync_mode="deferred",
+        )
+        self.add_state(
+            "count", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum",
+            sync_mode="deferred",
+        )
+
+    def update(self, values):
+        self.total = self.total + values[:8]
+        self.count = self.count + 1
+
+    def compute(self):
+        return self.total.sum() / jnp.maximum(self.count, 1)
+
+
+class EngagedIncrementalMetric(DeferredPinnedMetric):
+    """Control for E113: the same states declared ``sync_mode='incremental'``
+    — the group takes in-streak emissions, nothing is pinned."""
+
+    def __init__(self, **kwargs):
+        Metric.__init__(self, **kwargs)
+        self.add_state(
+            "total", default=jnp.zeros((8,)), dist_reduce_fx="sum",
+            sync_mode="incremental",
+        )
+        self.add_state(
+            "count", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum",
+            sync_mode="incremental",
+        )
+
+
 class CatReductionMetric(Metric):
     """E110: dense state under a ``cat`` reduction — fine for the compiled
     engines, but a TenantSet cannot fold its tenant axis into the flat sync
@@ -620,6 +660,46 @@ class TestEvalStage:
         findings = _evaluate(OverBudgetTransportMetric, dict(_SPEC, allow=("E112",)))
         e112 = [f for f in findings if f.rule == "E112"]
         assert e112 and all(f.suppressed for f in e112)
+
+    def test_deferred_pinned_metric_is_E113(self):
+        findings = _evaluate(DeferredPinnedMetric)
+        e113 = [f for f in findings if f.rule == "E113" and not f.suppressed]
+        assert len(e113) == 1, [f.rule for f in findings]
+        assert e113[0].severity == "warning"
+        extra = e113[0].extra
+        assert extra["global_mode"] == "deferred"
+        assert extra["declared_modes"] == {"total": "deferred", "count": "deferred"}
+        named = sorted(n for b in extra["residue_buckets"] for n in b["states"])
+        assert named == ["count", "total"]
+        assert "residue bucket" in e113[0].message
+
+    def test_engaged_incremental_has_no_E113(self):
+        findings = _evaluate(EngagedIncrementalMetric)
+        assert "E113" not in {f.rule for f in findings}
+
+    def test_undeclared_metric_under_default_mode_has_no_E113(self):
+        findings = _evaluate(CleanMetric)
+        assert "E113" not in {f.rule for f in findings}
+
+    def test_global_incremental_mode_flags_pinned_declarations_only(self):
+        import metrics_tpu
+
+        metrics_tpu.set_sync_mode("incremental")
+        try:
+            pinned = _evaluate(DeferredPinnedMetric)
+            clean = _evaluate(CleanMetric)
+        finally:
+            metrics_tpu.set_sync_mode(None)
+        e113 = [f for f in pinned if f.rule == "E113" and not f.suppressed]
+        assert len(e113) == 1
+        assert e113[0].extra["global_mode"] == "incremental"
+        # undeclared leaves follow the global mode — engaged, nothing pinned
+        assert "E113" not in {f.rule for f in clean}
+
+    def test_E113_is_suppressible_via_spec_allow(self):
+        findings = _evaluate(DeferredPinnedMetric, dict(_SPEC, allow=("E113",)))
+        e113 = [f for f in findings if f.rule == "E113"]
+        assert e113 and all(f.suppressed for f in e113)
 
     def test_missing_spec_is_E002(self):
         findings = eval_stage.evaluate_entry(Entry(cls=CleanMetric, spec=None))

@@ -154,6 +154,35 @@ def test_async_save_error_surfaces_on_wait(tmp_path):
         handle.wait()
 
 
+def test_overlap_copy_save_commits_and_times_both_phases(tmp_path):
+    """ISSUE-15 overlap model: overlap_copy=True enqueues the device→host
+    copy and returns; the save thread drains it. The handle times both sides
+    (copy_enqueue_s on the caller, host_copy_s on the thread) and the
+    restored bytes match a snapshot taken before further updates."""
+    m = Accuracy()
+    m.update(*_batch(seed=20))
+    ref = m.compute()
+    handle = save_checkpoint(m, str(tmp_path), blocking=False, overlap_copy=True)
+    # the caller-side streak continues while the copy drains on the thread
+    m.update(*_batch(seed=21))
+    handle.wait()
+    assert handle.committed
+    assert "copy_enqueue_s" in handle.timings
+    assert "host_copy_s" in handle.timings
+    assert handle.timings["copy_enqueue_s"] >= 0.0
+    assert handle.timings["host_copy_s"] >= 0.0
+    fresh = Accuracy()
+    restore_checkpoint(fresh, str(tmp_path), host_index=0, host_count=1)
+    _tree_equal(ref, fresh.compute())
+
+
+def test_overlap_copy_requires_async(tmp_path):
+    m = Accuracy()
+    m.update(*_batch(seed=22))
+    with pytest.raises(ValueError, match="overlap_copy"):
+        save_checkpoint(m, str(tmp_path), blocking=True, overlap_copy=True)
+
+
 # -------------------------------------------------- engine/streak interop ----
 def test_save_during_fused_streak_realizes_members(tmp_path):
     coll = MetricCollection([Precision(), Recall()])

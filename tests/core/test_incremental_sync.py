@@ -1,0 +1,678 @@
+"""Incremental sync mode (ISSUE-15): per-bucket collectives inside the streak.
+
+Pins the tentpole contract end to end on the 8-device CPU mesh:
+
+* **bitwise identity** — an incremental streak (``init_incremental`` →
+  ``advance_incremental``\\* → ``finalize_incremental_state``) produces exactly
+  the bytes of the deferred path (``sync_state`` over the final state) for
+  exact transports, across fold (integer-sum) and replace (float
+  sum/mean/max/min) codecs and every cadence K, including cadence tails;
+* **residue proof** — ``count_collectives`` shows emissions inside the streak
+  (per-bucket counts) and a ``compute()``-time collective count of zero when
+  the cadence divides the streak, residue-only otherwise;
+* the **mode/cadence knob surface** — per-state ``add_state(sync_mode=)`` >
+  ``set_sync_mode`` > ``METRICS_TPU_SYNC_MODE`` > deferred, and the matching
+  ``sync_every`` / ``set_sync_cadence`` / ``METRICS_TPU_SYNC_EVERY`` ladder;
+* composition with **quantized transports** (the cadence-compounded error
+  bound and its ``emissions``-carrying refusal record), **sharded state**
+  (shard_axis leaves stay deferred residue, reshard semantics intact), and
+  the **partitioned dispatcher** (an ``"incremental"`` partition-view section;
+  a mode flip re-keys the partition exactly once — zero steady-state
+  recompiles).
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu
+from metrics_tpu import Accuracy, MetricCollection, Precision, Recall
+from metrics_tpu.core.engine import classify_incremental_member
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.parallel import sync as sync_mod
+from metrics_tpu.parallel.sync import (
+    IncrementalCarry,
+    advance_incremental,
+    count_collectives,
+    finalize_incremental_state,
+    incremental_plan,
+    init_incremental,
+    sync_state,
+    transport_plan,
+)
+
+WORLD = 8
+
+
+@pytest.fixture(autouse=True)
+def _mode_defaults():
+    """Every test starts and ends on the factory mode/cadence defaults."""
+    metrics_tpu.set_sync_mode(None)
+    metrics_tpu.set_sync_cadence(None)
+    yield
+    metrics_tpu.set_sync_mode(None)
+    metrics_tpu.set_sync_cadence(None)
+
+
+@pytest.fixture()
+def mesh():
+    devices = jax.devices()
+    if len(devices) < WORLD:
+        pytest.skip("needs 8 devices")
+    return Mesh(np.asarray(devices[:WORLD]), ("data",))
+
+
+# fold (int-sum) and replace (float sum/max) codecs side by side, plus a
+# scalar of each — exercises both emission arms and both bucket layouts
+_STATE = {
+    "hits": jnp.arange(16, dtype=jnp.int32),
+    "n": jnp.asarray(0, jnp.int32),
+    "total": jnp.zeros((8,), jnp.float32),
+    "peak": jnp.asarray(-jnp.inf, jnp.float32),
+}
+_REDS = {"hits": "sum", "n": "sum", "total": "sum", "peak": "max"}
+_INCR = {k: "incremental" for k in _STATE}
+
+
+def _step(state, x):
+    """One deterministic, device-dependent update of _STATE."""
+    return {
+        "hits": state["hits"] + x.astype(jnp.int32),
+        "n": state["n"] + jnp.asarray(1, jnp.int32),
+        "total": state["total"] + jnp.sin(x[:8].astype(jnp.float32)),
+        "peak": jnp.maximum(state["peak"], jnp.max(x.astype(jnp.float32))),
+    }
+
+
+def _batches(steps, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        jnp.asarray(rng.integers(0, 7, (WORLD, 16)), jnp.int32)
+        for _ in range(steps)
+    ]
+
+
+def _run_incremental(mesh, batches, sync_every, reds=_REDS, modes=_INCR):
+    """Full streak under shard_map: carry protocol, finalize at the end."""
+
+    def body(xs):
+        carry = init_incremental(
+            dict(_STATE), reds, modes=modes, sync_every=sync_every
+        )
+        for i in range(xs.shape[1]):
+            state = _step(carry.state, xs[0, i])
+            carry = advance_incremental(carry, state, reds, "data", modes=modes)
+        out = finalize_incremental_state(carry, reds, "data", modes=modes)
+        return jax.tree_util.tree_map(lambda v: jnp.expand_dims(v, 0), out)
+
+    stacked = jnp.stack(batches, axis=1)  # (WORLD, steps, 16)
+    f = shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_rep=False)
+    return jax.jit(f)(stacked)
+
+
+def _run_deferred(mesh, batches):
+    """The seed path: update streak, one deferred sync_state at the end."""
+
+    def body(xs):
+        state = dict(_STATE)
+        for i in range(xs.shape[1]):
+            state = _step(state, xs[0, i])
+        out = sync_state(state, _REDS, "data")
+        return jax.tree_util.tree_map(lambda v: jnp.expand_dims(v, 0), out)
+
+    stacked = jnp.stack(batches, axis=1)
+    f = shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_rep=False)
+    return jax.jit(f)(stacked)
+
+
+def _assert_trees_bitwise(a, b):
+    flat_a, td_a = jax.tree_util.tree_flatten(a)
+    flat_b, td_b = jax.tree_util.tree_flatten(b)
+    assert td_a == td_b
+    for x, y in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ----------------------------------------------------------------- parity ----
+@pytest.mark.mesh8
+class TestBitwiseParity:
+    @pytest.mark.parametrize("k", [1, 2, 5, 7])
+    def test_streak_matches_deferred(self, mesh, k):
+        """5-step streak, every cadence class: K=1 (emit each step), K=2
+        (tail of 1), K=5 (single emission, no tail), K=7 (never emits —
+        finalize degrades to the deferred path)."""
+        batches = _batches(5)
+        _assert_trees_bitwise(
+            _run_incremental(mesh, batches, sync_every=k),
+            _run_deferred(mesh, batches),
+        )
+
+    def test_mixed_modes_match_deferred(self, mesh):
+        """Half the leaves declared incremental, half left deferred — the
+        split-routing finalize still reproduces the deferred bytes."""
+        modes = {"hits": "incremental", "total": "incremental"}
+        batches = _batches(4, seed=3)
+        _assert_trees_bitwise(
+            _run_incremental(mesh, batches, sync_every=1, modes=modes),
+            _run_deferred(mesh, batches),
+        )
+
+    def test_metric_protocol_matches_sync_states(self, mesh):
+        """The Metric-level carry protocol on a real domain metric: an
+        incremental Accuracy streak finalizes to the exact bytes (and the
+        exact compute()) of the deferred sync_states path."""
+        m = Accuracy(num_classes=5, average="micro")
+        for name in m._defaults:
+            m._sync_modes[name] = "incremental"
+        rng = np.random.default_rng(7)
+        preds = jnp.asarray(rng.standard_normal((4, WORLD, 16, 5)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, 5, (4, WORLD, 16)))
+
+        def run_incr(p, t):
+            carry = m.init_incremental(m.init_state(), sync_every=2)
+            for i in range(p.shape[0]):
+                carry = m.update_state_incremental(carry, p[i, 0], t[i, 0], axis_name="data")
+            out = m.finalize_incremental(carry, "data")
+            return jax.tree_util.tree_map(lambda v: jnp.expand_dims(v, 0), out)
+
+        def run_def(p, t):
+            state = m.init_state()
+            for i in range(p.shape[0]):
+                state = m.update_state(state, p[i, 0], t[i, 0])
+            out = m.sync_states(state, "data")
+            return jax.tree_util.tree_map(lambda v: jnp.expand_dims(v, 0), out)
+
+        kw = dict(mesh=mesh, in_specs=P(None, "data"), out_specs=P("data"), check_rep=False)
+        got = jax.jit(shard_map(run_incr, **kw))(preds, target)
+        ref = jax.jit(shard_map(run_def, **kw))(preds, target)
+        _assert_trees_bitwise(got, ref)
+        np.testing.assert_array_equal(
+            np.asarray(m.compute_state(jax.tree_util.tree_map(lambda v: v[0], got))),
+            np.asarray(m.compute_state(jax.tree_util.tree_map(lambda v: v[0], ref))),
+        )
+
+
+# ------------------------------------------------------- collective counts ---
+def _count_emit(sync_every, steps, modes=_INCR, reds=_REDS):
+    """Per-phase trace-time collective counts of a whole streak."""
+
+    def streak(state0):
+        carry = init_incremental(dict(state0), reds, modes=modes, sync_every=sync_every)
+        boxes = []
+        for _ in range(steps):
+            state = _step(carry.state, jnp.zeros((16,), jnp.int32))
+            with count_collectives() as step_box:
+                carry = advance_incremental(carry, state, reds, "data", modes=modes)
+            boxes.append(step_box["count"])
+        with count_collectives() as fin_box:
+            finalize_incremental_state(carry, reds, "data", modes=modes)
+        return boxes, fin_box["count"]
+
+    per_step = []
+    final = []
+
+    def probe(state0):
+        steps_counts, fin = streak(state0)
+        per_step.extend(steps_counts)
+        final.append(fin)
+        return jnp.zeros(())
+
+    jax.make_jaxpr(probe, axis_env=[("data", WORLD)])(_STATE)
+    return per_step, final[0]
+
+
+class TestCollectiveCounts:
+    def test_k1_emits_per_bucket_every_step_and_free_finalize(self):
+        # one int-sum fold bucket + one f32-sum replace + one f32-max replace
+        per_step, final = _count_emit(sync_every=1, steps=4)
+        assert per_step == [3, 3, 3, 3]
+        assert final == 0  # pending == 0: compute-time collectives are gone
+
+    def test_cadence_skips_steps_and_finalize_pays_tail_only(self):
+        per_step, final = _count_emit(sync_every=4, steps=6)
+        # emissions only on steps 4 (the rest just count pending)
+        assert per_step == [0, 0, 0, 3, 0, 0]
+        # tail of 2 pending: 1 residual fold-delta psum + the 2 replace
+        # buckets re-sync fully through the deferred path
+        assert final == 3
+
+    def test_never_emitting_carry_finalizes_like_deferred(self):
+        per_step, final = _count_emit(sync_every=9, steps=3)
+        assert per_step == [0, 0, 0]
+        with count_collectives() as ref:
+            jax.make_jaxpr(
+                lambda st: sync_state(st, _REDS, "data"),
+                axis_env=[("data", WORLD)],
+            )(_STATE)
+        assert final == ref["count"]
+
+    def test_deferred_leaves_cost_nothing_in_the_streak(self):
+        modes = {"hits": "incremental"}  # one fold leaf; rest stays deferred
+        per_step, final = _count_emit(sync_every=1, steps=2, modes=modes)
+        assert per_step == [1, 1]
+        # residue: one int-sum bucket ("n" shares hits' dtype but is
+        # deferred), one f32-sum, one f32-max
+        assert final == 3
+
+    def test_no_axis_advance_never_emits(self):
+        """The facade/plain-jit path: axis_name=None tracks state only, so
+        the carry is deferred-equivalent by construction."""
+        carry = init_incremental(dict(_STATE), _REDS, modes=_INCR, sync_every=1)
+        with count_collectives() as box:
+            jax.make_jaxpr(
+                lambda st: advance_incremental(
+                    carry, st, _REDS, None, modes=_INCR
+                ).state
+            )(_STATE)
+        assert box["count"] == 0
+        stepped = advance_incremental(carry, dict(_STATE), _REDS, None, modes=_INCR)
+        assert stepped.emissions == 0
+        out = finalize_incremental_state(stepped, _REDS, None, modes=_INCR)
+        _assert_trees_bitwise(out, dict(_STATE))
+
+
+# --------------------------------------------------- carry / retrace bounds --
+class TestCarryStability:
+    def test_carry_is_a_registered_pytree(self):
+        carry = init_incremental(dict(_STATE), _REDS, modes=_INCR, sync_every=3)
+        leaves, treedef = jax.tree_util.tree_flatten(carry)
+        rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+        assert isinstance(rebuilt, IncrementalCarry)
+        assert rebuilt.sync_every == 3 and rebuilt.pending == 0
+        assert not rebuilt.synced
+
+    def test_signature_set_is_bounded_by_cadence(self):
+        """pending cycles 0..K-1 and emissions saturates at 1 without
+        quantized transports — a 20-step K=3 streak sees a bounded set of
+        static carry signatures, so a per-step jit compiles a bounded number
+        of programs no matter how long the streak runs."""
+        seen = set()
+
+        def streak(state0):
+            carry = init_incremental(dict(state0), _REDS, modes=_INCR, sync_every=3)
+            for _ in range(20):
+                state = _step(carry.state, jnp.zeros((16,), jnp.int32))
+                carry = advance_incremental(carry, state, _REDS, "data", modes=_INCR)
+                # static aux is concrete at trace time — this IS the treedef
+                seen.add((carry.sync_every, carry.pending, carry.emissions,
+                          carry.track_emissions))
+            return jnp.zeros(())
+
+        jax.make_jaxpr(streak, axis_env=[("data", WORLD)])(_STATE)
+        assert {p for (_, p, _, _) in seen} == {0, 1, 2}  # cycles, never grows
+        # pre-first-emission steps carry 0; afterwards saturated at 1 forever
+        assert {e for (_, _, e, _) in seen} == {0, 1}
+        assert len(seen) <= 5
+
+    def test_no_axis_pending_saturates(self):
+        carry = init_incremental(dict(_STATE), _REDS, modes=_INCR, sync_every=2)
+        for _ in range(10):
+            carry = advance_incremental(carry, dict(_STATE), _REDS, None, modes=_INCR)
+        assert carry.pending == 2  # saturated at K, not 10
+
+
+# ------------------------------------------------------------ mode plumbing --
+class TestModeSurface:
+    def test_plan_routing_and_codecs(self):
+        plan = incremental_plan(_STATE, _REDS, modes=_INCR)
+        assert plan["hits"]["codec"] == "fold" and plan["hits"]["mode"] == "incremental"
+        assert plan["n"]["codec"] == "fold"
+        assert plan["total"]["codec"] == "replace"
+        assert plan["peak"]["codec"] == "replace"
+        assert all(e["eligible"] for e in plan.values())
+
+    def test_default_mode_is_deferred(self):
+        assert metrics_tpu.sync_mode_default() == "deferred"
+        plan = incremental_plan(_STATE, _REDS)
+        assert all(e["mode"] == "deferred" for e in plan.values())
+        assert all(e["eligible"] for e in plan.values())
+
+    def test_global_switch_engages_all_eligible(self):
+        metrics_tpu.set_sync_mode("incremental")
+        plan = incremental_plan(_STATE, _REDS)
+        assert all(e["mode"] == "incremental" for e in plan.values())
+
+    def test_per_state_declaration_beats_global(self):
+        metrics_tpu.set_sync_mode("incremental")
+        plan = incremental_plan(_STATE, _REDS, modes={"hits": "deferred"})
+        assert plan["hits"]["mode"] == "deferred"
+        assert plan["total"]["mode"] == "incremental"
+
+    def test_env_var_is_the_weakest_rung(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_MODE", "incremental")
+        assert metrics_tpu.sync_mode_default() == "incremental"
+        metrics_tpu.set_sync_mode("deferred")  # process switch beats env
+        assert metrics_tpu.sync_mode_default() == "deferred"
+        metrics_tpu.set_sync_mode(None)  # back to env
+        assert metrics_tpu.sync_mode_default() == "incremental"
+
+    def test_unknown_modes_raise(self):
+        with pytest.raises(ValueError, match="unknown sync mode"):
+            metrics_tpu.set_sync_mode("streaming")
+        with pytest.raises(ValueError, match="unknown sync mode"):
+            incremental_plan(_STATE, _REDS, modes={"hits": "lazy"})
+
+    def test_add_state_sync_mode_kwarg(self):
+        class Declared(Metric):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_state(
+                    "c", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum",
+                    sync_mode="incremental",
+                )
+
+            def update(self):
+                self.c = self.c + 1
+
+            def compute(self):
+                return self.c
+
+        m = Declared()
+        assert m.sync_modes == {"c": "incremental"}
+        assert m.incremental_plan()["c"]["mode"] == "incremental"
+
+        class Bad(Metric):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_state(
+                    "c", default=jnp.zeros(()), dist_reduce_fx="sum",
+                    sync_mode="sometimes",
+                )
+
+            def update(self):
+                pass
+
+            def compute(self):
+                return self.c
+
+        with pytest.raises(ValueError, match="sync_mode"):
+            Bad()
+
+    def test_cadence_ladder(self, monkeypatch):
+        assert metrics_tpu.sync_cadence_default() == 1
+        monkeypatch.setenv("METRICS_TPU_SYNC_EVERY", "4")
+        assert metrics_tpu.sync_cadence_default() == 4
+        metrics_tpu.set_sync_cadence(2)
+        assert metrics_tpu.sync_cadence_default() == 2
+        metrics_tpu.set_sync_cadence(None)
+        assert metrics_tpu.sync_cadence_default() == 4
+        with pytest.raises(ValueError):
+            metrics_tpu.set_sync_cadence(0)
+        with pytest.raises(ValueError):
+            init_incremental(dict(_STATE), _REDS, modes=_INCR, sync_every=0)
+
+
+# ----------------------------------------------- ineligible leaves / residue --
+class TestResidueRouting:
+    def test_cat_list_callable_and_sharded_stay_deferred(self):
+        state = {
+            "rows": jnp.zeros((4, 2)),
+            "chunks": [jnp.zeros((2,))],
+            "custom": jnp.zeros(()),
+            "tiles": jnp.zeros((8, 3)),
+        }
+        reds = {
+            "rows": "cat",
+            "chunks": "cat",
+            "custom": lambda xs: xs,
+            "tiles": "sum",
+        }
+        plan = incremental_plan(
+            state, reds,
+            modes={k: "incremental" for k in state},
+            shard_axes={"tiles": 0},
+        )
+        assert all(e["mode"] == "deferred" for e in plan.values())
+        assert all(not e["eligible"] for e in plan.values())
+        assert "not mergeable-elementwise" in plan["rows"]["reason"]
+        assert "per-device layout" in plan["chunks"]["reason"]
+        assert "resharded at finalize" in plan["tiles"]["reason"]
+
+    @pytest.mark.mesh8
+    def test_sharded_leaf_reshards_at_finalize_only(self, mesh):
+        """shard_axis residue under incremental mode: the streak emits only
+        the elementwise buckets; finalize routes the sharded leaf through the
+        same reshard path as the deferred seed, bitwise."""
+        state = {
+            "tiles": jnp.arange(WORLD * 3, dtype=jnp.float32).reshape(WORLD, 3),
+            "hits": jnp.arange(4, dtype=jnp.int32),
+        }
+        reds = {"tiles": "sum", "hits": "sum"}
+        modes = {k: "incremental" for k in state}
+        shard_axes = {"tiles": 0}
+
+        def body_incr(st):
+            local = jax.tree_util.tree_map(lambda v: v[0], st)
+            carry = init_incremental(
+                local, reds, modes=modes, shard_axes=shard_axes, sync_every=1
+            )
+            stepped = {
+                "tiles": local["tiles"] * 2.0, "hits": local["hits"] + 1
+            }
+            carry = advance_incremental(
+                carry, stepped, reds, "data", modes=modes, shard_axes=shard_axes
+            )
+            out = finalize_incremental_state(
+                carry, reds, "data", modes=modes, shard_axes=shard_axes
+            )
+            return jax.tree_util.tree_map(lambda v: jnp.expand_dims(v, 0), out)
+
+        def body_def(st):
+            local = jax.tree_util.tree_map(lambda v: v[0], st)
+            stepped = {
+                "tiles": local["tiles"] * 2.0, "hits": local["hits"] + 1
+            }
+            out = sync_state(stepped, reds, "data", shard_axes=shard_axes)
+            return jax.tree_util.tree_map(lambda v: jnp.expand_dims(v, 0), out)
+
+        per_dev = jax.tree_util.tree_map(
+            lambda v: jnp.stack([v * (i + 1) for i in range(WORLD)]), state
+        )
+        kw = dict(mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_rep=False)
+        got = jax.jit(shard_map(body_incr, **kw))(per_dev)
+        ref = jax.jit(shard_map(body_def, **kw))(per_dev)
+        _assert_trees_bitwise(got, ref)
+
+    def test_sharded_emission_excludes_reshard_buckets(self):
+        state = {
+            "tiles": jnp.zeros((WORLD, 3), jnp.float32),
+            "hits": jnp.zeros((4,), jnp.int32),
+        }
+        reds = {"tiles": "sum", "hits": "sum"}
+        modes = {k: "incremental" for k in state}
+        carry = init_incremental(
+            state, reds, modes=modes, shard_axes={"tiles": 0}, sync_every=1
+        )
+        assert set(carry.acc) == {"hits"}  # the sharded leaf is residue
+        with count_collectives() as box:
+            jax.make_jaxpr(
+                lambda st: advance_incremental(
+                    carry, st, reds, "data", modes=modes, shard_axes={"tiles": 0}
+                ).acc,
+                axis_env=[("data", WORLD)],
+            )(state)
+        assert box["count"] == 1  # the int-sum fold bucket only
+
+
+# --------------------------------------------------- quantized composition ---
+class TestQuantizedComposition:
+    def test_cadence_compounds_the_error_bound(self):
+        state = {"total": jnp.zeros((256,), jnp.float32)}
+        reds = {"total": "sum"}
+        # tolerance wide enough to admit both scales: the planned bound must
+        # be the per-emission bound compounded by the emission ordinal
+        tol = metrics_tpu.transport_error_bound("bf16", WORLD) * 8.0
+        single = transport_plan(
+            state, reds, WORLD,
+            transports={"total": "bf16"}, tolerances={"total": tol},
+        )
+        fourth = transport_plan(
+            state, reds, WORLD,
+            transports={"total": "bf16"}, tolerances={"total": tol},
+            error_scale=4.0,
+        )
+        assert single[0]["transport"] == fourth[0]["transport"] == "bf16"
+        assert fourth[0]["bound"] == pytest.approx(single[0]["bound"] * 4.0)
+
+    def test_refusal_reports_effective_emission_count(self):
+        """A tolerance sized for the single-shot bound but not the 4th
+        compounded emission: the gate refuses and the record says which
+        emission ordinal's bound was judged."""
+        state = {"total": jnp.zeros((256,), jnp.float32)}
+        reds = {"total": "sum"}
+        tol = metrics_tpu.transport_error_bound("bf16", WORLD) * 2.0
+        ok = transport_plan(
+            state, reds, WORLD,
+            transports={"total": "bf16"}, tolerances={"total": tol},
+        )
+        assert ok[0]["refusal"] is None
+        refused = transport_plan(
+            state, reds, WORLD,
+            transports={"total": "bf16"}, tolerances={"total": tol},
+            error_scale=4.0,
+        )
+        assert refused[0]["transport"] == "exact"
+        assert refused[0]["refusal"]["reason"] == "error_budget"
+        assert refused[0]["refusal"]["emissions"] == 4
+
+    def test_quantized_emissions_track_real_ordinal(self):
+        """With a quantized transport on a covered leaf the carry tracks the
+        true emission ordinal (no saturation) so each emission's gate judges
+        the compounded bound; exact carries saturate at 1 instead."""
+        state = {"hits": jnp.zeros((16,), jnp.int32)}
+        reds = {"hits": "sum"}
+        modes = {"hits": "incremental"}
+
+        def streak(st0, transports):
+            ordinals = []
+            carry = init_incremental(
+                dict(st0), reds, modes=modes, sync_every=1, transports=transports
+            )
+            for _ in range(3):
+                state = {"hits": carry.state["hits"] + 1}
+                carry = advance_incremental(
+                    carry, state, reds, "data", modes=modes, transports=transports
+                )
+                ordinals.append(carry.emissions)
+            return ordinals
+
+        quant = []
+        exact = []
+        jax.make_jaxpr(
+            lambda st: (quant.extend(streak(st, {"hits": "sparse_count"})), jnp.zeros(()))[1],
+            axis_env=[("data", WORLD)],
+        )(state)
+        jax.make_jaxpr(
+            lambda st: (exact.extend(streak(st, None)), jnp.zeros(()))[1],
+            axis_env=[("data", WORLD)],
+        )(state)
+        assert quant == [1, 2, 3]  # real ordinals — the gate compounds
+        assert exact == [1, 1, 1]  # saturated — bounded jit signatures
+
+
+# -------------------------------------------------- engine / partition view --
+class TestEngineIntegration:
+    def _config2(self):
+        return MetricCollection(
+            {
+                "acc": Accuracy(num_classes=5, average="micro"),
+                "prec": Precision(num_classes=5, average="macro"),
+                "rec": Recall(num_classes=5, average="macro"),
+            }
+        )
+
+    def test_classifier_follows_the_resolved_mode(self):
+        m = Accuracy(num_classes=5)
+        assert classify_incremental_member(m)[0] == "deferred"
+        metrics_tpu.set_sync_mode("incremental")
+        path, reason = classify_incremental_member(m)
+        assert path == "incremental"
+        assert "emission" in reason
+
+    def test_partition_view_reports_incremental_section(self):
+        coll = self._config2()
+        rng = np.random.default_rng(0)
+        preds = jnp.asarray(rng.standard_normal((32, 5)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, 5, 32))
+        coll.update(preds, target)
+        view = coll._dispatcher.partition_view()
+        assert set(view["incremental"]) == set(coll._metrics)
+        assert all(
+            info["path"] in ("incremental", "deferred")
+            for info in view["incremental"].values()
+        )
+        assert all(
+            info["path"] == "deferred" for info in view["incremental"].values()
+        )
+
+    def test_mode_flip_rekeys_partition_exactly_once(self):
+        coll = self._config2()
+        rng = np.random.default_rng(1)
+        preds = jnp.asarray(rng.standard_normal((32, 5)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, 5, 32))
+        for _ in range(4):
+            coll.update(preds, target)
+        assert coll._dispatcher.stats.builds == 1
+        metrics_tpu.set_sync_mode("incremental")
+        try:
+            coll.update(preds, target)
+            stats = coll._dispatcher.stats
+            assert stats.repartitions == 1
+            view = coll._dispatcher.partition_view()
+            assert all(
+                info["path"] == "incremental"
+                for info in view["incremental"].values()
+            )
+            # steady state after the flip: no further churn
+            for _ in range(3):
+                coll.update(preds, target)
+            assert coll._dispatcher.stats.repartitions == 1
+        finally:
+            metrics_tpu.set_sync_mode(None)
+
+    def test_default_deferred_path_is_structurally_unchanged(self):
+        """With the mode ladder at its default every leaf routes deferred and
+        sync_states traces to exactly the canonical bucketed program — the
+        incremental machinery is invisible until opted into."""
+        m = Accuracy(num_classes=5)
+        plan = m.incremental_plan(m.init_state())
+        assert all(e["mode"] == "deferred" for e in plan.values())
+        state = m.init_state()
+        jx_now = str(
+            jax.make_jaxpr(
+                lambda st: m.sync_states(st, "data"), axis_env=[("data", WORLD)]
+            )(state)
+        )
+        jx_raw = str(
+            jax.make_jaxpr(
+                lambda st: sync_state(st, m._reductions, "data"),
+                axis_env=[("data", WORLD)],
+            )(state)
+        )
+        assert jx_now == jx_raw
+
+    def test_collection_carry_protocol_round_trips(self):
+        coll = self._config2()
+        rng = np.random.default_rng(5)
+        preds = jnp.asarray(rng.standard_normal((32, 5)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, 5, 32))
+        states = coll.init_state()
+        carries = coll.init_incremental(states, sync_every=2)
+        assert set(carries) == {g[0] for g in coll._groups}
+        carries = coll.update_state_incremental(carries, preds, target)
+        out = coll.finalize_incremental(carries)
+        ref = {g[0]: coll._metrics[g[0]].update_state(states[g[0]], preds, target)
+               for g in coll._groups}
+        _assert_trees_bitwise(out, ref)  # axis-free: deferred-equivalent
+        vals = coll.sync_compute_incremental(
+            coll.update_state_incremental(coll.init_incremental(states), preds, target)
+        )
+        ref_vals = coll.compute_state(ref)
+        for name in ref_vals:
+            np.testing.assert_array_equal(
+                np.asarray(vals[name]), np.asarray(ref_vals[name])
+            )

@@ -289,6 +289,45 @@ class TestPrometheusRoundTrip:
         finally:
             get_registry().clear()
 
+    def test_incremental_sync_series_parse_strictly(self):
+        """ISSUE-15: tracing an incremental streak ticks
+        metrics_tpu_engine_incremental_emissions_total (one per emission) and
+        sets metrics_tpu_engine_incremental_deferred_residue_buckets to the
+        collectives the finalize still paid — both parse through the strict
+        exposition with the right family types."""
+        import jax
+        import jax.numpy as jnp
+        from metrics_tpu.observability.instruments import get_registry
+        from metrics_tpu.parallel.sync import (
+            advance_incremental, finalize_incremental_state, init_incremental,
+        )
+
+        get_registry().clear()
+        try:
+            reds = {"hits": "sum", "rows": "cat"}
+            modes = {"hits": "incremental"}
+
+            def streak(state):
+                carry = init_incremental(dict(state), reds, modes=modes, sync_every=1)
+                for _ in range(3):
+                    stepped = {"hits": carry.state["hits"] + 1, "rows": carry.state["rows"]}
+                    carry = advance_incremental(carry, stepped, reds, "data", modes=modes)
+                return finalize_incremental_state(carry, reds, "data", modes=modes)
+
+            jax.make_jaxpr(streak, axis_env=[("data", 8)])(
+                {"hits": jnp.zeros((8,), jnp.int32), "rows": jnp.zeros((2, 3), jnp.float32)}
+            )
+            text = obs.to_prometheus_text(get_registry())
+            families, samples = _StrictPromParser().parse(text)
+            by_name = {s[0]: s for s in samples}
+            assert by_name["metrics_tpu_engine_incremental_emissions_total"][2] == 3.0
+            # the cat leaf is residue: the finalize paid exactly its gather
+            assert by_name["metrics_tpu_engine_incremental_deferred_residue_buckets"][2] == 1.0
+            assert families["metrics_tpu_engine_incremental_emissions_total"]["type"] == "counter"
+            assert families["metrics_tpu_engine_incremental_deferred_residue_buckets"]["type"] == "gauge"
+        finally:
+            get_registry().clear()
+
     def test_awkward_label_values_round_trip(self):
         reg = InstrumentRegistry()
         awkward = 'quote " backslash \\ newline \n tab\tdone'

@@ -8,6 +8,7 @@ The quick single-seed case runs in the tier-1 gate; the full 3-seed sweep is
 ``slow``."""
 import contextlib
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -129,3 +130,76 @@ def test_three_seed_sweep_is_bitwise_equal():
         faulted, stats = _eval_loop(seed=seed)
         assert stats["fired"] > 0
         assert faulted == baseline, f"seed {seed} diverged from the fault-free run"
+
+
+# --------------------------------------------------------------------------- #
+# ISSUE-15: the sync/incremental site — in-streak emissions under chaos
+# --------------------------------------------------------------------------- #
+def _incremental_streak(seed=None, steps=4):
+    """A pmap incremental streak over integer-sum state; returns the final
+    globally-synced bytes plus how many emission faults fired."""
+    from metrics_tpu.parallel.sync import (
+        advance_incremental, finalize_incremental_state, init_incremental,
+    )
+
+    reds = {"hits": "sum"}
+    modes = {"hits": "incremental"}
+    n_dev = jax.local_device_count()
+
+    def run(xs):
+        carry = init_incremental(
+            {"hits": jnp.zeros((4,), jnp.int32)}, reds, modes=modes, sync_every=1
+        )
+        for i in range(steps):
+            state = {"hits": carry.state["hits"] + xs[i]}
+            carry = advance_incremental(carry, state, reds, "i", modes=modes)
+        return finalize_incremental_state(carry, reds, "i", modes=modes)["hits"]
+
+    xs = jnp.arange(n_dev * steps * 4, dtype=jnp.int32).reshape(n_dev, steps, 4)
+    with contextlib.ExitStack() as stack:
+        plan_ = None
+        if seed is not None:
+            plan_ = stack.enter_context(
+                chaos.plan(
+                    [FaultSpec("sync/incremental", kind="latency",
+                               probability=0.5, latency_s=0.0)],
+                    seed=seed,
+                )
+            )
+        out = np.asarray(jax.pmap(run, axis_name="i")(xs)).tobytes()
+        fired = plan_.fired("sync/incremental") if plan_ is not None else 0
+    return out, fired
+
+
+def test_incremental_emission_fault_fires_at_trace_time():
+    from metrics_tpu.parallel.sync import (
+        advance_incremental, init_incremental,
+    )
+
+    reds = {"hits": "sum"}
+    modes = {"hits": "incremental"}
+    n_dev = jax.local_device_count()
+
+    def f(v):
+        carry = init_incremental(
+            {"hits": jnp.zeros((4,), jnp.int32)}, reds, modes=modes, sync_every=1
+        )
+        return advance_incremental(
+            carry, {"hits": v}, reds, "i", modes=modes
+        ).acc["hits"]
+
+    x = jnp.ones((n_dev, 4), jnp.int32)
+    with chaos.plan([FaultSpec("sync/incremental", nth=1)]) as p:
+        with pytest.raises(chaos.ChaosError):
+            jax.pmap(f, axis_name="i")(x)
+    assert p.fired("sync/incremental") == 1
+
+
+def test_incremental_streak_seeded_sweep_is_bitwise_equal():
+    """Seeded latency faults at every emission leave the finalized state
+    bitwise-identical to the fault-free streak, for every seed."""
+    baseline, _ = _incremental_streak(seed=None)
+    for seed in (0, 1, 2):
+        faulted, fired = _incremental_streak(seed=seed)
+        assert fired > 0, "the plan must actually hit emissions"
+        assert faulted == baseline

@@ -198,3 +198,66 @@ class TestErrors:
 
         with pytest.raises(ValueError, match="non-elementwise"):
             trace()
+
+
+class TestIncrementalStacked:
+    """ISSUE-15: the stacked incremental carry keeps the tenant axis folded
+    into the flat buckets — per-emission collective count independent of N,
+    finalize bitwise-equal to the deferred sync_states over the same states,
+    and a zero-collective finalize when the cadence divides the streak."""
+
+    def _emission_count(self, capacity, n_admit):
+        ts, _ = _tenant_set(capacity, n_admit)
+        carry = ts.init_incremental_sync(ts.stacked_states)
+        with count_collectives() as box:
+            jax.make_jaxpr(
+                lambda st: ts.advance_incremental_sync(carry, st, "data").acc,
+                axis_env=[("data", 8)],
+            )(ts.stacked_states)
+        return box
+
+    def test_emission_count_independent_of_capacity(self):
+        b_small = self._emission_count(16, 3)
+        b_large = self._emission_count(1024, 37)
+        # one (sum, f32) bucket + one (max, f32) bucket per emission, any N
+        assert b_small["count"] == b_large["count"] == 2
+        assert b_small["by_kind"] == b_large["by_kind"]
+
+    def test_finalize_after_emission_is_collective_free(self):
+        ts, _ = _tenant_set(16, 3)
+
+        def streak(st):
+            carry = ts.init_incremental_sync(st)
+            carry = ts.advance_incremental_sync(carry, st, "data")
+            with count_collectives() as fin_box:
+                ts.finalize_incremental_sync(carry, "data")
+            boxes.append(fin_box["count"])
+            return jnp.zeros(())
+
+        boxes = []
+        jax.make_jaxpr(streak, axis_env=[("data", 8)])(ts.stacked_states)
+        assert boxes == [0]  # every bucket was already emitted in-streak
+
+    def test_pmap_parity_with_deferred_sync(self):
+        n_dev = jax.local_device_count()
+        assert n_dev == 8
+        ts, _ = _tenant_set(8, 5)
+        base = ts.stacked_states
+        dev_stacked = jax.tree_util.tree_map(
+            lambda v: jnp.stack([v * (d + 1.0) for d in range(n_dev)]), base
+        )
+
+        def run_incr(st):
+            carry = ts.init_incremental_sync(st)
+            carry = ts.advance_incremental_sync(carry, st, "data")
+            return ts.finalize_incremental_sync(carry, "data")
+
+        got = jax.pmap(run_incr, axis_name="data")(dev_stacked)
+        ref = jax.pmap(
+            lambda st: ts.sync_states(st, "data"), axis_name="data"
+        )(dev_stacked)
+        for lname, st in ref.items():
+            for name, leaf in st.items():
+                np.testing.assert_array_equal(
+                    np.asarray(got[lname][name]), np.asarray(leaf)
+                )
