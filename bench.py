@@ -2400,6 +2400,262 @@ def bench_incremental_sync() -> None:
         sys.exit(1)
 
 
+def _heavy_kernels_child() -> None:
+    """``--child heavy_kernels``: the compiled heavy-kernel layer end to end.
+
+    mAP: 64 ragged synthetic COCO images through the device-resident state
+    (pow2-padded CatBuffers + the fused ``iou_matching`` kernel) versus the
+    pre-change host-list eager path (``device_state=False``) — update+compute
+    wall time, steady-state recompiles read off the kernel trace counters and
+    the update-engine stats, results bitwise-compared. BERTScore: pad-on-append
+    packed-cache copy work at N versus 4N updates (the amortized-O(1) claim —
+    the legacy ``_cat_padded`` re-pad did O(N^2) work over a
+    compute-after-every-update stream) plus an interleaved-compute timing
+    against the forced fallback, byte-identical scores required. One JSON
+    line on stdout."""
+    import jax
+
+    from metrics_tpu import BERTScore
+    from metrics_tpu.detection import MeanAveragePrecision
+    from metrics_tpu.ops import kernels as K
+
+    out = {"platform": jax.default_backend()}
+
+    # ------------------------- mAP end to end ------------------------------ #
+    rng = np.random.default_rng(7)
+    n_img, n_cls, per_batch = 64, 20, 8
+
+    def boxes(n):
+        xy = rng.uniform(0, 400, size=(n, 2))
+        wh = rng.uniform(8, 120, size=(n, 2))
+        return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+    preds, targets = [], []
+    for _ in range(n_img):
+        nd = int(rng.integers(4, 50))
+        ng = int(rng.integers(2, 16))
+        preds.append({
+            "boxes": boxes(nd),
+            "scores": rng.uniform(size=(nd,)).astype(np.float32),
+            "labels": rng.integers(0, n_cls, size=(nd,)).astype(np.int32),
+        })
+        targets.append({
+            "boxes": boxes(ng),
+            "labels": rng.integers(0, n_cls, size=(ng,)).astype(np.int32),
+        })
+    batches = [
+        (preds[i:i + per_batch], targets[i:i + per_batch])
+        for i in range(0, n_img, per_batch)
+    ]
+
+    def one_pass(metric):
+        for p, t in batches:
+            metric.update(p, t)
+        res = metric.compute()
+        jax.block_until_ready(res["map"])
+        return res
+
+    def timed(build, reps):
+        m = build()
+        one_pass(m)  # warmup: every pow2 bucket this stream hits gets traced
+        trace_before = dict(K.trace_counts())
+        eng = getattr(m, "_update_engine", None)
+        misses_before = eng.stats.cache_misses if eng is not None else 0
+        best, res = float("inf"), None
+        for _ in range(reps):
+            m.reset()
+            t0 = time.perf_counter()
+            res = one_pass(m)
+            best = min(best, time.perf_counter() - t0)
+        trace_after = dict(K.trace_counts())
+        retraces = sum(trace_after.values()) - sum(trace_before.values())
+        misses_after = eng.stats.cache_misses if eng is not None else 0
+        return best, res, retraces + (misses_after - misses_before)
+
+    device_s, device_res, device_retraces = timed(
+        lambda: MeanAveragePrecision(device_state=True), reps=3)
+    legacy_s, legacy_res, _ = timed(
+        lambda: MeanAveragePrecision(device_state=False), reps=2)
+    out["map"] = {
+        "n_images": n_img,
+        "legacy_eager_s": legacy_s,
+        "device_state_s": device_s,
+        "e2e_speedup_x": legacy_s / device_s,
+        "steady_recompiles": int(device_retraces),
+        "parity_bitwise": bool(np.array_equal(
+            np.asarray(device_res["map"]), np.asarray(legacy_res["map"]))),
+        "map_value": float(np.asarray(device_res["map"])),
+        "trace_counts": dict(K.trace_counts()),
+    }
+
+    # --------------------------- BERTScore --------------------------------- #
+    table = np.random.default_rng(1).normal(
+        size=(len(_BERT_VOCAB), _BERT_DIM)).astype(np.float32)
+
+    class VarWidthTok:
+        """Width follows the longest sentence in the batch — a ragged stream,
+        the shape regime the packed cache has to absorb without re-padding."""
+
+        def __call__(self, sentences):
+            width = max(len(s.split()) for s in sentences) + 2
+            ids = np.full((len(sentences), width), _BERT_VOCAB.index("[PAD]"), dtype=np.int32)
+            mask = np.zeros((len(sentences), width), dtype=np.int32)
+            for row, sent in enumerate(sentences):
+                tokens = ["[CLS]"] + sent.split()[: width - 2] + ["[SEP]"]
+                for col, tok in enumerate(tokens):
+                    ids[row, col] = _BERT_VOCAB.index(tok)
+                    mask[row, col] = 1
+            return {"input_ids": ids, "attention_mask": mask}
+
+    def build_bert():
+        return BERTScore(
+            model=object(),
+            user_tokenizer=VarWidthTok(),
+            user_forward_fn=lambda model, b: table[np.asarray(b["input_ids"])],
+            max_length=_BERT_MAX_LEN,
+            batch_size=64,
+        )
+
+    def feed(metric, n_updates, seed=0):
+        srng = np.random.default_rng(seed)
+        words = _BERT_VOCAB[3:]
+        make = lambda: " ".join(srng.choice(words, size=srng.integers(3, 9)))
+        for _ in range(n_updates):
+            metric.update([make() for _ in range(4)], [make() for _ in range(4)])
+
+    def copied_after(n_updates):
+        m = build_bert()
+        feed(m, n_updates)
+        return m._packed_stats["rows_copied"]
+
+    copied_1x = copied_after(24)
+    copied_4x = copied_after(96)
+    copied_growth = copied_4x / max(copied_1x, 1)
+
+    def interleaved(force_fallback):
+        m = build_bert()
+        srng = np.random.default_rng(3)
+        words = _BERT_VOCAB[3:]
+        make = lambda: " ".join(srng.choice(words, size=srng.integers(3, 9)))
+        total, res = 0.0, None
+        for i in range(48):
+            m.update([make() for _ in range(4)], [make() for _ in range(4)])
+            if (i + 1) % 8 == 0:
+                if force_fallback:
+                    m._packed = {}
+                t0 = time.perf_counter()
+                res = m.compute()
+                total += time.perf_counter() - t0
+        return total, np.asarray(res["f1"])
+
+    interleaved(force_fallback=False)  # warmup: both variants hit the same shapes
+    packed_s, f1_packed = interleaved(force_fallback=False)
+    fallback_s, f1_fallback = interleaved(force_fallback=True)
+    out["bert"] = {
+        "updates_1x": 24,
+        "updates_4x": 96,
+        "rows_copied_1x": int(copied_1x),
+        "rows_copied_4x": int(copied_4x),
+        # linear (amortized O(1) per row) growth is ~4x across a 4x stream;
+        # the legacy quadratic re-pad grows ~16x
+        "copied_growth_over_4x_stream": copied_growth,
+        "interleaved_packed_s": packed_s,
+        "interleaved_fallback_s": fallback_s,
+        "interleaved_speedup_x": fallback_s / max(packed_s, 1e-9),
+        "parity_bitwise": bool(np.array_equal(f1_packed, f1_fallback)),
+    }
+
+    print(json.dumps(out), flush=True)
+
+
+def bench_heavy_kernels() -> None:
+    """``--heavy-kernels``: the compiled heavy-kernel layer (ops/kernels/) —
+    device-resident mAP through the fused ``iou_matching`` kernel versus the
+    pre-change host-list eager path, and the BERTScore pad-on-append packed
+    cache versus the quadratic ``_cat_padded`` re-pad; recorded into
+    ``BENCH_r21.json`` and judged by the regression watchdog. Host-side CPU
+    bench (child process pinned to the CPU backend).
+
+    Hard gates: mAP end-to-end (update+compute, 64 ragged images) >= 3x over
+    the eager path with 0 steady-state recompiles after warmup, bitwise mAP
+    parity, BERTScore packed copy work growing linearly (not quadratically)
+    over a 4x update stream, and byte-identical BERTScore results."""
+    import glob as _glob
+
+    from metrics_tpu.observability import regress as _regress
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    child = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", "heavy_kernels"],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=1500.0,
+        cwd=REPO,
+    )
+    if child.returncode != 0:
+        raise RuntimeError(f"heavy-kernels child failed:\n{child.stderr[-2000:]}")
+    res = json.loads(child.stdout.strip().splitlines()[-1])
+
+    record = {
+        # headline: end-to-end 64-image ragged mAP speedup of the
+        # device-resident kernel path over the host-list eager path
+        "metric": "heavy_map_e2e_speedup_x",
+        "value": res["map"]["e2e_speedup_x"],
+        "unit": "x",
+        "extra": {
+            "platform": res["platform"],
+            "map": res["map"],
+            "bert": res["bert"],
+        },
+    }
+
+    # watchdog self-check: judge this round against the checked-in trajectory
+    rounds = [
+        r
+        for r in _regress.load_rounds(sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json"))))
+        if r.name != "r21"
+    ]
+    rounds.append(_regress.Round("r21", "<this-run>", record))
+    report = _regress.check_trajectory(rounds)
+    record["extra"]["regress"] = {
+        "ok": report.ok,
+        "regression_count": len(report.regressions),
+        "keys_checked": report.keys_checked,
+        "regressions": [r.describe() for r in report.regressions],
+    }
+
+    with open(os.path.join(REPO, "BENCH_r21.json"), "w") as fh:
+        json.dump(record, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(record), flush=True)
+
+    problems = []
+    m = res["map"]
+    if m["e2e_speedup_x"] < 3.0:
+        problems.append(f"mAP end-to-end speedup {m['e2e_speedup_x']:.2f}x < 3x")
+    if m["steady_recompiles"] != 0:
+        problems.append(f"mAP device path: {m['steady_recompiles']} steady-state recompiles after warmup (want 0)")
+    if not m["parity_bitwise"]:
+        problems.append("mAP device-state result differs from the host-list path (bitwise)")
+    b = res["bert"]
+    if b["copied_growth_over_4x_stream"] > 8.0:
+        problems.append(
+            f"BERTScore packed copy work grew {b['copied_growth_over_4x_stream']:.1f}x over a "
+            "4x update stream (linear is ~4x, the quadratic re-pad is ~16x)"
+        )
+    if not b["parity_bitwise"]:
+        problems.append("BERTScore packed scores differ from the _cat_padded fallback (bitwise)")
+    if not report.ok:
+        problems.extend(r.describe() for r in report.regressions)
+    if problems:
+        print("[bench] heavy-kernels round FAILED its gates:", file=sys.stderr)
+        for p in problems:
+            print(f"[bench]   {p}", file=sys.stderr)
+        sys.exit(1)
+
+
 def bench_quantized_sync() -> None:
     """``--quantized-sync``: wire-byte reduction and measured quantization
     error of the bf16/int8 (and sparse_count) sync transports on the 8-device
@@ -3658,8 +3914,18 @@ def main() -> None:
         "burst byte reduction >= 2x, zero retraces after warmup",
     )
     parser.add_argument(
+        "--heavy-kernels",
+        action="store_true",
+        help="measure the compiled heavy-kernel layer: device-resident mAP "
+        "(fused iou_matching kernel, 64 ragged images, update+compute) vs the "
+        "host-list eager path, and the BERTScore pad-on-append packed cache "
+        "vs the quadratic _cat_padded re-pad; record into BENCH_r21.json; "
+        "gates: >= 3x mAP speedup, 0 steady-state recompiles, linear packed "
+        "copy growth, bitwise parity both ways",
+    )
+    parser.add_argument(
         "--child",
-        choices=["sync_overhead", "sharded_state", "sharded_compute", "quantized_sync", "incremental_sync", *_CHILD_BENCHES],
+        choices=["sync_overhead", "sharded_state", "sharded_compute", "quantized_sync", "incremental_sync", "heavy_kernels", *_CHILD_BENCHES],
     )
     parser.add_argument(
         "--sync-scaling",
@@ -3712,6 +3978,9 @@ def main() -> None:
     if args.incremental_sync:
         bench_incremental_sync()
         return
+    if args.heavy_kernels:
+        bench_heavy_kernels()
+        return
     if args.sync_scaling:
         out = {}
         for w in (2, 4, 8, 16):
@@ -3737,6 +4006,9 @@ def main() -> None:
         return
     if args.child == "incremental_sync":
         _incremental_sync_child()
+        return
+    if args.child == "heavy_kernels":
+        _heavy_kernels_child()
         return
     if args.child in _CHILD_BENCHES:
         import jax

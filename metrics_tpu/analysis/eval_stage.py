@@ -100,6 +100,129 @@ def instantiate(entry: Entry) -> Optional[Finding]:
     return None
 
 
+# attribute names / constructor callees that signal a held model forward
+# (the E114 heuristic: a metric that owns an encoder/backbone and calls it
+# outside the compiled engines is heavy-eager unless a kernel path is declared)
+_MODEL_ATTR_NAMES = ("model", "net", "inception", "encoder", "backbone", "feature_extractor")
+_MODEL_CALLEE_HINTS = ("from_pretrained", "FeatureExtractor", "Net", "resolve_feature_extractor")
+
+
+def _heavy_eager_residue(entry: Entry) -> List[Finding]:
+    """The E114 leg — purely static (AST over the class source), so it runs
+    even for metrics whose eval sweep is skipped (which is exactly where the
+    model-forward heavies live).
+
+    Fires when the class (a) assigns a model-like attribute in ``__init__``
+    (name in :data:`_MODEL_ATTR_NAMES`, or built by a constructor matching
+    :data:`_MODEL_CALLEE_HINTS`) and uses it from update/compute-reachable
+    code, or (b) runs a per-item Python loop calling back into ``self`` from a
+    compute-reachable method — and declares no ``heavy_kernels`` path. A
+    declaration clears the finding iff every named kernel exists in the
+    ``ops/kernels`` registry."""
+    import ast
+    import inspect
+    import textwrap
+
+    from metrics_tpu.ops.kernels import KERNELS
+
+    declared = tuple(getattr(entry.cls, "heavy_kernels", ()) or ())
+    if declared:
+        unknown = sorted(set(declared) - set(KERNELS))
+        if unknown:
+            return [
+                Finding(
+                    rule="E114",
+                    obj=entry.name,
+                    message=f"heavy_kernels declares {unknown} which are not in the "
+                    f"ops/kernels registry {sorted(KERNELS)} — the declaration "
+                    f"vouches for a kernel path that does not exist",
+                    extra={"declared": declared, "unknown": tuple(unknown)},
+                )
+            ]
+        return []
+
+    try:
+        tree = ast.parse(textwrap.dedent(inspect.getsource(entry.cls)))
+    except (OSError, TypeError, SyntaxError):
+        return []
+    cls_node = next((n for n in tree.body if isinstance(n, ast.ClassDef)), None)
+    if cls_node is None:
+        return []
+    methods = {n.name: n for n in cls_node.body if isinstance(n, ast.FunctionDef)}
+
+    def _self_attr(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) and node.value.id == "self":
+            return node.attr
+        return None
+
+    # update/compute-reachable methods (transitive self.<m>() closure)
+    reachable: List[str] = []
+    work = [m for m in ("update", "_update", "update_state", "compute", "_compute", "compute_state") if m in methods]
+    while work:
+        name = work.pop()
+        if name in reachable:
+            continue
+        reachable.append(name)
+        for node in ast.walk(methods[name]):
+            if isinstance(node, ast.Call):
+                callee = _self_attr(node.func)
+                if callee in methods:
+                    work.append(callee)
+
+    # (a) model attribute assigned in __init__, consumed in reachable code
+    model_attrs: Dict[str, int] = {}
+    for node in ast.walk(methods.get("__init__", ast.Pass())):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            callee = node.value.func
+            callee_name = getattr(callee, "attr", None) or getattr(callee, "id", "") or ""
+            for target in node.targets:
+                attr = _self_attr(target)
+                if attr is None:
+                    continue
+                if attr.lstrip("_") in _MODEL_ATTR_NAMES or any(h in callee_name for h in _MODEL_CALLEE_HINTS):
+                    model_attrs.setdefault(attr, node.lineno)
+
+    findings: List[Finding] = []
+    used = {
+        attr
+        for name in reachable
+        for node in ast.walk(methods[name])
+        if (attr := _self_attr(node)) in model_attrs
+    }
+    if used:
+        findings.append(
+            Finding(
+                rule="E114",
+                obj=entry.name,
+                message=f"model attribute(s) {sorted(used)} run their forward outside the "
+                f"compiled engines and no heavy_kernels path is declared — route the "
+                f"forward through metrics_tpu/ops/kernels/ and declare it",
+                extra={"model_attrs": tuple(sorted(used))},
+            )
+        )
+    # (b) per-item Python loop calling back into self from compute-reachable code
+    compute_reachable = [m for m in reachable if not m.startswith(("update", "_update"))]
+    for name in compute_reachable:
+        for node in ast.walk(methods[name]):
+            if isinstance(node, (ast.For, ast.While)) and any(
+                isinstance(sub, ast.Call) and _self_attr(sub.func) is not None for sub in ast.walk(node)
+            ):
+                findings.append(
+                    Finding(
+                        rule="E114",
+                        obj=f"{entry.name}.{name}",
+                        message=f"per-item Python loop at line {node.lineno} calls back into "
+                        f"self outside the compiled engines and no heavy_kernels path is "
+                        f"declared — each item pays an eager dispatch the engines cannot "
+                        f"fuse or bucket",
+                        line=node.lineno,
+                        extra={"loop_method": name},
+                    )
+                )
+                break  # one finding per method is enough signal
+    return findings
+
+
 def _evaluate_sharded(entry: Entry, inst: Any, state: Any) -> List[Finding]:
     """The E108 leg: sharded-state sync routing for ``shard_axis`` declarers.
 
@@ -299,6 +422,13 @@ def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Findi
             )
         )
         return findings
+    # E114 is source-static: it runs before (and survives) the skip_eval and
+    # engine-ineligible early exits — the model-forward heavies live there
+    for f in _heavy_eager_residue(entry):
+        if f.rule in entry.allow:
+            f.suppressed = True
+        findings.append(f)
+
     if entry.skip_eval:
         entry.notes.append(f"eval skipped: {entry.skip_eval}")
         return findings

@@ -224,6 +224,17 @@ _EVAL_RULES = (
         "add_state(..., sync_mode='incremental') or widen set_sync_mode to "
         "move these buckets into the donated streak.",
     ),
+    Rule(
+        "E114", "heavy-eager-residue", WARNING,
+        "this metric holds a model/encoder attribute (or runs a per-item "
+        "Python loop at compute) whose forward executes outside the compiled "
+        "engines, and declares no heavy-kernel path — every update/compute "
+        "pays an un-batched eager model call the engines cannot fuse, donate, "
+        "or bucket. Route the heavy op through metrics_tpu/ops/kernels/ (see "
+        "docs/heavy_kernels.md) and declare it with a `heavy_kernels = "
+        "(\"<kernel>\", ...)` class attribute; an unknown kernel name in that "
+        "declaration is also flagged.",
+    ),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in (*_AST_RULES, *_EVAL_RULES)}

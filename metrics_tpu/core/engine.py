@@ -456,9 +456,16 @@ class _EngineBase:
             return fn(state, *args, **kwargs)
 
     def _dispatch(self, plain_fn: Callable, donate_fn: Callable,
-                  state: Any, args: Tuple, kwargs: Dict, protected: set) -> Tuple[bool, Any]:
-        """Core cache dance. Returns (handled, result)."""
+                  state: Any, args: Tuple, kwargs: Dict, protected: set,
+                  key_extra: Tuple = ()) -> Tuple[bool, Any]:
+        """Core cache dance. Returns (handled, result).
+
+        ``key_extra`` folds caller-supplied compile-time constants (static
+        update kwargs) into the dispatch key: the aval signature records only
+        the *type* of non-array leaves, so two calls differing in a static
+        VALUE (``real=True`` vs ``real=False``) must not share an entry."""
         key = (
+            key_extra,
             self._args_sig.signature((args, kwargs), self.stats),
             self._state_sig.signature(state, self.stats),
         )
@@ -598,6 +605,20 @@ class CompiledUpdateEngine(_EngineBase):
 
         self._jit_plain = jax.jit(_update_constrained)
         self._jit_donate = jax.jit(_update_constrained, donate_argnums=(0,))
+        # declared compile-time-constant update kwargs (e.g. FID's `real`):
+        # their VALUES are closed over in per-value jit variants instead of
+        # being traced — the historical reason the model-forward heavies broke
+        # their engines on the first compiled call
+        self._static_names = tuple(getattr(metric, "_static_update_kwargs", ()) or ())
+        self._static_jits: Dict[Tuple, Tuple[Callable, Callable]] = {}
+        self._update_sig = None
+        if self._static_names:
+            import inspect
+
+            try:
+                self._update_sig = inspect.signature(metric._update)
+            except (TypeError, ValueError):
+                self._static_names = ()
         # pad+mask bucketing needs the update to accept a validity mask
         mask_ok = getattr(metric, "_accepts_sample_mask", False)
         if mask_ok:
@@ -621,19 +642,72 @@ class CompiledUpdateEngine(_EngineBase):
             return False
         if not m.supports_compiled_update:
             return False
+        # per-call gate: a metric accepting several input forms (e.g. mAP's
+        # COCO lists vs dense padded dicts) declines the uncompilable ones
+        # here WITHOUT tripping the permanent `_broken` fallback
+        accepts = getattr(m, "_engine_accepts", None)
+        if accepts is not None and not accepts(args, kwargs):
+            return False
         if _tracing_active() or not _leaves_compilable((args, kwargs)):
             return False
+        statics: Tuple = ()
+        if self._static_names:
+            split = self._split_statics(args, kwargs)
+            if split is not None:
+                args, kwargs, statics = split
         if getattr(m, "_batch_buckets", False):
-            return self._dispatch_bucketed(args, kwargs)
-        return self._dispatch_compiled(args, kwargs)
+            return self._dispatch_bucketed(args, kwargs, statics)
+        return self._dispatch_compiled(args, kwargs, statics)
 
-    def _dispatch_compiled(self, args: Tuple, kwargs: Dict) -> bool:
+    def _split_statics(self, args: Tuple, kwargs: Dict) -> Optional[Tuple[Tuple, Dict, Tuple]]:
+        """Extract the declared static kwargs (wherever they were passed —
+        positionally or by name) into a hashable ``((name, value), ...)``
+        tuple; remaining arguments are rebuilt as kwargs. None = this call
+        can't be split (unbindable / non-internable value): trace as-is."""
+        try:
+            bound = self._update_sig.bind(*args, **kwargs)
+        except TypeError:
+            return None
+        bound.apply_defaults()
+        arguments = dict(bound.arguments)
+        for param in self._update_sig.parameters.values():
+            if param.kind in (param.VAR_POSITIONAL, param.VAR_KEYWORD) and param.name in arguments:
+                return None
+        statics = []
+        for name in self._static_names:
+            if name not in arguments:
+                return None
+            value = arguments.pop(name)
+            if not isinstance(value, _INTERNABLE_TYPES):
+                return None
+            statics.append((name, value))
+        return (), arguments, tuple(statics)
+
+    def _jits_for(self, statics: Tuple) -> Tuple[Callable, Callable]:
+        if not statics:
+            return self._jit_plain, self._jit_donate
+        pair = self._static_jits.get(statics)
+        if pair is None:
+            metric = self.metric
+            static_kwargs = dict(statics)
+
+            def _update_constrained(state, *args, **kwargs):
+                merged = dict(kwargs, **static_kwargs)
+                return metric._constrain_state(metric.update_state(state, *args, **merged))
+
+            pair = (jax.jit(_update_constrained), jax.jit(_update_constrained, donate_argnums=(0,)))
+            self._static_jits[statics] = pair
+        return pair
+
+    def _dispatch_compiled(self, args: Tuple, kwargs: Dict, statics: Tuple = ()) -> bool:
         m = self.metric
         state = m.get_state()
         shared = m._shared_state_ids
+        plain_fn, donate_fn = self._jits_for(statics)
         handled, new_state = self._dispatch(
-            self._jit_plain, self._jit_donate, state, args, kwargs,
+            plain_fn, donate_fn, state, args, kwargs,
             self._default_ids | shared if shared else self._default_ids,
+            key_extra=statics,
         )
         if handled:
             m.set_state(new_state)
@@ -652,14 +726,15 @@ class CompiledUpdateEngine(_EngineBase):
                 break
         return (leaves, treedef), n
 
-    def _dispatch_bucketed(self, args: Tuple, kwargs: Dict) -> bool:
+    def _dispatch_bucketed(self, args: Tuple, kwargs: Dict, statics: Tuple = ()) -> bool:
         """Pad to a power-of-two bucket (mask-capable metrics) or split the
         batch into power-of-two chunks, so ragged batches reuse at most
         log2(N) compiled signatures."""
         m = self.metric
+        static_kwargs = dict(statics)
         (leaves, treedef), n = self._batch_leaves(args, kwargs)
         if not n:
-            return False if n is None else self._dispatch_compiled(args, kwargs)
+            return False if n is None else self._dispatch_compiled(args, kwargs, statics)
         self.stats.bucketed_calls += 1
 
         if self._mask_param is not None and self._mask_param not in kwargs:
@@ -677,8 +752,8 @@ class CompiledUpdateEngine(_EngineBase):
             # padded and unpadded batches of one bucket share a signature
             kwargs = dict(kwargs)
             kwargs[self._mask_param] = jnp.arange(bucket) < n
-            if not self._dispatch_compiled(args, kwargs):
-                m._update(*args, **kwargs)
+            if not self._dispatch_compiled(args, kwargs, statics):
+                m._update(*args, **dict(kwargs, **static_kwargs))
             return True
 
         # chunk decomposition: exact whenever the update is row-decomposable
@@ -690,8 +765,8 @@ class CompiledUpdateEngine(_EngineBase):
                 else leaf
             )
             c_args, c_kwargs = jax.tree_util.tree_unflatten(treedef, [sl(l) for l in leaves])
-            if not self._dispatch_compiled(c_args, c_kwargs):
-                m._update(*c_args, **c_kwargs)
+            if not self._dispatch_compiled(c_args, c_kwargs, statics):
+                m._update(*c_args, **dict(c_kwargs, **static_kwargs))
             offset += chunk
         return True
 
@@ -727,7 +802,19 @@ def classify_update_member(metric: Any) -> Tuple[str, str]:
     if metric._child_metrics():
         return PATH_EAGER, "has child metrics"
     if not metric.supports_compiled_update:
-        return PATH_EAGER, "state unsupported by compiled update (unbounded list state)"
+        reason = "state unsupported by compiled update (unbounded list state)"
+        declared = tuple(getattr(metric, "heavy_kernels", ()) or ())
+        if declared:
+            reason += f"; heavy kernels declared: {', '.join(declared)}"
+        return PATH_EAGER, reason
+    statics = tuple(getattr(metric, "_static_update_kwargs", ()) or ())
+    if statics:
+        # the collection's fused program would trace the static values (the
+        # historical FID breakage); the per-metric engine closes over them
+        return PATH_BUCKETED, (
+            f"static update kwargs ({', '.join(statics)}) close over per-value "
+            "jit variants in the per-metric engine"
+        )
     if getattr(metric, "_batch_buckets", False):
         return PATH_BUCKETED, "batch_buckets=True (pow2-bucketed per-metric engine)"
     return PATH_FUSED, "compilable"

@@ -139,6 +139,17 @@ class Metric:
     # data first. Values must be JSON-serializable scalars.
     _ckpt_aux_attrs: Tuple[str, ...] = ()
 
+    # update kwargs whose values are compile-time constants for the compiled
+    # update engine (e.g. FID's ``real`` flag selecting the real/fake moment
+    # triple): the engine closes over each distinct value in its own jit
+    # variant instead of tracing it, so branching on the value stays legal
+    _static_update_kwargs: Tuple[str, ...] = ()
+
+    # declared heavy-kernel fast paths (names from the ``ops.kernels``
+    # registry) for metrics whose dominant cost runs through a fused kernel
+    # or a model forward — consumed by analyzer rule E114 (heavy-eager-residue)
+    heavy_kernels: Tuple[str, ...] = ()
+
     def __init__(
         self,
         compute_on_cpu: bool = False,

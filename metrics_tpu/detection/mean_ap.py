@@ -28,11 +28,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from metrics_tpu.core.buffers import CatBuffer
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.ops.detection.boxes import box_iou, mask_area, mask_iou
 from metrics_tpu.ops.detection.matching import match_image
 from metrics_tpu.ops.detection.rle import is_rle, masks_from_rle_list
+from metrics_tpu.ops.kernels.iou_matching import evaluate_matches
 from metrics_tpu.parallel import sync as _sync
+from metrics_tpu.utils.prints import rank_zero_warn
 
 _BBOX_AREA_RANGES = {
     "all": (0.0, 1e10),
@@ -119,6 +122,18 @@ class MeanAveragePrecision(Metric):
     metrics when GT areas straddle range boundaries — deviation quantified in
     tests/detection/test_pycoco.py (gated on pycocotools availability).
 
+    Device-resident state (ISSUE 16): for ``iou_type="bbox"`` (default) the
+    per-image lists live in pow2-padded ``CatBuffer`` device states instead of
+    host numpy lists — COCO list inputs are padded once at update time
+    (``pad_inputs``) and the dense form re-enters through the compiled update
+    engine (pow2 image-batch bucketing bounds recompiles); compute feeds the
+    buffers to the fused ``ops.kernels.iou_matching`` program in pow2 chunks.
+    Results are bitwise-identical to the legacy path whenever per-image counts
+    fit ``detections_capacity``/``groundtruths_capacity`` (defaults 128 — above
+    COCO's 100-detection convention; overflow keeps the top-scoring detections
+    with a warning). ``device_state=False`` restores the host-list path;
+    ``buffer_capacity`` sets the image capacity (default 1024).
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu.detection import MeanAveragePrecision
@@ -141,6 +156,8 @@ class MeanAveragePrecision(Metric):
     is_differentiable = False
     higher_is_better = True
     full_state_update = True
+    # declared fast path for analyzer rule E114 (heavy-eager-residue)
+    heavy_kernels = ("iou_matching",)
 
     def __init__(
         self,
@@ -150,18 +167,36 @@ class MeanAveragePrecision(Metric):
         rec_thresholds: Optional[List[float]] = None,
         max_detection_thresholds: Optional[List[int]] = None,
         class_metrics: bool = False,
+        device_state: Optional[bool] = None,
+        detections_capacity: int = 128,
+        groundtruths_capacity: int = 128,
+        use_pallas: str = "auto",
         **kwargs: Any,
     ) -> None:
+        allowed_iou_types = ("segm", "bbox")
+        if iou_type not in allowed_iou_types:
+            raise ValueError(f"Expected argument `iou_type` to be one of {allowed_iou_types} but got {iou_type}")
+        if device_state is None:
+            device_state = iou_type == "bbox"
+        elif device_state and iou_type != "bbox":
+            raise ValueError("`device_state=True` requires `iou_type='bbox'` (masks stay host-listed)")
+        self._device_state = bool(device_state)
+        if self._device_state:
+            # compute() slices buffers to dynamic per-image counts (host-side
+            # curve math); the fused matching kernel is jitted on its own
+            kwargs.setdefault("compiled_compute", False)
+            # ragged image-batch sizes reuse log2(N) update signatures
+            kwargs.setdefault("batch_buckets", True)
         super().__init__(**kwargs)
 
         allowed_box_formats = ("xyxy", "xywh", "cxcywh")
         if box_format not in allowed_box_formats:
             raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
         self.box_format = box_format
-        allowed_iou_types = ("segm", "bbox")
-        if iou_type not in allowed_iou_types:
-            raise ValueError(f"Expected argument `iou_type` to be one of {allowed_iou_types} but got {iou_type}")
         self.iou_type = iou_type
+        if use_pallas not in ("auto", "force", "never"):
+            raise ValueError(f"Expected argument `use_pallas` to be 'auto', 'force' or 'never' but got {use_pallas!r}")
+        self.use_pallas = use_pallas
 
         self.iou_thresholds = iou_thresholds or np.arange(0.5, 1.0, 0.05).round(2).tolist()
         self.rec_thresholds = rec_thresholds or np.linspace(0.0, 1.00, int(np.round((1.00 - 0.0) / 0.01)) + 1).tolist()
@@ -173,11 +208,32 @@ class MeanAveragePrecision(Metric):
             raise ValueError("Expected argument `class_metrics` to be a boolean")
         self.class_metrics = class_metrics
 
-        self.add_state("detections", default=[], dist_reduce_fx=None)
-        self.add_state("detection_scores", default=[], dist_reduce_fx=None)
-        self.add_state("detection_labels", default=[], dist_reduce_fx=None)
-        self.add_state("groundtruths", default=[], dist_reduce_fx=None)
-        self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
+        if self._device_state:
+            for name, cap in (("detections_capacity", detections_capacity),
+                              ("groundtruths_capacity", groundtruths_capacity)):
+                if not isinstance(cap, int) or cap <= 0:
+                    raise ValueError(f"Expected argument `{name}` to be a positive int but got {cap}")
+            self._det_cap = _next_bucket(detections_capacity, minimum=1)
+            self._gt_cap = _next_bucket(groundtruths_capacity, minimum=1)
+            images = self.buffer_capacity or 1024
+            self.add_state("det_boxes", CatBuffer.empty(images, (self._det_cap, 4), jnp.float32), dist_reduce_fx="cat")
+            self.add_state("det_scores", CatBuffer.empty(images, (self._det_cap,), jnp.float32), dist_reduce_fx="cat")
+            self.add_state("det_labels", CatBuffer.empty(images, (self._det_cap,), jnp.int32), dist_reduce_fx="cat")
+            self.add_state("det_counts", CatBuffer.empty(images, (), jnp.int32), dist_reduce_fx="cat")
+            self.add_state("gt_boxes", CatBuffer.empty(images, (self._gt_cap, 4), jnp.float32), dist_reduce_fx="cat")
+            self.add_state("gt_labels", CatBuffer.empty(images, (self._gt_cap,), jnp.int32), dist_reduce_fx="cat")
+            self.add_state("gt_counts", CatBuffer.empty(images, (), jnp.int32), dist_reduce_fx="cat")
+        else:
+            self.add_state("detections", default=[], dist_reduce_fx=None)
+            self.add_state("detection_scores", default=[], dist_reduce_fx=None)
+            self.add_state("detection_labels", default=[], dist_reduce_fx=None)
+            self.add_state("groundtruths", default=[], dist_reduce_fx=None)
+            self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
+
+    @property
+    def device_state(self) -> bool:
+        """Whether state lives in pow2-padded device buffers (bbox default)."""
+        return self._device_state
 
     # ------------------------------------------------------------------ #
     # update
@@ -212,6 +268,18 @@ class MeanAveragePrecision(Metric):
         return masks
 
     def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:  # type: ignore[override]
+        if self._device_state:
+            if isinstance(preds, dict) and isinstance(target, dict):
+                # dense padded form — traced-safe, this is what the compiled
+                # update engine replays (and what pad_inputs produces)
+                self._append_dense(preds, target)
+                return
+            _input_validator(preds, target, iou_type=self.iou_type)
+            dense_preds, dense_target = self.pad_inputs(preds, target)
+            engine = self._maybe_engine()
+            if engine is None or not engine.dispatch((dense_preds, dense_target), {}):
+                self._append_dense(dense_preds, dense_target)
+            return
         _input_validator(preds, target, iou_type=self.iou_type)
         for item in preds:
             self.detections.append(self._get_safe_item_values(item))
@@ -221,7 +289,98 @@ class MeanAveragePrecision(Metric):
             self.groundtruths.append(self._get_safe_item_values(item))
             self.groundtruth_labels.append(np.asarray(item["labels"], dtype=np.int32).reshape(-1))
 
+    def _engine_accepts(self, args: Tuple, kwargs: Dict) -> bool:
+        """Per-call engine gate: only dense padded dict updates may compile —
+        COCO list-of-dicts inputs stay eager without tripping the engine's
+        permanent fallback (they convert and re-enter in dense form)."""
+        if not self._device_state or kwargs or len(args) != 2:
+            return False
+        return all(isinstance(a, dict) and "boxes" in a and "count" in a for a in args)
+
+    def pad_inputs(
+        self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]
+    ) -> Tuple[Dict[str, Array], Dict[str, Array]]:
+        """Convert COCO list-of-dicts inputs to the dense padded dict form
+        (`boxes (B, cap, 4)` / `scores` / `labels` / `count`) the device-state
+        update consumes. Detections beyond ``detections_capacity`` keep the
+        top-scoring ``cap`` (in original order); groundtruths truncate."""
+        n_img = len(preds)
+        dcap, gcap = self._det_cap, self._gt_cap
+        det_boxes = np.zeros((n_img, dcap, 4), np.float32)
+        det_scores = np.zeros((n_img, dcap), np.float32)
+        det_labels = np.full((n_img, dcap), -1, np.int32)
+        det_counts = np.zeros(n_img, np.int32)
+        gt_boxes = np.zeros((n_img, gcap, 4), np.float32)
+        gt_labels = np.full((n_img, gcap), -1, np.int32)
+        gt_counts = np.zeros(n_img, np.int32)
+        for i, item in enumerate(preds):
+            boxes = self._get_safe_item_values(item)
+            labels = np.asarray(item["labels"], dtype=np.int32).reshape(-1)
+            scores = np.asarray(item["scores"], dtype=np.float32).reshape(-1)
+            n = labels.shape[0]
+            if n > dcap:
+                rank_zero_warn(
+                    f"MeanAveragePrecision: an image carries {n} detections, above "
+                    f"`detections_capacity={dcap}`; keeping the top {dcap} by score. "
+                    "Raise `detections_capacity` (or pass `device_state=False`) for exact handling.",
+                    UserWarning,
+                )
+                keep = np.sort(np.argsort(-scores, kind="stable")[:dcap])
+                boxes, labels, scores, n = boxes[keep], labels[keep], scores[keep], dcap
+            det_boxes[i, :n] = boxes
+            det_labels[i, :n] = labels
+            det_scores[i, :n] = scores
+            det_counts[i] = n
+        for i, item in enumerate(target):
+            boxes = self._get_safe_item_values(item)
+            labels = np.asarray(item["labels"], dtype=np.int32).reshape(-1)
+            n = labels.shape[0]
+            if n > gcap:
+                rank_zero_warn(
+                    f"MeanAveragePrecision: an image carries {n} groundtruths, above "
+                    f"`groundtruths_capacity={gcap}`; truncating. Raise `groundtruths_capacity` "
+                    "(or pass `device_state=False`) for exact handling.",
+                    UserWarning,
+                )
+                boxes, labels, n = boxes[:gcap], labels[:gcap], gcap
+            gt_boxes[i, :n] = boxes
+            gt_labels[i, :n] = labels
+            gt_counts[i] = n
+        dense_preds = {
+            "boxes": jnp.asarray(det_boxes),
+            "scores": jnp.asarray(det_scores),
+            "labels": jnp.asarray(det_labels),
+            "count": jnp.asarray(det_counts),
+        }
+        dense_target = {
+            "boxes": jnp.asarray(gt_boxes),
+            "labels": jnp.asarray(gt_labels),
+            "count": jnp.asarray(gt_counts),
+        }
+        return dense_preds, dense_target
+
+    def _append_dense(self, preds: Dict[str, Array], target: Dict[str, Array]) -> None:
+        self.det_boxes.append(preds["boxes"])
+        self.det_scores.append(preds["scores"])
+        self.det_labels.append(preds["labels"])
+        self.det_counts.append(preds["count"])
+        self.gt_boxes.append(target["boxes"])
+        self.gt_labels.append(target["labels"])
+        self.gt_counts.append(target["count"])
+
     def _get_classes(self) -> List[int]:
+        if self._device_state:
+            labels = []
+            for label_buf, count_buf in ((self.det_labels, self.det_counts),
+                                         (self.gt_labels, self.gt_counts)):
+                if len(count_buf) == 0:
+                    continue
+                lab = np.asarray(label_buf.to_array())  # (N, cap)
+                cnt = np.asarray(count_buf.to_array())  # (N,)
+                labels.append(lab[np.arange(lab.shape[1])[None, :] < cnt[:, None]])
+            if not labels:
+                return []
+            return np.unique(np.concatenate(labels)).astype(int).tolist()
         if len(self.detection_labels) > 0 or len(self.groundtruth_labels) > 0:
             all_labels = np.concatenate(
                 [np.asarray(lab).reshape(-1) for lab in self.detection_labels + self.groundtruth_labels]
@@ -316,10 +475,90 @@ class MeanAveragePrecision(Metric):
             "gt_area_ignore": gt_area_ignore,  # (A, G)
         }
 
+    def _evaluate_images_device_state(self, class_ids: List[int]) -> List[Optional[Dict[str, np.ndarray]]]:
+        """Device-state epoch-end evaluation: the pow2-padded buffers feed the
+        fused ``ops.kernels.iou_matching`` program in pow2-padded image chunks
+        — no per-image host prep at all. Outputs are sliced back to the true
+        per-image counts so ``_calculate`` consumes the exact structures the
+        legacy per-image path produced (bitwise-identical)."""
+        det_counts = np.asarray(self.det_counts.to_array()) if len(self.det_counts) else np.zeros(0, np.int32)
+        n_images = int(det_counts.shape[0])
+        evals: List[Optional[Dict[str, np.ndarray]]] = [None] * n_images
+        if n_images == 0:
+            return evals
+        det_boxes = np.asarray(self.det_boxes.to_array())
+        det_scores = np.asarray(self.det_scores.to_array())
+        det_labels = np.asarray(self.det_labels.to_array())
+        gt_boxes = np.asarray(self.gt_boxes.to_array())
+        gt_labels = np.asarray(self.gt_labels.to_array())
+        gt_counts = np.asarray(self.gt_counts.to_array())
+
+        # buffers are capacity-wide; the kernel only needs the pow2 bucket of
+        # the largest TRUE count (pad columns are all-invalid, so trimming is
+        # bitwise-free and keeps the matcher's work data-proportional)
+        d_used = _next_bucket(max(int(det_counts.max(initial=0)), 1), minimum=1)
+        if d_used < det_boxes.shape[1]:
+            det_boxes = det_boxes[:, :d_used]
+            det_scores = det_scores[:, :d_used]
+            det_labels = det_labels[:, :d_used]
+        g_used = _next_bucket(max(int(gt_counts.max(initial=0)), 1), minimum=1)
+        if g_used < gt_boxes.shape[1]:
+            gt_boxes = gt_boxes[:, :g_used]
+            gt_labels = gt_labels[:, :g_used]
+
+        k = len(class_ids)
+        k_pad = _next_bucket(max(k, 1), minimum=1)
+        cid = np.zeros(k_pad, np.int32)
+        cid[:k] = class_ids
+        cmask = np.arange(k_pad) < k
+        area_ranges = np.asarray(list(self.bbox_area_ranges.values()), np.float32)
+        thresholds = np.asarray(self.iou_thresholds, np.float32)
+        max_det = self.max_detection_thresholds[-1]
+
+        # same two-phase dispatch-then-fetch chunking as the legacy path: the
+        # (B, K, A, T, D) match output stays bounded and pow2 image-chunk
+        # padding keeps the kernel's signature set finite
+        chunk_cap = 256
+        pending = []
+        for start in range(0, n_images, chunk_cap):
+            stop = min(start + chunk_cap, n_images)
+            b_pad = _next_bucket(stop - start, minimum=1)
+
+            def chunk(a: np.ndarray, start=start, stop=stop, b_pad=b_pad) -> np.ndarray:
+                piece = a[start:stop]
+                if b_pad == piece.shape[0]:
+                    return piece
+                return np.concatenate([piece, np.zeros((b_pad - piece.shape[0], *a.shape[1:]), a.dtype)])
+
+            out = evaluate_matches(
+                chunk(det_boxes), chunk(det_scores), chunk(det_labels), chunk(det_counts),
+                chunk(gt_boxes), chunk(gt_labels), chunk(gt_counts),
+                cid, cmask, area_ranges, thresholds,
+                max_det=max_det, use_pallas=self.use_pallas,
+            )
+            pending.append((start, stop, out))
+        for start, stop, out in pending:
+            fetched = {key: np.asarray(val) for key, val in out.items()}
+            for b, i in enumerate(range(start, stop)):
+                n, g = int(det_counts[i]), int(gt_counts[i])
+                if n == 0 and g == 0:
+                    continue
+                evals[i] = {
+                    "det_matches": fetched["det_matches"][b][:k, :, :, :n],
+                    "scores_sorted": fetched["scores_sorted"][b][:n],
+                    "det_class_valid": fetched["det_class_valid"][b][:k, :n],
+                    "det_area_ignore": fetched["det_area_ignore"][b][:, :n],
+                    "gt_class_valid": fetched["gt_class_valid"][b][:k, :g],
+                    "gt_area_ignore": fetched["gt_area_ignore"][b][:, :g],
+                }
+        return evals
+
     def _evaluate_images(self, class_ids: List[int]) -> List[Optional[Dict[str, np.ndarray]]]:
         """Per-image host prep, then ONE vmapped matcher dispatch per
         (det, gt) bucket — the epoch-end device cost is O(#buckets), not
         O(#images). The segm path stays per-image (mask shapes vary)."""
+        if self._device_state:
+            return self._evaluate_images_device_state(class_ids)
         evals = [self._evaluate_image_device(i, class_ids) for i in range(len(self.groundtruths))]
 
         by_bucket: Dict[Tuple[int, int], List[int]] = {}
@@ -502,7 +741,10 @@ class MeanAveragePrecision(Metric):
     # state with gather_all_tensors, metric.py:350-354)
     # ------------------------------------------------------------------ #
     def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
-        if dist_sync_fn is not None:
+        if self._device_state or dist_sync_fn is not None:
+            # device-state buffers are fixed-shape "cat" states: the generic
+            # CatBuffer gather applies one identical permutation to all seven
+            # buffers, so the per-image rows stay aligned
             return super()._sync_dist(dist_sync_fn, process_group)
         # every rank must execute the SAME number of collectives: agree on the
         # per-rank image counts first; ranks short of the max contribute dummy

@@ -34,10 +34,20 @@ def resolve_feature_extractor(
     metric_name: str,
     valid_features: tuple,
     variables: Optional[dict] = None,
+    bucketed: bool = True,
 ) -> Callable:
-    """Return a callable ``imgs -> [N, d]`` feature extractor."""
+    """Return a callable ``imgs -> [N, d]`` feature extractor.
+
+    Unless ``bucketed=False`` (or the callable opts out with
+    ``row_independent = False``), the extractor is wrapped in a
+    :class:`~metrics_tpu.ops.kernels.BucketedFeatureExtractor` so ragged
+    update batches are padded to pow2 buckets before the jitted forward —
+    bounding the forward's compile signatures to ``log2(N)`` without changing
+    any feature value (zero-pad rows are sliced back off)."""
+    from metrics_tpu.ops.kernels.features import maybe_bucketed
+
     if callable(feature):
-        return feature
+        return maybe_bucketed(feature, bucketed)
     if not isinstance(feature, (int, str)):
         raise TypeError("Got unknown input to argument `feature`")
     if feature not in valid_features:
@@ -55,4 +65,4 @@ def resolve_feature_extractor(
             " to published numbers; pass converted weights for that.",
             UserWarning,
         )
-    return InceptionV3FeatureExtractor(feature, variables=variables)
+    return maybe_bucketed(InceptionV3FeatureExtractor(feature, variables=variables), bucketed)

@@ -53,6 +53,13 @@ class FrechetInceptionDistance(Metric):
     higher_is_better = False
     is_differentiable = False
     full_state_update = False
+    # `real` is a routing flag, not data: close over it per-value in the
+    # compiled engine instead of tracing it (a traced bool would concretize
+    # in the `"real" if real else "fake"` branch and poison the engine).
+    _static_update_kwargs = ("real",)
+    # Declared heavy-kernel path (analysis rule E114): the InceptionV3 forward
+    # streams through the pow2-bucketed extractor at update time.
+    heavy_kernels = ("feature_extract",)
 
     def __init__(
         self,

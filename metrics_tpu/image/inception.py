@@ -42,6 +42,8 @@ class InceptionScore(Metric):
     higher_is_better = True
     is_differentiable = False
     full_state_update = False
+    # the Inception forward streams through the pow2-bucketed extractor (E114)
+    heavy_kernels = ("feature_extract",)
 
     def __init__(
         self,

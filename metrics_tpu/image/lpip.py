@@ -49,6 +49,8 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
+    # the trunk forward streams through the pow2-bucketed extractor (E114)
+    heavy_kernels = ("feature_extract",)
 
     def __init__(
         self,
@@ -59,9 +61,11 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        from metrics_tpu.ops.kernels.features import maybe_bucketed
+
         valid_net_type = ("vgg", "alex", "squeeze")
         if net is not None:
-            self.net = net
+            self.net = maybe_bucketed(net, True)
         else:
             if net_type not in valid_net_type:
                 raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
@@ -71,7 +75,7 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
                     " backbone: pass converted torch weights via `variables` for comparable scores.",
                     UserWarning,
                 )
-            self.net = LPIPSNet(net_type, variables=variables)
+            self.net = maybe_bucketed(LPIPSNet(net_type, variables=variables), True)
 
         valid_reduction = ("mean", "sum")
         if reduction not in valid_reduction:

@@ -246,6 +246,11 @@ class InceptionV3FeatureExtractor:
     metric layer).
     """
 
+    # inference-mode forward: the feature row for image i never depends on the
+    # other rows, so pow2 zero-padding the batch is value-preserving
+    # (contract consumed by ops/kernels/features.maybe_bucketed)
+    row_independent = True
+
     def __init__(self, feature: Any = "2048", variables: Dict | None = None, dtype=jnp.float32) -> None:
         name = str(feature)
         if name not in VALID_FEATURES:

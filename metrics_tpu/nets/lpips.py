@@ -186,6 +186,10 @@ class LPIPSNet:
     Reference analog: ``NoTrainLpips`` (torchmetrics/image/lpip.py:21-25).
     """
 
+    # per-pair distances are row-independent: pow2 zero-padding the batch is
+    # value-preserving (contract consumed by ops/kernels/features.maybe_bucketed)
+    row_independent = True
+
     def __init__(
         self,
         net_type: str = "alex",

@@ -116,6 +116,10 @@ EVENT_CATALOG: Dict[str, Tuple[str, ...]] = {
     "guard": (
         "guard/nonfinite",  # non-finite state detected at a guarded boundary
     ),
+    "kernel": (
+        "kernel/dispatch",  # one heavy-kernel dispatch (args: kernel, impl, bucket_width)
+        "kernel/fallback",  # Pallas variant failed; XLA reference used (args: kernel, reason)
+    ),
     "serve": (
         "serve/ingest",  # one observation admitted to the ingest queue
         "serve/reject",  # one observation rejected at admission (args: reason)

@@ -1,0 +1,178 @@
+"""Heavy-metric kernel layer (ISSUE 16).
+
+The model-forward heavies — detection-mAP IoU matching, BERTScore greedy
+cosine matching, Inception/LPIPS feature extraction — historically ran as
+eager residue outside the compiled engines. Each kernel here ships a
+reference ``jax.jit`` implementation plus an opt-in Pallas variant that
+auto-falls back to the jit reference off-TPU (and runs the Pallas body in
+interpret mode there for parity tests), mirroring the
+``ops/classification/binned_pallas.py`` dispatch idiom.
+
+Every kernel is registered in :data:`KERNELS` so metric classes can declare
+their fast path via a ``heavy_kernels`` class attribute — analyzer rule E114
+(``heavy-eager-residue``) checks those declarations. Dispatches emit
+``kernel/dispatch`` tracer events and ``metrics_tpu_heavy_kernel_*``
+Prometheus series (per-kernel call counters, a bucket-width histogram, and a
+fallback counter). See docs/heavy_kernels.md.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from metrics_tpu.observability import instruments as _instruments
+from metrics_tpu.observability import tracer as _otrace
+
+__all__ = [
+    "KernelSpec",
+    "KERNELS",
+    "next_pow2",
+    "record_dispatch",
+    "record_fallback",
+    "resolve_use_pallas",
+    "trace_counts",
+    "reset_trace_counts",
+    "bump_trace_count",
+]
+
+
+@dataclass(frozen=True)
+class KernelSpec:
+    """One registered heavy kernel: its name, owning module, and what the
+    Pallas variant covers (the rest of the kernel stays XLA either way)."""
+
+    name: str
+    module: str
+    description: str
+    pallas_scope: str
+
+
+KERNELS: Dict[str, KernelSpec] = {
+    "iou_matching": KernelSpec(
+        name="iou_matching",
+        module="metrics_tpu.ops.kernels.iou_matching",
+        description=(
+            "Fused pairwise-IoU + greedy COCO matching over pow2-padded "
+            "detection/groundtruth buffers (batched across images and classes)"
+        ),
+        pallas_scope="pairwise IoU matrix (matching scan stays XLA)",
+    ),
+    "cosine_matching": KernelSpec(
+        name="cosine_matching",
+        module="metrics_tpu.ops.kernels.cosine_matching",
+        description=(
+            "Pairwise token cosine-similarity + greedy max matching for "
+            "BERTScore precision/recall/F1"
+        ),
+        pallas_scope="row/col max of the token similarity matrix",
+    ),
+    "feature_extract": KernelSpec(
+        name="feature_extract",
+        module="metrics_tpu.ops.kernels.features",
+        description=(
+            "pow2-bucketed batched feature extraction (Inception, LPIPS) so "
+            "ragged update batches reuse at most log2(N) forward signatures"
+        ),
+        pallas_scope="none (the network forward is already one jitted XLA program)",
+    ),
+}
+
+# pow2 histogram buckets for the bucket-width series: 1..8192 covers every
+# batch/token width the engines produce (wider observations land in +Inf)
+_WIDTH_BUCKETS = tuple(float(1 << i) for i in range(14))
+
+# trace-time side-effect counters: incremented inside jitted kernel bodies,
+# so a steady-state loop that retraces shows up as a rising count. The parity
+# suite and bench round r21 use these as their recompile guards.
+_TRACE_COUNTS: Dict[str, int] = {}
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= max(n, 1)."""
+    n = max(int(n), 1)
+    return 1 << (n - 1).bit_length()
+
+
+def bump_trace_count(kernel: str) -> None:
+    """Record one trace of ``kernel``'s jitted body (call at trace time)."""
+    _TRACE_COUNTS[kernel] = _TRACE_COUNTS.get(kernel, 0) + 1
+
+
+def trace_counts() -> Dict[str, int]:
+    """Snapshot of per-kernel trace counts since process start / last reset."""
+    return dict(_TRACE_COUNTS)
+
+
+def reset_trace_counts() -> None:
+    _TRACE_COUNTS.clear()
+
+
+def resolve_use_pallas(use_pallas: str, *, traced: bool = False) -> Tuple[bool, bool]:
+    """Resolve a kernel's ``use_pallas`` mode to ``(use, interpret)``.
+
+    Mirrors ``binned_pallas``: ``"auto"`` honours the ``METRICS_TPU_PALLAS``
+    env toggle, stays on XLA under an outer trace, and runs interpret mode off
+    TPU so tier-1 CPU runs still exercise the Pallas body; ``"force"``/
+    ``"never"`` are explicit overrides.
+    """
+    if use_pallas not in ("auto", "force", "never"):
+        raise ValueError(f"use_pallas must be 'auto', 'force' or 'never', got {use_pallas!r}")
+    if use_pallas == "never":
+        return False, False
+    import jax
+
+    on_tpu = jax.default_backend() not in ("cpu", "gpu")
+    if use_pallas == "auto":
+        env = os.environ.get("METRICS_TPU_PALLAS", "").strip().lower()
+        if env in ("0", "never", "off", "false"):
+            return False, False
+        if env not in ("1", "force", "on", "true"):
+            # plain auto: only claim the fast path on TPU, never mid-trace
+            if traced or not on_tpu:
+                return False, False
+    return True, not on_tpu
+
+
+def record_dispatch(kernel: str, impl: str, bucket_width: Optional[int] = None) -> None:
+    """Count one kernel dispatch (``impl`` is ``"jit"``, ``"pallas"`` or
+    ``"pallas_interpret"``) and observe the pow2 bucket width it ran at."""
+    _instruments.REGISTRY.counter(
+        "heavy_kernel_calls",
+        help="heavy-kernel dispatches by kernel and implementation",
+        kernel=kernel,
+        impl=impl,
+    ).inc()
+    if bucket_width is not None:
+        _instruments.REGISTRY.histogram(
+            "heavy_kernel_bucket_width",
+            help="pow2 bucket widths heavy kernels dispatched at",
+            buckets=_WIDTH_BUCKETS,
+            kernel=kernel,
+        ).observe(float(bucket_width))
+    if _otrace.active:
+        _otrace.emit_instant(
+            "kernel/dispatch", "kernel",
+            kernel=kernel, impl=impl,
+            **({"bucket_width": int(bucket_width)} if bucket_width is not None else {}),
+        )
+
+
+def record_fallback(kernel: str, reason: str) -> None:
+    """Count one Pallas -> XLA fallback for ``kernel``."""
+    _instruments.REGISTRY.counter(
+        "heavy_kernel_fallbacks",
+        help="heavy-kernel Pallas->XLA fallbacks",
+        kernel=kernel,
+    ).inc()
+    if _otrace.active:
+        _otrace.emit_instant(
+            "kernel/fallback", "kernel",
+            kernel=kernel, reason=str(reason).splitlines()[0][:200],
+        )
+
+
+# submodules import the registry helpers above, so they load after them
+from metrics_tpu.ops.kernels.cosine_matching import pairwise_cosine_pr  # noqa: E402,F401
+from metrics_tpu.ops.kernels.features import BucketedFeatureExtractor, maybe_bucketed  # noqa: E402,F401
+from metrics_tpu.ops.kernels.iou_matching import evaluate_matches  # noqa: E402,F401

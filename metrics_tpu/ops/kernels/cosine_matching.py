@@ -1,0 +1,123 @@
+"""Pairwise token cosine-similarity + greedy max matching for BERTScore.
+
+The reference computes the full ``(B, L, P, R)`` token similarity tensor and
+reduces it with row/col maxima (``ops/text/bert.py``). The XLA reference here
+is that exact computation (bitwise-identical). The Pallas variant never
+materializes the 4D tensor: one grid step per (batch, layer) computes the
+``(P, R)`` similarity block on the MXU and emits only its row and column
+maxima — the idf weighting and F1 stay XLA in both paths, so Pallas parity
+is tolerance-bounded only by the matmul accumulation order.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+try:  # pragma: no cover - exercised only where pallas is importable
+    from jax.experimental import pallas as pl
+except Exception:  # pragma: no cover
+    pl = None  # type: ignore[assignment]
+
+from metrics_tpu.ops import kernels as _kernels
+from metrics_tpu.utils.prints import rank_zero_warn
+
+__all__ = ["pairwise_cosine_pr"]
+
+
+def _finalize(rowmax: Array, colmax: Array, preds_idf_scale: Array,
+              target_idf_scale: Array) -> Tuple[Array, Array, Array]:
+    """idf weighting + F1 from the similarity row/col maxima — shared tail of
+    both implementations, same ops as the legacy ``_precision_recall_f1``."""
+    precision = jnp.einsum("bls,bs->bls", rowmax, preds_idf_scale).sum(-1)
+    recall = jnp.einsum("bls,bs->bls", colmax, target_idf_scale).sum(-1)
+    f1 = 2 * precision * recall / (precision + recall)
+    f1 = jnp.where(jnp.isnan(f1), 0.0, f1)
+    return precision.T.squeeze(), recall.T.squeeze(), f1.T.squeeze()
+
+
+@jax.jit
+def _pr_f1_reference(preds_embeddings: Array, target_embeddings: Array,
+                     preds_idf_scale: Array, target_idf_scale: Array):
+    _kernels.bump_trace_count("cosine_matching")
+    cos_sim = jnp.einsum("blpd,blrd->blpr", preds_embeddings, target_embeddings)
+    return _finalize(
+        jnp.max(cos_sim, axis=3), jnp.max(cos_sim, axis=2),
+        preds_idf_scale, target_idf_scale,
+    )
+
+
+def _maxsim_kernel(p_ref, t_ref, rmax_ref, cmax_ref):
+    p = p_ref[0, 0]  # (P, D)
+    t = t_ref[0, 0]  # (R, D)
+    sim = jnp.dot(p, t.T, preferred_element_type=jnp.float32)  # (P, R) on the MXU
+    rmax_ref[0, 0] = jnp.max(sim, axis=1)
+    cmax_ref[0, 0] = jnp.max(sim, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pr_f1_pallas(preds_embeddings: Array, target_embeddings: Array,
+                  preds_idf_scale: Array, target_idf_scale: Array, *, interpret: bool):
+    _kernels.bump_trace_count("cosine_matching")
+    b, l, p, d = preds_embeddings.shape
+    r = target_embeddings.shape[2]
+    rowmax, colmax = pl.pallas_call(
+        _maxsim_kernel,
+        grid=(b, l),
+        in_specs=[
+            pl.BlockSpec((1, 1, p, d), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, r, d), lambda i, j: (i, j, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, p), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, 1, r), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, l, p), jnp.float32),
+            jax.ShapeDtypeStruct((b, l, r), jnp.float32),
+        ],
+        interpret=interpret,
+    )(preds_embeddings, target_embeddings)
+    return _finalize(rowmax, colmax, preds_idf_scale, target_idf_scale)
+
+
+def pairwise_cosine_pr(
+    preds_embeddings: Array,  # (B, L, P, D) normalized token embeddings
+    target_embeddings: Array,  # (B, L, R, D)
+    preds_idf_scale: Array,  # (B, P)
+    target_idf_scale: Array,  # (B, R)
+    use_pallas: str = "auto",
+) -> Tuple[Array, Array, Array]:
+    """BERTScore greedy-matching precision/recall/F1 per sentence (and layer).
+
+    Drop-in for the legacy jitted ``_precision_recall_f1``: identical outputs
+    on the XLA path, tolerance-bounded on the Pallas path.
+    """
+    traced = isinstance(preds_embeddings, jax.core.Tracer)
+    use, interpret = _kernels.resolve_use_pallas(use_pallas, traced=traced)
+    if use and pl is None:
+        _kernels.record_fallback("cosine_matching", "jax.experimental.pallas unavailable")
+        use = False
+    width = int(preds_embeddings.shape[2])
+    if use:
+        try:
+            out = _pr_f1_pallas(
+                preds_embeddings, target_embeddings,
+                preds_idf_scale, target_idf_scale, interpret=interpret,
+            )
+            _kernels.record_dispatch(
+                "cosine_matching", "pallas_interpret" if interpret else "pallas", bucket_width=width
+            )
+            return out
+        except Exception as err:
+            _kernels.record_fallback("cosine_matching", f"{type(err).__name__}: {err}")
+            rank_zero_warn(
+                f"cosine_matching pallas path failed ({type(err).__name__}); using the XLA reference",
+                UserWarning,
+            )
+    out = _pr_f1_reference(preds_embeddings, target_embeddings, preds_idf_scale, target_idf_scale)
+    _kernels.record_dispatch("cosine_matching", "jit", bucket_width=width)
+    return out

@@ -1,0 +1,89 @@
+"""pow2-bucketed batched feature extraction (Inception, LPIPS, …).
+
+Inference feature extractors are row-independent — the feature row for image
+``i`` does not depend on any other image in the batch — so a ragged stream of
+update batches can be padded to power-of-two buckets with zero rows and
+sliced back, reusing at most ``log2(N)`` compiled forward signatures instead
+of one per distinct batch size. That moves the model forward from a
+compute-time burst into steady update-time streaming through the donated
+update streak without ever changing a single feature value.
+
+Wrapping happens in ``metrics_tpu/image/_extractor.py`` (and ``LPIPS``) when
+the owning metric opts into ``batch_buckets`` — the same row-decomposability
+contract the engine's pow2 chunk decomposition already relies on. Networks
+assert the contract with a ``row_independent = True`` class attribute; a
+callable carrying ``row_independent = False`` is never wrapped.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.ops import kernels as _kernels
+
+__all__ = ["BucketedFeatureExtractor"]
+
+
+class BucketedFeatureExtractor:
+    """Pad batched inputs to the next pow2 bucket, run ``fn``, slice back.
+
+    Transparent under an outer trace (the compiled update engine owns shape
+    bucketing there) and for inputs already sized to a power of two. All
+    positional arrays sharing the leading batch dimension are padded together
+    (LPIPS takes two image batches).
+    """
+
+    row_independent = True
+
+    def __init__(self, fn: Callable, kernel: str = "feature_extract") -> None:
+        self._fn = fn
+        self._kernel = kernel
+        self.__wrapped__ = fn
+
+    def __getattr__(self, name: str) -> Any:
+        # delegate num_features & friends to the wrapped extractor
+        return getattr(self.__dict__["_fn"], name)
+
+    def __call__(self, *arrays: Any) -> Any:
+        if not arrays:
+            return self._fn()
+        if any(isinstance(a, jax.core.Tracer) for a in arrays):
+            return self._fn(*arrays)
+        first = jnp.asarray(arrays[0])
+        if first.ndim == 0:
+            return self._fn(*arrays)
+        n = first.shape[0]
+        bucket = _kernels.next_pow2(n)
+        if bucket == n:
+            _kernels.record_dispatch(self._kernel, "jit", bucket_width=bucket)
+            return self._fn(*arrays)
+        padded = []
+        for a in arrays:
+            arr = jnp.asarray(a)
+            if arr.ndim >= 1 and arr.shape[0] == n:
+                arr = jnp.concatenate(
+                    [arr, jnp.zeros((bucket - n, *arr.shape[1:]), arr.dtype)]
+                )
+            padded.append(arr)
+        out = self._fn(*padded)
+        _kernels.record_dispatch(self._kernel, "jit", bucket_width=bucket)
+        return jax.tree_util.tree_map(
+            lambda leaf: leaf[:n]
+            if isinstance(leaf, (jnp.ndarray,)) and jnp.ndim(leaf) >= 1 and leaf.shape[0] == bucket
+            else leaf,
+            out,
+        )
+
+
+def maybe_bucketed(fn: Callable, enabled: bool) -> Callable:
+    """Wrap ``fn`` in a :class:`BucketedFeatureExtractor` when ``enabled`` and
+    the callable does not opt out via ``row_independent = False``."""
+    if not enabled or fn is None:
+        return fn
+    if getattr(fn, "row_independent", True) is False:
+        return fn
+    if isinstance(fn, BucketedFeatureExtractor):
+        return fn
+    return BucketedFeatureExtractor(fn)

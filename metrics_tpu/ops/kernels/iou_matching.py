@@ -1,0 +1,269 @@
+"""Fused pairwise-IoU + greedy COCO matching over padded detection buffers.
+
+One jitted program evaluates a whole batch of images — per-image score sort,
+box areas, area-range ignores, per-class rank caps, the pairwise IoU matrix
+and a single merged-class greedy matcher (one scan for ALL classes; see
+``_merged_greedy_match``) — replacing the per-image host prep + per-bucket
+dispatch loop ``MeanAveragePrecision`` used to run at compute time.
+
+Semantics are bitwise-identical to the legacy per-image path for every real
+(non-padded) detection/groundtruth: pad rows carry ``valid=False`` masks, so
+they can never match, never claim a groundtruth, and never join a class
+column; the caller slices outputs back to the true counts.
+
+The Pallas variant covers the pairwise IoU matrix (the MXU-friendly dense
+part); the sequential greedy scan stays XLA either way. Off-TPU the Pallas
+body runs in interpret mode (parity tests) and ``"auto"`` stays on XLA.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+try:  # pragma: no cover - exercised only where pallas is importable
+    from jax.experimental import pallas as pl
+except Exception:  # pragma: no cover
+    pl = None  # type: ignore[assignment]
+
+from jax import lax
+
+from metrics_tpu.ops.detection.boxes import box_iou
+from metrics_tpu.ops import kernels as _kernels
+from metrics_tpu.utils.prints import rank_zero_warn
+
+__all__ = ["evaluate_matches"]
+
+
+def _iou_kernel(det_ref, gt_ref, out_ref):
+    """Pairwise IoU of one image's padded boxes: (D, 4) x (G, 4) -> (D, G).
+
+    Same arithmetic, in the same order, as ``boxes.box_iou`` — the outputs
+    must be bitwise-identical so the Pallas and XLA paths interchange freely.
+    All intermediates are kept 2D (per-coordinate column slices broadcast
+    against row slices) to stay Mosaic-friendly on real TPUs.
+    """
+    det = det_ref[0]  # (D, 4)
+    gt = gt_ref[0]  # (G, 4)
+    dx1, dy1, dx2, dy2 = (det[:, i:i + 1] for i in range(4))  # (D, 1) each
+    gx1, gy1, gx2, gy2 = (gt[:, i][None, :] for i in range(4))  # (1, G) each
+    area_d = (dx2 - dx1) * (dy2 - dy1)  # (D, 1)
+    area_g = (gx2 - gx1) * (gy2 - gy1)  # (1, G)
+    lt_x = jnp.maximum(dx1, gx1)  # (D, G) from here on
+    lt_y = jnp.maximum(dy1, gy1)
+    rb_x = jnp.minimum(dx2, gx2)
+    rb_y = jnp.minimum(dy2, gy2)
+    wh_x = jnp.clip(rb_x - lt_x, 0, None)
+    wh_y = jnp.clip(rb_y - lt_y, 0, None)
+    inter = wh_x * wh_y
+    union = area_d + area_g - inter
+    out_ref[0] = jnp.where(union > 0, inter / union, 0.0)
+
+
+def _pairwise_iou_pallas(det_boxes: Array, gt_boxes: Array, *, interpret: bool) -> Array:
+    """Batched pairwise IoU via one Pallas grid step per image."""
+    b, d, _ = det_boxes.shape
+    g = gt_boxes.shape[1]
+    return pl.pallas_call(
+        _iou_kernel,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, d, 4), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, g, 4), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, d, g), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, d, g), jnp.float32),
+        interpret=interpret,
+    )(det_boxes, gt_boxes)
+
+
+def _merged_greedy_match(
+    ious: Array,  # (D, G), score-desc det order
+    det_ok: Array,  # (D,) bool — det valid for its own class (incl. max_det cap)
+    det_labels: Array,  # (D,) int32, score-desc order
+    gt_labels: Array,  # (G,) int32
+    gt_in_class: Array,  # (G,) bool — gt valid for some linted class
+    gt_ignore_area: Array,  # (A, G) bool
+    thresholds: Array,  # (T,)
+) -> Array:
+    """All-classes greedy matching in ONE scan over detections: (A, T, D).
+
+    The legacy ``ops.detection.matching.match_image`` scans all D detections
+    once per class — K redundant passes, because a detection can only ever
+    claim a groundtruth of its own label and the per-class matched sets are
+    disjoint. Folding the class axis into the candidate mask
+    (``gt_label == det_label``) runs the identical greedy evolution in a
+    single pass: per-class subsequences of the global score order are the
+    per-class score orders, so every (class, area, threshold) match decision
+    is bitwise-identical to the per-class scans. The body is also kept free
+    of gathers/scatters (rows arrive as scan inputs; the matched-set update
+    is a one-hot OR) — XLA's batched scatter lowering dominated the legacy
+    kernel's CPU profile.
+    """
+    gidx = jnp.arange(ious.shape[1])
+
+    def for_area(gt_ign):
+        def for_thr(thr):
+            def step(gt_matched, xs):
+                iou_row, dlab, dok = xs
+                candidates = (gt_labels == dlab) & gt_in_class & (~gt_ign) & (~gt_matched)
+                gt_ious = iou_row * candidates
+                m = jnp.argmax(gt_ious)
+                ok = (jnp.max(gt_ious) > thr) & dok
+                gt_matched = gt_matched | ((gidx == m) & ok)
+                return gt_matched, ok
+
+            _, det_matches = lax.scan(
+                step, jnp.zeros(ious.shape[1], dtype=bool), (ious, det_labels, det_ok)
+            )
+            return det_matches  # (D,)
+
+        return jax.vmap(for_thr)(thresholds)  # (T, D)
+
+    return jax.vmap(for_area)(gt_ignore_area)  # (A, T, D)
+
+
+def _image_eval(
+    det_boxes: Array,  # (D, 4) xyxy, update order
+    det_scores: Array,  # (D,)
+    det_labels: Array,  # (D,) int32
+    n_det: Array,  # scalar int32
+    gt_boxes: Array,  # (G, 4)
+    gt_labels: Array,  # (G,) int32
+    n_gt: Array,  # scalar int32
+    ious_raw: Array,  # (D, G) pairwise IoU in update order
+    class_ids: Array,  # (K,) int32, padded
+    class_mask: Array,  # (K,) bool — False for class-padding rows
+    area_ranges: Array,  # (A, 2) float32
+    thresholds: Array,  # (T,) float32
+    max_det: int,
+) -> Dict[str, Array]:
+    """One image's full evaluation — the device twin of the legacy host prep
+    in ``MeanAveragePrecision._evaluate_image_device``."""
+    num_det = det_scores.shape[0]
+    num_gt = gt_labels.shape[0]
+    det_valid = jnp.arange(num_det) < n_det
+    gt_valid = jnp.arange(num_gt) < n_gt
+
+    # score-descending stable sort with pads forced last: ascending argsort of
+    # the negated scores (+inf for pads) preserves the legacy numpy tie order
+    order = jnp.argsort(jnp.where(det_valid, -det_scores, jnp.inf), stable=True)
+    scores_sorted = det_scores[order]
+    labels_sorted = det_labels[order]
+    boxes_sorted = det_boxes[order]
+    dv_sorted = det_valid  # exactly the first n_det slots are valid post-sort
+
+    det_areas = (boxes_sorted[:, 2] - boxes_sorted[:, 0]) * (boxes_sorted[:, 3] - boxes_sorted[:, 1])
+    gt_areas = (gt_boxes[:, 2] - gt_boxes[:, 0]) * (gt_boxes[:, 3] - gt_boxes[:, 1])
+    det_area_ignore = (det_areas[None, :] < area_ranges[:, :1]) | (det_areas[None, :] > area_ranges[:, 1:])
+    gt_area_ignore = (gt_areas[None, :] < area_ranges[:, :1]) | (gt_areas[None, :] > area_ranges[:, 1:])
+
+    det_class = (labels_sorted[None, :] == class_ids[:, None]) & dv_sorted[None, :] & class_mask[:, None]
+    rank_in_class = jnp.cumsum(det_class, axis=1)
+    det_class_valid = det_class & (rank_in_class <= max_det)
+    gt_class_valid = (gt_labels[None, :] == class_ids[:, None]) & gt_valid[None, :] & class_mask[:, None]
+
+    valid_pairs = dv_sorted[:, None] & gt_valid[None, :]
+    ious = jnp.where(valid_pairs, ious_raw[order], 0.0)
+    # one merged scan for every class at once, then broadcast back out to the
+    # (K, A, T, D) layout the curve accumulation consumes: a det can only
+    # match within its own class, so merged & det_class_valid is exactly the
+    # per-class result
+    merged = _merged_greedy_match(
+        ious,
+        det_class_valid.any(axis=0),
+        labels_sorted,
+        gt_labels,
+        gt_class_valid.any(axis=0),
+        gt_area_ignore,
+        thresholds,
+    )
+    det_matches = merged[None] & det_class_valid[:, None, None, :]
+    return {
+        "det_matches": det_matches,  # (K, A, T, D)
+        "scores_sorted": scores_sorted,  # (D,)
+        "det_class_valid": det_class_valid,  # (K, D)
+        "det_area_ignore": det_area_ignore,  # (A, D)
+        "gt_class_valid": gt_class_valid,  # (K, G)
+        "gt_area_ignore": gt_area_ignore,  # (A, G)
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("max_det", "impl", "interpret"))
+def _evaluate_padded(
+    det_boxes, det_scores, det_labels, det_counts,
+    gt_boxes, gt_labels, gt_counts,
+    class_ids, class_mask, area_ranges, thresholds,
+    *, max_det: int, impl: str, interpret: bool,
+):
+    _kernels.bump_trace_count("iou_matching")
+    if impl == "pallas":
+        ious = _pairwise_iou_pallas(det_boxes, gt_boxes, interpret=interpret)
+    else:
+        ious = jax.vmap(box_iou)(det_boxes, gt_boxes)
+    return jax.vmap(
+        _image_eval,
+        in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None, None, None, None),
+    )(
+        det_boxes, det_scores, det_labels, det_counts,
+        gt_boxes, gt_labels, gt_counts, ious,
+        class_ids, class_mask, area_ranges, thresholds, max_det,
+    )
+
+
+def evaluate_matches(
+    det_boxes: Any,  # (B, D, 4) float32 xyxy
+    det_scores: Any,  # (B, D) float32
+    det_labels: Any,  # (B, D) int32
+    det_counts: Any,  # (B,) int32
+    gt_boxes: Any,  # (B, G, 4) float32
+    gt_labels: Any,  # (B, G) int32
+    gt_counts: Any,  # (B,) int32
+    class_ids: Any,  # (K,) int32 (pow2-padded; padding rows masked off)
+    class_mask: Any,  # (K,) bool
+    area_ranges: Any,  # (A, 2) float32
+    thresholds: Any,  # (T,) float32
+    max_det: int,
+    use_pallas: str = "auto",
+) -> Dict[str, Array]:
+    """Evaluate a padded batch of images in one fused dispatch.
+
+    Returns a dict of batched arrays (leading axis B): ``det_matches
+    (B, K, A, T, D)``, ``scores_sorted (B, D)``, ``det_class_valid (B, K, D)``,
+    ``det_area_ignore (B, A, D)``, ``gt_class_valid (B, K, G)`` and
+    ``gt_area_ignore (B, A, G)``. Pad rows/columns are all-False/garbage and
+    must be sliced to the true per-image counts by the caller.
+    """
+    det_boxes = jnp.asarray(det_boxes, jnp.float32)
+    gt_boxes = jnp.asarray(gt_boxes, jnp.float32)
+    traced = isinstance(det_boxes, jax.core.Tracer)
+    use, interpret = _kernels.resolve_use_pallas(use_pallas, traced=traced)
+    if use and pl is None:
+        _kernels.record_fallback("iou_matching", "jax.experimental.pallas unavailable")
+        use = False
+    args = (
+        det_boxes, jnp.asarray(det_scores, jnp.float32), jnp.asarray(det_labels, jnp.int32),
+        jnp.asarray(det_counts, jnp.int32),
+        gt_boxes, jnp.asarray(gt_labels, jnp.int32), jnp.asarray(gt_counts, jnp.int32),
+        jnp.asarray(class_ids, jnp.int32), jnp.asarray(class_mask, bool),
+        jnp.asarray(area_ranges, jnp.float32), jnp.asarray(thresholds, jnp.float32),
+    )
+    impl = "pallas_interpret" if (use and interpret) else ("pallas" if use else "jit")
+    if use:
+        try:
+            out = _evaluate_padded(*args, max_det=max_det, impl="pallas", interpret=interpret)
+        except Exception as err:  # lowering/runtime failure: fall back to XLA
+            _kernels.record_fallback("iou_matching", f"{type(err).__name__}: {err}")
+            rank_zero_warn(
+                f"iou_matching pallas path failed ({type(err).__name__}); using the XLA reference",
+                UserWarning,
+            )
+            impl = "jit"
+            out = _evaluate_padded(*args, max_det=max_det, impl="jit", interpret=False)
+    else:
+        out = _evaluate_padded(*args, max_det=max_det, impl="jit", interpret=False)
+    _kernels.record_dispatch("iou_matching", impl, bucket_width=int(det_boxes.shape[1]))
+    return out

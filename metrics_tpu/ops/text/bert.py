@@ -16,7 +16,6 @@ from __future__ import annotations
 import csv
 import math
 from collections import Counter, defaultdict
-from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import jax
@@ -119,17 +118,19 @@ def _embed_and_scale(
     return _finalize_embeddings(out, attention_mask, token_idf)
 
 
-@partial(jax.jit, static_argnames=())
 def _precision_recall_f1(
     preds_embeddings: Array, target_embeddings: Array, preds_idf_scale: Array, target_idf_scale: Array
 ) -> Tuple[Array, Array, Array]:
-    """Greedy-matching P/R/F1 (reference bert.py:338-362); shapes (L, B) squeezed."""
-    cos_sim = jnp.einsum("blpd,blrd->blpr", preds_embeddings, target_embeddings)
-    precision = jnp.einsum("bls,bs->bls", jnp.max(cos_sim, axis=3), preds_idf_scale).sum(-1)
-    recall = jnp.einsum("bls,bs->bls", jnp.max(cos_sim, axis=2), target_idf_scale).sum(-1)
-    f1 = 2 * precision * recall / (precision + recall)
-    f1 = jnp.where(jnp.isnan(f1), 0.0, f1)
-    return precision.T.squeeze(), recall.T.squeeze(), f1.T.squeeze()
+    """Greedy-matching P/R/F1 (reference bert.py:338-362); shapes (L, B) squeezed.
+
+    Dispatches through the ``cosine_matching`` heavy kernel
+    (ops/kernels/cosine_matching.py): the XLA reference is this function's
+    historical jitted einsum body verbatim; on TPU the pairwise similarity
+    row/col maxima can run as a Pallas kernel that never materializes the
+    (B, L, P, R) similarity tensor."""
+    from metrics_tpu.ops.kernels.cosine_matching import pairwise_cosine_pr
+
+    return pairwise_cosine_pr(preds_embeddings, target_embeddings, preds_idf_scale, target_idf_scale)
 
 
 def _read_csv_baseline(baseline_path: str) -> Array:

@@ -936,3 +936,113 @@ class TestA008:
 
     def test_a008_is_an_error_severity_rule(self):
         assert RULES["A008"].severity == ERROR
+
+
+# --------------------------------------------------------------------------- #
+# E114 — heavy-eager-residue
+# --------------------------------------------------------------------------- #
+def _toy_net():
+    return lambda x: x
+
+
+class HeavyModelMetric(Metric):
+    """E114 (a): a model-like attribute built in __init__ runs its forward
+    from compute with no declared kernel path."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.net = _toy_net()
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.total = self.total + jnp.sum(values)
+
+    def compute(self):
+        return self.net(self.total)
+
+
+class HeavyLoopMetric(Metric):
+    """E114 (b): compute runs a per-item Python loop calling back into self."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.total = self.total + jnp.sum(values)
+
+    def _score_one(self, i):
+        return self.total * i
+
+    def compute(self):
+        out = jnp.zeros(())
+        for i in range(4):
+            out = out + self._score_one(i)
+        return out
+
+
+class DeclaredHeavyMetric(HeavyModelMetric):
+    """Control: the declaration names a real registry kernel, clearing E114."""
+
+    heavy_kernels = ("feature_extract",)
+
+
+class BogusDeclarationMetric(HeavyModelMetric):
+    """E114: the declaration vouches for a kernel that does not exist."""
+
+    heavy_kernels = ("not_a_kernel",)
+
+
+class TestE114HeavyEagerResidue:
+    def test_model_attr_without_declaration_is_E114(self):
+        findings = _evaluate(HeavyModelMetric)
+        e114 = [f for f in findings if f.rule == "E114" and not f.suppressed]
+        assert len(e114) == 1, [f.rule for f in findings]
+        assert e114[0].severity == "warning"
+        assert e114[0].extra["model_attrs"] == ("net",)
+        assert "heavy_kernels" in e114[0].message
+
+    def test_compute_loop_without_declaration_is_E114(self):
+        findings = _evaluate(HeavyLoopMetric)
+        e114 = [f for f in findings if f.rule == "E114" and not f.suppressed]
+        assert len(e114) == 1, [f.rule for f in findings]
+        assert e114[0].extra["loop_method"] == "compute"
+        assert e114[0].obj == "HeavyLoopMetric.compute"
+
+    def test_registry_declaration_clears_E114(self):
+        findings = _evaluate(DeclaredHeavyMetric)
+        assert "E114" not in {f.rule for f in findings}
+
+    def test_unknown_kernel_name_is_E114(self):
+        findings = _evaluate(BogusDeclarationMetric)
+        e114 = [f for f in findings if f.rule == "E114" and not f.suppressed]
+        assert len(e114) == 1
+        assert "not_a_kernel" in e114[0].message
+
+    def test_clean_metric_has_no_E114(self):
+        findings = _evaluate(CleanMetric)
+        assert "E114" not in {f.rule for f in findings}
+
+    def test_E114_is_suppressible_via_spec_allow(self):
+        findings = _evaluate(HeavyModelMetric, dict(_SPEC, allow=("E114",)))
+        e114 = [f for f in findings if f.rule == "E114"]
+        assert e114 and all(f.suppressed for f in e114)
+
+    def test_declared_heavies_in_repo_pass(self):
+        """The shipped heavy metrics all declare registry kernels."""
+        from metrics_tpu.ops.kernels import KERNELS
+
+        for cls_name, mod in (
+            ("MeanAveragePrecision", "metrics_tpu.detection"),
+            ("BERTScore", "metrics_tpu.text.bert"),
+            ("FrechetInceptionDistance", "metrics_tpu.image"),
+            ("KernelInceptionDistance", "metrics_tpu.image"),
+            ("InceptionScore", "metrics_tpu.image"),
+            ("LearnedPerceptualImagePatchSimilarity", "metrics_tpu.image"),
+        ):
+            import importlib
+
+            cls = getattr(importlib.import_module(mod), cls_name)
+            declared = cls.heavy_kernels
+            assert declared, f"{cls_name} must declare its heavy-kernel path"
+            assert set(declared) <= set(KERNELS), f"{cls_name}: {declared}"

@@ -60,5 +60,5 @@ def test_cli_list_rules():
         timeout=120,
     )
     assert proc.returncode == 0
-    for rule_id in ("A001", "A006", "E002", "E107"):
+    for rule_id in ("A001", "A006", "E002", "E107", "E114"):
         assert rule_id in proc.stdout

@@ -109,11 +109,16 @@ def test_overflow_is_sticky_through_merge_and_append():
 
 
 def test_non_bufferable_metric_rejects_capacity():
-    """Per-element list states (mAP's per-image boxes) cannot be buffered."""
+    """Per-element list states (mAP's host-list mode) cannot be buffered."""
     from metrics_tpu import MeanAveragePrecision
 
     with pytest.raises(MetricsUserError, match="does not support `buffer_capacity`"):
-        MeanAveragePrecision(buffer_capacity=64)
+        MeanAveragePrecision(device_state=False, buffer_capacity=64)
+
+    # the device-state default replaces the per-image lists with pow2-padded
+    # CatBuffers, so there buffer_capacity is the image capacity
+    m = MeanAveragePrecision(buffer_capacity=64)
+    assert m.device_state and m.det_boxes.capacity == 64
 
 
 def test_from_array_roundtrip():

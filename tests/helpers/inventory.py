@@ -387,9 +387,12 @@ INVENTORY = {
     ),
     # --------------------------------------------------------- detection ----
     "MeanAveragePrecision": Entry(
-        lambda: M.MeanAveragePrecision(),
+        # host-list mode: per-image variable-count box lists by design. The
+        # device_state=True default compiles update through CatBuffer states
+        # and is pinned by tests/ops/test_heavy_kernels.py + tests/detection.
+        lambda: M.MeanAveragePrecision(device_state=False),
         lambda: ((_DET_PREDS, _DET_TARGET), {}),
-        "eager_only",  # per-image variable-count box lists by design
+        "eager_only",
     ),
     # ---------------------------------------------------------- wrappers ----
     "BootStrapper": Entry(

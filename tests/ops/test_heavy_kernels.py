@@ -1,0 +1,450 @@
+"""Parity suite for the heavy-kernel layer (``metrics_tpu/ops/kernels/``).
+
+Three gates per kernel, all running on the tier-1 CPU lane:
+
+* **Pallas-interpret vs jit reference** — ``use_pallas="force"`` runs the
+  Pallas body in interpret mode off-TPU; matching/IoU outputs must be bitwise
+  equal, float similarity is tolerance-bounded by matmul accumulation order.
+* **jit reference vs pre-change eager** — the legacy einsum/per-image code
+  the kernels replaced, reproduced inline (and, for mAP, the still-shipping
+  ``device_state=False`` host-list path); bitwise.
+* **recompile-count guards** — the trace-time counters in
+  ``metrics_tpu.ops.kernels`` prove pow2 bucketing bounds the jit signature
+  set: ragged streams retrace at most once per bucket, steady state retraces
+  zero times.
+
+Device-mode Pallas runs are ``@pytest.mark.pallas`` and skip off-TPU.
+"""
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.ops import kernels as K
+from metrics_tpu.ops.kernels import (
+    BucketedFeatureExtractor,
+    evaluate_matches,
+    maybe_bucketed,
+    next_pow2,
+    pairwise_cosine_pr,
+)
+
+_ON_TPU = jax.default_backend() not in ("cpu", "gpu")
+
+
+# --------------------------------------------------------------------------- #
+# fixtures
+# --------------------------------------------------------------------------- #
+def _random_images(rng, n_images, max_det=9, max_gt=7, pad_det=16, pad_gt=8):
+    """pow2-padded ragged detection/groundtruth buffers + counts."""
+
+    def boxes(n, pad):
+        xy = rng.uniform(0, 80, size=(pad, 2)).astype(np.float32)
+        wh = rng.uniform(1, 40, size=(pad, 2)).astype(np.float32)
+        out = np.concatenate([xy, xy + wh], axis=1)
+        out[n:] = 0.0
+        return out
+
+    det_boxes, det_scores, det_labels, det_counts = [], [], [], []
+    gt_boxes, gt_labels, gt_counts = [], [], []
+    for _ in range(n_images):
+        nd = int(rng.integers(0, max_det + 1))
+        ng = int(rng.integers(0, max_gt + 1))
+        det_boxes.append(boxes(nd, pad_det))
+        scores = rng.uniform(0, 1, size=pad_det).astype(np.float32)
+        scores[nd:] = 0.0
+        det_scores.append(scores)
+        lbl = rng.integers(0, 3, size=pad_det).astype(np.int32)
+        lbl[nd:] = -1
+        det_labels.append(lbl)
+        det_counts.append(nd)
+        gt_boxes.append(boxes(ng, pad_gt))
+        glbl = rng.integers(0, 3, size=pad_gt).astype(np.int32)
+        glbl[ng:] = -1
+        gt_labels.append(glbl)
+        gt_counts.append(ng)
+    return dict(
+        det_boxes=np.stack(det_boxes), det_scores=np.stack(det_scores),
+        det_labels=np.stack(det_labels), det_counts=np.asarray(det_counts, np.int32),
+        gt_boxes=np.stack(gt_boxes), gt_labels=np.stack(gt_labels),
+        gt_counts=np.asarray(gt_counts, np.int32),
+    )
+
+
+_CLASS_IDS = np.array([0, 1, 2, 0], np.int32)
+_CLASS_MASK = np.array([True, True, True, False])
+_AREA_RANGES = np.array([[0.0, 1e10], [0.0, 1024.0], [1024.0, 9216.0], [9216.0, 1e10]], np.float32)
+_THRESHOLDS = np.linspace(0.5, 0.95, 10).astype(np.float32)
+
+
+def _eval_matches(batch, use_pallas):
+    return evaluate_matches(
+        **batch,
+        class_ids=_CLASS_IDS, class_mask=_CLASS_MASK,
+        area_ranges=_AREA_RANGES, thresholds=_THRESHOLDS,
+        max_det=100, use_pallas=use_pallas,
+    )
+
+
+def _coco_lists(rng, n_images, n_classes=3):
+    """Legacy-format COCO list inputs (ragged per image)."""
+    preds, target = [], []
+    for _ in range(n_images):
+        nd = int(rng.integers(0, 8))
+        ng = int(rng.integers(0, 6))
+
+        def boxes(n):
+            xy = rng.uniform(0, 80, size=(n, 2)).astype(np.float32)
+            wh = rng.uniform(1, 40, size=(n, 2)).astype(np.float32)
+            return np.concatenate([xy, xy + wh], axis=1)
+
+        preds.append({
+            "boxes": jnp.asarray(boxes(nd)),
+            "scores": jnp.asarray(rng.uniform(0, 1, size=nd).astype(np.float32)),
+            "labels": jnp.asarray(rng.integers(0, n_classes, size=nd).astype(np.int32)),
+        })
+        target.append({
+            "boxes": jnp.asarray(boxes(ng)),
+            "labels": jnp.asarray(rng.integers(0, n_classes, size=ng).astype(np.int32)),
+        })
+    return preds, target
+
+
+# --------------------------------------------------------------------------- #
+# iou_matching
+# --------------------------------------------------------------------------- #
+class TestIouMatchingKernel:
+    def test_interpret_pallas_bitwise_equals_jit_reference(self, monkeypatch):
+        monkeypatch.delenv("METRICS_TPU_PALLAS", raising=False)
+        rng = np.random.default_rng(0)
+        batch = _random_images(rng, 12)
+        ref = _eval_matches(batch, "never")
+        pal = _eval_matches(batch, "force")
+        assert set(ref) == set(pal)
+        for key in ref:
+            np.testing.assert_array_equal(
+                np.asarray(ref[key]), np.asarray(pal[key]), err_msg=key
+            )
+
+    def test_jit_reference_matches_legacy_per_image_eager(self):
+        """The fused batch program vs the pre-change building blocks
+        (``box_iou`` + ``match_image``) applied per image, eagerly."""
+        from metrics_tpu.ops.detection.boxes import box_iou
+        from metrics_tpu.ops.detection.matching import match_image
+
+        rng = np.random.default_rng(1)
+        batch = _random_images(rng, 6)
+        out = _eval_matches(batch, "never")
+        for i in range(6):
+            nd = int(batch["det_counts"][i])
+            ng = int(batch["gt_counts"][i])
+            order = np.argsort(-batch["det_scores"][i][:nd], kind="stable")
+            ious = np.zeros((batch["det_boxes"].shape[1], batch["gt_boxes"].shape[1]), np.float32)
+            if nd and ng:
+                ious[:nd, :ng] = np.asarray(
+                    box_iou(batch["det_boxes"][i][:nd][order], batch["gt_boxes"][i][:ng])
+                )
+            labels_sorted = np.full(batch["det_labels"].shape[1], -1, np.int32)
+            labels_sorted[:nd] = batch["det_labels"][i][:nd][order]
+            det_class = (labels_sorted[None, :] == _CLASS_IDS[:, None]) & (
+                np.arange(labels_sorted.size)[None, :] < nd
+            ) & _CLASS_MASK[:, None]
+            gt_class = (batch["gt_labels"][i][None, :] == _CLASS_IDS[:, None]) & (
+                np.arange(batch["gt_labels"].shape[1])[None, :] < ng
+            ) & _CLASS_MASK[:, None]
+            gt_areas = (batch["gt_boxes"][i][:, 2] - batch["gt_boxes"][i][:, 0]) * (
+                batch["gt_boxes"][i][:, 3] - batch["gt_boxes"][i][:, 1]
+            )
+            gt_area_ignore = (gt_areas[None, :] < _AREA_RANGES[:, :1]) | (
+                gt_areas[None, :] > _AREA_RANGES[:, 1:]
+            )
+            legacy_matches, _ = match_image(
+                jnp.asarray(ious), jnp.asarray(det_class), jnp.asarray(gt_class),
+                jnp.asarray(gt_area_ignore), jnp.asarray(_THRESHOLDS),
+            )
+            np.testing.assert_array_equal(
+                np.asarray(out["det_matches"])[i], np.asarray(legacy_matches), err_msg=f"image {i}"
+            )
+
+    def test_recompile_guard_same_shapes_trace_once(self):
+        rng = np.random.default_rng(2)
+        K.reset_trace_counts()
+        for _ in range(5):
+            _eval_matches(_random_images(rng, 4), "never")
+        assert K.trace_counts().get("iou_matching", 0) <= 1
+
+    @pytest.mark.pallas
+    @pytest.mark.skipif(not _ON_TPU, reason="device-mode Pallas needs a real TPU")
+    def test_device_pallas_bitwise_equals_jit_reference(self):
+        rng = np.random.default_rng(3)
+        batch = _random_images(rng, 8)
+        ref = _eval_matches(batch, "never")
+        pal = _eval_matches(batch, "force")
+        for key in ref:
+            np.testing.assert_array_equal(np.asarray(ref[key]), np.asarray(pal[key]), err_msg=key)
+
+
+class TestMeanAPDeviceState:
+    def test_device_state_bitwise_equals_legacy_host_lists(self):
+        from metrics_tpu.detection import MeanAveragePrecision
+
+        rng = np.random.default_rng(4)
+        dev = MeanAveragePrecision(class_metrics=True)
+        host = MeanAveragePrecision(class_metrics=True, device_state=False)
+        assert dev.device_state and not host.device_state
+        for _ in range(3):
+            preds, target = _coco_lists(rng, 5)
+            dev.update(preds, target)
+            host.update(preds, target)
+        got, want = dev.compute(), host.compute()
+        assert set(got) == set(want)
+        for key in want:
+            np.testing.assert_array_equal(np.asarray(got[key]), np.asarray(want[key]), err_msg=key)
+
+    def test_update_recompiles_bounded_by_pow2_buckets(self):
+        """Ragged image-batch sizes (1..6) collapse to 3 pow2 buckets; the
+        compiled update engine plus the matching kernel retrace at most once
+        per bucket and not per distinct batch size."""
+        from metrics_tpu.detection import MeanAveragePrecision
+
+        rng = np.random.default_rng(5)
+        K.reset_trace_counts()
+        m = MeanAveragePrecision()
+        sizes = [1, 2, 3, 4, 5, 6, 3, 5, 2, 6, 1, 4]
+        for n in sizes:
+            preds, target = _coco_lists(rng, n)
+            m.update(preds, target)
+        buckets = {next_pow2(n) for n in sizes}
+        stats = m._update_engine.stats
+        assert stats.cache_misses <= len(buckets), stats
+        assert stats.cache_hits + stats.donated_calls > 0, stats
+        m.compute()
+        traced_after_first = K.trace_counts().get("iou_matching", 0)
+        m.compute()  # steady state: no new kernel traces
+        assert K.trace_counts().get("iou_matching", 0) == traced_after_first
+
+
+# --------------------------------------------------------------------------- #
+# cosine_matching
+# --------------------------------------------------------------------------- #
+def _random_embeddings(rng, b=3, l=1, p=7, r=5, d=16):
+    pe = rng.normal(size=(b, l, p, d)).astype(np.float32)
+    te = rng.normal(size=(b, l, r, d)).astype(np.float32)
+    pe /= np.linalg.norm(pe, axis=-1, keepdims=True)
+    te /= np.linalg.norm(te, axis=-1, keepdims=True)
+    pw = rng.uniform(0.1, 1, size=(b, p)).astype(np.float32)
+    tw = rng.uniform(0.1, 1, size=(b, r)).astype(np.float32)
+    return jnp.asarray(pe), jnp.asarray(te), jnp.asarray(pw), jnp.asarray(tw)
+
+
+@jax.jit
+def _legacy_pr_f1(pe, te, pw, tw):
+    """The pre-change ``_precision_recall_f1`` verbatim — including its
+    ``jax.jit`` decoration, which fixes the fusion (and thus rounding) order
+    the bitwise comparison pins."""
+    cos_sim = jnp.einsum("blpd,blrd->blpr", pe, te)
+    precision = jnp.einsum("bls,bs->bls", jnp.max(cos_sim, axis=3), pw).sum(-1)
+    recall = jnp.einsum("bls,bs->bls", jnp.max(cos_sim, axis=2), tw).sum(-1)
+    f1 = 2 * precision * recall / (precision + recall)
+    f1 = jnp.where(jnp.isnan(f1), 0.0, f1)
+    return precision.T.squeeze(), recall.T.squeeze(), f1.T.squeeze()
+
+
+class TestCosineMatchingKernel:
+    def test_jit_reference_bitwise_equals_legacy_eager(self):
+        args = _random_embeddings(np.random.default_rng(6))
+        got = pairwise_cosine_pr(*args, use_pallas="never")
+        want = _legacy_pr_f1(*args)
+        for g, w, name in zip(got, want, ("precision", "recall", "f1")):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=name)
+
+    def test_interpret_pallas_tolerance_bounded_vs_reference(self, monkeypatch):
+        monkeypatch.delenv("METRICS_TPU_PALLAS", raising=False)
+        args = _random_embeddings(np.random.default_rng(7))
+        ref = pairwise_cosine_pr(*args, use_pallas="never")
+        pal = pairwise_cosine_pr(*args, use_pallas="force")
+        for g, w, name in zip(pal, ref, ("precision", "recall", "f1")):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-5, atol=1e-6, err_msg=name)
+
+    def test_recompile_guard_same_shapes_trace_once(self):
+        rng = np.random.default_rng(8)
+        K.reset_trace_counts()
+        for _ in range(4):
+            pairwise_cosine_pr(*_random_embeddings(rng), use_pallas="never")
+        assert K.trace_counts().get("cosine_matching", 0) <= 1
+
+    def test_ops_text_bert_delegates_to_kernel(self):
+        from metrics_tpu.ops.text.bert import _precision_recall_f1
+
+        args = _random_embeddings(np.random.default_rng(9))
+        got = _precision_recall_f1(*args)
+        want = _legacy_pr_f1(*args)
+        for g, w, name in zip(got, want, ("precision", "recall", "f1")):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=name)
+
+    @pytest.mark.pallas
+    @pytest.mark.skipif(not _ON_TPU, reason="device-mode Pallas needs a real TPU")
+    def test_device_pallas_tolerance_bounded(self):
+        args = _random_embeddings(np.random.default_rng(10))
+        ref = pairwise_cosine_pr(*args, use_pallas="never")
+        pal = pairwise_cosine_pr(*args, use_pallas="force")
+        for g, w in zip(pal, ref):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-4)
+
+
+# --------------------------------------------------------------------------- #
+# feature_extract
+# --------------------------------------------------------------------------- #
+class TestBucketedFeatureExtractor:
+    def test_values_identical_and_signatures_bounded(self):
+        shapes_seen = set()
+
+        def feat(imgs):
+            shapes_seen.add(tuple(imgs.shape))
+            return imgs.reshape(imgs.shape[0], -1) * 2.0
+
+        feat.row_independent = True
+        wrapped = maybe_bucketed(feat, True)
+        assert isinstance(wrapped, BucketedFeatureExtractor)
+        rng = np.random.default_rng(11)
+        for n in (1, 2, 3, 4, 5, 6, 7, 8, 3, 5, 7):
+            imgs = jnp.asarray(rng.normal(size=(n, 2, 2)).astype(np.float32))
+            np.testing.assert_array_equal(np.asarray(wrapped(imgs)), np.asarray(feat(imgs)))
+        # ragged 1..8 collapses to pow2 batches {1,2,4,8} (+ the raw shapes the
+        # parity recheck above added): the padded call set stays log-bounded
+        padded = {s for s in shapes_seen if s[0] in (1, 2, 4, 8)}
+        assert {s[0] for s in padded} <= {1, 2, 4, 8}
+
+    def test_opt_outs(self):
+        def frn(x):
+            return x
+
+        frn.row_independent = False
+        assert maybe_bucketed(frn, True) is frn
+        assert maybe_bucketed(None, True) is None
+
+        def fr(x):
+            return x
+
+        assert maybe_bucketed(fr, False) is fr
+        wrapped = maybe_bucketed(fr, True)
+        assert maybe_bucketed(wrapped, True) is wrapped
+
+    def test_multi_array_padding_lpips_style(self):
+        def dist(a, b):
+            return jnp.mean((a - b) ** 2, axis=(1, 2, 3))
+
+        wrapped = maybe_bucketed(dist, True)
+        rng = np.random.default_rng(12)
+        a = jnp.asarray(rng.normal(size=(5, 3, 4, 4)).astype(np.float32))
+        b = jnp.asarray(rng.normal(size=(5, 3, 4, 4)).astype(np.float32))
+        np.testing.assert_array_equal(np.asarray(wrapped(a, b)), np.asarray(dist(a, b)))
+
+    def test_attribute_delegation(self):
+        class Net:
+            row_independent = True
+            num_features = 77
+
+            def __call__(self, x):
+                return x
+
+        wrapped = maybe_bucketed(Net(), True)
+        assert wrapped.num_features == 77
+
+
+# --------------------------------------------------------------------------- #
+# observability: tracer events + strict Prometheus exposition
+# --------------------------------------------------------------------------- #
+class TestHeavyKernelObservability:
+    def test_dispatch_and_fallback_series_parse_strictly(self):
+        from metrics_tpu.observability import to_prometheus_text
+        from metrics_tpu.observability.instruments import get_registry
+        from tests.observability.test_exporters import _StrictPromParser
+
+        get_registry().clear()
+        try:
+            batch = _random_images(np.random.default_rng(13), 2)
+            _eval_matches(batch, "never")
+            K.record_fallback("iou_matching", "synthetic: exposition test")
+            text = to_prometheus_text(get_registry())
+            families, samples = _StrictPromParser().parse(text)
+            by = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
+            assert by[(
+                "metrics_tpu_heavy_kernel_calls",
+                (("impl", "jit"), ("kernel", "iou_matching")),
+            )] >= 1.0
+            assert by[(
+                "metrics_tpu_heavy_kernel_fallbacks", (("kernel", "iou_matching"),)
+            )] == 1.0
+            assert families["metrics_tpu_heavy_kernel_bucket_width"]["type"] == "histogram"
+            width_counts = [
+                v for (n, labels), v in by.items()
+                if n == "metrics_tpu_heavy_kernel_bucket_width_count"
+                and dict(labels)["kernel"] == "iou_matching"
+            ]
+            assert width_counts and width_counts[0] >= 1.0
+        finally:
+            get_registry().clear()
+
+    def test_kernel_dispatch_tracer_events(self):
+        from metrics_tpu import observability as obs
+        from metrics_tpu.observability.tracer import EVENT_CATALOG
+
+        assert EVENT_CATALOG["kernel"] == ("kernel/dispatch", "kernel/fallback")
+        with obs.trace() as tracer:
+            _eval_matches(_random_images(np.random.default_rng(14), 2), "never")
+        counts = tracer.counts_by_name()
+        assert counts.get("kernel/dispatch", 0) >= 1
+        event = next(e for e in tracer.events() if e.name == "kernel/dispatch")
+        assert event.args["kernel"] == "iou_matching"
+        assert event.args["impl"] == "jit"
+        assert event.args["bucket_width"] == 16
+
+
+# --------------------------------------------------------------------------- #
+# registry hygiene
+# --------------------------------------------------------------------------- #
+class TestKernelRegistry:
+    def test_registry_entries_are_importable_and_documented(self):
+        import importlib
+
+        for name, spec in K.KERNELS.items():
+            assert spec.name == name
+            mod = importlib.import_module(spec.module)
+            assert mod is not None
+            assert spec.description and spec.pallas_scope
+
+    def test_resolve_use_pallas_modes(self, monkeypatch):
+        monkeypatch.delenv("METRICS_TPU_PALLAS", raising=False)
+        assert K.resolve_use_pallas("never") == (False, False)
+        use, interpret = K.resolve_use_pallas("force")
+        assert use and interpret == (not _ON_TPU)
+        # plain auto never claims the pallas path off-TPU or mid-trace
+        if not _ON_TPU:
+            assert K.resolve_use_pallas("auto") == (False, False)
+        assert K.resolve_use_pallas("auto", traced=True)[0] in (False, _ON_TPU)
+        monkeypatch.setenv("METRICS_TPU_PALLAS", "never")
+        assert K.resolve_use_pallas("auto") == (False, False)
+        monkeypatch.setenv("METRICS_TPU_PALLAS", "force")
+        assert K.resolve_use_pallas("auto")[0] is True
+        with pytest.raises(ValueError):
+            K.resolve_use_pallas("sometimes")
+
+    def test_pallas_failure_falls_back_to_reference(self, monkeypatch):
+        """A Pallas body that raises must land on the XLA reference with a
+        fallback record, never an exception."""
+        from metrics_tpu.ops.kernels import cosine_matching as cm
+
+        def boom(*a, **kw):
+            raise RuntimeError("synthetic pallas failure")
+
+        monkeypatch.setattr(cm, "_pr_f1_pallas", boom)
+        args = _random_embeddings(np.random.default_rng(15))
+        want = pairwise_cosine_pr(*args, use_pallas="never")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            got = pairwise_cosine_pr(*args, use_pallas="force")
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
