@@ -2758,6 +2758,352 @@ def bench_quantized_sync() -> None:
         sys.exit(1)
 
 
+def _self_tuning_child() -> None:
+    """``--child self_tuning``: the ISSUE-17 self-tuning sync controller on
+    the 8-device CPU mesh (device count forced by the parent's XLA_FLAGS).
+
+    Three regimes on the merged config2 state: all-exact (the floor the
+    tuner must beat), the hand-best declaration from BENCH_r19 (int8
+    everywhere), and the tuner starting from nothing — a driver loop that
+    re-jits exactly when the decision epoch moves, until every bucket
+    commits. Records the converged wire bytes, steady-state jitted sync wall
+    time, realized error against the exact sync, retraces after warmup, and
+    the decision log; plus the tuned trace-time wire accounting of a
+    4096-class confusion matrix and the facade dispatch fast-lane overhead
+    (the ``Metric.update()`` hot path vs a raw jit call on the same-shaped
+    pytree)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import metrics_tpu
+    from metrics_tpu import (
+        Accuracy, ConfusionMatrix, F1Score, MetricCollection, Precision, Recall,
+    )
+    from metrics_tpu.autotune import controller as _at
+    from metrics_tpu.parallel.sync import count_collectives, sync_state
+
+    world = 8
+    devices = jax.devices()
+    if len(devices) < world:
+        raise RuntimeError(f"expected {world} forced host devices, got {len(devices)}")
+    mesh = Mesh(np.asarray(devices[:world]), ("data",))
+    rng = np.random.default_rng(0)
+
+    # ---- config2: the merged member states, one flat dict ------------------
+    coll = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES, average="micro"),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "precision": Precision(num_classes=NUM_CLASSES, average="macro"),
+            "recall": Recall(num_classes=NUM_CLASSES, average="macro"),
+        }
+    )
+    logits = jnp.asarray(rng.normal(size=(BATCH, NUM_CLASSES)), dtype=jnp.float32)
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=jnp.int32)
+    coll.update(logits, target)
+    flat_state, flat_reds = {}, {}
+    for mname, m in coll.items():
+        for sname, leaf in m.metric_state.items():
+            flat_state[f"{mname}.{sname}"] = jnp.asarray(leaf)
+            flat_reds[f"{mname}.{sname}"] = m._reductions[sname]
+    stacked = jax.tree_util.tree_map(
+        lambda a: jnp.stack([a * (i + 1) for i in range(world)]), flat_state
+    )
+
+    def make_fn(transports=None):
+        def body(s):
+            local = jax.tree_util.tree_map(lambda x: x[0], s)
+            out = sync_state(
+                local, flat_reds, "data", bucketed=True, transports=transports
+            )
+            return jax.tree_util.tree_map(lambda x: jnp.expand_dims(x, 0), out)
+
+        return jax.jit(shard_map(
+            body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_rep=False
+        ))
+
+    def trace_wire(transports=None):
+        with count_collectives() as box:
+            jax.make_jaxpr(
+                lambda st: sync_state(
+                    st, flat_reds, "data", bucketed=True, transports=transports
+                ),
+                axis_env=[("data", world)],
+            )(flat_state)
+        return {
+            "wire_bytes": int(sum(v["wire"] for v in box["bytes_by_transport"].values())),
+            "logical_bytes": int(sum(v["logical"] for v in box["bytes_by_transport"].values())),
+            "by_transport": {k: dict(v) for k, v in box["bytes_by_transport"].items()},
+            "refusals": len(box["refusals"]),
+        }
+
+    def steady_ms(fn):
+        out = jax.block_until_ready(fn(stacked))
+        return out, min(
+            _timed(lambda: jax.block_until_ready(fn(stacked))) for _ in range(5)
+        ) * 1e3
+
+    def rel_err(out, ref):
+        denom = max(
+            float(max(np.max(np.abs(np.asarray(v, np.float64))) for v in ref.values())),
+            1e-30,
+        )
+        return max(
+            float(np.max(np.abs(
+                np.asarray(out[k], np.float64) - np.asarray(ref[k], np.float64)
+            )))
+            for k in ref
+        ) / denom
+
+    # the two fixed regimes: all-exact and the BENCH_r19 hand-best (int8)
+    metrics_tpu.set_autotune(False)
+    exact_out, exact_ms = steady_ms(make_fn({k: "exact" for k in flat_state}))
+    exact_rec = dict(trace_wire({k: "exact" for k in flat_state}), sync_ms=round(exact_ms, 3))
+    hand = {k: "int8" for k in flat_state}
+    hand_out, hand_ms = steady_ms(make_fn(hand))
+    hand_rec = dict(
+        trace_wire(hand),
+        sync_ms=round(hand_ms, 3),
+        max_rel_err=rel_err(hand_out, exact_out),
+    )
+
+    # ---- the tuner: re-jit on epoch movement until every bucket commits ----
+    metrics_tpu.set_autotune(True)
+    epoch = _at.decision_epoch()
+    fn = make_fn()
+    retraces = 0
+    for _ in range(48):
+        if _at.decision_epoch() != epoch:
+            epoch = _at.decision_epoch()
+            fn = make_fn()
+            retraces += 1
+        out = fn(stacked)
+    ctl = _at.get_controller()
+    converged = all(t.phase == "committed" for t in ctl.buckets.values())
+    # warm now: further steps (and one fresh trace) must add zero decisions
+    pre = _at.decision_epoch()
+    for _ in range(4):
+        out = fn(stacked)
+    make_fn()(stacked)
+    retraces_after_warm = _at.decision_epoch() - pre
+    tuned_out, tuned_ms = steady_ms(fn)
+    tuned_rec = dict(
+        trace_wire(),  # traces with the committed transports
+        sync_ms=round(tuned_ms, 3),
+        max_rel_err=rel_err(tuned_out, exact_out),
+        converged=converged,
+        retraces=retraces,
+        retraces_after_warm=int(retraces_after_warm),
+        decisions=len(ctl.decisions),
+        committed={k: t.committed for k, t in sorted(ctl.buckets.items())},
+        error_budget=max(
+            t.tolerance_for(t.current)
+            for t in ctl.buckets.values()
+            if t.current not in ("exact", "sparse_count")
+        ) if any(
+            t.current not in ("exact", "sparse_count") for t in ctl.buckets.values()
+        ) else 0.0,
+    )
+    plan = _at.export_plan().to_dict()
+
+    # ---- confmat-4096: tuned trace-time wire accounting --------------------
+    metrics_tpu.set_autotune(True)  # fresh controller for the new universe
+    cm = ConfusionMatrix(num_classes=4096)
+    cm.update(
+        jnp.asarray(rng.integers(0, 4096, size=(8192,)), dtype=jnp.int32),
+        jnp.asarray(rng.integers(0, 4096, size=(8192,)), dtype=jnp.int32),
+    )
+    cm_state = {k: jnp.asarray(v) for k, v in cm.metric_state.items()}
+    cm_reds = dict(cm._reductions)
+    box_rec = None
+    for _ in range(12):
+        before = _at.decision_epoch()
+        with count_collectives() as box:
+            jax.make_jaxpr(
+                lambda st: sync_state(st, cm_reds, "data", bucketed=True),
+                axis_env=[("data", world)],
+            )(cm_state)
+        box_rec = {
+            "wire_bytes": int(sum(v["wire"] for v in box["bytes_by_transport"].values())),
+            "logical_bytes": int(sum(v["logical"] for v in box["bytes_by_transport"].values())),
+        }
+        cm_ctl = _at.get_controller()
+        if _at.decision_epoch() == before and all(
+            t.phase == "committed" for t in cm_ctl.buckets.values()
+        ):
+            break
+    confmat = dict(
+        box_rec,
+        committed={k: t.committed for k, t in sorted(cm_ctl.buckets.items())},
+        wire_reduction_x=round(
+            box_rec["logical_bytes"] / max(1, box_rec["wire_bytes"]), 3
+        ),
+    )
+    metrics_tpu.set_autotune(None)
+
+    # ---- facade dispatch fast lane (satellite: the update() hot path) ------
+    acc = Accuracy(num_classes=4)
+    preds = jnp.asarray(rng.normal(size=(32, 4)), dtype=jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 4, size=(32,)), dtype=jnp.int32)
+    for _ in range(8):
+        acc.update(preds, labels)  # warm past the eager-warmup window
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        acc.update(preds, labels)
+    jax.block_until_ready(acc.metric_state["tp"])
+    facade_us = (time.perf_counter() - t0) / n * 1e6
+    raw_state = {k: jnp.asarray(v) for k, v in acc.metric_state.items()}
+    raw_fn = jax.jit(lambda s: {k: v + 1 for k, v in s.items()})
+    raw_out = jax.block_until_ready(raw_fn(raw_state))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        raw_out = raw_fn(raw_out)
+    jax.block_until_ready(raw_out["tp"])
+    raw_us = (time.perf_counter() - t0) / n * 1e6
+    stats = acc.engine_stats()["update"]
+    dispatch = {
+        "facade_us_per_update": round(facade_us, 2),
+        "raw_jit_us_per_call": round(raw_us, 2),
+        "facade_overhead_us": round(facade_us - raw_us, 2),
+        "key_fast_hits": int(stats.key_fast_hits),
+        "cache_hits": int(stats.cache_hits),
+        "eager_calls": int(stats.eager_calls),
+    }
+
+    print(
+        json.dumps({
+            "world": world,
+            "config2": {
+                "exact": exact_rec,
+                "hand_best_int8": hand_rec,
+                "tuned": tuned_rec,
+            },
+            "tuned_plan": plan,
+            "confmat_4096": confmat,
+            "dispatch": dispatch,
+        }),
+        flush=True,
+    )
+
+
+def bench_self_tuning() -> None:
+    """``--self-tuning``: the self-tuning sync controller end to end on the
+    8-device mesh — tuned vs hand-best vs all-exact on config2's merged sync
+    plus a tuned 4096-class confusion matrix — and the facade dispatch
+    fast-lane overhead; recorded into ``BENCH_r22.json`` and judged by the
+    regression watchdog. Host-side CPU bench (forced device count in a child
+    process).
+
+    Hard gates: the tuner converges (every bucket committed) with zero
+    retraces after warmup; realized error <= the error budget; tuned wire
+    bytes within 10% of the BENCH_r19 hand-best declaration; the facade
+    fast-lane is live (key_fast_hits > 0) and its dispatch overhead over a
+    raw jit call stays under 120 µs."""
+    import glob as _glob
+
+    from metrics_tpu.observability import regress as _regress
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    child = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", "self_tuning"],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=1500.0,
+        cwd=REPO,
+    )
+    if child.returncode != 0:
+        raise RuntimeError(f"self-tuning child failed:\n{child.stderr[-2000:]}")
+    mesh8 = json.loads(child.stdout.strip().splitlines()[-1])
+
+    c2 = mesh8["config2"]
+    tuned, hand, exact = c2["tuned"], c2["hand_best_int8"], c2["exact"]
+    record = {
+        # headline: config2's tuned wire bytes per sync — lower is better;
+        # the hand-best and exact baselines ride in extra
+        "metric": "self_tuning_config2_tuned_wire_bytes",
+        "value": tuned["wire_bytes"],
+        "unit": "bytes",
+        "extra": {
+            "world": mesh8["world"],
+            "config2_exact_wire_bytes": exact["wire_bytes"],
+            "config2_hand_best_wire_bytes": hand["wire_bytes"],
+            "config2_tuned_vs_hand_best_x": round(
+                tuned["wire_bytes"] / max(1, hand["wire_bytes"]), 3
+            ),
+            "config2_tuned_sync_ms": tuned["sync_ms"],
+            "config2_tuned_max_rel_err": tuned["max_rel_err"],
+            "config2_tuned_retraces_after_warm": tuned["retraces_after_warm"],
+            "config2": c2,
+            "confmat_4096": mesh8["confmat_4096"],
+            "dispatch": mesh8["dispatch"],
+            "tuned_plan_buckets": mesh8["tuned_plan"]["buckets"],
+        },
+    }
+
+    # watchdog self-check: judge this round against the checked-in trajectory
+    rounds = [
+        r
+        for r in _regress.load_rounds(sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json"))))
+        if r.name != "r22"
+    ]
+    rounds.append(_regress.Round("r22", "<this-run>", record))
+    report = _regress.check_trajectory(rounds)
+    record["extra"]["regress"] = {
+        "ok": report.ok,
+        "regression_count": len(report.regressions),
+        "keys_checked": report.keys_checked,
+        "regressions": [r.describe() for r in report.regressions],
+    }
+
+    with open(os.path.join(REPO, "BENCH_r22.json"), "w") as fh:
+        json.dump(record, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(record), flush=True)
+
+    problems = []
+    if not tuned["converged"]:
+        problems.append("tuner did not commit every config2 bucket in budget")
+    if tuned["retraces_after_warm"] != 0:
+        problems.append(
+            f"{tuned['retraces_after_warm']} retraces after warmup (want 0)"
+        )
+    if tuned["error_budget"] and tuned["max_rel_err"] > tuned["error_budget"]:
+        problems.append(
+            f"tuned realized error {tuned['max_rel_err']} exceeds the "
+            f"budget {tuned['error_budget']}"
+        )
+    if tuned["wire_bytes"] > 1.10 * hand["wire_bytes"]:
+        problems.append(
+            f"tuned wire bytes {tuned['wire_bytes']} not within 10% of the "
+            f"hand-best {hand['wire_bytes']}"
+        )
+    if tuned["refusals"]:
+        problems.append("the converged tuned trace still hit gate refusals")
+    dispatch = mesh8["dispatch"]
+    if dispatch["key_fast_hits"] <= 0:
+        problems.append("facade fast lane never hit (key_fast_hits == 0)")
+    if dispatch["facade_overhead_us"] > 120.0:
+        problems.append(
+            f"facade dispatch overhead {dispatch['facade_overhead_us']} µs "
+            "over a raw jit call exceeds the 120 µs gate"
+        )
+    if not report.ok:
+        problems.extend(r.describe() for r in report.regressions)
+    if problems:
+        print("[bench] self-tuning round FAILED its gates:", file=sys.stderr)
+        for p in problems:
+            print(f"[bench]   {p}", file=sys.stderr)
+        sys.exit(1)
+
+
 def bench_observability() -> None:
     """``--observability``: tracer on/off overhead on the config2 fused
     update (the ISSUE-7 hard rule: tracer *off* must not move the 4x fused
@@ -3924,8 +4270,17 @@ def main() -> None:
         "copy growth, bitwise parity both ways",
     )
     parser.add_argument(
+        "--self-tuning",
+        action="store_true",
+        help="measure the self-tuning sync controller: tuned vs hand-best vs "
+        "all-exact on config2's merged sync plus a tuned confmat-4096, and "
+        "the facade dispatch fast-lane overhead; record into BENCH_r22.json; "
+        "gates: error <= budget, 0 retraces after warmup, tuned wire bytes "
+        "within 10% of hand-best, fast lane live",
+    )
+    parser.add_argument(
         "--child",
-        choices=["sync_overhead", "sharded_state", "sharded_compute", "quantized_sync", "incremental_sync", "heavy_kernels", *_CHILD_BENCHES],
+        choices=["sync_overhead", "sharded_state", "sharded_compute", "quantized_sync", "incremental_sync", "heavy_kernels", "self_tuning", *_CHILD_BENCHES],
     )
     parser.add_argument(
         "--sync-scaling",
@@ -3981,6 +4336,9 @@ def main() -> None:
     if args.heavy_kernels:
         bench_heavy_kernels()
         return
+    if args.self_tuning:
+        bench_self_tuning()
+        return
     if args.sync_scaling:
         out = {}
         for w in (2, 4, 8, 16):
@@ -4009,6 +4367,9 @@ def main() -> None:
         return
     if args.child == "heavy_kernels":
         _heavy_kernels_child()
+        return
+    if args.child == "self_tuning":
+        _self_tuning_child()
         return
     if args.child in _CHILD_BENCHES:
         import jax

@@ -86,6 +86,14 @@ from metrics_tpu.image import (  # noqa: F401
     StructuralSimilarityIndexMeasure,
     UniversalImageQualityIndex,
 )
+from metrics_tpu import autotune  # noqa: F401
+from metrics_tpu.autotune import (  # noqa: F401
+    PolicyConfig,
+    TunedPlan,
+    autotune_enabled,
+    set_autotune,
+)
+from metrics_tpu.autotune import export_plan as export_tuned_plan  # noqa: F401
 from metrics_tpu.parallel import (  # noqa: F401
     bucketed_sync_enabled,
     set_bucketed_sync,
@@ -158,6 +166,9 @@ __all__ = [
     "set_bucketed_sync", "bucketed_sync_enabled",
     "set_sync_transport", "sync_transport_default", "transport_error_bound",
     "set_sync_mode", "sync_mode_default", "set_sync_cadence", "sync_cadence_default",
+    # autotune (self-tuning sync)
+    "autotune", "set_autotune", "autotune_enabled", "export_tuned_plan",
+    "TunedPlan", "PolicyConfig",
     # checkpoint
     "checkpoint", "save_checkpoint", "restore_checkpoint", "verify_checkpoint",
     # observability (event tracer, instrument registry, exporters)

@@ -126,6 +126,9 @@ def run_analysis(
                 report.skipped[entry.name] = entry.skip_eval
             if entry.notes:
                 report.notes[entry.name] = list(entry.notes)
+        # E115 is universe-level: a pinned tuned plan is diffed against the
+        # aggregate bucket set of every instantiated metric, not per class
+        report.findings.extend(eval_stage.evaluate_plan_drift(entries))
     else:
         # still surface constructor failures discovered while probing
         report.findings.extend(init_findings.values())

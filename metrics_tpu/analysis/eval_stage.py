@@ -410,6 +410,73 @@ def _evaluate_reshard_at_compute(entry: Entry, inst: Any, state: Any) -> List[Fi
     return findings
 
 
+def evaluate_plan_drift(entries: List[Entry]) -> List[Finding]:
+    """The E115 leg — universe-level, not per-metric: when a *pinned* tuned
+    plan is active (``set_autotune(plan)`` / ``METRICS_TPU_AUTOTUNE=<path>``),
+    aggregate every instantiated metric's tunable sync buckets and diff them
+    against the plan with :func:`metrics_tpu.autotune.plan.plan_drift`.
+
+    Pure planning — nothing is traced; the drift check re-runs the same
+    ``_gate_transport`` the runtime uses, so an ``inadmissible_transport``
+    record here IS the runtime's silent fall-back to exact. Live tuning (no
+    pin) has nothing to drift from and is skipped.
+    """
+    try:
+        from metrics_tpu.autotune import controller as _at
+        from metrics_tpu.autotune.plan import plan_drift
+    except Exception:  # pragma: no cover - autotune is part of this package
+        return []
+    if not _at.autotune_enabled():
+        return []
+    ctl = _at.get_controller()
+    plan = getattr(ctl, "pinned", None)
+    if plan is None:
+        return []
+
+    live: List[Dict[str, Any]] = []
+    for entry in entries:
+        inst = entry.instance
+        if inst is None or entry.skip_eval:
+            continue
+        try:
+            state = inst.get_state()
+        except Exception:  # noqa: BLE001 - uninstantiable states are E003's beat
+            continue
+        if not isinstance(state, dict) or not state:
+            continue
+        tolerances = dict(getattr(inst, "_sync_tolerances", {}) or {})
+        try:
+            buckets = _sync.transport_plan(
+                state,
+                dict(inst._reductions),
+                WORLD,
+                transports=dict(getattr(inst, "_sync_transports", {}) or {}),
+                tolerances=tolerances,
+                shard_axes=inst.active_shard_axes,
+            )
+        except Exception:  # noqa: BLE001 - unplannable states are E106/E107's beat
+            continue
+        for bucket in buckets:
+            # transport_plan reports the *effective* tolerance (0.0 when the
+            # requested transport is exact); the drift gate must see only the
+            # declared one, else a pinned lossy transport always reads refused
+            bucket = dict(bucket)
+            bucket["tolerance"] = _sync._bucket_tolerance(bucket["names"], tolerances)
+            live.append(bucket)
+
+    findings: List[Finding] = []
+    for record in plan_drift(plan, live, world=WORLD):
+        findings.append(
+            Finding(
+                rule="E115",
+                obj=f"tuned_plan[{record['bucket']}]",
+                message=f"pinned tuned_plan drift ({record['kind']}): {record['detail']}",
+                extra=dict(record),
+            )
+        )
+    return findings
+
+
 def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Finding]:
     findings: List[Finding] = []
     if entry.spec is None:

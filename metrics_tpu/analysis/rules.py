@@ -235,6 +235,19 @@ _EVAL_RULES = (
         "(\"<kernel>\", ...)` class attribute; an unknown kernel name in that "
         "declaration is also flagged.",
     ),
+    Rule(
+        "E115", "autotune-plan-drift", WARNING,
+        "a pinned self-tuning sync plan (set_autotune(plan) / "
+        "METRICS_TPU_AUTOTUNE=<path>) no longer matches the live metric "
+        "universe: it pins buckets the collection no longer produces "
+        "(missing_bucket), misses tunable buckets the collection does produce "
+        "(stale_bucket — they silently sync exact under the pin), or pins a "
+        "transport today's error-budget gate refuses for the live bucket "
+        "parameters (inadmissible_transport — the pin silently falls back to "
+        "exact and the recorded wire-byte saving never materializes); "
+        "re-export the plan (export_tuned_plan) against the current "
+        "collection.",
+    ),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in (*_AST_RULES, *_EVAL_RULES)}

@@ -1,0 +1,52 @@
+"""metrics_tpu.autotune — self-tuning sync under the error-budget gate.
+
+An opt-in controller that picks the sync transport (exact/bf16/int8/
+sparse_count) and incremental cadence K per (reduction, dtype) bucket from
+measured trace-time history, with the PR 14 gate as the hard safety floor:
+the tuner can only ever choose configurations the gate would admit, never
+loosen it. See docs/self_tuning_sync.md.
+
+Quick start::
+
+    import metrics_tpu
+
+    metrics_tpu.set_autotune(True)          # live explore-then-commit
+    ... run the workload, re-jitting when decision_epoch() moves ...
+    plan = metrics_tpu.export_tuned_plan()  # pin for reproducibility
+    plan.save("tuned_plan.json")
+
+    metrics_tpu.set_autotune(plan)          # replay: zero exploration
+    # or: METRICS_TPU_AUTOTUNE=/path/to/tuned_plan.json
+"""
+from metrics_tpu.autotune.controller import (
+    AutotuneController,
+    CADENCE_LADDER,
+    LADDER,
+    PolicyConfig,
+    autotune_enabled,
+    decision_epoch,
+    export_plan,
+    get_controller,
+    partition_token,
+    set_autotune,
+)
+from metrics_tpu.autotune.history import BucketHistory, BucketSample
+from metrics_tpu.autotune.plan import TunedPlan, bucket_key, plan_drift
+
+__all__ = [
+    "AutotuneController",
+    "BucketHistory",
+    "BucketSample",
+    "CADENCE_LADDER",
+    "LADDER",
+    "PolicyConfig",
+    "TunedPlan",
+    "autotune_enabled",
+    "bucket_key",
+    "decision_epoch",
+    "export_plan",
+    "get_controller",
+    "partition_token",
+    "plan_drift",
+    "set_autotune",
+]

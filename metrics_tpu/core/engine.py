@@ -94,6 +94,17 @@ def _env_default(flag: str = _ENV_FLAG) -> bool:
     return os.environ.get(flag, "1").lower() not in ("0", "false", "off")
 
 
+def _autotune_token() -> int:
+    """The self-tuning sync decision epoch (a stable constant while tuning is
+    off). Lazy import: autotune sits above parallel, which this module also
+    imports — the dependency must stay one-way."""
+    try:
+        from metrics_tpu.autotune import controller as _at
+    except Exception:
+        return -1
+    return _at.partition_token()
+
+
 _global_enabled: Optional[bool] = None  # None = follow the environment
 _global_compute_enabled: Optional[bool] = None  # None = follow the environment
 _global_fused_enabled: Optional[bool] = None  # None = follow the environment
@@ -315,7 +326,21 @@ class _SigCache:
             for leaf in leaves
         )
 
-    def signature(self, tree: Any, stats: Optional["EngineStats"] = None) -> Tuple:
+    def signature(
+        self,
+        tree: Any,
+        stats: Optional["EngineStats"] = None,
+        verify: Optional[Callable[[list], bool]] = None,
+    ) -> Optional[Tuple]:
+        """The tree's aval signature, or None when ``verify`` rejects it.
+
+        ``verify`` (a predicate over the flat leaves, e.g. the compilability
+        probe) only runs on a memo miss: a fast hit means the tree is built
+        from the very same leaf objects that passed verification when they
+        were stored, so re-checking them is pure overhead. Callers that pass
+        ``verify`` must do so on *every* call through this memo — mixing
+        verified and unverified stores in one cache would let an unverified
+        hit skip the probe."""
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         keys = self._leaf_keys(leaves)
         if (
@@ -326,6 +351,8 @@ class _SigCache:
             if stats is not None:
                 stats.key_fast_hits += 1
             return self._sig
+        if verify is not None and not verify(leaves):
+            return None
         sig = _aval_signature_flat(leaves, treedef)
         self._store(leaves, treedef, keys, sig)
         return sig
@@ -356,14 +383,23 @@ class _SigCache:
         self._keys, self._treedef, self._sig = keys, treedef, sig
 
 
-def _leaves_compilable(tree: Any) -> bool:
-    """True when every leaf is a concrete array or python/numpy scalar."""
-    for leaf in jax.tree_util.tree_leaves(tree):
+_COMPILABLE_LEAF_TYPES = (jnp.ndarray, np.ndarray) + _SCALAR_TYPES
+
+
+def _flat_leaves_compilable(leaves: list) -> bool:
+    """True when every (already flattened) leaf is a concrete array or
+    python/numpy scalar — the ``verify`` predicate for ``_SigCache``."""
+    for leaf in leaves:
         if isinstance(leaf, jax.core.Tracer):
             return False
-        if not isinstance(leaf, (jnp.ndarray, np.ndarray) + _SCALAR_TYPES):
+        if not isinstance(leaf, _COMPILABLE_LEAF_TYPES):
             return False
     return True
+
+
+def _leaves_compilable(tree: Any) -> bool:
+    """True when every leaf is a concrete array or python/numpy scalar."""
+    return _flat_leaves_compilable(jax.tree_util.tree_leaves(tree))
 
 
 def _protected_leaf_ids(*metrics: Any, include_shared: bool = True) -> set:
@@ -415,6 +451,10 @@ class _EngineBase:
         self._args_sig = _SigCache()
         self._state_sig = _SigCache()
         self._out_sigs: Dict[Any, Tuple] = {}  # dispatch key -> output state sig
+        # single-entry saturated-key memo: identity-compares the two memoized
+        # sig tuples (same objects on every steady-state call) so the hot
+        # path skips rebuilding + rehashing the nested key tuple entirely
+        self._fast_lane: Optional[Tuple] = None
         # weakly tracked by the instrument registry: this engine's stats show
         # up in observability snapshots as metrics_tpu_engine_*{kind,owner}
         _instruments.register_engine(self)
@@ -439,6 +479,7 @@ class _EngineBase:
         signature once and is compiled again immediately."""
         self._args_sig = _SigCache()
         self._state_sig = _SigCache()
+        self._fast_lane = None
 
     def _owner_name(self) -> str:
         """Class name of the metric/collection this engine accelerates."""
@@ -457,20 +498,39 @@ class _EngineBase:
 
     def _dispatch(self, plain_fn: Callable, donate_fn: Callable,
                   state: Any, args: Tuple, kwargs: Dict, protected: set,
-                  key_extra: Tuple = ()) -> Tuple[bool, Any]:
+                  key_extra: Tuple = (),
+                  verify_args: Optional[Callable[[list], bool]] = None) -> Tuple[bool, Any]:
         """Core cache dance. Returns (handled, result).
 
         ``key_extra`` folds caller-supplied compile-time constants (static
         update kwargs) into the dispatch key: the aval signature records only
         the *type* of non-array leaves, so two calls differing in a static
-        VALUE (``real=True`` vs ``real=False``) must not share an entry."""
-        key = (
-            key_extra,
-            self._args_sig.signature((args, kwargs), self.stats),
-            self._state_sig.signature(state, self.stats),
-        )
-        count = self._seen.get(key, 0)
-        self._seen[key] = count + 1
+        VALUE (``real=True`` vs ``real=False``) must not share an entry.
+        ``verify_args`` is a flat-leaf predicate run on args-memo misses (a
+        memo hit re-sees leaf objects that already passed it); rejection
+        returns (False, None) — the caller runs eager."""
+        args_sig = self._args_sig.signature((args, kwargs), self.stats, verify_args)
+        if args_sig is None:
+            self.stats.eager_calls += 1
+            return False, None
+        state_sig = self._state_sig.signature(state, self.stats)
+        fast = self._fast_lane
+        if (
+            fast is not None
+            and fast[0] is args_sig
+            and fast[1] is state_sig
+            and fast[2] == key_extra
+        ):
+            # saturated signature: past warmup and the trace probe, so the
+            # warmup counter dict is pure overhead — skip read and write
+            key = fast[3]
+            count = _WARMUP_CALLS + 1
+        else:
+            key = (key_extra, args_sig, state_sig)
+            count = self._seen.get(key, 0)
+            self._seen[key] = count + 1
+            if count > _WARMUP_CALLS:
+                self._fast_lane = (args_sig, state_sig, key_extra, key)
         if count < _WARMUP_CALLS:
             self.stats.eager_calls += 1
             if _otrace.active:
@@ -629,6 +689,21 @@ class CompiledUpdateEngine(_EngineBase):
         # the registered default objects never change for a live metric, so
         # their leaf ids are computed once, not per dispatch
         self._default_ids = frozenset(_protected_leaf_ids(metric, include_shared=False))
+        # construction-stable dispatch probes, snapshotted off the hot path
+        # (the engine is created on the first eligible update, after every
+        # add_state); reset_signature_memos refreshes them alongside the
+        # id-keyed memos on out-of-band state replacement
+        self._refresh_probes()
+
+    def _refresh_probes(self) -> None:
+        m = self.metric
+        self._supports_compiled = m.supports_compiled_update
+        self._accepts = getattr(m, "_engine_accepts", None)
+        self._buckets_flag = bool(getattr(m, "_batch_buckets", False))
+
+    def reset_signature_memos(self) -> None:
+        super().reset_signature_memos()
+        self._refresh_probes()
 
     # ------------------------------------------------------------------ #
     def dispatch(self, args: Tuple, kwargs: Dict) -> bool:
@@ -637,26 +712,31 @@ class CompiledUpdateEngine(_EngineBase):
         Returns True when the update has been fully applied (compiled or
         bucketed); False tells the caller to run the eager update itself.
         """
-        m = self.metric
         if self._broken is not None or self._has_children:
             return False
-        if not m.supports_compiled_update:
+        if not self._supports_compiled:
             return False
         # per-call gate: a metric accepting several input forms (e.g. mAP's
         # COCO lists vs dense padded dicts) declines the uncompilable ones
         # here WITHOUT tripping the permanent `_broken` fallback
-        accepts = getattr(m, "_engine_accepts", None)
+        accepts = self._accepts
         if accepts is not None and not accepts(args, kwargs):
             return False
-        if _tracing_active() or not _leaves_compilable((args, kwargs)):
+        if _tracing_active():
             return False
         statics: Tuple = ()
         if self._static_names:
+            if not _leaves_compilable((args, kwargs)):
+                return False
             split = self._split_statics(args, kwargs)
             if split is not None:
                 args, kwargs, statics = split
-        if getattr(m, "_batch_buckets", False):
+        if self._buckets_flag:
+            if not _leaves_compilable((args, kwargs)):
+                return False
             return self._dispatch_bucketed(args, kwargs, statics)
+        # the plain path folds the leaf compilability probe into the args
+        # signature memo: a memo hit re-sees verified leaf objects
         return self._dispatch_compiled(args, kwargs, statics)
 
     def _split_statics(self, args: Tuple, kwargs: Dict) -> Optional[Tuple[Tuple, Dict, Tuple]]:
@@ -708,6 +788,7 @@ class CompiledUpdateEngine(_EngineBase):
             plain_fn, donate_fn, state, args, kwargs,
             self._default_ids | shared if shared else self._default_ids,
             key_extra=statics,
+            verify_args=_flat_leaves_compilable,
         )
         if handled:
             m.set_state(new_state)
@@ -1359,7 +1440,12 @@ class CollectionDispatcher:
         membership rebuild, which drops the dispatcher outright. Migrated
         members are part of the key so their eager placement is sticky."""
         coll = self.collection
-        parts = [("sync_mode", _sync.sync_mode_default())]
+        parts = [
+            ("sync_mode", _sync.sync_mode_default()),
+            # the autotune decision epoch: a tuner decision repartitions (and
+            # re-traces) exactly once; a committed tuner adds zero rebuilds
+            ("autotune", _autotune_token()),
+        ]
         for group in coll._groups:
             leader = coll._metrics[group[0]]
             parts.append((

@@ -433,6 +433,7 @@ class InstrumentRegistry:
         out.extend(self._partition_samples())
         out.extend(self._tenant_samples())
         out.extend(self._ingest_samples())
+        out.extend(_autotune_samples())
         out.extend(_process_samples())
         return out
 
@@ -470,6 +471,43 @@ def _rss_bytes() -> Optional[int]:
         return int(rss_kb) * 1024  # Linux reports KiB (peak, not current — best effort)
     except Exception:
         return None
+
+
+def _autotune_samples() -> Iterable[Sample]:
+    """Derived self-tuning-sync gauges read off the live controller at
+    snapshot time (the per-decision counters/gauges are pushed by the
+    controller itself; these cover the controller-level view). Lazy import:
+    observability must stay importable without the autotune package."""
+    try:
+        from metrics_tpu.autotune import controller as _at
+    except Exception:
+        return
+    enabled = _at.autotune_enabled()
+    yield Sample(f"{PREFIX}autotune_enabled", {},
+                 1.0 if enabled else 0.0, "gauge",
+                 "1 while the self-tuning sync controller is active.")
+    yield Sample(f"{PREFIX}autotune_decision_epoch", {},
+                 float(_at.decision_epoch()), "gauge",
+                 "Monotonic tuner decision epoch (cache keys re-trace on change).")
+    if not enabled:
+        return
+    ctl = _at.get_controller()
+    if ctl is None:
+        return
+    yield Sample(f"{PREFIX}autotune_pinned", {},
+                 1.0 if ctl.pinned is not None else 0.0, "gauge",
+                 "1 while a pinned tuned_plan bypasses exploration.")
+    with ctl._lock:
+        n_buckets = len(ctl.buckets) if ctl.pinned is None else len(ctl.pinned.buckets)
+        committed = sum(
+            1 for t in ctl.buckets.values() if t.phase == "committed"
+        ) if ctl.pinned is None else n_buckets
+    yield Sample(f"{PREFIX}autotune_tracked_buckets", {},
+                 float(n_buckets), "gauge",
+                 "Buckets the tuner currently tracks (or the pinned plan covers).")
+    yield Sample(f"{PREFIX}autotune_committed_buckets", {},
+                 float(committed), "gauge",
+                 "Tracked buckets whose decision has committed.")
 
 
 def _process_samples() -> Iterable[Sample]:

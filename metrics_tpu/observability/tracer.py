@@ -86,6 +86,7 @@ EVENT_CATALOG: Dict[str, Tuple[str, ...]] = {
         "sync/bucket_build",  # one bucketed sync build (args: collective tallies)
         "sync/transport_refused",  # error-budget gate fell a bucket back to exact (args: reason)
         "sync/incremental_emit",  # one in-streak incremental emission (args: emission, fold/replace leaves, tallies)
+        "sync/tune_decision",  # autotune controller decision (args: bucket, from, to, reason, cadence, predicted bytes/bound)
     ),
     "shard": (
         "shard/place",  # Metric.shard_state placement

@@ -118,16 +118,25 @@ def set_sync_mode(mode: Optional[str]) -> None:
 
 def sync_cadence_default() -> int:
     """The default emission cadence K (emit every K-th update of an
-    incremental streak): ``set_sync_cadence`` / ``METRICS_TPU_SYNC_EVERY``,
-    1 unless overridden. The per-carry ``sync_every=`` argument of
-    :func:`init_incremental` takes precedence."""
+    incremental streak): ``set_sync_cadence`` > ``METRICS_TPU_SYNC_EVERY`` >
+    the autotune controller's committed cadence > 1. The per-carry
+    ``sync_every=`` argument of :func:`init_incremental` takes precedence
+    over all of these."""
     if _sync_cadence_default is not None:
         return _sync_cadence_default
-    try:
-        k = int(os.environ.get(_ENV_SYNC_EVERY, "1"))
-    except ValueError:
-        return 1
-    return max(1, k)
+    env = os.environ.get(_ENV_SYNC_EVERY)
+    if env is not None:
+        try:
+            k = int(env)
+        except ValueError:
+            return 1
+        return max(1, k)
+    ctl = _autotune_controller()
+    if ctl is not None:
+        tuned = ctl.cadence()
+        if tuned is not None:
+            return max(1, int(tuned))
+    return 1
 
 
 def set_sync_cadence(sync_every: Optional[int]) -> None:
@@ -275,6 +284,33 @@ def _sparse_slots(nelems: int) -> int:
     return max(1, min(nelems, int(np.ceil(SPARSE_COUNT_DENSITY * nelems))))
 
 
+def transport_wire_bytes(transport: str, nelems: int, dtype: Any) -> int:
+    """Analytic per-device wire bytes one synced bucket moves on ``transport``.
+
+    Mirrors exactly what the codecs tick into :func:`count_collectives`
+    (payload + protocol overhead: int8 scale exchange, sparse nnz probe —
+    minus the sparse overflow fallback branch, which never executes in the
+    admitted regime). The autotune controller scores candidate transports
+    with this model, so its predictions and the measured tallies agree by
+    construction; a parity test pins the two against each other.
+    """
+    n = int(nelems)
+    itemsize = int(np.dtype(dtype).itemsize)
+    if transport == "exact":
+        return n * itemsize
+    if transport == "bf16":
+        return 2 * n
+    if transport == "int8":
+        # padded int8 payload (the codec psums whole INT8_BLOCK blocks) plus
+        # one f32 max-abs scale per block (the pmax exchange)
+        nblocks = -(-n // INT8_BLOCK) if n else 0
+        return nblocks * (INT8_BLOCK + 4)
+    if transport == "sparse_count":
+        # nnz pmax probe + (values ++ indices) gather at the slot capacity
+        return 4 + 2 * _sparse_slots(n) * itemsize
+    raise ValueError(f"unknown sync transport {transport!r}")
+
+
 def _gate_transport(
     transport: str,
     red: Any,
@@ -356,14 +392,46 @@ def _axis_world(axis_name: AxisNames) -> Optional[int]:
         return None
 
 
-def _resolve_transport(name: str, transports: Optional[Dict[str, str]]) -> str:
+def _autotune_controller():
+    """The live autotune controller, or None (lazy import — the autotune
+    package imports this module at module level, so the dependency must point
+    one way only)."""
+    try:
+        from metrics_tpu.autotune import controller as _at
+    except Exception:
+        return None
+    if not _at.autotune_enabled():
+        return None
+    return _at.get_controller()
+
+
+def _resolve_transport(
+    name: str,
+    transports: Optional[Dict[str, str]],
+    red: Any = None,
+    dtype: Any = None,
+    kind: str = "psum",
+) -> str:
+    """Per-state declaration > autotune controller > global default.
+
+    The tuner only speaks for buckets it can key — elementwise psum
+    reductions and reshard leaves — and only when the caller supplies the
+    (reduction, dtype) identity; everything else falls straight through to
+    the global default, and per-state declarations always outrank the tuner
+    (declared buckets are invisible to it)."""
     t = (transports or {}).get(name)
     if t is not None and t not in TRANSPORTS:
         raise ValueError(
             f"unknown sync transport {t!r} for state {name!r}; "
             f"expected one of {TRANSPORTS}"
         )
-    return t if t is not None else sync_transport_default()
+    if t is not None:
+        return t
+    if dtype is not None and (kind == "reshard" or red in _ELEMENTWISE):
+        ctl = _autotune_controller()
+        if ctl is not None:
+            return ctl.transport_for(red, dtype, kind=kind)
+    return sync_transport_default()
 
 
 def _bucket_tolerance(
@@ -408,7 +476,7 @@ def transport_plan(
         if dtype is None or shape is None or callable(red):
             continue
         kind = "reshard" if name in shard_axes else "psum"
-        t = _resolve_transport(name, transports)
+        t = _resolve_transport(name, transports, red=red, dtype=dtype, kind=kind)
         groups.setdefault((red, np.dtype(dtype), t, kind), []).append((name, val))
     plan: List[Dict[str, Any]] = []
     for (red, dtype, requested, kind), items in groups.items():
@@ -815,25 +883,41 @@ def _sync_bucketed(
     value, so refusals are value-invisible.
     """
     out: Dict[str, Any] = {}
+    ctl = _autotune_controller()
     buckets: Dict[Tuple[Any, Any, str], List[Tuple[str, Array]]] = {}
     for name, arr, red in entries:
         arr = jnp.asarray(arr)
-        buckets.setdefault((red, arr.dtype, _resolve_transport(name, transports)), []).append((name, arr))
+        t = _resolve_transport(name, transports, red=red, dtype=arr.dtype)
+        buckets.setdefault((red, arr.dtype, t), []).append((name, arr))
     world = None
-    if any(t != "exact" for _, _, t in buckets):
+    if ctl is not None or any(t != "exact" for _, _, t in buckets):
         world = _axis_world(axis_name)
     for (red, dtype, requested), items in buckets.items():
-        transport = requested
-        if requested != "exact":
+        transport, refusal = requested, None
+        if requested != "exact" or ctl is not None:
             names = [n for n, _ in items]
             nelems = int(sum(a.size for _, a in items))
+            tol = _bucket_tolerance(names, tolerances)
+        if requested != "exact":
             transport, refusal = _gate_transport(
                 requested, red, np.dtype(dtype), nelems, world,
-                _bucket_tolerance(names, tolerances),
-                error_scale=error_scale,
+                tol, error_scale=error_scale,
             )
             if refusal is not None:
                 _tick_refusal(dict(refusal, reduction=str(red), dtype=str(np.dtype(dtype)), states=names))
+        if (
+            ctl is not None
+            and red in _ELEMENTWISE
+            and not any(n in (transports or {}) for n in names)
+        ):
+            # trace-time observation feed: buckets with per-state transport
+            # declarations stay invisible to the tuner (they outrank it)
+            ctl.observe_bucket(
+                red, np.dtype(dtype), kind="psum",
+                requested=requested, transport=transport, refusal=refusal,
+                nelems=nelems, world=world, tolerance=tol,
+                error_scale=error_scale,
+            )
         if transport != "exact":
             flat = (
                 jnp.ravel(items[0][1]) if len(items) == 1
@@ -902,28 +986,37 @@ def _sync_resharded(
     ``sparse_count`` never applies here (dense disjoint blocks).
     """
     out: Dict[str, Any] = {}
+    ctl = _autotune_controller()
     buckets: Dict[Tuple[Any, int, str], List[Tuple[str, Array, int]]] = {}
     for name, arr, axis in entries:
         arr = jnp.asarray(arr)
         axis = axis % max(arr.ndim, 1)
-        t = _resolve_transport(name, transports)
+        t = _resolve_transport(name, transports, dtype=arr.dtype, kind="reshard")
         buckets.setdefault((arr.dtype, int(arr.shape[axis]), t), []).append((name, arr, axis))
     world = None
-    if any(t != "exact" for _, _, t in buckets):
+    if ctl is not None or any(t != "exact" for _, _, t in buckets):
         world = _axis_world(axis_name)
     for (dtype, dim, requested), items in buckets.items():
-        transport = requested
-        if requested != "exact":
+        transport, refusal = requested, None
+        if requested != "exact" or ctl is not None:
             names = [n for n, _, _ in items]
             nelems = int(sum(a.size for _, a, _ in items))
+            tol = _bucket_tolerance(names, tolerances)
+        if requested != "exact":
             transport, refusal = _gate_transport(
                 requested, None, np.dtype(dtype), nelems, world,
-                _bucket_tolerance(names, tolerances), kind="reshard",
+                tol, kind="reshard",
             )
             if refusal is not None:
                 _tick_refusal(dict(
                     refusal, reduction="reshard", dtype=str(np.dtype(dtype)), states=names,
                 ))
+        if ctl is not None and not any(n in (transports or {}) for n in names):
+            ctl.observe_bucket(
+                "reshard", np.dtype(dtype), kind="reshard",
+                requested=requested, transport=transport, refusal=refusal,
+                nelems=nelems, world=world, tolerance=tol,
+            )
         if transport == "exact" and len(items) == 1:
             name, arr, axis = items[0]
             _tick_collective("reshard", _leaf_nbytes(arr))
@@ -1465,7 +1558,14 @@ def init_incremental(
         acc[name] = jnp.zeros(leaf.shape, leaf.dtype)
         if entry["codec"] == "fold":
             last[name] = jnp.zeros(leaf.shape, leaf.dtype)
-    track = any(_resolve_transport(n, transports) != "exact" for n in acc)
+    track = any(
+        _resolve_transport(
+            n, transports,
+            red=reductions.get(n), dtype=getattr(state.get(n), "dtype", None),
+        )
+        != "exact"
+        for n in acc
+    )
     return IncrementalCarry(
         dict(state), acc, last, sync_every=k, pending=0, emissions=0,
         track_emissions=track,

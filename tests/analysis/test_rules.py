@@ -1046,3 +1046,145 @@ class TestE114HeavyEagerResidue:
             declared = cls.heavy_kernels
             assert declared, f"{cls_name} must declare its heavy-kernel path"
             assert set(declared) <= set(KERNELS), f"{cls_name}: {declared}"
+
+
+# --------------------------------------------------------------------------- #
+# E115 — pinned tuned-plan drift (universe-level leg)
+# --------------------------------------------------------------------------- #
+class TestPlanDriftE115:
+    """``plan_drift`` unit coverage per drift kind, plus the universe-level
+    ``evaluate_plan_drift`` leg end-to-end under a pinned plan."""
+
+    @staticmethod
+    def _live(red="sum", dtype="float32", kind="psum", elements=8192,
+              names=("total",), tolerance=None):
+        return {
+            "names": list(names), "reduction": red, "dtype": dtype,
+            "kind": kind, "elements": elements, "tolerance": tolerance,
+        }
+
+    @staticmethod
+    def _plan(buckets):
+        from metrics_tpu.autotune.plan import TunedPlan
+
+        return TunedPlan(buckets=buckets)
+
+    def test_matching_plan_has_no_drift(self):
+        from metrics_tpu.autotune.plan import plan_drift
+
+        plan = self._plan({"sum|float32|psum": {"transport": "bf16"}})
+        assert plan_drift(plan, [self._live()], world=8) == []
+
+    def test_missing_bucket(self):
+        from metrics_tpu.autotune.plan import plan_drift
+
+        plan = self._plan({
+            "sum|float32|psum": {"transport": "exact"},
+            "mean|float64|psum": {"transport": "bf16"},
+        })
+        drift = plan_drift(plan, [self._live()], world=8)
+        assert [d["kind"] for d in drift] == ["missing_bucket"]
+        assert drift[0]["bucket"] == "mean|float64|psum"
+
+    def test_stale_bucket(self):
+        from metrics_tpu.autotune.plan import plan_drift
+
+        plan = self._plan({"sum|float32|psum": {"transport": "exact"}})
+        drift = plan_drift(
+            plan,
+            [self._live(), self._live(dtype="int32", names=("count",))],
+            world=8,
+        )
+        assert [d["kind"] for d in drift] == ["stale_bucket"]
+        assert drift[0]["bucket"] == "sum|int32|psum"
+
+    def test_inadmissible_transport(self):
+        from metrics_tpu.autotune.plan import plan_drift
+
+        # pinned tolerance is tighter than the bf16 psum bound on world=8,
+        # so the gate refuses the pin — it silently syncs exact at runtime
+        plan = self._plan(
+            {"sum|float32|psum": {"transport": "bf16", "tolerance": 0.001}}
+        )
+        drift = plan_drift(plan, [self._live()], world=8)
+        assert [d["kind"] for d in drift] == ["inadmissible_transport"]
+        assert "error_budget" in drift[0]["detail"]
+
+    def test_live_declared_tolerance_wins_over_pinned(self):
+        from metrics_tpu.autotune.plan import plan_drift
+
+        # the live bucket's declared tolerance gates, not the plan's snapshot
+        plan = self._plan({"sum|float32|psum": {"transport": "bf16"}})
+        drift = plan_drift(plan, [self._live(tolerance=0.001)], world=8)
+        assert [d["kind"] for d in drift] == ["inadmissible_transport"]
+
+    def test_non_tunable_live_entries_are_ignored(self):
+        from metrics_tpu.autotune.plan import plan_drift
+
+        plan = self._plan({})
+        drift = plan_drift(
+            plan,
+            [self._live(red="cat"), self._live(red=None, dtype="int64")],
+            world=8,
+        )
+        assert drift == []
+
+    # ------------------------------------------------------------------ #
+    # the analyzer leg
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def _entries():
+        entry = Entry(cls=DeferredPinnedMetric, spec={"init": {}})
+        entry.instance = DeferredPinnedMetric()
+        return [entry]
+
+    def test_pinned_drift_is_E115(self):
+        import metrics_tpu
+
+        plan = self._plan({
+            "sum|float32|psum": {"transport": "exact"},
+            "mean|float64|psum": {"transport": "bf16"},
+        })
+        metrics_tpu.set_autotune(plan)
+        try:
+            findings = eval_stage.evaluate_plan_drift(self._entries())
+        finally:
+            metrics_tpu.set_autotune(None)
+        kinds = sorted(f.extra["kind"] for f in findings)
+        assert kinds == ["missing_bucket", "stale_bucket"], [
+            (f.obj, f.extra["kind"]) for f in findings
+        ]
+        assert all(f.rule == "E115" for f in findings)
+        assert all(f.severity == "warning" for f in findings)
+        assert all(f.obj.startswith("tuned_plan[") for f in findings)
+
+    def test_exactly_matching_pin_has_no_E115(self):
+        import metrics_tpu
+
+        plan = self._plan({
+            "sum|float32|psum": {"transport": "exact"},
+            "sum|int32|psum": {"transport": "exact"},
+        })
+        metrics_tpu.set_autotune(plan)
+        try:
+            findings = eval_stage.evaluate_plan_drift(self._entries())
+        finally:
+            metrics_tpu.set_autotune(None)
+        assert findings == []
+
+    def test_live_tuning_has_no_E115(self):
+        import metrics_tpu
+
+        metrics_tpu.set_autotune(True)  # live tuning: nothing pinned to drift
+        try:
+            findings = eval_stage.evaluate_plan_drift(self._entries())
+        finally:
+            metrics_tpu.set_autotune(None)
+        assert findings == []
+
+    def test_autotune_off_has_no_E115(self):
+        assert eval_stage.evaluate_plan_drift(self._entries()) == []
+
+    def test_E115_rule_is_cataloged_as_warning(self):
+        assert RULES["E115"].name == "autotune-plan-drift"
+        assert RULES["E115"].severity == "warning"

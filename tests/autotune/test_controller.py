@@ -1,0 +1,405 @@
+"""Unit contract of the self-tuning sync controller (``metrics_tpu.autotune``).
+
+Everything here is host-side and deterministic: the policy is a pure function
+of the observation sequence (no wall clock, no randomness), admissibility is
+delegated to the very same ``sync._gate_transport`` the runtime enforces, and
+the analytic wire-byte model (``sync.transport_wire_bytes``) matches what the
+codecs tick into ``count_collectives`` byte-for-byte.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.autotune import (
+    AutotuneController,
+    CADENCE_LADDER,
+    PolicyConfig,
+    TunedPlan,
+    bucket_key,
+)
+from metrics_tpu.autotune.controller import _BucketTuner
+from metrics_tpu.autotune.history import BucketHistory, BucketSample
+from metrics_tpu.parallel import sync as _sync
+
+WORLD = 8
+
+
+def _observe(tuner, *, requested=None, nelems=8192, world=WORLD, tolerance=None,
+             refusal=None, error_scale=1.0):
+    """Feed one gate outcome mirroring what ``_sync_bucketed`` reports: the
+    tuner's own proposal, admitted (refusal=None) unless stated otherwise."""
+    req = requested if requested is not None else tuner.current
+    transport = "exact" if refusal is not None else req
+    return tuner.observe(
+        requested=req, transport=transport, refusal=refusal,
+        nelems=nelems, world=world, tolerance=tolerance, error_scale=error_scale,
+    )
+
+
+def _drive_to_commit(tuner, **kw):
+    events = []
+    for _ in range(32):
+        events.extend(_observe(tuner, **kw))
+        if tuner.phase == "committed":
+            break
+    assert tuner.phase == "committed"
+    return events
+
+
+def _tuner(red="sum", dtype="float32", kind="psum", config=None):
+    dtype = np.dtype(dtype)
+    return _BucketTuner(
+        bucket_key(red, dtype, kind), red, dtype, kind,
+        config if config is not None else PolicyConfig(),
+    )
+
+
+# --------------------------------------------------------------- admissibility
+class TestLadder:
+    def test_every_rung_passes_the_runtime_gate(self):
+        t = _tuner()
+        _observe(t)
+        for rung in t.ladder():
+            final, refusal = _sync._gate_transport(
+                rung, t.red, t.dtype, t.nelems, t.world,
+                t.tolerance_for(rung) if rung != "exact" else None,
+                kind=t.kind, error_scale=t.max_error_scale,
+            )
+            assert final == rung and refusal is None
+
+    def test_exact_is_always_admissible(self):
+        for red, dtype in (("sum", "float32"), ("max", "float32"), ("sum", "int32")):
+            t = _tuner(red=red, dtype=dtype)
+            _observe(t, nelems=2)
+            assert t.ladder()[0] == "exact"
+
+    def test_f32_sum_bucket_admits_the_quantized_rungs(self):
+        t = _tuner()
+        _observe(t)
+        assert set(t.ladder()) >= {"exact", "bf16", "int8"}
+
+    def test_max_bucket_is_exact_only(self):
+        # quantized transports carry sum reductions only; the gate routes a
+        # max bucket to exact as inapplicable, so the ladder has one rung
+        t = _tuner(red="max")
+        _observe(t)
+        assert t.ladder() == ("exact",)
+
+    def test_tight_tolerance_prunes_lossy_rungs(self):
+        t = _tuner(config=PolicyConfig(error_budget=1e-6))
+        _observe(t)
+        assert "bf16" not in t.ladder() and "int8" not in t.ladder()
+
+    def test_zero_declared_tolerance_is_exact_only_for_floats(self):
+        t = _tuner()
+        _observe(t, tolerance=0.0)
+        assert all(r in ("exact", "sparse_count") for r in t.ladder())
+
+    def test_error_budget_tightens_but_never_loosens(self):
+        # declared 0.002 beats the default 0.05; a *wider* budget must not
+        # re-admit what the declaration refused
+        wide = _tuner(config=PolicyConfig(error_budget=0.5))
+        _observe(wide, tolerance=0.002)
+        assert wide.tolerance_for("bf16") == pytest.approx(0.002)
+
+
+# ------------------------------------------------------------ explore / commit
+class TestExploreCommit:
+    def test_walks_the_ladder_then_commits_cheapest(self):
+        t = _tuner()
+        events = _drive_to_commit(t)
+        reasons = [e["reason"] for e in events]
+        assert reasons[-1] == "commit"
+        assert all(r == "explore" for r in reasons[:-1])
+        # int8 is the cheapest admissible rung for a dense 8192-elem f32 bucket
+        assert t.committed == "int8"
+        costs = {r: t.predicted_wire(r) for r in t.ladder()}
+        assert costs[t.committed] == min(costs.values())
+
+    def test_one_observation_per_rung_suffices(self):
+        # wire bytes are deterministic at trace time: exploration length is
+        # |ladder| observations, commit on the |ladder|-th
+        t = _tuner()
+        events = _drive_to_commit(t)
+        assert t.observations == len(t.ladder())
+        assert len(events) == len(t.ladder())
+
+    def test_no_world_no_decisions(self):
+        t = _tuner()
+        assert _observe(t, world=None) == []
+        assert t.phase == "explore" and t.current == "exact"
+
+    def test_decision_events_carry_the_audit_fields(self):
+        t = _tuner()
+        events = _drive_to_commit(t)
+        for e in events:
+            assert set(e) >= {
+                "bucket", "from", "to", "reason", "phase", "observation",
+                "cadence", "predicted_wire_bytes", "predicted_error_bound",
+            }
+            assert e["bucket"] == t.key
+
+
+# ------------------------------------------------------ dwell / hysteresis ---
+class TestNoFlap:
+    def test_committed_decision_stands_under_unchanged_costs(self):
+        t = _tuner()
+        _drive_to_commit(t)
+        committed = t.committed
+        for _ in range(3 * t.config.min_dwell):
+            assert _observe(t) == []
+        assert t.committed == committed
+
+    def test_challenger_needs_dwell_and_margin(self):
+        t = _tuner(config=PolicyConfig(min_dwell=4, hysteresis=0.10))
+        commit = _drive_to_commit(t, nelems=64)[-1]
+        # at 64 elements int8 costs one full block (260 B) vs bf16's 128 B, so
+        # the gate prunes it (no_byte_win) and bf16 commits. Grow the bucket:
+        # int8 amortizes its block overhead into the >10% cheaper challenger...
+        assert t.committed == "bf16"
+        events = []
+        for _ in range(2 * t.config.min_dwell):
+            events.extend(_observe(t, nelems=8192))
+        switched = [e for e in events if e["reason"] == "hysteresis"]
+        assert len(switched) == 1 and switched[0]["to"] == "int8"
+        # ...and the dwell floor kept the switch from firing immediately
+        assert switched[0]["observation"] - commit["observation"] >= t.config.min_dwell
+
+    def test_sub_margin_win_never_switches(self):
+        t = _tuner(config=PolicyConfig(min_dwell=2, hysteresis=0.60))
+        _drive_to_commit(t, nelems=64)
+        # int8 at 8192 elems beats bf16 by ~47% — under the 60% margin
+        for _ in range(6):
+            assert _observe(t, nelems=8192) == []
+
+
+# --------------------------------------------------------------- hard safety
+class TestPoison:
+    def test_gate_refusal_of_the_proposal_poisons_the_rung(self):
+        t = _tuner()
+        events = _observe(t)  # exact observed; exploration advances to bf16
+        assert events and events[-1]["to"] == "bf16"
+        events = _observe(  # the bf16 proposal comes back gate-refused
+            t, refusal={"reason": "error_budget", "bound": 1.0, "tolerance": 0.0}
+        )
+        assert "bf16" in t.poisoned
+        assert events and events[-1]["reason"] == "poisoned:error_budget"
+        assert events[-1]["to"] == "exact"
+        assert "bf16" not in t.ladder()
+
+    def test_poisoned_rung_never_reappears(self):
+        t = _tuner()
+        _observe(t)  # exact observed; advances to bf16
+        _observe(t, refusal={"reason": "error_budget"})  # bf16 refused
+        _drive_to_commit(t)
+        assert t.committed != "bf16"
+        for _ in range(3 * t.config.min_dwell):
+            _observe(t)
+        assert t.current != "bf16" and "bf16" not in t.ladder()
+
+    def test_poisoning_all_lossy_rungs_lands_on_exact(self):
+        t = _tuner()
+        _drive_to_commit(t)
+        for rung in ("bf16", "int8", "sparse_count"):
+            t.poison(rung, "error_spike")
+        assert t.current == "exact"
+
+    def test_controller_error_spike_demotes_and_logs(self):
+        ctl = AutotuneController(config=PolicyConfig())
+        key = bucket_key("sum", np.dtype("float32"))
+        for _ in range(8):
+            tuner = ctl.buckets.get(key)
+            ctl.observe_bucket(
+                "sum", np.dtype("float32"), requested=tuner.current if tuner else "exact",
+                transport=tuner.current if tuner else "exact", refusal=None,
+                nelems=8192, world=WORLD,
+            )
+            if ctl.buckets[key].phase == "committed":
+                break
+        committed = ctl.buckets[key].committed
+        assert committed in ("bf16", "int8")
+        ctl.observe_error("sum", np.dtype("float32"), measured=0.5)
+        assert committed in ctl.buckets[key].poisoned
+        assert ctl.decisions[-1]["reason"] == "error_spike" or \
+            ctl.decisions[-1]["reason"].startswith("poisoned:")
+
+    def test_measured_error_within_tolerance_is_benign(self):
+        ctl = AutotuneController(config=PolicyConfig())
+        ctl.observe_bucket(
+            "sum", np.dtype("float32"), requested="exact", transport="exact",
+            refusal=None, nelems=8192, world=WORLD,
+        )
+        before = list(ctl.decisions)
+        ctl.observe_error("sum", np.dtype("float32"), measured=1e-6)
+        assert ctl.decisions == before
+
+
+# ------------------------------------------------------------------- cadence
+class TestCadence:
+    def test_lossless_transports_take_the_cap(self):
+        t = _tuner(config=PolicyConfig(max_cadence=8))
+        assert t._cadence_for("exact") == 8
+        assert t._cadence_for("sparse_count") == 8
+
+    def test_lossy_cadence_respects_the_compounded_bound(self):
+        t = _tuner()
+        _observe(t, tolerance=0.2)
+        bound = _sync.transport_error_bound("bf16", WORLD, "psum")
+        want = max(k for k in CADENCE_LADDER if bound * k <= 0.2)
+        assert t._cadence_for("bf16") == want > 1
+
+    def test_tight_tolerance_pins_cadence_to_one(self):
+        t = _tuner()
+        _observe(t)  # default 0.05 tolerance; 2*bound > 0.05
+        assert t._cadence_for("bf16") == 1
+
+    def test_controller_cadence_is_min_over_committed(self):
+        ctl = AutotuneController(config=PolicyConfig())
+        assert ctl.cadence() is None  # nothing committed yet
+        for red, dtype, tol in (("sum", "float32", 0.2), ("sum", "int32", None)):
+            key = bucket_key(red, np.dtype(dtype))
+            for _ in range(8):
+                tuner = ctl.buckets.get(key)
+                cur = tuner.current if tuner else "exact"
+                ctl.observe_bucket(
+                    red, np.dtype(dtype), requested=cur, transport=cur,
+                    refusal=None, nelems=8192, world=WORLD, tolerance=tol,
+                )
+                if ctl.buckets[key].phase == "committed":
+                    break
+        cadences = [t.cadence for t in ctl.buckets.values()]
+        assert ctl.cadence() == min(cadences)
+
+
+# --------------------------------------------------------------- determinism
+class TestDeterminism:
+    def _run(self):
+        ctl = AutotuneController(config=PolicyConfig(min_dwell=2))
+        for step in range(24):
+            for red, dtype in (("sum", "float32"), ("sum", "int32"), ("max", "float32")):
+                key = bucket_key(red, np.dtype(dtype))
+                tuner = ctl.buckets.get(key)
+                cur = tuner.current if tuner else "exact"
+                ctl.observe_bucket(
+                    red, np.dtype(dtype), requested=cur, transport=cur,
+                    refusal=None, nelems=4096 if step < 12 else 8192, world=WORLD,
+                )
+        return ctl
+
+    def test_identical_observations_replay_identical_decisions_bitwise(self):
+        a, b = self._run(), self._run()
+        assert json.dumps(a.decisions, sort_keys=True) == \
+            json.dumps(b.decisions, sort_keys=True)
+
+    def test_export_plan_round_trips(self, tmp_path):
+        ctl = self._run()
+        plan = ctl.export_plan()
+        path = tmp_path / "plan.json"
+        plan.save(str(path))
+        loaded = TunedPlan.load(str(path))
+        assert loaded.to_dict() == plan.to_dict()
+        assert TunedPlan.from_dict(plan.to_dict()).to_dict() == plan.to_dict()
+
+    def test_plan_rejects_unknown_version_and_transport(self):
+        with pytest.raises(ValueError, match="version"):
+            TunedPlan.from_dict({"version": 99})
+        with pytest.raises(ValueError, match="transport"):
+            TunedPlan.from_dict(
+                {"buckets": {"sum|float32|psum": {"transport": "zstd"}}}
+            )
+
+
+# -------------------------------------------------------------- pinned plans
+class TestPinned:
+    def _plan(self):
+        return TunedPlan(
+            cadence=4,
+            buckets={
+                bucket_key("sum", np.dtype("float32")): {"transport": "int8"},
+                bucket_key("sum", np.dtype("int32")): {"transport": "bf16"},
+            },
+        )
+
+    def test_pin_bypasses_exploration(self):
+        ctl = AutotuneController(pinned=self._plan())
+        assert ctl.transport_for("sum", np.dtype("float32")) == "int8"
+        assert ctl.transport_for("sum", np.dtype("int32")) == "bf16"
+        ctl.observe_bucket(
+            "sum", np.dtype("float32"), requested="int8", transport="int8",
+            refusal=None, nelems=8192, world=WORLD,
+        )
+        assert ctl.buckets == {} and ctl.decisions == []
+
+    def test_uncovered_bucket_pins_to_exact(self):
+        ctl = AutotuneController(pinned=self._plan())
+        assert ctl.transport_for("mean", np.dtype("float64")) == "exact"
+
+    def test_pinned_cadence_wins(self):
+        ctl = AutotuneController(pinned=self._plan())
+        assert ctl.cadence() == 4
+
+    def test_pinned_replay_is_bitwise_identical(self):
+        # replaying a pinned plan produces the identical (empty) decision
+        # sequence and the identical transports — nothing explores
+        a = AutotuneController(pinned=self._plan())
+        b = AutotuneController(pinned=self._plan())
+        for ctl in (a, b):
+            for _ in range(8):
+                ctl.observe_bucket(
+                    "sum", np.dtype("float32"), requested="int8", transport="int8",
+                    refusal=None, nelems=8192, world=WORLD,
+                )
+        assert json.dumps(a.decisions) == json.dumps(b.decisions) == "[]"
+        assert a.export_plan().to_dict() == b.export_plan().to_dict()
+
+
+# ---------------------------------------------------- wire-byte model parity
+class TestWireByteModel:
+    @pytest.mark.parametrize("transport", ["exact", "bf16", "int8", "sparse_count"])
+    @pytest.mark.parametrize("n", [1, 64, 256, 1000, 8192])
+    def test_helper_matches_the_codec_tick(self, transport, n):
+        """``transport_wire_bytes`` (the tuner's cost model) must equal the
+        bytes the codec actually ticks into ``count_collectives`` for the
+        transport's *own* collectives — predicted == realized, per transport."""
+        dtype = jnp.int32 if transport == "sparse_count" else jnp.float32
+        state = {"s": jnp.zeros((n,), dtype)}
+        final, refusal = _sync._gate_transport(
+            transport, "sum", np.dtype(state["s"].dtype),
+            n, WORLD, None if transport == "exact" else _sync.default_tolerance(transport),
+        )
+        if final != transport:
+            pytest.skip(f"gate routes n={n} to {final}: {refusal}")
+        with _sync.count_collectives() as box:
+            jax.make_jaxpr(
+                lambda st: _sync.sync_state(
+                    st, {"s": "sum"}, "data", bucketed=True,
+                    transports={"s": transport},
+                ),
+                axis_env=[("data", WORLD)],
+            )(state)
+        ticked = box["bytes_by_transport"][transport]["wire"]
+        assert ticked == _sync.transport_wire_bytes(transport, n, np.dtype(state["s"].dtype))
+
+
+# ----------------------------------------------------------- history window
+class TestHistory:
+    def test_window_evicts_oldest(self):
+        h = BucketHistory(window=4)
+        for i in range(10):
+            h.record(BucketSample(ordinal=i, requested="exact", transport="exact",
+                                  wire_bytes=i))
+        assert h.count() == 4
+        assert h.last().wire_bytes == 9
+
+    def test_wire_mean_excludes_refused_samples(self):
+        h = BucketHistory(window=8)
+        h.record(BucketSample(ordinal=1, requested="bf16", transport="bf16",
+                              wire_bytes=100))
+        h.record(BucketSample(ordinal=2, requested="bf16", transport="exact",
+                              refused=True, refusal_reason="error_budget",
+                              wire_bytes=400))
+        assert h.wire_mean("bf16") == 100
+        assert h.refusals("bf16") == 1

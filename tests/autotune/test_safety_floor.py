@@ -1,0 +1,204 @@
+"""The tuner's hard safety floor under fault injection (chaos sweep).
+
+The strongest property the self-tuning controller offers: no matter what
+chaos does to the sync path — injected trace-time latency, aborted bucket
+builds, measured-error spikes — every transport the tuner ever *selects* is
+one the trace-time error-budget gate admits. Chaos can slow convergence and
+poison rungs; it can never push a bucket onto a transport the gate would
+refuse, and after error spikes the bucket demotes rung by rung back to
+``exact`` and stays there (poisoned rungs never return).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu
+from metrics_tpu import autotune as at
+from metrics_tpu.autotune import bucket_key
+from metrics_tpu.autotune import controller as at_controller
+from metrics_tpu.parallel import sync as sync_mod
+from metrics_tpu.resilience import chaos
+from metrics_tpu.resilience.chaos import ChaosError, FaultSpec
+
+WORLD = 8
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    metrics_tpu.set_autotune(False)
+    sync_mod.set_sync_transport(None)
+    sync_mod.set_sync_cadence(None)
+    yield
+    chaos.uninstall()
+    metrics_tpu.set_autotune(None)
+    sync_mod.set_sync_transport(None)
+    sync_mod.set_sync_cadence(None)
+
+
+@pytest.fixture()
+def mesh():
+    devices = jax.devices()
+    if len(devices) < WORLD:
+        pytest.skip("needs 8 devices")
+    return Mesh(np.asarray(devices[:WORLD]), ("data",))
+
+
+_STATE = {
+    "big": jnp.linspace(0.1, 40.0, 8192, dtype=jnp.float32),
+    "counts": (jnp.arange(1000, dtype=jnp.int32) % 7),
+    "mx": jnp.asarray([7.0, 1.0], jnp.float32),
+}
+_REDS = {"big": "sum", "counts": "sum", "mx": "max"}
+
+
+def _per_device(state):
+    return jax.tree_util.tree_map(
+        lambda a: jnp.stack([a * (i + 1) for i in range(WORLD)]), state
+    )
+
+
+def _make_fn(mesh, reds, transports=None):
+    def body(s):
+        local = jax.tree_util.tree_map(lambda x: x[0], s)
+        out = sync_mod.sync_state(
+            local, reds, "data", bucketed=True, transports=transports
+        )
+        return jax.tree_util.tree_map(lambda x: jnp.expand_dims(x, 0), out)
+
+    return jax.jit(
+        shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+                  check_rep=False)
+    )
+
+
+def _chaos_drive(mesh, state, reds, steps=40):
+    """Tuned driver that survives chaos: an aborted trace is dropped and
+    re-jitted on the next step (exactly what a resilient engine driver does).
+    Returns (last good output, aborted-trace count)."""
+    per_dev = _per_device(state)
+    epoch = at.decision_epoch()
+    fn = _make_fn(mesh, reds)
+    aborted = 0
+    out = None
+    for _ in range(steps):
+        if at.decision_epoch() != epoch:
+            epoch = at.decision_epoch()
+            fn = _make_fn(mesh, reds)
+        try:
+            out = fn(per_dev)
+        except ChaosError:
+            aborted += 1
+            fn = _make_fn(mesh, reds)
+    return out, aborted
+
+
+def _exact_reference(mesh, state, reds):
+    fn = _make_fn(mesh, reds, transports={n: "exact" for n in state})
+    out = fn(_per_device(state))
+    return jax.tree_util.tree_map(lambda x: np.asarray(x[0]), out)
+
+
+def _assert_decisions_gate_admissible(ctl):
+    """Re-run the runtime gate on every decision the tuner ever made: each
+    selected transport must be admitted at the bucket's own parameters."""
+    for event in ctl.decisions:
+        to = event["to"]
+        if to == "exact":
+            continue
+        tuner = ctl.buckets[event["bucket"]]
+        final, refusal = sync_mod._gate_transport(
+            to,
+            None if tuner.kind == "reshard" else tuner.red,
+            tuner.dtype,
+            tuner.nelems,
+            tuner.world,
+            tuner.tolerance_for(to),
+            kind=tuner.kind,
+            error_scale=tuner.max_error_scale,
+        )
+        assert final == to and refusal is None, (
+            f"tuner selected gate-refused transport {to!r} for "
+            f"{event['bucket']}: {refusal}"
+        )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_chaos_never_pushes_past_the_gate(mesh, seed):
+    """Latency + aborted-build faults at the sync seams: the tuner still
+    converges to the gate-admissible optimum and never selects a refused
+    transport, at any seed."""
+    metrics_tpu.set_autotune(True)
+    specs = [
+        FaultSpec("sync/*", kind="latency", probability=0.4, latency_s=0.002),
+        FaultSpec("sync/bucket_build", kind="error", probability=0.3, times=4),
+    ]
+    with chaos.plan(specs, seed=seed) as plan:
+        _chaos_drive(mesh, _STATE, _REDS, steps=40)
+        assert plan.fired("sync/bucket_build") > 0  # chaos actually hit
+    ctl = at_controller.get_controller()
+    _assert_decisions_gate_admissible(ctl)
+    for key, tuner in ctl.buckets.items():
+        assert tuner.phase == "committed", key
+    # chaos slowed the walk but the destination is unchanged
+    assert ctl.buckets[bucket_key("sum", np.dtype("float32"))].committed == "int8"
+    assert ctl.buckets[bucket_key("max", np.dtype("float32"))].committed == "exact"
+    # a post-chaos trace syncs within tolerance of the exact reference
+    out = np.asarray(_make_fn(mesh, _REDS)(_per_device(_STATE))["big"][0])
+    want = _exact_reference(mesh, _STATE, _REDS)["big"]
+    tol = ctl.buckets[bucket_key("sum", np.dtype("float32"))].tolerance_for("int8")
+    assert float(np.max(np.abs(out - want)) / np.max(np.abs(want))) <= tol
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_error_spikes_demote_to_exact_and_stay(mesh, seed):
+    """Measured-error spikes (the runtime feedback channel) poison the
+    current rung immediately; repeated spikes walk the bucket back to
+    ``exact``, poisoned rungs never return, and the demoted integer bucket
+    syncs bitwise-identical to untuned. The (deterministic) demotion path is
+    swept under three chaos seeds to interleave faults with the spikes."""
+    metrics_tpu.set_autotune(True)
+    with chaos.plan(
+        [FaultSpec("sync/bucket_build", kind="error", probability=0.25, times=3)],
+        seed=seed,
+    ):
+        _chaos_drive(mesh, _STATE, _REDS, steps=40)
+    ctl = at_controller.get_controller()
+    f32, i32 = np.dtype("float32"), np.dtype("int32")
+    lossless = ("exact", "sparse_count")  # both bitwise by construction
+    for dtype in (f32, i32):
+        tuner = ctl.buckets[bucket_key("sum", dtype)]
+        assert tuner.phase == "committed" and tuner.committed != "exact"
+        # spike until the bucket has demoted off every lossy rung (the i32
+        # bucket may land on sparse_count — lossless, so equally safe)
+        for _ in range(len(at.LADDER)):
+            if tuner.current in lossless:
+                break
+            ctl.observe_error("sum", dtype, measured=10.0 * tuner.tolerance_for(
+                tuner.current))
+        assert tuner.current in lossless
+        assert tuner.poisoned  # the spiked rungs are banned, not just avoided
+    demotions = [d for d in ctl.decisions if d["reason"].startswith("poisoned:")]
+    assert any(d["reason"] == "poisoned:error_spike" for d in demotions)
+    _assert_decisions_gate_admissible(ctl)
+
+    # poisoned rungs never reappear: further observations (well past the
+    # dwell floor) leave the decision log untouched
+    n_decisions = len(ctl.decisions)
+    for _ in range(3 * ctl.config.min_dwell):
+        for dtype in (f32, i32):
+            ctl.observe_bucket(
+                "sum", dtype, requested="exact", transport="exact",
+                nelems=8192 if dtype is f32 else 1000, world=WORLD,
+            )
+    assert len(ctl.decisions) == n_decisions
+
+    # fully demoted, the tuned sync is bitwise the untuned sync
+    out, _ = _chaos_drive(mesh, _STATE, _REDS, steps=2)
+    want = _exact_reference(mesh, _STATE, _REDS)
+    for name in _STATE:
+        np.testing.assert_array_equal(np.asarray(out[name][0]), want[name])

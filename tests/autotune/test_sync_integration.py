@@ -1,0 +1,282 @@
+"""Self-tuning sync wired into the runtime (``sync.py`` × ``autotune``).
+
+The contract on the 8-device CPU mesh: with ``set_autotune(True)`` a driver
+that re-jits when the decision epoch moves converges within the exploration
+budget (one trace per ladder rung per bucket), the converged transports are
+the cheapest gate-admissible rungs, realized error stays within the budget,
+the epoch then stops moving (zero retraces after warmup), per-state
+declarations stay invisible to the tuner, zero-tolerance buckets stay
+bitwise, cadence precedence is switch > env > tuner, and tenancy-stacked
+buckets tune through the same (reduction, dtype) keys independent of N.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu
+from metrics_tpu import autotune as at
+from metrics_tpu.autotune import PolicyConfig, bucket_key
+from metrics_tpu.autotune import controller as at_controller
+from metrics_tpu.parallel import sync as sync_mod
+
+WORLD = 8
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    metrics_tpu.set_autotune(False)
+    sync_mod.set_sync_transport(None)
+    sync_mod.set_sync_cadence(None)
+    yield
+    metrics_tpu.set_autotune(None)
+    sync_mod.set_sync_transport(None)
+    sync_mod.set_sync_cadence(None)
+
+
+@pytest.fixture()
+def mesh():
+    devices = jax.devices()
+    if len(devices) < WORLD:
+        pytest.skip("needs 8 devices")
+    return Mesh(np.asarray(devices[:WORLD]), ("data",))
+
+
+_STATE = {
+    "big": jnp.linspace(0.1, 40.0, 8192, dtype=jnp.float32),
+    "counts": (jnp.arange(1000, dtype=jnp.int32) % 7),
+    "mx": jnp.asarray([7.0, 1.0], jnp.float32),
+}
+_REDS = {"big": "sum", "counts": "sum", "mx": "max"}
+
+
+def _per_device(state):
+    return jax.tree_util.tree_map(
+        lambda a: jnp.stack([a * (i + 1) for i in range(WORLD)]), state
+    )
+
+
+def _make_fn(mesh, reds, transports=None, tolerances=None):
+    def body(s):
+        local = jax.tree_util.tree_map(lambda x: x[0], s)
+        out = sync_mod.sync_state(
+            local, reds, "data", bucketed=True,
+            transports=transports, tolerances=tolerances,
+        )
+        return jax.tree_util.tree_map(lambda x: jnp.expand_dims(x, 0), out)
+
+    return jax.jit(
+        shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+                  check_rep=False)
+    )
+
+
+def _drive(mesh, state, reds, steps=24, tolerances=None):
+    """The tuned driver: re-jit exactly when the decision epoch moves (the
+    documented integration pattern — the engine's partition key does the same
+    via its autotune token). Returns (last_out, retraces)."""
+    per_dev = _per_device(state)
+    epoch = at.decision_epoch()
+    fn = _make_fn(mesh, reds, tolerances=tolerances)
+    retraces = 0
+    out = None
+    for _ in range(steps):
+        if at.decision_epoch() != epoch:
+            epoch = at.decision_epoch()
+            fn = _make_fn(mesh, reds, tolerances=tolerances)
+            retraces += 1
+        out = fn(per_dev)
+    return jax.tree_util.tree_map(lambda x: np.asarray(x[0]), out), retraces
+
+
+def _exact_reference(mesh, state, reds):
+    exact = {n: "exact" for n in state}
+    fn = _make_fn(mesh, reds, transports=exact)
+    out = fn(_per_device(state))
+    return jax.tree_util.tree_map(lambda x: np.asarray(x[0]), out)
+
+
+def _rel_err(got, want):
+    got, want = np.asarray(got, np.float64), np.asarray(want, np.float64)
+    denom = max(np.max(np.abs(want)), 1e-30)
+    return float(np.max(np.abs(got - want)) / denom)
+
+
+# ------------------------------------------------------------- convergence ---
+@pytest.mark.mesh8
+def test_converges_commits_cheapest_and_stops_retracing(mesh):
+    metrics_tpu.set_autotune(True)
+    out, retraces = _drive(mesh, _STATE, _REDS, steps=24)
+    ctl = at_controller.get_controller()
+
+    big = ctl.buckets[bucket_key("sum", np.dtype("float32"))]
+    assert big.phase == "committed"
+    # the cheapest admissible rung for a dense 8192-elem f32 sum is int8
+    assert big.committed == "int8"
+    costs = {r: big.predicted_wire(r) for r in big.ladder()}
+    assert costs[big.committed] == min(costs.values())
+
+    counts = ctl.buckets[bucket_key("sum", np.dtype("int32"))]
+    assert counts.phase == "committed"
+    assert counts.committed == min(counts.ladder(), key=counts.predicted_wire)
+
+    # max buckets have an exact-only ladder: committed on the spot
+    mx = ctl.buckets[bucket_key("max", np.dtype("float32"))]
+    assert mx.committed == "exact"
+
+    # exploration budget: one retrace per epoch movement (several buckets may
+    # decide inside a single trace), decisions bounded by the ladder walk —
+    # and the epoch has stopped moving (no flap, no retraces)
+    assert 0 < retraces <= len(ctl.decisions) <= 4 * len(at.LADDER)
+    epoch = at.decision_epoch()
+    _drive(mesh, _STATE, _REDS, steps=4)
+    assert at.decision_epoch() == epoch
+
+    # realized error within the (default-tolerance) budget; the exact-only
+    # max bucket stays bitwise
+    want = _exact_reference(mesh, _STATE, _REDS)
+    assert _rel_err(out["big"], want["big"]) <= big.tolerance_for("int8")
+    np.testing.assert_array_equal(out["mx"], want["mx"])
+
+
+@pytest.mark.mesh8
+def test_decision_log_replays_bitwise(mesh):
+    logs = []
+    for _ in range(2):
+        metrics_tpu.set_autotune(True, config=PolicyConfig())
+        _drive(mesh, _STATE, _REDS, steps=16)
+        logs.append(json.dumps(at_controller.get_controller().decisions,
+                               sort_keys=True))
+        metrics_tpu.set_autotune(False)
+    assert logs[0] == logs[1] and logs[0] != "[]"
+
+
+@pytest.mark.mesh8
+def test_pinned_plan_replays_and_never_retraces(mesh):
+    metrics_tpu.set_autotune(True)
+    tuned_out, _ = _drive(mesh, _STATE, _REDS, steps=16)
+    plan = metrics_tpu.export_tuned_plan()
+    first_decisions = json.dumps(plan.decisions, sort_keys=True)
+
+    metrics_tpu.set_autotune(plan)
+    epoch = at.decision_epoch()
+    out, retraces = _drive(mesh, _STATE, _REDS, steps=8)
+    assert retraces == 0 and at.decision_epoch() == epoch  # pins add no retraces
+    ctl = at_controller.get_controller()
+    assert ctl.decisions == []  # nothing explores under a pin
+    # the pin replays the converged transports: identical computation,
+    # bitwise-identical synced values (lossy rungs included)
+    for name in _STATE:
+        np.testing.assert_array_equal(out[name], tuned_out[name])
+    # and the exported artifact round-trips the decision log bitwise
+    assert json.dumps(ctl.export_plan().decisions, sort_keys=True) == first_decisions
+
+
+# ------------------------------------------------------------- precedence ---
+@pytest.mark.mesh8
+def test_per_state_declaration_outranks_and_hides_the_bucket(mesh):
+    metrics_tpu.set_autotune(True)
+    transports = {"big": "bf16"}
+    per_dev = _per_device(_STATE)
+    fn = _make_fn(mesh, _REDS, transports=transports)
+    with sync_mod.count_collectives() as box:
+        jax.make_jaxpr(
+            lambda st: sync_mod.sync_state(
+                st, _REDS, "data", bucketed=True, transports=transports
+            ),
+            axis_env=[("data", WORLD)],
+        )(_STATE)
+    fn(per_dev)
+    ctl = at_controller.get_controller()
+    # the declared bucket syncs bf16 (declaration wins) and the tuner never
+    # observes it — declared buckets are the user's call, not the tuner's
+    assert "bf16" in box["bytes_by_transport"]
+    assert bucket_key("sum", np.dtype("float32")) not in ctl.buckets
+
+
+@pytest.mark.mesh8
+def test_zero_tolerance_buckets_stay_bitwise(mesh):
+    metrics_tpu.set_autotune(True)
+    tolerances = {"big": 0.0, "counts": 0.0}
+    out, _ = _drive(mesh, _STATE, _REDS, steps=20, tolerances=tolerances)
+    ctl = at_controller.get_controller()
+    big = ctl.buckets[bucket_key("sum", np.dtype("float32"))]
+    # a zero tolerance prunes every lossy rung; only lossless transports
+    # survive, so the synced values are bitwise-identical to untuned
+    assert all(r in ("exact", "sparse_count") for r in big.ladder())
+    want = _exact_reference(mesh, _STATE, _REDS)
+    for name in _STATE:
+        np.testing.assert_array_equal(out[name], want[name])
+
+
+def test_cadence_precedence_switch_env_tuner(monkeypatch):
+    metrics_tpu.set_autotune(True)
+    ctl = at_controller.get_controller()
+    # drive one bucket to commit with a tolerance wide enough for K>1
+    key = bucket_key("sum", np.dtype("float32"))
+    for _ in range(8):
+        tuner = ctl.buckets.get(key)
+        cur = tuner.current if tuner else "exact"
+        ctl.observe_bucket(
+            "sum", np.dtype("float32"), requested=cur, transport=cur,
+            refusal=None, nelems=8192, world=WORLD, tolerance=0.2,
+        )
+        if ctl.buckets[key].phase == "committed":
+            break
+    tuned = ctl.cadence()
+    assert tuned is not None and tuned > 1
+    assert sync_mod.sync_cadence_default() == tuned  # tuner is the fallback
+    monkeypatch.setenv("METRICS_TPU_SYNC_EVERY", "5")
+    assert sync_mod.sync_cadence_default() == 5      # env outranks the tuner
+    sync_mod.set_sync_cadence(3)
+    assert sync_mod.sync_cadence_default() == 3      # switch outranks both
+    sync_mod.set_sync_cadence(None)
+    monkeypatch.delenv("METRICS_TPU_SYNC_EVERY")
+    assert sync_mod.sync_cadence_default() == tuned
+
+
+def test_partition_token_moves_only_on_decisions():
+    from metrics_tpu.core.engine import _autotune_token
+
+    metrics_tpu.set_autotune(False)
+    assert at.partition_token() == -1 == _autotune_token()
+    metrics_tpu.set_autotune(True)
+    tok = at.partition_token()
+    assert tok == at.decision_epoch() == _autotune_token()
+    ctl = at_controller.get_controller()
+    ctl.observe_bucket(
+        "sum", np.dtype("float32"), requested="exact", transport="exact",
+        refusal=None, nelems=8192, world=WORLD,
+    )
+    assert at.partition_token() > tok  # the decision repartitions the drivers
+
+
+# ----------------------------------------------------------------- tenancy ---
+@pytest.mark.parametrize("tenants", [2, 5])
+def test_stacked_buckets_tune_through_n_independent_keys(tenants):
+    """TenantSet-stacked state flattens into the same (reduction, dtype)
+    buckets as unstacked state, so the tuner's keys — and therefore its
+    decisions — are independent of tenant count N and of the leader set."""
+    metrics_tpu.set_autotune(True)
+    states = {
+        "acc": {"tp": jnp.zeros((tenants, 16), jnp.float32)},
+        "f1": {"tp": jnp.zeros((tenants, 16), jnp.float32),
+               "count": jnp.zeros((tenants,), jnp.int32)},
+    }
+    reds = {"acc": {"tp": "sum"}, "f1": {"tp": "sum", "count": "sum"}}
+    jax.make_jaxpr(
+        lambda s: sync_mod.sync_stacked_states(s, reds, "data"),
+        axis_env=[("data", WORLD)],
+    )(states)
+    ctl = at_controller.get_controller()
+    assert set(ctl.buckets) == {
+        bucket_key("sum", np.dtype("float32")),
+        bucket_key("sum", np.dtype("int32")),
+    }
+    # the bucket sees the flattened element count: every leader's leaves of
+    # one (reduction, dtype) ravel into a single tuned bucket
+    assert ctl.buckets[bucket_key("sum", np.dtype("float32"))].nelems == 32 * tenants

@@ -328,6 +328,75 @@ class TestPrometheusRoundTrip:
         finally:
             get_registry().clear()
 
+    def test_autotune_series_parse_strictly(self):
+        """Self-tuning sync (ISSUE-17): tuner decisions tick
+        metrics_tpu_autotune_decisions_total and the per-bucket gauges, the
+        snapshot synthesizes the controller-level gauges (enabled / epoch /
+        pinned / tracked / committed), every decision lands in the tracer
+        under its catalogued name sync/tune_decision — and the whole family
+        set parses through the strict exposition."""
+        import metrics_tpu
+        from metrics_tpu.autotune import bucket_key
+        from metrics_tpu.autotune import controller as at_controller
+        from metrics_tpu.observability.instruments import get_registry
+        from metrics_tpu.observability.tracer import EVENT_CATALOG
+
+        assert "sync/tune_decision" in EVENT_CATALOG["sync"]
+        get_registry().clear()
+        metrics_tpu.set_autotune(True)
+        f32 = np.dtype("float32")
+        key = bucket_key("sum", f32)
+        try:
+            with obs.trace() as tracer:
+                ctl = at_controller.get_controller()
+                for _ in range(4):
+                    tuner = ctl.buckets.get(key)
+                    cur = tuner.current if tuner else "exact"
+                    ctl.observe_bucket(
+                        "sum", f32, requested=cur, transport=cur,
+                        nelems=8192, world=8,
+                    )
+                ctl.observe_error("sum", f32, measured=0.001)
+                ctl.observe_sync_seconds(0.0125)
+            counts = tracer.counts_by_name()
+            assert counts.get("sync/tune_decision", 0) == len(ctl.decisions) >= 3
+
+            text = obs.to_prometheus_text(get_registry())
+            families, samples = _StrictPromParser().parse(text)
+            by = {}
+            for name, labels, value in samples:
+                by[(name, tuple(sorted(labels.items())))] = value
+
+            # the decision counter carries the transition labels
+            assert by[(
+                "metrics_tpu_autotune_decisions_total",
+                (("bucket", key), ("from", "exact"), ("to", "bf16")),
+            )] == 1.0
+            assert families["metrics_tpu_autotune_decisions_total"]["type"] == "counter"
+
+            # per-bucket gauges pushed by the controller
+            blabel = (("bucket", key),)
+            assert by[("metrics_tpu_autotune_predicted_wire_bytes", blabel)] == 8320.0
+            assert ("metrics_tpu_autotune_realized_wire_bytes", blabel) in by
+            assert ("metrics_tpu_autotune_predicted_error_bound", blabel) in by
+            assert ("metrics_tpu_autotune_dwell", blabel) in by
+            assert by[("metrics_tpu_autotune_realized_error", blabel)] == 0.001
+            assert by[("metrics_tpu_autotune_last_sync_seconds", ())] == 0.0125
+
+            # controller-level derived gauges synthesized at snapshot time
+            assert by[("metrics_tpu_autotune_enabled", ())] == 1.0
+            assert by[("metrics_tpu_autotune_pinned", ())] == 0.0
+            assert by[("metrics_tpu_autotune_tracked_buckets", ())] == 1.0
+            assert by[("metrics_tpu_autotune_committed_buckets", ())] == 1.0
+            assert by[("metrics_tpu_autotune_decision_epoch", ())] > 0.0
+            for fam in ("metrics_tpu_autotune_enabled",
+                        "metrics_tpu_autotune_decision_epoch",
+                        "metrics_tpu_autotune_tracked_buckets"):
+                assert families[fam]["type"] == "gauge"
+        finally:
+            metrics_tpu.set_autotune(None)
+            get_registry().clear()
+
     def test_awkward_label_values_round_trip(self):
         reg = InstrumentRegistry()
         awkward = 'quote " backslash \\ newline \n tab\tdone'
