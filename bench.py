@@ -3104,6 +3104,232 @@ def bench_self_tuning() -> None:
         sys.exit(1)
 
 
+def _sketches_child() -> None:
+    """``--child sketches``: mergeable sketch states vs the CatBuffer gather
+    on the 8-device CPU mesh (device count forced by the parent's XLA_FLAGS).
+
+    One million lognormal samples. The CatBuffer path must gather every
+    per-device row on sync (wire grows with N); the QuantileSketch path syncs
+    a fixed ~16 KB of bucket counts whatever N is. Records the traced wire
+    accounting for both, the realized quantile error of the sketch against
+    the exact ``np.quantile`` at N=1e6, bitwise merge-order invariance across
+    1/2/4/8-way shardings, and the jitted insert throughput."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu import Quantile
+    from metrics_tpu.core.buffers import CatBuffer
+    from metrics_tpu.parallel.sync import count_collectives, sync_state
+    from metrics_tpu.sketches import QuantileSketch
+
+    world = 8
+    devices = jax.devices()
+    if len(devices) < world:
+        raise RuntimeError(f"expected {world} forced host devices, got {len(devices)}")
+    mesh = Mesh(np.asarray(devices[:world]), ("data",))
+    rng = np.random.default_rng(0)
+
+    n_total = 1_000_000
+    per_dev = n_total // world
+    data = rng.lognormal(mean=1.0, sigma=1.2, size=n_total).astype(np.float32)
+
+    # ---- traced wire accounting: what one sync moves ----------------------
+    def trace_wire(state, reds):
+        with count_collectives() as box:
+            jax.make_jaxpr(
+                lambda st: sync_state(st, reds, "data", bucketed=True),
+                axis_env=[("data", world)],
+            )(state)
+        return {
+            "wire_bytes": int(sum(v["wire"] for v in box["bytes_by_transport"].values())),
+            "logical_bytes": int(sum(v["logical"] for v in box["bytes_by_transport"].values())),
+        }
+
+    cat_state = {"value": CatBuffer.from_array(jnp.asarray(data[:per_dev]), capacity=per_dev)}
+    cat_rec = trace_wire(cat_state, {"value": "cat"})
+    # the gather's real cost: every device receives the other shards' rows and
+    # materializes all N of them — wire_bytes above only counts what one
+    # device *sends* (N/world rows)
+    cat_rec["gathered_bytes"] = int(world * cat_rec["wire_bytes"])
+    cat_rec["host_state_bytes"] = int(per_dev * 4)
+
+    sketch = QuantileSketch().insert(jnp.asarray(data[:per_dev]))
+    sketch_rec = trace_wire({"sketch": sketch}, {"sketch": "sketch"})
+    # elementwise psum/pmax: the synced state each device holds is the same
+    # fixed-size sketch, independent of N and world
+    sketch_rec["gathered_bytes"] = int(sketch_rec["wire_bytes"])
+    sketch_rec["host_state_bytes"] = int(sketch.state_nbytes)
+
+    # ---- realized quantile error at N=1e6 (jitted chunk inserts) ----------
+    m = Quantile(q=[0.01, 0.5, 0.99])
+    insert = jax.jit(lambda s, x: s.insert(x))
+    chunk = 65536
+    sk = m.sketch
+    t0 = time.perf_counter()
+    for lo in range(0, n_total, chunk):
+        sk = insert(sk, jnp.asarray(data[lo:lo + chunk]))
+    jax.block_until_ready(sk.pos)
+    insert_s = time.perf_counter() - t0
+    qs = np.asarray([0.01, 0.5, 0.99], np.float32)
+    got = np.asarray(sk.quantile(jnp.asarray(qs)))
+    exact = np.quantile(data, qs, method="inverted_cdf")
+    max_rel_err = float(np.max(np.abs(got - exact) / exact))
+    gamma = float(sk.error_bound()["value"])
+
+    # ---- bitwise merge-order invariance across shard counts ---------------
+    whole = QuantileSketch().insert(jnp.asarray(data[: 8 * 4096]))
+    invariant = True
+    for shards in (1, 2, 4, 8):
+        parts = [
+            QuantileSketch().insert(jnp.asarray(c))
+            for c in np.array_split(data[: 8 * 4096], shards)
+        ]
+        folded = parts[0]
+        for p in parts[1:]:
+            folded = folded.merge(p)
+        for fname, _ in whole.sketch_fields:
+            if not np.array_equal(np.asarray(getattr(folded, fname)), np.asarray(getattr(whole, fname))):
+                invariant = False
+
+    # ---- the synced mesh estimate agrees with the whole stream ------------
+    mq = Quantile(q=0.5)
+
+    def body(x):
+        state = mq.update_state(mq.init_state(), jnp.ravel(x))
+        state = mq.sync_states(state, "data")
+        return jnp.atleast_1d(mq.compute_state(state))
+
+    synced = np.asarray(
+        jax.jit(shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_rep=False))(
+            jnp.asarray(data).reshape(world, per_dev)
+        )
+    )
+    mesh_agrees = bool(np.all(synced == synced[0]))
+    mesh_rel_err = float(
+        abs(synced[0] - np.quantile(data, 0.5, method="inverted_cdf"))
+        / np.quantile(data, 0.5, method="inverted_cdf")
+    )
+
+    print(json.dumps(_round({
+        "world": world,
+        "n_total": n_total,
+        "catbuffer": cat_rec,
+        "sketch": sketch_rec,
+        # headline: bytes every device must receive + materialize for one
+        # CatBuffer gather vs the sketch's fixed sync payload
+        "gather_reduction_x": cat_rec["gathered_bytes"] / max(1, sketch_rec["gathered_bytes"]),
+        "sent_wire_reduction_x": cat_rec["wire_bytes"] / max(1, sketch_rec["wire_bytes"]),
+        "host_reduction_x": cat_rec["host_state_bytes"] / max(1, sketch_rec["host_state_bytes"]),
+        "quantile_max_rel_err": max_rel_err,
+        "quantile_error_bound": gamma,
+        "merge_order_bitwise_invariant": invariant,
+        "mesh_devices_agree_bitwise": mesh_agrees,
+        "mesh_median_rel_err": mesh_rel_err,
+        "insert_throughput_msamples_per_s": n_total / insert_s / 1e6,
+    })), flush=True)
+
+
+def bench_sketches() -> None:
+    """``--sketches``: bounded-memory sketch states vs the CatBuffer gather at
+    N=1e6 on the 8-device mesh; recorded into ``BENCH_r23.json`` and judged by
+    the regression watchdog. Host-side CPU bench (forced device count in a
+    child process).
+
+    Hard gates: sketch sync wire bytes >= 50x below the CatBuffer gather;
+    realized quantile error <= the declared rank-error bound; bitwise
+    merge-order invariance across 1/2/4/8-way shardings; all mesh devices
+    agree bitwise after sync."""
+    import glob as _glob
+
+    from metrics_tpu.observability import regress as _regress
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    child = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", "sketches"],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=1500.0,
+        cwd=REPO,
+    )
+    if child.returncode != 0:
+        raise RuntimeError(f"sketches child failed:\n{child.stderr[-2000:]}")
+    mesh8 = json.loads(child.stdout.strip().splitlines()[-1])
+
+    record = {
+        # headline: how many times fewer bytes one sync makes each device
+        # receive + materialize with the sketch state than with the CatBuffer
+        # gather (which hands every device all N rows) — higher is better
+        "metric": "sketch_vs_catbuffer_gather_reduction_x",
+        "value": mesh8["gather_reduction_x"],
+        "unit": "x",
+        "extra": {
+            "world": mesh8["world"],
+            "n_total": mesh8["n_total"],
+            "catbuffer_gathered_bytes": mesh8["catbuffer"]["gathered_bytes"],
+            "sketch_wire_bytes": mesh8["sketch"]["wire_bytes"],
+            "sent_wire_reduction_x": mesh8["sent_wire_reduction_x"],
+            "host_reduction_x": mesh8["host_reduction_x"],
+            "quantile_max_rel_err": mesh8["quantile_max_rel_err"],
+            "quantile_error_bound": mesh8["quantile_error_bound"],
+            "merge_order_bitwise_invariant": mesh8["merge_order_bitwise_invariant"],
+            "mesh_devices_agree_bitwise": mesh8["mesh_devices_agree_bitwise"],
+            "mesh_median_rel_err": mesh8["mesh_median_rel_err"],
+            "insert_throughput_msamples_per_s": mesh8["insert_throughput_msamples_per_s"],
+            "catbuffer": mesh8["catbuffer"],
+            "sketch": mesh8["sketch"],
+        },
+    }
+
+    # watchdog self-check: judge this round against the checked-in trajectory
+    rounds = [
+        r
+        for r in _regress.load_rounds(sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json"))))
+        if r.name != "r23"
+    ]
+    rounds.append(_regress.Round("r23", "<this-run>", record))
+    report = _regress.check_trajectory(rounds)
+    record["extra"]["regress"] = {
+        "ok": report.ok,
+        "regression_count": len(report.regressions),
+        "keys_checked": report.keys_checked,
+        "regressions": [r.describe() for r in report.regressions],
+    }
+
+    with open(os.path.join(REPO, "BENCH_r23.json"), "w") as fh:
+        json.dump(record, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(record), flush=True)
+
+    problems = []
+    if mesh8["gather_reduction_x"] < 50.0:
+        problems.append(
+            f"sketch sync gather reduction {mesh8['gather_reduction_x']}x below the 50x gate"
+        )
+    if mesh8["quantile_max_rel_err"] > mesh8["quantile_error_bound"]:
+        problems.append(
+            f"realized quantile error {mesh8['quantile_max_rel_err']} exceeds "
+            f"the declared bound {mesh8['quantile_error_bound']}"
+        )
+    if not mesh8["merge_order_bitwise_invariant"]:
+        problems.append("sketch merge is not bitwise order-invariant across shardings")
+    if not mesh8["mesh_devices_agree_bitwise"]:
+        problems.append("mesh devices disagree after a sketch sync")
+    if not report.ok:
+        problems.extend(r.describe() for r in report.regressions)
+    if problems:
+        print("[bench] sketches round FAILED its gates:", file=sys.stderr)
+        for p in problems:
+            print(f"[bench]   {p}", file=sys.stderr)
+        sys.exit(1)
+
+
 def bench_observability() -> None:
     """``--observability``: tracer on/off overhead on the config2 fused
     update (the ISSUE-7 hard rule: tracer *off* must not move the 4x fused
@@ -4279,8 +4505,16 @@ def main() -> None:
         "within 10% of hand-best, fast lane live",
     )
     parser.add_argument(
+        "--sketches",
+        action="store_true",
+        help="measure mergeable sketch states vs the CatBuffer gather at "
+        "N=1e6 on the 8-device mesh; record into BENCH_r23.json; gates: "
+        "sync wire bytes >= 50x below the gather, quantile error <= the "
+        "declared bound, bitwise merge-order invariance across shardings",
+    )
+    parser.add_argument(
         "--child",
-        choices=["sync_overhead", "sharded_state", "sharded_compute", "quantized_sync", "incremental_sync", "heavy_kernels", "self_tuning", *_CHILD_BENCHES],
+        choices=["sync_overhead", "sharded_state", "sharded_compute", "quantized_sync", "incremental_sync", "heavy_kernels", "self_tuning", "sketches", *_CHILD_BENCHES],
     )
     parser.add_argument(
         "--sync-scaling",
@@ -4339,6 +4573,9 @@ def main() -> None:
     if args.self_tuning:
         bench_self_tuning()
         return
+    if args.sketches:
+        bench_sketches()
+        return
     if args.sync_scaling:
         out = {}
         for w in (2, 4, 8, 16):
@@ -4370,6 +4607,9 @@ def main() -> None:
         return
     if args.child == "self_tuning":
         _self_tuning_child()
+        return
+    if args.child == "sketches":
+        _sketches_child()
         return
     if args.child in _CHILD_BENCHES:
         import jax

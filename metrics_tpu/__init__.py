@@ -8,7 +8,18 @@ SSIM convs) as jitted XLA programs.
 """
 from metrics_tpu.__about__ import __version__  # noqa: F401
 from metrics_tpu import functional  # noqa: F401
-from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric  # noqa: F401
+from metrics_tpu.aggregation import (  # noqa: F401
+    CatMetric,
+    DistinctCount,
+    HeavyHitters,
+    MaxMetric,
+    MeanMetric,
+    Median,
+    MinMetric,
+    Quantile,
+    SumMetric,
+)
+from metrics_tpu import sketches  # noqa: F401
 from metrics_tpu.audio import (  # noqa: F401
     PerceptualEvaluationSpeechQuality,
     PermutationInvariantTraining,
@@ -177,6 +188,8 @@ __all__ = [
     "resilience",
     # aggregation
     "CatMetric", "MaxMetric", "MeanMetric", "MinMetric", "SumMetric",
+    # sketch-backed aggregation (bounded-memory approximate metrics)
+    "sketches", "Quantile", "Median", "DistinctCount", "HeavyHitters",
     # audio
     "PerceptualEvaluationSpeechQuality",
     "PermutationInvariantTraining", "ScaleInvariantSignalDistortionRatio",

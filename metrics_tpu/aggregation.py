@@ -12,12 +12,13 @@ the eager filter since its state is an unbounded buffer anyway.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, List, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.core.metric import Metric, StateDict
+from metrics_tpu.sketches import DyadicCountMinSketch, HyperLogLogSketch, QuantileSketch
 from metrics_tpu.utils.checks import _is_concrete
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -234,6 +235,216 @@ class MeanMetric(BaseAggregator):
 
 
 # --------------------------------------------------------------------------- #
+# sketch-backed aggregators (ISSUE-18): bounded-memory approximate metrics
+# over unbounded streams. State is a fixed-size MergeableSketch synced under
+# the "sketch" reduction tag — wire bytes per sync are independent of how many
+# samples were inserted, unlike a CatBuffer gather. Each declares its sketch's
+# error bound as the state's sync tolerance, so the error-budget gate and the
+# transport autotuner consume it like any dense state's budget.
+# --------------------------------------------------------------------------- #
+class Quantile(Metric):
+    """Streaming quantile(s) from a fixed-size mergeable sketch.
+
+    No torchmetrics reference: an exact streaming quantile needs the full
+    sample set (``CatMetric`` + ``jnp.quantile`` — unbounded state). This
+    aggregator keeps a :class:`~metrics_tpu.sketches.QuantileSketch`
+    (~40 KB at defaults, regardless of stream length); ranks are exact and
+    returned values carry relative error ``<= relative_accuracy``.
+
+    Args:
+        q: quantile(s) in [0, 1] — scalar result for a scalar ``q``, a
+            vector result for a sequence.
+        num_buckets / relative_accuracy / min_magnitude: sketch layout, see
+            :class:`~metrics_tpu.sketches.QuantileSketch`.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Quantile
+        >>> metric = Quantile(q=0.5)
+        >>> metric.update(jnp.arange(1, 101, dtype=jnp.float32))
+        >>> round(float(metric.compute()), 1)
+        49.9
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    sketch: QuantileSketch
+
+    def __init__(
+        self,
+        q: Union[float, Sequence[float]] = 0.5,
+        num_buckets: int = 2048,
+        relative_accuracy: float = 0.01,
+        min_magnitude: float = 1e-8,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self._scalar_q = not isinstance(q, (list, tuple))
+        qs = (float(q),) if self._scalar_q else tuple(float(v) for v in q)
+        if not qs or not all(0.0 <= v <= 1.0 for v in qs):
+            raise ValueError(f"Expected argument `q` to be probabilities in [0, 1] but got {q}")
+        self.q = qs
+        self.add_state(
+            "sketch",
+            default=QuantileSketch(
+                num_buckets=num_buckets,
+                relative_accuracy=relative_accuracy,
+                min_magnitude=min_magnitude,
+            ),
+            dist_reduce_fx="sketch",
+            persistent=True,
+            sync_tolerance=float(relative_accuracy),
+        )
+
+    def update(self, value: Union[float, Array]) -> None:  # type: ignore[override]
+        self.sketch = self.sketch.insert(value)
+
+    def compute(self) -> Array:
+        out = self.sketch.quantile(jnp.asarray(self.q, jnp.float32))
+        return out[0] if self._scalar_q else out
+
+    def error_bound(self) -> Dict[str, Any]:
+        """The sketch's declared accuracy contract (see docs/sketch_metrics.md)."""
+        return self.sketch.error_bound()
+
+
+class Median(Quantile):
+    """Streaming median — :class:`Quantile` pinned at ``q=0.5``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Median
+        >>> metric = Median()
+        >>> metric.update(jnp.asarray([1.0, 9.0, 2.0]))
+        >>> round(float(metric.compute()), 2)
+        1.99
+    """
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(q=0.5, **kwargs)
+
+
+class DistinctCount(Metric):
+    """Approximate distinct-count over a key stream (HyperLogLog).
+
+    State is ``2**precision`` int32 registers merged by elementwise max —
+    re-observing a key never changes the estimate, and shard merges are
+    bitwise order-invariant. Relative standard error ``1.04 / sqrt(2**p)``
+    (~1.6% at the default ``precision=12`` / 16 KB).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import DistinctCount
+        >>> metric = DistinctCount()
+        >>> metric.update(jnp.asarray([1, 2, 3, 2, 1]))
+        >>> round(float(metric.compute()))
+        3
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    sketch: HyperLogLogSketch
+
+    def __init__(self, precision: int = 12, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        sk = HyperLogLogSketch(precision=precision)
+        self.add_state(
+            "sketch",
+            default=sk,
+            dist_reduce_fx="sketch",
+            persistent=True,
+            sync_tolerance=float(sk.error_bound()["value"]),
+        )
+
+    def update(self, value: Array) -> None:  # type: ignore[override]
+        self.sketch = self.sketch.insert(value)
+
+    def compute(self) -> Array:
+        return self.sketch.estimate()
+
+    def error_bound(self) -> Dict[str, Any]:
+        """The sketch's declared accuracy contract (see docs/sketch_metrics.md)."""
+        return self.sketch.error_bound()
+
+
+class HeavyHitters(Metric):
+    """Keys above a frequency threshold, from a dyadic count-min hierarchy.
+
+    ``compute()`` walks the dyadic tree on the host (data-dependent descent),
+    so the metric opts out of the compiled-compute engine up front — exactly
+    like :class:`~metrics_tpu.MeanAveragePrecision`'s curve math. The
+    ``update`` path stays jittable (one scatter-add per dyadic level) and the
+    state is a fixed ``domain_bits x depth x width`` int32 grid, sum-merged.
+
+    Returns ``{"keys": int64[max_hitters], "counts": int64[max_hitters]}``
+    sorted by descending estimated count, padded with ``-1`` / ``0``.
+
+    Args:
+        threshold: report keys with estimated frequency >= ``threshold *
+            total`` (count-min never understates, so no true hitter is lost).
+        max_hitters: fixed result length.
+        domain_bits / width / depth: sketch shape, see
+            :class:`~metrics_tpu.sketches.DyadicCountMinSketch`.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import HeavyHitters
+        >>> metric = HeavyHitters(threshold=0.4, max_hitters=2)
+        >>> metric.update(jnp.asarray([7, 7, 7, 5, 7]))
+        >>> [int(k) for k in metric.compute()["keys"]]
+        [7, -1]
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    sketch: DyadicCountMinSketch
+
+    def __init__(
+        self,
+        threshold: float = 0.01,
+        max_hitters: int = 16,
+        domain_bits: int = 16,
+        width: int = 1024,
+        depth: int = 4,
+        **kwargs: Any,
+    ) -> None:
+        # host-side descent: keep compute() off the compiled engine
+        kwargs.setdefault("compiled_compute", False)
+        super().__init__(**kwargs)
+        if not 0.0 < threshold <= 1.0:
+            raise ValueError(f"Expected argument `threshold` in (0, 1] but got {threshold}")
+        if max_hitters < 1:
+            raise ValueError(f"Expected argument `max_hitters` to be >= 1 but got {max_hitters}")
+        self.threshold = float(threshold)
+        self.max_hitters = int(max_hitters)
+        sk = DyadicCountMinSketch(domain_bits=domain_bits, width=width, depth=depth)
+        self.add_state(
+            "sketch",
+            default=sk,
+            dist_reduce_fx="sketch",
+            persistent=True,
+            sync_tolerance=float(sk.error_bound()["value"]),
+        )
+
+    def update(self, value: Array, weight: Optional[Array] = None) -> None:  # type: ignore[override]
+        self.sketch = self.sketch.insert(value, weight)
+
+    def compute(self) -> Dict[str, Array]:
+        keys, counts = self.sketch.heavy_hitters(self.threshold, self.max_hitters)
+        return {"keys": jnp.asarray(keys), "counts": jnp.asarray(counts)}
+
+    def error_bound(self) -> Dict[str, Any]:
+        """The sketch's declared accuracy contract (see docs/sketch_metrics.md)."""
+        return self.sketch.error_bound()
+
+
+# --------------------------------------------------------------------------- #
 # analyzer registry (metrics_tpu.analysis): how each export is constructed and
 # fed for the abstract-eval sweep; see docs/static_analysis.md
 # --------------------------------------------------------------------------- #
@@ -245,4 +456,10 @@ ANALYSIS_SPECS = {
     "MinMetric": {"inputs": [("float32", (8,))]},
     "SumMetric": {"inputs": [("float32", (8,))]},
     "MeanMetric": {"inputs": [("float32", (8,)), ("float32", (8,))]},
+    "Quantile": {"inputs": [("float32", (8,))]},
+    "Median": {"inputs": [("float32", (8,))]},
+    "DistinctCount": {"inputs": [("int32", (8,))]},
+    # compute() is a host-side dyadic descent (declared via
+    # compiled_compute=False in __init__) — E107 is the informed trade-off
+    "HeavyHitters": {"inputs": [("int32", (8,))], "allow": ("E107",)},
 }

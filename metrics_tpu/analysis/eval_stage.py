@@ -223,6 +223,38 @@ def _heavy_eager_residue(entry: Entry) -> List[Finding]:
     return findings
 
 
+def _unbounded_state(entry: Entry, inst: Any) -> List[Finding]:
+    """The E116 leg: unbounded accumulation with no bounded alternative.
+
+    Fires on instances holding plain list-append states (the analyzer probe
+    constructs with the spec's init kwargs, so a spec that passes
+    ``buffer_capacity`` has already bounded them). Cleared by either bound the
+    metric can declare: a ``MergeableSketch`` state on the probe instance, or
+    an ``approx_twins`` class attribute naming its sketch-backed construction
+    (e.g. ``approx="sketch"``)."""
+    unbounded = sorted(
+        name for name, default in inst._defaults.items() if isinstance(default, list)
+    )
+    if not unbounded:
+        return []
+    if any(_sync._is_sketch(d) for d in inst._defaults.values()):
+        return []
+    twins = tuple(getattr(entry.cls, "approx_twins", ()) or ())
+    if twins:
+        return []
+    return [
+        Finding(
+            rule="E116",
+            obj=entry.name,
+            message=f"list-append state {unbounded} grows with every update and its "
+            f"sync gathers the whole stream; no buffer_capacity bound and no "
+            f"sketch twin (approx_twins) is declared — unbounded-stream callers "
+            f"have no bounded-memory opt-in",
+            extra={"states": tuple(unbounded)},
+        )
+    ]
+
+
 def _evaluate_sharded(entry: Entry, inst: Any, state: Any) -> List[Finding]:
     """The E108 leg: sharded-state sync routing for ``shard_axis`` declarers.
 
@@ -505,6 +537,13 @@ def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Findi
         findings.append(e003)
         return findings
     inst = entry.instance
+
+    # E116 runs before the engine-ineligible early exit below — list-state
+    # metrics are exactly the unbounded ones it targets
+    for f in _unbounded_state(entry, inst):
+        if f.rule in entry.allow:
+            f.suppressed = True
+        findings.append(f)
 
     if not (inst.supports_compiled_update and inst.supports_compiled_compute):
         findings.append(

@@ -248,6 +248,17 @@ _EVAL_RULES = (
         "re-export the plan (export_tuned_plan) against the current "
         "collection.",
     ),
+    Rule(
+        "E116", "unbounded-state", WARNING,
+        "this metric accumulates unbounded host/device state: a list-append "
+        "or capacity-less CatBuffer state grows with every update and its "
+        "sync gathers the whole stream, with no bounded alternative declared "
+        "— construct with buffer_capacity=N to cap it, or declare a "
+        "fixed-size sketch twin (an `approx_twins = (\"sketch\", ...)` class "
+        "attribute backed by an approx= constructor arg, or a MergeableSketch "
+        "state) so unbounded-stream callers have a bounded-memory opt-in "
+        "(see docs/sketch_metrics.md).",
+    ),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in (*_AST_RULES, *_EVAL_RULES)}

@@ -43,6 +43,7 @@ import numpy as np
 from metrics_tpu.core.buffers import CatBuffer
 from metrics_tpu.core.collections import MetricCollection
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.sketches.base import is_sketch as _is_sketch
 from metrics_tpu.utils.exceptions import MetricsUserError
 
 FORMAT_VERSION = 1
@@ -51,8 +52,9 @@ FORMAT_VERSION = 1
 SELF_KEY = "__self__"
 
 # reduction tags whose shards can be folded at restore time; a callable tag or
-# a ``none`` tag on a dense leaf keeps per-shard values and cannot merge
-MERGEABLE_TAGS = ("sum", "mean", "max", "min", "cat", "none")
+# a ``none`` tag on a dense leaf keeps per-shard values and cannot merge.
+# "sketch" folds via the sketch's own commutative merge (order-invariant).
+MERGEABLE_TAGS = ("sum", "mean", "max", "min", "cat", "none", "sketch")
 
 
 def shard_axis_meta(shard_axis: Any) -> Any:
@@ -166,6 +168,18 @@ def metric_leaves(metric: Metric, prefix: str) -> Tuple[Dict[str, np.ndarray], D
             }
             for i, a in enumerate(arrs):
                 payload[f"{key}.{i}"] = a
+        elif _is_sketch(val):
+            # one payload array per component; the static config rides in the
+            # meta so restore rebuilds through SKETCH_CLASSES, never pickle
+            meta[name] = {
+                "kind": "sketch",
+                "reduction": tag,
+                "sketch_class": type(val).__name__,
+                "config": val.config_dict(),
+                "fields": [f for f, _ in val.component_reductions()],
+            }
+            for fname, _ in val.component_reductions():
+                payload[f"{key}.{fname}"] = np.asarray(getattr(val, fname))
         else:
             # np.asarray on a mesh-sharded leaf gathers the global value: the
             # on-disk layout is placement-free and restores onto any mesh width
@@ -210,6 +224,13 @@ def metric_fingerprint(metric: Metric) -> Dict[str, Any]:
             states[name] = {"kind": "catbuffer", "reduction": tag}
         elif isinstance(default, (list, tuple)):
             states[name] = {"kind": "list", "reduction": tag}
+        elif _is_sketch(default):
+            states[name] = {
+                "kind": "sketch",
+                "reduction": tag,
+                "sketch_class": type(default).__name__,
+                "config": default.config_dict(),
+            }
         else:
             arr = np.asarray(default)
             states[name] = {

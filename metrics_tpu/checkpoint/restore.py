@@ -139,6 +139,22 @@ def _decode_member_state(
                 state[name] = (
                     CatBuffer.empty(cap) if arr.shape[0] == 0 else CatBuffer.from_array(arr, capacity=cap)
                 )
+        elif kind == "sketch":
+            from metrics_tpu.sketches import SKETCH_CLASSES
+
+            cls = SKETCH_CLASSES.get(meta.get("sketch_class", ""))
+            if cls is None:
+                raise _io.CheckpointCorruptError(
+                    f"unknown sketch class {meta.get('sketch_class')!r} for {key!r}"
+                )
+            sketch = cls.from_config(meta.get("config") or {})
+            comps = {}
+            for fname, _ in sketch.component_reductions():
+                fkey = f"{key}.{fname}"
+                if fkey not in payload:
+                    raise _io.CheckpointCorruptError(f"payload key {fkey!r} missing from shard")
+                comps[fname] = jnp.asarray(payload[fkey])
+            state[name] = sketch.replace(**comps)
         else:
             raise _io.CheckpointCorruptError(f"unknown leaf kind {kind!r} for {key!r}")
     return state
@@ -205,6 +221,13 @@ def _entry_decoded_bytes(entry: Dict[str, Any]) -> Tuple[int, int]:
                 for s in meta.get("item_shape", []):
                     n *= int(s)
                 concat += n * np.dtype(meta["dtype"]).itemsize
+            elif kind == "sketch":
+                # fixed-size by construction; folds keep one resident copy
+                from metrics_tpu.sketches import SKETCH_CLASSES
+
+                cls = SKETCH_CLASSES.get(meta.get("sketch_class", ""))
+                if cls is not None:
+                    dense += cls.from_config(meta.get("config") or {}).state_nbytes
     return dense, concat
 
 

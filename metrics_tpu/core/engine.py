@@ -864,8 +864,10 @@ PATH_TENANT = "tenant_stacked"
 
 # reductions whose tenant axis folds into the flat sync buckets (an
 # elementwise reduce of a stacked buffer is the stacked elementwise reduce);
-# cat/None/callable reductions change layout per tenant and cannot stack
-_TENANT_STACKABLE_REDUCTIONS = ("sum", "mean", "max", "min")
+# cat/None/callable reductions change layout per tenant and cannot stack.
+# "sketch" stacks because every sketch *component* is elementwise — the
+# stacked sync decomposes and reassembles (parallel.sync._sketch_entries).
+_TENANT_STACKABLE_REDUCTIONS = ("sum", "mean", "max", "min", "sketch")
 
 
 def classify_update_member(metric: Any) -> Tuple[str, str]:
@@ -1193,6 +1195,10 @@ class CollectionUpdateEngine(_EngineBase):
             leader._computed = None
             # nothing shares the leader's state while members are detached
             leader._shared_state_ids = frozenset()
+            # fused dispatch bypasses the facade update wrapper, so surface
+            # buffer overflows here (members realias the leader's state later)
+            if leader._buffer_states:
+                leader._surface_buffer_overflows()
         return True
 
 

@@ -37,6 +37,7 @@ from jax import Array
 
 from metrics_tpu.core.buffers import CatBuffer, _is_traced
 from metrics_tpu.observability import instruments as _instruments
+from metrics_tpu.sketches.base import MergeableSketch, is_sketch as _is_sketch
 from metrics_tpu.observability import tracer as _otrace
 from metrics_tpu.parallel import mesh as _meshlib
 from metrics_tpu.parallel import sync as _sync
@@ -54,7 +55,7 @@ from metrics_tpu.utils.data import (
 from metrics_tpu.utils.exceptions import MetricsUserError
 from metrics_tpu.utils.prints import rank_zero_warn
 
-StateValue = Union[Array, List[Array], CatBuffer]
+StateValue = Union[Array, List[Array], CatBuffer, "MergeableSketch"]
 StateDict = Dict[str, StateValue]
 
 _PROTECTED_PROPERTIES = ("is_differentiable", "higher_is_better", "full_state_update")
@@ -220,6 +221,11 @@ class Metric:
         self._is_synced = False
         self._cache: Optional[StateDict] = None
         self._states_detached = False  # fused-collection streak poison flag
+        # CatBuffer states (registered via buffer_capacity= or a CatBuffer
+        # default) and the subset whose sticky `overflowed` flag has already
+        # been surfaced; reset() re-arms the one-shot reporting
+        self._buffer_states: Tuple[str, ...] = ()
+        self._overflow_reported: set = set()
 
         # wrap the subclass update/compute with bookkeeping (reference :118-119)
         self.update: Callable = self._wrap_update(self.update)  # type: ignore[method-assign]
@@ -297,14 +303,27 @@ class Metric:
         """
         if (
             not isinstance(default, (jnp.ndarray, np.ndarray, CatBuffer))
+            and not _is_sketch(default)
             and not (isinstance(default, list) and default == [])
         ):
             raise ValueError(
-                "state variable must be a jax array, an empty list, or a CatBuffer"
-                " (any other type would not be supported by jit)"
+                "state variable must be a jax array, an empty list, a CatBuffer, or a"
+                " MergeableSketch (any other type would not be supported by jit)"
             )
-        if dist_reduce_fx not in ("sum", "mean", "cat", "max", "min", None) and not callable(dist_reduce_fx):
-            raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")
+        if dist_reduce_fx not in ("sum", "mean", "cat", "max", "min", "sketch", None) and not callable(dist_reduce_fx):
+            raise ValueError(
+                "`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', 'sketch', None]"
+            )
+        if _is_sketch(default) != (dist_reduce_fx == "sketch"):
+            raise ValueError(
+                f"state {name!r}: MergeableSketch defaults require dist_reduce_fx='sketch' "
+                "and vice versa (the sketch's own merge is the reduction)"
+            )
+        if _is_sketch(default) and shard_axis is not None:
+            raise ValueError(
+                f"state {name!r}: sketch states are fixed-size and stay replicated; "
+                "`shard_axis` is not supported"
+            )
         if isinstance(default, np.ndarray):
             default = jnp.asarray(default)
         if isinstance(default, list) and default == [] and self.buffer_capacity is not None:
@@ -377,6 +396,8 @@ class Metric:
         self._defaults[name] = _copy_state_value(default)
         self._persistent[name] = persistent
         self._reductions[name] = dist_reduce_fx
+        if isinstance(default, CatBuffer):
+            self._buffer_states = self._buffer_states + (name,)
         setattr(self, name, _copy_state_value(default))
 
     @property
@@ -649,6 +670,16 @@ class Metric:
                     "dense fixed-shape array leaves; this metric is not "
                     "tenant-stackable (analysis rule E110)."
                 )
+            if _is_sketch(cur):
+                # each component carries the stacked tenant axis; restore
+                # selected rows to the fresh-default component values
+                comps = {}
+                for fname, fdefault in default.components().items():
+                    arr = jnp.asarray(getattr(cur, fname))
+                    sel = m.reshape((-1,) + (1,) * (arr.ndim - 1))
+                    comps[fname] = jnp.where(sel, jnp.asarray(fdefault, arr.dtype), arr)
+                out[attr] = cur.replace(**comps)
+                continue
             arr = jnp.asarray(cur)
             sel = m.reshape((-1,) + (1,) * (arr.ndim - 1))
             out[attr] = jnp.where(sel, jnp.asarray(default, arr.dtype), arr)
@@ -802,6 +833,8 @@ class Metric:
                 out[attr] = jnp.maximum(a, b)
             elif reduce_fn == "min":
                 out[attr] = jnp.minimum(a, b)
+            elif reduce_fn == "sketch":
+                out[attr] = a.merge(b)
             elif isinstance(a, CatBuffer) and (reduce_fn == "cat" or reduce_fn is None):
                 out[attr] = a.merge(b)
             elif reduce_fn == "cat":
@@ -1129,11 +1162,53 @@ class Metric:
                 # quarantine: drop the poisoned batch wholesale
                 self.set_state(prev)
                 self._update_count -= 1
+            if self._buffer_states:
+                self._surface_buffer_overflows()
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
 
         self._update = update  # unwrapped, used by the pure protocol
         return wrapped_func
+
+    def _surface_buffer_overflows(self) -> None:
+        """One-shot surfacing of the sticky CatBuffer ``overflowed`` flag.
+
+        The first time a buffer state's flag flips, bump
+        ``metrics_tpu_catbuffer_overflows_total{owner}``, warn once, and drop
+        a ``buffer/overflow`` tracer instant — at update time, well before
+        ``to_array()`` raises at compute. Costs one scalar-bool host readback
+        per still-unreported buffer state per update (metrics without
+        CatBuffer states skip the call entirely); traced flags are skipped
+        since the concrete value is not knowable mid-program.
+        """
+        for name in self._buffer_states:
+            if name in self._overflow_reported:
+                continue
+            buf = getattr(self, name, None)
+            if not isinstance(buf, CatBuffer) or _is_traced(buf.overflowed):
+                continue
+            if not bool(buf.overflowed):
+                continue
+            self._overflow_reported.add(name)
+            owner = f"{type(self).__name__}.{name}"
+            _instruments.get_registry().counter(
+                "catbuffer_overflows_total",
+                help="CatBuffer states whose sticky overflow flag flipped "
+                "(compiled appends beyond capacity overwrote the buffer tail)",
+                owner=owner,
+            ).inc()
+            rank_zero_warn(
+                f"CatBuffer state `{owner}` overflowed its capacity of "
+                f"{buf.capacity} inside a compiled program: the overflowing "
+                "appends overwrote the buffer tail and compute() will raise. "
+                "Raise `buffer_capacity` to at least the per-device sample "
+                "count, or use a bounded sketch twin where the metric "
+                "declares one (see docs/sketch_metrics.md)."
+            )
+            if _otrace.active:
+                _otrace.emit_instant(
+                    "buffer/overflow", "buffer", owner=owner, capacity=buf.capacity
+                )
 
     def _move_list_states_to_cpu(self) -> None:
         """Device->host offload of list states (reference: metric.py:386-391)."""
@@ -1180,6 +1255,16 @@ class Metric:
                         continue
                     gathered = _sync.gather_all_arrays(val)
                     synced[attr] = [dim_zero_cat(gathered)]
+                    continue
+                if _is_sketch(val):
+                    # gather each component across hosts, fold by its
+                    # elementwise reduction — bitwise what merge() would do
+                    comps = {}
+                    for fname, fred in val.component_reductions():
+                        parts = jnp.stack(_sync.gather_all_arrays(getattr(val, fname)))
+                        fn = {"sum": dim_zero_sum, "max": dim_zero_max, "min": dim_zero_min}[fred]
+                        comps[fname] = fn(parts)
+                    synced[attr] = val.replace(**comps)
                     continue
                 gathered_list = _sync.gather_all_arrays(val)
                 if red == "cat":
@@ -1347,6 +1432,7 @@ class Metric:
         self._cache = None
         self._is_synced = False
         self._states_detached = False
+        self._overflow_reported.clear()  # re-arm one-shot overflow reporting
 
     def clone(self) -> "Metric":
         """Deep copy (reference: metric.py:545-547)."""
@@ -1399,6 +1485,8 @@ class Metric:
                 return [move(v) for v in val]
             if isinstance(val, CatBuffer):
                 return val if not val.materialized else CatBuffer(move(val.data), val.count, val.capacity, val.overflowed)
+            if _is_sketch(val):
+                return val.replace(**{f: move(v) for f, v in val.components().items()})
             return move(val)
 
         for attr in self._defaults:
@@ -1438,6 +1526,10 @@ class Metric:
                 current = getattr(self, key)
                 if isinstance(current, list):
                     out[prefix + key] = [np.asarray(v) for v in current]
+                elif _is_sketch(current):
+                    out[prefix + key] = {
+                        f: np.asarray(v) for f, v in current.components().items()
+                    }
                 elif isinstance(current, CatBuffer):
                     # checkpoint the compact valid prefix — same on-disk format
                     # as a concatenated list state, so buffer/list checkpoints
@@ -1458,6 +1550,17 @@ class Metric:
                         val = np.concatenate([np.atleast_1d(v) for v in val]) if val else np.zeros((0,), np.float32)
                     arr = jnp.asarray(val)
                     setattr(self, key, CatBuffer.empty(cap) if arr.shape[0] == 0 else CatBuffer.from_array(arr, capacity=cap))
+                elif _is_sketch(self._defaults[key]):
+                    default = self._defaults[key]
+                    if not isinstance(val, dict):
+                        raise MetricsUserError(
+                            f"state {key!r}: sketch states load from a dict of "
+                            f"components, got {type(val).__name__}"
+                        )
+                    setattr(
+                        self, key,
+                        default.replace(**{f: jnp.asarray(v) for f, v in val.items()}),
+                    )
                 elif isinstance(val, list):
                     setattr(self, key, [jnp.asarray(v) for v in val])
                 else:

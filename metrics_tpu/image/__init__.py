@@ -44,19 +44,25 @@ def _ckpt_msssim_inputs():
     return (a, b), {}
 
 
+# E116 (unbounded-state) allows: these list states buffer full image tensors
+# (or Inception feature rows) that the finalize consumes verbatim — a rank
+# sketch cannot summarize them, and callers bound memory with the existing
+# buffer_capacity= opt-in instead of an approx= twin.
+_E116 = ("E116",)
 ANALYSIS_SPECS = {
     "PeakSignalNoiseRatio": {"inputs": _IMG},
-    "StructuralSimilarityIndexMeasure": {"inputs": _IMG},
+    "StructuralSimilarityIndexMeasure": {"inputs": _IMG, "allow": _E116},
     "MultiScaleStructuralSimilarityIndexMeasure": {
         "inputs": [("float32", (2, 3, 128, 128)), ("float32", (2, 3, 128, 128))],
         # compute at 5 scales needs sides > 160; the 128px abstract-eval shape
         # only ever runs update
         "ckpt": {"inputs_fn": _ckpt_msssim_inputs},
+        "allow": _E116,
     },
-    "SpectralAngleMapper": {"inputs": _IMG},
-    "SpectralDistortionIndex": {"inputs": _IMG},
-    "UniversalImageQualityIndex": {"inputs": _IMG},
-    "ErrorRelativeGlobalDimensionlessSynthesis": {"inputs": _IMG},
+    "SpectralAngleMapper": {"inputs": _IMG, "allow": _E116},
+    "SpectralDistortionIndex": {"inputs": _IMG, "allow": _E116},
+    "UniversalImageQualityIndex": {"inputs": _IMG, "allow": _E116},
+    "ErrorRelativeGlobalDimensionlessSynthesis": {"inputs": _IMG, "allow": _E116},
     "FrechetInceptionDistance": {
         "inputs": [("uint8", (2, 3, 299, 299))],
         "static_kwargs": {"real": True},
@@ -69,10 +75,12 @@ ANALYSIS_SPECS = {
         "inputs": [("uint8", (2, 3, 299, 299))],
         "static_kwargs": {"real": True},
         "ckpt": {"skip": "inception forward too heavy for the tier-1 sweep"},
+        "allow": _E116,
     },
     "InceptionScore": {
         "inputs": [("uint8", (2, 3, 299, 299))],
         "ckpt": {"skip": "inception forward too heavy for the tier-1 sweep"},
+        "allow": _E116,
     },
     "LearnedPerceptualImagePatchSimilarity": {
         "inputs": [("float32", (2, 3, 64, 64)), ("float32", (2, 3, 64, 64))],

@@ -117,6 +117,9 @@ EVENT_CATALOG: Dict[str, Tuple[str, ...]] = {
     "guard": (
         "guard/nonfinite",  # non-finite state detected at a guarded boundary
     ),
+    "buffer": (
+        "buffer/overflow",  # sticky CatBuffer overflow flag first flipped (args: owner, capacity)
+    ),
     "kernel": (
         "kernel/dispatch",  # one heavy-kernel dispatch (args: kernel, impl, bucket_width)
         "kernel/fallback",  # Pallas variant failed; XLA reference used (args: kernel, reason)

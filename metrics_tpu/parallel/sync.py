@@ -89,6 +89,56 @@ _sync_cadence_default: Optional[int] = None  # None = follow the environment
 # incremental emission can cover (cat/None/callable change layout per device).
 _ELEMENTWISE = ("sum", "mean", "max", "min")
 
+# ``"sketch"`` leaves (MergeableSketch pytrees) are not themselves elementwise,
+# but every *component* carries an elementwise reduction — the sync layer
+# decomposes them into per-component entries joined with this separator, routes
+# those through the ordinary buckets, and reassembles. \x1e never appears in
+# metric/state names (same contract as the tenancy \x1f join, which nests
+# outside this one: "leader\x1fstate\x1ecomponent" still splits leader-first).
+_SKETCH_SEP = "\x1e"
+
+
+def _is_sketch(val: Any) -> bool:
+    return getattr(val, "_is_mergeable_sketch", False) is True
+
+
+def _sketch_entries(key: str, sketch: Any) -> List[Tuple[str, Any, str]]:
+    """Per-component ``(flat_key, array, reduction)`` rows for a sketch leaf."""
+    return [
+        (f"{key}{_SKETCH_SEP}{fname}", getattr(sketch, fname), fred)
+        for fname, fred in sketch.component_reductions()
+    ]
+
+
+def _expand_sketch_maps(
+    key: str,
+    sketch: Any,
+    transports: Optional[Dict[str, str]],
+    tolerances: Optional[Dict[str, float]],
+    eff_transports: Dict[str, str],
+    eff_tolerances: Dict[str, float],
+) -> None:
+    """Copy a sketch state's declared transport/tolerance onto its component
+    flat keys so the decomposed entries inherit the parent declaration."""
+    t = (transports or {}).get(key)
+    tol = (tolerances or {}).get(key)
+    for fname, _ in sketch.component_reductions():
+        fkey = f"{key}{_SKETCH_SEP}{fname}"
+        if t is not None:
+            eff_transports[fkey] = t
+        if tol is not None:
+            eff_tolerances[fkey] = tol
+
+
+def _sketch_field_codec(fred: str, dtype: Any) -> str:
+    """Incremental codec for one sketch component: integer sums delta-fold
+    (exact), everything else (max/min registers, float trackers) replaces."""
+    return (
+        "fold"
+        if fred == "sum" and np.issubdtype(np.dtype(dtype), np.integer)
+        else "replace"
+    )
+
 
 def sync_mode_default() -> str:
     """The process-wide default sync mode for states with no per-state
@@ -469,20 +519,34 @@ def transport_plan(
     """
     shard_axes = shard_axes or {}
     groups: Dict[Tuple[Any, Any, str, str], List[Tuple[str, Any]]] = {}
+    flat_items: List[Tuple[str, Any, Any, str]] = []
+    eff_transports: Dict[str, str] = dict(transports or {})
+    eff_tolerances: Dict[str, float] = dict(tolerances or {})
     for name, val in state.items():
         red = reductions.get(name)
+        if _is_sketch(val) and red == "sketch":
+            # plan the decomposed components exactly as the runtime routes them
+            _expand_sketch_maps(
+                name, val, transports, tolerances, eff_transports, eff_tolerances
+            )
+            for fkey, arr, fred in _sketch_entries(name, val):
+                flat_items.append((fkey, arr, fred, "psum"))
+            continue
         dtype = getattr(val, "dtype", None)
         shape = getattr(val, "shape", None)
         if dtype is None or shape is None or callable(red):
             continue
         kind = "reshard" if name in shard_axes else "psum"
-        t = _resolve_transport(name, transports, red=red, dtype=dtype, kind=kind)
+        flat_items.append((name, val, red, kind))
+    for name, val, red, kind in flat_items:
+        dtype = getattr(val, "dtype", None)
+        t = _resolve_transport(name, eff_transports, red=red, dtype=dtype, kind=kind)
         groups.setdefault((red, np.dtype(dtype), t, kind), []).append((name, val))
     plan: List[Dict[str, Any]] = []
     for (red, dtype, requested, kind), items in groups.items():
         names = [n for n, _ in items]
         nelems = int(sum(int(np.prod(v.shape)) if v.shape else 1 for _, v in items))
-        tol = _bucket_tolerance(names, tolerances)
+        tol = _bucket_tolerance(names, eff_tolerances)
         final, refusal = _gate_transport(
             requested, None if kind == "reshard" else red, dtype, nelems, world,
             tol, kind=kind, error_scale=error_scale,
@@ -1167,10 +1231,27 @@ def sync_stacked_states(
     entries: List[Tuple[str, Array, Optional[str]]] = []
     flat_transports: Dict[str, str] = {}
     flat_tolerances: Dict[str, float] = {}
+    sketch_templates: Dict[Tuple[str, str], Any] = {}
     for lname, st in states.items():
         reds = reductions[lname]
         for name, leaf in st.items():
             red = reds.get(name)
+            # \x1f never appears in metric/state names; joins leader+state into
+            # one flat key so all leaders share the same bucket namespace
+            key = f"{lname}\x1f{name}"
+            declared_t = (transports or {}).get(lname) or {}
+            declared_tol = (tolerances or {}).get(lname) or {}
+            if red == "sketch" and _is_sketch(leaf):
+                # stacked sketch: every component carries the tenant axis and
+                # folds into the flat buckets like any stacked elementwise leaf
+                sketch_templates[(lname, name)] = leaf
+                for fkey, arr, fred in _sketch_entries(key, leaf):
+                    entries.append((fkey, arr, fred))
+                    if name in declared_t:
+                        flat_transports[fkey] = declared_t[name]
+                    if name in declared_tol:
+                        flat_tolerances[fkey] = declared_tol[name]
+                continue
             if red not in ("sum", "mean", "max", "min"):
                 raise ValueError(
                     f"sync_stacked_states: state {lname!r}.{name!r} has "
@@ -1178,19 +1259,22 @@ def sync_stacked_states(
                     "fold into a flat bucket (classify_tenant_member should have "
                     "demoted this group)."
                 )
-            # \x1f never appears in metric/state names; joins leader+state into
-            # one flat key so all leaders share the same bucket namespace
-            key = f"{lname}\x1f{name}"
             entries.append((key, leaf, red))
-            if transports and name in (transports.get(lname) or {}):
-                flat_transports[key] = transports[lname][name]
-            if tolerances and name in (tolerances.get(lname) or {}):
-                flat_tolerances[key] = tolerances[lname][name]
+            if name in declared_t:
+                flat_transports[key] = declared_t[name]
+            if name in declared_tol:
+                flat_tolerances[key] = declared_tol[name]
     synced = _sync_bucketed(entries, axis_name, flat_transports, flat_tolerances)
     out: Dict[str, Dict[str, Any]] = {lname: {} for lname in states}
     for key, leaf in synced.items():
         lname, name = key.split("\x1f", 1)
         out[lname][name] = leaf
+    for (lname, name), template in sketch_templates.items():
+        comps = {
+            fname: out[lname].pop(f"{name}{_SKETCH_SEP}{fname}")
+            for fname, _ in template.component_reductions()
+        }
+        out[lname][name] = template.replace(**comps)
     return out
 
 
@@ -1300,8 +1384,29 @@ def _sync_state_impl(
     buf_entries: List[Tuple[str, CatBuffer]] = []
     shard_buf_entries: List[Tuple[str, CatBuffer]] = []
     rewrap: Dict[str, type] = {}
+    sketch_templates: Dict[str, Any] = {}
+    eff_transports: Dict[str, str] = dict(transports or {})
+    eff_tolerances: Dict[str, float] = dict(tolerances or {})
     for name, val in state.items():
         red = reductions.get(name)
+        if _is_sketch(val):
+            if red != "sketch":
+                raise ValueError(
+                    f"sketch state {name!r} requires dist_reduce_fx 'sketch', got {red!r}"
+                )
+            # decompose into per-component elementwise entries; they join the
+            # ordinary (reduction, dtype, transport) buckets and reassemble
+            # below — zero sketch-specific collectives
+            sketch_templates[name] = val
+            _expand_sketch_maps(
+                name, val, transports, tolerances, eff_transports, eff_tolerances
+            )
+            for fkey, arr, fred in _sketch_entries(name, val):
+                if bucketed:
+                    entries.append((fkey, arr, fred))
+                else:
+                    out[fkey] = sync_array(arr, fred, axis_name)
+            continue
         if isinstance(val, CatBuffer):
             if red not in ("cat", None):
                 raise ValueError(
@@ -1344,7 +1449,7 @@ def _sync_state_impl(
         else:
             out[name] = sync_array(arr, red, axis_name)
     if entries:
-        out.update(_sync_bucketed(entries, axis_name, transports, tolerances))
+        out.update(_sync_bucketed(entries, axis_name, eff_transports, eff_tolerances))
     if shard_entries:
         out.update(_sync_resharded(shard_entries, axis_name, transports, tolerances))
     if multi_shard_entries:
@@ -1355,6 +1460,12 @@ def _sync_state_impl(
         out.update(_sync_bucketed_catbuffers(shard_buf_entries, axis_name, kind="reshard"))
     for name, container in rewrap.items():
         out[name] = container((out[name],))
+    for name, template in sketch_templates.items():
+        comps = {
+            fname: out.pop(f"{name}{_SKETCH_SEP}{fname}")
+            for fname, _ in template.component_reductions()
+        }
+        out[name] = template.replace(**comps)
     return {name: out[name] for name in state}
 
 
@@ -1425,6 +1536,21 @@ def incremental_plan(
     plan: Dict[str, Dict[str, Any]] = {}
     for name, val in state.items():
         red = reductions.get(name)
+        if _is_sketch(val) and red == "sketch":
+            # sketch components are all elementwise: int-sum fields delta-fold
+            # (exact), max/min registers replace — handled per component by
+            # init/emit/finalize under the umbrella "sketch" codec
+            if _resolve_mode(name, modes) == "incremental":
+                plan[name] = {
+                    "mode": "incremental", "codec": "sketch", "eligible": True,
+                    "reason": "",
+                }
+            else:
+                plan[name] = {
+                    "mode": "deferred", "codec": "sketch", "eligible": True,
+                    "reason": "sync mode resolves to deferred",
+                }
+            continue
         dtype = None if isinstance(val, CatBuffer) else getattr(val, "dtype", None)
         if isinstance(val, (list, tuple)) or dtype is None:
             entry = {
@@ -1551,20 +1677,29 @@ def init_incremental(
     plan = incremental_plan(state, reductions, modes=modes, shard_axes=shard_axes)
     acc: Dict[str, Array] = {}
     last: Dict[str, Array] = {}
+    track_reds: Dict[str, Any] = {}
+    eff_transports: Dict[str, str] = dict(transports or {})
     for name, entry in plan.items():
         if entry["mode"] != "incremental":
+            continue
+        if entry["codec"] == "sketch":
+            sk = state[name]
+            _expand_sketch_maps(name, sk, transports, None, eff_transports, {})
+            for fkey, arr, fred in _sketch_entries(name, sk):
+                arr = jnp.asarray(arr)
+                acc[fkey] = jnp.zeros(arr.shape, arr.dtype)
+                if _sketch_field_codec(fred, arr.dtype) == "fold":
+                    last[fkey] = jnp.zeros(arr.shape, arr.dtype)
+                track_reds[fkey] = (fred, arr.dtype)
             continue
         leaf = jnp.asarray(state[name])
         acc[name] = jnp.zeros(leaf.shape, leaf.dtype)
         if entry["codec"] == "fold":
             last[name] = jnp.zeros(leaf.shape, leaf.dtype)
+        track_reds[name] = (reductions.get(name), leaf.dtype)
     track = any(
-        _resolve_transport(
-            n, transports,
-            red=reductions.get(n), dtype=getattr(state.get(n), "dtype", None),
-        )
-        != "exact"
-        for n in acc
+        _resolve_transport(n, eff_transports, red=red, dtype=dtype) != "exact"
+        for n, (red, dtype) in track_reds.items()
     )
     return IncrementalCarry(
         dict(state), acc, last, sync_every=k, pending=0, emissions=0,
@@ -1600,13 +1735,31 @@ def emit_incremental(
     plan = incremental_plan(state, reductions, modes=modes, shard_axes=shard_axes)
     fold_entries: List[Tuple[str, Array, Optional[str]]] = []
     replace_entries: List[Tuple[str, Array, Optional[str]]] = []
+    live: Dict[str, Array] = {}
+    eff_transports: Dict[str, str] = dict(transports or {})
+    eff_tolerances: Dict[str, float] = dict(tolerances or {})
     for name, entry in plan.items():
         if entry["mode"] != "incremental":
             continue
+        if entry["codec"] == "sketch":
+            sk = state[name]
+            _expand_sketch_maps(
+                name, sk, transports, tolerances, eff_transports, eff_tolerances
+            )
+            for fkey, arr, fred in _sketch_entries(name, sk):
+                arr = jnp.asarray(arr)
+                live[fkey] = arr
+                if _sketch_field_codec(fred, arr.dtype) == "fold":
+                    fold_entries.append((fkey, arr - last[fkey], "sum"))
+                else:
+                    replace_entries.append((fkey, arr, fred))
+            continue
+        arr = jnp.asarray(state[name])
+        live[name] = arr
         if entry["codec"] == "fold":
-            fold_entries.append((name, jnp.asarray(state[name]) - last[name], "sum"))
+            fold_entries.append((name, arr - last[name], "sum"))
         else:
-            replace_entries.append((name, jnp.asarray(state[name]), reductions.get(name)))
+            replace_entries.append((name, arr, reductions.get(name)))
 
     t0_us = _otrace._now_us() if _otrace.active else 0
     with count_collectives() as box:
@@ -1617,16 +1770,18 @@ def emit_incremental(
             # fold is exactly the integer-sum set — so two _sync_bucketed calls
             # produce the same bucket layout one call would
             synced = _sync_bucketed(
-                fold_entries, axis_name, transports, tolerances,
+                fold_entries, axis_name, eff_transports, eff_tolerances,
                 error_scale=float(emission),
             )
             for name, _, _ in fold_entries:
                 new_acc[name] = acc[name] + synced[name]
-                new_last[name] = jnp.asarray(state[name])
+                new_last[name] = live[name]
         if replace_entries:
             # replace emissions are single-shot collectives of the full state:
             # error does not compound across emissions, scale stays 1
-            synced = _sync_bucketed(replace_entries, axis_name, transports, tolerances)
+            synced = _sync_bucketed(
+                replace_entries, axis_name, eff_transports, eff_tolerances
+            )
             for name, _, _ in replace_entries:
                 new_acc[name] = synced[name]
     if _otrace.active:
@@ -1689,6 +1844,27 @@ def finalize_incremental(
     residue: Dict[str, Any] = {}
     fold_tail: List[Tuple[str, Array, Optional[str]]] = []
     for name, entry in plan.items():
+        if entry["codec"] == "sketch" and _is_sketch(state.get(name)):
+            sk = state[name]
+            fkeys = [
+                f"{name}{_SKETCH_SEP}{fname}"
+                for fname, _ in sk.component_reductions()
+            ]
+            covered = entry["mode"] == "incremental" and all(k in acc for k in fkeys)
+            if covered and synced and pending <= 0:
+                # fresh accumulator: reassemble the synced sketch, zero cost
+                out[name] = sk.replace(
+                    **{
+                        fname: acc[f"{name}{_SKETCH_SEP}{fname}"]
+                        for fname, _ in sk.component_reductions()
+                    }
+                )
+            else:
+                # cadence tail or never-emitted: the max/min components need a
+                # full re-sync regardless, so the whole sketch goes to residue
+                # (sync_state decomposes it again; emissions wasted, correct)
+                residue[name] = sk
+            continue
         covered = entry["mode"] == "incremental" and name in acc
         if not covered or not synced:
             # uncovered leaf, or a carry that never emitted (acc still zeros):
@@ -1826,7 +2002,7 @@ def _stacked_flat(
         reds = reductions[lname]
         for name, leaf in st.items():
             red = reds.get(name)
-            if red not in _ELEMENTWISE:
+            if red not in _ELEMENTWISE and not (red == "sketch" and _is_sketch(leaf)):
                 raise ValueError(
                     f"incremental stacked sync: state {lname!r}.{name!r} has "
                     f"non-elementwise reduction {red!r} — classify_tenant_member "

@@ -19,7 +19,7 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -144,13 +144,19 @@ class IngestClient:
         tenant_id: Any,
         max_staleness_steps: Optional[int] = None,
         timeout_s: Optional[float] = None,
+        quantiles: Optional[Sequence[float]] = None,
     ) -> Dict[str, Any]:
-        """GET one tenant's values + staleness contract (``status`` included)."""
+        """GET one tenant's values + staleness contract (``status`` included).
+
+        ``quantiles`` asks the server to evaluate extra quantiles from the
+        tenant's ``QuantileSketch`` states (``doc["quantiles"]``)."""
         params = {}
         if max_staleness_steps is not None:
             params["max_staleness_steps"] = str(int(max_staleness_steps))
         if timeout_s is not None:
             params["timeout_s"] = str(float(timeout_s))
+        if quantiles is not None:
+            params["quantiles"] = ",".join(repr(float(q)) for q in quantiles)
         query = f"?{urllib.parse.urlencode(params)}" if params else ""
         req = urllib.request.Request(
             f"{self.base_url}/read/{urllib.parse.quote(str(tenant_id), safe='')}{query}"

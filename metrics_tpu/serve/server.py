@@ -72,7 +72,12 @@ JSON_CONTENT_TYPE = "application/json"
 NPY_CONTENT_TYPE = "application/x-npy"
 NPZ_CONTENT_TYPE = "application/x-npz"
 
-ENDPOINTS = ("/ingest/<tenant>", "/read/<tenant>", "/healthz", "/stats.json")
+ENDPOINTS = (
+    "/ingest/<tenant>",
+    "/read/<tenant>",  # ?max_staleness_steps=K&timeout_s=S&quantiles=0.5,0.99
+    "/healthz",
+    "/stats.json",
+)
 
 
 class DeadlineMissed(Exception):
@@ -210,6 +215,7 @@ class IngestPipeline:
         tenant_id: Union[str, int],
         max_staleness_steps: Optional[int] = None,
         timeout_s: Optional[float] = None,
+        quantiles: Optional[Sequence[float]] = None,
     ) -> Dict[str, Any]:
         """One tenant's metric values with the explicit staleness contract.
 
@@ -217,6 +223,11 @@ class IngestPipeline:
         remain unapplied (dead-lettered steps can never apply, so they do
         not count against the bound — they are surfaced separately); a wait
         past ``timeout_s`` raises :class:`DeadlineMissed`.
+
+        ``quantiles`` evaluates extra quantiles from every ``QuantileSketch``
+        state of the tenant (see :meth:`TenantSet.read_quantiles`) into a
+        ``"quantiles"`` key — readers are not limited to the ``q`` the
+        template metric was constructed with.
         """
         if _chaos.active:
             _chaos.maybe_fail("serve/read", tenant=str(tenant_id))
@@ -253,12 +264,20 @@ class IngestPipeline:
             applied = self._applied.get(tenant_id, 0)
             dead = self._dead.get(tenant_id, 0)
         values: Optional[Dict[str, Any]] = None
+        quantile_values: Optional[Dict[str, Dict[str, float]]] = None
         # the apply lock serializes compute against the dispatcher's stacked
         # update, so a read never sees a half-applied dispatch
         with self.apply_lock:
             if tenant_id in self.tenant_set._slot_of:
                 raw = self.tenant_set.compute([tenant_id])[tenant_id]
                 values = {k: np.asarray(v).tolist() for k, v in raw.items()}
+                if quantiles is not None:
+                    quantile_values = {
+                        name: {repr(float(q)): v for q, v in zip(quantiles, vals)}
+                        for name, vals in self.tenant_set.read_quantiles(
+                            tenant_id, quantiles
+                        ).items()
+                    }
         doc = {
             "tenant": tenant_id,
             "values": values,
@@ -269,6 +288,8 @@ class IngestPipeline:
         }
         if max_staleness_steps is not None:
             doc["max_staleness_steps"] = int(max_staleness_steps)
+        if quantile_values is not None:
+            doc["quantiles"] = quantile_values
         if _otrace.active:
             _otrace.emit_complete(
                 "serve/read", "serve", t0_us, _otrace._now_us() - t0_us,
@@ -636,12 +657,26 @@ class _IngestHandler(BaseHTTPRequestHandler):
     def _get_read(self, tenant_id: str, params: Dict[str, List[str]]) -> None:
         max_staleness = params.get("max_staleness_steps")
         timeout = params.get("timeout_s")
+        raw_qs = params.get("quantiles")
+        quantiles: Optional[List[float]] = None
+        if raw_qs:
+            try:
+                quantiles = [float(q) for q in raw_qs[0].split(",") if q.strip()]
+            except ValueError:
+                self._send_json(
+                    400, {"error": f"malformed quantiles={raw_qs[0]!r}: expected "
+                                   "a comma-separated list of floats in [0, 1]"})
+                return
         try:
             doc = self.ingest_server.pipeline.read(
                 tenant_id,
                 max_staleness_steps=int(max_staleness[0]) if max_staleness else None,
                 timeout_s=float(timeout[0]) if timeout else None,
+                quantiles=quantiles,
             )
+        except MetricsUserError as err:
+            self._send_json(400, {"error": str(err), "tenant": tenant_id})
+            return
         except UnknownTenant:
             self._send_json(404, {"error": f"unknown tenant {tenant_id!r}"})
             return

@@ -1,0 +1,30 @@
+"""Mergeable sketches: fixed-size approximate state for unbounded streams.
+
+Each sketch is a registered pytree whose components are device arrays and
+whose ``merge`` is a commutative elementwise monoid — the ``"sketch"``
+reduction tag in :mod:`metrics_tpu.core.metric` dispatches to it, and
+:mod:`metrics_tpu.parallel.sync` decomposes sketch leaves into their
+components so they ride the existing bucketed transports unchanged. See
+``docs/sketch_metrics.md``.
+"""
+
+from metrics_tpu.sketches.base import (
+    SKETCH_CLASSES,
+    MergeableSketch,
+    is_sketch,
+    register_sketch,
+)
+from metrics_tpu.sketches.countmin import CountMinSketch, DyadicCountMinSketch
+from metrics_tpu.sketches.hll import HyperLogLogSketch
+from metrics_tpu.sketches.quantile import QuantileSketch
+
+__all__ = [
+    "MergeableSketch",
+    "register_sketch",
+    "is_sketch",
+    "SKETCH_CLASSES",
+    "QuantileSketch",
+    "HyperLogLogSketch",
+    "CountMinSketch",
+    "DyadicCountMinSketch",
+]

@@ -1,0 +1,173 @@
+"""Mergeable sketch base — fixed-size, pytree-native, order-invariant state.
+
+A :class:`MergeableSketch` is a small bundle of device arrays (the
+*components*) plus static python config. Every component carries an
+elementwise reduction (``"sum"``/``"max"``/``"min"``), and ``merge`` is the
+per-component application of those reductions — a commutative, associative
+monoid operation, so merging shards in any order (or any tree shape) is
+**bitwise identical**. That property is what lets sketch states ride the
+bucketed sync, incremental fold streaks, tenant stacking, and
+reshard-on-restore machinery unchanged: the sync layer decomposes a sketch
+leaf into its components, routes each through the existing elementwise
+buckets, and reassembles.
+
+Subclasses declare:
+
+``sketch_fields``
+    ordered tuple of ``(component_name, reduction)`` pairs — the pytree
+    children, in flatten order.
+``config_attrs``
+    ordered tuple of static attribute names (ints/floats) — the pytree aux
+    data, also the checkpoint config payload.
+
+and implement ``fresh()`` (zero-state components for their config) plus
+whatever insert/query methods make sense. All insert/query methods are pure:
+they return new sketches / arrays and are jittable and vmappable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["MergeableSketch", "register_sketch", "SKETCH_CLASSES", "is_sketch"]
+
+# name -> class; the checkpoint decoder resolves ``sketch_class`` meta through
+# this registry so restores never unpickle arbitrary code.
+SKETCH_CLASSES: Dict[str, Type["MergeableSketch"]] = {}
+
+_VALID_REDUCTIONS = ("sum", "max", "min")
+
+
+def register_sketch(cls: Type["MergeableSketch"]) -> Type["MergeableSketch"]:
+    """Class decorator: register as a pytree node and in ``SKETCH_CLASSES``."""
+    for fname, fred in cls.sketch_fields:
+        if fred not in _VALID_REDUCTIONS:
+            raise ValueError(
+                f"{cls.__name__}.{fname}: sketch component reduction must be "
+                f"one of {_VALID_REDUCTIONS}, got {fred!r}"
+            )
+    jax.tree_util.register_pytree_node_class(cls)
+    SKETCH_CLASSES[cls.__name__] = cls
+    return cls
+
+
+def is_sketch(val: Any) -> bool:
+    """True when ``val`` is a MergeableSketch instance (duck-typed marker so
+    low-level modules can test without importing this package)."""
+    return getattr(val, "_is_mergeable_sketch", False) is True
+
+
+class MergeableSketch:
+    """Base class for fixed-size mergeable sketch states."""
+
+    _is_mergeable_sketch = True
+
+    # subclasses override
+    sketch_fields: Tuple[Tuple[str, str], ...] = ()
+    config_attrs: Tuple[str, ...] = ()
+
+    # ------------------------------------------------------------------ #
+    # pytree protocol
+    # ------------------------------------------------------------------ #
+    def tree_flatten(self):
+        children = tuple(getattr(self, fname) for fname, _ in self.sketch_fields)
+        aux = tuple(getattr(self, a) for a in self.config_attrs)
+        return children, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        obj = object.__new__(cls)
+        for a, v in zip(cls.config_attrs, aux):
+            object.__setattr__(obj, a, v)
+        for (fname, _), c in zip(cls.sketch_fields, children):
+            object.__setattr__(obj, fname, c)
+        return obj
+
+    # ------------------------------------------------------------------ #
+    # component access
+    # ------------------------------------------------------------------ #
+    def components(self) -> Dict[str, Any]:
+        """``{component_name: array}`` in declared order."""
+        return {fname: getattr(self, fname) for fname, _ in self.sketch_fields}
+
+    def component_reductions(self) -> Tuple[Tuple[str, str], ...]:
+        return self.sketch_fields
+
+    def replace(self, **components: Any) -> "MergeableSketch":
+        """New sketch with the given components swapped in (config shared)."""
+        unknown = set(components) - {f for f, _ in self.sketch_fields}
+        if unknown:
+            raise ValueError(f"unknown sketch components: {sorted(unknown)}")
+        obj = object.__new__(type(self))
+        for a in self.config_attrs:
+            object.__setattr__(obj, a, getattr(self, a))
+        for fname, _ in self.sketch_fields:
+            object.__setattr__(
+                obj, fname, components.get(fname, getattr(self, fname))
+            )
+        return obj
+
+    def config_dict(self) -> Dict[str, Any]:
+        """Static config as plain python scalars (checkpoint meta payload)."""
+        out: Dict[str, Any] = {}
+        for a in self.config_attrs:
+            v = getattr(self, a)
+            out[a] = float(v) if isinstance(v, float) else int(v)
+        return out
+
+    @classmethod
+    def from_config(cls, config: Dict[str, Any]) -> "MergeableSketch":
+        """Fresh (empty) sketch for a checkpoint-decoded config dict."""
+        return cls(**config)
+
+    # ------------------------------------------------------------------ #
+    # monoid
+    # ------------------------------------------------------------------ #
+    def merge(self, other: "MergeableSketch") -> "MergeableSketch":
+        """Commutative elementwise merge; bitwise order-invariant."""
+        if type(other) is not type(self):
+            raise TypeError(
+                f"cannot merge {type(self).__name__} with {type(other).__name__}"
+            )
+        if tuple(other.config_dict().items()) != tuple(self.config_dict().items()):
+            raise ValueError(
+                f"cannot merge {type(self).__name__} sketches with different "
+                f"configs: {self.config_dict()} vs {other.config_dict()}"
+            )
+        merged: Dict[str, Any] = {}
+        for fname, fred in self.sketch_fields:
+            a, b = getattr(self, fname), getattr(other, fname)
+            if fred == "sum":
+                merged[fname] = a + b
+            elif fred == "max":
+                merged[fname] = jnp.maximum(a, b)
+            else:
+                merged[fname] = jnp.minimum(a, b)
+        return self.replace(**merged)
+
+    # ------------------------------------------------------------------ #
+    # introspection
+    # ------------------------------------------------------------------ #
+    @property
+    def state_nbytes(self) -> int:
+        """Total component bytes — fixed for a given config, independent of
+        how many samples were inserted."""
+        total = 0
+        for fname, _ in self.sketch_fields:
+            v = getattr(self, fname)
+            shape = tuple(np.shape(v))
+            dtype = np.dtype(getattr(v, "dtype", np.float32))
+            total += int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        return total
+
+    def error_bound(self) -> Dict[str, Any]:
+        """Declared accuracy contract (subclasses override)."""
+        return {}
+
+    def __repr__(self) -> str:
+        cfg = ", ".join(f"{k}={v}" for k, v in self.config_dict().items())
+        return f"{type(self).__name__}({cfg}, nbytes={self.state_nbytes})"

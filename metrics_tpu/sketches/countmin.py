@@ -1,0 +1,218 @@
+"""Count-min frequency sketch + dyadic heavy-hitters hierarchy.
+
+``CountMinSketch`` keeps a ``(depth, width)`` int32 counter grid, sum-merged
+— point queries overestimate by at most ``2N/width`` with probability
+``1 - 2**-depth`` (Cormode & Muthukrishnan 2005). Rows use independent
+seeded fmix32 hashes; ``width`` is a power of two so the slot is a mask.
+
+``DyadicCountMinSketch`` stacks one count-min per dyadic level of a bounded
+integer key domain (``domain_bits`` levels) so heavy hitters can be found by
+binary descent: a prefix whose estimated mass clears the threshold is split
+until single keys remain. The descent is a data-dependent host-side walk
+(``heavy_hitters``), so metrics exposing it run their compute eagerly; the
+insert path stays jittable — one scatter-add per level.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.sketches.base import MergeableSketch, register_sketch
+from metrics_tpu.sketches.hll import canonical_u32, fmix32
+
+__all__ = ["CountMinSketch", "DyadicCountMinSketch"]
+
+# fixed per-row seed schedule (golden-ratio odd constants; level folds in)
+_SEED0 = 0x9E3779B1
+
+
+def _row_seeds(depth: int, level: int = 0) -> np.ndarray:
+    return np.asarray(
+        [(_SEED0 * (2 * r + 1) + 0x7F4A7C15 * level) & 0xFFFFFFFF for r in range(depth)],
+        dtype=np.uint32,
+    )
+
+
+@register_sketch
+class CountMinSketch(MergeableSketch):
+    """Fixed-size mergeable frequency sketch over integer/float keys.
+
+    Args:
+        width: slots per row (power of two).
+        depth: independent hash rows.
+    """
+
+    sketch_fields = (("counts", "sum"), ("total", "sum"))
+    config_attrs = ("width", "depth")
+
+    def __init__(self, width: int = 2048, depth: int = 4):
+        width, depth = int(width), int(depth)
+        if width < 2 or width & (width - 1):
+            raise ValueError("width must be a power of two >= 2")
+        if not 1 <= depth <= 16:
+            raise ValueError("depth must be in [1, 16]")
+        self.width = width
+        self.depth = depth
+        self.counts = jnp.zeros((depth, width), jnp.int32)
+        self.total = jnp.zeros((), jnp.int32)
+
+    # ------------------------------------------------------------------ #
+    def _slots(self, keys: jnp.ndarray) -> jnp.ndarray:
+        """(depth, n) slot indices for uint32 keys."""
+        seeds = jnp.asarray(_row_seeds(self.depth))
+        h = fmix32(keys[None, :] ^ seeds[:, None])
+        return (h & jnp.uint32(self.width - 1)).astype(jnp.int32)
+
+    def insert(self, keys: Any, weights: Any = None) -> "CountMinSketch":
+        """Pure insert; ``weights`` defaults to 1 per key (int32)."""
+        k = canonical_u32(keys)
+        if k.size == 0:
+            return self
+        if weights is None:
+            w = jnp.ones(k.shape, jnp.int32)
+        else:
+            w = jnp.broadcast_to(
+                jnp.ravel(jnp.asarray(weights, jnp.int32)), k.shape
+            )
+        slots = self._slots(k)
+        rows = jnp.broadcast_to(
+            jnp.arange(self.depth, dtype=jnp.int32)[:, None], slots.shape
+        )
+        counts = self.counts.at[rows, slots].add(
+            jnp.broadcast_to(w[None, :], slots.shape)
+        )
+        return self.replace(counts=counts, total=self.total + jnp.sum(w))
+
+    def query(self, keys: Any) -> jnp.ndarray:
+        """Estimated counts (int32, same length as keys); never understates."""
+        k = canonical_u32(keys)
+        slots = self._slots(k)
+        rows = jnp.arange(self.depth, dtype=jnp.int32)[:, None]
+        return jnp.min(self.counts[rows, slots], axis=0)
+
+    def error_bound(self) -> Dict[str, Any]:
+        return {
+            "kind": "additive_count_error",
+            "value": 2.0 / self.width,  # x total inserted weight
+            "confidence": 1.0 - 2.0 ** (-self.depth),
+            "one_sided": True,
+        }
+
+
+@register_sketch
+class DyadicCountMinSketch(MergeableSketch):
+    """Dyadic count-min hierarchy over a bounded integer key domain.
+
+    Args:
+        domain_bits: keys live in ``[0, 2**domain_bits)`` (wider inputs are
+            masked); one count-min level per bit enables heavy-hitter descent.
+        width / depth: per-level count-min shape.
+    """
+
+    sketch_fields = (("counts", "sum"), ("total", "sum"))
+    config_attrs = ("domain_bits", "width", "depth")
+
+    def __init__(self, domain_bits: int = 16, width: int = 1024, depth: int = 4):
+        domain_bits, width, depth = int(domain_bits), int(width), int(depth)
+        if not 1 <= domain_bits <= 28:
+            raise ValueError("domain_bits must be in [1, 28]")
+        if width < 2 or width & (width - 1):
+            raise ValueError("width must be a power of two >= 2")
+        if not 1 <= depth <= 16:
+            raise ValueError("depth must be in [1, 16]")
+        self.domain_bits = domain_bits
+        self.width = width
+        self.depth = depth
+        self.counts = jnp.zeros((domain_bits, depth, width), jnp.int32)
+        self.total = jnp.zeros((), jnp.int32)
+
+    # ------------------------------------------------------------------ #
+    def _level_slots(self, level: int, prefixes: jnp.ndarray) -> jnp.ndarray:
+        """(depth, n) slots for level-``level`` prefixes (uint32)."""
+        seeds = jnp.asarray(_row_seeds(self.depth, level + 1))
+        h = fmix32(prefixes[None, :] ^ seeds[:, None])
+        return (h & jnp.uint32(self.width - 1)).astype(jnp.int32)
+
+    def insert(self, keys: Any, weights: Any = None) -> "DyadicCountMinSketch":
+        """Pure insert of integer keys (masked into the domain)."""
+        k = canonical_u32(keys) & jnp.uint32((1 << self.domain_bits) - 1)
+        if k.size == 0:
+            return self
+        if weights is None:
+            w = jnp.ones(k.shape, jnp.int32)
+        else:
+            w = jnp.broadcast_to(
+                jnp.ravel(jnp.asarray(weights, jnp.int32)), k.shape
+            )
+        counts = self.counts
+        rows = jnp.arange(self.depth, dtype=jnp.int32)[:, None]
+        wrow = jnp.broadcast_to(w[None, :], (self.depth, k.size))
+        # level l holds prefixes of length l+1 (level domain_bits-1 = full keys)
+        for level in range(self.domain_bits):
+            prefix = k >> jnp.uint32(self.domain_bits - 1 - level)
+            slots = self._level_slots(level, prefix)
+            counts = counts.at[level, rows, slots].add(wrow)
+        return self.replace(counts=counts, total=self.total + jnp.sum(w))
+
+    def _prefix_count(
+        self, counts: np.ndarray, level: int, prefix: int
+    ) -> int:
+        seeds = _row_seeds(self.depth, level + 1)
+        mask = 0xFFFFFFFF
+        est = None
+        for r in range(self.depth):
+            h = (int(prefix) ^ int(seeds[r])) & mask
+            h ^= h >> 16
+            h = (h * 0x85EBCA6B) & mask
+            h ^= h >> 13
+            h = (h * 0xC2B2AE35) & mask
+            h ^= h >> 16
+            c = int(counts[level, r, h & (self.width - 1)])
+            est = c if est is None else min(est, c)
+        return int(est)
+
+    def heavy_hitters(
+        self, threshold: float = 0.01, max_hitters: int = 16
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Keys whose estimated frequency is ``>= threshold * total``.
+
+        Host-side dyadic descent (not jittable). Returns ``(keys, counts)``
+        as int64/int64 numpy arrays sorted by descending count, padded with
+        ``-1`` / ``0`` up to ``max_hitters``.
+        """
+        counts = np.asarray(self.counts)
+        total = int(np.asarray(self.total))
+        keys: List[Tuple[int, int]] = []
+        if total > 0:
+            cut = max(1, int(np.ceil(threshold * total)))
+            frontier = [(0, 0), (0, 1)]  # (level, prefix)
+            while frontier:
+                level, prefix = frontier.pop()
+                est = self._prefix_count(counts, level, prefix)
+                if est < cut:
+                    continue
+                if level == self.domain_bits - 1:
+                    keys.append((prefix, est))
+                else:
+                    frontier.append((level + 1, prefix << 1))
+                    frontier.append((level + 1, (prefix << 1) | 1))
+        keys.sort(key=lambda kv: (-kv[1], kv[0]))
+        keys = keys[:max_hitters]
+        out_k = np.full((max_hitters,), -1, dtype=np.int64)
+        out_c = np.zeros((max_hitters,), dtype=np.int64)
+        for i, (kk, cc) in enumerate(keys):
+            out_k[i] = kk
+            out_c[i] = cc
+        return out_k, out_c
+
+    def error_bound(self) -> Dict[str, Any]:
+        return {
+            "kind": "additive_count_error",
+            "value": 2.0 / self.width,
+            "confidence": 1.0 - 2.0 ** (-self.depth),
+            "one_sided": True,
+            "levels": self.domain_bits,
+        }

@@ -1,0 +1,130 @@
+"""HyperLogLog distinct-count sketch (Flajolet et al. 2007, 32-bit variant).
+
+State is ``m = 2**precision`` int32 registers merged by elementwise ``max`` —
+a commutative idempotent monoid, so shard merges are bitwise order-invariant
+and re-inserting the same key is a no-op. Keys are canonicalized to uint32
+(integers truncate mod 2**32; floats go through their IEEE bit pattern with
+``-0.0`` folded into ``+0.0``) and mixed with the murmur3 fmix32 finalizer,
+which is a full avalanche permutation of uint32 — exactly the uniform-hash
+assumption HLL needs. The top ``precision`` hash bits pick the register, the
+leading-zero rank of the remaining bits updates it.
+
+Default ``precision=12`` → 4096 registers (16 KB), relative standard error
+``1.04/sqrt(m) ≈ 1.6%``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+from metrics_tpu.sketches.base import MergeableSketch, register_sketch
+
+__all__ = ["HyperLogLogSketch", "fmix32", "canonical_u32"]
+
+
+def fmix32(h: Any) -> jnp.ndarray:
+    """murmur3 32-bit finalizer; uint32 in, uint32 out (full avalanche)."""
+    h = jnp.asarray(h, jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def canonical_u32(values: Any) -> jnp.ndarray:
+    """Canonical uint32 key view of an int or float array."""
+    x = jnp.ravel(jnp.asarray(values))
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        xf = x.astype(jnp.float32)
+        xf = jnp.where(xf == 0.0, jnp.float32(0.0), xf)  # fold -0.0 -> +0.0
+        import jax
+
+        return jax.lax.bitcast_convert_type(xf, jnp.uint32)
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.uint32)
+    return x.astype(jnp.uint32)
+
+
+def _clz32(h: jnp.ndarray) -> jnp.ndarray:
+    """Count of leading zeros of each uint32 (32 for zero) — exact integer
+    shift-chain, no float log round-off."""
+    h = jnp.asarray(h, jnp.uint32)
+    n = jnp.zeros(h.shape, jnp.int32)
+    for shift in (16, 8, 4, 2, 1):
+        top = h >> jnp.uint32(32 - shift)
+        move = top == 0
+        n = n + jnp.where(move, shift, 0)
+        h = jnp.where(move, h << jnp.uint32(shift), h)
+    return jnp.where(jnp.asarray(h, jnp.uint32) == 0, 32, n)
+
+
+@register_sketch
+class HyperLogLogSketch(MergeableSketch):
+    """Fixed-size mergeable distinct-count sketch.
+
+    Args:
+        precision: register-index bits; ``m = 2**precision`` registers.
+    """
+
+    sketch_fields = (("registers", "max"),)
+    config_attrs = ("precision",)
+
+    def __init__(self, precision: int = 12):
+        if not 4 <= int(precision) <= 18:
+            raise ValueError("precision must be in [4, 18]")
+        self.precision = int(precision)
+        self.registers = jnp.zeros((1 << self.precision,), jnp.int32)
+
+    # ------------------------------------------------------------------ #
+    def insert(self, values: Any) -> "HyperLogLogSketch":
+        """Pure insert of a batch of hashable keys (int or float arrays)."""
+        k = canonical_u32(values)
+        if k.size == 0:
+            return self
+        h = fmix32(k)
+        p = self.precision
+        idx = (h >> jnp.uint32(32 - p)).astype(jnp.int32)
+        # rank of the remaining (32-p)-bit suffix: leading zeros + 1, capped
+        rho = jnp.minimum(_clz32(h << jnp.uint32(p)) + 1, 32 - p + 1)
+        regs = self.registers.at[idx].max(rho.astype(jnp.int32))
+        return self.replace(registers=regs)
+
+    def estimate(self) -> jnp.ndarray:
+        """Cardinality estimate (float32 scalar) with the standard small- and
+        large-range corrections."""
+        m = float(1 << self.precision)
+        if m == 16:
+            alpha = 0.673
+        elif m == 32:
+            alpha = 0.697
+        elif m == 64:
+            alpha = 0.709
+        else:
+            alpha = 0.7213 / (1.0 + 1.079 / m)
+        regs = self.registers.astype(jnp.float32)
+        inv_sum = jnp.sum(jnp.exp2(-regs))
+        raw = jnp.float32(alpha * m * m) / inv_sum
+        zeros = jnp.sum((self.registers == 0).astype(jnp.float32))
+        # linear counting when the raw estimate is small and registers remain
+        # empty; 32-bit hash-collision correction at the very top of the range
+        small = jnp.float32(m) * jnp.log(jnp.float32(m) / jnp.maximum(zeros, 1.0))
+        two32 = jnp.float32(2.0**32)
+        large = -two32 * jnp.log1p(-jnp.minimum(raw / two32, 0.999999))
+        est = jnp.where(
+            (raw <= 2.5 * m) & (zeros > 0),
+            small,
+            jnp.where(raw > two32 / 30.0, large, raw),
+        )
+        return est.astype(jnp.float32)
+
+    def error_bound(self) -> Dict[str, Any]:
+        m = 1 << self.precision
+        return {
+            "kind": "relative_std_error",
+            "value": 1.04 / math.sqrt(m),
+        }

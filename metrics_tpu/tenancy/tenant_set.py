@@ -128,12 +128,18 @@ class TenantSet:
             # would flip to strong on the first reset/update program output,
             # changing the stacked pytree's abstract signature and retracing
             # every cached executable once
-            self._stacked[group[0]] = {
-                k: jnp.array(
-                    jnp.broadcast_to(jnp.asarray(v)[None], (self.capacity,) + jnp.shape(v))
-                ).astype(jnp.asarray(v).dtype)
-                for k, v in base.items()
-            }
+            def _stack(v: Any) -> Any:
+                # broadcast per array leaf so sketch pytree states stack
+                # component-wise (each component gains the tenant axis)
+                def bcast(leaf: Any) -> jnp.ndarray:
+                    arr = jnp.asarray(leaf)
+                    return jnp.array(
+                        jnp.broadcast_to(arr[None], (self.capacity,) + arr.shape)
+                    ).astype(arr.dtype)
+
+                return jax.tree_util.tree_map(bcast, v)
+
+            self._stacked[group[0]] = {k: _stack(v) for k, v in base.items()}
         # eager (unstackable) groups: one state dict per occupied slot
         self._eager_states: Dict[str, Dict[int, StateDict]] = {
             g[0]: {} for g in self._eager_groups
@@ -551,6 +557,49 @@ class TenantSet:
             )
         return out
 
+    def read_quantiles(
+        self, tenant_id: TenantId, qs: Sequence[float]
+    ) -> Dict[str, List[float]]:
+        """Arbitrary quantiles from one tenant's ``QuantileSketch`` states.
+
+        The sketch holds the whole (approximate) distribution, so readers are
+        not limited to the ``q`` the template was constructed with — any
+        quantile evaluates from the same fixed-size state. Pure read over the
+        tenant's stacked row; metrics without a ``QuantileSketch`` state are
+        skipped. Keys are the collection output name, suffixed with
+        ``/<state>`` when a metric holds several sketches.
+        """
+        from metrics_tpu.sketches import QuantileSketch
+
+        slot = self._slot_of.get(tenant_id)
+        if slot is None:
+            raise MetricsUserError(f"TenantSet: tenant {tenant_id!r} is not admitted")
+        qs = [float(q) for q in qs]
+        if not qs or not all(0.0 <= q <= 1.0 for q in qs):
+            raise MetricsUserError(f"quantiles must be in [0, 1], got {qs!r}")
+        qs_arr = jnp.asarray(qs, jnp.float32)
+        out: Dict[str, List[float]] = {}
+        for group in self.template._groups:
+            leader = group[0]
+            metric = self.template._metrics[leader]
+            sketch_states = [
+                k for k, d in metric._defaults.items() if isinstance(d, QuantileSketch)
+            ]
+            if not sketch_states:
+                continue
+            eager = self._eager_states.get(leader)
+            for k in sketch_states:
+                if eager is not None:
+                    sk = eager[slot][k]
+                else:
+                    sk = jax.tree_util.tree_map(
+                        lambda c: c[slot], self._stacked[leader][k]
+                    )
+                name = self.template._set_name(leader)
+                key = name if len(sketch_states) == 1 else f"{name}/{k}"
+                out[key] = np.asarray(sk.quantile(qs_arr)).tolist()
+        return out
+
     # ------------------------------------------------------------------ #
     # tenant-batched sync (pure; call under shard_map/pmap)
     # ------------------------------------------------------------------ #
@@ -659,7 +708,12 @@ class TenantSet:
         if slot is None:
             raise MetricsUserError(f"TenantSet: tenant {tenant_id!r} is not admitted")
         states = {
-            lname: {k: np.asarray(leaf[slot]) for k, leaf in st.items()}
+            lname: {
+                # tree_map so sketch states export component-wise (a sketch
+                # leaf becomes a sketch of host arrays; plain arrays unchanged)
+                k: jax.tree_util.tree_map(lambda c: np.asarray(c[slot]), leaf)
+                for k, leaf in st.items()
+            }
             for lname, st in self._stacked.items()
         }
         eager = {
@@ -685,7 +739,7 @@ class TenantSet:
             slot = self.admit(tenant_id)
         if self._stacked:
             rows = {
-                lname: {k: jnp.asarray(v) for k, v in st.items()}
+                lname: {k: jax.tree_util.tree_map(jnp.asarray, v) for k, v in st.items()}
                 for lname, st in snapshot["states"].items()
             }
             key = ("import",)
@@ -745,11 +799,18 @@ class TenantSet:
                 f"groups [{eager}] are tenant_eager (see partition_view()['tenant'] "
                 "for the reasons and analysis rule E110)."
             )
-        payload = {
-            f"tenant/{lname}.{k}": np.asarray(leaf)
-            for lname, st in self._stacked.items()
-            for k, leaf in st.items()
-        }
+        payload: Dict[str, np.ndarray] = {}
+        for lname, st in self._stacked.items():
+            for k, leaf in st.items():
+                if _sync._is_sketch(leaf):
+                    # one array per sketch component; _apply_snapshot
+                    # reassembles through the template default's structure
+                    for fname, _ in leaf.component_reductions():
+                        payload[f"tenant/{lname}.{k}.{fname}"] = np.asarray(
+                            getattr(leaf, fname)
+                        )
+                else:
+                    payload[f"tenant/{lname}.{k}"] = np.asarray(leaf)
         shard_meta = {
             "kind": "tenant_set",
             "members": {
@@ -776,10 +837,18 @@ class TenantSet:
         stacked: Dict[str, StateDict] = {}
         for group in self._stacked_groups:
             lname = group[0]
-            stacked[lname] = {
-                k: jnp.asarray(payload[f"tenant/{lname}.{k}"])
-                for k in self._stacked[lname]
-            }
+            stacked[lname] = {}
+            for k in self._stacked[lname]:
+                cur = self._stacked[lname][k]
+                if _sync._is_sketch(cur):
+                    stacked[lname][k] = cur.replace(
+                        **{
+                            fname: jnp.asarray(payload[f"tenant/{lname}.{k}.{fname}"])
+                            for fname, _ in cur.component_reductions()
+                        }
+                    )
+                else:
+                    stacked[lname][k] = jnp.asarray(payload[f"tenant/{lname}.{k}"])
         self._stacked = stacked
         self._slot_of = {tid: int(slot) for tid, slot in info["slots"]}
         self._tenant_at = [None] * self.capacity

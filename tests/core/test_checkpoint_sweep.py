@@ -26,6 +26,7 @@ import pytest
 from metrics_tpu.analysis.registry import Entry, build_registry
 from metrics_tpu.checkpoint import restore_checkpoint, save_checkpoint
 from metrics_tpu.core.buffers import CatBuffer
+from metrics_tpu.sketches.base import is_sketch
 
 
 def _sweepable(entry: Entry) -> bool:
@@ -77,7 +78,16 @@ def _feed(metric: Any, entry: Entry) -> None:
 
 
 def _assert_leaf_equal(va: Any, vb: Any, where: str) -> None:
-    if isinstance(va, CatBuffer):
+    if is_sketch(va):
+        assert type(va) is type(vb), where
+        assert va.config_dict() == vb.config_dict(), where
+        for fname, _ in va.sketch_fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(va, fname)),
+                np.asarray(getattr(vb, fname)),
+                err_msg=f"{where}.{fname}",
+            )
+    elif isinstance(va, CatBuffer):
         assert isinstance(vb, CatBuffer), where
         empty_a = not va.materialized or int(va.count) == 0
         empty_b = not vb.materialized or int(vb.count) == 0

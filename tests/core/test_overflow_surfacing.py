@@ -1,0 +1,91 @@
+"""CatBuffer overflow surfacing at update time (ISSUE-18 satellite).
+
+Compiled appends beyond capacity silently overwrite the buffer tail and only
+blow up later, at ``to_array()`` inside compute. The facade now surfaces the
+sticky ``overflowed`` flag the first time it flips: a
+``metrics_tpu_catbuffer_overflows_total{owner}`` counter, a one-shot warning,
+and a ``buffer/overflow`` tracer instant. ``reset()`` re-arms the one-shot.
+"""
+import warnings
+
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import CatMetric, observability
+from metrics_tpu.observability import tracer as otrace
+
+
+def _overflow_warnings(records):
+    return [str(w.message) for w in records if "overflowed its capacity" in str(w.message)]
+
+
+def _counter(owner):
+    return observability.get_registry().counter("catbuffer_overflows_total", owner=owner)
+
+
+@pytest.fixture()
+def overflowing_metric():
+    # compiled update: static shapes, so appends past capacity clamp + flag
+    m = CatMetric(buffer_capacity=4, compiled_update=True)
+    return m
+
+
+def _push_8_rows(m):
+    for i in range(4):
+        m.update(jnp.arange(2, dtype=jnp.float32) + i)
+
+
+def test_overflow_reported_once_with_counter_and_trace(overflowing_metric):
+    m = overflowing_metric
+    before = _counter("CatMetric.value").value
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        with otrace.trace() as tr:
+            _push_8_rows(m)
+    msgs = _overflow_warnings(rec)
+    assert len(msgs) == 1  # one-shot, even though updates kept overflowing
+    assert "CatMetric.value" in msgs[0] and "buffer_capacity" in msgs[0]
+    assert _counter("CatMetric.value").value == before + 1
+    events = [e for e in tr.events() if e.name == "buffer/overflow"]
+    assert len(events) == 1
+    assert events[0].cat == "buffer"
+    assert events[0].args == {"owner": "CatMetric.value", "capacity": 4}
+
+
+def test_reset_rearms_the_one_shot(overflowing_metric):
+    m = overflowing_metric
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        _push_8_rows(m)
+    before = _counter("CatMetric.value").value
+    m.reset()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        _push_8_rows(m)
+    assert len(_overflow_warnings(rec)) == 1
+    assert _counter("CatMetric.value").value == before + 1
+
+
+def test_eager_growth_never_warns():
+    # eager appends grow the buffer geometrically — no overflow, no report
+    m = CatMetric(buffer_capacity=2, compiled_update=False)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        for i in range(5):
+            m.update(jnp.arange(2, dtype=jnp.float32) + i)
+    assert not _overflow_warnings(rec)
+    assert len(m.value) == 10
+
+
+def test_within_capacity_never_warns():
+    m = CatMetric(buffer_capacity=64, compiled_update=True)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        _push_8_rows(m)
+    assert not _overflow_warnings(rec)
+
+
+def test_catalog_lists_the_event():
+    from metrics_tpu.observability.tracer import EVENT_CATALOG
+
+    assert EVENT_CATALOG["buffer"] == ("buffer/overflow",)

@@ -141,6 +141,13 @@ INVENTORY = {
         lambda: M.CatMetric(), _b(_REG_P), "buffered",
         buffered=lambda: M.CatMetric(buffer_capacity=256), buffered_level="update_sync",
     ),
+    "Quantile": Entry(lambda: M.Quantile(q=0.5), _b(_REG_P), "full"),
+    "Median": Entry(lambda: M.Median(), _b(_REG_P), "full"),
+    "DistinctCount": Entry(lambda: M.DistinctCount(), _b(_LABELS), "full"),
+    # heavy-hitter extraction is a host-side dyadic descent (compiled_compute=False)
+    "HeavyHitters": Entry(
+        lambda: M.HeavyHitters(threshold=0.05, max_hitters=4), _b(_LABELS), "update_sync",
+    ),
     # ---------------------------------------------------- classification ----
     "Accuracy": Entry(lambda: M.Accuracy(num_classes=C), _b(_PROBS, _LABELS), "full"),
     "AUC": Entry(

@@ -1,0 +1,85 @@
+"""Quantile passthrough on the read endpoint (ISSUE-18 satellite).
+
+``GET /read/<tenant>?quantiles=0.5,0.99`` evaluates arbitrary quantiles from
+the tenant's ``QuantileSketch`` states at read time — the sketch holds the
+whole (approximate) distribution, so readers are not limited to the ``q`` the
+template metric was constructed with.
+"""
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu import serve as msv
+
+pytestmark = pytest.mark.network
+
+
+@pytest.fixture()
+def server():
+    srv = msv.IngestServer(
+        mt.TenantSet(mt.Quantile(q=0.5), capacity=4), queue_capacity=64
+    ).start()
+    yield srv
+    srv.stop(drain=False, timeout=5.0)
+
+
+def test_read_quantiles_end_to_end(server):
+    client = msv.IngestClient(server.url)
+    rng = np.random.default_rng(3)
+    data = rng.uniform(1.0, 100.0, size=(16, 8)).astype(np.float32)
+    for row in data:
+        assert client.post_with_retry("t1", row)["admitted"]
+    doc = client.read("t1", max_staleness_steps=0, timeout_s=10, quantiles=[0.5, 0.99])
+    assert doc["status"] == 200
+    flat = data.ravel()
+    for q in (0.5, 0.99):
+        got = doc["quantiles"]["Quantile"][repr(q)]
+        exact = float(np.quantile(flat, q, method="inverted_cdf"))
+        assert got == pytest.approx(exact, rel=0.03), q
+    # the plain values key is untouched and matches the ctor's q=0.5
+    assert doc["values"]["Quantile"] == pytest.approx(
+        doc["quantiles"]["Quantile"][repr(0.5)]
+    )
+
+
+def test_read_without_quantiles_has_no_key(server):
+    client = msv.IngestClient(server.url)
+    rng = np.random.default_rng(4)
+    assert client.post_with_retry("t1", rng.uniform(1.0, 2.0, 8).astype(np.float32))["admitted"]
+    doc = client.read("t1", max_staleness_steps=0, timeout_s=10)
+    assert doc["status"] == 200
+    assert "quantiles" not in doc
+
+
+def test_out_of_range_quantile_is_400(server):
+    client = msv.IngestClient(server.url)
+    rng = np.random.default_rng(5)
+    assert client.post_with_retry("t1", rng.uniform(1.0, 2.0, 8).astype(np.float32))["admitted"]
+    assert server.drain(10.0)
+    doc = client.read("t1", quantiles=[1.5])
+    assert doc["status"] == 400
+    assert "quantile" in doc["error"]
+
+
+def test_malformed_quantiles_is_400(server):
+    client = msv.IngestClient(server.url)
+    with pytest.raises(urllib.error.HTTPError) as err:
+        urllib.request.urlopen(server.url + "/read/t1?quantiles=abc")
+    assert err.value.code == 400
+
+
+def test_sketchless_template_returns_empty_quantiles():
+    srv = msv.IngestServer(
+        mt.TenantSet(mt.MeanMetric(), capacity=2), queue_capacity=16
+    ).start()
+    try:
+        client = msv.IngestClient(srv.url)
+        assert client.post_with_retry("t", np.asarray([1.0, 2.0], np.float32))["admitted"]
+        doc = client.read("t", max_staleness_steps=0, timeout_s=10, quantiles=[0.5])
+        assert doc["status"] == 200
+        assert doc["quantiles"] == {}
+    finally:
+        srv.stop(drain=False, timeout=5.0)

@@ -1,0 +1,127 @@
+"""Sketch states under the 8-device mesh: bitwise merge-order invariance.
+
+The acceptance contract of the sketch subsystem: syncing a sketch state over
+the mesh produces *bitwise* identical components no matter how the stream is
+sharded (1/2/4/8 shards) or in what order shards fold — because every
+component reduction is a commutative elementwise collective. These tests run
+``sync_states`` inside ``shard_map`` over the session's 8 CPU devices and
+compare raw component bytes, not tolerances.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import AUROC, DistinctCount, Quantile
+
+WORLD = 8
+
+
+@pytest.fixture()
+def mesh():
+    devices = jax.devices()
+    if len(devices) < WORLD:
+        pytest.skip("needs 8 devices")
+    return Mesh(np.asarray(devices[:WORLD]), ("data",))
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(7)
+
+
+def _components(sk):
+    """Host copies of the sketch's components, scalars lifted to rank 1."""
+    return {f: np.atleast_1d(np.asarray(getattr(sk, f))) for f, _ in sk.sketch_fields}
+
+
+def _per_device_blocks(stacked, world):
+    """Split a dim-0-concatenated shard_map output into per-device blocks."""
+    return np.split(np.asarray(stacked), world)
+
+
+@pytest.mark.mesh8
+def test_quantile_mesh_sync_bitwise_vs_whole_stream(mesh, rng):
+    m = Quantile(q=0.5)
+    data = jnp.asarray(rng.uniform(0.5, 100.0, (WORLD, 64)), jnp.float32)
+
+    def body(x):
+        state = m.update_state(m.init_state(), jnp.ravel(x))
+        synced = m.sync_states(state, "data")
+        sk = synced["sketch"]
+        return {f: jnp.atleast_1d(getattr(sk, f)) for f, _ in sk.sketch_fields}
+
+    f = shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_rep=False)
+    synced = f(data)
+    whole = _components(m.update_state(m.init_state(), jnp.ravel(data))["sketch"])
+    for fname, stacked in synced.items():
+        # after the sync every device must hold bitwise the same merged
+        # component, equal to a single-stream insert of the whole data
+        for d, block in enumerate(_per_device_blocks(stacked, WORLD)):
+            np.testing.assert_array_equal(block, whole[fname], err_msg=f"{fname}@dev{d}")
+
+
+@pytest.mark.parametrize("shards", [1, 2, 4, 8])
+def test_quantile_merge_states_invariant_across_shard_counts(shards, rng):
+    m = Quantile(q=[0.25, 0.9])
+    data = rng.uniform(0.5, 100.0, 256).astype(np.float32)
+    whole = _components(m.update_state(m.init_state(), jnp.asarray(data))["sketch"])
+    parts = [
+        m.update_state(m.init_state(), jnp.asarray(chunk))
+        for chunk in np.array_split(data, shards)
+    ]
+    folded = parts[0]
+    for p in parts[1:]:
+        folded = m.merge_states(folded, p)
+    got = _components(folded["sketch"])
+    for fname in whole:
+        np.testing.assert_array_equal(got[fname], whole[fname], err_msg=f"{shards}:{fname}")
+
+
+@pytest.mark.mesh8
+def test_distinct_count_mesh_sync_estimate(mesh, rng):
+    m = DistinctCount()
+    keys = rng.choice(10**6, size=WORLD * 512, replace=False).astype(np.int32)
+    data = jnp.asarray(keys).reshape(WORLD, 512)
+
+    def body(x):
+        state = m.update_state(m.init_state(), jnp.ravel(x))
+        state = m.sync_states(state, "data")
+        return jnp.atleast_1d(m.compute_state(state))
+
+    f = shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_rep=False)
+    per_dev = np.asarray(f(data))
+    # all devices agree bitwise, and the estimate sees the union of all shards
+    assert np.all(per_dev == per_dev[0])
+    true_n = len(keys)
+    sigma = m.sketch.error_bound()["value"]
+    assert abs(per_dev[0] - true_n) / true_n < 4 * sigma
+
+
+@pytest.mark.mesh8
+def test_auroc_sketch_mesh_sync_matches_single_host(mesh, rng):
+    m = AUROC(pos_label=1, approx="sketch")
+    n = WORLD * 128
+    target = (rng.uniform(size=n) < 0.5).astype(np.int32)
+    preds = np.clip(rng.normal(0.4, 0.2, n) + 0.2 * target, 1e-4, 1.0).astype(np.float32)
+
+    def body(p, t):
+        state = m.update_state(m.init_state(), jnp.ravel(p), jnp.ravel(t))
+        state = m.sync_states(state, "data")
+        return jnp.atleast_1d(m.compute_state(state))
+
+    f = shard_map(
+        body, mesh=mesh,
+        in_specs=(P("data"), P("data")),
+        out_specs=P("data"),
+        check_rep=False,
+    )
+    per_dev = np.asarray(
+        f(jnp.asarray(preds).reshape(WORLD, -1), jnp.asarray(target).reshape(WORLD, -1))
+    )
+    assert np.all(per_dev == per_dev[0])
+    single = AUROC(pos_label=1, approx="sketch")
+    single.update(jnp.asarray(preds), jnp.asarray(target))
+    assert per_dev[0] == pytest.approx(float(single.compute()), abs=1e-6)

@@ -1,0 +1,174 @@
+"""Sketch-backed metric facades: accuracy vs exact oracles, roundtrips.
+
+Covers the new aggregation metrics (``Quantile``/``Median``,
+``DistinctCount``, ``HeavyHitters``) and the ``AUROC(approx="sketch")`` twin
+of a CatBuffer-backed metric — including the state_dict/checkpoint roundtrips
+that the registry-driven sweep cannot reach for constructor variants.
+"""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    AUROC,
+    DistinctCount,
+    HeavyHitters,
+    Median,
+    Quantile,
+)
+from metrics_tpu.checkpoint import restore_checkpoint, save_checkpoint
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(99)
+
+
+def test_quantile_vs_numpy(rng):
+    data = rng.uniform(0.5, 200.0, size=(32, 16)).astype(np.float32)
+    m = Quantile(q=[0.1, 0.5, 0.99])
+    for row in data:
+        m.update(jnp.asarray(row))
+    got = np.asarray(m.compute())
+    exact = np.quantile(data.ravel(), [0.1, 0.5, 0.99], method="inverted_cdf")
+    np.testing.assert_allclose(got, exact, rtol=0.011)
+
+
+def test_quantile_scalar_q_returns_scalar(rng):
+    m = Quantile(q=0.5)
+    m.update(jnp.asarray(rng.uniform(1.0, 10.0, 64), jnp.float32))
+    assert np.asarray(m.compute()).shape == ()
+
+
+def test_quantile_rejects_out_of_range_q():
+    with pytest.raises((ValueError, MetricsUserError)):
+        Quantile(q=1.5)
+
+
+def test_median_is_quantile_half(rng):
+    data = rng.uniform(1.0, 50.0, 128).astype(np.float32)
+    med, q = Median(), Quantile(q=0.5)
+    med.update(jnp.asarray(data))
+    q.update(jnp.asarray(data))
+    assert float(med.compute()) == float(q.compute())
+
+
+def test_distinct_count(rng):
+    true_n = 4000
+    keys = rng.choice(10**6, size=true_n, replace=False).astype(np.int32)
+    m = DistinctCount()
+    m.update(jnp.asarray(keys))
+    m.update(jnp.asarray(keys[:1000]))  # repeats must not inflate
+    sigma = m.sketch.error_bound()["value"]
+    assert abs(float(m.compute()) - true_n) / true_n < 4 * sigma
+
+
+def test_heavy_hitters(rng):
+    stream = np.concatenate([
+        np.full(5000, 42, np.int64),
+        np.full(3000, 7, np.int64),
+        rng.integers(0, 2**16, size=2000),
+    ])
+    rng.shuffle(stream)
+    m = HeavyHitters(threshold=0.1, max_hitters=4)
+    m.update(jnp.asarray(stream.astype(np.int32)))
+    out = m.compute()
+    found = {int(k): int(c) for k, c in zip(np.asarray(out["keys"]), np.asarray(out["counts"])) if c > 0}
+    assert 42 in found and 7 in found
+    assert found[42] >= 5000 and found[7] >= 3000
+
+
+def test_quantile_reset_and_reuse(rng):
+    m = Quantile(q=0.5)
+    m.update(jnp.asarray(rng.uniform(100.0, 200.0, 64), jnp.float32))
+    m.reset()
+    data = rng.uniform(1.0, 2.0, 64).astype(np.float32)
+    m.update(jnp.asarray(data))
+    exact = np.quantile(data, 0.5, method="inverted_cdf")
+    assert float(m.compute()) == pytest.approx(exact, rel=0.011)
+
+
+# --------------------------------------------------------------------------- #
+# AUROC sketch twin
+# --------------------------------------------------------------------------- #
+def _binary_scores(rng, n=4000):
+    target = (rng.uniform(size=n) < 0.4).astype(np.int32)
+    preds = np.clip(
+        rng.normal(0.35, 0.15, n) + 0.25 * target, 1e-4, 1.0
+    ).astype(np.float32)
+    return preds, target
+
+
+def test_auroc_sketch_matches_exact(rng):
+    preds, target = _binary_scores(rng)
+    exact, approx = AUROC(pos_label=1), AUROC(pos_label=1, approx="sketch")
+    for lo in range(0, len(preds), 500):
+        exact.update(jnp.asarray(preds[lo:lo + 500]), jnp.asarray(target[lo:lo + 500]))
+        approx.update(jnp.asarray(preds[lo:lo + 500]), jnp.asarray(target[lo:lo + 500]))
+    assert float(approx.compute()) == pytest.approx(float(exact.compute()), abs=5e-3)
+
+
+def test_auroc_sketch_state_is_fixed_size(rng):
+    m = AUROC(pos_label=1, approx="sketch")
+    preds, target = _binary_scores(rng, n=256)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    before = m.pos_scores.state_nbytes + m.neg_scores.state_nbytes
+    preds, target = _binary_scores(rng, n=4096)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    assert m.pos_scores.state_nbytes + m.neg_scores.state_nbytes == before
+
+
+def test_auroc_sketch_rejects_multiclass_and_max_fpr():
+    with pytest.raises(MetricsUserError):
+        AUROC(num_classes=3, approx="sketch")
+    with pytest.raises(MetricsUserError):
+        AUROC(approx="sketch", max_fpr=0.5)
+    with pytest.raises(ValueError):
+        AUROC(approx="nope")
+
+
+def test_auroc_sketch_state_dict_roundtrip(rng):
+    preds, target = _binary_scores(rng, n=512)
+    m1 = AUROC(pos_label=1, approx="sketch")
+    m1.update(jnp.asarray(preds), jnp.asarray(target))
+    m2 = AUROC(pos_label=1, approx="sketch")
+    m2.load_state_dict(m1.state_dict())
+    for name in ("pos_scores", "neg_scores"):
+        a, b = getattr(m1, name), getattr(m2, name)
+        for f, _ in a.sketch_fields:
+            np.testing.assert_array_equal(np.asarray(getattr(a, f)), np.asarray(getattr(b, f)))
+    assert float(m2.compute()) == float(m1.compute())
+
+
+def test_auroc_sketch_checkpoint_roundtrip(rng, tmp_path):
+    preds, target = _binary_scores(rng, n=512)
+    m1 = AUROC(pos_label=1, approx="sketch")
+    m1.update(jnp.asarray(preds), jnp.asarray(target))
+    save_checkpoint(m1, tmp_path).wait()
+    m2 = AUROC(pos_label=1, approx="sketch")
+    restore_checkpoint(m2, tmp_path)
+    assert float(m2.compute()) == float(m1.compute())
+
+
+def test_quantile_checkpoint_roundtrip(rng, tmp_path):
+    m1 = Quantile(q=[0.5, 0.9])
+    m1.update(jnp.asarray(rng.uniform(1.0, 100.0, 256), jnp.float32))
+    save_checkpoint(m1, tmp_path).wait()
+    m2 = Quantile(q=[0.5, 0.9])
+    restore_checkpoint(m2, tmp_path)
+    np.testing.assert_array_equal(np.asarray(m1.compute()), np.asarray(m2.compute()))
+
+
+def test_declared_tolerances_feed_the_gate():
+    # the PR-14 error-budget gate and PR-17 autotuner read these declarations;
+    # a sketch metric must declare its error bound as the sync tolerance
+    q = Quantile(q=0.5, relative_accuracy=0.02)
+    assert q.sync_tolerances["sketch"] == pytest.approx(0.02)
+    d = DistinctCount()
+    assert d.sync_tolerances["sketch"] == pytest.approx(d.sketch.error_bound()["value"])
+    a = AUROC(approx="sketch", relative_accuracy=0.015)
+    assert a.sync_tolerances["pos_scores"] == pytest.approx(0.015)
+    assert a.sync_tolerances["neg_scores"] == pytest.approx(0.015)

@@ -1,0 +1,204 @@
+"""Mergeable sketch primitives: error bounds, bitwise merge algebra, pytrees.
+
+The merge contract is the load-bearing one — every component reduction is a
+commutative, associative elementwise fold (sum/max/min of integer counts or
+extrema), so any shard/fold order produces *bitwise* identical state. That is
+what lets sketches ride the bucketed sync and incremental streaks with zero
+new distributed code.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.sketches import (
+    CountMinSketch,
+    DyadicCountMinSketch,
+    HyperLogLogSketch,
+    QuantileSketch,
+)
+from metrics_tpu.sketches.base import SKETCH_CLASSES, is_sketch
+
+
+def _bitwise_equal(a, b) -> bool:
+    return all(
+        np.array_equal(np.asarray(getattr(a, f)), np.asarray(getattr(b, f)))
+        for f, _ in a.sketch_fields
+    )
+
+
+ALL_SKETCHES = [QuantileSketch, HyperLogLogSketch, CountMinSketch, DyadicCountMinSketch]
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(1234)
+
+
+# --------------------------------------------------------------------------- #
+# shared contracts
+# --------------------------------------------------------------------------- #
+@pytest.mark.parametrize("cls", ALL_SKETCHES)
+def test_registered_and_marked(cls):
+    assert cls.__name__ in SKETCH_CLASSES
+    sk = cls()
+    assert is_sketch(sk)
+    assert sk.sketch_fields and all(r in ("sum", "max", "min") for _, r in sk.sketch_fields)
+
+
+@pytest.mark.parametrize("cls", ALL_SKETCHES)
+def test_pytree_roundtrip(cls, rng):
+    sk = cls().insert(jnp.asarray(rng.integers(0, 1000, 64), jnp.int32))
+    leaves, treedef = jax.tree_util.tree_flatten(sk)
+    rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert type(rebuilt) is cls
+    assert rebuilt.config_dict() == sk.config_dict()
+    assert _bitwise_equal(rebuilt, sk)
+
+
+@pytest.mark.parametrize("cls", ALL_SKETCHES)
+def test_config_roundtrip(cls):
+    sk = cls()
+    clone = type(sk).from_config(sk.config_dict())
+    assert clone.config_dict() == sk.config_dict()
+    # fresh components, same shapes/dtypes
+    for f, _ in sk.sketch_fields:
+        assert getattr(clone, f).shape == getattr(sk, f).shape
+        assert getattr(clone, f).dtype == getattr(sk, f).dtype
+
+
+@pytest.mark.parametrize("cls", ALL_SKETCHES)
+def test_state_nbytes_fixed(cls, rng):
+    sk = cls()
+    before = sk.state_nbytes
+    sk = sk.insert(jnp.asarray(rng.integers(0, 10**6, 4096), jnp.int32))
+    assert sk.state_nbytes == before  # bounded memory: inserts never grow state
+
+
+@pytest.mark.parametrize("cls", ALL_SKETCHES)
+def test_merge_bitwise_order_invariance(cls, rng):
+    parts = [
+        cls().insert(jnp.asarray(rng.integers(0, 500, 64), jnp.int32))
+        for _ in range(5)
+    ]
+    fwd = parts[0]
+    for p in parts[1:]:
+        fwd = fwd.merge(p)
+    rev = parts[-1]
+    for p in parts[-2::-1]:
+        rev = rev.merge(p)
+    # tree-shaped fold, different association
+    tree = parts[0].merge(parts[1]).merge(parts[2].merge(parts[3].merge(parts[4])))
+    assert _bitwise_equal(fwd, rev)
+    assert _bitwise_equal(fwd, tree)
+
+
+# --------------------------------------------------------------------------- #
+# quantile
+# --------------------------------------------------------------------------- #
+def test_quantile_relative_error_bound(rng):
+    data = rng.lognormal(mean=2.0, sigma=1.5, size=20000).astype(np.float32)
+    sk = QuantileSketch().insert(jnp.asarray(data))
+    qs = np.asarray([0.01, 0.25, 0.5, 0.75, 0.99], np.float32)
+    got = np.asarray(sk.quantile(jnp.asarray(qs)))
+    exact = np.quantile(data, qs, method="inverted_cdf")
+    gamma = sk.error_bound()["value"]
+    np.testing.assert_array_less(np.abs(got - exact) / exact, gamma + 1e-6)
+
+
+def test_quantile_merge_equals_whole_stream(rng):
+    data = rng.uniform(0.1, 100.0, size=512).astype(np.float32)
+    whole = QuantileSketch().insert(jnp.asarray(data))
+    merged = QuantileSketch().insert(jnp.asarray(data[:200])).merge(
+        QuantileSketch().insert(jnp.asarray(data[200:]))
+    )
+    assert _bitwise_equal(whole, merged)
+
+
+def test_quantile_drops_nonfinite_and_handles_empty():
+    sk = QuantileSketch()
+    assert np.isnan(np.asarray(sk.quantile(jnp.asarray(0.5))))
+    sk = sk.insert(jnp.asarray([np.nan, np.inf, -np.inf, 5.0], jnp.float32))
+    assert int(sk.count) == 1
+    assert np.asarray(sk.quantile(jnp.asarray(0.5))) == pytest.approx(5.0, rel=0.011)
+
+
+def test_quantile_negative_values(rng):
+    data = np.concatenate([
+        -rng.uniform(0.1, 50.0, 300), rng.uniform(0.1, 50.0, 300),
+    ]).astype(np.float32)
+    sk = QuantileSketch().insert(jnp.asarray(data))
+    qs = np.asarray([0.1, 0.5, 0.9], np.float32)
+    got = np.asarray(sk.quantile(jnp.asarray(qs)))
+    exact = np.quantile(data, qs, method="inverted_cdf")
+    np.testing.assert_allclose(got, exact, rtol=0.011, atol=1e-6)
+
+
+def test_quantile_clamped_to_observed_range():
+    sk = QuantileSketch().insert(jnp.asarray([3.0, 4.0, 5.0], jnp.float32))
+    assert float(sk.quantile(jnp.asarray(0.0))) >= 3.0 - 1e-6
+    assert float(sk.quantile(jnp.asarray(1.0))) <= 5.0 + 1e-6
+
+
+# --------------------------------------------------------------------------- #
+# hyperloglog
+# --------------------------------------------------------------------------- #
+@pytest.mark.parametrize("true_n", [100, 5000, 50000])
+def test_hll_cardinality_error(true_n, rng):
+    keys = rng.choice(10**7, size=true_n, replace=False).astype(np.int32)
+    # duplicates must not change the estimate
+    stream = np.concatenate([keys, keys[: true_n // 2]])
+    sk = HyperLogLogSketch().insert(jnp.asarray(stream))
+    est = float(sk.estimate())
+    sigma = sk.error_bound()["value"]
+    assert abs(est - true_n) / true_n < 4 * sigma
+
+
+def test_hll_merge_is_union(rng):
+    a_keys = np.arange(0, 3000, dtype=np.int32)
+    b_keys = np.arange(1500, 4500, dtype=np.int32)  # 50% overlap
+    a = HyperLogLogSketch().insert(jnp.asarray(a_keys))
+    b = HyperLogLogSketch().insert(jnp.asarray(b_keys))
+    union = HyperLogLogSketch().insert(jnp.asarray(np.concatenate([a_keys, b_keys])))
+    assert _bitwise_equal(a.merge(b), union)
+
+
+# --------------------------------------------------------------------------- #
+# count-min / heavy hitters
+# --------------------------------------------------------------------------- #
+def test_countmin_overestimates_only(rng):
+    keys = rng.integers(0, 2**15, size=8192).astype(np.int32)
+    sk = CountMinSketch().insert(jnp.asarray(keys))
+    uniq, true_counts = np.unique(keys, return_counts=True)
+    est = np.asarray(sk.query(jnp.asarray(uniq.astype(np.int32))))
+    assert np.all(est >= true_counts)  # one-sided error
+    # eps * N additive bound (e/width), generous slack for the small grid
+    eps = sk.error_bound()["value"]
+    assert np.mean(est - true_counts) <= 3 * eps * len(keys)
+
+
+def test_dyadic_heavy_hitters_finds_true_heavies(rng):
+    heavy = {7: 4000, 123: 2500, 9001: 1500}
+    tail = rng.integers(0, 2**16, size=2000).astype(np.int64)
+    stream = np.concatenate(
+        [np.full(n, k, np.int64) for k, n in heavy.items()] + [tail]
+    )
+    rng.shuffle(stream)
+    sk = DyadicCountMinSketch().insert(jnp.asarray(stream.astype(np.int32)))
+    keys, counts = sk.heavy_hitters(threshold=0.1, max_hitters=8)
+    keys, counts = np.asarray(keys), np.asarray(counts)
+    found = {int(k): int(c) for k, c in zip(keys, counts) if c > 0}
+    for k, n in heavy.items():
+        assert k in found, (k, found)
+        assert found[k] >= n  # count-min never undercounts
+    # sorted descending by estimated count
+    valid = counts[counts > 0]
+    assert np.all(valid[:-1] >= valid[1:])
+
+
+def test_jit_insert_matches_eager(rng):
+    data = jnp.asarray(rng.integers(0, 1000, 256), jnp.int32)
+    for cls in ALL_SKETCHES:
+        eager = cls().insert(data)
+        jitted = jax.jit(lambda s, x: s.insert(x))(cls(), data)
+        assert _bitwise_equal(eager, jitted), cls.__name__

@@ -1,0 +1,105 @@
+"""Sketch states under tenant stacking: parity, isolation, quantile reads.
+
+Sketches are fixed-size pytrees, so TenantSet stacks them like any other
+state — one vmapped executable over the tenant axis, no per-tenant
+recompiles. These tests pin per-tenant isolation (one tenant's inserts never
+leak into another's sketch), parity with an unstacked metric, export/import
+roundtrips, and the ``read_quantiles`` read path the serve endpoint uses.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import DistinctCount, Quantile, TenantSet
+from metrics_tpu.utils.exceptions import MetricsUserError
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(21)
+
+
+def _feed(ts, tenant_rows):
+    for tid, rows in tenant_rows.items():
+        for row in rows:
+            ts.apply_batch([tid], (jnp.asarray(row)[None],), auto_admit=True)
+
+
+def test_stacked_quantile_parity_and_isolation(rng):
+    ts = TenantSet(Quantile(q=0.5), capacity=4)
+    tenant_rows = {
+        "lo": rng.uniform(1.0, 10.0, (4, 32)).astype(np.float32),
+        "hi": rng.uniform(100.0, 1000.0, (4, 32)).astype(np.float32),
+    }
+    _feed(ts, tenant_rows)
+    out = ts.compute(["lo", "hi"])
+    for tid, rows in tenant_rows.items():
+        oracle = Quantile(q=0.5)
+        for row in rows:
+            oracle.update(jnp.asarray(row))
+        got = float(out[tid]["Quantile"])
+        assert got == pytest.approx(float(oracle.compute()), abs=1e-6), tid
+    # isolation: the tenants' value ranges must not bleed into each other
+    assert float(out["lo"]["Quantile"]) < 11.0 < 99.0 < float(out["hi"]["Quantile"])
+
+
+def test_stacked_distinct_count_parity(rng):
+    ts = TenantSet(DistinctCount(), capacity=4)
+    keys = {
+        "a": rng.choice(10**6, size=(2, 256), replace=False).astype(np.int32),
+        "b": rng.choice(10**6, size=(2, 64), replace=False).astype(np.int32),
+    }
+    _feed(ts, keys)
+    out = ts.compute(["a", "b"])
+    for tid, rows in keys.items():
+        oracle = DistinctCount()
+        for row in rows:
+            oracle.update(jnp.asarray(row))
+        assert float(out[tid]["DistinctCount"]) == pytest.approx(
+            float(oracle.compute()), abs=1e-6
+        ), tid
+
+
+def test_export_import_roundtrip(rng):
+    ts = TenantSet(Quantile(q=0.5), capacity=4)
+    data = rng.uniform(1.0, 100.0, (3, 64)).astype(np.float32)
+    _feed(ts, {"src": data})
+    snapshot = ts.export_tenant("src")
+    ts2 = TenantSet(Quantile(q=0.5), capacity=4)
+    ts2.import_tenant("dst", snapshot)
+    a = float(ts.compute(["src"])["src"]["Quantile"])
+    b = float(ts2.compute(["dst"])["dst"]["Quantile"])
+    assert a == b
+
+
+def test_read_quantiles(rng):
+    ts = TenantSet(Quantile(q=0.5), capacity=4)
+    data = rng.uniform(1.0, 100.0, (8, 64)).astype(np.float32)
+    _feed(ts, {"t": data})
+    qs = [0.1, 0.5, 0.99]
+    got = ts.read_quantiles("t", qs)
+    assert set(got) == {"Quantile"}
+    exact = np.quantile(data.ravel(), qs, method="inverted_cdf")
+    np.testing.assert_allclose(got["Quantile"], exact, rtol=0.011)
+    # any quantile evaluates from the same state — not just the ctor's q
+    (p25,) = ts.read_quantiles("t", [0.25])["Quantile"]
+    assert p25 == pytest.approx(
+        float(np.quantile(data.ravel(), 0.25, method="inverted_cdf")), rel=0.011
+    )
+
+
+def test_read_quantiles_rejects_bad_input(rng):
+    ts = TenantSet(Quantile(q=0.5), capacity=2)
+    _feed(ts, {"t": rng.uniform(1.0, 2.0, (1, 8)).astype(np.float32)})
+    with pytest.raises(MetricsUserError):
+        ts.read_quantiles("missing", [0.5])
+    with pytest.raises(MetricsUserError):
+        ts.read_quantiles("t", [1.5])
+    with pytest.raises(MetricsUserError):
+        ts.read_quantiles("t", [])
+
+
+def test_read_quantiles_skips_sketchless_metrics(rng):
+    ts = TenantSet(DistinctCount(), capacity=2)
+    _feed(ts, {"t": rng.integers(0, 100, (1, 16)).astype(np.int32)})
+    assert ts.read_quantiles("t", [0.5]) == {}
