@@ -1293,20 +1293,38 @@ def _split_throughput(d, key="samples_per_sec"):
 
 
 def bench_analysis() -> None:
-    """``--analysis``: run the static analyzer (``metrics_tpu.analysis``) over
-    the registered metric universe and record wall time + per-rule hit counts
-    into ``BENCH_r09.json`` (one JSON line on stdout, same shape)."""
+    """``--analysis``: run the full three-stage analyzer (AST lint,
+    abstract-eval sweep, stage-3 cost model) over the registered metric
+    universe and record wall time plus the live manifest's aggregate resource
+    totals — collectives, wire/state/copied bytes, recompile risks — into
+    ``BENCH_r24.json`` (one JSON line on stdout, same shape), judged by the
+    regression watchdog so manifest-level byte growth shows up as a bench
+    regression too, not only as the ``--manifest --diff`` CI gate."""
+    import glob as _glob
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")  # host-only: axis_env mock mesh
+    from metrics_tpu.analysis import manifest as _manifest
     from metrics_tpu.analysis import run_analysis
     from metrics_tpu.analysis.rules import INFO, WARNING
+    from metrics_tpu.observability import regress as _regress
 
     t0 = time.perf_counter()
     report = run_analysis()
     wall_s = time.perf_counter() - t0
+
+    totals = dict(report.manifest["totals"])
+    committed = _manifest.load_manifest()
+    diff_regressions = None
+    if committed is not None:
+        records = _manifest.diff_manifest(committed, report.manifest)
+        diff_regressions = len(_manifest.gate_failures(records))
+
     record = {
-        "metric": "analysis_wall_s",
+        # three-stage headline (its own key: the two-stage r09 wall time is
+        # not a comparable baseline for a run that also builds the manifest)
+        "metric": "analysis_manifest_wall_s",
         "value": round(wall_s, 3),
         "unit": "s",
         "extra": {
@@ -1318,9 +1336,38 @@ def bench_analysis() -> None:
             "suppressed": sum(1 for f in report.findings if f.suppressed),
             "by_rule": report.by_rule(),
             "eval_skipped": len(report.skipped),
+            # the watched *_bytes keys make the watchdog track resource
+            # aggregates round-over-round alongside the diff gate
+            "manifest": {
+                "profiled": totals["profiled"],
+                "skipped": totals["skipped"],
+                "collectives": totals["collectives"],
+                "state_bytes": totals["state_bytes"],
+                "wire_bytes": totals["wire_bytes"],
+                "copied_bytes": totals["copied_bytes"],
+                "recompile_risks": totals["recompile_risks"],
+                "incremental_eligible_leaves": totals["incremental_eligible_leaves"],
+            },
+            "manifest_diff_regressions": diff_regressions,
         },
     }
-    with open(os.path.join(REPO, "BENCH_r09.json"), "w") as fh:
+
+    # watchdog self-check: judge this round against the checked-in trajectory
+    rounds = [
+        r
+        for r in _regress.load_rounds(sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json"))))
+        if r.name != "r24"
+    ]
+    rounds.append(_regress.Round("r24", "<this-run>", record))
+    regress_report = _regress.check_trajectory(rounds)
+    record["extra"]["regress"] = {
+        "ok": regress_report.ok,
+        "regression_count": len(regress_report.regressions),
+        "keys_checked": regress_report.keys_checked,
+        "regressions": [r.describe() for r in regress_report.regressions],
+    }
+
+    with open(os.path.join(REPO, "BENCH_r24.json"), "w") as fh:
         json.dump(record, fh, indent=1)
         fh.write("\n")
     print(json.dumps(record), flush=True)
@@ -4399,8 +4446,8 @@ def main() -> None:
     parser.add_argument(
         "--analysis",
         action="store_true",
-        help="run the metrics_tpu.analysis static analyzer and record wall "
-        "time + per-rule hit counts into BENCH_r09.json",
+        help="run the three-stage metrics_tpu.analysis analyzer and record "
+        "wall time + manifest resource aggregates into BENCH_r24.json",
     )
     parser.add_argument(
         "--observability",

@@ -454,8 +454,27 @@ ANALYSIS_SPECS = {
     "CatMetric": {"init": {"buffer_capacity": 32}, "inputs": [("float32", (8,))]},
     "MaxMetric": {"inputs": [("float32", (8,))]},
     "MinMetric": {"inputs": [("float32", (8,))]},
-    "SumMetric": {"inputs": [("float32", (8,))]},
-    "MeanMetric": {"inputs": [("float32", (8,)), ("float32", (8,))]},
+    "SumMetric": {
+        "inputs": [("float32", (8,))],
+        # a single scalar accumulator: the cheapest profile in the registry
+        "cost_budget": {
+            "flops_per_step": 128,
+            "state_bytes": 16,
+            "collectives": 1,
+            "wire_bytes": 32,
+            "copied_bytes": 0,
+            "recompile_risks": 0,
+        },
+    },
+    "MeanMetric": {
+        "inputs": [("float32", (8,)), ("float32", (8,))],
+        "cost_budget": {
+            "flops_per_step": 128,
+            "collectives": 2,
+            "copied_bytes": 0,
+            "recompile_risks": 0,
+        },
+    },
     "Quantile": {"inputs": [("float32", (8,))]},
     "Median": {"inputs": [("float32", (8,))]},
     "DistinctCount": {"inputs": [("int32", (8,))]},

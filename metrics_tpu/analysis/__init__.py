@@ -5,9 +5,14 @@ registered metric's jit-facing methods (host round-trips, data-dependent
 control flow, hidden state writes, bare-scalar state, mutable-global
 closures), stage 2 an abstract-eval sweep (``jax.eval_shape`` /
 ``jax.make_jaxpr`` under a mock 8-device mesh) asserting treedef, aval and
-donation stability plus a trace-time collective budget. Run it as::
+donation stability plus a trace-time collective budget, and stage 3 a static
+cost model (:mod:`metrics_tpu.analysis.costmodel`) deriving a deterministic
+resource profile per metric — FLOPs, state bytes, donation aliasing,
+collective counts, per-transport wire bytes — diffed against the committed
+``analysis_manifest.json``. Run it as::
 
     python -m metrics_tpu.analysis [--json] [--strict]
+    python -m metrics_tpu.analysis --manifest [--write | --diff]
 
 See ``docs/static_analysis.md`` for the rule catalog and suppression syntax.
 """
@@ -30,6 +35,8 @@ __all__ = [
     "audit_paths",
 ]
 
+DEFAULT_STAGES = ("ast", "eval", "cost")
+
 
 @dataclass
 class Report:
@@ -39,6 +46,7 @@ class Report:
     skipped: Dict[str, str] = field(default_factory=dict)
     notes: Dict[str, List[str]] = field(default_factory=dict)
     elapsed_s: float = 0.0
+    manifest: Optional[Dict[str, Any]] = None   # stage-3 live build
 
     def active(self) -> List[Finding]:
         return [f for f in self.findings if not f.suppressed]
@@ -57,7 +65,7 @@ class Report:
         return dict(sorted(out.items()))
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        d = {
             "findings": [f.to_dict() for f in sorted(self.findings, key=Finding.sort_key)],
             "summary": {
                 "classes": self.classes,
@@ -71,10 +79,81 @@ class Report:
             },
             "elapsed_s": round(self.elapsed_s, 4),
         }
+        if self.manifest is not None:
+            d["summary"]["manifest_totals"] = dict(self.manifest.get("totals", {}))
+        return d
+
+
+def _validate_spec_allows(entries: List["registry.Entry"]) -> List[Finding]:
+    """A009 over declarative suppressions: unknown rule ids in ANALYSIS_SPECS
+    ``allow`` tuples, unknown drift kinds in ``manifest_allow`` waivers,
+    unknown field names in ``cost_budget`` caps."""
+    from metrics_tpu.analysis import costmodel
+    from metrics_tpu.analysis.manifest import DRIFT_KINDS
+
+    findings: List[Finding] = []
+    for entry in entries:
+        if entry.spec is None:
+            continue
+        for rule_id in entry.allow:
+            if rule_id not in RULES:
+                findings.append(
+                    Finding(
+                        rule="A009",
+                        obj=f"{entry.name}.ANALYSIS_SPECS",
+                        message=f"allow names unknown rule id {rule_id!r} — it suppresses "
+                        f"nothing (see --list-rules for the catalog)",
+                        extra={"unknown": rule_id, "where": "allow"},
+                    )
+                )
+        for kind in entry.manifest_allow:
+            if kind not in DRIFT_KINDS:
+                findings.append(
+                    Finding(
+                        rule="A009",
+                        obj=f"{entry.name}.ANALYSIS_SPECS",
+                        message=f"manifest_allow names unknown drift kind {kind!r}; known "
+                        f"kinds: {', '.join(DRIFT_KINDS)}",
+                        extra={"unknown": kind, "where": "manifest_allow"},
+                    )
+                )
+        for key in entry.cost_budget:
+            if key not in costmodel.BUDGET_KEYS:
+                findings.append(
+                    Finding(
+                        rule="A009",
+                        obj=f"{entry.name}.ANALYSIS_SPECS",
+                        message=f"cost_budget names unknown profile field {key!r}; known "
+                        f"fields: {', '.join(costmodel.BUDGET_KEYS)}",
+                        extra={"unknown": key, "where": "cost_budget"},
+                    )
+                )
+    return findings
+
+
+def _validate_module_spec_allows(
+    module_specs: Dict[str, Dict[str, Any]]
+) -> List[Finding]:
+    """A009 over ANALYSIS_MODULE_SPECS ``allow`` tuples."""
+    findings: List[Finding] = []
+    for path, spec in sorted(module_specs.items()):
+        for rule_id in spec.get("allow", ()):
+            if rule_id not in RULES:
+                findings.append(
+                    Finding(
+                        rule="A009",
+                        obj=path,
+                        message=f"ANALYSIS_MODULE_SPECS allow names unknown rule id "
+                        f"{rule_id!r} — it suppresses nothing",
+                        file=path,
+                        extra={"unknown": rule_id, "where": "module_allow"},
+                    )
+                )
+    return findings
 
 
 def run_analysis(
-    stages: Sequence[str] = ("ast", "eval"),
+    stages: Sequence[str] = DEFAULT_STAGES,
     budget_cap: Optional[int] = None,
 ) -> Report:
     """Run the analyzer over the registered metric universe."""
@@ -92,7 +171,15 @@ def run_analysis(
             init_findings[entry.name] = f
     universe = registry.state_name_universe(entries)
 
+    # A009 over declarative suppressions runs in every stage mix — typos in
+    # allow/manifest_allow/cost_budget silently disarm the other rules
+    report.findings.extend(_validate_spec_allows(entries))
+    report.findings.extend(
+        _validate_module_spec_allows(registry.collect_module_specs())
+    )
+
     if "ast" in stages:
+        seen_modules: set = set()
         for cls in registry.lintable_classes(entries):
             entry = registry.spec_for_class(entries, cls)
             state_names = known_attrs = None
@@ -116,6 +203,10 @@ def run_analysis(
                 )
             )
             report.linted_classes += 1
+            ctx = ast_stage.module_context_for(cls)
+            if ctx is not None and ctx.filename not in seen_modules:
+                seen_modules.add(ctx.filename)
+                report.findings.extend(ast_stage.validate_suppression_ids(ctx))
 
     if "eval" in stages:
         for entry in entries:
@@ -133,15 +224,36 @@ def run_analysis(
         # still surface constructor failures discovered while probing
         report.findings.extend(init_findings.values())
 
+    if "cost" in stages:
+        # stage 3: build the live manifest (re-using stage-2 trace artifacts
+        # when the eval stage ran), bill E117 budget overruns, and — when a
+        # committed manifest exists — surface drift as E118
+        from metrics_tpu.analysis import costmodel, manifest as manifest_mod
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            report.manifest = manifest_mod.build_manifest(entries)
+        report.findings.extend(
+            costmodel.cost_budget_findings(entries, report.manifest["metrics"])
+        )
+        committed = manifest_mod.load_manifest()
+        if committed is not None:
+            records = manifest_mod.diff_manifest(
+                committed, report.manifest, manifest_mod.collect_waivers(entries)
+            )
+            report.findings.extend(manifest_mod.drift_findings(records, entries))
+
     report.findings.sort(key=Finding.sort_key)
     report.elapsed_s = time.perf_counter() - t0
     return report
 
 
 def audit_paths(paths: Sequence[str]) -> Report:
-    """``--paths`` mode: scan arbitrary files for direct metric-state reads
-    (A006, the fused-streak staleness caveat) and host-clock / tracer-emit
-    calls (A007), statically.
+    """``--paths`` mode: scan arbitrary files with the full A-rule set —
+    foreign metric-state reads (A006, the fused-streak staleness caveat),
+    host-clock / tracer-emit calls (A007), swallowing handlers (A008),
+    unknown suppression ids (A009), and — for any class defining jit-facing
+    method names — the per-method taint lint (A001–A005), statically.
 
     Files named in an ``ANALYSIS_MODULE_SPECS`` dict (collected from
     :data:`registry.MODULE_SPEC_SOURCES`) get the spec's ``allow`` rules
@@ -154,6 +266,7 @@ def audit_paths(paths: Sequence[str]) -> Report:
         eval_stage.instantiate(entry)
     universe = registry.state_name_universe(entries)
     module_specs = registry.collect_module_specs()
+    report.findings.extend(_validate_module_spec_allows(module_specs))
     for path in paths:
         with open(path, "r") as fh:
             source = fh.read()

@@ -1,9 +1,16 @@
 """``python -m metrics_tpu.analysis`` — CLI for the trace-safety analyzer.
 
 Exit codes: 0 = clean (or only warnings/info), 1 = unsuppressed errors under
-``--strict``, 2 = the analyzer itself failed. Runs entirely on the host: the
-mock 8-device mesh is an ``axis_env`` trace, so no accelerator (or XLA device
-flag) is needed.
+``--strict`` or unexplained manifest drift under ``--manifest --diff``,
+2 = the analyzer itself failed (including ``--diff`` with no committed
+manifest to diff against). Runs entirely on the host: the mock 8-device mesh
+is an ``axis_env`` trace, so no accelerator (or XLA device flag) is needed.
+
+Manifest workflow (stage 3)::
+
+    python -m metrics_tpu.analysis --manifest             # print canonical JSON
+    python -m metrics_tpu.analysis --manifest --write     # refresh the ledger
+    python -m metrics_tpu.analysis --manifest --diff      # gate: exit 1 on drift
 """
 from __future__ import annotations
 
@@ -39,6 +46,55 @@ def _print_human(report: Report, show_suppressed: bool) -> None:
     )
 
 
+def _run_manifest(args) -> int:
+    from metrics_tpu.analysis import manifest as manifest_mod
+    from metrics_tpu.analysis import registry
+
+    path = args.manifest_path or manifest_mod.manifest_path()
+    entries = registry.build_registry()
+    live = manifest_mod.build_manifest(entries)
+
+    if args.write:
+        out = manifest_mod.write_manifest(live, path)
+        totals = live["totals"]
+        print(
+            f"wrote {out} ({totals['profiled']}/{totals['metrics']} metrics "
+            f"profiled, {totals['collectives']} collectives, "
+            f"{totals['wire_bytes']} wire bytes)"
+        )
+        return 0
+
+    if args.diff:
+        committed = manifest_mod.load_manifest(path)
+        if committed is None:
+            print(f"no committed manifest at {path} — run --manifest --write first",
+                  file=sys.stderr)
+            return 2
+        records = manifest_mod.diff_manifest(
+            committed, live, manifest_mod.collect_waivers(entries)
+        )
+        failures = manifest_mod.gate_failures(records)
+        if args.json:
+            print(json.dumps(
+                {"drift": records, "regressions": len(failures)},
+                indent=2, sort_keys=True,
+            ))
+        else:
+            for rec in records:
+                tag = "drift" if rec["regression"] else "note "
+                waived = " [waived]" if rec["waived"] else ""
+                print(f"{tag} {rec['kind']} {rec['obj']}{waived}")
+                print(f"      {rec['detail']}")
+            print(
+                f"== {len(records)} drift record(s), "
+                f"{len(failures)} unexplained regression(s)"
+            )
+        return 1 if failures else 0
+
+    print(manifest_mod.canonical_dumps(live), end="")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m metrics_tpu.analysis",
@@ -49,13 +105,14 @@ def main(argv=None) -> int:
         "--strict", action="store_true", help="exit 1 on any unsuppressed error finding"
     )
     parser.add_argument(
-        "--stage", choices=("ast", "eval", "all"), default="all", help="run one stage only"
+        "--stage", choices=("ast", "eval", "cost", "all"), default="all",
+        help="run one stage only",
     )
     parser.add_argument(
         "--paths",
         nargs="+",
         metavar="FILE",
-        help="audit arbitrary Python files for direct metric-state reads (A006) "
+        help="audit arbitrary Python files with the full A-rule set "
         "instead of analyzing the registry",
     )
     parser.add_argument(
@@ -63,6 +120,24 @@ def main(argv=None) -> int:
         type=int,
         default=None,
         help="absolute per-metric trace-time collective cap (tightens the canonical budget)",
+    )
+    parser.add_argument(
+        "--manifest", action="store_true",
+        help="build the stage-3 static cost manifest; alone prints it, "
+        "--write commits it to disk, --diff gates against the committed copy",
+    )
+    parser.add_argument(
+        "--write", action="store_true",
+        help="with --manifest: write analysis_manifest.json (canonical bytes)",
+    )
+    parser.add_argument(
+        "--diff", action="store_true",
+        help="with --manifest: diff the live build against the committed "
+        "manifest and exit 1 on unexplained regressions",
+    )
+    parser.add_argument(
+        "--manifest-path", default=None, metavar="PATH",
+        help="override the manifest location (default: repo-root analysis_manifest.json)",
     )
     parser.add_argument(
         "--show-suppressed", action="store_true", help="include suppressed findings in output"
@@ -75,11 +150,18 @@ def main(argv=None) -> int:
             print(f"{rule.id} [{rule.severity}] {rule.name}\n      {rule.summary}")
         return 0
 
+    if args.write and args.diff:
+        parser.error("--write and --diff are mutually exclusive")
+    if (args.write or args.diff) and not args.manifest:
+        parser.error("--write/--diff require --manifest")
+
     try:
+        if args.manifest:
+            return _run_manifest(args)
         if args.paths:
             report = audit_paths(args.paths)
         else:
-            stages = ("ast", "eval") if args.stage == "all" else (args.stage,)
+            stages = ("ast", "eval", "cost") if args.stage == "all" else (args.stage,)
             report = run_analysis(stages=stages, budget_cap=args.budget)
     except Exception as e:  # noqa: BLE001 — analyzer crash is exit 2, not a finding
         print(f"analysis failed: {type(e).__name__}: {e}", file=sys.stderr)

@@ -21,7 +21,7 @@ import inspect
 import textwrap
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple, Type
 
-from metrics_tpu.analysis.rules import Finding, parse_suppressions
+from metrics_tpu.analysis.rules import RULES, Finding, parse_suppressions
 
 # methods that run under jit in the compiled engines (or feed them)
 LINT_METHODS = ("update", "compute", "update_state", "compute_state", "sync_states", "sync_compute_state")
@@ -619,6 +619,30 @@ def lint_class(
     return findings
 
 
+def validate_suppression_ids(ctx: ModuleContext) -> List[Finding]:
+    """A009: inline ``# metrics-tpu: allow[...]`` comments naming rule ids
+    the analyzer does not define. Runs once per module (both in the registry
+    sweep and in audit mode) — a typo like ``allow[A01]`` suppresses nothing
+    while reading as if it did."""
+    findings: List[Finding] = []
+    for line, ids in sorted(ctx.suppressions.items()):
+        for rule_id in ids:
+            if rule_id in RULES:
+                continue
+            findings.append(
+                Finding(
+                    rule="A009",
+                    obj=ctx.filename,
+                    message=f"inline suppression names unknown rule id {rule_id!r} — "
+                    "it suppresses nothing (see --list-rules for the catalog)",
+                    file=ctx.filename,
+                    line=line,
+                    extra={"unknown": rule_id, "where": "inline"},
+                )
+            )
+    return findings
+
+
 def _root_name_of(node: ast.AST) -> Optional[str]:
     while isinstance(node, ast.Attribute):
         node = node.value
@@ -717,17 +741,79 @@ def _audit_clock_findings(ctx: ModuleContext) -> List[Finding]:
     return findings
 
 
+def _audit_class_findings(ctx: ModuleContext, global_state_names: Set[str]) -> List[Finding]:
+    """Audit-mode class lint: every class in the file that defines a method
+    with a jit-facing protocol name gets the full per-method taint walk
+    (A001–A006, A008) plus the add_state default check (A004). State names
+    and ``__init__`` attrs come from the source (no probe instance exists in
+    audit mode); infra classes that legitimately run host-side carry an
+    ``ANALYSIS_MODULE_SPECS`` exemption instead of being skipped silently."""
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        methods = [
+            m for m in node.body
+            if isinstance(m, ast.FunctionDef) and m.name in LINT_METHODS
+        ]
+        if not methods:
+            continue
+        state = _addstate_names(node)
+        known = _init_attr_names(node)
+        findings.extend(_lint_addstate_defaults(ctx, node))
+        for m in methods:
+            linter = _MethodLinter(
+                ctx, node.name, m, state, known, global_state_names, host_inputs=False
+            )
+            findings.extend(linter.lint())
+    return findings
+
+
+def _audit_global_findings(ctx: ModuleContext) -> List[Finding]:
+    """File-wide A005 sweep for audit mode: every ``global`` declaration
+    inside a function body, wherever it appears."""
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Global):
+            findings.append(
+                Finding(
+                    rule="A005",
+                    obj=ctx.filename,
+                    message=f"`global {', '.join(node.names)}` — hidden cross-call state; "
+                    "if this module is host-side by design, exempt it via "
+                    "ANALYSIS_MODULE_SPECS with a reason",
+                    file=ctx.filename,
+                    line=node.lineno,
+                )
+            )
+    return findings
+
+
 def lint_source(filename: str, source: str, global_state_names: Set[str]) -> List[Finding]:
-    """Audit mode (``--paths``): scan arbitrary code for foreign-state reads
-    (A006) — the ROADMAP's stale-member-state caveat — for host-clock /
-    tracer-emit calls (A007, file-wide; see :func:`_audit_clock_findings`),
-    and for swallowing exception handlers (A008, bare/``BaseException`` only;
-    see :func:`_audit_except_findings`)."""
+    """Audit mode (``--paths``): the full A-rule set over arbitrary code —
+    the per-method taint lint for any class defining jit-facing method names
+    (A001–A005, A008; see :func:`_audit_class_findings`), foreign-state reads
+    (A006, the ROADMAP's stale-member-state caveat), host-clock / tracer-emit
+    calls (A007, file-wide), swallowing exception handlers (A008,
+    bare/``BaseException`` only file-wide), ``global`` declarations (A005,
+    file-wide) and unknown inline suppression ids (A009). Findings the class
+    lint already produced win over the file-wide sweeps at the same
+    (rule, line)."""
     try:
         ctx = ModuleContext(filename, textwrap.dedent(source))
     except SyntaxError as err:
         return [Finding(rule="A006", obj=filename, message=f"unparseable: {err}", file=filename, suppressed=True)]
-    findings: List[Finding] = []
+    findings: List[Finding] = list(_audit_class_findings(ctx, global_state_names))
+    seen = {(f.rule, f.line) for f in findings}
+
+    def _add(batch: List[Finding]) -> None:
+        for f in batch:
+            if (f.rule, f.line) in seen:
+                continue
+            seen.add((f.rule, f.line))
+            findings.append(f)
+
+    a006: List[Finding] = []
     for node in ast.walk(ctx.tree):
         if not (isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load)):
             continue
@@ -738,7 +824,7 @@ def lint_source(filename: str, source: str, global_state_names: Set[str]) -> Lis
             continue
         if not isinstance(base, (ast.Name, ast.Attribute)):
             continue
-        findings.append(
+        a006.append(
             Finding(
                 rule="A006",
                 obj=filename,
@@ -749,8 +835,11 @@ def lint_source(filename: str, source: str, global_state_names: Set[str]) -> Lis
                 line=node.lineno,
             )
         )
-    findings.extend(_audit_clock_findings(ctx))
-    findings.extend(_audit_except_findings(ctx))
+    _add(a006)
+    _add(_audit_clock_findings(ctx))
+    _add(_audit_except_findings(ctx))
+    _add(_audit_global_findings(ctx))
+    _add(validate_suppression_ids(ctx))
     for f in findings:
         if f.line is not None and f.rule in ctx.suppressions.get(f.line, ()):
             f.suppressed = True

@@ -1,0 +1,607 @@
+"""Stage 3 — static resource cost model over the registered metric universe.
+
+Stage 2 already traces every metric's pure protocol under the mock 8-device
+mesh (``jax.eval_shape`` / ``jax.make_jaxpr(..., axis_env=[("data", 8)])``)
+and then throws the jaxprs away. This stage walks them instead and derives a
+**deterministic** per-metric resource profile — no accelerator, no timing, no
+randomness, so two runs on the same tree are byte-identical:
+
+* ``flops_per_step`` — static FLOP estimate of one ``update_state`` step at
+  the spec's canonical input shapes (jaxpr walk: elementwise primitives bill
+  one op per output element, ``dot_general`` bills ``2·M·N·K``, reductions
+  bill their input extent, ``scan`` multiplies its body by the trip count);
+* ``finalize_flops`` — the same estimate for the fused
+  ``sync_states ∘ compute_state`` finalize under the mock mesh;
+* ``state_bytes`` — peak live bytes of the steady-state pytree;
+* ``donation`` — bytes the compiled engines' ``donate_argnums`` can alias
+  in-place across a streak vs bytes XLA silently copies (a shape/dtype
+  mismatch between streak input and output at the same tree position);
+* ``collectives`` — trace-time collective count / per-kind breakdown of
+  ``sync_states`` (:func:`metrics_tpu.parallel.sync.count_collectives`);
+* ``buckets`` / ``wire`` — the per-(reduction, dtype, transport) sync buckets
+  with analytic per-device wire bytes (``transport_plan`` — the PR-14
+  error-budget gate's own bound math, sketch components decomposed);
+* ``wire_ladder`` — post-gate wire bytes if every state requested each
+  quantized rung (exact/bf16/int8): the statically-admissible saving;
+* ``incremental`` — emission eligibility per leaf (``incremental_plan``);
+* ``recompile_risks`` — aval drifts + weak-type flips + treedef drift across
+  the simulated streak: each one is a silent recompile of the cached
+  executable.
+
+Everything here is pure planning over abstract values; profiles re-use the
+trace artifacts the eval stage leaves on each :class:`Entry` when stage 2 ran
+first, and re-derive them when stage 3 runs standalone.
+"""
+from __future__ import annotations
+
+import math
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.analysis.eval_stage import (
+    AXIS,
+    WORLD,
+    _aval,
+    _err,
+    _leaf_paths,
+    _materialize,
+    _materialize_kwargs,
+    _sub_jaxprs,
+    instantiate,
+)
+from metrics_tpu.analysis.registry import Entry
+from metrics_tpu.analysis.rules import Finding
+from metrics_tpu.parallel import sync as _sync
+
+# the wire_ladder's rungs: sparse_count is shape-dependent enough that a
+# blanket "what if everything went sparse" number would mislead more than help
+LADDER = ("exact", "bf16", "int8")
+
+
+# --------------------------------------------------------------------------- #
+# FLOP estimation — a deterministic jaxpr walk
+# --------------------------------------------------------------------------- #
+# primitives billed at one op per *output* element
+_ELEMENTWISE_PRIMS = frozenset({
+    "abs", "add", "and", "atan2", "cbrt", "ceil", "clamp", "cos", "cosh",
+    "div", "eq", "erf", "erf_inv", "erfc", "exp", "exp2", "expm1", "floor",
+    "ge", "gt", "integer_pow", "is_finite", "le", "log", "log1p", "logistic",
+    "lt", "max", "min", "mul", "ne", "neg", "nextafter", "not", "or", "pow",
+    "rem", "round", "rsqrt", "select_n", "shift_left",
+    "shift_right_arithmetic", "shift_right_logical", "sign", "sin", "sinh",
+    "sqrt", "square", "sub", "tan", "tanh", "xor",
+})
+
+# primitives billed at one op per *input* element (they collapse or scan it)
+_REDUCTION_PRIMS = frozenset({
+    "argmax", "argmin", "cumlogsumexp", "cummax", "cummin", "cumprod",
+    "cumsum", "reduce_and", "reduce_max", "reduce_min", "reduce_or",
+    "reduce_prod", "reduce_sum", "reduce_xor",
+})
+
+# scatter family: one op per element of the updates operand
+_SCATTER_PRIMS = frozenset({
+    "scatter", "scatter-add", "scatter_add", "scatter_max", "scatter_min",
+    "scatter_mul",
+})
+
+
+def _nelems(aval: Any) -> int:
+    size = 1
+    for d in getattr(aval, "shape", ()) or ():
+        size *= int(d)
+    return size
+
+
+def _eqn_flops(eqn: Any) -> int:
+    name = eqn.primitive.name
+    if name == "dot_general":
+        (lhs_contract, _), _ = eqn.params["dimension_numbers"]
+        lhs_shape = tuple(eqn.invars[0].aval.shape)
+        contract = 1
+        for d in lhs_contract:
+            contract *= int(lhs_shape[d])
+        return 2 * _nelems(eqn.outvars[0].aval) * contract
+    if name == "conv_general_dilated":
+        # 2 · out_elements · (kernel footprint per output element)
+        out = _nelems(eqn.outvars[0].aval)
+        rhs = _nelems(eqn.invars[1].aval)
+        out_ch = 1
+        rhs_shape = tuple(eqn.invars[1].aval.shape)
+        if rhs_shape:
+            out_ch = max(1, int(max(rhs_shape)))
+        return 2 * out * max(1, rhs // out_ch)
+    if name in _REDUCTION_PRIMS:
+        return _nelems(eqn.invars[0].aval)
+    if name in _SCATTER_PRIMS:
+        idx = 2 if len(eqn.invars) > 2 else len(eqn.invars) - 1
+        return _nelems(eqn.invars[idx].aval)
+    if name in ("sort", "top_k"):
+        n = _nelems(eqn.invars[0].aval)
+        return n * max(1, int(math.ceil(math.log2(max(n, 2)))))
+    if name in _ELEMENTWISE_PRIMS:
+        return sum(_nelems(v.aval) for v in eqn.outvars)
+    return 0  # casts, reshapes, gathers, collectives: data movement, not FLOPs
+
+
+def flops_of_jaxpr(jaxpr: Any) -> int:
+    """Deterministic static FLOP estimate of a jaxpr, recursing through
+    pjit/closed-call bodies; ``scan`` multiplies its body by the static trip
+    count, ``cond`` bills the most expensive branch, ``while`` bills one
+    iteration (a static lower bound — trip counts are value-dependent)."""
+    total = 0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        subs = list(_sub_jaxprs(eqn.params))
+        if subs:
+            if name == "cond":
+                total += max((flops_of_jaxpr(s) for s in subs), default=0)
+            elif name == "scan":
+                length = int(eqn.params.get("length", 1) or 1)
+                total += length * sum(flops_of_jaxpr(s) for s in subs)
+            else:
+                total += sum(flops_of_jaxpr(s) for s in subs)
+        else:
+            total += _eqn_flops(eqn)
+    return total
+
+
+# --------------------------------------------------------------------------- #
+# profile building blocks
+# --------------------------------------------------------------------------- #
+def _tree_bytes(tree: Any) -> int:
+    return sum(_sync._leaf_nbytes(l) for l in jax.tree_util.tree_leaves(tree))
+
+
+def _donation_profile(out1: Any, out2: Any) -> Tuple[Dict[str, Any], int]:
+    """(donation dict, recompile risk count) from the simulated streak —
+    the same out1→out2 comparison stage 2 bills as E102/E103/E104, here in
+    bytes. Returns aliased vs copied bytes and the risk tally."""
+    risks = 0
+    t1, t2 = jax.tree_util.tree_structure(out1), jax.tree_util.tree_structure(out2)
+    if t1 != t2:
+        # structure drift: nothing can alias, and every step recompiles
+        total = _tree_bytes(out2)
+        return (
+            {"aliased_bytes": 0, "copied_bytes": total, "copied_leaves": ["<treedef>"]},
+            1,
+        )
+    aliased = copied = 0
+    copied_leaves: List[str] = []
+    for (path, a), (_, b) in zip(_leaf_paths(out1), _leaf_paths(out2)):
+        (sh_a, dt_a, wk_a), (sh_b, dt_b, wk_b) = _aval(a), _aval(b)
+        nbytes = _sync._leaf_nbytes(b)
+        if (sh_a, dt_a) != (sh_b, dt_b):
+            copied += nbytes
+            copied_leaves.append(path)
+            risks += 1
+        else:
+            aliased += nbytes
+            if wk_a != wk_b:
+                risks += 1
+    return (
+        {
+            "aliased_bytes": int(aliased),
+            "copied_bytes": int(copied),
+            "copied_leaves": sorted(copied_leaves),
+        },
+        risks,
+    )
+
+
+def _bucket_rows(plan: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """transport_plan entries -> sorted, JSON-canonical manifest rows (ints
+    and strings only — the gate's float error bounds stay out of the
+    manifest so byte-identity never hinges on float formatting)."""
+    rows = [
+        {
+            "names": sorted(str(n) for n in b["names"]),
+            "reduction": str(b["reduction"]),
+            "dtype": str(b["dtype"]),
+            "kind": str(b["kind"]),
+            "requested": str(b["requested"]),
+            "transport": str(b["transport"]),
+            "refused": b["refusal"] is not None,
+            "elements": int(b["elements"]),
+            "wire_bytes": int(b["wire_bytes"]),
+            "logical_bytes": int(b["logical_bytes"]),
+        }
+        for b in plan
+    ]
+    return sorted(
+        rows, key=lambda r: (r["reduction"], r["dtype"], r["kind"], r["names"])
+    )
+
+
+def _wire_summary(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
+    by_transport: Dict[str, int] = {}
+    for r in rows:
+        by_transport[r["transport"]] = by_transport.get(r["transport"], 0) + r["wire_bytes"]
+    return {
+        "total_bytes": int(sum(r["wire_bytes"] for r in rows)),
+        "by_transport": dict(sorted(by_transport.items())),
+    }
+
+
+def _wire_ladder(
+    state: Dict[str, Any],
+    reductions: Dict[str, Any],
+    tolerances: Dict[str, float],
+    shard_axes: Dict[str, Any],
+) -> Dict[str, int]:
+    """Post-gate wire bytes if every state requested each ladder rung — what
+    quantized sync could statically save (or not: the error-budget gate still
+    refuses inadmissible buckets back to exact, and that refusal is priced
+    in, exactly as at runtime)."""
+    out: Dict[str, int] = {}
+    for rung in LADDER:
+        plan = _sync.transport_plan(
+            state,
+            dict(reductions),
+            WORLD,
+            transports={name: rung for name in state},
+            tolerances=dict(tolerances),
+            shard_axes=dict(shard_axes),
+        )
+        out[rung] = int(sum(int(b["wire_bytes"]) for b in plan))
+    return out
+
+
+def _incremental_summary(
+    state: Dict[str, Any],
+    reductions: Dict[str, Any],
+    modes: Dict[str, str],
+    shard_axes: Dict[str, Any],
+) -> Dict[str, Any]:
+    iplan = _sync.incremental_plan(
+        state, dict(reductions), modes=dict(modes), shard_axes=dict(shard_axes)
+    )
+    eligible = sorted(n for n, e in iplan.items() if e["eligible"])
+    return {
+        "leaves": len(iplan),
+        "eligible_leaves": len(eligible),
+        "fully_eligible": bool(iplan) and len(eligible) == len(iplan),
+    }
+
+
+def _skipped(reason: str) -> Dict[str, Any]:
+    return {"skipped": reason}
+
+
+# --------------------------------------------------------------------------- #
+# per-entry profile
+# --------------------------------------------------------------------------- #
+def profile_entry(entry: Entry) -> Dict[str, Any]:
+    """The static resource profile of one registry metric, re-using stage-2
+    trace artifacts when present. Unprofilable metrics (no spec, skip_eval,
+    engine-ineligible, uninstantiable) return ``{"skipped": reason}`` — they
+    stay in the manifest so the universe itself is diffable."""
+    if entry.spec is None:
+        return _skipped("no ANALYSIS_SPECS entry (E002)")
+    if entry.skip_eval:
+        return _skipped(f"skip_eval: {entry.skip_eval}")
+    if entry.instance is None:
+        instantiate(entry)
+    inst = entry.instance
+    if inst is None:
+        return _skipped(f"uninstantiable: {entry.init_error or 'no_probe'}")
+    if not (inst.supports_compiled_update and inst.supports_compiled_compute):
+        return _skipped("engine-ineligible: unbounded Python-list state (E001)")
+
+    notes: List[str] = []
+    args = _materialize(entry.spec.get("inputs"))
+    kwargs = _materialize_kwargs(entry.spec.get("kwargs"))
+    static_kwargs = dict(entry.spec.get("static_kwargs", {}))
+
+    def _step(s, *a, **kw):
+        return inst.update_state(s, *a, **kw, **static_kwargs)
+
+    streak = entry.artifacts.get("streak")
+    if streak is None:
+        try:
+            state0 = inst.init_state(*args, **kwargs) if not static_kwargs else inst.get_state()
+            out1 = jax.eval_shape(_step, state0, *args, **kwargs)
+            out2 = jax.eval_shape(_step, out1, *args, **kwargs)
+            streak = (state0, out1, out2)
+        except Exception as e:  # noqa: BLE001 — untraceable update is E101's beat
+            return _skipped(f"untraceable update (E101): {_err(e)}")
+    state0, out1, out2 = streak
+
+    state = entry.artifacts.get("state")
+    if state is None:
+        state = jax.tree_util.tree_map(
+            lambda l: jnp.zeros(l.shape, l.dtype) if hasattr(l, "shape") else l, out1
+        )
+
+    # ---- update leg: steady-state step FLOPs --------------------------------
+    flops = 0
+    try:
+        traced = jax.make_jaxpr(_step)(state, *args, **kwargs)
+        flops = flops_of_jaxpr(traced.jaxpr)
+    except Exception as e:  # noqa: BLE001 — eval_shape passed but jaxpr didn't
+        notes.append(f"update jaxpr failed: {_err(e)}")
+
+    # ---- donation / recompile risk ------------------------------------------
+    donation, risks = _donation_profile(out1, out2)
+
+    # ---- sync leg: collectives ----------------------------------------------
+    sync_box = entry.artifacts.get("sync_box")
+    if sync_box is None:
+        with _sync.count_collectives() as box:
+            try:
+                jax.make_jaxpr(
+                    lambda s: inst.sync_states(s, AXIS), axis_env=[(AXIS, WORLD)]
+                )(state)
+                sync_box = {"count": int(box["count"]), "by_kind": dict(box["by_kind"])}
+            except Exception as e:  # noqa: BLE001 — untraceable sync is E107's beat
+                notes.append(f"sync untraceable: {_err(e)}")
+                sync_box = {"count": 0, "by_kind": {}}
+    collectives = {
+        "count": int(sync_box["count"]),
+        "by_kind": {str(k): int(v) for k, v in sorted(sync_box["by_kind"].items())},
+    }
+
+    # ---- fused finalize: sync_states ∘ compute_state FLOPs ------------------
+    finalize_flops = 0
+    try:
+        traced = jax.make_jaxpr(
+            lambda s: inst.sync_compute_state(s, AXIS), axis_env=[(AXIS, WORLD)]
+        )(state)
+        finalize_flops = flops_of_jaxpr(traced.jaxpr)
+    except Exception as e:  # noqa: BLE001 — untraceable compute is E107's beat
+        notes.append(f"finalize untraceable: {_err(e)}")
+
+    # ---- transport buckets, wire bytes, ladder, incremental -----------------
+    rows: List[Dict[str, Any]] = []
+    ladder: Dict[str, int] = {}
+    incremental = {"leaves": 0, "eligible_leaves": 0, "fully_eligible": False}
+    if isinstance(state, dict) and state:
+        reds = dict(inst._reductions)
+        tolerances = dict(getattr(inst, "_sync_tolerances", {}) or {})
+        shard_axes = dict(inst.active_shard_axes or {})
+        try:
+            plan = _sync.transport_plan(
+                state, reds, WORLD,
+                transports=dict(getattr(inst, "_sync_transports", {}) or {}),
+                tolerances=tolerances,
+                shard_axes=shard_axes,
+            )
+            rows = _bucket_rows(plan)
+            ladder = _wire_ladder(state, reds, tolerances, shard_axes)
+        except Exception as e:  # noqa: BLE001 — unplannable states are E106/E107's beat
+            notes.append(f"transport plan failed: {_err(e)}")
+        try:
+            incremental = _incremental_summary(
+                state, reds, dict(getattr(inst, "_sync_modes", {}) or {}), shard_axes
+            )
+        except Exception as e:  # noqa: BLE001
+            notes.append(f"incremental plan failed: {_err(e)}")
+
+    return {
+        "flops_per_step": int(flops),
+        "finalize_flops": int(finalize_flops),
+        "state_bytes": int(_tree_bytes(state)),
+        "state_leaves": len(jax.tree_util.tree_leaves(state)),
+        "donation": donation,
+        "recompile_risks": int(risks),
+        "collectives": collectives,
+        "buckets": rows,
+        "wire": _wire_summary(rows),
+        "wire_ladder": ladder,
+        "incremental": incremental,
+        "notes": sorted(notes),
+    }
+
+
+def build_profiles(entries: List[Entry]) -> Dict[str, Dict[str, Any]]:
+    out: Dict[str, Dict[str, Any]] = {}
+    for entry in entries:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out[entry.name] = profile_entry(entry)
+    return dict(sorted(out.items()))
+
+
+# --------------------------------------------------------------------------- #
+# canonical collections (the bench's config1/config2) and TenantSet shapes
+# --------------------------------------------------------------------------- #
+def _collection_profile(coll: Any, args: List[Any]) -> Dict[str, Any]:
+    """Profile a MetricCollection at canonical input shapes: per-step fused
+    update FLOPs, merged flat state, and ONE fused sync over the merged
+    buckets — the engines' actual execution shape, where cross-member
+    bucketing is the whole point."""
+    states = coll.init_state()
+    traced = jax.make_jaxpr(lambda s, *a: coll.update_state(s, *a))(states, *args)
+    flat_state: Dict[str, Any] = {}
+    flat_reds: Dict[str, Any] = {}
+    flat_tols: Dict[str, float] = {}
+    flat_shards: Dict[str, Any] = {}
+    for mname, m in coll.items():
+        for sname, leaf in m.metric_state.items():
+            key = f"{mname}.{sname}"
+            flat_state[key] = jnp.zeros(getattr(leaf, "shape", ()), getattr(leaf, "dtype", jnp.float32)) if hasattr(leaf, "shape") else leaf
+            flat_reds[key] = m._reductions[sname]
+            if sname in (getattr(m, "_sync_tolerances", {}) or {}):
+                flat_tols[key] = m._sync_tolerances[sname]
+            if sname in (m.active_shard_axes or {}):
+                flat_shards[key] = m.active_shard_axes[sname]
+    with _sync.count_collectives() as box:
+        jax.make_jaxpr(
+            lambda s: _sync.sync_state(s, flat_reds, AXIS),
+            axis_env=[(AXIS, WORLD)],
+        )(flat_state)
+    plan = _sync.transport_plan(
+        flat_state, flat_reds, WORLD,
+        tolerances=flat_tols, shard_axes=flat_shards,
+    )
+    rows = _bucket_rows(plan)
+    return {
+        "members": sorted(name for name, _ in coll.items()),
+        "flops_per_step": int(flops_of_jaxpr(traced.jaxpr)),
+        "state_bytes": int(_tree_bytes(flat_state)),
+        "collectives": {
+            "count": int(box["count"]),
+            "by_kind": {str(k): int(v) for k, v in sorted(box["by_kind"].items())},
+        },
+        "buckets": rows,
+        "wire": _wire_summary(rows),
+        "wire_ladder": _wire_ladder(flat_state, flat_reds, flat_tols, flat_shards),
+    }
+
+
+def _config1():
+    from metrics_tpu import Accuracy
+
+    coll_args = [
+        jnp.zeros((128, 10), jnp.float32),
+        jnp.zeros((128,), jnp.int32),
+    ]
+    acc = Accuracy(num_classes=10)
+    state0 = acc.init_state(*coll_args)
+    traced = jax.make_jaxpr(lambda s, *a: acc.update_state(s, *a))(state0, *coll_args)
+    with _sync.count_collectives() as box:
+        jax.make_jaxpr(
+            lambda s: acc.sync_states(s, AXIS), axis_env=[(AXIS, WORLD)]
+        )(state0)
+    plan = _sync.transport_plan(dict(state0), dict(acc._reductions), WORLD)
+    rows = _bucket_rows(plan)
+    return {
+        "members": ["accuracy"],
+        "flops_per_step": int(flops_of_jaxpr(traced.jaxpr)),
+        "state_bytes": int(_tree_bytes(state0)),
+        "collectives": {
+            "count": int(box["count"]),
+            "by_kind": {str(k): int(v) for k, v in sorted(box["by_kind"].items())},
+        },
+        "buckets": rows,
+        "wire": _wire_summary(rows),
+        "wire_ladder": _wire_ladder(
+            dict(state0), dict(acc._reductions), {}, {}
+        ),
+    }
+
+
+def _config2_members():
+    from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, Recall
+
+    num_classes = 1000
+    coll = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=num_classes, average="micro"),
+            "f1": F1Score(num_classes=num_classes, average="macro"),
+            "precision": Precision(num_classes=num_classes, average="macro"),
+            "recall": Recall(num_classes=num_classes, average="macro"),
+        }
+    )
+    args = [
+        jnp.zeros((1024, num_classes), jnp.float32),
+        jnp.zeros((1024,), jnp.int32),
+    ]
+    return coll, args
+
+
+def collection_profiles() -> Dict[str, Dict[str, Any]]:
+    """The bench's canonical configs, profiled at the bench's input shapes:
+    config1 (single 10-class Accuracy, batch 128) and config2 (the fused
+    4-member collection at 1k classes, batch 1024)."""
+    coll, args = _config2_members()
+    return {
+        "config1": _config1(),
+        "config2": _collection_profile(coll, args),
+    }
+
+
+def tenancy_profiles(widths: Tuple[int, ...] = (8, 64)) -> Dict[str, Any]:
+    """TenantSet bucket shapes: the config2 members' states stacked along a
+    leading tenant axis at each capacity, synced through
+    ``sync_stacked_states`` under the mock mesh. The manifest pins the
+    N-independence claim — collective count identical at every width — as a
+    diffable fact, not a test-only assertion."""
+    coll, _ = _config2_members()
+    members = [(name, m) for name, m in coll.items()]
+    out: Dict[str, Any] = {"widths": {}}
+    counts = []
+    for width in widths:
+        states: Dict[str, Dict[str, Any]] = {}
+        reds: Dict[str, Dict[str, Any]] = {}
+        for name, m in members:
+            states[name] = {
+                sname: jnp.zeros((width,) + tuple(leaf.shape), leaf.dtype)
+                for sname, leaf in m.metric_state.items()
+                if hasattr(leaf, "shape")
+            }
+            reds[name] = {sname: m._reductions[sname] for sname in states[name]}
+        with _sync.count_collectives() as box:
+            jax.make_jaxpr(
+                lambda s: _sync.sync_stacked_states(s, reds, AXIS),
+                axis_env=[(AXIS, WORLD)],
+            )(states)
+        counts.append(int(box["count"]))
+        out["widths"][str(width)] = {
+            "collectives": {
+                "count": int(box["count"]),
+                "by_kind": {str(k): int(v) for k, v in sorted(box["by_kind"].items())},
+            },
+            "state_bytes": int(_tree_bytes(states)),
+            "wire_bytes": int(box["bytes"]),
+        }
+    out["collectives_n_independent"] = len(set(counts)) <= 1
+    return {"config2_stacked": out}
+
+
+# --------------------------------------------------------------------------- #
+# E117 — cost-budget overruns
+# --------------------------------------------------------------------------- #
+# budget key -> profile field getter
+_BUDGET_FIELDS = {
+    "flops_per_step": lambda p: p["flops_per_step"],
+    "finalize_flops": lambda p: p["finalize_flops"],
+    "state_bytes": lambda p: p["state_bytes"],
+    "collectives": lambda p: p["collectives"]["count"],
+    "wire_bytes": lambda p: p["wire"]["total_bytes"],
+    "copied_bytes": lambda p: p["donation"]["copied_bytes"],
+    "recompile_risks": lambda p: p["recompile_risks"],
+}
+
+BUDGET_KEYS = tuple(sorted(_BUDGET_FIELDS))
+
+
+def cost_budget_findings(
+    entries: List[Entry], profiles: Dict[str, Dict[str, Any]]
+) -> List[Finding]:
+    """E117: a profile field exceeds the cap its ANALYSIS_SPECS entry
+    declares under ``cost_budget``. Unknown budget keys are A009's beat
+    (unknown-suppression's sibling check in run_analysis)."""
+    findings: List[Finding] = []
+    for entry in entries:
+        budget = entry.cost_budget
+        if not budget:
+            continue
+        profile = profiles.get(entry.name)
+        if profile is None or "skipped" in profile:
+            continue
+        for key, cap in sorted(budget.items()):
+            getter = _BUDGET_FIELDS.get(key)
+            if getter is None:
+                continue
+            value = int(getter(profile))
+            if value > int(cap):
+                f = Finding(
+                    rule="E117",
+                    obj=entry.name,
+                    message=(
+                        f"static cost profile exceeds the declared budget: "
+                        f"{key} = {value} > cost_budget[{key!r}] = {int(cap)} "
+                        f"— cheapen the implementation or raise the budget in "
+                        f"the same PR"
+                    ),
+                    extra={"field": key, "value": value, "budget": int(cap)},
+                )
+                if "E117" in entry.allow:
+                    f.suppressed = True
+                findings.append(f)
+    return findings

@@ -593,6 +593,10 @@ def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Findi
             )
         return findings
 
+    # stage 3 (costmodel) re-walks this streak for donation/recompile billing;
+    # leaving the abstract pytrees on the entry saves it a re-trace
+    entry.artifacts["streak"] = (state0, out1, out2)
+
     t1, t2 = jax.tree_util.tree_structure(out1), jax.tree_util.tree_structure(out2)
     if t1 != t2:
         findings.append(
@@ -641,6 +645,7 @@ def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Findi
     state = jax.tree_util.tree_map(
         lambda l: jnp.zeros(l.shape, l.dtype) if hasattr(l, "shape") else l, out1
     )
+    entry.artifacts["state"] = state
 
     with _sync.count_collectives() as budget_box:
         try:
@@ -678,6 +683,16 @@ def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Findi
             )
             sync_shape = None
     actual = box["count"]
+    if sync_shape is not None:
+        entry.artifacts["sync_box"] = {
+            "count": int(box["count"]),
+            "by_kind": dict(box["by_kind"]),
+            "bytes": int(box["bytes"]),
+            "bytes_by_kind": dict(box["bytes_by_kind"]),
+            "bytes_by_transport": {
+                t: dict(v) for t, v in box["bytes_by_transport"].items()
+            },
+        }
     entry.notes.append(
         f"collectives: {actual} (budget {allowed}, by_kind {box['by_kind']}, "
         f"bytes_by_kind {box['bytes_by_kind']})"

@@ -1,0 +1,363 @@
+"""The stage-3 resource manifest: build, canonical serialization, diff.
+
+``analysis_manifest.json`` at the repo root is the committed, machine-readable
+perf ledger: every registry metric's static resource profile
+(:mod:`metrics_tpu.analysis.costmodel`), the canonical bench collections
+(config1/config2), the TenantSet stacked-sync shapes, and universe totals.
+Serialization is canonical — sorted keys, fixed indent, integers only, a
+trailing newline — so two consecutive ``--manifest --write`` runs on the same
+tree are **byte-identical** and the file diffs line-by-line in review.
+
+:func:`diff_manifest` is the regression gate (``--manifest --diff``, CI):
+it compares the committed manifest against a freshly built one and reports
+drift records, each tagged with a kind from :data:`DRIFT_KINDS`:
+
+* ``new_collective`` — a metric's sync emits more collectives than recorded;
+* ``wire_bytes_growth`` — a sync bucket's wire bytes grew beyond the
+  per-bucket tolerance (``DEFAULT_WIRE_TOLERANCE`` relative, with a small
+  absolute floor so one-element buckets don't flap);
+* ``lost_donation_alias`` — a state leaf that used to alias its donated
+  input buffer now silently copies;
+* ``new_recompile_risk`` — the simulated streak shows more aval/weak-type/
+  treedef drifts than recorded;
+* ``new_metric`` / ``removed_metric`` / ``profile_degraded`` — the universe
+  itself changed and the manifest has not been re-written;
+* ``budget_regression`` — a totals/collection aggregate regressed.
+
+Improvements (fewer collectives, fewer bytes) are reported too but never
+fail the gate — they just mean the manifest is stale and ``--write`` should
+refresh it. A known, intentional delta is waived per metric with a
+``"manifest_allow": ("<kind>", ...)`` spec key — the inline mirror of
+``allow`` — or suppressed wholesale with ``"allow": ("E118",)``.
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from metrics_tpu.analysis import costmodel, registry
+from metrics_tpu.analysis.registry import Entry
+from metrics_tpu.analysis.rules import Finding
+
+SCHEMA_VERSION = 1
+
+# per-bucket relative wire-byte growth tolerated without a drift record, and
+# the absolute floor below which growth is ignored (a scalar bucket gaining
+# one leaf is bookkeeping, not a regression)
+DEFAULT_WIRE_TOLERANCE = 0.10
+WIRE_ABS_FLOOR = 64
+
+DRIFT_KINDS = (
+    "budget_regression",
+    "lost_donation_alias",
+    "new_collective",
+    "new_metric",
+    "new_recompile_risk",
+    "profile_degraded",
+    "removed_metric",
+    "wire_bytes_growth",
+)
+
+
+def manifest_path() -> Path:
+    """The committed manifest at the repo root (two levels above this file)."""
+    return Path(__file__).resolve().parents[2] / "analysis_manifest.json"
+
+
+def canonical_dumps(manifest: Dict[str, Any]) -> str:
+    """Canonical bytes: sorted keys, two-space indent, trailing newline.
+    The builder keeps every value an int/str/bool/list, so there is no float
+    formatting to destabilize byte-identity."""
+    return json.dumps(manifest, sort_keys=True, indent=2) + "\n"
+
+
+def _totals(profiles: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+    live = {n: p for n, p in profiles.items() if "skipped" not in p}
+    by_transport: Dict[str, int] = {}
+    for p in live.values():
+        for t, b in p["wire"]["by_transport"].items():
+            by_transport[t] = by_transport.get(t, 0) + int(b)
+    return {
+        "metrics": len(profiles),
+        "profiled": len(live),
+        "skipped": len(profiles) - len(live),
+        "flops_per_step": int(sum(p["flops_per_step"] for p in live.values())),
+        "finalize_flops": int(sum(p["finalize_flops"] for p in live.values())),
+        "state_bytes": int(sum(p["state_bytes"] for p in live.values())),
+        "collectives": int(sum(p["collectives"]["count"] for p in live.values())),
+        "wire_bytes": int(sum(p["wire"]["total_bytes"] for p in live.values())),
+        "wire_bytes_by_transport": dict(sorted(by_transport.items())),
+        "copied_bytes": int(sum(p["donation"]["copied_bytes"] for p in live.values())),
+        "recompile_risks": int(sum(p["recompile_risks"] for p in live.values())),
+        "incremental_eligible_leaves": int(
+            sum(p["incremental"]["eligible_leaves"] for p in live.values())
+        ),
+    }
+
+
+def build_manifest(entries: Optional[List[Entry]] = None) -> Dict[str, Any]:
+    """The full manifest document. ``entries`` re-uses an existing registry
+    (with any stage-2 trace artifacts); absent, the registry is built fresh
+    — both paths produce identical bytes."""
+    if entries is None:
+        entries = registry.build_registry()
+    profiles = costmodel.build_profiles(entries)
+    return {
+        "schema": SCHEMA_VERSION,
+        "axis": costmodel.AXIS,
+        "world": costmodel.WORLD,
+        "metrics": profiles,
+        "collections": costmodel.collection_profiles(),
+        "tenancy": costmodel.tenancy_profiles(),
+        "totals": _totals(profiles),
+    }
+
+
+def load_manifest(path: Optional[Path] = None) -> Optional[Dict[str, Any]]:
+    p = Path(path) if path is not None else manifest_path()
+    if not p.exists():
+        return None
+    with open(p, "r") as fh:
+        return json.load(fh)
+
+
+def write_manifest(manifest: Dict[str, Any], path: Optional[Path] = None) -> Path:
+    p = Path(path) if path is not None else manifest_path()
+    p.write_text(canonical_dumps(manifest))
+    return p
+
+
+# --------------------------------------------------------------------------- #
+# diff
+# --------------------------------------------------------------------------- #
+def _record(
+    kind: str,
+    obj: str,
+    detail: str,
+    regression: bool,
+    waived: bool = False,
+    **extra: Any,
+) -> Dict[str, Any]:
+    rec = {
+        "kind": kind,
+        "obj": obj,
+        "detail": detail,
+        "regression": bool(regression),
+        "waived": bool(waived),
+    }
+    rec.update(extra)
+    return rec
+
+
+def _bucket_key(row: Dict[str, Any]) -> str:
+    return f"{row['reduction']}/{row['dtype']}/{row['kind']}/{row['requested']}"
+
+
+def _diff_profile(
+    name: str, old: Dict[str, Any], new: Dict[str, Any]
+) -> List[Dict[str, Any]]:
+    records: List[Dict[str, Any]] = []
+    if "skipped" in old or "skipped" in new:
+        if "skipped" not in old and "skipped" in new:
+            records.append(
+                _record(
+                    "profile_degraded", name,
+                    f"previously profiled, now skipped: {new['skipped']}",
+                    regression=True,
+                )
+            )
+        return records
+
+    # collectives
+    old_n, new_n = old["collectives"]["count"], new["collectives"]["count"]
+    if new_n > old_n:
+        records.append(
+            _record(
+                "new_collective", name,
+                f"sync emits {new_n} collectives vs {old_n} recorded "
+                f"(by_kind {new['collectives']['by_kind']} vs {old['collectives']['by_kind']})",
+                regression=True, recorded=old_n, live=new_n,
+            )
+        )
+    elif new_n < old_n:
+        records.append(
+            _record(
+                "new_collective", name,
+                f"sync emits {new_n} collectives vs {old_n} recorded (improvement)",
+                regression=False, recorded=old_n, live=new_n,
+            )
+        )
+
+    # per-bucket wire bytes
+    old_buckets = {_bucket_key(r): r for r in old["buckets"]}
+    new_buckets = {_bucket_key(r): r for r in new["buckets"]}
+    for key, row in sorted(new_buckets.items()):
+        prev = old_buckets.get(key)
+        recorded = int(prev["wire_bytes"]) if prev else 0
+        live = int(row["wire_bytes"])
+        slack = max(int(recorded * DEFAULT_WIRE_TOLERANCE), WIRE_ABS_FLOOR)
+        if live > recorded + slack:
+            records.append(
+                _record(
+                    "wire_bytes_growth", name,
+                    f"bucket {key} moves {live} wire bytes vs {recorded} recorded "
+                    f"(tolerance {slack}B; states {row['names']})",
+                    regression=True, bucket=key, recorded=recorded, live=live,
+                )
+            )
+
+    # donation aliasing
+    old_copied = set(old["donation"]["copied_leaves"])
+    new_copied = set(new["donation"]["copied_leaves"])
+    lost = sorted(new_copied - old_copied)
+    if lost:
+        records.append(
+            _record(
+                "lost_donation_alias", name,
+                f"state leaf(s) {lost} no longer alias the donated input buffer "
+                f"(copied bytes {old['donation']['copied_bytes']} -> "
+                f"{new['donation']['copied_bytes']})",
+                regression=True, leaves=lost,
+            )
+        )
+
+    # recompile risks
+    if new["recompile_risks"] > old["recompile_risks"]:
+        records.append(
+            _record(
+                "new_recompile_risk", name,
+                f"{new['recompile_risks']} recompile risks vs "
+                f"{old['recompile_risks']} recorded",
+                regression=True,
+                recorded=old["recompile_risks"], live=new["recompile_risks"],
+            )
+        )
+    return records
+
+
+def _diff_aggregate(
+    obj: str, old: Dict[str, Any], new: Dict[str, Any]
+) -> List[Dict[str, Any]]:
+    """Collections / tenancy / totals: collective counts must not grow, wire
+    totals get the same relative tolerance as buckets."""
+    records: List[Dict[str, Any]] = []
+    old_c = old.get("collectives", {}).get("count")
+    new_c = new.get("collectives", {}).get("count")
+    if old_c is not None and new_c is not None and new_c > old_c:
+        records.append(
+            _record(
+                "new_collective", obj,
+                f"fused sync emits {new_c} collectives vs {old_c} recorded",
+                regression=True, recorded=old_c, live=new_c,
+            )
+        )
+    old_w = old.get("wire", {}).get("total_bytes")
+    new_w = new.get("wire", {}).get("total_bytes")
+    if old_w is not None and new_w is not None:
+        slack = max(int(old_w * DEFAULT_WIRE_TOLERANCE), WIRE_ABS_FLOOR)
+        if new_w > old_w + slack:
+            records.append(
+                _record(
+                    "wire_bytes_growth", obj,
+                    f"fused sync moves {new_w} wire bytes vs {old_w} recorded "
+                    f"(tolerance {slack}B)",
+                    regression=True, recorded=old_w, live=new_w,
+                )
+            )
+    return records
+
+
+def diff_manifest(
+    committed: Dict[str, Any],
+    live: Dict[str, Any],
+    waivers: Optional[Dict[str, Any]] = None,
+) -> List[Dict[str, Any]]:
+    """Drift records between the committed manifest and a live build.
+
+    ``waivers`` maps metric name -> iterable of waived :data:`DRIFT_KINDS`
+    (the ``manifest_allow`` spec keys, gathered by the caller). A waived
+    record stays in the report — visibly tagged — but does not fail the gate.
+    """
+    waivers = waivers or {}
+    records: List[Dict[str, Any]] = []
+
+    old_metrics = committed.get("metrics", {})
+    new_metrics = live.get("metrics", {})
+    for name in sorted(set(old_metrics) - set(new_metrics)):
+        records.append(
+            _record(
+                "removed_metric", name,
+                "metric present in the committed manifest is gone from the live "
+                "universe — re-write the manifest if the removal is intentional",
+                regression=True,
+            )
+        )
+    for name in sorted(set(new_metrics) - set(old_metrics)):
+        records.append(
+            _record(
+                "new_metric", name,
+                "metric missing from the committed manifest — run "
+                "`python -m metrics_tpu.analysis --manifest --write` and commit",
+                regression=True,
+            )
+        )
+    for name in sorted(set(old_metrics) & set(new_metrics)):
+        records.extend(_diff_profile(name, old_metrics[name], new_metrics[name]))
+
+    for section in ("collections", "tenancy"):
+        old_sec, new_sec = committed.get(section, {}), live.get(section, {})
+        for key in sorted(set(old_sec) & set(new_sec)):
+            if section == "tenancy":
+                for width in sorted(
+                    set(old_sec[key].get("widths", {}))
+                    & set(new_sec[key].get("widths", {}))
+                ):
+                    records.extend(
+                        _diff_aggregate(
+                            f"{section}[{key}][{width}]",
+                            old_sec[key]["widths"][width],
+                            new_sec[key]["widths"][width],
+                        )
+                    )
+            else:
+                records.extend(
+                    _diff_aggregate(f"{section}[{key}]", old_sec[key], new_sec[key])
+                )
+
+    for rec in records:
+        if rec["kind"] in tuple(waivers.get(rec["obj"], ())):
+            rec["waived"] = True
+    return records
+
+
+def gate_failures(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """The records that fail ``--manifest --diff``: unwaived regressions."""
+    return [r for r in records if r["regression"] and not r["waived"]]
+
+
+def collect_waivers(entries: List[Entry]) -> Dict[str, Any]:
+    return {e.name: e.manifest_allow for e in entries if e.manifest_allow}
+
+
+def drift_findings(
+    records: List[Dict[str, Any]], entries: List[Entry]
+) -> List[Finding]:
+    """E118 findings from drift records — the in-analyzer mirror of the
+    ``--diff`` gate. Waived records surface suppressed; metrics allowing
+    E118 wholesale suppress their own records too."""
+    allow_by_name = {e.name: e.allow for e in entries}
+    findings: List[Finding] = []
+    for rec in records:
+        if not rec["regression"]:
+            continue
+        f = Finding(
+            rule="E118",
+            obj=rec["obj"],
+            message=f"manifest drift ({rec['kind']}): {rec['detail']}",
+            extra={k: v for k, v in rec.items() if k not in ("obj", "detail")},
+        )
+        if rec["waived"] or "E118" in allow_by_name.get(rec["obj"], ()):
+            f.suppressed = True
+        findings.append(f)
+    return findings

@@ -26,9 +26,13 @@ A spec entry looks like::
 
 Optional keys: ``"kwargs"`` (update kwargs, same ``(dtype, shape)`` form),
 ``"allow"`` (rule ids suppressed class-wide), ``"collective_budget"`` (absolute
-per-metric cap overriding the canonical-sync budget). An exported metric class
-with no spec is itself a finding (``E002``) — that is the merge gate: new
-metrics must declare how they are analyzed.
+per-metric cap overriding the canonical-sync budget), ``"cost_budget"`` (stage-3
+caps — ``{"flops_per_step": N, "wire_bytes": N, ...}`` — whose overrun is E117),
+and ``"manifest_allow"`` (drift kinds waived in the ``--manifest --diff`` gate,
+e.g. ``("wire_bytes_growth",)``; mirrors ``allow`` but names
+:data:`metrics_tpu.analysis.manifest.DRIFT_KINDS` instead of rule ids). An
+exported metric class with no spec is itself a finding (``E002``) — that is the
+merge gate: new metrics must declare how they are analyzed.
 
 The ``"ckpt"`` key parameterizes the checkpoint/state-dict roundtrip sweep
 (``tests/core/test_checkpoint_sweep.py``), which — unlike the abstract-eval
@@ -73,6 +77,9 @@ SPEC_MODULES = (
 # jit-facing metric method in an exempt file is still flagged.
 MODULE_SPEC_SOURCES = (
     "metrics_tpu.observability",
+    "metrics_tpu.parallel",
+    "metrics_tpu.serve",
+    "metrics_tpu.tenancy",
 )
 
 
@@ -83,6 +90,11 @@ class Entry:
     instance: Any = None                 # populated by the eval stage
     init_error: Optional[str] = None
     notes: List[str] = field(default_factory=list)
+    # trace artifacts the eval stage leaves behind for stage 3 (costmodel):
+    # "streak" (state0, out1, out2 abstract pytrees), "state" (concrete
+    # steady-state zeros), "sync_box" (count_collectives tallies). Stage 3
+    # re-derives anything missing, so running it standalone still works.
+    artifacts: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def name(self) -> str:
@@ -91,6 +103,16 @@ class Entry:
     @property
     def allow(self) -> Tuple[str, ...]:
         return tuple((self.spec or {}).get("allow", ()))
+
+    @property
+    def cost_budget(self) -> Dict[str, int]:
+        """Stage-3 caps; a profile field exceeding its cap is E117."""
+        return dict((self.spec or {}).get("cost_budget", {}))
+
+    @property
+    def manifest_allow(self) -> Tuple[str, ...]:
+        """Drift kinds waived for this metric in the manifest diff gate."""
+        return tuple((self.spec or {}).get("manifest_allow", ()))
 
     @property
     def host_inputs(self) -> bool:

@@ -96,6 +96,13 @@ _AST_RULES = (
         "classification depend on; catch narrow exception types or re-raise "
         "after handling.",
     ),
+    Rule(
+        "A009", "unknown-suppression", WARNING,
+        "a suppression names a rule id the analyzer does not define — in an "
+        "inline `# metrics-tpu: allow[...]` comment, an ANALYSIS_SPECS / "
+        "ANALYSIS_MODULE_SPECS `allow` tuple, or a `manifest_allow` waiver "
+        "kind; the typo suppresses nothing while reading as if it did.",
+    ),
 )
 
 # --------------------------------------------------------------------------- #
@@ -258,6 +265,25 @@ _EVAL_RULES = (
         "attribute backed by an approx= constructor arg, or a MergeableSketch "
         "state) so unbounded-stream callers have a bounded-memory opt-in "
         "(see docs/sketch_metrics.md).",
+    ),
+    Rule(
+        "E117", "cost-budget-overrun", ERROR,
+        "the metric's static resource profile (stage 3 — flops_per_step, "
+        "state_bytes, collectives, wire_bytes, copied_bytes, recompile_risks) "
+        "exceeds a cap its ANALYSIS_SPECS entry declares under `cost_budget` "
+        "— the change made the metric statically more expensive than its "
+        "domain package vouches for; either cheapen the implementation or "
+        "raise the declared budget in the same PR.",
+    ),
+    Rule(
+        "E118", "manifest-drift", WARNING,
+        "the live static cost profile disagrees with the committed "
+        "analysis_manifest.json (the static twin of E115's plan drift): a new "
+        "collective, per-bucket wire-byte growth beyond tolerance, a lost "
+        "donation alias, a new recompile risk, or a universe change the "
+        "manifest has not recorded — run `python -m metrics_tpu.analysis "
+        "--manifest --write` on intentional changes (and commit the result), "
+        "or waive a known delta with a `manifest_allow` spec key.",
     ),
 )
 

@@ -61,9 +61,30 @@ def _ckpt_kld_inputs():
 
 
 ANALYSIS_SPECS = {
-    "Accuracy": {"inputs": _BINARY},
+    # cost_budget: stage-3 static caps (E117). Counter metrics are a handful
+    # of scalar states — one fused psum, zero copies, zero recompile risks —
+    # so the caps are tight invariants, not generous headroom.
+    "Accuracy": {
+        "inputs": _BINARY,
+        "cost_budget": {
+            "flops_per_step": 1024,
+            "state_bytes": 64,
+            "collectives": 2,
+            "wire_bytes": 64,
+            "copied_bytes": 0,
+            "recompile_risks": 0,
+        },
+    },
     "Dice": {"inputs": _BINARY},
-    "F1Score": {"inputs": _BINARY},
+    "F1Score": {
+        "inputs": _BINARY,
+        "cost_budget": {
+            "flops_per_step": 1024,
+            "collectives": 2,
+            "copied_bytes": 0,
+            "recompile_risks": 0,
+        },
+    },
     "FBetaScore": {"inputs": _BINARY},
     "HammingDistance": {"inputs": _BINARY},
     "HingeLoss": {"inputs": _BINARY},
@@ -85,7 +106,21 @@ ANALYSIS_SPECS = {
     "PrecisionRecallCurve": {"init": {"buffer_capacity": 64}, "inputs": _BINARY},
     "ROC": {"init": {"buffer_capacity": 64}, "inputs": _BINARY},
     "CohenKappa": {"init": {"num_classes": 4}, "inputs": _LABELS4, "ckpt": _CKPT4},
-    "ConfusionMatrix": {"init": {"num_classes": 4}, "inputs": _LABELS4, "ckpt": _CKPT4, "sharded": {"confmat": 0}},
+    "ConfusionMatrix": {
+        "init": {"num_classes": 4},
+        "inputs": _LABELS4,
+        "ckpt": _CKPT4,
+        "sharded": {"confmat": 0},
+        # one num_classes² int matrix, one fused psum
+        "cost_budget": {
+            "flops_per_step": 2048,
+            "state_bytes": 256,
+            "collectives": 2,
+            "wire_bytes": 256,
+            "copied_bytes": 0,
+            "recompile_risks": 0,
+        },
+    },
     "JaccardIndex": {"init": {"num_classes": 4}, "inputs": _LABELS4, "ckpt": _CKPT4, "sharded": {"confmat": 0}},
     "MatthewsCorrCoef": {"init": {"num_classes": 4}, "inputs": _LABELS4, "ckpt": _CKPT4, "sharded": {"confmat": 0}},
     "KLDivergence": {

@@ -41,3 +41,19 @@ from metrics_tpu.parallel.sync import (  # noqa: F401
     transport_error_bound,
     transport_plan,
 )
+
+# analyzer module-spec surface (--paths audit mode only): sync.py's
+# process-wide mode/cadence/transport defaults are deliberate host-side
+# configuration (A005), and its tracer emits wrap host dispatch, not traced
+# code (A007). lint_class ignores these for jit-facing metric methods.
+ANALYSIS_MODULE_SPECS = {
+    "metrics_tpu/parallel/mesh.py": {
+        "allow": ("A007",),
+        "reason": "mesh bring-up: span emit around host-side device discovery",
+    },
+    "metrics_tpu/parallel/sync.py": {
+        "allow": ("A005", "A007"),
+        "reason": "sync configuration plane: module-level mode/cadence/transport "
+        "defaults and host-dispatch span emits are the design",
+    },
+}

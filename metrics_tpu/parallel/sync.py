@@ -509,9 +509,13 @@ def transport_plan(
     over abstract (``jax.ShapeDtypeStruct``-like) states; nothing is traced.
 
     Each entry: ``{"names", "reduction", "dtype", "kind", "elements",
-    "requested", "transport", "bound", "tolerance", "refusal"}`` where
-    ``transport`` is the post-gate decision and ``refusal`` carries the gate's
-    reason when the requested transport was refused. Leaves named in
+    "requested", "transport", "bound", "tolerance", "refusal", "wire_bytes",
+    "logical_bytes"}`` where ``transport`` is the post-gate decision,
+    ``refusal`` carries the gate's reason when the requested transport was
+    refused, ``wire_bytes`` is the analytic per-device payload the bucket
+    moves on its *final* transport (:func:`transport_wire_bytes` — codec
+    protocol overhead included), and ``logical_bytes`` what the exact path
+    would move for the same bucket. Leaves named in
     ``shard_axes`` plan against the mesh-width-independent ``kind="reshard"``
     bounds, mirroring the runtime routing. ``error_scale`` plans against the
     cadence-compounded bound of the ``error_scale``-th incremental emission
@@ -566,6 +570,8 @@ def transport_plan(
             * max(1.0, float(error_scale)),
             "tolerance": eff_tol,
             "refusal": refusal,
+            "wire_bytes": transport_wire_bytes(final, nelems, dtype),
+            "logical_bytes": nelems * int(np.dtype(dtype).itemsize),
         })
     return plan
 

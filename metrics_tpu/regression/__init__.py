@@ -27,7 +27,18 @@ _VEC = [("float32", (16,)), ("float32", (16,))]
 ANALYSIS_SPECS = {
     "MeanAbsoluteError": {"inputs": _VEC},
     "MeanAbsolutePercentageError": {"inputs": _VEC},
-    "MeanSquaredError": {"inputs": _VEC},
+    "MeanSquaredError": {
+        "inputs": _VEC,
+        # two scalar accumulators, two psums, no copies: tight E117 caps
+        "cost_budget": {
+            "flops_per_step": 256,
+            "state_bytes": 32,
+            "collectives": 3,
+            "wire_bytes": 64,
+            "copied_bytes": 0,
+            "recompile_risks": 0,
+        },
+    },
     "MeanSquaredLogError": {"inputs": _VEC},
     "SymmetricMeanAbsolutePercentageError": {"inputs": _VEC},
     "WeightedMeanAbsolutePercentageError": {"inputs": _VEC},

@@ -1,0 +1,382 @@
+"""Stage-3 cost model and manifest gate, exercised on test-only fixture
+metrics: profile determinism, seeded drift (an extra collective, a dropped
+donation alias) caught by the diff, E117/E118 positive and suppressed paths,
+and A009 over unknown suppression ids.
+
+Fixtures live at module top level (same pattern as ``test_rules.py``) so the
+registry machinery resolves real source when it needs to.
+"""
+import copy
+import json
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu.analysis import _validate_spec_allows, ast_stage
+from metrics_tpu.analysis import costmodel, manifest as manifest_mod
+from metrics_tpu.analysis.registry import Entry
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.parallel import sync as _sync
+
+_SPEC = {"init": {}, "inputs": [("float32", (8,))]}
+
+
+# --------------------------------------------------------------------------- #
+# fixtures: one clean counter and two seeded regressions of it
+# --------------------------------------------------------------------------- #
+class FixtureCounter(Metric):
+    """The clean baseline: one scalar sum state, one fused psum, donation-
+    aliased across consecutive steps."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.total = self.total + jnp.sum(values)
+
+    def compute(self):
+        return self.total
+
+
+class ChattyCounter(FixtureCounter):
+    """Seeded regression: sync emits an extra per-leaf psum on top of the
+    bucketed base sync — the ``new_collective`` drift kind."""
+
+    def sync_states(self, state, axis_name):
+        state = super().sync_states(state, axis_name)
+        return {k: _sync.psum_result(v, axis_name) for k, v in state.items()}
+
+
+class GrowingCounter(Metric):
+    """Seeded regression: the state aval drifts every step (concat growth),
+    so the donated buffer can never be aliased — ``lost_donation_alias``
+    plus a recompile risk."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.zeros((1,)), dist_reduce_fx="sum")
+
+    def update(self, values):
+        self.total = jnp.concatenate(
+            [jnp.atleast_1d(self.total), jnp.atleast_1d(jnp.sum(values))]
+        )
+
+    def compute(self):
+        return jnp.sum(self.total)
+
+
+def _profile(cls, spec=_SPEC):
+    return costmodel.profile_entry(Entry(cls=cls, spec=dict(spec)))
+
+
+def _doc(profile, name="FixtureCounter"):
+    """A minimal manifest document wrapping one profile."""
+    return {"metrics": {name: copy.deepcopy(profile)}}
+
+
+@pytest.fixture(scope="module")
+def clean_profile():
+    return _profile(FixtureCounter)
+
+
+@pytest.fixture(scope="module")
+def chatty_profile():
+    return _profile(ChattyCounter)
+
+
+@pytest.fixture(scope="module")
+def growing_profile():
+    return _profile(GrowingCounter)
+
+
+# --------------------------------------------------------------------------- #
+# profiles
+# --------------------------------------------------------------------------- #
+class TestProfiles:
+    def test_clean_profile_shape(self, clean_profile):
+        p = clean_profile
+        assert "skipped" not in p
+        assert p["flops_per_step"] > 0
+        assert p["state_bytes"] == 4
+        assert p["collectives"]["count"] >= 1
+        assert p["donation"]["copied_bytes"] == 0
+        assert p["donation"]["copied_leaves"] == []
+        assert p["recompile_risks"] == 0
+        assert p["wire"]["total_bytes"] == sum(
+            r["wire_bytes"] for r in p["buckets"]
+        )
+
+    def test_profile_is_deterministic(self, clean_profile):
+        again = _profile(FixtureCounter)
+        assert manifest_mod.canonical_dumps(_doc(clean_profile)) == (
+            manifest_mod.canonical_dumps(_doc(again))
+        )
+
+    def test_chatty_emits_more_collectives(self, clean_profile, chatty_profile):
+        assert (
+            chatty_profile["collectives"]["count"]
+            > clean_profile["collectives"]["count"]
+        )
+
+    def test_growing_loses_donation_alias(self, growing_profile):
+        assert growing_profile["donation"]["copied_leaves"] == ["['total']"]
+        assert growing_profile["donation"]["copied_bytes"] > 0
+        assert growing_profile["recompile_risks"] >= 1
+
+    def test_specless_entry_is_skipped_not_crashed(self):
+        p = costmodel.profile_entry(Entry(cls=FixtureCounter, spec=None))
+        assert "skipped" in p
+
+    def test_canonical_dumps_is_canonical(self, clean_profile):
+        text = manifest_mod.canonical_dumps(_doc(clean_profile))
+        assert text.endswith("\n")
+        assert json.loads(text) == _doc(clean_profile)
+
+
+# --------------------------------------------------------------------------- #
+# diff gate: seeded regressions
+# --------------------------------------------------------------------------- #
+class TestDiffGate:
+    def test_clean_diff_is_empty(self, clean_profile):
+        records = manifest_mod.diff_manifest(
+            _doc(clean_profile), _doc(clean_profile)
+        )
+        assert records == []
+
+    def test_seeded_extra_collective_fails_gate(self, clean_profile, chatty_profile):
+        records = manifest_mod.diff_manifest(
+            _doc(clean_profile), _doc(chatty_profile)
+        )
+        kinds = {r["kind"] for r in records if r["regression"]}
+        assert "new_collective" in kinds
+        assert manifest_mod.gate_failures(records)
+
+    def test_seeded_lost_donation_alias_fails_gate(
+        self, clean_profile, growing_profile
+    ):
+        records = manifest_mod.diff_manifest(
+            _doc(clean_profile), _doc(growing_profile)
+        )
+        kinds = {r["kind"] for r in records if r["regression"]}
+        assert "lost_donation_alias" in kinds
+        assert "new_recompile_risk" in kinds
+        assert manifest_mod.gate_failures(records)
+
+    def test_improvement_never_fails(self, clean_profile, chatty_profile):
+        # recorded chatty, live clean: fewer collectives is a note, not a gate
+        records = manifest_mod.diff_manifest(
+            _doc(chatty_profile), _doc(clean_profile)
+        )
+        assert records  # the stale manifest is reported...
+        assert manifest_mod.gate_failures(records) == []  # ...but passes
+
+    def test_wire_growth_within_tolerance_is_silent(self, clean_profile):
+        bumped = copy.deepcopy(clean_profile)
+        for row in bumped["buckets"]:
+            row["wire_bytes"] += manifest_mod.WIRE_ABS_FLOOR  # inside slack
+        assert (
+            manifest_mod.diff_manifest(_doc(clean_profile), _doc(bumped)) == []
+        )
+
+    def test_wire_growth_beyond_tolerance_fails(self, clean_profile):
+        bumped = copy.deepcopy(clean_profile)
+        for row in bumped["buckets"]:
+            row["wire_bytes"] += 10 * manifest_mod.WIRE_ABS_FLOOR
+        records = manifest_mod.diff_manifest(_doc(clean_profile), _doc(bumped))
+        assert {r["kind"] for r in records} == {"wire_bytes_growth"}
+        assert manifest_mod.gate_failures(records)
+
+    def test_new_and_removed_metric_are_regressions(self, clean_profile):
+        records = manifest_mod.diff_manifest(
+            _doc(clean_profile, name="OldCounter"),
+            _doc(clean_profile, name="NewCounter"),
+        )
+        kinds = sorted(r["kind"] for r in records)
+        assert kinds == ["new_metric", "removed_metric"]
+        assert len(manifest_mod.gate_failures(records)) == 2
+
+    def test_waiver_keeps_record_but_passes_gate(
+        self, clean_profile, chatty_profile
+    ):
+        records = manifest_mod.diff_manifest(
+            _doc(clean_profile),
+            _doc(chatty_profile),
+            waivers={"FixtureCounter": ("new_collective",)},
+        )
+        waived = [r for r in records if r["kind"] == "new_collective"]
+        assert waived and all(r["waived"] for r in waived)
+        assert manifest_mod.gate_failures(records) == []
+
+    def test_collect_waivers_reads_manifest_allow(self):
+        entries = [
+            Entry(
+                cls=FixtureCounter,
+                spec={**_SPEC, "manifest_allow": ("new_collective",)},
+            )
+        ]
+        assert manifest_mod.collect_waivers(entries) == {
+            "FixtureCounter": ("new_collective",)
+        }
+
+
+# --------------------------------------------------------------------------- #
+# E117 / E118
+# --------------------------------------------------------------------------- #
+class TestBudgetRules:
+    def test_e117_fires_on_overrun(self, clean_profile):
+        entries = [
+            Entry(cls=FixtureCounter, spec={**_SPEC, "cost_budget": {"collectives": 0}})
+        ]
+        findings = costmodel.cost_budget_findings(
+            entries, {"FixtureCounter": clean_profile}
+        )
+        assert [f.rule for f in findings] == ["E117"]
+        assert not findings[0].suppressed
+        assert findings[0].extra["field"] == "collectives"
+        assert findings[0].extra["budget"] == 0
+
+    def test_e117_suppressed_by_allow(self, clean_profile):
+        entries = [
+            Entry(
+                cls=FixtureCounter,
+                spec={
+                    **_SPEC,
+                    "cost_budget": {"collectives": 0},
+                    "allow": ("E117",),
+                },
+            )
+        ]
+        findings = costmodel.cost_budget_findings(
+            entries, {"FixtureCounter": clean_profile}
+        )
+        assert [f.suppressed for f in findings] == [True]
+
+    def test_e117_silent_within_budget(self, clean_profile):
+        entries = [
+            Entry(
+                cls=FixtureCounter,
+                spec={**_SPEC, "cost_budget": {"copied_bytes": 0, "recompile_risks": 0}},
+            )
+        ]
+        assert (
+            costmodel.cost_budget_findings(entries, {"FixtureCounter": clean_profile})
+            == []
+        )
+
+    def test_e118_fires_on_drift(self, clean_profile, chatty_profile):
+        records = manifest_mod.diff_manifest(
+            _doc(clean_profile), _doc(chatty_profile)
+        )
+        entries = [Entry(cls=FixtureCounter, spec=dict(_SPEC))]
+        findings = manifest_mod.drift_findings(records, entries)
+        assert any(f.rule == "E118" and not f.suppressed for f in findings)
+
+    def test_e118_suppressed_by_allow_or_waiver(self, clean_profile, chatty_profile):
+        records = manifest_mod.diff_manifest(
+            _doc(clean_profile), _doc(chatty_profile)
+        )
+        entries = [Entry(cls=FixtureCounter, spec={**_SPEC, "allow": ("E118",)})]
+        findings = manifest_mod.drift_findings(records, entries)
+        assert findings and all(f.suppressed for f in findings)
+
+        waived = manifest_mod.diff_manifest(
+            _doc(clean_profile),
+            _doc(chatty_profile),
+            waivers={"FixtureCounter": ("new_collective",)},
+        )
+        entries = [Entry(cls=FixtureCounter, spec=dict(_SPEC))]
+        findings = manifest_mod.drift_findings(waived, entries)
+        assert findings and all(f.suppressed for f in findings)
+
+
+# --------------------------------------------------------------------------- #
+# A009 — unknown suppression ids
+# --------------------------------------------------------------------------- #
+class TestUnknownSuppressions:
+    def test_unknown_allow_rule_id(self):
+        entries = [Entry(cls=FixtureCounter, spec={**_SPEC, "allow": ("E999",)})]
+        findings = _validate_spec_allows(entries)
+        assert [f.rule for f in findings] == ["A009"]
+        assert findings[0].extra == {"unknown": "E999", "where": "allow"}
+
+    def test_unknown_manifest_allow_kind(self):
+        entries = [
+            Entry(cls=FixtureCounter, spec={**_SPEC, "manifest_allow": ("wire_bytez",)})
+        ]
+        findings = _validate_spec_allows(entries)
+        assert [f.extra["where"] for f in findings] == ["manifest_allow"]
+
+    def test_unknown_cost_budget_field(self):
+        entries = [
+            Entry(cls=FixtureCounter, spec={**_SPEC, "cost_budget": {"flopz": 1}})
+        ]
+        findings = _validate_spec_allows(entries)
+        assert [f.extra["where"] for f in findings] == ["cost_budget"]
+
+    def test_known_ids_are_silent(self):
+        entries = [
+            Entry(
+                cls=FixtureCounter,
+                spec={
+                    **_SPEC,
+                    "allow": ("E117", "E118"),
+                    "manifest_allow": ("new_collective",),
+                    "cost_budget": {"collectives": 8},
+                },
+            )
+        ]
+        assert _validate_spec_allows(entries) == []
+
+    def test_inline_unknown_id_flags_a009(self):
+        source = (
+            "import jax.numpy as jnp\n"
+            "x = jnp.zeros(())  # metrics-tpu: allow[E999]\n"
+        )
+        findings = ast_stage.lint_source("fixture.py", source, set())
+        assert any(
+            f.rule == "A009" and f.extra.get("unknown") == "E999" for f in findings
+        )
+
+    def test_inline_known_id_is_silent(self):
+        source = (
+            "import jax.numpy as jnp\n"
+            "x = jnp.zeros(())  # metrics-tpu: allow[A007]\n"
+        )
+        findings = ast_stage.lint_source("fixture.py", source, set())
+        assert not [f for f in findings if f.rule == "A009"]
+
+
+# --------------------------------------------------------------------------- #
+# CLI: the committed manifest gates for real
+# --------------------------------------------------------------------------- #
+@pytest.mark.slow
+def test_cli_diff_catches_seeded_regression(tmp_path):
+    """A doctored committed manifest (recorded collectives lower than live)
+    makes ``--manifest --diff`` exit 1; a missing one exits 2."""
+    committed = manifest_mod.load_manifest()
+    assert committed is not None, "analysis_manifest.json must be committed"
+    committed["metrics"]["Accuracy"]["collectives"]["count"] = 0
+    seeded = tmp_path / "seeded_manifest.json"
+    seeded.write_text(manifest_mod.canonical_dumps(committed))
+
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "metrics_tpu.analysis",
+            "--manifest", "--diff", "--manifest-path", str(seeded),
+        ],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "new_collective" in proc.stdout
+
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "metrics_tpu.analysis",
+            "--manifest", "--diff", "--manifest-path", str(tmp_path / "absent.json"),
+        ],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
