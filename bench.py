@@ -4441,6 +4441,265 @@ def bench_serve() -> None:
         sys.exit(1)
 
 
+def bench_cluster() -> None:
+    """``--cluster``: the scale-out serving tier measured end to end in
+    process — live-migration write-unavailability (fence→cutover downtime
+    p50/p99 over repeated moves of a warm tenant), the routing layer's
+    per-post overhead (shard-aware ``ClusterClient`` vs posting straight
+    into the owner's pipeline), and the 3-seed × 5-site chaos sweep's
+    abort-and-total-rollback pass rate — recorded into ``BENCH_r25.json``
+    and judged by the regression watchdog. Host-side CPU bench."""
+    import glob as _glob
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+    from metrics_tpu.cluster import ClusterClient, ClusterCoordinator
+    from metrics_tpu.observability import regress as _regress
+    from metrics_tpu.resilience import chaos as _chaos
+    from metrics_tpu.serve import IngestPipeline
+
+    n_classes, per_tenant_batch, n_tenants = 16, 64, 8
+    migrations_timed, chaos_seeds = 10, (0, 1, 2)
+    fault_sites = {
+        "cluster/fence": "fence",
+        "cluster/export": "export",
+        "cluster/transfer": "transfer",
+        "cluster/import": "import",
+        "cluster/cutover": "cutover",
+    }
+
+    def build():
+        return MetricCollection(
+            {
+                "acc": Accuracy(num_classes=n_classes, average="micro"),
+                "mse": MeanSquaredError(),
+            }
+        )
+
+    rng = np.random.default_rng(0)
+    ids = [f"t{i}" for i in range(n_tenants)]
+
+    def batch():
+        preds = rng.integers(0, n_classes, size=(per_tenant_batch,)).astype(np.int32)
+        target = rng.integers(0, n_classes, size=(per_tenant_batch,)).astype(np.int32)
+        return preds, target
+
+    coordinator = ClusterCoordinator(
+        {
+            rid: IngestPipeline(build(), name=rid, queue_capacity=2048)
+            for rid in ("r0", "r1")
+        },
+        name="bench",
+    ).start()
+    try:
+        client = ClusterClient(dict(coordinator.replicas), coordinator)
+
+        def drain_all():
+            for replica in coordinator.replicas.values():
+                if replica.alive and not replica.pipeline.drain(60.0):
+                    raise RuntimeError("cluster drain timed out")
+
+        # warm every tenant (admit + trace) so timed phases are steady state
+        for tid in ids:
+            for _ in range(2):
+                doc = client.post(tid, *batch())
+                if not doc.get("admitted"):
+                    raise RuntimeError(f"warmup post rejected: {doc}")
+        drain_all()
+
+        # --- routed-post overhead: ClusterClient vs the owner pipeline ------
+        posts_per_path = 200
+        routed_us, direct_us = [], []
+        for j in range(posts_per_path):
+            tid = ids[j % n_tenants]
+            preds, target = batch()
+            t0 = time.perf_counter()
+            doc = client.post(tid, preds, target)
+            routed_us.append((time.perf_counter() - t0) * 1e6)
+            if not doc.get("admitted"):
+                raise RuntimeError(f"routed post rejected: {doc}")
+        drain_all()
+        owner_pipeline = {
+            tid: coordinator.replicas[coordinator.owner(tid)].pipeline
+            for tid in ids
+        }
+        for j in range(posts_per_path):
+            tid = ids[j % n_tenants]
+            preds, target = batch()
+            pipeline = owner_pipeline[tid]
+            t0 = time.perf_counter()
+            admission = pipeline.post(tid, preds, target)
+            direct_us.append((time.perf_counter() - t0) * 1e6)
+            if not admission.admitted:
+                raise RuntimeError("direct post rejected")
+        drain_all()
+        routed_us.sort()
+        direct_us.sort()
+        routed_p50 = routed_us[len(routed_us) // 2]
+        direct_p50 = direct_us[len(direct_us) // 2]
+        routing = {
+            "posts_per_path": posts_per_path,
+            "routed_p50_us": round(routed_p50, 1),
+            "direct_p50_us": round(direct_p50, 1),
+            "routing_overhead_p50_us": round(routed_p50 - direct_p50, 1),
+            "redirects_followed": client.redirects_followed,
+        }
+
+        # --- migration downtime: fence→cutover over repeated warm moves -----
+        mover = ids[0]
+        downtimes_ms = []
+        for _ in range(migrations_timed):
+            src = coordinator.owner(mover)
+            dst = next(r for r in coordinator.replicas if r != src)
+            record = coordinator.migrate(mover, dst)
+            if record.outcome != "committed":
+                raise RuntimeError(f"timed migration failed: {record.to_dict()}")
+            downtimes_ms.append(record.downtime_s * 1e3)
+            # the tenant keeps serving between moves — state stays warm
+            doc = client.post_with_retry(mover, *batch())
+            if not doc.get("admitted"):
+                raise RuntimeError(f"post-migration post rejected: {doc}")
+        drain_all()
+        downtimes_ms.sort()
+        migration = {
+            "migrations": migrations_timed,
+            "downtime_p50_ms": round(downtimes_ms[len(downtimes_ms) // 2], 2),
+            "downtime_p99_ms": round(
+                downtimes_ms[
+                    min(len(downtimes_ms) - 1, int(len(downtimes_ms) * 0.99))
+                ],
+                2,
+            ),
+            "downtime_max_ms": round(downtimes_ms[-1], 2),
+        }
+
+        # --- chaos sweep: a fault at every phase must abort + roll back -----
+        sweep_pass = sweep_total = 0
+        victim = ids[1]
+        for seed in chaos_seeds:
+            for site, phase in fault_sites.items():
+                sweep_total += 1
+                src = coordinator.owner(victim)
+                dst = next(r for r in coordinator.replicas if r != src)
+                epoch_before = coordinator.shard_map.epoch
+                with _chaos.plan(
+                    [_chaos.FaultSpec(site=site, kind="error", nth=1, times=1)],
+                    seed=seed,
+                ):
+                    record = coordinator.migrate(victim, dst)
+                doc = client.post_with_retry(victim, *batch())
+                ok = (
+                    record.outcome == "aborted"
+                    and record.phase == phase
+                    and coordinator.owner(victim) == src
+                    and coordinator.shard_map.epoch == epoch_before
+                    and victim not in map(
+                        str, coordinator.replicas[dst].tenant_ids()
+                    )
+                    and bool(doc.get("admitted"))
+                )
+                sweep_pass += ok
+                if not ok:
+                    print(
+                        f"[bench] chaos case failed: seed={seed} site={site} "
+                        f"record={record.to_dict()} post={doc}",
+                        file=sys.stderr,
+                    )
+        # chaos disarmed: the same move commits cleanly
+        retry = coordinator.migrate(
+            victim, next(r for r in coordinator.replicas if r != coordinator.owner(victim))
+        )
+        drain_all()
+        outcomes = {"committed": 0, "aborted": 0}
+        for r in coordinator.migrations:
+            outcomes[r.outcome] = outcomes.get(r.outcome, 0) + 1
+        sweep = {
+            "seeds": list(chaos_seeds),
+            "sites": sorted(fault_sites),
+            "cases": sweep_total,
+            "passed": sweep_pass,
+            "pass_rate": round(sweep_pass / sweep_total, 3),
+            "retry_after_sweep": retry.outcome,
+            "migration_outcomes": outcomes,
+        }
+    finally:
+        for replica in coordinator.replicas.values():
+            if replica.alive:
+                replica.stop(drain=False)
+
+    record = {
+        # headline: the tail write-unavailability one live migration costs a
+        # tenant — the number a rebalance planner budgets against
+        "metric": "cluster_migration_downtime_p99_ms",
+        "value": migration["downtime_p99_ms"],
+        "unit": "ms",
+        "extra": {
+            "config": "acc+mse_collection_2replicas_inproc",
+            "num_classes": n_classes,
+            "per_tenant_batch": per_tenant_batch,
+            "tenants": n_tenants,
+            "migration": migration,
+            "routing": routing,
+            "chaos_sweep": sweep,
+        },
+    }
+
+    # watchdog self-check: judge this round against the checked-in trajectory
+    rounds = [
+        r for r in _regress.load_rounds(
+            sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json"))))
+        if r.name != "r25"
+    ]
+    rounds.append(_regress.Round("r25", "<this-run>", record))
+    report = _regress.check_trajectory(rounds)
+    record["extra"]["regress"] = {
+        "ok": report.ok,
+        "regression_count": len(report.regressions),
+        "keys_checked": report.keys_checked,
+        "regressions": [r.describe() for r in report.regressions],
+    }
+
+    with open(os.path.join(REPO, "BENCH_r25.json"), "w") as fh:
+        json.dump(record, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(record), flush=True)
+    problems = []
+    if sweep["pass_rate"] != 1.0:
+        problems.append(
+            f"chaos sweep pass rate {sweep['pass_rate']} != 1.0 "
+            f"({sweep['passed']}/{sweep['cases']})"
+        )
+    if sweep["retry_after_sweep"] != "committed":
+        problems.append("clean migration after the chaos sweep did not commit")
+    if outcomes["aborted"] != sweep_total:
+        problems.append(
+            f"{outcomes['aborted']} aborts recorded, expected exactly the "
+            f"{sweep_total} injected faults"
+        )
+    if migration["downtime_p99_ms"] > 5000.0:
+        problems.append(
+            f"migration downtime p99 {migration['downtime_p99_ms']} ms "
+            "exceeds the 5 s budget"
+        )
+    if routing["routing_overhead_p50_us"] > 500.0:
+        problems.append(
+            f"routing layer adds {routing['routing_overhead_p50_us']} us/post "
+            "(want < 500 us: an owner lookup, not a hop)"
+        )
+    if routing["redirects_followed"] != 0:
+        problems.append("fresh-map posts followed redirects")
+    if not report.ok:
+        problems.extend(r.describe() for r in report.regressions)
+    if problems:
+        print("[bench] cluster round FAILED its gates:", file=sys.stderr)
+        for p in problems:
+            print(f"[bench]   {p}", file=sys.stderr)
+        sys.exit(1)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
@@ -4493,6 +4752,15 @@ def main() -> None:
         "latency (p50/p99) + throughput with zero recompiles, and rejection "
         "behavior at 2x overload against a chaos-stalled consumer; record "
         "into BENCH_r18.json and judge with the regression watchdog",
+    )
+    parser.add_argument(
+        "--cluster",
+        action="store_true",
+        help="measure the scale-out serving tier: live-migration downtime "
+        "p50/p99 over repeated warm moves, shard-aware routed-post overhead "
+        "vs posting straight into the owner pipeline, and the 3-seed x "
+        "5-site chaos sweep's abort+rollback pass rate; record into "
+        "BENCH_r25.json and judge with the regression watchdog",
     )
     parser.add_argument(
         "--checkpoint",
@@ -4598,6 +4866,9 @@ def main() -> None:
         return
     if args.serve:
         bench_serve()
+        return
+    if args.cluster:
+        bench_cluster()
         return
     if args.checkpoint:
         bench_checkpoint()

@@ -255,6 +255,55 @@ def _unbounded_state(entry: Entry, inst: Any) -> List[Finding]:
     ]
 
 
+def _migration_unsafe(entry: Entry, inst: Any) -> List[Finding]:
+    """The E119 leg: state that cannot ride the cluster migration wire.
+
+    Live migration (``metrics_tpu.cluster``) moves a tenant as
+    ``export_tenant -> canonical npz frames -> import_tenant``; the transfer
+    is *planned* — every leaf contributes a fixed byte count and a checksum
+    before the first frame is sent. Two constructions defeat that plan:
+
+    * a **callable** ``dist_reduce_fx`` — the wire carries values only, so
+      the receiving process cannot reconstruct or validate the merge
+      semantics behind the leaf it is importing;
+    * a **capacity-less list state** (``'cat'``/``None`` reduction, no
+      ``buffer_capacity``) — its byte count is data-dependent and unbounded,
+      so no transfer plan or peak-memory bound exists for it.
+
+    A spec that passes ``buffer_capacity`` has already turned its lists into
+    bounded :class:`CatBuffer` leaves (which frame exactly), and sketch
+    states frame component-wise — both are safe and not flagged. This is a
+    warning, not an error: the metric still serves; migrating its tenants is
+    what degrades from a planned, checksummed move to a runtime refusal."""
+    from metrics_tpu.core.buffers import CatBuffer
+
+    unsafe: List[Tuple[str, str]] = []
+    for name in sorted(inst._reductions):
+        red = inst._reductions[name]
+        default = inst._defaults.get(name)
+        if callable(red) and not isinstance(red, str):
+            unsafe.append((name, "callable dist_reduce_fx"))
+        elif isinstance(default, (list, tuple)):
+            unsafe.append((name, f"capacity-less {type(default).__name__} state"))
+        elif isinstance(default, CatBuffer) and default.capacity is None:
+            unsafe.append((name, "CatBuffer with no capacity bound"))
+    if not unsafe:
+        return []
+    detail = ", ".join(f"{name!r} ({why})" for name, why in unsafe)
+    return [
+        Finding(
+            rule="E119",
+            obj=entry.name,
+            message=f"migration-unsafe state: {detail} — export_tenant -> wire -> "
+            f"import_tenant cannot plan or validate these leaves, so live "
+            f"migration of tenants running this metric is refused; declare "
+            f"named reductions and bound buffers with buffer_capacity=N "
+            f"(or a sketch twin) to make the state movable",
+            extra={"states": tuple(name for name, _ in unsafe)},
+        )
+    ]
+
+
 def _evaluate_sharded(entry: Entry, inst: Any, state: Any) -> List[Finding]:
     """The E108 leg: sharded-state sync routing for ``shard_axis`` declarers.
 
@@ -541,6 +590,13 @@ def evaluate_entry(entry: Entry, budget_cap: Optional[int] = None) -> List[Findi
     # E116 runs before the engine-ineligible early exit below — list-state
     # metrics are exactly the unbounded ones it targets
     for f in _unbounded_state(entry, inst):
+        if f.rule in entry.allow:
+            f.suppressed = True
+        findings.append(f)
+
+    # E119 likewise: capacity-less buffers are engine-ineligible, so the
+    # migration-safety verdict must land before the early exit below
+    for f in _migration_unsafe(entry, inst):
         if f.rule in entry.allow:
             f.suppressed = True
         findings.append(f)

@@ -285,6 +285,20 @@ _EVAL_RULES = (
         "--manifest --write` on intentional changes (and commit the result), "
         "or waive a known delta with a `manifest_allow` spec key.",
     ),
+    Rule(
+        "E119", "migration-unsafe-state", WARNING,
+        "this metric's state cannot round-trip the cluster migration wire "
+        "format (export_tenant -> canonical npz -> import_tenant): a "
+        "callable dist_reduce_fx is opaque on the wire (the receiving "
+        "process cannot reconstruct or validate its merge semantics), and a "
+        "capacity-less list state (dist_reduce_fx 'cat' or None with no "
+        "buffer_capacity bound) has no bounded, verifiable framing for the "
+        "streamed transfer plan — live migration of tenants running this "
+        "metric degrades from a planned, checksummed move to a refusal at "
+        "runtime; declare named reductions and construct buffers with "
+        "buffer_capacity=N (or a sketch twin) to make the state movable "
+        "(see docs/cluster_serving.md).",
+    ),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in (*_AST_RULES, *_EVAL_RULES)}

@@ -1,0 +1,85 @@
+"""metrics_tpu.cluster — the scale-out serving tier.
+
+N ingestion replicas (each a full :mod:`metrics_tpu.serve` stack over its own
+TenantSet) own disjoint tenant shards behind a versioned
+:class:`ShardMap` (rendezvous placement, explicit pins). The
+:class:`ClusterCoordinator` is the control plane: it drives live tenant
+migration (fence → drain → export → streamed transfer → import → epoch-bump
+cutover, chaos-proofed so no step is ever lost or double-applied), plans and
+executes rebalances from ledger occupancy, and restores a dead replica's
+shard from its latest verifiable checkpoint while the rest of the cluster
+keeps serving. :class:`ClusterClient` routes directly on a map copy and
+follows ``307 + X-Metrics-Shard-Epoch`` redirects when stale. See
+``docs/cluster_serving.md``.
+"""
+from metrics_tpu.cluster.client import ClusterClient
+from metrics_tpu.cluster.coordinator import ClusterCoordinator, CoordinatorServer
+from metrics_tpu.cluster.migrate import (
+    MigrationError,
+    MigrationRecord,
+    PHASES,
+    run_migration,
+)
+from metrics_tpu.cluster.replica import Replica, ReplicaLost, ShardGate
+from metrics_tpu.cluster.shardmap import Move, ShardMap, plan_rebalance, rendezvous_owner
+from metrics_tpu.cluster.wire import (
+    Frame,
+    TenantTransfer,
+    TransferError,
+    TransferPlan,
+    decode_tenant_snapshot,
+    encode_tenant_snapshot,
+    iter_frames,
+    plan_transfer,
+)
+
+__all__ = [
+    "ClusterClient",
+    "ClusterCoordinator",
+    "CoordinatorServer",
+    "Frame",
+    "MigrationError",
+    "MigrationRecord",
+    "Move",
+    "PHASES",
+    "Replica",
+    "ReplicaLost",
+    "ShardGate",
+    "ShardMap",
+    "TenantTransfer",
+    "TransferError",
+    "TransferPlan",
+    "decode_tenant_snapshot",
+    "encode_tenant_snapshot",
+    "iter_frames",
+    "plan_rebalance",
+    "plan_transfer",
+    "rendezvous_owner",
+    "run_migration",
+]
+
+# analyzer module-spec surface (--paths audit mode only): the cluster tier is
+# host-side control plane — wall-clock phase timings, HTTP threads and the
+# coordinator's process-lifetime registries are the design, exactly like the
+# serve stack it orchestrates.
+ANALYSIS_MODULE_SPECS = {
+    "metrics_tpu/cluster/coordinator.py": {
+        "allow": ("A005", "A007"),
+        "reason": "cluster control plane: wall-clock migration timings and a "
+        "coordinator-lifetime replica registry are the design",
+    },
+    "metrics_tpu/cluster/migrate.py": {
+        "allow": ("A007",),
+        "reason": "migration state machine: host thread stamping phase "
+        "durations and fence windows",
+    },
+    "metrics_tpu/cluster/replica.py": {
+        "allow": ("A007",),
+        "reason": "replica handle: host-side fence/drain verbs around the "
+        "serve stack",
+    },
+    "metrics_tpu/cluster/client.py": {
+        "allow": ("A007",),
+        "reason": "routing client: retry/backoff loops need wall clocks",
+    },
+}

@@ -176,6 +176,7 @@ class InstrumentRegistry:
         self._dispatchers: List[weakref.ref] = []
         self._tenant_sets: List[weakref.ref] = []
         self._ingest_pipelines: List[weakref.ref] = []
+        self._clusters: List[weakref.ref] = []
 
     # ------------------------------------------------------------------ #
     # manual instruments
@@ -318,6 +319,49 @@ class InstrumentRegistry:
                          float(stats.last_width), "gauge",
                          "Distinct tenants in the most recent coalesced dispatch.")
 
+    # ------------------------------------------------------------------ #
+    # cluster-coordinator registration — the scale-out serving tier
+    # ------------------------------------------------------------------ #
+    def register_cluster(self, coordinator: Any) -> None:
+        """Weakly track a :class:`metrics_tpu.cluster.ClusterCoordinator`;
+        shard sizes, the shard-map epoch and replica liveness appear as
+        ``metrics_tpu_cluster_*{cluster=...}`` gauges (migration counters and
+        the fence-duration histogram are ticked by the coordinator itself)."""
+        with self._lock:
+            self._clusters.append(weakref.ref(coordinator))
+
+    def live_clusters(self) -> List[Any]:
+        out, kept = [], []
+        with self._lock:
+            for ref in self._clusters:
+                coordinator = ref()
+                if coordinator is not None:
+                    out.append(coordinator)
+                    kept.append(ref)
+            self._clusters = kept
+        return out
+
+    def _cluster_samples(self) -> Iterable[Sample]:
+        for coordinator in self.live_clusters():
+            labels = {"cluster": coordinator.name}
+            yield Sample(f"{PREFIX}cluster_epoch", dict(labels),
+                         float(coordinator.shard_map.epoch), "gauge",
+                         "Current shard-map epoch (the routing logical clock).")
+            yield Sample(f"{PREFIX}cluster_replicas", dict(labels),
+                         float(len(coordinator.replicas)), "gauge",
+                         "Replicas in the shard map.")
+            dead = sum(1 for r in coordinator.replicas.values() if not r.alive)
+            yield Sample(f"{PREFIX}cluster_replicas_dead", dict(labels), float(dead),
+                         "gauge", "Replicas currently lost (degraded serving).")
+            for replica_id, replica in sorted(coordinator.replicas.items()):
+                if replica.alive:
+                    yield Sample(
+                        f"{PREFIX}cluster_shard_tenants",
+                        {**labels, "replica": replica_id},
+                        float(replica.tenant_set.active_count), "gauge",
+                        "Tenants resident on this replica's shard.",
+                    )
+
     def _tenant_samples(self) -> Iterable[Sample]:
         for ts in self.live_tenant_sets():
             labels = {"owner": ts.name}
@@ -433,6 +477,7 @@ class InstrumentRegistry:
         out.extend(self._partition_samples())
         out.extend(self._tenant_samples())
         out.extend(self._ingest_samples())
+        out.extend(self._cluster_samples())
         out.extend(_autotune_samples())
         out.extend(_process_samples())
         return out
@@ -582,6 +627,11 @@ def register_tenant_set(tenant_set: Any) -> None:
 def register_ingest_pipeline(pipeline: Any) -> None:
     """Module-level convenience over ``REGISTRY.register_ingest_pipeline``."""
     REGISTRY.register_ingest_pipeline(pipeline)
+
+
+def register_cluster(coordinator: Any) -> None:
+    """Module-level convenience over ``REGISTRY.register_cluster``."""
+    REGISTRY.register_cluster(coordinator)
 
 
 def get_registry() -> InstrumentRegistry:

@@ -133,6 +133,18 @@ EVENT_CATALOG: Dict[str, Tuple[str, ...]] = {
         "serve/drain",  # graceful drain: every admitted batch accounted for
         "serve/dead_letter",  # a batch parked on the dead-letter list (args: error)
     ),
+    "cluster": (
+        "cluster/fence",  # migration: src stops admitting the tenant (args: tenant, src, dst)
+        "cluster/drain",  # migration: waiting for the src ledger to settle
+        "cluster/export",  # migration: single-row gather of the tenant's state
+        "cluster/transfer",  # migration: checksummed frames streaming to dst
+        "cluster/import",  # migration: single-row scatter + ledger seed on dst
+        "cluster/cutover",  # migration: shard-map epoch bump pins tenant to dst
+        "cluster/abort",  # migration rolled back (args: phase, error)
+        "cluster/rebalance",  # one rebalance pass (args: moves, committed)
+        "cluster/replica_lost",  # a replica died; cluster serves degraded
+        "cluster/replica_restored",  # lost replica recovered from checkpoint
+    ),
 }
 
 

@@ -48,6 +48,11 @@ Known sites (the registry below is documentation *and* test surface)::
                          rename/size/exists/sha256) — sits *inside* the retry
                          wrapper, so transient faults here exercise RetryPolicy
     server/scrape        one scrape-server GET
+    cluster/<phase>      one live-migration phase boundary (fence/export/
+                         transfer/import/cutover — fires *before* the phase
+                         mutates anything, so an injected fault aborts a move
+                         that has not happened yet) plus cluster/recover on
+                         the checkpoint-restore path of a lost replica
 """
 from __future__ import annotations
 
@@ -91,6 +96,12 @@ KNOWN_SITES = (
     "serve/coalesce",
     "serve/dispatch",
     "serve/read",
+    "cluster/fence",
+    "cluster/export",
+    "cluster/transfer",
+    "cluster/import",
+    "cluster/cutover",
+    "cluster/recover",
 )
 
 

@@ -82,7 +82,10 @@ class Admission:
     admitted: bool
     seq: int = -1
     queue_depth: int = 0
-    reason: str = ""            # "" | "queue_full" | "tenant_cap" | "draining" | "fault"
+    # "" | "queue_full" | "tenant_cap" | "tenant_capacity" | "draining" |
+    # "fault" | "tenant_fenced" (a live migration is moving this tenant) |
+    # "not_owner" (this replica does not own the tenant's shard)
+    reason: str = ""
     retry_after_s: float = 0.0
 
     @property
@@ -174,7 +177,24 @@ class BoundedIngestQueue:
             )
         return Admission(True, seq=obs.seq, queue_depth=depth)
 
-    def _reject(self, obs: Observation, reason: str) -> Admission:
+    def reject(
+        self, obs: Observation, reason: str,
+        retry_after_s: Optional[float] = None,
+    ) -> Admission:
+        """Record a rejection decided *outside* the queue's own bounds.
+
+        The pipeline uses this for admission verdicts the queue cannot see —
+        tenant-set capacity, a per-tenant migration fence, shard ownership —
+        so every rejection ticks the same ``ingest_rejected_total`` counter
+        and carries the same ``Retry-After`` contract.
+        """
+        with self._cond:
+            return self._reject(obs, reason, retry_after_s=retry_after_s)
+
+    def _reject(
+        self, obs: Observation, reason: str,
+        retry_after_s: Optional[float] = None,
+    ) -> Admission:
         # called under the lock
         self.rejected_total += 1
         depth = len(self._items)
@@ -190,7 +210,9 @@ class BoundedIngestQueue:
             )
         return Admission(
             False, queue_depth=depth, reason=reason,
-            retry_after_s=self.retry_after_s,
+            retry_after_s=(
+                self.retry_after_s if retry_after_s is None else float(retry_after_s)
+            ),
         )
 
     # ------------------------------------------------------------------ #

@@ -68,6 +68,10 @@ from metrics_tpu.utils.exceptions import MetricsUserError
 
 PORT_ENV = "METRICS_TPU_SERVE_PORT"
 
+# the shard-map version header on every clustered response; a 307 carries the
+# owning replica in the body (and Location when the owner's URL is known)
+SHARD_EPOCH_HEADER = "X-Metrics-Shard-Epoch"
+
 JSON_CONTENT_TYPE = "application/json"
 NPY_CONTENT_TYPE = "application/x-npy"
 NPZ_CONTENT_TYPE = "application/x-npz"
@@ -143,6 +147,15 @@ class IngestPipeline:
         self._applied: Dict[Any, int] = {}
         self._dead: Dict[Any, int] = {}
         self._known: set = set(tenant_set.tenant_ids())
+        # per-tenant migration fences: tenant -> Retry-After hint (seconds).
+        # A fenced tenant is rejected with "tenant_fenced" (429) while the
+        # cluster tier moves its state — distinct from the global "draining".
+        self._fenced: Dict[Any, float] = {}
+        # optional shard-ownership gate installed by the cluster tier: a
+        # callable ``(tenant_id) -> Optional[dict]`` returning redirect info
+        # ({"owner", "epoch", optional "location"}) for tenants this replica
+        # does not own, or None when the post may proceed.
+        self.shard_gate: Optional[Any] = None
         self.apply_lock = threading.Lock()
         self.dispatcher = Dispatcher(
             tenant_set,
@@ -184,19 +197,106 @@ class IngestPipeline:
         in-process caller sees it for the same reason: surfaced, not silent.
         """
         with self._cond:
+            fence_retry = self._fenced.get(tenant_id)
             over_capacity = (
                 tenant_id not in self._known
                 and len(self._known) >= self.tenant_set.capacity
             )
+        if fence_retry is not None:
+            return self.queue.reject(
+                Observation(tenant_id), "tenant_fenced", retry_after_s=fence_retry,
+            )
+        if self.shard_gate is not None and self.shard_gate.check(tenant_id) is not None:
+            return self.queue.reject(Observation(tenant_id), "not_owner")
         if over_capacity:
-            with self.queue._cond:
-                return self.queue._reject(Observation(tenant_id), "tenant_capacity")
+            return self.queue.reject(Observation(tenant_id), "tenant_capacity")
         admission = self.queue.offer(Observation(tenant_id, args, dict(kwargs)))
         if admission.admitted:
             with self._cond:
                 self._known.add(tenant_id)
                 self._admitted[tenant_id] = self._admitted.get(tenant_id, 0) + 1
         return admission
+
+    # ------------------------------------------------------------------ #
+    # per-tenant fencing + ledger surgery (the cluster migration protocol)
+    # ------------------------------------------------------------------ #
+    def fence_tenant(self, tenant_id: Any, retry_after_s: Optional[float] = None) -> None:
+        """Reject new posts for one tenant with ``"tenant_fenced"`` (429).
+
+        Already-admitted observations keep draining through the dispatcher —
+        fencing is admission control only, so a migration can wait for the
+        ledger to settle (:meth:`drain_tenant`) without pausing other
+        tenants. ``retry_after_s`` is the hint echoed to clients (defaults
+        to the queue's).
+        """
+        with self._cond:
+            self._fenced[tenant_id] = (
+                self.queue.retry_after_s if retry_after_s is None
+                else float(retry_after_s)
+            )
+
+    def unfence_tenant(self, tenant_id: Any) -> None:
+        with self._cond:
+            self._fenced.pop(tenant_id, None)
+            self._cond.notify_all()
+
+    def fenced_tenants(self) -> Tuple[Any, ...]:
+        with self._cond:
+            return tuple(sorted(self._fenced, key=str))
+
+    def pending_steps(self, tenant_id: Any) -> int:
+        """Admitted-but-unaccounted steps for one tenant (queue + in flight)."""
+        with self._cond:
+            return (
+                self._admitted.get(tenant_id, 0)
+                - self._applied.get(tenant_id, 0)
+                - self._dead.get(tenant_id, 0)
+            )
+
+    def drain_tenant(self, tenant_id: Any, timeout: float = 30.0) -> bool:
+        """Block until one tenant's admitted steps are all applied or
+        dead-lettered. Unlike :meth:`drain` this does not close admission —
+        fence the tenant first or the wait may never settle under load.
+        Returns ``False`` on timeout."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: (
+                    self._admitted.get(tenant_id, 0)
+                    - self._applied.get(tenant_id, 0)
+                    - self._dead.get(tenant_id, 0)
+                ) <= 0,
+                timeout,
+            )
+
+    def seed_ledger(self, tenant_id: Any, applied_steps: int) -> None:
+        """Install a migrated tenant's ledger row (admitted == applied).
+
+        Called by the cluster tier after ``import_tenant`` so
+        ``last_applied_step`` continues monotonically on the destination
+        replica instead of restarting at zero.
+        """
+        steps = int(applied_steps)
+        with self._cond:
+            self._known.add(tenant_id)
+            self._admitted[tenant_id] = steps
+            self._applied[tenant_id] = steps
+            self._dead.setdefault(tenant_id, 0)
+            self._cond.notify_all()
+
+    def forget_tenant(self, tenant_id: Any) -> None:
+        """Drop a tenant's ledger row and fence (after migrating it away)."""
+        with self._cond:
+            self._known.discard(tenant_id)
+            self._admitted.pop(tenant_id, None)
+            self._applied.pop(tenant_id, None)
+            self._dead.pop(tenant_id, None)
+            self._fenced.pop(tenant_id, None)
+            self._cond.notify_all()
+
+    def last_applied_steps(self) -> Dict[str, int]:
+        """``{tenant: applied steps}`` — the coordinator's occupancy signal."""
+        with self._cond:
+            return {str(t): self._applied.get(t, 0) for t in sorted(self._known, key=str)}
 
     # ------------------------------------------------------------------ #
     # serve
@@ -348,6 +448,7 @@ class IngestPipeline:
             admitted = dict(self._admitted)
             applied = dict(self._applied)
             dead = dict(self._dead)
+            fenced = tuple(sorted(self._fenced, key=str))
         ts = self.tenant_set
         part = ts.partition_view()
         return {
@@ -366,11 +467,17 @@ class IngestPipeline:
                 "admitted": sum(admitted.values()),
                 "applied": sum(applied.values()),
                 "dead_lettered": sum(dead.values()),
+                "fenced": [str(t) for t in fenced],
                 "per_tenant": {
                     str(t): {
                         "admitted": admitted.get(t, 0),
                         "applied": applied.get(t, 0),
                         "dead_lettered": dead.get(t, 0),
+                        "last_applied_step": applied.get(t, 0),
+                        "pending": max(
+                            0,
+                            admitted.get(t, 0) - applied.get(t, 0) - dead.get(t, 0),
+                        ),
                     }
                     for t in sorted(self._known, key=str)
                 },
@@ -548,18 +655,51 @@ class _IngestHandler(BaseHTTPRequestHandler):
 
     # -------------------------------------------------------------- #
     def _send_json(self, status: int, doc: Dict[str, Any],
-                   retry_after: Optional[str] = None) -> None:
+                   retry_after: Optional[str] = None,
+                   extra_headers: Optional[Dict[str, str]] = None) -> None:
         body = json.dumps(doc).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         if retry_after is not None:
             self.send_header("Retry-After", retry_after)
+        gate = self.ingest_server.pipeline.shard_gate
+        if gate is not None:
+            # every clustered response advertises the map version, so a
+            # client with a stale map learns about a cutover from any reply
+            self.send_header(SHARD_EPOCH_HEADER, str(gate.epoch))
+        for name, value in (extra_headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
     def _tenant_from(self, path: str, prefix: str) -> str:
         return urllib.parse.unquote(path[len(prefix):])
+
+    def _shard_redirect(self, tenant_id: str, prefix: str) -> bool:
+        """Answer ``307 + X-Metrics-Shard-Epoch`` if another replica owns
+        this tenant; returns True when the response was sent."""
+        gate = self.ingest_server.pipeline.shard_gate
+        if gate is None:
+            return False
+        info = gate.check(tenant_id)
+        if info is None:
+            return False
+        headers: Dict[str, str] = {}
+        location = info.get("location")
+        if location:
+            headers["Location"] = f"{location}{prefix}{urllib.parse.quote(str(tenant_id))}"
+        self._send_json(
+            307,
+            {
+                "error": "not_owner",
+                "tenant": tenant_id,
+                "owner": str(info.get("owner")),
+                "epoch": int(info.get("epoch", 0)),
+            },
+            extra_headers=headers,
+        )
+        return True
 
     # -------------------------------------------------------------- #
     def do_POST(self) -> None:  # noqa: N802 — http.server API
@@ -572,6 +712,8 @@ class _IngestHandler(BaseHTTPRequestHandler):
             tenant_id = self._tenant_from(path, "/ingest/")
             if not tenant_id:
                 self._send_json(400, {"error": "missing tenant id"})
+                return
+            if self._shard_redirect(tenant_id, "/ingest/"):
                 return
             length = int(self.headers.get("Content-Length", "0") or "0")
             if length > self.ingest_server.max_body_bytes:
@@ -638,7 +780,10 @@ class _IngestHandler(BaseHTTPRequestHandler):
             path, _, query = self.path.partition("?")
             params = urllib.parse.parse_qs(query)
             if path.startswith("/read/"):
-                self._get_read(self._tenant_from(path, "/read/"), params)
+                tenant_id = self._tenant_from(path, "/read/")
+                if self._shard_redirect(tenant_id, "/read/"):
+                    return
+                self._get_read(tenant_id, params)
             elif path == "/healthz":
                 self._get_healthz()
             elif path == "/stats.json":
@@ -702,14 +847,20 @@ class _IngestHandler(BaseHTTPRequestHandler):
     def _get_healthz(self) -> None:
         pipeline = self.ingest_server.pipeline
         dispatcher = pipeline.dispatcher
+        # queue depth, dead letters and the per-tenant applied watermark are
+        # the coordinator's rebalance inputs — healthz is the one endpoint a
+        # cluster control loop polls, so the occupancy signal lives here too
         self._send_json(200, {
             "status": "degraded" if dispatcher.error else "ok",
             "uptime_s": round(time.monotonic() - pipeline.started_monotonic, 3),
             "queue_depth": len(pipeline.queue),
+            "queue_capacity": pipeline.queue.capacity,
             "draining": pipeline.queue.closed,
             "dispatcher_alive": dispatcher.running,
             "dead_letters": dispatcher.stats.dead_letters,
             "tenants": pipeline.tenant_set.active_count,
+            "fenced_tenants": [str(t) for t in pipeline.fenced_tenants()],
+            "last_applied_step": pipeline.last_applied_steps(),
         })
 
 

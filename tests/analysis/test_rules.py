@@ -420,6 +420,45 @@ class CatReductionMetric(Metric):
         return self.vals.sum()
 
 
+def _pairwise_merge(xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+class CallableReductionMetric(Metric):
+    """E119: a callable ``dist_reduce_fx`` — the migration wire carries
+    values only, so the importing process cannot reconstruct or validate the
+    merge semantics behind the leaf."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.zeros((4,)), dist_reduce_fx=_pairwise_merge)
+
+    def update(self, values):
+        self.total = self.total + values[:4]
+
+    def compute(self):
+        return self.total.sum()
+
+
+class ListBufferMetric(Metric):
+    """E119 (and E116): a capacity-less list state — data-dependent byte
+    count, no transfer plan; bounded by constructing with
+    ``buffer_capacity=N`` (the control spec in the tests)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("vals", default=[], dist_reduce_fx="cat")
+
+    def update(self, values):
+        self.vals.append(values)
+
+    def compute(self):
+        return jnp.concatenate(list(self.vals)).sum()
+
+
 _SPEC = {"init": {}, "inputs": [("float32", (8,))]}
 
 
@@ -700,6 +739,34 @@ class TestEvalStage:
         findings = _evaluate(DeferredPinnedMetric, dict(_SPEC, allow=("E113",)))
         e113 = [f for f in findings if f.rule == "E113"]
         assert e113 and all(f.suppressed for f in e113)
+
+    def test_callable_reduction_is_E119(self):
+        findings = _evaluate(CallableReductionMetric)
+        e119 = [f for f in findings if f.rule == "E119" and not f.suppressed]
+        assert len(e119) == 1, [f.rule for f in findings]
+        assert e119[0].severity == "warning"
+        assert "callable dist_reduce_fx" in e119[0].message
+        assert e119[0].extra["states"] == ("total",)
+
+    def test_capacity_less_buffer_is_E119(self):
+        findings = _evaluate(ListBufferMetric)
+        e119 = [f for f in findings if f.rule == "E119" and not f.suppressed]
+        assert len(e119) == 1, [f.rule for f in findings]
+        assert "capacity-less list state" in e119[0].message
+        assert e119[0].extra["states"] == ("vals",)
+
+    def test_buffer_capacity_bound_silences_E119(self):
+        findings = _evaluate(ListBufferMetric, dict(_SPEC, init={"buffer_capacity": 4}))
+        assert "E119" not in {f.rule for f in findings}
+
+    def test_dense_named_reductions_have_no_E119(self):
+        findings = _evaluate(CleanMetric)
+        assert "E119" not in {f.rule for f in findings}
+
+    def test_E119_is_suppressible_via_spec_allow(self):
+        findings = _evaluate(ListBufferMetric, dict(_SPEC, allow=("E119",)))
+        e119 = [f for f in findings if f.rule == "E119"]
+        assert e119 and all(f.suppressed for f in e119)
 
     def test_missing_spec_is_E002(self):
         findings = eval_stage.evaluate_entry(Entry(cls=CleanMetric, spec=None))

@@ -1,0 +1,87 @@
+"""Cluster suite hygiene and shared builders.
+
+The chaos plan, tracer and instrument registry are process-global (same story
+as the serve suite), and every test builds its own in-process cluster — the
+factory fixture guarantees coordinators are stopped even when an assertion
+fires mid-migration.
+"""
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+from metrics_tpu.observability import tracer as _otrace
+from metrics_tpu.observability.instruments import REGISTRY
+from metrics_tpu.resilience import chaos as _chaos
+from metrics_tpu.serve import IngestPipeline, offline_replay
+from metrics_tpu.serve import server as _iserver
+from metrics_tpu.cluster import ClusterClient, ClusterCoordinator
+
+
+@pytest.fixture(autouse=True)
+def _pristine_cluster_globals():
+    yield
+    _chaos.uninstall()
+    _iserver.shutdown(drain=False, timeout=5.0)
+    _otrace.disable()
+    tracer = _otrace.get_tracer()
+    if tracer is not None:
+        tracer.clear()
+    REGISTRY.clear()
+
+
+def build_collection():
+    return MetricCollection({
+        "acc": Accuracy(num_classes=4, average="micro"),
+        "mse": MeanSquaredError(),
+    })
+
+
+def make_pipeline(name):
+    return IngestPipeline(build_collection(), name=name)
+
+
+@pytest.fixture
+def cluster_factory(tmp_path):
+    made = []
+
+    def make(n_replicas=2, name="cl", checkpoint_root=None):
+        coordinator = ClusterCoordinator(
+            {
+                f"r{i}": make_pipeline(f"{name}-r{i}")
+                for i in range(n_replicas)
+            },
+            name=name,
+            checkpoint_root=str(tmp_path / "ckpt") if checkpoint_root else None,
+        ).start()
+        made.append(coordinator)
+        client = ClusterClient(dict(coordinator.replicas), coordinator)
+        return coordinator, client
+
+    yield make
+    for coordinator in made:
+        coordinator.stop(drain=False, timeout=5.0)
+
+
+def post_stream(client, tenants, steps=3, seed=0):
+    """Post a deterministic stream; returns the admission-ordered oracle log."""
+    rng = np.random.default_rng(seed)
+    log = []
+    for step in range(steps):
+        for tid in tenants:
+            preds = rng.integers(0, 4, size=(8,)).astype(np.int32)
+            target = rng.integers(0, 4, size=(8,)).astype(np.int32)
+            doc = client.post_with_retry(tid, preds, target)
+            assert doc.get("admitted"), doc
+            log.append((tid, (preds, target), {}))
+    return log
+
+
+def assert_matches_oracle(client, log):
+    """Every tenant's served read must equal the pure-protocol replay bitwise."""
+    oracle = offline_replay(build_collection, log)
+    for tid in sorted({t for t, _, _ in log}):
+        doc = client.read(tid, max_staleness_steps=0, timeout_s=30.0)
+        assert doc.get("values") is not None, doc
+        for name, expected in oracle[tid].items():
+            got = np.asarray(doc["values"][name], dtype=expected.dtype)
+            np.testing.assert_array_equal(got, expected, err_msg=f"{tid}/{name}")

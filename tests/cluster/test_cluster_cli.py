@@ -1,0 +1,67 @@
+"""``python -m metrics_tpu.cluster`` — the control-plane verbs end to end.
+
+The non-slow tests drive :func:`main` in-process (same argv surface, no
+interpreter start-up); one slow test proves the real ``python -m`` entry."""
+import json
+import subprocess
+import sys
+
+import pytest
+
+from metrics_tpu.cluster.__main__ import main
+
+pytestmark = pytest.mark.cluster
+
+
+def _run(capsys, argv):
+    code = main(argv)
+    return code, json.loads(capsys.readouterr().out)
+
+
+def test_plan_reports_occupancy_and_moves(capsys):
+    code, doc = _run(capsys, ["plan", "--demo"])
+    assert code == 0
+    assert doc["epoch"] >= 1
+    assert set(doc["occupancy"]) == {"r0", "r1"}
+    for move in doc["moves"]:
+        assert {"tenant", "src", "dst", "weight"} <= set(move)
+
+
+def test_status_demo_prints_the_document(capsys):
+    code, doc = _run(capsys, ["status", "--demo"])
+    assert code == 0
+    assert doc["name"] == "demo"
+    assert sorted(doc["replicas"]) == ["r0", "r1"]
+    assert sum(doc["shard_sizes"].values()) == 8
+
+
+def test_migrate_prints_a_committed_record(capsys):
+    # tenant-0's owner is deterministic (rendezvous), so pick the other side
+    from metrics_tpu.cluster import ShardMap
+
+    dst = "r1" if ShardMap(("r0", "r1")).owner("tenant-0") == "r0" else "r0"
+    code, doc = _run(capsys, ["migrate", "--demo", "--tenant", "tenant-0", "--dst", dst])
+    assert code == 0
+    assert doc["outcome"] == "committed"
+    assert doc["phase"] == "done"
+    assert doc["dst"] == dst
+
+
+def test_rebalance_add_replica_scales_two_to_three(capsys):
+    code, doc = _run(capsys, ["rebalance", "--demo", "--add-replica"])
+    assert code == 0
+    assert set(doc["shard_sizes"]) == {"r0", "r1", "r2"}
+    assert doc["shard_sizes"]["r2"] > 0
+    assert sum(doc["shard_sizes"].values()) == 8
+    assert all(m["outcome"] == "committed" for m in doc["migrations"])
+
+
+@pytest.mark.slow
+def test_python_dash_m_entry_point():
+    out = subprocess.run(
+        [sys.executable, "-m", "metrics_tpu.cluster", "plan", "--demo"],
+        capture_output=True, text=True, timeout=300,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin", "HOME": "/root"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert "occupancy" in json.loads(out.stdout)

@@ -1,0 +1,126 @@
+"""Coordinator control plane: the status document, the read-only HTTP
+endpoints, and the house observability contract (Prometheus series, tracer
+event catalog, chaos sites)."""
+import json
+import urllib.request
+
+import pytest
+
+from metrics_tpu.observability import tracer as _otrace
+from metrics_tpu.observability.instruments import REGISTRY
+from metrics_tpu.observability.tracer import EVENT_CATALOG
+from metrics_tpu.resilience.chaos import KNOWN_SITES
+
+from tests.cluster.conftest import post_stream
+
+pytestmark = pytest.mark.cluster
+
+
+class TestStatusDocument:
+    def test_status_names_every_replica_and_the_map(self, cluster_factory):
+        coordinator, client = cluster_factory(n_replicas=2, name="doc")
+        post_stream(client, ["t0", "t1", "t2"], steps=1)
+        for replica in coordinator.replicas.values():
+            replica.pipeline.drain(30.0)
+        doc = coordinator.status()
+        assert doc["name"] == "doc"
+        assert doc["epoch"] == coordinator.shard_map.epoch
+        assert doc["degraded"] is False
+        assert sorted(doc["replicas"]) == ["r0", "r1"]
+        assert all(r["alive"] for r in doc["replicas"].values())
+        assert sum(doc["shard_sizes"].values()) == 3
+        assert doc["migrations"] == {
+            "total": 0, "committed": 0, "aborted": 0, "last": None,
+        }
+
+    def test_migration_outcomes_land_in_status(self, cluster_factory):
+        coordinator, client = cluster_factory(n_replicas=2, name="mig")
+        post_stream(client, ["t0"], steps=1)
+        src = coordinator.owner("t0")
+        dst = next(r for r in coordinator.replicas if r != src)
+        coordinator.migrate("t0", dst)
+        doc = coordinator.status()
+        assert doc["migrations"]["committed"] == 1
+        assert doc["migrations"]["last"]["tenant"] == "t0"
+        assert doc["pins"] == 1  # the cutover pinned the tenant to its new home
+
+
+class TestCoordinatorServer:
+    def test_http_endpoints_serve_status_shardmap_healthz(self, cluster_factory):
+        coordinator, client = cluster_factory(n_replicas=2, name="httpd")
+        post_stream(client, ["t0"], steps=1)
+        server = coordinator.serve_status(port=0)
+        try:
+            base = server.url
+            with urllib.request.urlopen(f"{base}/status.json", timeout=10) as resp:
+                status = json.loads(resp.read().decode())
+            assert status["name"] == "httpd"
+            with urllib.request.urlopen(f"{base}/shardmap", timeout=10) as resp:
+                shardmap = json.loads(resp.read().decode())
+            assert shardmap["epoch"] == coordinator.shard_map.epoch
+            assert sorted(shardmap["replicas"]) == ["r0", "r1"]
+            with urllib.request.urlopen(f"{base}/healthz", timeout=10) as resp:
+                health = json.loads(resp.read().decode())
+            assert health["status"] == "ok"
+        finally:
+            server.stop()
+
+
+class TestObservabilityContract:
+    def test_cluster_prometheus_series_are_exported(self, cluster_factory):
+        coordinator, client = cluster_factory(n_replicas=2, name="prom")
+        post_stream(client, ["t0", "t1"], steps=1)
+        src = coordinator.owner("t0")
+        dst = next(r for r in coordinator.replicas if r != src)
+        coordinator.migrate("t0", dst)
+        samples = {
+            (s.name, s.labels.get("replica", ""), s.labels.get("outcome", "")): s.value
+            for s in REGISTRY.samples()
+            if s.labels.get("cluster") == "prom"
+        }
+        assert samples[("metrics_tpu_cluster_epoch", "", "")] == float(
+            coordinator.shard_map.epoch
+        )
+        assert samples[("metrics_tpu_cluster_replicas", "", "")] == 2.0
+        assert samples[("metrics_tpu_cluster_replicas_dead", "", "")] == 0.0
+        shard_sizes = coordinator.status()["shard_sizes"]
+        for rid in ("r0", "r1"):
+            assert samples[
+                ("metrics_tpu_cluster_shard_tenants", rid, "")
+            ] == float(shard_sizes[rid])
+        migrated = [
+            value for (name, _, outcome), value in samples.items()
+            if name == "metrics_tpu_cluster_migrations_total"
+            and outcome == "committed"
+        ]
+        assert migrated == [1.0]
+        assert any(
+            s.name.startswith("metrics_tpu_cluster_fence_seconds")
+            for s in REGISTRY.samples()
+            if s.labels.get("cluster") == "prom"
+        )
+
+    def test_migration_emits_cataloged_trace_events(self, cluster_factory):
+        coordinator, client = cluster_factory(n_replicas=2, name="trace")
+        post_stream(client, ["t0"], steps=1)
+        _otrace.enable()
+        try:
+            src = coordinator.owner("t0")
+            dst = next(r for r in coordinator.replicas if r != src)
+            coordinator.migrate("t0", dst)
+        finally:
+            _otrace.disable()
+        tracer = _otrace.get_tracer()
+        names = {e.name for e in tracer.events()}
+        for phase in ("fence", "drain", "export", "transfer", "import", "cutover"):
+            assert f"cluster/{phase}" in names, sorted(names)
+        # every emitted cluster event is in the catalog — no drift
+        catalog = {
+            name for events in EVENT_CATALOG.values() for name in events
+        }
+        cluster_events = {n for n in names if n.startswith("cluster/")}
+        assert cluster_events <= catalog
+
+    def test_chaos_sites_are_registered(self):
+        for phase in ("fence", "export", "transfer", "import", "cutover", "recover"):
+            assert f"cluster/{phase}" in KNOWN_SITES

@@ -1,0 +1,159 @@
+"""ShardMap: deterministic routing, pins, epochs, serde, rebalance planning.
+
+Pure host-side logic — no jax, no servers; this file is the fast half of the
+cluster suite.
+"""
+import json
+import subprocess
+import sys
+
+import pytest
+
+from metrics_tpu.cluster.shardmap import Move, ShardMap, plan_rebalance, rendezvous_owner
+
+pytestmark = pytest.mark.cluster
+
+REPLICAS = ("r0", "r1", "r2")
+
+
+class TestRendezvous:
+    def test_owner_is_deterministic_and_order_independent(self):
+        for tenant in ("t0", "alpha", 42, "tenant-čž"):
+            owner = rendezvous_owner(tenant, REPLICAS)
+            assert owner in REPLICAS
+            assert rendezvous_owner(tenant, REPLICAS[::-1]) == owner
+            assert rendezvous_owner(str(tenant), list(REPLICAS)) == owner
+
+    def test_owner_is_stable_across_processes(self):
+        # the whole point of BLAKE2 over hash(): immune to PYTHONHASHSEED
+        tenants = [f"t{i}" for i in range(16)]
+        script = (
+            "from metrics_tpu.cluster.shardmap import rendezvous_owner;"
+            "import json,sys;"
+            f"print(json.dumps([rendezvous_owner(t, {REPLICAS!r}) for t in {tenants!r}]))"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, check=True,
+            env={"PYTHONHASHSEED": "12345", "PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu"},
+        )
+        assert json.loads(out.stdout) == [rendezvous_owner(t, REPLICAS) for t in tenants]
+
+    def test_minimal_churn_on_growth(self):
+        # rendezvous property: adding a replica only moves tenants *to* it
+        tenants = [f"t{i}" for i in range(64)]
+        before = {t: rendezvous_owner(t, REPLICAS[:2]) for t in tenants}
+        after = {t: rendezvous_owner(t, REPLICAS) for t in tenants}
+        moved = {t for t in tenants if before[t] != after[t]}
+        assert all(after[t] == "r2" for t in moved)
+        assert moved  # and some actually land on the new replica
+
+    def test_empty_replica_list_is_an_error(self):
+        with pytest.raises(ValueError):
+            rendezvous_owner("t", [])
+
+
+class TestShardMap:
+    def test_pins_override_rendezvous_and_bump_epoch(self):
+        m = ShardMap(REPLICAS)
+        natural = m.owner("t0")
+        other = next(r for r in REPLICAS if r != natural)
+        pinned = m.with_pin("t0", other)
+        assert pinned.owner("t0") == other
+        assert pinned.epoch == m.epoch + 1
+        assert m.owner("t0") == natural  # immutable: the old map is untouched
+        unpinned = pinned.without_pin("t0")
+        assert unpinned.owner("t0") == natural
+        assert unpinned.epoch == pinned.epoch + 1
+
+    def test_pin_to_unknown_replica_refused(self):
+        with pytest.raises(ValueError):
+            ShardMap(REPLICAS).with_pin("t0", "nope")
+
+    def test_with_replicas_pins_live_tenants_in_place(self):
+        m = ShardMap(("r0", "r1"))
+        live = [f"t{i}" for i in range(32)]
+        owners = {t: m.owner(t) for t in live}
+        grown = m.with_replicas(("r0", "r1", "r2"), live)
+        # membership change must not re-route any tenant whose state exists
+        assert {t: grown.owner(t) for t in live} == owners
+        assert grown.epoch == m.epoch + 1
+        # fresh tenants may land on the new replica
+        fresh = [t for t in (f"new{i}" for i in range(64)) if grown.owner(t) == "r2"]
+        assert fresh
+
+    def test_cannot_drop_replica_still_owning_pins(self):
+        m = ShardMap(REPLICAS).with_pin("t0", "r2")
+        with pytest.raises(ValueError, match="migrate them away first"):
+            m.with_replicas(("r0", "r1"), ["t0"])
+
+    def test_assignment_partitions_all_tenants(self):
+        m = ShardMap(REPLICAS)
+        tenants = [f"t{i}" for i in range(20)]
+        assignment = m.assignment(tenants)
+        assert sorted(t for ts in assignment.values() for t in ts) == sorted(tenants)
+
+    def test_json_round_trip_is_exact(self):
+        m = ShardMap(REPLICAS, epoch=7, pins={"t1": "r2"})
+        back = ShardMap.from_json(m.to_json())
+        assert back == m
+        assert ShardMap.from_json(back.to_json()).to_json() == m.to_json()
+
+    def test_unsupported_wire_version_refused(self):
+        doc = ShardMap(REPLICAS).to_dict()
+        doc["version"] = 99
+        with pytest.raises(ValueError, match="wire version"):
+            ShardMap.from_dict(doc)
+
+    def test_duplicate_or_empty_replicas_refused(self):
+        with pytest.raises(ValueError):
+            ShardMap(())
+        with pytest.raises(ValueError):
+            ShardMap(("r0", "r0"))
+
+
+class TestPlanRebalance:
+    def test_hot_shard_is_flattened_within_tolerance(self):
+        m = ShardMap(("r0", "r1"))
+        occupancy = {"r0": {"a": 10.0, "b": 8.0, "c": 2.0}, "r1": {"d": 2.0}}
+        moves = plan_rebalance(m, occupancy, tolerance=0.10)
+        assert moves
+        loads = {"r0": 20.0, "r1": 2.0}
+        for mv in moves:
+            assert mv.src == "r0" and mv.dst == "r1"
+            loads[mv.src] -= mv.weight
+            loads[mv.dst] += mv.weight
+        mean = 22.0 / 2
+        assert max(loads.values()) <= mean * 1.10
+
+    def test_plan_is_deterministic(self):
+        m = ShardMap(REPLICAS)
+        occupancy = {
+            "r0": {"a": 5.0, "b": 5.0, "e": 1.0},
+            "r1": {"c": 1.0},
+            "r2": {"d": 1.0},
+        }
+        first = plan_rebalance(m, occupancy)
+        assert first == plan_rebalance(m, dict(reversed(list(occupancy.items()))))
+        assert [m.to_dict() for m in first] == [m.to_dict() for m in first]
+
+    def test_balanced_cluster_proposes_nothing(self):
+        m = ShardMap(("r0", "r1"))
+        assert plan_rebalance(m, {"r0": {"a": 5.0}, "r1": {"b": 5.0}}) == []
+
+    def test_single_giant_tenant_cannot_wedge_or_thrash(self):
+        m = ShardMap(("r0", "r1"))
+        # moving the only tenant would just swap which replica is hot
+        moves = plan_rebalance(m, {"r0": {"whale": 100.0}, "r1": {}})
+        assert moves == []
+
+    def test_max_moves_caps_the_plan(self):
+        m = ShardMap(("r0", "r1"))
+        occupancy = {"r0": {f"t{i}": 4.0 for i in range(6)}, "r1": {}}
+        moves = plan_rebalance(m, occupancy, max_moves=1)
+        assert len(moves) == 1
+        assert isinstance(moves[0], Move)
+
+    def test_unknown_replica_in_occupancy_refused(self):
+        with pytest.raises(ValueError, match="unknown replica"):
+            plan_rebalance(ShardMap(("r0",)), {"rX": {"t": 1.0}})

@@ -1,0 +1,213 @@
+"""The migration wire format: canonical bytes, streamed frames, verification.
+
+Includes the byte-stability regression (satellite of the cluster PR): the
+canonical npz encoding of one snapshot must be identical across processes —
+checkpoints of migrated tenants and the chaos harness's bitwise comparisons
+both lean on it.
+"""
+import hashlib
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from metrics_tpu.core.buffers import CatBuffer
+from metrics_tpu.sketches import CountMinSketch, QuantileSketch
+from metrics_tpu.cluster.wire import (
+    Frame,
+    TenantTransfer,
+    TransferError,
+    decode_tenant_snapshot,
+    encode_tenant_snapshot,
+    iter_frames,
+    plan_transfer,
+)
+
+pytestmark = pytest.mark.cluster
+
+
+def _snapshot():
+    """One snapshot exercising every wire leaf kind and several dtypes."""
+    sketch = CountMinSketch(width=64, depth=2)
+    sketch = sketch.replace(
+        counts=np.arange(128, dtype=np.float32).reshape(2, 64),
+        total=np.asarray(128.0, dtype=np.float32),
+    )
+    qsketch = QuantileSketch()
+    return {
+        "states": {
+            "acc": {
+                "correct": np.asarray(7, dtype=np.int32),        # 0-d int
+                "total": np.asarray(9.5, dtype=np.float64),      # 0-d float
+                "confmat": np.arange(16, dtype=np.uint8).reshape(4, 4),
+                "freqs": sketch,
+            },
+        },
+        "eager_states": {
+            "mse": {
+                "vals": [np.zeros((3,), np.float16), np.ones((3,), np.float16)],
+                "buf": CatBuffer(
+                    np.arange(8, dtype=np.bfloat16 if hasattr(np, "bfloat16") else np.float32),
+                    5, overflowed=True,
+                ),
+                "empty_buf": CatBuffer(None, 0, capacity=12),
+                "mode": "global",                                 # scalar config
+                "quants": qsketch,
+            },
+        },
+        "update_count": 42,
+        "aux": {"mse": {"num_outputs": 1}},
+    }
+
+
+def _assert_snapshots_equal(a, b):
+    assert a["update_count"] == b["update_count"]
+    assert a["aux"] == b["aux"]
+    for group in ("states", "eager_states"):
+        assert sorted(a[group]) == sorted(b[group])
+        for leader in a[group]:
+            assert sorted(a[group][leader]) == sorted(b[group][leader])
+            for state, left in a[group][leader].items():
+                right = b[group][leader][state]
+                _assert_leaf_equal(left, right, f"{group}/{leader}/{state}")
+
+
+def _assert_leaf_equal(left, right, where):
+    if isinstance(left, CatBuffer):
+        assert isinstance(right, CatBuffer), where
+        assert int(np.asarray(right.count)) == int(np.asarray(left.count)), where
+        assert bool(np.asarray(right.overflowed)) == bool(np.asarray(left.overflowed)), where
+        if left.data is None:
+            assert right.data is None and right.capacity == left.capacity, where
+        else:
+            _assert_array_equal(np.asarray(left.data), np.asarray(right.data), where)
+    elif isinstance(left, list):
+        assert isinstance(right, list) and len(right) == len(left), where
+        for i, (l, r) in enumerate(zip(left, right)):
+            _assert_array_equal(np.asarray(l), np.asarray(r), f"{where}[{i}]")
+    elif hasattr(left, "components"):
+        assert type(right).__name__ == type(left).__name__, where
+        assert right.config_dict() == left.config_dict(), where
+        for name, comp in left.components().items():
+            _assert_array_equal(
+                np.asarray(comp), np.asarray(right.components()[name]), f"{where}.{name}"
+            )
+    elif hasattr(left, "dtype"):
+        _assert_array_equal(np.asarray(left), np.asarray(right), where)
+    else:
+        assert left == right, where
+
+
+def _assert_array_equal(left, right, where):
+    assert right.dtype == left.dtype, f"{where}: dtype {right.dtype} != {left.dtype}"
+    assert right.shape == left.shape, f"{where}: shape {right.shape} != {left.shape}"
+    np.testing.assert_array_equal(right, left, err_msg=where)
+
+
+class TestCanonicalEncoding:
+    def test_round_trip_preserves_every_leaf_kind(self):
+        snap = _snapshot()
+        back = decode_tenant_snapshot(encode_tenant_snapshot(snap))
+        _assert_snapshots_equal(snap, back)
+
+    def test_zero_d_arrays_survive(self):
+        # regression: ascontiguousarray silently promoted () to (1,)
+        snap = {"states": {"m": {"x": np.asarray(3.5)}}, "eager_states": {},
+                "update_count": 1, "aux": {}}
+        back = decode_tenant_snapshot(encode_tenant_snapshot(snap))
+        assert back["states"]["m"]["x"].shape == ()
+
+    def test_encoding_is_byte_stable_within_process(self):
+        snap = _snapshot()
+        assert encode_tenant_snapshot(snap) == encode_tenant_snapshot(_snapshot())
+
+    def test_encoding_is_byte_stable_across_process_boundary(self, tmp_path):
+        # satellite: the same snapshot pickled into a fresh interpreter (with a
+        # different hash seed) must encode to the identical bytes
+        snap = _snapshot()
+        blob = encode_tenant_snapshot(snap)
+        payload = tmp_path / "snap.pkl"
+        payload.write_bytes(pickle.dumps(snap))
+        script = (
+            "import pickle, sys, hashlib;"
+            "from metrics_tpu.cluster.wire import encode_tenant_snapshot;"
+            f"snap = pickle.load(open({str(payload)!r}, 'rb'));"
+            "sys.stdout.write(hashlib.sha256(encode_tenant_snapshot(snap)).hexdigest())"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, check=True,
+            env={"PYTHONHASHSEED": "9876", "PATH": "/usr/bin:/bin",
+                 "JAX_PLATFORMS": "cpu", "HOME": "/root"},
+        )
+        assert out.stdout.strip() == hashlib.sha256(blob).hexdigest()
+
+    def test_truncated_blob_is_refused(self):
+        blob = encode_tenant_snapshot(_snapshot())
+        with pytest.raises(TransferError):
+            decode_tenant_snapshot(blob[: len(blob) // 2])
+
+    def test_header_is_required(self):
+        with pytest.raises(TransferError, match="undecodable|header"):
+            decode_tenant_snapshot(b"PK\x05\x06" + b"\x00" * 18)
+
+
+class TestStreamedTransfer:
+    def test_frames_reassemble_bitwise(self):
+        snap = _snapshot()
+        recv = TenantTransfer()
+        for frame in iter_frames(snap, chunk_bytes=97):
+            recv.feed(frame, frame.digest)
+        back = recv.finish()
+        _assert_snapshots_equal(snap, back)
+        assert recv.frames_fed > 3
+
+    def test_peak_memory_is_one_leaf_not_the_gather(self):
+        snap = _snapshot()
+        plan = plan_transfer(snap, chunk_bytes=64)
+        assert plan.plan_peak_bytes < plan.gather_peak_bytes
+        assert plan.total_bytes == plan.gather_peak_bytes
+        ops = [s["op"] for s in plan.steps]
+        assert ops[:3] == ["load", "send", "free"]
+        recv = TenantTransfer()
+        for frame in iter_frames(snap, chunk_bytes=1 << 20):
+            recv.feed(frame, frame.digest)
+        recv.finish()
+        # the receiver never held more than the largest single leaf blob + slop
+        assert recv.peak_bytes <= plan.plan_peak_bytes + 4096
+
+    def test_corrupted_frame_is_detected(self):
+        frames = list(iter_frames(_snapshot(), chunk_bytes=128))
+        recv = TenantTransfer()
+        recv.feed(frames[0], frames[0].digest)
+        bad = Frame(
+            seq=frames[1].seq, leaf=frames[1].leaf, index=frames[1].index,
+            last=frames[1].last, payload=frames[1].payload[:-1] + b"\x00",
+        )
+        with pytest.raises(TransferError, match="digest mismatch|corrupted"):
+            recv.feed(bad, frames[1].digest)
+
+    def test_dropped_frame_is_detected(self):
+        frames = list(iter_frames(_snapshot(), chunk_bytes=128))
+        recv = TenantTransfer()
+        recv.feed(frames[0], frames[0].digest)
+        with pytest.raises(TransferError, match="out of order"):
+            recv.feed(frames[2], frames[2].digest)
+
+    def test_truncated_stream_is_detected_at_finish(self):
+        frames = list(iter_frames(_snapshot(), chunk_bytes=128))
+        recv = TenantTransfer()
+        for frame in frames[:-3]:
+            recv.feed(frame, frame.digest)
+        with pytest.raises(TransferError, match="truncated"):
+            recv.finish()
+
+    def test_leaf_frames_before_manifest_are_refused(self):
+        frames = list(iter_frames(_snapshot(), chunk_bytes=128))
+        recv = TenantTransfer()
+        shifted = Frame(seq=0, leaf=frames[1].leaf, index=0, last=frames[1].last,
+                        payload=frames[1].payload)
+        with pytest.raises(TransferError, match="manifest"):
+            recv.feed(shifted, shifted.digest)
